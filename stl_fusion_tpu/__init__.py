@@ -1,0 +1,31 @@
+"""stl_fusion_tpu — a TPU-native reactive-memoization framework.
+
+A ground-up rebuild of the capabilities of Stl.Fusion (reference:
+/root/reference, C#/.NET) designed TPU-first:
+
+- transparent memoization of async functions into versioned ``Computed``
+  nodes with automatic runtime dependency capture (``@compute_method``);
+- **cascading invalidation** through the dependency DAG — executed on the
+  hot path as batched sparse-BFS frontier expansion over a CSR mirror of
+  the graph in TPU HBM (``stl_fusion_tpu.ops`` / ``graph``), not as the
+  reference's lock-per-node recursive host walk;
+- reactive state containers (``MutableState`` / ``ComputedState``);
+- a command pipeline whose completions replay as invalidations
+  (``commands`` + ``operations``);
+- invalidation-aware RPC with per-call invalidation subscriptions
+  (``rpc`` + ``client``), multi-host invalidation via a durable operation
+  log (``oplog``), and intra-pod frontier exchange over XLA collectives
+  (``parallel``).
+
+See SURVEY.md for the reference structural map this build follows.
+"""
+
+__version__ = "0.1.0"
+
+from .utils import (  # noqa: F401
+    AsyncEvent,
+    LTag,
+    Result,
+    TestClock,
+    TransientError,
+)

@@ -1,0 +1,36 @@
+"""L0 substrate: results, versions, clocks, async primitives, timers.
+
+TPU-native re-expression of the reference's base library (src/Stl/ — see
+SURVEY.md §2.9). Everything above (computed graph, states, commands, RPC,
+device graph mirror) builds on these.
+"""
+from .async_chain import AsyncChain, RetryDelaySeq, WorkerBase
+from .async_utils import (
+    AsyncEvent,
+    AsyncLockSet,
+    Channel,
+    ChannelClosedError,
+    ChannelPair,
+    LockReentryError,
+    create_twisted_pair,
+)
+from .collections import OptionSet, RecentlySeenMap
+from .errors import ExceptionInfo, RemoteError, ServiceError, TransientError, register_exception_type
+from .ltag import ClockBasedVersionGenerator, LTag, LTagVersionGenerator, VersionGenerator
+from .moment import CpuClock, Moment, MomentClock, MomentClockSet, SystemClock, TestClock
+from .result import Result, error, ok
+from .serialization import WireSerializer, decode, dumps, encode, loads, register_wire_type, wire_type
+from .timer_set import ConcurrentTimerSet
+
+__all__ = [
+    "AsyncChain", "RetryDelaySeq", "WorkerBase",
+    "AsyncEvent", "AsyncLockSet", "Channel", "ChannelClosedError", "ChannelPair",
+    "LockReentryError", "create_twisted_pair",
+    "OptionSet", "RecentlySeenMap",
+    "ExceptionInfo", "RemoteError", "ServiceError", "TransientError", "register_exception_type",
+    "ClockBasedVersionGenerator", "LTag", "LTagVersionGenerator", "VersionGenerator",
+    "CpuClock", "Moment", "MomentClock", "MomentClockSet", "SystemClock", "TestClock",
+    "Result", "error", "ok",
+    "WireSerializer", "decode", "dumps", "encode", "loads", "register_wire_type", "wire_type",
+    "ConcurrentTimerSet",
+]

@@ -1,0 +1,131 @@
+"""ConcurrentTimerSet — quantized bulk timers over a min-heap.
+
+Re-expression of the reference's ``ConcurrentTimerSet<TTimer>``
+(src/Stl/Time/ConcurrentTimerSet.cs:12-38) over ``TimerSet`` +
+``RadixHeapSet`` (src/Stl/Collections/RadixHeapSet.cs). Fusion uses two of
+these for keep-alive and auto-invalidation (Fusion/Internal/Timeouts.cs:3-34)
+with 0.2 s quanta — timers fire in batches on quantum ticks, so millions of
+computed nodes share one background task instead of one timer each.
+
+Python build: a single asyncio task per set, a heapq keyed by fire-time, and
+a dict for O(1) add-or-update/remove. Clock-aware so TestClock drives it.
+"""
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+from typing import Callable, Dict, Generic, Hashable, List, Optional, Tuple, TypeVar
+
+from .moment import CpuClock, MomentClock
+
+T = TypeVar("T", bound=Hashable)
+
+__all__ = ["ConcurrentTimerSet"]
+
+
+class ConcurrentTimerSet(Generic[T]):
+    """Bulk timer set: ``add_or_update(item, fire_at)``; fires ``handler(item)``.
+
+    Items are hashable; re-adding an item moves its deadline (stale heap
+    entries are skipped via a sequence check, the standard lazy-deletion
+    heap pattern).
+    """
+
+    def __init__(
+        self,
+        handler: Callable[[T], None],
+        quanta: float = 0.05,
+        clock: Optional[MomentClock] = None,
+        name: str = "timers",
+    ):
+        self._handler = handler
+        self._quanta = quanta
+        self._clock = clock or CpuClock()
+        self._name = name
+        self._heap: List[Tuple[float, int, T]] = []
+        self._entries: Dict[T, int] = {}  # item -> latest seq
+        self._seq = itertools.count()
+        self._task: Optional[asyncio.Task] = None
+        self._wake: Optional[asyncio.Event] = None
+        self._stopped = False
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # -- mutation ----------------------------------------------------------
+    def add_or_update(self, item: T, fire_at: float) -> None:
+        seq = next(self._seq)
+        self._entries[item] = seq
+        heapq.heappush(self._heap, (fire_at, seq, item))
+        self._ensure_running()
+        if self._wake is not None:
+            self._wake.set()
+
+    def add_or_update_to_later(self, item: T, fire_at: float) -> None:
+        """Only move the deadline forward (keep-alive renewal semantics)."""
+        cur = self._current_fire_at(item)
+        if cur is None or fire_at > cur:
+            self.add_or_update(item, fire_at)
+
+    def remove(self, item: T) -> bool:
+        return self._entries.pop(item, None) is not None
+
+    def _current_fire_at(self, item: T) -> Optional[float]:
+        seq = self._entries.get(item)
+        if seq is None:
+            return None
+        for fire_at, s, it in self._heap:
+            if s == seq and it == item:
+                return fire_at
+        return None
+
+    # -- loop --------------------------------------------------------------
+    def _ensure_running(self) -> None:
+        if self._task is None or self._task.done():
+            loop = asyncio.get_event_loop()
+            self._wake = asyncio.Event()
+            self._stopped = False
+            self._task = loop.create_task(self._run(), name=f"timer-set:{self._name}")
+
+    async def _run(self) -> None:
+        assert self._wake is not None
+        while not self._stopped:
+            self._fire_due()
+            if not self._heap:
+                # idle: park until a timer is added
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(self._wake.wait(), timeout=5.0)
+                except asyncio.TimeoutError:
+                    if not self._heap:
+                        return  # park the task entirely; restarted on next add
+                continue
+            await self._clock.delay(self._quanta)
+
+    def _fire_due(self) -> None:
+        now = self._clock.now()
+        while self._heap and self._heap[0][0] <= now:
+            _, seq, item = heapq.heappop(self._heap)
+            if self._entries.get(item) != seq:
+                continue  # stale (updated or removed)
+            del self._entries[item]
+            try:
+                self._handler(item)
+            except Exception:  # noqa: BLE001 — timer handlers must not kill the wheel
+                pass
+
+    def fire_all_due(self) -> None:
+        """Synchronous tick — lets tests drive the wheel with a TestClock."""
+        self._fire_due()
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._wake is not None:
+            self._wake.set()
+        if self._task is not None:
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None

@@ -1,0 +1,227 @@
+"""L0 substrate tests — ports of the reference's primitive unit tests
+(tests/Stl.Tests: AsyncLockSetTest, ConcurrentTimerSetTest, HashSetSlimTest,
+SerializationTest semantics)."""
+import asyncio
+import dataclasses
+
+import pytest
+
+from stl_fusion_tpu.utils import (
+    AsyncEvent,
+    AsyncLockSet,
+    Channel,
+    ChannelClosedError,
+    ConcurrentTimerSet,
+    ExceptionInfo,
+    LockReentryError,
+    LTag,
+    LTagVersionGenerator,
+    OptionSet,
+    RecentlySeenMap,
+    RemoteError,
+    Result,
+    TestClock,
+    create_twisted_pair,
+    dumps,
+    loads,
+    wire_type,
+)
+
+
+# ---------------------------------------------------------------- Result
+
+def test_result_value_and_error():
+    r = Result.ok(42)
+    assert r.has_value and not r.has_error
+    assert r.value == 42
+    e = Result.err(ValueError("boom"))
+    assert e.has_error
+    with pytest.raises(ValueError):
+        _ = e.value
+    assert e.value_or_default is None
+    assert Result.ok(1) == Result.ok(1)
+    assert Result.err(ValueError("x")) == Result.err(ValueError("x"))
+    assert Result.ok(1) != Result.err(ValueError("x"))
+
+
+def test_result_capture_and_map():
+    r = Result.capture(lambda: 1 / 0)
+    assert r.has_error and isinstance(r.error, ZeroDivisionError)
+    assert Result.ok(2).map(lambda x: x * 3).value == 6
+    assert r.map(lambda x: x).has_error
+
+
+# ---------------------------------------------------------------- LTag
+
+def test_ltag_format_parse_roundtrip():
+    for n in (0, 1, 61, 62, 12345678901234):
+        t = LTag(n)
+        assert LTag.parse(t.format()) == t
+    assert LTag(0).is_none
+    assert str(LTag(10)) == "@A"
+
+
+def test_ltag_generator_never_repeats_current():
+    gen = LTagVersionGenerator(seed=1)
+    cur = gen.next()
+    for _ in range(100):
+        nxt = gen.next(cur)
+        assert nxt != cur and nxt != 0
+        cur = nxt
+
+
+# ---------------------------------------------------------------- AsyncEvent
+
+async def test_async_event_chain():
+    ev = AsyncEvent("a")
+    assert ev.is_latest
+
+    async def producer():
+        await asyncio.sleep(0.01)
+        ev.create_next("b").create_next("c")
+
+    task = asyncio.ensure_future(producer())
+    nxt = await ev.when_next()
+    assert nxt.value == "b"
+    assert (await nxt.when_next()).value == "c"
+    assert ev.latest().value == "c"
+    await task
+    hit = await ev.when(lambda v: v == "c")
+    assert hit.value == "c"
+
+
+# ---------------------------------------------------------------- AsyncLockSet
+
+async def test_async_lock_set_serializes_per_key():
+    locks = AsyncLockSet()
+    order = []
+
+    async def work(key, tag, hold):
+        async with locks.lock(key):
+            order.append((key, tag, "in"))
+            await asyncio.sleep(hold)
+            order.append((key, tag, "out"))
+
+    await asyncio.gather(work("k", 1, 0.02), work("k", 2, 0.0), work("other", 3, 0.0))
+    k_events = [(t, io) for key, t, io in order if key == "k"]
+    assert k_events == [(1, "in"), (1, "out"), (2, "in"), (2, "out")]
+    assert len(locks) == 0  # entries dropped when uncontended
+
+
+async def test_async_lock_set_reentry_fails():
+    locks = AsyncLockSet()
+    async with locks.lock("k"):
+        with pytest.raises(LockReentryError):
+            async with locks.lock("k"):
+                pass
+    # different key is fine while holding
+    async with locks.lock("a"):
+        async with locks.lock("b"):
+            pass
+
+
+# ---------------------------------------------------------------- timers
+
+async def test_timer_set_fires_and_updates():
+    clock = TestClock()
+    fired = []
+    timers = ConcurrentTimerSet(fired.append, quanta=0.001, clock=clock)
+    timers.add_or_update("x", clock.now() + 100.0)
+    timers.add_or_update("y", clock.now() + 0.5)
+    timers.add_or_update("x", clock.now() + 0.5)  # move earlier
+    clock.advance(1.0)
+    timers.fire_all_due()
+    assert sorted(fired) == ["x", "y"]
+    fired.clear()
+    timers.add_or_update("z", clock.now() + 0.5)
+    assert timers.remove("z")
+    clock.advance(1.0)
+    timers.fire_all_due()
+    assert fired == []
+    await timers.stop()
+
+
+async def test_timer_set_background_task():
+    fired = asyncio.Event()
+    timers = ConcurrentTimerSet(lambda item: fired.set(), quanta=0.005)
+    import time
+
+    timers.add_or_update("a", time.monotonic() + 0.02)
+    await asyncio.wait_for(fired.wait(), timeout=2.0)
+    await timers.stop()
+
+
+# ---------------------------------------------------------------- channels
+
+async def test_twisted_channel_pair():
+    a, b = create_twisted_pair()
+    await a.writer.send("ping")
+    assert await b.reader.receive() == "ping"
+    await b.writer.send("pong")
+    assert await a.reader.receive() == "pong"
+    a.close()
+    with pytest.raises(ChannelClosedError):
+        await b.reader.receive()
+
+
+async def test_channel_close_wakes_receiver():
+    ch = Channel()
+
+    async def receiver():
+        with pytest.raises(ChannelClosedError):
+            await ch.receive()
+
+    task = asyncio.ensure_future(receiver())
+    await asyncio.sleep(0.01)
+    ch.close()
+    await asyncio.wait_for(task, 1.0)
+
+
+# ---------------------------------------------------------------- misc
+
+def test_recently_seen_map():
+    m = RecentlySeenMap(capacity=3, max_age=100.0)
+    assert m.try_add("a") and not m.try_add("a")
+    assert m.try_add("b") and m.try_add("c") and m.try_add("d")
+    assert "a" not in m  # evicted by capacity
+    assert len(m) == 3
+
+
+def test_option_set():
+    opts = OptionSet()
+    opts.set(42, key="answer")
+    opts.set("hello")
+    assert opts.get(str) == "hello"
+    assert "answer" in opts
+    opts.remove(str)
+    assert opts.get(str) is None
+
+
+# ---------------------------------------------------------------- wire
+
+@wire_type
+@dataclasses.dataclass(frozen=True)
+class _Point:
+    x: int
+    y: int
+
+
+def test_wire_roundtrip():
+    payload = {"k": [1, 2.5, "s", None, True], "p": _Point(1, 2), "b": b"\x00\x01"}
+    out = loads(dumps(payload))
+    assert out["k"] == [1, 2.5, "s", None, True]
+    assert out["p"] == _Point(1, 2)
+    assert out["b"] == b"\x00\x01"
+    assert loads(dumps(LTag(123))) == LTag(123)
+
+
+def test_exception_info_roundtrip():
+    info = ExceptionInfo.capture(ValueError("bad"))
+    exc = info.to_exception()
+    assert isinstance(exc, ValueError) and str(exc) == "bad"
+
+    class Custom(Exception):
+        pass
+
+    remote = ExceptionInfo.capture(Custom("z")).to_exception()
+    assert isinstance(remote, RemoteError) and remote.type_name == "Custom"
