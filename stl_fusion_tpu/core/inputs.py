@@ -1,0 +1,71 @@
+"""ComputedInput — the cache key of a computed node.
+
+Re-expression of src/Stl.Fusion/ComputedInput.cs:5-40 and
+Interception/ComputeMethodInput.cs. An input identifies one memoization
+slot: (function, service instance, normalized arguments). Inputs are
+hashable, compare by value, and resolve their live node through the
+registry (``get_existing_computed``).
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Optional, Tuple
+
+if TYPE_CHECKING:
+    from .computed import Computed
+    from .function import FunctionBase
+
+__all__ = ["ComputedInput", "ComputeMethodInput"]
+
+
+class ComputedInput:
+    """Abstract cache key; subclasses define equality/hash."""
+
+    __slots__ = ("_hash",)
+
+    @property
+    def function(self) -> "FunctionBase":
+        raise NotImplementedError
+
+    def get_existing_computed(self) -> Optional["Computed"]:
+        return self.function.hub.registry.get(self)
+
+    def __hash__(self) -> int:
+        return self._hash
+
+
+class ComputeMethodInput(ComputedInput):
+    """(method, service instance, args) — equality skips nothing because the
+    decorator already strips non-key args (reference skips CancellationToken,
+    ComputeMethodInput.cs:20-23)."""
+
+    __slots__ = ("method_def", "service", "args")
+
+    def __init__(self, method_def, service: Any, args: Tuple):
+        self.method_def = method_def
+        self.service = service
+        self.args = args
+        self._hash = hash((id(method_def), id(service), args))
+
+    @property
+    def function(self) -> "FunctionBase":
+        return self.method_def.get_function(self.service)
+
+    async def invoke_original(self):
+        """Call the user's method body (≈ InvokeOriginalFunction,
+        ComputeMethodInput.cs:32-45)."""
+        return await self.method_def.original(self.service, *self.args)
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            type(other) is ComputeMethodInput
+            and self.method_def is other.method_def  # type: ignore[union-attr]
+            and self.service is other.service  # type: ignore[union-attr]
+            and self.args == other.args  # type: ignore[union-attr]
+        )
+
+    def __hash__(self) -> int:
+        return self._hash
+
+    def __repr__(self) -> str:
+        name = getattr(self.method_def, "name", "?")
+        return f"{type(self.service).__name__}.{name}{self.args!r}"

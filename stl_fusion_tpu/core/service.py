@@ -1,0 +1,123 @@
+"""@compute_method + ComputeService — transparent memoization of async methods.
+
+The TPU-native replacement for the reference's compile-time proxy machinery:
+where Stl.Fusion generates ``{Name}Proxy`` classes via a Roslyn source
+generator and intercepts virtual ``[ComputeMethod]`` calls
+(Stl.Generators/ProxyGenerator.cs, Interception/ComputeServiceInterceptor.cs),
+Python decorators wrap the method directly — same call path, zero codegen:
+
+    class CartService(ComputeService):
+        @compute_method
+        async def get_total(self, cart_id: str) -> float: ...
+
+Every call builds a ``ComputeMethodInput`` key, captures the ambient
+currently-computing node as the dependency edge source, and runs the
+Read→Lock→RetryRead→Compute→Store pipeline (see function.py).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Callable, Optional
+
+from .context import CallOptions, ComputeContext, get_current
+from .function import ComputeMethodFunction
+from .hub import FusionHub, default_hub
+from .inputs import ComputeMethodInput
+from .options import ComputedOptions
+
+__all__ = ["compute_method", "ComputeService", "ComputeMethodDef", "hub_of"]
+
+
+class ComputeMethodDef:
+    """Per-method metadata + per-(hub) function cache
+    (≈ ComputeMethodDef, Interception/ComputeMethodDef.cs)."""
+
+    __slots__ = ("original", "name", "options", "signature", "_functions")
+
+    def __init__(self, original: Callable, options: ComputedOptions):
+        self.original = original
+        self.name = original.__qualname__
+        self.options = options
+        self.signature = inspect.signature(original)
+        self._functions: dict = {}
+
+    def get_function(self, service: Any) -> ComputeMethodFunction:
+        hub = hub_of(service)
+        fn = self._functions.get(id(hub))
+        if fn is None:
+            fn = ComputeMethodFunction(hub, self)
+            self._functions[id(hub)] = fn
+        return fn
+
+    def bind_args(self, service: Any, args: tuple, kwargs: dict) -> tuple:
+        """Normalize (args, kwargs) → canonical positional tuple so
+        ``get(x=1)`` and ``get(1)`` share one cache slot."""
+        if not kwargs:
+            return args
+        bound = self.signature.bind(service, *args, **kwargs)
+        bound.apply_defaults()
+        return tuple(bound.arguments.values())[1:]  # drop self
+
+
+def hub_of(service: Any) -> FusionHub:
+    hub = getattr(service, "_fusion_hub", None)
+    return hub if hub is not None else default_hub()
+
+
+def compute_method(
+    fn: Optional[Callable] = None,
+    *,
+    min_cache_duration: Optional[float] = None,
+    auto_invalidation_delay: Optional[float] = None,
+    invalidation_delay: Optional[float] = None,
+    transient_error_invalidation_delay: Optional[float] = None,
+):
+    """Decorator turning an async method into a memoized compute method.
+
+    ≈ ``[ComputeMethod]`` (ComputeMethodAttribute.cs + ComputedOptions.cs
+    resolution). Options map 1:1 onto ComputedOptions.
+    """
+
+    def decorate(func: Callable) -> Callable:
+        if not inspect.iscoroutinefunction(func):
+            raise TypeError(f"@compute_method requires an async def, got {func!r}")
+        options = ComputedOptions.new(
+            min_cache_duration=min_cache_duration,
+            auto_invalidation_delay=auto_invalidation_delay,
+            invalidation_delay=invalidation_delay,
+            transient_error_invalidation_delay=transient_error_invalidation_delay,
+        )
+        method_def = ComputeMethodDef(func, options)
+
+        @functools.wraps(func)
+        async def wrapper(self, *args, **kwargs):
+            input = ComputeMethodInput(method_def, self, method_def.bind_args(self, args, kwargs))
+            context = ComputeContext.current()
+            # the ambient computing node is the dependency-capture root —
+            # except inside an invalidation replay, where no edges form
+            used_by = None if context.call_options & CallOptions.INVALIDATE else get_current()
+            function = method_def.get_function(self)
+            return await function.invoke_and_strip(input, used_by, context)
+
+        wrapper.__compute_method_def__ = method_def  # type: ignore[attr-defined]
+        return wrapper
+
+    if fn is not None:
+        return decorate(fn)
+    return decorate
+
+
+class ComputeService:
+    """Optional base for compute services: explicit hub binding + helpers.
+
+    Any class works with @compute_method; inheriting this adds hub plumbing
+    (≈ IComputeService marker)."""
+
+    _fusion_hub: Optional[FusionHub] = None
+
+    def __init__(self, hub: Optional[FusionHub] = None):
+        self._fusion_hub = hub
+
+    def _bind_hub(self, hub: FusionHub) -> None:
+        self._fusion_hub = hub

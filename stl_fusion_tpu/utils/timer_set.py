@@ -44,7 +44,7 @@ class ConcurrentTimerSet(Generic[T]):
         self._clock = clock or CpuClock()
         self._name = name
         self._heap: List[Tuple[float, int, T]] = []
-        self._entries: Dict[T, int] = {}  # item -> latest seq
+        self._entries: Dict[T, Tuple[int, float]] = {}  # item -> (latest seq, fire_at)
         self._seq = itertools.count()
         self._task: Optional[asyncio.Task] = None
         self._wake: Optional[asyncio.Event] = None
@@ -56,7 +56,7 @@ class ConcurrentTimerSet(Generic[T]):
     # -- mutation ----------------------------------------------------------
     def add_or_update(self, item: T, fire_at: float) -> None:
         seq = next(self._seq)
-        self._entries[item] = seq
+        self._entries[item] = (seq, fire_at)
         heapq.heappush(self._heap, (fire_at, seq, item))
         self._ensure_running()
         if self._wake is not None:
@@ -64,26 +64,20 @@ class ConcurrentTimerSet(Generic[T]):
 
     def add_or_update_to_later(self, item: T, fire_at: float) -> None:
         """Only move the deadline forward (keep-alive renewal semantics)."""
-        cur = self._current_fire_at(item)
-        if cur is None or fire_at > cur:
+        cur = self._entries.get(item)
+        if cur is None or fire_at > cur[1]:
             self.add_or_update(item, fire_at)
 
     def remove(self, item: T) -> bool:
         return self._entries.pop(item, None) is not None
 
-    def _current_fire_at(self, item: T) -> Optional[float]:
-        seq = self._entries.get(item)
-        if seq is None:
-            return None
-        for fire_at, s, it in self._heap:
-            if s == seq and it == item:
-                return fire_at
-        return None
-
     # -- loop --------------------------------------------------------------
     def _ensure_running(self) -> None:
         if self._task is None or self._task.done():
-            loop = asyncio.get_event_loop()
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return  # no loop: timers fire via fire_all_due() or on next in-loop add
             self._wake = asyncio.Event()
             self._stopped = False
             self._task = loop.create_task(self._run(), name=f"timer-set:{self._name}")
@@ -107,7 +101,8 @@ class ConcurrentTimerSet(Generic[T]):
         now = self._clock.now()
         while self._heap and self._heap[0][0] <= now:
             _, seq, item = heapq.heappop(self._heap)
-            if self._entries.get(item) != seq:
+            entry = self._entries.get(item)
+            if entry is None or entry[0] != seq:
                 continue  # stale (updated or removed)
             del self._entries[item]
             try:

@@ -1,0 +1,338 @@
+"""Computed-core tests — semantics ports of the reference's
+ComputedInterceptorTest, ConcurrencyTest, MinCacheDurationTest
+(tests/Stl.Fusion.Tests)."""
+import asyncio
+import gc
+
+import pytest
+
+from stl_fusion_tpu.core import (
+    AnonymousComputedSource,
+    ComputeService,
+    ConsistencyState,
+    FusionHub,
+    capture,
+    compute_method,
+    get_existing,
+    invalidating,
+    is_invalidating,
+    set_default_hub,
+    try_capture,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub():
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    yield hub
+    set_default_hub(old)
+
+
+class CounterService(ComputeService):
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self.counters = {}
+        self.compute_count = 0
+
+    @compute_method
+    async def get(self, key: str) -> int:
+        self.compute_count += 1
+        return self.counters.get(key, 0)
+
+    @compute_method
+    async def sum2(self, a: str, b: str) -> int:
+        return await self.get(a) + await self.get(b)
+
+    async def increment(self, key: str):
+        self.counters[key] = self.counters.get(key, 0) + 1
+        with invalidating():
+            await self.get(key)
+
+
+# ------------------------------------------------------------------ memoization
+
+async def test_memoization_hit():
+    svc = CounterService()
+    assert await svc.get("a") == 0
+    assert await svc.get("a") == 0
+    assert svc.compute_count == 1  # second call was a cache hit
+    assert await svc.get("b") == 0
+    assert svc.compute_count == 2  # different key computes
+
+
+async def test_kwargs_normalize_to_same_key():
+    svc = CounterService()
+    await svc.get("a")
+    await svc.get(key="a")
+    assert svc.compute_count == 1
+
+
+async def test_invalidation_recomputes():
+    svc = CounterService()
+    assert await svc.get("a") == 0
+    await svc.increment("a")
+    assert await svc.get("a") == 1
+    assert svc.compute_count == 2
+
+
+# ------------------------------------------------------------------ dependency capture
+
+async def test_cascading_invalidation_through_dependency():
+    svc = CounterService()
+    assert await svc.sum2("x", "y") == 0
+    c_sum = await get_existing(lambda: svc.sum2("x", "y"))
+    assert c_sum is not None and c_sum.is_consistent
+    assert len(c_sum.used) == 2  # captured both get() deps
+
+    await svc.increment("x")  # invalidates get(x) -> cascades to sum2
+    assert c_sum.is_invalidated
+    assert await svc.sum2("x", "y") == 1
+
+
+async def test_version_mismatched_edge_does_not_invalidate():
+    svc = CounterService()
+    await svc.sum2("x", "y")
+    old_sum = await get_existing(lambda: svc.sum2("x", "y"))
+    await svc.increment("x")  # old_sum invalidated
+    assert old_sum.is_invalidated
+    new_val = await svc.sum2("x", "y")  # recomputed: new node, new version
+    new_sum = await get_existing(lambda: svc.sum2("x", "y"))
+    assert new_sum is not old_sum and new_sum.is_consistent
+    assert new_val == 1
+
+
+async def test_capture_returns_computed():
+    svc = CounterService()
+    c = await capture(lambda: svc.get("a"))
+    assert c.is_consistent and c.value == 0
+    c2 = await capture(lambda: svc.get("a"))
+    assert c2 is c  # same interned node
+
+
+async def test_get_existing_peeks_without_compute():
+    svc = CounterService()
+    assert await get_existing(lambda: svc.get("a")) is None
+    assert svc.compute_count == 0
+    await svc.get("a")
+    existing = await get_existing(lambda: svc.get("a"))
+    assert existing is not None and existing.value == 0
+    assert svc.compute_count == 1
+
+
+async def test_is_invalidating_scope():
+    assert not is_invalidating()
+    with invalidating():
+        assert is_invalidating()
+    assert not is_invalidating()
+
+
+# ------------------------------------------------------------------ errors
+
+class FailingService(ComputeService):
+    def __init__(self):
+        super().__init__()
+        self.calls = 0
+        self.should_fail = True
+
+    @compute_method(transient_error_invalidation_delay=float("inf"))
+    async def get(self) -> int:
+        self.calls += 1
+        if self.should_fail:
+            raise ValueError("nope")
+        return 42
+
+
+async def test_errors_are_memoized():
+    svc = FailingService()
+    with pytest.raises(ValueError):
+        await svc.get()
+    with pytest.raises(ValueError):
+        await svc.get()
+    assert svc.calls == 1  # error was cached
+    c = await try_capture(lambda: svc.get())
+    assert c is not None and c.output.has_error
+    svc.should_fail = False
+    c.invalidate(immediately=True)
+    assert await svc.get() == 42
+
+
+async def test_transient_error_self_heals(fresh_hub):
+    class S(ComputeService):
+        calls = 0
+
+        @compute_method(transient_error_invalidation_delay=0.02)
+        async def get(self) -> int:
+            S.calls += 1
+            if S.calls == 1:
+                raise RuntimeError("transient")
+            return 7
+
+    svc = S()
+    with pytest.raises(RuntimeError):
+        await svc.get()
+    await asyncio.sleep(0.15)  # timer wheel invalidates the error node
+    assert await svc.get() == 7
+
+
+# ------------------------------------------------------------------ single flight
+
+async def test_concurrent_calls_compute_once():
+    class Slow(ComputeService):
+        calls = 0
+
+        @compute_method
+        async def get(self, k: str) -> str:
+            Slow.calls += 1
+            await asyncio.sleep(0.02)
+            return k * 2
+
+    svc = Slow()
+    results = await asyncio.gather(*(svc.get("z") for _ in range(20)))
+    assert all(r == "zz" for r in results)
+    assert Slow.calls == 1
+
+
+async def test_invalidate_while_computing_defers():
+    """A node invalidated mid-compute lands invalidated (the flag dance)."""
+    started = asyncio.Event()
+    release = asyncio.Event()
+
+    class Slow(ComputeService):
+        @compute_method
+        async def get(self) -> int:
+            started.set()
+            await release.wait()
+            return 1
+
+    svc = Slow()
+    task = asyncio.ensure_future(svc.get())
+    await started.wait()
+    existing = await get_existing(lambda: svc.get())
+    # node is registered while computing; invalidate it mid-flight
+    assert existing is not None
+    assert existing.consistency_state == ConsistencyState.COMPUTING
+    existing.invalidate(immediately=True)
+    release.set()
+    assert await task == 1  # the call still returns its value
+    assert existing.is_invalidated  # but the node is born invalidated
+
+
+# ------------------------------------------------------------------ GC / keep-alive
+
+async def test_unreferenced_node_is_collected():
+    class Weak(ComputeService):
+        @compute_method(min_cache_duration=0.0)  # pure-weak: no keep-alive
+        async def get(self, k: str) -> int:
+            return 0
+
+    svc = Weak()
+    await svc.get("gc-me")
+    gc.collect()
+    assert await get_existing(lambda: svc.get("gc-me")) is None  # weak entry died
+
+
+async def test_min_cache_duration_keeps_alive():
+    class Cached(ComputeService):
+        calls = 0
+
+        @compute_method(min_cache_duration=30.0)
+        async def get(self) -> int:
+            Cached.calls += 1
+            return 5
+
+    svc = Cached()
+    await svc.get()
+    gc.collect()
+    assert await get_existing(lambda: svc.get()) is not None  # keep-alive holds it
+    assert await svc.get() == 5
+    assert Cached.calls == 1
+
+
+async def test_dependents_keep_dependencies_alive():
+    class Weak(ComputeService):
+        @compute_method(min_cache_duration=0.0)
+        async def get(self, k: str) -> int:
+            return 1
+
+        @compute_method(min_cache_duration=0.0)
+        async def sum2(self, a: str, b: str) -> int:
+            return await self.get(a) + await self.get(b)
+
+    svc = Weak()
+    c_sum = await capture(lambda: svc.sum2("p", "q"))
+    gc.collect()
+    # deps are strongly held by c_sum (_used edges are strong refs)
+    assert await get_existing(lambda: svc.get("p")) is not None
+    del c_sum
+    gc.collect()
+    assert await get_existing(lambda: svc.get("p")) is None
+
+
+# ------------------------------------------------------------------ when/changes
+
+async def test_when_invalidated_and_changes():
+    svc = CounterService()
+    c = await capture(lambda: svc.get("w"))
+    fut = c.when_invalidated()
+    assert not fut.done()
+    await svc.increment("w")
+    await asyncio.wait_for(fut, 1.0)
+
+    seen = []
+
+    async def watcher():
+        c0 = await capture(lambda: svc.get("w"))
+        async for snapshot in c0.changes():
+            seen.append(snapshot.value)
+            if snapshot.value >= 3:
+                return
+
+    task = asyncio.ensure_future(watcher())
+    await asyncio.sleep(0.01)
+    await svc.increment("w")
+    await asyncio.sleep(0.01)
+    await svc.increment("w")
+    await asyncio.wait_for(task, 2.0)
+    assert seen == [1, 2, 3]
+
+
+# ------------------------------------------------------------------ anonymous source
+
+async def test_anonymous_computed_source():
+    calls = 0
+
+    async def compute(source):
+        nonlocal calls
+        calls += 1
+        return calls * 10
+
+    src = AnonymousComputedSource(compute)
+    assert await src.use() == 10
+    assert await src.use() == 10
+    assert calls == 1
+    src.invalidate()
+    assert await src.use() == 20
+
+
+async def test_anonymous_source_as_dependency():
+    src = AnonymousComputedSource(lambda s: _value())
+    state = {"v": 1}
+
+    async def _value():
+        return state["v"]
+
+    src.computer = lambda s: _value()
+
+    class S(ComputeService):
+        @compute_method
+        async def doubled(self) -> int:
+            return 2 * await src.use()
+
+    svc = S()
+    assert await svc.doubled() == 2
+    doubled = await get_existing(lambda: svc.doubled())
+    state["v"] = 5
+    src.invalidate()  # cascades into doubled()
+    assert doubled.is_invalidated
+    assert await svc.doubled() == 10
