@@ -1,0 +1,141 @@
+"""State container tests — MutableState/ComputedState semantics
+(reference: tests/Stl.Fusion.Tests StateTest patterns)."""
+import asyncio
+
+import pytest
+
+from stl_fusion_tpu.core import ComputeService, FusionHub, compute_method, set_default_hub
+from stl_fusion_tpu.state import ComputedState, FixedDelayer, MutableState, StateFactory
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub():
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    yield hub
+    set_default_hub(old)
+
+
+async def test_mutable_state_set_is_synchronous():
+    s = MutableState(1)
+    assert s.value == 1
+    s.set(2)
+    assert s.value == 2  # no await needed
+    s.set_error(ValueError("bad"))
+    assert isinstance(s.error, ValueError)
+    assert s.last_non_error_value == 2
+    s.set(3)
+    assert s.value == 3
+    assert s.snapshot.update_count == 3
+
+
+async def test_mutable_state_invalidates_dependents():
+    price = MutableState(10)
+    qty = MutableState(3)
+
+    class Cart(ComputeService):
+        calls = 0
+
+        @compute_method
+        async def total(self) -> int:
+            Cart.calls += 1
+            return await price.use() * await qty.use()
+
+    svc = Cart()
+    assert await svc.total() == 30
+    assert await svc.total() == 30
+    assert Cart.calls == 1
+    price.set(20)
+    assert await svc.total() == 60
+    qty.set(5)
+    assert await svc.total() == 100
+    assert Cart.calls == 3
+
+
+async def test_computed_state_update_cycle():
+    source = MutableState(1)
+    seen = []
+
+    async def compute():
+        v = await source.use()
+        seen.append(v)
+        return v * 100
+
+    state = StateFactory().new_computed(compute, update_delayer=FixedDelayer.ZERO_UNSAFE)
+    try:
+        await state.when_first_value()
+        assert state.value == 100
+        source.set(2)
+        await asyncio.sleep(0.05)  # update cycle: invalidate -> recompute
+        assert state.value == 200
+        source.set(3)
+        await asyncio.sleep(0.05)
+        assert state.value == 300
+        assert seen == [1, 2, 3]
+    finally:
+        await state.dispose()
+
+
+async def test_computed_state_retry_on_error():
+    attempts = 0
+
+    async def compute():
+        nonlocal attempts
+        attempts += 1
+        if attempts < 3:
+            raise RuntimeError("flaky")
+        return "ok"
+
+    from stl_fusion_tpu.core import ComputedOptions
+    from stl_fusion_tpu.state import UpdateDelayer
+    from stl_fusion_tpu.utils import RetryDelaySeq
+
+    state = ComputedState(
+        compute,
+        options=ComputedOptions.new(transient_error_invalidation_delay=0.01),
+        update_delayer=UpdateDelayer(retry_delays=RetryDelaySeq(min_delay=0.01, max_delay=0.02)),
+    )
+    state.start()
+    try:
+        for _ in range(300):
+            await asyncio.sleep(0.01)
+            if state._snapshot is not None and state.snapshot.last_non_error_computed is not None:
+                break
+        assert state.last_non_error_value == "ok"
+        assert attempts >= 3
+        assert state.snapshot.error_count >= 2
+    finally:
+        await state.dispose()
+
+
+async def test_state_changes_stream():
+    s = MutableState(0)
+    got = []
+
+    async def watcher():
+        async for c in s.changes():
+            got.append(c.output.value)
+            if c.output.value >= 2:
+                return
+
+    task = asyncio.ensure_future(watcher())
+    await asyncio.sleep(0.02)
+    s.set(1)
+    await asyncio.sleep(0.02)
+    s.set(2)
+    await asyncio.wait_for(task, 2.0)
+    assert got == [0, 1, 2]
+
+
+async def test_when_predicate():
+    s = MutableState(0)
+
+    async def bump():
+        for i in range(1, 5):
+            await asyncio.sleep(0.01)
+            s.set(i)
+
+    task = asyncio.ensure_future(bump())
+    c = await s.when(lambda v: v >= 3)
+    assert c.output.value >= 3
+    await task
