@@ -1,0 +1,15 @@
+"""CommandR — the CQRS command pipeline (SURVEY.md §2.3)."""
+from .commander import Commander, LocalCommand
+from .context import CommandContext, current_command_context
+from .handlers import CommandHandler, HandlerRegistry, command_filter, command_handler
+
+__all__ = [
+    "Commander",
+    "LocalCommand",
+    "CommandContext",
+    "current_command_context",
+    "CommandHandler",
+    "HandlerRegistry",
+    "command_filter",
+    "command_handler",
+]

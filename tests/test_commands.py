@@ -1,0 +1,243 @@
+"""Command pipeline + operations framework tests, ending in the HelloCart v1
+end-to-end slice (reference: samples/HelloCart — Product→Cart→Total chain,
+transparent caching, command-driven cascading invalidation, Changes() watch)."""
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import pytest
+
+from stl_fusion_tpu.core import (
+    ComputeService,
+    FusionHub,
+    compute_method,
+    get_existing,
+    is_invalidating,
+    set_default_hub,
+)
+from stl_fusion_tpu.commands import command_filter, command_handler
+from stl_fusion_tpu.utils import TransientError
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub():
+    hub = FusionHub()
+    hub.commander.attach_operations_pipeline()
+    old = set_default_hub(hub)
+    yield hub
+    set_default_hub(old)
+
+
+# ------------------------------------------------------------------ plain commands
+
+@dataclass(frozen=True)
+class Greet:
+    name: str
+
+
+async def test_basic_command_dispatch(fresh_hub):
+    class Svc:
+        @command_handler
+        async def greet(self, command: Greet) -> str:
+            return f"hello {command.name}"
+
+    fresh_hub.commander.add_service(Svc())
+    assert await fresh_hub.commander.call(Greet("tpu")) == "hello tpu"
+
+
+async def test_filter_ordering(fresh_hub):
+    trace = []
+
+    class Svc:
+        @command_filter(priority=10)
+        async def outer(self, command: Greet, context):
+            trace.append("outer-in")
+            r = await context.invoke_remaining_handlers()
+            trace.append("outer-out")
+            return r
+
+        @command_filter(priority=5)
+        async def inner(self, command: Greet, context):
+            trace.append("inner-in")
+            r = await context.invoke_remaining_handlers()
+            trace.append("inner-out")
+            return r
+
+        @command_handler
+        async def run(self, command: Greet) -> str:
+            trace.append("handler")
+            return command.name
+
+    fresh_hub.commander.add_service(Svc())
+    assert await fresh_hub.commander.call(Greet("x")) == "x"
+    pattern = ["outer-in", "inner-in", "handler", "inner-out", "outer-out"]
+    # the chain runs twice: once live, once as the invalidation replay
+    assert trace == pattern * 2
+
+
+async def test_missing_handler_raises(fresh_hub):
+    with pytest.raises(LookupError):
+        await fresh_hub.commander.call(Greet("nobody"))
+
+
+# ------------------------------------------------------------------ reprocessor
+
+async def test_transient_error_retry(fresh_hub):
+    attempts = []
+
+    class Svc:
+        @command_handler
+        async def flaky(self, command: Greet) -> str:
+            if is_invalidating():
+                return "done"
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise TransientError("not yet")
+            return "done"
+
+    fresh_hub.commander.add_service(Svc())
+    assert await fresh_hub.commander.call(Greet("retry")) == "done"
+    assert len(attempts) == 3
+
+
+# ------------------------------------------------------------------ HelloCart v1
+
+@dataclass(frozen=True)
+class Product:
+    id: str
+    price: float
+
+
+@dataclass(frozen=True)
+class Cart:
+    id: str
+    item_ids: tuple
+
+
+@dataclass(frozen=True)
+class EditProduct:
+    product: Product
+
+
+class ProductService(ComputeService):
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self._products: Dict[str, Product] = {}
+
+    @compute_method
+    async def get(self, product_id: str) -> Optional[Product]:
+        return self._products.get(product_id)
+
+    @command_handler
+    async def edit(self, command: EditProduct) -> None:
+        if is_invalidating():
+            await self.get(command.product.id)  # marks get(id) invalid
+            return
+        self._products[command.product.id] = command.product
+
+
+class CartService(ComputeService):
+    def __init__(self, products: ProductService, hub=None):
+        super().__init__(hub)
+        self.products = products
+        self._carts: Dict[str, Cart] = {}
+        self.total_computes = 0
+
+    def add_cart(self, cart: Cart):
+        self._carts[cart.id] = cart
+
+    @compute_method
+    async def get_total(self, cart_id: str) -> float:
+        self.total_computes += 1
+        cart = self._carts.get(cart_id)
+        if cart is None:
+            return 0.0
+        total = 0.0
+        for pid in cart.item_ids:
+            p = await self.products.get(pid)
+            if p is not None:
+                total += p.price
+        return total
+
+
+async def test_hello_cart_end_to_end(fresh_hub):
+    products = ProductService()
+    carts = CartService(products)
+    fresh_hub.commander.add_service(products)
+
+    await fresh_hub.commander.call(EditProduct(Product("apple", 2.0)))
+    await fresh_hub.commander.call(EditProduct(Product("banana", 1.0)))
+    carts.add_cart(Cart("c1", ("apple", "banana")))
+
+    # transparent caching
+    assert await carts.get_total("c1") == 3.0
+    assert await carts.get_total("c1") == 3.0
+    assert carts.total_computes == 1
+
+    # command → operation → completion → invalidation replay → cascade
+    await fresh_hub.commander.call(EditProduct(Product("apple", 5.0)))
+    total_node = await get_existing(lambda: carts.get_total("c1"))
+    assert total_node is None or total_node.is_invalidated
+    assert await carts.get_total("c1") == 6.0
+    assert carts.total_computes == 2
+
+
+async def test_hello_cart_changes_watch_loop(fresh_hub):
+    """The sample's `Changes()` watcher: totals stream in as edits land."""
+    products = ProductService()
+    carts = CartService(products)
+    fresh_hub.commander.add_service(products)
+    await fresh_hub.commander.call(EditProduct(Product("apple", 2.0)))
+    carts.add_cart(Cart("c1", ("apple",)))
+
+    from stl_fusion_tpu.core import capture
+
+    totals: List[float] = []
+
+    async def watch():
+        c = await capture(lambda: carts.get_total("c1"))
+        async for snapshot in c.changes():
+            totals.append(snapshot.output.value)
+            if len(totals) == 3:
+                return
+
+    task = asyncio.ensure_future(watch())
+    await asyncio.sleep(0.02)
+    await fresh_hub.commander.call(EditProduct(Product("apple", 10.0)))
+    await asyncio.sleep(0.02)
+    await fresh_hub.commander.call(EditProduct(Product("apple", 20.0)))
+    await asyncio.wait_for(task, 2.0)
+    assert totals == [2.0, 10.0, 20.0]
+
+
+# ------------------------------------------------------------------ nested commands
+
+@dataclass(frozen=True)
+class EditBoth:
+    a: Product
+    b: Product
+
+
+async def test_nested_command_replay(fresh_hub):
+    products = ProductService()
+    carts = CartService(products)
+
+    class BulkService(ComputeService):
+        @command_handler
+        async def edit_both(self, command: EditBoth, context) -> None:
+            if is_invalidating():
+                return  # nested EditProduct commands replay on their own
+            await fresh_hub.commander.call(EditProduct(command.a))
+            await fresh_hub.commander.call(EditProduct(command.b))
+
+    fresh_hub.commander.add_service(products)
+    fresh_hub.commander.add_service(BulkService())
+
+    await fresh_hub.commander.call(EditProduct(Product("x", 1.0)))
+    await fresh_hub.commander.call(EditProduct(Product("y", 1.0)))
+    carts.add_cart(Cart("c", ("x", "y")))
+    assert await carts.get_total("c") == 2.0
+
+    # nested commands run inside ONE outer operation; replay must reach both
+    await fresh_hub.commander.call(EditBoth(Product("x", 3.0), Product("y", 4.0)))
+    assert await carts.get_total("c") == 7.0
