@@ -198,6 +198,36 @@ class Computed(Generic[T]):
             hub.on_invalidated(node)
         return transitioned
 
+    def invalidate_local(self) -> bool:
+        """Single-node invalidation WITHOUT cascading — used when a device
+        wave already computed the full transitive closure and the host just
+        applies it (stl_fusion_tpu.graph.TpuGraphBackend)."""
+        with self._lock:
+            state = self._state
+            if state == ConsistencyState.INVALIDATED:
+                return False
+            if state == ConsistencyState.COMPUTING:
+                self._invalidate_on_set_output = True
+                return False
+            self._state = int(ConsistencyState.INVALIDATED)
+            handlers = self._invalidated_handlers
+            self._invalidated_handlers = None
+            used = list(self._used)
+            self._used.clear()
+            self._used_by.clear()
+        hub = self._hub()
+        hub.timeouts.cancel(self)
+        if handlers:
+            for h in handlers:
+                try:
+                    h(self)
+                except Exception:  # noqa: BLE001
+                    log.exception("invalidation handler failed for %r", self)
+        for u in used:
+            u._remove_used_by(self)
+        hub.on_invalidated(self)
+        return True
+
     def on_invalidated(self, handler: Callable[["Computed"], None]) -> None:
         """Attach an invalidation handler; fires immediately if already invalid."""
         fire_now = False

@@ -1,0 +1,5 @@
+"""Host↔device graph backend: DeviceGraph container + live hub mirror."""
+from .backend import TpuGraphBackend
+from .device_graph import DeviceGraph
+
+__all__ = ["TpuGraphBackend", "DeviceGraph"]

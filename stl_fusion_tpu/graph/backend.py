@@ -1,0 +1,141 @@
+"""TpuGraphBackend — live mirror of a FusionHub's dependency graph on device.
+
+The bridge between the authoritative host graph (ComputedRegistry + per-node
+edge sets) and the device CSR mirror (DeviceGraph): registry/edge/invalidate
+events stream in through the hub hooks, batch up host-side, and flush to
+device before each wave. ``invalidate_cascade`` then offloads the transitive
+invalidation closure to the TPU kernel and applies the result back to host
+nodes via ``Computed.invalidate_local`` (no host cascade — the device already
+walked the graph).
+
+Host↔device coherence (SURVEY.md "hard parts"): every mutation is buffered
+with a monotonically growing pending list and flushed under a single lock
+before any wave runs, so a wave never observes half an edge batch. Epoch
+bumps happen at node *registration* (compute start), matching the host rule
+that edges captured during a compute belong to the new version.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import weakref
+from typing import TYPE_CHECKING, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .device_graph import DeviceGraph
+
+if TYPE_CHECKING:
+    from ..core.computed import Computed
+    from ..core.hub import FusionHub
+    from ..core.inputs import ComputedInput
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["TpuGraphBackend"]
+
+
+class TpuGraphBackend:
+    def __init__(self, hub: "FusionHub", node_capacity: int = 4096, edge_capacity: int = 16384):
+        self.hub = hub
+        self.graph = DeviceGraph(node_capacity, edge_capacity)
+        self._lock = threading.Lock()
+        self._id_by_input: Dict["ComputedInput", int] = {}
+        self._computed_by_id: Dict[int, "weakref.ref[Computed]"] = {}
+        # ordered event journal: ("bump", nid) | ("edge", (src, dst)) |
+        # ("invalid", nid). Order preserves causality — an invalidation mark
+        # buffered before a node's recompute-bump must not survive it.
+        self._journal: List[Tuple[str, object]] = []
+        self.waves_run = 0
+        self.device_invalidations = 0
+        hub.registry.on_register.append(self._on_register)
+        hub.edge_added_hooks.append(self._on_edge_added)
+        hub.invalidated_hooks.append(self._on_invalidated)
+        hub.attach_graph_backend(self)
+
+    # ------------------------------------------------------------------ event feed
+    def _on_register(self, computed: "Computed") -> None:
+        input = computed.input
+        with self._lock:
+            nid = self._id_by_input.get(input)
+            if nid is None:
+                nid = int(self.graph.add_nodes(1)[0])
+                self._id_by_input[input] = nid
+            else:
+                # recompute: next epoch; stale in-edges die, invalid clears
+                self._journal.append(("bump", nid))
+            self._computed_by_id[nid] = weakref.ref(computed)
+
+    def _on_edge_added(self, dependent: "Computed", used: "Computed") -> None:
+        with self._lock:
+            did = self._id_by_input.get(dependent.input)
+            uid = self._id_by_input.get(used.input)
+            if did is None or uid is None:
+                return  # nodes born before the backend attached
+            self._journal.append(("edge", (uid, did)))
+
+    def _on_invalidated(self, computed: "Computed") -> None:
+        with self._lock:
+            nid = self._id_by_input.get(computed.input)
+            if nid is not None:
+                self._journal.append(("invalid", nid))
+
+    # ------------------------------------------------------------------ flush
+    def flush(self) -> None:
+        """Replay the event journal against the device mirror IN ORDER,
+        coalescing consecutive same-type runs into batches. Ordered replay is
+        what keeps the mirror coherent: a stale invalid-mark buffered before
+        a node's recompute-bump dies with the bump instead of resurrecting."""
+        with self._lock:
+            journal, self._journal = self._journal, []
+        if not journal:
+            return
+        i, n = 0, len(journal)
+        while i < n:
+            kind = journal[i][0]
+            j = i
+            while j < n and journal[j][0] == kind:
+                j += 1
+            batch = [payload for _, payload in journal[i:j]]
+            if kind == "bump":
+                self.graph.bump_epochs(np.asarray(batch, dtype=np.int32))
+            elif kind == "edge":
+                arr = np.asarray(batch, dtype=np.int32)
+                # dst_epoch defaults to the dependent's CURRENT epoch, which
+                # is correct exactly because earlier bumps already applied
+                self.graph.add_edges(arr[:, 0], arr[:, 1])
+            else:  # invalid
+                self.graph.mark_invalid(np.asarray(batch, dtype=np.int32))
+            i = j
+
+    # ------------------------------------------------------------------ offload
+    def invalidate_cascade(self, computed: "Computed") -> int:
+        """Run the invalidation wave for ``computed`` ON DEVICE, then apply
+        the closure to host nodes. Returns nodes invalidated."""
+        self.flush()
+        nid = self._id_by_input.get(computed.input)
+        if nid is None:
+            computed.invalidate(immediately=True)
+            return 1
+        before = self.graph.invalid_mask().copy()
+        self.graph.run_wave([nid])
+        after = self.graph.invalid_mask()
+        newly = np.nonzero(after & ~before)[0]
+        applied = 0
+        for node_id in newly:
+            ref = self._computed_by_id.get(int(node_id))
+            c = ref() if ref is not None else None
+            if c is not None and c.invalidate_local():
+                applied += 1
+        self.waves_run += 1
+        self.device_invalidations += len(newly)
+        return applied
+
+    # ------------------------------------------------------------------ stats
+    @property
+    def node_count(self) -> int:
+        return self.graph.n_nodes
+
+    @property
+    def edge_count(self) -> int:
+        return self.graph.n_edges

@@ -1,0 +1,215 @@
+"""DeviceGraph — host-managed container around the device CSR mirror.
+
+The management half of the TPU graph backend: capacity-padded device arrays
+(see stl_fusion_tpu.ops.wave for the layout), batched edge ingestion, epoch
+bumps on recompute, and the wave API. This is what the reference implements
+as ComputedRegistry + per-node edge sets (src/Stl.Fusion/ComputedRegistry.cs,
+Computed.cs:347-419) — re-shaped so the invalidation hot path runs on TPU.
+
+Capacities are static per compiled program; growth doubles capacity and
+re-pads (one recompile per doubling, amortized like a vector push_back).
+Edge ingestion is append-only with tombstoning-by-epoch: edges whose
+``edge_dst_epoch`` no longer matches are dead weight until ``compact()``
+rebuilds the arrays (the device analogue of the reference's
+ComputedGraphPruner edge sweep).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.wave import GraphArrays, run_wave, run_wave_with_stats, seeds_to_frontier
+
+__all__ = ["DeviceGraph"]
+
+
+def _round_up_pow2(x: int) -> int:
+    n = 1
+    while n < x:
+        n <<= 1
+    return n
+
+
+class DeviceGraph:
+    def __init__(self, node_capacity: int = 1024, edge_capacity: int = 4096):
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        self.n_cap = _round_up_pow2(max(node_capacity, 16))
+        self.e_cap = _round_up_pow2(max(edge_capacity, 16))
+        self.n_nodes = 0  # dense ids [0, n_nodes)
+        self.n_edges = 0  # live prefix of edge arrays
+        # host staging (authoritative for structure)
+        self._h_edge_src = np.full(self.e_cap, self.n_cap, dtype=np.int32)
+        self._h_edge_dst = np.full(self.e_cap, self.n_cap, dtype=np.int32)
+        self._h_edge_dst_epoch = np.full(self.e_cap, -1, dtype=np.int32)
+        self._h_node_epoch = np.zeros(self.n_cap + 1, dtype=np.int32)
+        self._h_node_epoch[self.n_cap] = -2  # dummy slot never version-matches
+        self._h_invalid = np.zeros(self.n_cap + 1, dtype=bool)  # host-authoritative
+        self._g: Optional[GraphArrays] = None  # device copy, built lazily
+        self._dirty = True
+
+    # ------------------------------------------------------------------ build
+    def add_nodes(self, count: int) -> np.ndarray:
+        """Allocate ``count`` dense node ids."""
+        start = self.n_nodes
+        self.n_nodes += count
+        if self.n_nodes > self.n_cap:
+            self._grow_nodes(self.n_nodes)
+        return np.arange(start, self.n_nodes, dtype=np.int32)
+
+    def add_edges(
+        self,
+        src: np.ndarray,
+        dst: np.ndarray,
+        dst_epoch: Optional[np.ndarray] = None,
+    ) -> None:
+        """Append dependency edges src(used) → dst(dependent) in batch.
+
+        ``dst_epoch`` defaults to each dependent's CURRENT epoch — the
+        "edge is valid for this version" capture rule."""
+        src = np.asarray(src, dtype=np.int32)
+        dst = np.asarray(dst, dtype=np.int32)
+        k = len(src)
+        if self.n_edges + k > self.e_cap:
+            self._grow_edges(self.n_edges + k)
+        if dst_epoch is None:
+            dst_epoch = self._h_node_epoch[dst]
+        sl = slice(self.n_edges, self.n_edges + k)
+        self._h_edge_src[sl] = src
+        self._h_edge_dst[sl] = dst
+        self._h_edge_dst_epoch[sl] = np.asarray(dst_epoch, dtype=np.int32)
+        self.n_edges += k
+        self._dirty = True
+
+    def bump_epochs(self, node_ids: np.ndarray) -> None:
+        """Nodes recomputed: new epoch ⇒ their stale in-edges go dead, and
+        their invalid flag clears (a recomputed node is consistent again)."""
+        node_ids = np.asarray(node_ids, dtype=np.int32)
+        self._h_node_epoch[node_ids] += 1
+        self._h_invalid[node_ids] = False
+        if self._g is not None and not self._dirty:
+            jnp = self._jnp
+            ids = jnp.asarray(node_ids)
+            self._g = self._g._replace(
+                node_epoch=self._g.node_epoch.at[ids].add(1),
+                invalid=self._g.invalid.at[ids].set(False),
+            )
+        else:
+            self._dirty = True
+
+    def mark_invalid(self, node_ids: np.ndarray) -> None:
+        """Externally-observed invalidations (host-led waves) → mirror state."""
+        node_ids = np.asarray(node_ids, dtype=np.int32)
+        self._h_invalid[node_ids] = True
+        if self._g is not None and not self._dirty:
+            ids = self._jnp.asarray(node_ids)
+            self._g = self._g._replace(invalid=self._g.invalid.at[ids].set(True))
+
+    def _grow_nodes(self, need: int) -> None:
+        new_cap = _round_up_pow2(need)
+        node_epoch = np.zeros(new_cap + 1, dtype=np.int32)
+        node_epoch[: self.n_cap] = self._h_node_epoch[: self.n_cap]
+        node_epoch[new_cap] = -2
+        invalid = np.zeros(new_cap + 1, dtype=bool)
+        invalid[: self.n_cap] = self._h_invalid[: self.n_cap]
+        # re-point padded edges at the new dummy slot
+        pad_mask = self._h_edge_src == self.n_cap
+        self._h_edge_src[pad_mask] = new_cap
+        self._h_edge_dst[self._h_edge_dst == self.n_cap] = new_cap
+        self._h_node_epoch = node_epoch
+        self._h_invalid = invalid
+        self.n_cap = new_cap
+        self._dirty = True
+
+    def _grow_edges(self, need: int) -> None:
+        new_cap = _round_up_pow2(need)
+        for name in ("_h_edge_src", "_h_edge_dst"):
+            arr = np.full(new_cap, self.n_cap, dtype=np.int32)
+            arr[: self.n_edges] = getattr(self, name)[: self.n_edges]
+            setattr(self, name, arr)
+        epoch = np.full(new_cap, -1, dtype=np.int32)
+        epoch[: self.n_edges] = self._h_edge_dst_epoch[: self.n_edges]
+        self._h_edge_dst_epoch = epoch
+        self.e_cap = new_cap
+        self._dirty = True
+
+    # ------------------------------------------------------------------ device sync
+    def device_arrays(self) -> GraphArrays:
+        """Materialize (or reuse) the device copy; host staging is
+        authoritative for structure AND invalid state at rebuild time."""
+        if self._g is None or self._dirty:
+            jnp = self._jnp
+            self._g = GraphArrays(
+                edge_src=jnp.asarray(self._h_edge_src),
+                edge_dst=jnp.asarray(self._h_edge_dst),
+                edge_dst_epoch=jnp.asarray(self._h_edge_dst_epoch),
+                node_epoch=jnp.asarray(self._h_node_epoch),
+                invalid=jnp.asarray(self._h_invalid),
+            )
+            self._dirty = False
+        return self._g
+
+    # ------------------------------------------------------------------ waves
+    def run_wave(self, seed_ids: Sequence[int], with_stats: bool = False):
+        """Cascade from ``seed_ids``; returns newly-invalidated count
+        (+ BFS depth with stats). The device arrays keep the result state."""
+        jnp = self._jnp
+        g = self.device_arrays()
+        seeds = seeds_to_frontier(self.n_cap, jnp.asarray(np.asarray(seed_ids, dtype=np.int32)))
+        if with_stats:
+            self._g, count, depth = run_wave_with_stats(seeds, g)
+            self._sync_invalid_back()
+            return int(count), int(depth)
+        self._g, count = run_wave(seeds, g)
+        self._sync_invalid_back()
+        return int(count)
+
+    def run_wave_frontier(self, seed_frontier, sync_host: bool = False) -> int:
+        """Wave from a prebuilt boolean frontier (bench hot path — host copy
+        of invalid state stays stale unless sync_host)."""
+        g = self.device_arrays()
+        self._g, count = run_wave(seed_frontier, g)
+        if sync_host:
+            self._sync_invalid_back()
+        return int(count)
+
+    def _sync_invalid_back(self) -> None:
+        """After a device wave, the device invalid lane is newer — pull it."""
+        self._h_invalid = np.array(self._g.invalid)  # writable copy
+
+    # ------------------------------------------------------------------ readback
+    def invalid_mask(self) -> np.ndarray:
+        g = self.device_arrays()
+        return np.asarray(g.invalid[: self.n_nodes])
+
+    def invalid_ids(self) -> np.ndarray:
+        return np.nonzero(self.invalid_mask())[0].astype(np.int32)
+
+    def clear_invalid(self) -> None:
+        jnp = self._jnp
+        g = self.device_arrays()
+        self._g = g._replace(invalid=jnp.zeros_like(g.invalid))
+        self._h_invalid = np.zeros(self.n_cap + 1, dtype=bool)
+
+    def compact(self) -> int:
+        """Drop dead edges (epoch-mismatched) — the pruner sweep. Returns
+        removed count."""
+        live = (
+            self._h_node_epoch[self._h_edge_dst[: self.n_edges]]
+            == self._h_edge_dst_epoch[: self.n_edges]
+        )
+        removed = int((~live).sum())
+        if removed == 0:
+            return 0
+        k = int(live.sum())
+        for name in ("_h_edge_src", "_h_edge_dst", "_h_edge_dst_epoch"):
+            arr = getattr(self, name)
+            kept = arr[: self.n_edges][live]
+            pad_val = self.n_cap if name != "_h_edge_dst_epoch" else -1
+            arr[:k] = kept
+            arr[k : self.n_edges] = pad_val
+        self.n_edges = k
+        self._dirty = True
+        return removed

@@ -1,0 +1,4 @@
+"""Device kernels: the invalidation-wave BFS (jit) + pallas variants."""
+from .wave import GraphArrays, run_wave, run_wave_with_stats, seeds_to_frontier, wave_step
+
+__all__ = ["GraphArrays", "run_wave", "run_wave_with_stats", "seeds_to_frontier", "wave_step"]

@@ -1,0 +1,189 @@
+"""Device invalidation-wave tests: python BFS oracle equivalence on random
+DAGs (the SURVEY §7 step-3 gate), churn/epoch semantics, and the live
+hub-mirror offload path."""
+import numpy as np
+import pytest
+
+from stl_fusion_tpu.graph import DeviceGraph
+
+
+# ------------------------------------------------------------------ oracle
+
+def python_wave_oracle(n, edges, edge_epochs, node_epochs, invalid, seeds):
+    """Reference BFS with version matching — mirrors the C# cascade rule
+    (Computed.cs:210-217): fire only if dependent's current epoch matches the
+    edge's captured epoch and it isn't already invalidated."""
+    from collections import defaultdict, deque
+
+    adj = defaultdict(list)
+    for (s, d), ep in zip(edges, edge_epochs):
+        adj[s].append((d, ep))
+    invalid = dict(enumerate(invalid))
+    q = deque()
+    for s in seeds:
+        if not invalid[s]:
+            invalid[s] = True
+            q.append(s)
+    while q:
+        u = q.popleft()
+        for d, ep in adj[u]:
+            if not invalid[d] and node_epochs[d] == ep:
+                invalid[d] = True
+                q.append(d)
+    return np.array([invalid[i] for i in range(n)], dtype=bool)
+
+
+def random_dag(rng, n, avg_deg=3.0):
+    """Random DAG edges src→dst with src < dst (dependents have higher id)."""
+    edges = []
+    for d in range(1, n):
+        k = rng.poisson(avg_deg)
+        k = min(k, d)
+        if k > 0:
+            srcs = rng.choice(d, size=k, replace=False)
+            edges.extend((int(s), d) for s in srcs)
+    return edges
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_wave_matches_python_oracle(seed):
+    rng = np.random.default_rng(seed)
+    n = 300
+    edges = random_dag(rng, n)
+    g = DeviceGraph(node_capacity=n, edge_capacity=len(edges) + 1)
+    g.add_nodes(n)
+    arr = np.asarray(edges, dtype=np.int32)
+    g.add_edges(arr[:, 0], arr[:, 1])
+
+    # random epoch churn: bump some nodes AFTER edges were captured,
+    # killing their stale in-edges
+    bumped = rng.choice(n, size=n // 10, replace=False)
+    g.bump_epochs(bumped)
+
+    seeds = rng.choice(n, size=5, replace=False).tolist()
+    count = g.run_wave(seeds)
+    got = g.invalid_mask()
+
+    node_epochs = g._h_node_epoch[:n]
+    edge_epochs = [0] * len(edges)  # captured at epoch 0
+    want = python_wave_oracle(n, edges, edge_epochs, node_epochs, np.zeros(n, bool), seeds)
+    np.testing.assert_array_equal(got, want)
+    assert count == int(want.sum())
+
+
+def test_wave_depth_and_counts():
+    # chain 0 -> 1 -> 2 -> 3 -> 4
+    g = DeviceGraph()
+    g.add_nodes(5)
+    g.add_edges(np.arange(4), np.arange(1, 5))
+    count, depth = g.run_wave([0], with_stats=True)
+    assert count == 5
+    assert depth == 4
+    assert g.invalid_mask().all()
+
+
+def test_wave_idempotent_and_incremental():
+    g = DeviceGraph()
+    g.add_nodes(4)
+    g.add_edges([0, 1], [1, 2])  # 0->1->2, 3 isolated
+    assert g.run_wave([0]) == 3
+    assert g.run_wave([0]) == 0  # already invalid: no re-invalidation
+    assert g.run_wave([3]) == 1
+    assert g.invalid_mask().all()
+
+
+def test_epoch_bump_kills_stale_edges_and_revives_node():
+    g = DeviceGraph()
+    g.add_nodes(3)
+    g.add_edges([0, 1], [1, 2])
+    g.run_wave([0])
+    assert g.invalid_mask().all()
+    # "recompute" node 1 and 2: epoch bump clears invalid, old edges die
+    g.bump_epochs([1, 2])
+    mask = g.invalid_mask()
+    assert mask[0] and not mask[1] and not mask[2]
+    # invalidating 0 again does NOT cascade: 0 already invalid
+    assert g.run_wave([0]) == 0
+    # re-adding the edge at the new epoch reconnects the graph
+    g.bump_epochs([0])  # 0 recomputed too
+    g.add_edges([0], [1])
+    assert g.run_wave([0]) == 2  # 0 and 1 (no live 1->2 edge)
+    mask = g.invalid_mask()
+    assert mask[0] and mask[1] and not mask[2]
+
+
+def test_capacity_growth():
+    g = DeviceGraph(node_capacity=16, edge_capacity=16)
+    ids = g.add_nodes(100)
+    g.add_edges(ids[:-1], ids[1:])
+    assert g.run_wave([0]) == 100
+    assert g.invalid_mask().sum() == 100
+
+
+def test_compact_drops_dead_edges():
+    g = DeviceGraph()
+    g.add_nodes(3)
+    g.add_edges([0, 0], [1, 2])
+    g.bump_epochs([1])  # edge 0->1 now dead
+    assert g.compact() == 1
+    assert g.n_edges == 1
+    assert g.run_wave([0]) == 2  # 0 + 2 only
+
+
+# ------------------------------------------------------------------ live hub mirror
+
+async def test_backend_offload_matches_host_semantics():
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        capture,
+        compute_method,
+        set_default_hub,
+    )
+    from stl_fusion_tpu.graph import TpuGraphBackend
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        backend = TpuGraphBackend(hub)
+
+        class S(ComputeService):
+            def __init__(self):
+                super().__init__()
+                self.data = {"a": 1, "b": 2}
+
+            @compute_method
+            async def get(self, k: str) -> int:
+                return self.data[k]
+
+            @compute_method
+            async def total(self) -> int:
+                return await self.get("a") + await self.get("b")
+
+            @compute_method
+            async def doubled(self) -> int:
+                return 2 * await self.total()
+
+        svc = S()
+        assert await svc.doubled() == 6
+        c_a = await capture(lambda: svc.get("a"))
+        c_total = await capture(lambda: svc.total())
+        c_doubled = await capture(lambda: svc.doubled())
+        assert backend.node_count == 4  # get(a), get(b), total, doubled
+
+        # offload the cascade: device wave computes the closure
+        svc.data["a"] = 10
+        applied = backend.invalidate_cascade(c_a)
+        assert applied == 3  # a, total, doubled
+        assert c_a.is_invalidated and c_total.is_invalidated and c_doubled.is_invalidated
+        b_node = await capture(lambda: svc.get("b"))
+        assert b_node.is_consistent  # untouched branch stays consistent
+
+        # recompute rebuilds edges at new epochs; a second offload wave works
+        assert await svc.doubled() == 24
+        c_a2 = await capture(lambda: svc.get("a"))
+        svc.data["a"] = 0
+        backend.invalidate_cascade(c_a2)
+        assert await svc.doubled() == 4
+    finally:
+        set_default_hub(old)
