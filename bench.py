@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""North-star benchmark: cascading invalidations/sec on a power-law DAG.
+
+The reference never measured invalidation throughput (its only published
+benchmark is memoized read ops/sec — see BASELINE.md); this benchmark
+establishes the metric the TPU build is designed around: a synthetic
+power-law dependency DAG lives in device HBM (work-efficient ELL mirror with
+virtual forwarding trees for hubs — stl_fusion_tpu/ops/ell_wave.py), random
+seed batches invalidate, and the bucketed sparse-BFS wave kernel expands
+each cascade entirely on device. All waves of a run are chained in one
+lax.scan with a single host readback at the end (host↔device sync through
+this environment's relay costs ~64 ms — measured — so per-wave syncs would
+benchmark the tunnel, not the kernel).
+
+Prints ONE JSON line:
+  {"metric": "cascading_invalidations_per_sec", "value": N, "unit": "inv/s",
+   "vs_baseline": value / 100e6}
+(vs_baseline = ratio against the BASELINE.json north-star target of 100M
+cascading invalidations/sec on this graph class.)
+
+Env knobs: FUSION_BENCH_NODES (default 10_000_000), FUSION_BENCH_DEG (3),
+FUSION_BENCH_SEEDS (100_000 per wave), FUSION_BENCH_WAVES (20),
+FUSION_BENCH_SHARDED=1 → mesh-sharded dense wave over all devices.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
+    """Primary path: bit-packed pull-mode kernel — 32 independent waves per
+    pass (ops/pull_wave.py). The work-efficient single-wave kernel
+    (ops/ell_wave.py) serves the low-latency path and is exercised by the
+    p50/p99 latency samples below."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from stl_fusion_tpu.graph.synthetic import power_law_dag
+    from stl_fusion_tpu.ops.ell_wave import build_ell, build_ell_wave
+    from stl_fusion_tpu.ops.pull_wave import build_pull_graph, build_pull_wave32, seeds_to_bits
+
+    t0 = time.time()
+    src, dst = power_law_dag(n_nodes, avg_degree=avg_deg, seed=7)
+    graph = build_pull_graph(src, dst, n_nodes, k=8)
+    build_s = time.time() - t0
+
+    state0, wave32 = build_pull_wave32(graph)
+    n_batches = max(n_waves // 32, 1)
+    seed_mats = np.stack(
+        [
+            seeds_to_bits(
+                graph.n_tot,
+                [rng.choice(n_nodes, size=seeds_per_wave, replace=False) for _ in range(32)],
+            )
+            for _ in range(n_batches)
+        ]
+    )
+    seed_mats = jnp.asarray(seed_mats)
+    n_waves = n_batches * 32
+
+    @jax.jit
+    def run_all(seed_mats, state):
+        def body(carry, seed_bits):
+            state, total = carry
+            # churn model: the graph is fully consistent before each batch
+            # (nodes "recomputed" between batches), so every wave cascades
+            state = state._replace(invalid_bits=jnp.zeros_like(state.invalid_bits))
+            state, count = wave32(seed_bits, state)
+            return (state, total + count), count
+        (state, total), counts = lax.scan(body, (state, jnp.int32(0)), seed_mats)
+        return state, total, counts
+
+    # measure host-sync overhead of this environment (relay round trip)
+    x = jnp.zeros(8)
+    float((x + 1).sum())
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float((x + 1).sum())
+    sync_overhead = (time.perf_counter() - t0) / 3
+
+    # warmup / compile
+    t0 = time.time()
+    _, total, _ = run_all(seed_mats, state0)
+    total = int(total)
+    compile_s = time.time() - t0
+
+    # timed run: one readback for the whole run
+    t0 = time.perf_counter()
+    _, total, counts = run_all(seed_mats, state0)
+    total = int(total)
+    elapsed = time.perf_counter() - t0 - sync_overhead
+
+    # single-wave latency samples on the work-efficient kernel (the
+    # low-latency path a lone invalidate() takes), sync-corrected
+    ell = build_ell(src, dst, n_nodes, k=4)
+    ell_state, ell_wave = build_ell_wave(ell)
+    # small-wave latency: seed shallow nodes (high ids = few transitive
+    # dependents in the PA-DAG) — the shape of a typical single edit
+    lat_seeds = jnp.asarray(
+        (n_nodes - 1 - rng.choice(n_nodes // 100, size=min(256, n_nodes // 100), replace=False)).astype(np.int32)
+    )
+    st, c = ell_wave(lat_seeds, ell_state)  # compile
+    int(c)
+    lat = []
+    for _ in range(5):
+        st = st._replace(invalid=jnp.zeros_like(st.invalid))
+        t0 = time.perf_counter()
+        st, c = ell_wave(lat_seeds, st)
+        int(c)
+        lat.append(max(time.perf_counter() - t0 - sync_overhead, 1e-6))
+
+    return {
+        "total_invalidated": total,
+        "elapsed_s": max(elapsed, 1e-9),
+        "wave_ms_p50": float(np.percentile(np.asarray(lat) * 1e3, 50)),
+        "wave_ms_p99": float(np.percentile(np.asarray(lat) * 1e3, 99)),
+        "edges": int(len(src)),
+        "virtual_nodes": graph.n_tot - graph.n_real,
+        "graph_build_s": round(build_s, 2),
+        "compile_s": round(compile_s, 2),
+        "sync_overhead_ms": round(sync_overhead * 1e3, 1),
+        "batches_of_32": n_batches,
+        "counts_head": [int(c) for c in np.asarray(counts)[:3]],
+    }
+
+
+def run_sharded(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
+    import jax
+
+    from stl_fusion_tpu.graph.synthetic import power_law_dag
+    from stl_fusion_tpu.parallel import ShardedDeviceGraph, graph_mesh
+
+    t0 = time.time()
+    src, dst = power_law_dag(n_nodes, avg_degree=avg_deg, seed=7)
+    graph = ShardedDeviceGraph(src, dst, n_nodes, mesh=graph_mesh())
+    build_s = time.time() - t0
+
+    total = 0
+    t_start = time.perf_counter()
+    for i in range(n_waves):
+        graph.clear_invalid()
+        seeds = rng.choice(n_nodes, size=seeds_per_wave, replace=False)
+        total += graph.run_wave(seeds.tolist())
+    elapsed = time.perf_counter() - t_start
+    return {
+        "total_invalidated": total,
+        "elapsed_s": elapsed,
+        "wave_ms_p50": elapsed / n_waves * 1e3,
+        "wave_ms_p99": elapsed / n_waves * 1e3,
+        "edges": int(len(src)),
+        "graph_build_s": round(build_s, 2),
+        "sharded": True,
+    }
+
+
+def main() -> None:
+    import jax
+
+    n_nodes = int(os.environ.get("FUSION_BENCH_NODES", 10_000_000))
+    avg_deg = float(os.environ.get("FUSION_BENCH_DEG", 3))
+    seeds_per_wave = int(os.environ.get("FUSION_BENCH_SEEDS", 100_000))
+    n_waves = int(os.environ.get("FUSION_BENCH_WAVES", 20))
+    sharded = os.environ.get("FUSION_BENCH_SHARDED", "0") == "1" and len(jax.devices()) > 1
+
+    rng = np.random.default_rng(123)
+    runner = run_sharded if sharded else run_single_chip
+    detail = runner(n_nodes, avg_deg, seeds_per_wave, n_waves, rng)
+
+    inv_per_sec = detail["total_invalidated"] / detail["elapsed_s"]
+    detail.update(
+        nodes=n_nodes,
+        waves=n_waves,
+        seeds_per_wave=seeds_per_wave,
+        n_devices=len(jax.devices()),
+        device=str(jax.devices()[0]),
+    )
+    result = {
+        "metric": "cascading_invalidations_per_sec",
+        "value": round(inv_per_sec, 1),
+        "unit": "inv/s",
+        "vs_baseline": round(inv_per_sec / 100e6, 4),
+        "detail": detail,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

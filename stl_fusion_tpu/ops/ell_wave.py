@@ -1,0 +1,235 @@
+"""Work-efficient invalidation waves: ELL adjacency + bucketed frontiers.
+
+The dense edge-parallel kernel (wave.py) costs O(total edges) per BFS level
+— the right shape for huge frontiers, hopeless for the common case where a
+wave touches 0.1-10% of a 10M-node graph. This module is the work-efficient
+path: per level it reads only the out-edges of the ACTIVE frontier.
+
+Two TPU-specific problems and their solutions:
+
+1. **Power-law out-degree vs static shapes.** A hub node (a config value
+   ten thousand views depend on) has out-degree ~10⁴; padding every node's
+   edge list to the max is unusable. The graph is therefore rewritten into
+   **ELL form with virtual forwarding trees**: every node keeps at most
+   ``k`` out-slots; a node with more dependents fans out through a k-ary
+   tree of virtual nodes (built statically, `build_ell`). This bounds the
+   per-level row width at the cost of +log_k(degree) wave depth for hub
+   cascades — latency for bandwidth, the right trade on a machine that
+   hates gathers and loves dense rows.
+
+2. **Frontier sizes vary wildly** (SURVEY.md §7 hard parts). Static shapes
+   would force every level to pay the worst-case frontier. Instead the
+   kernel compiles a ladder of frontier **buckets** (16k → … → F_max) and
+   `lax.switch`es per level into the smallest bucket that fits — so a
+   1k-node level costs a 16k-slot program, not a 10M-slot one.
+
+Dedup inside a level uses a claim-by-scatter-max trick (first edge slot to
+claim a destination wins) instead of sort+unique — one scatter + one gather
+over active slots, no host round trips anywhere in the wave.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["EllGraph", "build_ell", "build_ell_wave"]
+
+
+class EllGraph(NamedTuple):
+    """Host-built ELL graph (device arrays created by the wave builder)."""
+
+    ell_dst: np.ndarray  # int32[n_tot+1, k] — out-slot targets; pad = n_tot
+    ell_epoch: np.ndarray  # int32[n_tot+1, k] — captured target epochs; pad -1
+    is_real: np.ndarray  # bool[n_tot+1] — False for virtual forwarding nodes
+    n_real: int
+    n_tot: int
+    k: int
+
+
+def build_ell(
+    src: np.ndarray, dst: np.ndarray, n_nodes: int, k: int = 4
+) -> EllGraph:
+    """Rewrite an edge list into ELL(k) with virtual forwarding trees.
+
+    Layered construction, fully vectorized: in each round, nodes whose
+    current out-list exceeds ``k`` get their list chunked into groups of
+    ``k`` hung under fresh virtual nodes; the virtual ids become the node's
+    new out-list. Rounds ≈ log_k(max_degree).
+    """
+    src = np.asarray(src, dtype=np.int64)
+    dst = np.asarray(dst, dtype=np.int64)
+    next_virtual = n_nodes
+    final_src: List[np.ndarray] = []
+    final_dst: List[np.ndarray] = []
+
+    cur_src, cur_dst = src, dst
+    while len(cur_src):
+        order = np.argsort(cur_src, kind="stable")
+        s, d = cur_src[order], cur_dst[order]
+        # rank of each edge within its source group
+        uniq, starts, counts = np.unique(s, return_index=True, return_counts=True)
+        rank = np.arange(len(s)) - np.repeat(starts, counts)
+        deg = np.repeat(counts, counts)
+        small = deg <= k
+        final_src.append(s[small])
+        final_dst.append(d[small])
+        # big groups: chunk into virtual nodes of k
+        bs, bd, brank = s[~small], d[~small], rank[~small]
+        if len(bs) == 0:
+            break
+        # chunk index within the big group
+        chunk = brank // k
+        # assign one virtual id per (source, chunk)
+        grp_key = np.stack([bs, chunk], axis=1)
+        _, grp_first, grp_inv = np.unique(
+            grp_key[:, 0] * (chunk.max() + 1) + grp_key[:, 1],
+            return_index=True,
+            return_inverse=True,
+        )
+        n_virtual = len(grp_first)
+        virtual_ids = next_virtual + np.arange(n_virtual)
+        next_virtual += n_virtual
+        # edges virtual → original dst (these are ≤ k per virtual by chunking)
+        final_src.append(virtual_ids[grp_inv])
+        final_dst.append(bd)
+        # next round: source → its virtual children (dedup (src, chunk))
+        cur_src = bs[grp_first]
+        cur_dst = virtual_ids
+
+    n_tot = next_virtual
+    ell_dst = np.full((n_tot + 1, k), n_tot, dtype=np.int32)
+    ell_epoch = np.full((n_tot + 1, k), -1, dtype=np.int32)
+    fs = np.concatenate(final_src)
+    fd = np.concatenate(final_dst)
+    order = np.argsort(fs, kind="stable")
+    fs, fd = fs[order], fd[order]
+    uniq, starts, counts = np.unique(fs, return_index=True, return_counts=True)
+    slot = np.arange(len(fs)) - np.repeat(starts, counts)
+    assert slot.max() < k, "ELL transform failed to bound out-degree"
+    ell_dst[fs, slot] = fd
+    ell_epoch[fs, slot] = 0  # all targets start at epoch 0
+    is_real = np.zeros(n_tot + 1, dtype=bool)
+    is_real[:n_nodes] = True
+    return EllGraph(ell_dst, ell_epoch, is_real, n_nodes, n_tot, k)
+
+
+class EllWaveState(NamedTuple):
+    node_epoch: "object"  # int32[n_tot+1]
+    invalid: "object"  # bool[n_tot+1]
+
+
+def build_ell_wave(
+    graph: EllGraph,
+    f_max: Optional[int] = None,
+    buckets: Optional[Sequence[int]] = None,
+):
+    """Compile the bucketed work-efficient wave for an ELL graph.
+
+    Returns (initial_state, wave_fn) where
+    ``wave_fn(seed_ids_padded, state) -> (state, real_invalidated_count)``;
+    ``seed_ids_padded`` is int32[seed_cap] padded with -1. The whole wave —
+    all levels, bucket switching, dedup — runs in one XLA program.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_tot, k = graph.n_tot, graph.k
+    if f_max is None:
+        # must bound the widest possible level (worst case: the whole graph)
+        f_max = 1 << int(np.ceil(np.log2(max(n_tot, 1 << 14))))
+    if buckets is None:
+        buckets = []
+        b = 1 << 14
+        while b < f_max:
+            buckets.append(b)
+            b <<= 3
+        buckets.append(f_max)
+    buckets = [min(b, f_max) for b in buckets]
+
+    ell_dst = jnp.asarray(graph.ell_dst)
+    ell_epoch = jnp.asarray(graph.ell_epoch)
+    is_real = jnp.asarray(graph.is_real)
+
+    def init_state() -> EllWaveState:
+        node_epoch = jnp.zeros(n_tot + 1, dtype=jnp.int32).at[n_tot].set(-2)
+        invalid = jnp.zeros(n_tot + 1, dtype=jnp.bool_)
+        return EllWaveState(node_epoch, invalid)
+
+    def _level(bsize: int, F, invalid, node_epoch):
+        """Expand F[:bsize] one level; returns (F_next, nF_next, invalid, newly_real)."""
+        Fb = lax.slice(F, (0,), (bsize,))
+        rows = ell_dst[Fb]  # (bsize, k) row gather; pad rows → n_tot
+        eps = ell_epoch[Fb]
+        cur = node_epoch[rows]
+        inv = invalid[rows]
+        fire = (cur == eps) & ~inv & (rows < n_tot)
+        flat_dst = rows.reshape(-1)
+        flat_fire = fire.reshape(-1)
+        invalid = invalid.at[flat_dst].max(flat_fire)
+        # claim dedup: first firing slot per destination wins
+        slot_id = jnp.arange(flat_dst.shape[0], dtype=jnp.int32) + 1
+        claim = (
+            jnp.zeros(n_tot + 1, dtype=jnp.int32)
+            .at[flat_dst]
+            .max(jnp.where(flat_fire, slot_id, 0))
+        )
+        win = flat_fire & (claim[flat_dst] == slot_id)
+        pos = jnp.cumsum(win.astype(jnp.int32)) - 1
+        nF_next = win.sum(dtype=jnp.int32)
+        scatter_pos = jnp.where(win, pos, f_max + 1)  # OOB → dropped
+        F_next = jnp.full(f_max, n_tot, dtype=jnp.int32).at[scatter_pos].set(
+            flat_dst.astype(jnp.int32), mode="drop"
+        )
+        newly_real = (win & is_real[flat_dst]).sum(dtype=jnp.int32)
+        return F_next, nF_next, invalid, newly_real
+
+    branches = [
+        functools.partial(_level, b) for b in buckets
+    ]
+
+    def level_switch(F, nF, invalid, node_epoch):
+        # smallest bucket that fits nF
+        bidx = jnp.searchsorted(jnp.asarray(buckets, dtype=jnp.int32), nF, side="left")
+        bidx = jnp.minimum(bidx, len(buckets) - 1)
+        return lax.switch(bidx, branches, F, invalid, node_epoch)
+
+    @jax.jit
+    def wave(seed_ids: "jax.Array", state: EllWaveState):
+        node_epoch, invalid = state.node_epoch, state.invalid
+        # seed frontier: pad -1 → n_tot slot; only fresh (not-invalid) seeds,
+        # deduped by the same claim trick (first occurrence wins)
+        safe = jnp.where(seed_ids >= 0, seed_ids, n_tot).astype(jnp.int32)
+        candidate = (safe < n_tot) & ~invalid[safe]
+        seed_slot = jnp.arange(safe.shape[0], dtype=jnp.int32) + 1
+        seed_claim = (
+            jnp.zeros(n_tot + 1, dtype=jnp.int32)
+            .at[safe]
+            .max(jnp.where(candidate, seed_slot, 0))
+        )
+        fresh = candidate & (seed_claim[safe] == seed_slot)
+        invalid = invalid.at[safe].max(fresh)
+        count0 = (fresh & is_real[safe]).sum(dtype=jnp.int32)
+        pos = jnp.cumsum(fresh.astype(jnp.int32)) - 1
+        F0 = (
+            jnp.full(f_max, n_tot, dtype=jnp.int32)
+            .at[jnp.where(fresh, pos, f_max + 1)]
+            .set(safe, mode="drop")
+        )
+        nF0 = fresh.sum(dtype=jnp.int32)
+
+        def cond(carry):
+            _F, nF, _inv, _cnt = carry
+            return nF > 0
+
+        def body(carry):
+            F, nF, invalid, cnt = carry
+            F2, nF2, invalid, newly = level_switch(F, nF, invalid, node_epoch)
+            return F2, nF2, invalid, cnt + newly
+
+        _F, _nF, invalid, count = lax.while_loop(cond, body, (F0, nF0, invalid, count0))
+        return EllWaveState(node_epoch, invalid), count
+
+    return init_state(), wave

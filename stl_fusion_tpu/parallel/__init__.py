@@ -1,0 +1,11 @@
+"""Multi-chip execution: mesh helpers + sharded invalidation waves."""
+from .mesh import GRAPH_AXIS, graph_mesh
+from .sharded_wave import ShardedDeviceGraph, ShardedGraphArrays, build_sharded_wave
+
+__all__ = [
+    "GRAPH_AXIS",
+    "graph_mesh",
+    "ShardedDeviceGraph",
+    "ShardedGraphArrays",
+    "build_sharded_wave",
+]

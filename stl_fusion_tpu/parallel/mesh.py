@@ -1,0 +1,28 @@
+"""Mesh helpers — the device topology the sharded graph runs on.
+
+The TPU-native replacement for the reference's server-pool scaling story
+(RpcCallRouter consistent-hash routing across hosts,
+samples/MultiServerRpc/Program.cs:58-76): instead of routing calls between
+processes over WebSockets, the dependency graph itself is sharded over a
+``jax.sharding.Mesh`` and invalidation frontiers ride ICI collectives.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["graph_mesh", "P", "Mesh", "NamedSharding"]
+
+GRAPH_AXIS = "graph"
+
+
+def graph_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the graph axis (edge/node sharding dimension)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (GRAPH_AXIS,))

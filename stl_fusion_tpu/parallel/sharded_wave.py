@@ -1,0 +1,182 @@
+"""Sharded invalidation waves — the multi-chip execution of the hot path.
+
+This is the TPU-native replacement for the reference's cross-host
+invalidation fan-out (per-peer WebSocket pub/sub + DB op-log readers,
+SURVEY.md §3.5, §5.8), re-designed per the BASELINE north star: the
+dependency graph's nodes AND edges are sharded over a device mesh, and each
+BFS level exchanges the invalidation frontier with ONE ``all_gather`` over
+ICI instead of N point-to-point messages.
+
+Sharding layout (1-D mesh, axis ``graph``):
+- nodes: block-sharded — device d owns ids [d*n_local, (d+1)*n_local);
+  ``node_epoch`` / ``invalid`` live sharded, never replicated;
+- edges: sharded by DESTINATION owner, so the version-match gather
+  (``node_epoch[dst]``) and the invalidation scatter are device-local;
+  only the frontier read (``frontier[src]``) needs remote data — hence the
+  all-gather;
+- per level: local fire-mask → local scatter → ``psum`` of the newly-lit
+  count decides continuation (the while_loop carries the flag so no
+  collective runs in ``cond``).
+
+Out-of-range padding uses JAX's gather-clamps/scatter-drops semantics:
+padded edges point at ``dst = n_local`` (dropped on scatter) with epoch -1
+(never matches on gather).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from .mesh import GRAPH_AXIS, graph_mesh
+
+__all__ = ["ShardedGraphArrays", "ShardedDeviceGraph", "build_sharded_wave"]
+
+
+class ShardedGraphArrays(NamedTuple):
+    edge_src: jax.Array  # int32[n_dev * e_shard] — GLOBAL source ids
+    edge_dst_local: jax.Array  # int32[n_dev * e_shard] — LOCAL dest ids (pad = n_local)
+    edge_dst_epoch: jax.Array  # int32[n_dev * e_shard] — pad = -1
+    node_epoch: jax.Array  # int32[n_global] — sharded by node block
+    invalid: jax.Array  # bool[n_global] — sharded by node block
+
+
+def build_sharded_wave(mesh: Mesh, n_global: int):
+    """Compile the sharded wave for a mesh + node capacity.
+
+    Returns ``wave(seed_frontier, g) -> (g, newly_invalidated_count)``.
+    """
+    n_dev = mesh.devices.size
+    assert n_global % n_dev == 0, "node capacity must divide evenly over the mesh"
+
+    node_spec = P(GRAPH_AXIS)
+    edge_spec = P(GRAPH_AXIS)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(node_spec, edge_spec, edge_spec, edge_spec, node_spec, node_spec),
+        out_specs=(node_spec, node_spec, P()),
+    )
+    def _wave(seeds_l, esrc_l, edst_l, eepoch_l, nepoch_l, inv_l):
+        fresh = seeds_l & ~inv_l
+        inv_l = inv_l | fresh
+        count0 = lax.psum(fresh.sum(dtype=jnp.int32), GRAPH_AXIS)
+        go0 = lax.psum(fresh.any().astype(jnp.int32), GRAPH_AXIS) > 0
+
+        def cond(carry):
+            _f, _inv, _count, go = carry
+            return go
+
+        def body(carry):
+            f_l, inv_l, count, _go = carry
+            # ONE collective per level: the global frontier
+            f_full = lax.all_gather(f_l, GRAPH_AXIS, tiled=True)
+            src_active = f_full[esrc_l]
+            ver_ok = nepoch_l[edst_l] == eepoch_l  # gather clamps; -1 never matches
+            fire = src_active & ver_ok & ~inv_l[edst_l]
+            nxt_l = jnp.zeros_like(f_l).at[edst_l].max(fire)  # OOB pads dropped
+            inv_l = inv_l | nxt_l
+            newly = lax.psum(nxt_l.sum(dtype=jnp.int32), GRAPH_AXIS)
+            return nxt_l, inv_l, count + newly, newly > 0
+
+        _f, inv_l, count, _go = lax.while_loop(cond, body, (fresh, inv_l, count0, go0))
+        return inv_l, nepoch_l, count
+
+    @jax.jit
+    def wave(seed_frontier: jax.Array, g: ShardedGraphArrays):
+        invalid, node_epoch, count = _wave(
+            seed_frontier, g.edge_src, g.edge_dst_local, g.edge_dst_epoch, g.node_epoch, g.invalid
+        )
+        return g._replace(invalid=invalid, node_epoch=node_epoch), count
+
+    return wave
+
+
+class ShardedDeviceGraph:
+    """Static sharded graph for multi-chip waves (bench + dry-run scale
+    path; the incremental host mirror is DeviceGraph on one chip)."""
+
+    def __init__(
+        self,
+        edges_src: np.ndarray,
+        edges_dst: np.ndarray,
+        n_nodes: int,
+        mesh: Optional[Mesh] = None,
+        edge_dst_epoch: Optional[np.ndarray] = None,
+    ):
+        self.mesh = mesh or graph_mesh()
+        n_dev = self.mesh.devices.size
+        self.n_global = ((n_nodes + n_dev - 1) // n_dev) * n_dev
+        self.n_local = self.n_global // n_dev
+        self.n_nodes = n_nodes
+        self.n_dev = n_dev
+
+        src = np.asarray(edges_src, dtype=np.int32)
+        dst = np.asarray(edges_dst, dtype=np.int32)
+        epoch = (
+            np.zeros_like(dst)
+            if edge_dst_epoch is None
+            else np.asarray(edge_dst_epoch, dtype=np.int32)
+        )
+        # partition edges by destination owner; pad shards to equal length
+        owner = dst // self.n_local
+        order = np.argsort(owner, kind="stable")
+        src, dst, epoch, owner = src[order], dst[order], epoch[order], owner[order]
+        counts = np.bincount(owner, minlength=n_dev)
+        e_shard = max(int(counts.max()), 1)
+        E = n_dev * e_shard
+        esrc = np.zeros(E, dtype=np.int32)
+        edst_local = np.full(E, self.n_local, dtype=np.int32)  # pad: OOB → dropped
+        eepoch = np.full(E, -1, dtype=np.int32)  # pad: never version-matches
+        start = 0
+        for d in range(n_dev):
+            k = counts[d]
+            if k:
+                sl = slice(d * e_shard, d * e_shard + k)
+                esrc[sl] = src[start : start + k]
+                edst_local[sl] = dst[start : start + k] - d * self.n_local
+                eepoch[sl] = epoch[start : start + k]
+                start += k
+        self.e_shard = e_shard
+
+        node_sh = NamedSharding(self.mesh, P(GRAPH_AXIS))
+        edge_sh = NamedSharding(self.mesh, P(GRAPH_AXIS))
+        self.g = ShardedGraphArrays(
+            edge_src=jax.device_put(esrc, edge_sh),
+            edge_dst_local=jax.device_put(edst_local, edge_sh),
+            edge_dst_epoch=jax.device_put(eepoch, edge_sh),
+            node_epoch=jax.device_put(np.zeros(self.n_global, dtype=np.int32), node_sh),
+            invalid=jax.device_put(np.zeros(self.n_global, dtype=bool), node_sh),
+        )
+        self._node_sharding = node_sh
+        self._wave = build_sharded_wave(self.mesh, self.n_global)
+
+    # ------------------------------------------------------------------ waves
+    def seeds_to_frontier(self, seed_ids: Sequence[int]) -> jax.Array:
+        frontier = np.zeros(self.n_global, dtype=bool)
+        frontier[np.asarray(seed_ids, dtype=np.int64)] = True
+        return jax.device_put(frontier, self._node_sharding)
+
+    def run_wave(self, seed_ids: Sequence[int]) -> int:
+        self.g, count = self._wave(self.seeds_to_frontier(seed_ids), self.g)
+        return int(count)
+
+    def run_wave_frontier(self, frontier: jax.Array) -> int:
+        self.g, count = self._wave(frontier, self.g)
+        return int(count)
+
+    # ------------------------------------------------------------------ readback
+    def invalid_mask(self) -> np.ndarray:
+        return np.asarray(self.g.invalid)[: self.n_nodes]
+
+    def clear_invalid(self) -> None:
+        self.g = self.g._replace(
+            invalid=jax.device_put(np.zeros(self.n_global, dtype=bool), self._node_sharding)
+        )
