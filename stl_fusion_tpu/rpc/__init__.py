@@ -1,0 +1,29 @@
+"""RPC layer — distributed communication backend (SURVEY.md §2.4)."""
+from .calls import RpcCallTypeRegistry, RpcInboundCall, RpcOutboundCall
+from .hub import RpcClientProxy, RpcHub, consistent_hash_router
+from .message import COMPUTE_SYSTEM_SERVICE, SYSTEM_SERVICE, VERSION_HEADER, RpcMessage
+from .peer import ConnectionState, RpcClientPeer, RpcPeer, RpcServerPeer
+from .registry import RpcMethodDef, RpcServiceDef, RpcServiceRegistry, rpc_no_wait
+from .testing import RpcTestTransport
+
+__all__ = [
+    "RpcCallTypeRegistry",
+    "RpcInboundCall",
+    "RpcOutboundCall",
+    "RpcClientProxy",
+    "RpcHub",
+    "consistent_hash_router",
+    "COMPUTE_SYSTEM_SERVICE",
+    "SYSTEM_SERVICE",
+    "VERSION_HEADER",
+    "RpcMessage",
+    "ConnectionState",
+    "RpcClientPeer",
+    "RpcPeer",
+    "RpcServerPeer",
+    "RpcMethodDef",
+    "RpcServiceDef",
+    "RpcServiceRegistry",
+    "rpc_no_wait",
+    "RpcTestTransport",
+]

@@ -1,0 +1,181 @@
+"""Outbound/inbound call machinery.
+
+Re-expression of src/Stl.Rpc/Infrastructure/RpcOutboundCall.cs:7-162 and
+RpcInboundCall.cs:8-243:
+
+- an OUTBOUND call registers itself with its peer (so reconnect can re-send
+  it, RpcPeer.cs:116-119), serializes its arguments, sends, and awaits a
+  ``$sys`` completion (Ok / Error / Cancel); awaiter cancellation pushes a
+  ``$sys.cancel`` to the server;
+- an INBOUND call dedups by (peer, call_id) — a re-sent call after reconnect
+  finds the registered call and just re-sends its result (``Restart``,
+  RpcInboundCall.cs:160-173) — invokes the target, and reports via ``$sys``.
+
+Call *types* (plain vs compute) come from a small registry so the Fusion
+client layer can swap in call classes that carry invalidation subscriptions
+(Client/Internal/RpcComputeCallType.cs) without the peer knowing.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import TYPE_CHECKING, Any, Dict, Optional, Tuple, Type
+
+from ..utils.errors import ExceptionInfo
+from ..utils.serialization import dumps, loads
+from .message import CALL_TYPE_PLAIN, SYSTEM_SERVICE, RpcMessage
+
+if TYPE_CHECKING:
+    from .peer import RpcPeer
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["RpcOutboundCall", "RpcInboundCall", "RpcCallTypeRegistry"]
+
+
+class RpcOutboundCall:
+    """One client-side call bound to a peer."""
+
+    call_type_id = CALL_TYPE_PLAIN
+
+    def __init__(self, peer: "RpcPeer", service: str, method: str, args: tuple, no_wait: bool = False):
+        self.peer = peer
+        self.service = service
+        self.method = method
+        self.args = args
+        self.no_wait = no_wait
+        self.call_id = peer.allocate_call_id()
+        self.future: Optional[asyncio.Future] = None if no_wait else asyncio.get_event_loop().create_future()
+
+    # -- wire --------------------------------------------------------------
+    def to_message(self) -> RpcMessage:
+        return RpcMessage(
+            call_type_id=self.call_type_id,
+            call_id=self.call_id,
+            service=self.service,
+            method=self.method,
+            argument_data=dumps(list(self.args)),
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    async def invoke(self) -> Any:
+        """Register → send → await completion (with cancel propagation)."""
+        if not self.no_wait:
+            self.peer.outbound_calls[self.call_id] = self
+        try:
+            await self.peer.send(self.to_message())
+        except Exception:
+            # not connected yet: stay registered; reconnect re-sends us
+            if self.no_wait:
+                raise
+        if self.no_wait:
+            return None
+        try:
+            return await self.future
+        except asyncio.CancelledError:
+            self.peer.outbound_calls.pop(self.call_id, None)
+            try:
+                await self.peer.send_system("cancel", [self.call_id])
+            except Exception:  # noqa: BLE001 — best-effort cancel
+                pass
+            raise
+
+    # -- completion (from $sys) -------------------------------------------
+    def set_result(self, value: Any, message: RpcMessage) -> None:
+        self.peer.outbound_calls.pop(self.call_id, None)
+        if self.future is not None and not self.future.done():
+            self.future.set_result(value)
+
+    def set_error(self, error: BaseException) -> None:
+        self.peer.outbound_calls.pop(self.call_id, None)
+        if self.future is not None and not self.future.done():
+            self.future.set_exception(error)
+
+
+class RpcInboundCall:
+    """One server-side call; registered for reconnect dedup."""
+
+    def __init__(self, peer: "RpcPeer", message: RpcMessage):
+        self.peer = peer
+        self.message = message
+        self.call_id = message.call_id
+        self.result_message: Optional[RpcMessage] = None
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self.peer.inbound_calls[self.call_id] = self
+        self._task = asyncio.get_event_loop().create_task(self._run())
+
+    def restart(self) -> None:
+        """Duplicate delivery (client re-sent after reconnect): re-send the
+        result if we have one; otherwise the original task is still running
+        and will send it."""
+        if self.result_message is not None:
+            asyncio.get_event_loop().create_task(self.peer.send(self.result_message))
+
+    async def _run(self) -> None:
+        try:
+            result = await self.invoke_target()
+            await self.send_ok(result)
+        except asyncio.CancelledError:
+            self.peer.inbound_calls.pop(self.call_id, None)
+            raise
+        except Exception as e:  # noqa: BLE001
+            await self.send_error(e)
+        finally:
+            self.on_completed()
+
+    async def invoke_target(self) -> Any:
+        args = loads(self.message.argument_data)
+        return await self.peer.hub.service_registry.invoke(
+            self.message.service, self.message.method, args
+        )
+
+    async def send_ok(self, result: Any, headers: tuple = ()) -> None:
+        self.result_message = RpcMessage(
+            call_type_id=self.message.call_type_id,
+            call_id=self.call_id,
+            service=SYSTEM_SERVICE,
+            method="ok",
+            argument_data=dumps(result),
+            headers=headers,
+        )
+        await self.peer.send(self.result_message)
+
+    async def send_error(self, error: BaseException) -> None:
+        self.result_message = RpcMessage(
+            call_type_id=self.message.call_type_id,
+            call_id=self.call_id,
+            service=SYSTEM_SERVICE,
+            method="error",
+            argument_data=dumps(ExceptionInfo.capture(error)),
+        )
+        await self.peer.send(self.result_message)
+
+    def on_completed(self) -> None:
+        """Plain calls stay registered for redelivery dedup; the peer prunes
+        completed entries with a recently-seen window."""
+        self.peer.note_inbound_completed(self.call_id)
+
+    def cancel(self) -> None:
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+
+
+class RpcCallTypeRegistry:
+    """(call_type_id) → (outbound class, inbound class); slot 0 = plain
+    calls (≈ RpcCallTypeRegistry.cs:7-40)."""
+
+    def __init__(self):
+        self._types: Dict[int, Tuple[Type[RpcOutboundCall], Type[RpcInboundCall]]] = {
+            CALL_TYPE_PLAIN: (RpcOutboundCall, RpcInboundCall)
+        }
+
+    def register(self, type_id: int, outbound: Type[RpcOutboundCall], inbound: Type[RpcInboundCall]):
+        self._types[type_id] = (outbound, inbound)
+
+    def outbound(self, type_id: int) -> Type[RpcOutboundCall]:
+        return self._types[type_id][0]
+
+    def inbound(self, type_id: int) -> Type[RpcInboundCall]:
+        return self._types[type_id][1]

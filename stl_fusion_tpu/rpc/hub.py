@@ -1,0 +1,140 @@
+"""RpcHub — root of the RPC stack + client proxies + call routing.
+
+Re-expression of src/Stl.Rpc/RpcHub.cs:7-93 (peer registry, lazy peer
+start), Configuration/RpcDefaultDelegates.cs (the ``RpcCallRouter`` — THE
+sharding/routing point: route a call to a peer by key, e.g. consistent
+hash over a server pool, samples/MultiServerRpc/Program.cs:58-76), and
+Infrastructure/RpcClientInterceptor.cs (proxy → outbound call, with local
+fallback when the router returns None — the basis of Router/Distributed
+service modes, FusionBuilder.cs:222-320).
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Sequence
+
+from ..utils.async_utils import ChannelPair
+from .calls import RpcCallTypeRegistry, RpcOutboundCall
+from .message import RpcMessage
+from .peer import RpcClientPeer, RpcPeer, RpcServerPeer
+from .registry import RpcServiceRegistry
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["RpcHub", "RpcClientProxy", "consistent_hash_router"]
+
+#: router: (service, method, args) -> peer ref (str) or None for local
+RpcCallRouter = Callable[[str, str, tuple], Optional[str]]
+
+
+class RpcHub:
+    def __init__(self, name: str = "rpc"):
+        self.name = name
+        self.service_registry = RpcServiceRegistry()
+        self.call_types = RpcCallTypeRegistry()
+        self.peers: Dict[str, RpcPeer] = {}
+        #: transport factory for client peers: async (peer) -> ChannelPair
+        self.client_connector: Optional[Callable[[RpcClientPeer], Awaitable[ChannelPair]]] = None
+        self.call_router: RpcCallRouter = lambda service, method, args: "default"
+        self.max_connect_attempts = 10_000
+        #: $sys-c dispatch hook, installed by the fusion client layer
+        self.compute_system_handler: Optional[Callable[[RpcPeer, RpcMessage], None]] = None
+        #: local service fallback for routing proxies
+        self.local_services: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------ server side
+    def add_service(self, name: str, implementation: Any):
+        """Expose a service to inbound calls."""
+        self.service_registry.add(name, implementation)
+        self.local_services[name] = implementation
+        return implementation
+
+    def server_peer(self, ref: str) -> RpcServerPeer:
+        peer = self.peers.get(ref)
+        if peer is None:
+            peer = RpcServerPeer(self, ref)
+            self.peers[ref] = peer
+        return peer  # type: ignore[return-value]
+
+    # ------------------------------------------------------------------ client side
+    def client_peer(self, ref: str = "default") -> RpcClientPeer:
+        peer = self.peers.get(ref)
+        if peer is None:
+            peer = RpcClientPeer(self, ref)
+            self.peers[ref] = peer
+            peer.start()
+        return peer  # type: ignore[return-value]
+
+    async def connect_client(self, peer: RpcClientPeer) -> ChannelPair:
+        if self.client_connector is None:
+            raise RuntimeError(f"hub {self.name!r} has no client connector configured")
+        return await self.client_connector(peer)
+
+    def client(self, service_name: str, peer_ref: Optional[str] = None) -> "RpcClientProxy":
+        """A call proxy for a remote service; without an explicit peer the
+        call router picks one per call (routing proxy)."""
+        return RpcClientProxy(self, service_name, peer_ref)
+
+    # ------------------------------------------------------------------ calls
+    async def call(
+        self,
+        service: str,
+        method: str,
+        args: tuple,
+        peer_ref: Optional[str] = None,
+        call_type_id: int = 0,
+        no_wait: bool = False,
+    ) -> Any:
+        ref = peer_ref if peer_ref is not None else self.call_router(service, method, args)
+        if ref is None:
+            # router says local (≈ RpcClientInterceptor local fallback)
+            local = self.local_services.get(service)
+            if local is None:
+                raise LookupError(f"no local implementation for {service!r}")
+            return await getattr(local, method)(*args)
+        peer = self.client_peer(ref)
+        await peer.when_connected()
+        outbound_cls = self.call_types.outbound(call_type_id)
+        call = outbound_cls(peer, service, method, args, no_wait=no_wait)
+        return await call.invoke()
+
+    async def stop(self) -> None:
+        for peer in list(self.peers.values()):
+            await peer.stop()
+
+
+class RpcClientProxy:
+    """Dynamic proxy: attribute access → remote (or routed) call."""
+
+    def __init__(self, hub: RpcHub, service: str, peer_ref: Optional[str] = None):
+        self._hub = hub
+        self._service = service
+        self._peer_ref = peer_ref
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        async def call(*args):
+            return await self._hub.call(self._service, method, args, peer_ref=self._peer_ref)
+
+        call.__name__ = method
+        return call
+
+    def __repr__(self) -> str:
+        return f"RpcClientProxy({self._service} @ {self._peer_ref or '<routed>'})"
+
+
+def consistent_hash_router(
+    peer_refs: Sequence[str], key_arg: int = 0
+) -> RpcCallRouter:
+    """Shard calls over a peer pool by hashing an argument — the reference's
+    MultiServerRpc routing pattern (Program.cs:58-76)."""
+
+    def route(service: str, method: str, args: tuple) -> str:
+        key = repr(args[key_arg]) if len(args) > key_arg else service
+        h = int.from_bytes(hashlib.sha1(key.encode()).digest()[:8], "big")
+        return peer_refs[h % len(peer_refs)]
+
+    return route

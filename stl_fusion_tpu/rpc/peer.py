@@ -1,0 +1,240 @@
+"""RpcPeer — the per-connection worker.
+
+Re-expression of src/Stl.Rpc/RpcPeer.cs:6-319: a peer owns one logical link
+(surviving physical reconnects), a connection-state AsyncEvent chain, the
+outbound/inbound call trackers, and the message pump. On every (re)connect
+it RE-SENDS all registered outbound calls (RpcPeer.cs:116-119) — the server
+side dedups via registered inbound calls — which is the whole reliability
+story: calls survive connection loss without user code noticing.
+
+``RpcClientPeer`` dials with jittered backoff (RpcClientPeerReconnectDelayer);
+``RpcServerPeer`` awaits connection handoffs from a listener/transport.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import TYPE_CHECKING, Any, Dict, Optional, Tuple
+
+from ..utils.async_chain import RetryDelaySeq, WorkerBase
+from ..utils.async_utils import AsyncEvent, Channel, ChannelClosedError, ChannelPair
+from ..utils.collections import RecentlySeenMap
+from ..utils.errors import ExceptionInfo
+from ..utils.serialization import dumps, loads
+from .message import COMPUTE_SYSTEM_SERVICE, SYSTEM_SERVICE, RpcMessage
+
+if TYPE_CHECKING:
+    from .hub import RpcHub
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["RpcPeer", "RpcClientPeer", "RpcServerPeer", "ConnectionState"]
+
+
+class ConnectionState:
+    DISCONNECTED = "disconnected"
+    CONNECTED = "connected"
+
+    def __init__(self, kind: str, error: Optional[BaseException] = None):
+        self.kind = kind
+        self.error = error
+
+    @property
+    def is_connected(self) -> bool:
+        return self.kind == ConnectionState.CONNECTED
+
+    def __repr__(self) -> str:
+        return f"ConnectionState({self.kind})"
+
+
+class RpcPeer(WorkerBase):
+    def __init__(self, hub: "RpcHub", ref: str):
+        super().__init__(f"rpc-peer:{ref}")
+        self.hub = hub
+        self.ref = ref
+        self.connection_state: AsyncEvent[ConnectionState] = AsyncEvent(
+            ConnectionState(ConnectionState.DISCONNECTED)
+        )
+        self.outbound_calls: Dict[int, Any] = {}
+        self.inbound_calls: Dict[int, Any] = {}
+        self._completed_inbound = RecentlySeenMap(capacity=10_000, max_age=600.0)
+        self._call_id_counter = itertools.count(1)
+        self._conn: Optional[ChannelPair] = None
+        self._send_lock = asyncio.Lock()
+
+    # ------------------------------------------------------------------ id/state
+    def allocate_call_id(self) -> int:
+        return next(self._call_id_counter)
+
+    @property
+    def is_connected(self) -> bool:
+        return self._conn is not None
+
+    def _set_state(self, kind: str, error: Optional[BaseException] = None) -> None:
+        self.connection_state = self.connection_state.latest().create_next(
+            ConnectionState(kind, error)
+        )
+
+    async def when_connected(self) -> None:
+        ev = self.connection_state.latest()
+        if not ev.value.is_connected:
+            self.start()
+            ev = await ev.when(lambda s: s.is_connected)
+
+    # ------------------------------------------------------------------ transport
+    async def acquire_connection(self) -> ChannelPair:
+        """Client: dial (with backoff); server: await handoff."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------ main loop
+    async def on_run(self) -> None:
+        while True:
+            try:
+                conn = await self.acquire_connection()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — unrecoverable connect error
+                log.debug("peer %s: terminal connect failure: %s", self.ref, e)
+                self._set_state(ConnectionState.DISCONNECTED, e)
+                return
+            self._conn = conn
+            self._set_state(ConnectionState.CONNECTED)
+            # reliability: re-send every registered outbound call
+            for call in list(self.outbound_calls.values()):
+                try:
+                    await self._send_raw(call.to_message())
+                except Exception:  # noqa: BLE001
+                    break
+            try:
+                while True:
+                    message = await conn.reader.receive()
+                    await self.process_message(message)
+            except asyncio.CancelledError:
+                conn.close()
+                raise
+            except (ChannelClosedError, ConnectionError, OSError) as e:
+                self._conn = None
+                self._set_state(ConnectionState.DISCONNECTED, e)
+                continue  # reconnect loop
+
+    # ------------------------------------------------------------------ send
+    async def send(self, message: RpcMessage) -> None:
+        if self._conn is None:
+            raise ConnectionError(f"peer {self.ref} is not connected")
+        await self._send_raw(message)
+
+    async def _send_raw(self, message: RpcMessage) -> None:
+        conn = self._conn
+        if conn is None:
+            raise ConnectionError(f"peer {self.ref} is not connected")
+        await conn.writer.send(message)
+
+    async def send_system(self, method: str, args: list, call_id: int = 0, headers: tuple = ()) -> None:
+        await self.send(
+            RpcMessage(0, call_id, SYSTEM_SERVICE, method, dumps(args), headers)
+        )
+
+    # ------------------------------------------------------------------ dispatch
+    async def process_message(self, message: RpcMessage) -> None:
+        if message.service == SYSTEM_SERVICE:
+            self._process_system(message)
+        elif message.service == COMPUTE_SYSTEM_SERVICE:
+            handler = self.hub.compute_system_handler
+            if handler is not None:
+                handler(self, message)
+        else:
+            self._process_inbound(message)
+
+    def _process_system(self, message: RpcMessage) -> None:
+        """$sys: ok / error / cancel / not-found (RpcSystemCalls.cs:6-71)."""
+        method = message.method
+        if method == "ok":
+            call = self.outbound_calls.get(message.call_id)
+            if call is not None:
+                call.set_result(loads(message.argument_data), message)
+        elif method == "error":
+            call = self.outbound_calls.get(message.call_id)
+            if call is not None:
+                info: ExceptionInfo = loads(message.argument_data)
+                call.set_error(info.to_exception())
+        elif method == "cancel":
+            (call_id,) = loads(message.argument_data)
+            inbound = self.inbound_calls.get(call_id)
+            if inbound is not None:
+                inbound.cancel()
+        elif method == "not-found":
+            call = self.outbound_calls.get(message.call_id)
+            if call is not None:
+                call.set_error(LookupError("remote endpoint not found"))
+
+    def _process_inbound(self, message: RpcMessage) -> None:
+        existing = self.inbound_calls.get(message.call_id)
+        if existing is not None:
+            existing.restart()  # duplicate delivery after reconnect
+            return
+        if message.call_id in self._completed_inbound:
+            return  # already served and pruned
+        inbound_cls = self.hub.call_types.inbound(message.call_type_id)
+        inbound_cls(self, message).start()
+
+    def note_inbound_completed(self, call_id: int) -> None:
+        # keep the entry for redelivery dedup; prune via recently-seen window
+        self._completed_inbound.try_add(call_id)
+
+    # ------------------------------------------------------------------ disconnect
+    async def disconnect(self, error: Optional[BaseException] = None) -> None:
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            conn.close(error)
+
+    async def stop(self) -> None:
+        await self.disconnect()
+        await super().stop()
+
+
+class RpcClientPeer(RpcPeer):
+    """Dials via the hub's client connector with jittered backoff
+    (≈ RpcClientPeer.cs:6-55 + RpcClientPeerReconnectDelayer)."""
+
+    def __init__(self, hub: "RpcHub", ref: str, reconnect_delays: Optional[RetryDelaySeq] = None):
+        super().__init__(hub, ref)
+        self.reconnect_delays = reconnect_delays or RetryDelaySeq(min_delay=0.05, max_delay=5.0)
+        self.reconnects_at: Optional[float] = None
+
+    async def acquire_connection(self) -> ChannelPair:
+        failures = 0
+        while True:
+            try:
+                conn = await self.hub.connect_client(self)
+                self.reconnects_at = None
+                return conn
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                failures += 1
+                if failures > self.hub.max_connect_attempts:
+                    raise
+                delay = self.reconnect_delays[failures]
+                self.reconnects_at = asyncio.get_event_loop().time() + delay
+                log.debug("peer %s reconnect #%d in %.2fs (%s)", self.ref, failures, delay, e)
+                await asyncio.sleep(delay)
+
+
+class RpcServerPeer(RpcPeer):
+    """Receives connections from a listener (≈ RpcServerPeer.cs)."""
+
+    def __init__(self, hub: "RpcHub", ref: str):
+        super().__init__(hub, ref)
+        self._handoff: "asyncio.Queue[ChannelPair]" = asyncio.Queue()
+
+    def connect(self, conn: ChannelPair) -> None:
+        """Hand a fresh transport to this peer (new physical connection)."""
+        old, self._conn = self._conn, None
+        if old is not None:
+            old.close()
+        self._handoff.put_nowait(conn)
+        self.start()
+
+    async def acquire_connection(self) -> ChannelPair:
+        return await self._handoff.get()

@@ -1,0 +1,52 @@
+"""In-memory RPC transport for protocol tests.
+
+Re-expression of src/Stl.Rpc/Testing/RpcTestClient.cs:7-73 +
+RpcTestConnection.cs: client peers connect over twisted in-memory channel
+pairs instead of sockets, with scripted ``disconnect()`` / ``reconnect()``
+so reliability behavior (re-send, dedup, invalidation-after-reconnect) is
+testable without any network. SURVEY.md §4 calls this out as the first
+transport to build.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+from ..utils.async_utils import ChannelPair, create_twisted_pair
+from .hub import RpcHub
+from .peer import RpcClientPeer, RpcServerPeer
+
+__all__ = ["RpcTestTransport"]
+
+
+class RpcTestTransport:
+    """Wires a client hub to a server hub through channel pairs."""
+
+    def __init__(self, client_hub: RpcHub, server_hub: RpcHub):
+        self.client_hub = client_hub
+        self.server_hub = server_hub
+        self.connect_count: Dict[str, int] = {}
+        self._blocked = False
+        client_hub.client_connector = self._connect
+
+    async def _connect(self, peer: RpcClientPeer) -> ChannelPair:
+        if self._blocked:
+            raise ConnectionError("test transport is blocked")
+        client_end, server_end = create_twisted_pair()
+        self.server_hub.server_peer(f"client:{peer.ref}").connect(server_end)
+        self.connect_count[peer.ref] = self.connect_count.get(peer.ref, 0) + 1
+        return client_end
+
+    # -- fault injection ---------------------------------------------------
+    async def disconnect(self, peer_ref: str = "default") -> None:
+        """Drop the physical link; the client peer will auto-reconnect."""
+        peer = self.client_hub.peers.get(peer_ref)
+        if peer is not None:
+            await peer.disconnect(ConnectionError("test disconnect"))
+
+    def block_reconnects(self, blocked: bool = True) -> None:
+        self._blocked = blocked
+
+    async def wait_connected(self, peer_ref: str = "default", timeout: float = 5.0) -> None:
+        peer = self.client_hub.client_peer(peer_ref)
+        await asyncio.wait_for(peer.when_connected(), timeout)

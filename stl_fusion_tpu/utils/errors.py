@@ -41,8 +41,9 @@ def register_exception_type(cls: Type[BaseException], name: Optional[str] = None
     return cls
 
 
-for _cls in (ValueError, KeyError, RuntimeError, TypeError, NotImplementedError,
-             TimeoutError, PermissionError, TransientError, ServiceError):
+for _cls in (ValueError, KeyError, LookupError, IndexError, RuntimeError, TypeError,
+             NotImplementedError, TimeoutError, PermissionError, ConnectionError,
+             TransientError, ServiceError):
     register_exception_type(_cls)
 
 
