@@ -1,0 +1,23 @@
+"""Fusion client layer — invalidation-aware caching RPC (SURVEY.md §2.5)."""
+from .cache import (
+    ClientComputedCache,
+    FileClientComputedCache,
+    InMemoryClientComputedCache,
+    RpcCacheKey,
+)
+from .client_function import ClientComputed, ClientComputeMethodFunction, FusionClient, compute_client
+from .compute_call import RpcInboundComputeCall, RpcOutboundComputeCall, install_compute_call_type
+
+__all__ = [
+    "ClientComputedCache",
+    "FileClientComputedCache",
+    "InMemoryClientComputedCache",
+    "RpcCacheKey",
+    "ClientComputed",
+    "ClientComputeMethodFunction",
+    "FusionClient",
+    "compute_client",
+    "RpcInboundComputeCall",
+    "RpcOutboundComputeCall",
+    "install_compute_call_type",
+]

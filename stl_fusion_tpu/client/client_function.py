@@ -1,0 +1,241 @@
+"""Client-side compute functions: cache-or-remote with invalidation binding.
+
+Re-expression of src/Stl.Fusion/Client/Interception/ —
+ClientComputeMethodFunction (:20-234), ClientComputed (:16-89) and the proxy
+wiring (Internal/FusionProxies.cs). A client proxy's methods are REAL
+compute methods on the client's own graph: results intern into the client
+registry, participate in dependency capture (a client ComputedState can
+depend on remote values), and invalidate when the server pushes
+``$sys-c.invalidate`` — re-entering the local cascade.
+
+Paths, mirroring the reference:
+- REMOTE: send a compute call, bind the resulting ClientComputed to the
+  call's invalidation future; if the result lands already-invalidated
+  (server invalidated between result and subscription), retry ≤3
+  (ClientComputeMethodFunction.cs:99-126);
+- CACHED: if a client cache holds bytes for the key, return a cache-based
+  computed IMMEDIATELY and race the true RPC in the background with
+  dependency capture suppressed (:59-85); when the true result arrives,
+  reuse the cached node if bytes match, else invalidate + replace
+  (:128-151); ``when_synchronized()`` gates consumers that need confirmed
+  values.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+from ..core.computed import Computed
+from ..core.context import ComputeContext, suspend_dependency_capture
+from ..core.function import FunctionBase
+from ..core.hub import FusionHub, default_hub
+from ..core.inputs import ComputedInput
+from ..core.options import ComputedOptions
+from ..utils.ltag import LTag
+from ..utils.result import Result
+from ..utils.serialization import dumps, loads
+from .cache import ClientComputedCache, RpcCacheKey
+from .compute_call import RpcOutboundComputeCall
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["ClientComputed", "ClientComputeMethodFunction", "FusionClient", "compute_client"]
+
+
+class ClientComputeMethodInput(ComputedInput):
+    __slots__ = ("function_ref", "method", "args")
+
+    def __init__(self, function_ref: "ClientComputeMethodFunction", method: str, args: tuple):
+        self.function_ref = function_ref
+        self.method = method
+        self.args = args
+        self._hash = hash((id(function_ref), method, args))
+
+    @property
+    def function(self) -> "FunctionBase":
+        return self.function_ref
+
+    def cache_key(self) -> RpcCacheKey:
+        return RpcCacheKey(self.function_ref.service, self.method, dumps(list(self.args)))
+
+    def __eq__(self, other):
+        return (
+            type(other) is ClientComputeMethodInput
+            and self.function_ref is other.function_ref
+            and self.method == other.method
+            and self.args == other.args
+        )
+
+    def __hash__(self):
+        return self._hash
+
+    def __repr__(self):
+        return f"{self.function_ref.service}.{self.method}{self.args!r} (client)"
+
+
+class ClientComputed(Computed):
+    """A computed whose source of truth is a remote node."""
+
+    __slots__ = ("call", "_synchronized")
+
+    def __init__(self, input, version, options, call: Optional[RpcOutboundComputeCall]):
+        super().__init__(input, version, options)
+        self.call = call
+        self._synchronized: Optional[asyncio.Future] = None
+        if call is not None:
+            self._bind_to_call(call)
+
+    def _bind_to_call(self, call: RpcOutboundComputeCall) -> None:
+        def on_invalidated(_fut):
+            self.invalidate(immediately=True)
+
+        call.when_invalidated.add_done_callback(on_invalidated)
+        self.on_invalidated(lambda _c: call.unregister())
+
+    # -- cache synchronization gate ---------------------------------------
+    @property
+    def is_synchronized(self) -> bool:
+        return self.call is not None or self._synchronized is None or self._synchronized.done()
+
+    def when_synchronized(self) -> asyncio.Future:
+        if self._synchronized is None:
+            self._synchronized = asyncio.get_event_loop().create_future()
+            if self.call is not None:
+                self._synchronized.set_result(None)
+        return self._synchronized
+
+    def _mark_synchronized(self) -> None:
+        if self._synchronized is not None and not self._synchronized.done():
+            self._synchronized.set_result(None)
+
+
+class ClientComputeMethodFunction(FunctionBase):
+    def __init__(
+        self,
+        hub: FusionHub,
+        rpc_hub,
+        service: str,
+        peer_ref: Optional[str],
+        cache: Optional[ClientComputedCache] = None,
+        options: Optional[ComputedOptions] = None,
+    ):
+        super().__init__(hub, options or ComputedOptions.CLIENT_DEFAULT)
+        self.rpc_hub = rpc_hub
+        self.service = service
+        self.peer_ref = peer_ref
+        self.cache = cache
+
+    # ------------------------------------------------------------------ compute
+    async def compute(self, input: ClientComputeMethodInput, existing: Optional[Computed]) -> Computed:
+        if self.cache is not None and existing is None:
+            cached = self.cache.get(input.cache_key())
+            if cached is not None:
+                return self._cached_compute(input, cached)
+        return await self._remote_compute(input, existing)
+
+    def _cached_compute(self, input, cached_bytes: bytes) -> "ClientComputed":
+        """Serve from cache NOW, confirm over RPC in the background."""
+        version = self.hub.version_generator.next()
+        computed = ClientComputed(input, version, self.options, call=None)
+        computed.when_synchronized()  # arm the gate before consumers can ask
+        computed.try_set_output(Result.ok(loads(cached_bytes)))
+        self.hub.registry.register(computed)
+
+        async def synchronize():
+            with suspend_dependency_capture():
+                try:
+                    real = await self._remote_compute(input, None, register=False)
+                except Exception:  # noqa: BLE001 — confirm failed; cache stays
+                    log.exception("cache synchronization for %r failed", input)
+                    return
+            real_bytes = dumps(real._output.value_or_default)
+            if real_bytes == cached_bytes and real.call is not None:
+                # cached value confirmed: rebind THIS node to the live call
+                computed.call = real.call
+                computed._bind_to_call(real.call)
+                computed._mark_synchronized()
+            else:
+                self.cache.set(input.cache_key(), real_bytes)
+                self.hub.registry.register(real)
+                computed._mark_synchronized()
+                computed.invalidate(immediately=True)  # dependents re-pull the real node
+
+        asyncio.get_event_loop().create_task(synchronize())
+        return computed
+
+    async def _remote_compute(
+        self, input, existing: Optional[Computed], register: bool = True
+    ) -> "ClientComputed":
+        tries = 0
+        while True:
+            tries += 1
+            peer_ref = self.peer_ref or self.rpc_hub.call_router(self.service, input.method, input.args)
+            peer = self.rpc_hub.client_peer(peer_ref or "default")
+            await peer.when_connected()
+            call = RpcOutboundComputeCall(peer, self.service, input.method, input.args)
+            try:
+                value = await call.invoke()
+                output = Result.ok(value)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — errors are memoized
+                output = Result.err(e)
+            version = call.result_version or self.hub.version_generator.next()
+            computed = ClientComputed(input, LTag(version), self.options, call)
+            computed.try_set_output(output)
+            # result arrived already invalidated ⇒ retry (≤3)
+            if call.when_invalidated.done() and not output.has_error and tries <= 3:
+                continue
+            if register:
+                self.hub.registry.register(computed)
+            if self.cache is not None and not output.has_error:
+                self.cache.set(input.cache_key(), dumps(value))
+            return computed
+
+
+class FusionClient:
+    """The client proxy: attribute access → client compute method.
+
+    ≈ FusionProxies.NewClientProxy — an RPC client proxy wrapped by the
+    client-compute interceptor."""
+
+    def __init__(
+        self,
+        service: str,
+        rpc_hub,
+        fusion_hub: Optional[FusionHub] = None,
+        peer_ref: Optional[str] = "default",
+        cache: Optional[ClientComputedCache] = None,
+        options: Optional[ComputedOptions] = None,
+    ):
+        self._function = ClientComputeMethodFunction(
+            fusion_hub or default_hub(), rpc_hub, service, peer_ref, cache, options
+        )
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+        function = self._function
+
+        async def call(*args):
+            from ..core.context import CallOptions, get_current
+
+            input = ClientComputeMethodInput(function, method, args)
+            context = ComputeContext.current()
+            used_by = None if context.call_options & CallOptions.INVALIDATE else get_current()
+            return await function.invoke_and_strip(input, used_by, context)
+
+        call.__name__ = method
+        return call
+
+
+def compute_client(
+    service: str,
+    rpc_hub,
+    fusion_hub: Optional[FusionHub] = None,
+    peer_ref: Optional[str] = "default",
+    cache: Optional[ClientComputedCache] = None,
+) -> FusionClient:
+    """Create an invalidation-aware client for a remote compute service."""
+    return FusionClient(service, rpc_hub, fusion_hub, peer_ref, cache)

@@ -1,0 +1,174 @@
+"""Compute-call RPC type: calls that carry invalidation subscriptions.
+
+Re-expression of src/Stl.Fusion/Client/Internal/ — RpcOutboundComputeCall
+(:11-109), RpcInboundComputeCall (:20-106), RpcComputeSystemCalls (:11-27):
+
+- the server runs the target under dependency capture, attaches the
+  computed's version as the ``@version`` header, sends the result, then
+  **keeps the call registered and awaits the computed's invalidation**;
+  when it fires, it pushes a ``$sys-c.invalidate`` (fire-and-forget) tagged
+  with the call id and only then completes;
+- the client resolves the pushed invalidation to the outbound call, which
+  invalidates its bound ClientComputed — re-entering the local cascade.
+
+This is THE mechanism that makes a remote cache coherent: every remote read
+is implicitly a subscription.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import TYPE_CHECKING, Any, Optional
+
+from ..core.context import try_capture
+from ..utils.ltag import LTag
+from ..utils.serialization import dumps, loads
+from ..rpc.calls import RpcInboundCall, RpcOutboundCall
+from ..rpc.message import (
+    CALL_TYPE_COMPUTE,
+    COMPUTE_SYSTEM_SERVICE,
+    VERSION_HEADER,
+    RpcMessage,
+)
+
+if TYPE_CHECKING:
+    from ..rpc.hub import RpcHub
+    from ..rpc.peer import RpcPeer
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["RpcOutboundComputeCall", "RpcInboundComputeCall", "install_compute_call_type"]
+
+
+class RpcOutboundComputeCall(RpcOutboundCall):
+    call_type_id = CALL_TYPE_COMPUTE
+
+    def __init__(self, peer, service, method, args, no_wait=False):
+        super().__init__(peer, service, method, args, no_wait)
+        self.result_version: Optional[LTag] = None
+        self.when_invalidated: asyncio.Future = asyncio.get_event_loop().create_future()
+
+    def set_result(self, value: Any, message: RpcMessage) -> None:
+        v = message.header(VERSION_HEADER)
+        self.result_version = LTag.parse(v) if v else None
+        # compute calls STAY registered — the invalidation push arrives later
+        if self.future is not None and not self.future.done():
+            self.future.set_result(value)
+
+    def set_error(self, error: BaseException) -> None:
+        super().set_error(error)
+        self.set_invalidated()  # an errored call can't deliver invalidations
+
+    def set_invalidated(self) -> None:
+        self.peer.outbound_calls.pop(self.call_id, None)
+        if not self.when_invalidated.done():
+            self.when_invalidated.set_result(None)
+
+    def unregister(self) -> None:
+        self.peer.outbound_calls.pop(self.call_id, None)
+
+
+class RpcInboundComputeCall(RpcInboundCall):
+    def __init__(self, peer, message):
+        super().__init__(peer, message)
+        self.computed = None
+
+    async def _run(self) -> None:
+        try:
+            computed = await self._capture_target()
+        except asyncio.CancelledError:
+            self.peer.inbound_calls.pop(self.call_id, None)
+            raise
+        except Exception as e:  # noqa: BLE001 — capture failed outright
+            await self.send_error(e)
+            self.peer.inbound_calls.pop(self.call_id, None)
+            return
+        self.computed = computed
+        headers = ((VERSION_HEADER, computed.version.format()),)
+        out = computed._output
+        if out is not None and out.has_error:
+            await self.send_error(out.error)  # errors carry no subscription
+            self.peer.inbound_calls.pop(self.call_id, None)
+            return
+        try:
+            await self.send_ok(out.value if out is not None else None, headers=headers)
+        except Exception:  # noqa: BLE001 — link died; restart() will re-send
+            pass
+        # stay registered; push $sys-c.invalidate when the computed dies
+        asyncio.get_event_loop().create_task(self._watch_invalidation(computed))
+
+    def restart(self) -> None:
+        """Re-delivery after reconnect: if our computed already died, the
+        result is stale — push the invalidation instead (≈ version-mismatch
+        handling, RpcInboundCall.Restart + RpcOutboundComputeCall version
+        checks)."""
+        if self.computed is not None and self.computed.is_invalidated:
+            asyncio.get_event_loop().create_task(self._send_invalidation())
+        else:
+            super().restart()
+
+    async def _capture_target(self):
+        from ..core.context import suspend_dependency_capture
+
+        args = loads(self.message.argument_data)
+        service_def = self.peer.hub.service_registry.require(self.message.service)
+        method = service_def.method(self.message.method)
+        with suspend_dependency_capture():  # RPC boundary: no cross-wire edges
+            computed = await try_capture(lambda: method.fn(*args))
+        if computed is None:
+            raise RuntimeError(
+                f"{self.message.service}.{self.message.method} is not a compute method "
+                f"(nothing was captured)"
+            )
+        return computed
+
+    async def _watch_invalidation(self, computed) -> None:
+        try:
+            await computed.when_invalidated()
+            await self._send_invalidation()
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self.peer.inbound_calls.pop(self.call_id, None)
+
+    async def _send_invalidation(self, max_attempts: int = 100) -> None:
+        """Deliver $sys-c.invalidate, riding out reconnects: the subscription
+        must not be lost just because the link was down when it fired."""
+        message = RpcMessage(
+            call_type_id=CALL_TYPE_COMPUTE,
+            call_id=self.call_id,
+            service=COMPUTE_SYSTEM_SERVICE,
+            method="invalidate",
+            argument_data=dumps([self.call_id]),
+        )
+        for _ in range(max_attempts):
+            try:
+                await self.peer.send(message)
+                return
+            except Exception:  # noqa: BLE001 — wait for the link to return
+                ev = self.peer.connection_state.latest()
+                if ev.value.is_connected:
+                    await asyncio.sleep(0.05)
+                else:
+                    try:
+                        await asyncio.wait_for(ev.when(lambda s: s.is_connected), 30.0)
+                    except asyncio.TimeoutError:
+                        return  # client is gone; it will resubscribe on return
+
+    def on_completed(self) -> None:
+        pass  # compute calls manage their own registration lifetime
+
+
+def install_compute_call_type(rpc_hub: "RpcHub") -> None:
+    """Register call type 1 + the $sys-c dispatcher on an RPC hub
+    (≈ RpcComputeCallType.cs registration)."""
+    rpc_hub.call_types.register(CALL_TYPE_COMPUTE, RpcOutboundComputeCall, RpcInboundComputeCall)
+
+    def handle_compute_system(peer: "RpcPeer", message: RpcMessage) -> None:
+        if message.method == "invalidate":
+            (call_id,) = loads(message.argument_data)
+            call = peer.outbound_calls.get(call_id)
+            if isinstance(call, RpcOutboundComputeCall):
+                call.set_invalidated()
+
+    rpc_hub.compute_system_handler = handle_compute_system

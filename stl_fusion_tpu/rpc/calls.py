@@ -127,9 +127,17 @@ class RpcInboundCall:
 
     async def invoke_target(self) -> Any:
         args = loads(self.message.argument_data)
-        return await self.peer.hub.service_registry.invoke(
-            self.message.service, self.message.method, args
-        )
+        # the RPC boundary is a dependency-capture boundary: this task may
+        # have inherited a computing node's contextvars from whatever task
+        # transitively started the peer (single-process client+server), and
+        # capturing server nodes into a CLIENT computed would short-circuit
+        # the graph across the "wire"
+        from ..core.context import ComputeContext, suspend_dependency_capture
+
+        with suspend_dependency_capture(), ComputeContext.DEFAULT.activate():
+            return await self.peer.hub.service_registry.invoke(
+                self.message.service, self.message.method, args
+            )
 
     async def send_ok(self, result: Any, headers: tuple = ()) -> None:
         self.result_message = RpcMessage(

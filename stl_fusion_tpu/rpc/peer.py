@@ -187,6 +187,8 @@ class RpcPeer(WorkerBase):
         conn, self._conn = self._conn, None
         if conn is not None:
             conn.close(error)
+            # surface the drop immediately — the pump notices asynchronously
+            self._set_state(ConnectionState.DISCONNECTED, error)
 
     async def stop(self) -> None:
         await self.disconnect()

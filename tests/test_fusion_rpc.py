@@ -1,0 +1,182 @@
+"""Fusion-over-RPC tests — ports of FusionRpcBasicTest /
+FusionRpcReconnectionTest / KeyValueServiceWithCacheTest semantics
+(tests/Stl.Fusion.Tests): remote compute calls memoize client-side,
+server-side invalidation pushes $sys-c and cascades through the client
+graph, calls survive reconnects, and the client cache boots values."""
+import asyncio
+
+import pytest
+
+from stl_fusion_tpu.client import (
+    InMemoryClientComputedCache,
+    compute_client,
+    install_compute_call_type,
+)
+from stl_fusion_tpu.core import (
+    ComputeService,
+    FusionHub,
+    capture,
+    compute_method,
+    invalidating,
+    set_default_hub,
+)
+from stl_fusion_tpu.rpc import RpcHub, RpcTestTransport
+
+
+class CounterService(ComputeService):
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self.counters = {}
+        self.compute_count = 0
+
+    @compute_method
+    async def get(self, key: str) -> int:
+        self.compute_count += 1
+        return self.counters.get(key, 0)
+
+    async def increment(self, key: str):
+        self.counters[key] = self.counters.get(key, 0) + 1
+        with invalidating():
+            await self.get(key)
+
+
+def make_stack(cache=None):
+    server_fusion = FusionHub()
+    client_fusion = FusionHub()
+    server_rpc = RpcHub("server")
+    client_rpc = RpcHub("client")
+    install_compute_call_type(server_rpc)
+    install_compute_call_type(client_rpc)
+    svc = CounterService(server_fusion)
+    server_rpc.add_service("counters", svc)
+    transport = RpcTestTransport(client_rpc, server_rpc)
+    client = compute_client("counters", client_rpc, client_fusion, cache=cache)
+    return svc, client, transport, client_rpc, server_rpc, client_fusion
+
+
+async def _stop(*hubs):
+    for h in hubs:
+        await h.stop()
+
+
+async def test_remote_compute_memoizes_client_side():
+    svc, client, _t, crpc, srpc, _cf = make_stack()
+    try:
+        assert await client.get("a") == 0
+        assert await client.get("a") == 0
+        assert svc.compute_count == 1  # second client call never hit the wire
+    finally:
+        await _stop(crpc, srpc)
+
+
+async def test_server_invalidation_pushes_to_client():
+    svc, client, _t, crpc, srpc, cf = make_stack()
+    try:
+        old = set_default_hub(cf)
+        try:
+            assert await client.get("a") == 0
+            node = await capture(lambda: client.get("a"))
+        finally:
+            set_default_hub(old)
+        assert node.is_consistent
+        await svc.increment("a")  # server-side invalidation
+        await asyncio.wait_for(node.when_invalidated(), 5.0)  # $sys-c push
+        assert await client.get("a") == 1
+    finally:
+        await _stop(crpc, srpc)
+
+
+async def test_client_graph_cascades_from_remote_dependency():
+    """A LOCAL compute method depending on a REMOTE value invalidates when
+    the server pushes — the cross-process dependency graph."""
+    svc, client, _t, crpc, srpc, client_fusion = make_stack()
+    try:
+
+        class LocalView(ComputeService):
+            views = 0
+
+            @compute_method
+            async def doubled(self, key: str) -> int:
+                LocalView.views += 1
+                return 2 * await client.get(key)
+
+        view = LocalView(client_fusion)
+        old = set_default_hub(client_fusion)
+        try:
+            assert await view.doubled("x") == 0
+            node = await capture(lambda: view.doubled("x"))
+        finally:
+            set_default_hub(old)
+        await svc.increment("x")
+        await asyncio.wait_for(node.when_invalidated(), 5.0)
+        old = set_default_hub(client_fusion)
+        try:
+            assert await view.doubled("x") == 2
+        finally:
+            set_default_hub(old)
+    finally:
+        await _stop(crpc, srpc)
+
+
+async def test_compute_call_survives_reconnect():
+    svc, client, transport, crpc, srpc, cf = make_stack()
+    try:
+        assert await client.get("r") == 0
+        node = await capture(lambda: client.get("r"))
+        await transport.disconnect()
+        await transport.wait_connected()
+        # invalidation subscription still works after the reconnect:
+        # client re-sent the registered compute call; server re-captured
+        await svc.increment("r")
+        await asyncio.wait_for(node.when_invalidated(), 5.0)
+        assert await client.get("r") == 1
+    finally:
+        await _stop(crpc, srpc)
+
+
+async def test_remote_error_memoized_and_raised():
+    server_fusion = FusionHub()
+    server_rpc = RpcHub("server")
+    client_rpc = RpcHub("client")
+    install_compute_call_type(server_rpc)
+    install_compute_call_type(client_rpc)
+
+    class Failing(ComputeService):
+        @compute_method(transient_error_invalidation_delay=float("inf"))
+        async def get(self) -> int:
+            raise ValueError("remote boom")
+
+    server_rpc.add_service("failing", Failing(server_fusion))
+    RpcTestTransport(client_rpc, server_rpc)
+    client = compute_client("failing", client_rpc, FusionHub())
+    try:
+        with pytest.raises(ValueError, match="remote boom"):
+            await client.get()
+    finally:
+        await _stop(client_rpc, server_rpc)
+
+
+async def test_client_cache_boots_and_synchronizes():
+    cache = InMemoryClientComputedCache()
+    svc, client, _t, crpc, srpc, cf = make_stack(cache=cache)
+    try:
+        assert await client.get("c") == 0
+        assert len(cache) == 1
+    finally:
+        await _stop(crpc, srpc)
+
+    # fresh client stack with the SAME cache: first read served from cache
+    svc2, client2, _t2, crpc2, srpc2, cf2 = make_stack(cache=cache)
+    svc2.counters["c"] = 5  # server state moved on while we were away
+    try:
+        node = None
+        v = await client2.get("c")
+        assert v == 0  # cached value served instantly
+        node = await capture(lambda: client2.get("c"))
+        assert isinstance(node.when_synchronized(), asyncio.Future)
+        await asyncio.wait_for(node.when_synchronized(), 5.0)
+        # cache mismatched the live value: node invalidated, next read is live
+        await asyncio.sleep(0.05)
+        assert await client2.get("c") == 5
+    finally:
+        await _stop(crpc2, srpc2)
