@@ -1,0 +1,19 @@
+"""Durable operation log + multi-host invalidation (SURVEY.md §2.6)."""
+from .log import InMemoryOperationLog, OperationLog, OperationRecord, SqliteOperationLog
+from .reader import (
+    FileChangeNotifier,
+    LocalChangeNotifier,
+    OperationLogReader,
+    attach_operation_log,
+)
+
+__all__ = [
+    "InMemoryOperationLog",
+    "OperationLog",
+    "OperationRecord",
+    "SqliteOperationLog",
+    "FileChangeNotifier",
+    "LocalChangeNotifier",
+    "OperationLogReader",
+    "attach_operation_log",
+]

@@ -1,0 +1,166 @@
+"""Durable operation log — the multi-host invalidation backbone.
+
+Re-expression of src/Stl.Fusion.EntityFramework/Operations/ (DbOperation,
+IDbOperationLog, DbOperationScope): every completed command is appended as a
+durable record (id, agent, commit time, serialized command + nested items);
+other hosts tail the log and replay external operations as invalidations
+(reader.py). Store-agnostic per SURVEY §7 step 7: a sqlite implementation
+(stdlib — the durable default) and an in-memory one for tests.
+
+This is also the checkpoint/resume story (SURVEY §5.4): a restarted host
+re-reads from its commit-time watermark, so invalidation truth survives
+restarts.
+"""
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, List, Optional
+
+from ..utils.serialization import decode, encode
+
+__all__ = ["OperationRecord", "OperationLog", "SqliteOperationLog", "InMemoryOperationLog"]
+
+
+@dataclass(frozen=True)
+class OperationRecord:
+    id: str
+    agent_id: str
+    commit_time: float
+    command: Any
+    items: tuple  # nested commands
+    index: int = 0  # log position (store-assigned)
+
+
+class OperationLog:
+    """Abstract durable operation log."""
+
+    def append(self, record: OperationRecord) -> OperationRecord:
+        raise NotImplementedError
+
+    def read_after(self, index: int, limit: int = 1024) -> List[OperationRecord]:
+        """Records with position > index, oldest first."""
+        raise NotImplementedError
+
+    def last_index(self) -> int:
+        raise NotImplementedError
+
+    def trim_before(self, commit_time: float) -> int:
+        """Drop old records (≈ DbOperationLogTrimmer). Returns removed count."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryOperationLog(OperationLog):
+    def __init__(self):
+        self._records: List[OperationRecord] = []
+        self._lock = threading.Lock()
+
+    def append(self, record: OperationRecord) -> OperationRecord:
+        with self._lock:
+            rec = OperationRecord(
+                record.id, record.agent_id, record.commit_time, record.command,
+                record.items, index=len(self._records) + 1,
+            )
+            self._records.append(rec)
+            return rec
+
+    def read_after(self, index: int, limit: int = 1024) -> List[OperationRecord]:
+        with self._lock:
+            return [r for r in self._records if r.index > index][:limit]
+
+    def last_index(self) -> int:
+        with self._lock:
+            return self._records[-1].index if self._records else 0
+
+    def trim_before(self, commit_time: float) -> int:
+        with self._lock:
+            keep = [r for r in self._records if r.commit_time >= commit_time]
+            removed = len(self._records) - len(keep)
+            self._records = keep
+            return removed
+
+
+class SqliteOperationLog(OperationLog):
+    """Durable log in sqlite — the shared-DB pattern the reference's
+    multi-host samples run on (two hosts, one database file)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute(
+            """CREATE TABLE IF NOT EXISTS operations (
+                idx INTEGER PRIMARY KEY AUTOINCREMENT,
+                id TEXT UNIQUE,
+                agent_id TEXT,
+                commit_time REAL,
+                command_json TEXT,
+                items_json TEXT
+            )"""
+        )
+        self._conn.execute(
+            "CREATE INDEX IF NOT EXISTS ix_operations_commit ON operations(commit_time)"
+        )
+        self._conn.commit()
+
+    def append(self, record: OperationRecord) -> OperationRecord:
+        with self._lock:
+            cur = self._conn.execute(
+                "INSERT OR IGNORE INTO operations (id, agent_id, commit_time, command_json, items_json)"
+                " VALUES (?, ?, ?, ?, ?)",
+                (
+                    record.id,
+                    record.agent_id,
+                    record.commit_time,
+                    json.dumps(encode(record.command)),
+                    json.dumps(encode(list(record.items))),
+                ),
+            )
+            self._conn.commit()
+            idx = cur.lastrowid or 0
+            return OperationRecord(
+                record.id, record.agent_id, record.commit_time, record.command,
+                record.items, index=idx,
+            )
+
+    def read_after(self, index: int, limit: int = 1024) -> List[OperationRecord]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT idx, id, agent_id, commit_time, command_json, items_json"
+                " FROM operations WHERE idx > ? ORDER BY idx LIMIT ?",
+                (index, limit),
+            ).fetchall()
+        return [
+            OperationRecord(
+                id=r[1],
+                agent_id=r[2],
+                commit_time=r[3],
+                command=decode(json.loads(r[4])),
+                items=tuple(decode(json.loads(r[5]))),
+                index=r[0],
+            )
+            for r in rows
+        ]
+
+    def last_index(self) -> int:
+        with self._lock:
+            row = self._conn.execute("SELECT MAX(idx) FROM operations").fetchone()
+            return row[0] or 0
+
+    def trim_before(self, commit_time: float) -> int:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM operations WHERE commit_time < ?", (commit_time,)
+            )
+            self._conn.commit()
+            return cur.rowcount
+
+    def close(self) -> None:
+        self._conn.close()

@@ -1,0 +1,167 @@
+"""Operation-log reader + change notifiers — cross-host invalidation.
+
+Re-expression of src/Stl.Fusion.EntityFramework/Operations/
+DbOperationLogReader.cs:7-128 and the change-notifier family (Npgsql NOTIFY,
+Redis pub/sub, file watcher — §2.6): each host runs a reader that tails the
+shared log from a position watermark, filters out its OWN operations
+(agent_id match, :85-92), and feeds external ones into the local
+OperationCompletionNotifier — whose CompletionProducer →
+PostCompletionInvalidator pipeline replays them as invalidations, exactly
+like local completions.
+
+Notifiers wake the reader without polling; the in-process ``LocalChangeNotifier``
+is the test/fan-out default, ``FileChangeNotifier`` watches a touch-file
+(≈ FileBasedDbOperationLogChangeNotifier) for cross-process setups.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import TYPE_CHECKING, Callable, List, Optional
+
+from ..operations.operation import Operation
+from ..utils.async_chain import WorkerBase
+from .log import OperationLog, OperationRecord
+
+if TYPE_CHECKING:
+    from ..operations.pipeline import OperationsHost
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["OperationLogReader", "LocalChangeNotifier", "FileChangeNotifier", "attach_operation_log"]
+
+
+class LocalChangeNotifier:
+    """In-process wakeup fan-out (multi-"host" single-process tests)."""
+
+    def __init__(self):
+        self._events: List[asyncio.Event] = []
+
+    def subscribe(self) -> asyncio.Event:
+        ev = asyncio.Event()
+        self._events.append(ev)
+        return ev
+
+    def notify(self) -> None:
+        for ev in self._events:
+            ev.set()
+
+
+class FileChangeNotifier:
+    """Touch-file wakeup for cross-process hosts sharing a log file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._local = LocalChangeNotifier()
+        self._last_mtime = 0.0
+
+    def subscribe(self) -> asyncio.Event:
+        return self._local.subscribe()
+
+    def notify(self) -> None:
+        with open(self.path, "a") as f:
+            f.write("")
+        os.utime(self.path, None)
+        self._local.notify()
+
+    def poll(self) -> bool:
+        try:
+            m = os.path.getmtime(self.path)
+        except OSError:
+            return False
+        if m > self._last_mtime:
+            self._last_mtime = m
+            self._local.notify()
+            return True
+        return False
+
+
+class OperationLogReader(WorkerBase):
+    def __init__(
+        self,
+        log_store: OperationLog,
+        operations: "OperationsHost",
+        notifier=None,
+        poll_period: float = 0.25,
+        start_from_end: bool = True,
+        batch_size: int = 1024,
+    ):
+        super().__init__("oplog-reader")
+        self.log_store = log_store
+        self.operations = operations
+        self.notifier = notifier
+        self.poll_period = poll_period
+        self.batch_size = batch_size
+        self.watermark = log_store.last_index() if start_from_end else 0
+        self.external_seen = 0
+
+    async def on_run(self) -> None:
+        wake = self.notifier.subscribe() if self.notifier is not None else None
+        while True:
+            await self.read_new()
+            if wake is not None:
+                try:
+                    await asyncio.wait_for(wake.wait(), self.poll_period * 4)
+                except asyncio.TimeoutError:
+                    pass  # safety poll: progress even on missed notifications
+                wake.clear()
+                if hasattr(self.notifier, "poll"):
+                    self.notifier.poll()
+            else:
+                await asyncio.sleep(self.poll_period)
+
+    async def read_new(self) -> int:
+        """Tail from the watermark; feed EXTERNAL operations to completion."""
+        handled = 0
+        while True:
+            records = self.log_store.read_after(self.watermark, self.batch_size)
+            if not records:
+                return handled
+            for rec in records:
+                self.watermark = max(self.watermark, rec.index)
+                if rec.agent_id == self.operations.agent.id:
+                    continue  # our own operation: already completed locally
+                self.external_seen += 1
+                operation = Operation(
+                    command=rec.command,
+                    agent_id=rec.agent_id,
+                    id=rec.id,
+                    commit_time=rec.commit_time,
+                    items=list(rec.items),
+                )
+                await self.operations.notify_completed(operation, is_local=False)
+                handled += 1
+
+
+def attach_operation_log(
+    commander,
+    log_store: OperationLog,
+    notifier=None,
+    start_reader: bool = True,
+) -> OperationLogReader:
+    """Wire a commander's operations pipeline to a durable log:
+    - local completions append to the log (+ notify),
+    - a reader replays external completions from other hosts.
+    """
+    commander.attach_operations_pipeline()
+    operations = commander.operations
+
+    async def persist(operation) -> None:
+        self_rec = OperationRecord(
+            id=operation.id,
+            agent_id=operation.agent_id,
+            commit_time=operation.commit_time or time.time(),
+            command=operation.command,
+            items=tuple(operation.items),
+        )
+        log_store.append(self_rec)
+        if notifier is not None:
+            notifier.notify()
+
+    operations.commit_listeners.append(persist)
+    reader = OperationLogReader(log_store, operations, notifier)
+    if start_reader:
+        reader.start()
+    return reader

@@ -1,0 +1,155 @@
+"""Multi-host invalidation via the shared operation log — the reference's
+two-hosts-one-DB pattern (SURVEY §3.5, DbContextTest / TodoApp multi-host):
+a command on host A invalidates host B's computed graph through the log."""
+import asyncio
+import dataclasses
+
+import pytest
+
+from stl_fusion_tpu.core import (
+    ComputeService,
+    FusionHub,
+    capture,
+    compute_method,
+    is_invalidating,
+)
+from stl_fusion_tpu.commands import command_handler
+from stl_fusion_tpu.oplog import (
+    InMemoryOperationLog,
+    LocalChangeNotifier,
+    SqliteOperationLog,
+    attach_operation_log,
+)
+from stl_fusion_tpu.utils.serialization import wire_type
+
+
+# shared "database" both hosts read
+DB = {}
+
+
+@wire_type("SetValue")
+@dataclasses.dataclass(frozen=True)
+class SetValue:
+    key: str
+    value: int
+
+
+class ValueService(ComputeService):
+    """One per host; reads the shared DB, command mutates + invalidates."""
+
+    @compute_method
+    async def get(self, key: str) -> int:
+        return DB.get(key, 0)
+
+    @command_handler
+    async def set_value(self, command: SetValue):
+        if is_invalidating():
+            await self.get(command.key)
+            return
+        DB[command.key] = command.value
+
+
+def make_host(log_store, notifier):
+    hub = FusionHub()
+    svc = ValueService(hub)
+    hub.commander.add_service(svc)
+    reader = attach_operation_log(hub.commander, log_store, notifier)
+    return hub, svc, reader
+
+
+async def test_cross_host_invalidation_in_memory():
+    DB.clear()
+    log_store = InMemoryOperationLog()
+    notifier = LocalChangeNotifier()
+    hub_a, svc_a, reader_a = make_host(log_store, notifier)
+    hub_b, svc_b, reader_b = make_host(log_store, notifier)
+    try:
+        assert await svc_b.get("x") == 0
+        node_b = await capture(lambda: svc_b.get("x"))
+
+        # host A runs the command; host B must invalidate via the log
+        await hub_a.commander.call(SetValue("x", 42))
+        await asyncio.wait_for(node_b.when_invalidated(), 5.0)
+        assert await svc_b.get("x") == 42
+
+        # A's own node invalidated locally (pipeline), without the log
+        assert await svc_a.get("x") == 42
+    finally:
+        await reader_a.stop()
+        await reader_b.stop()
+
+
+async def test_cross_host_invalidation_sqlite(tmp_path):
+    DB.clear()
+    path = str(tmp_path / "ops.sqlite")
+    log_store = SqliteOperationLog(path)
+    notifier = LocalChangeNotifier()
+    hub_a, svc_a, reader_a = make_host(log_store, notifier)
+    hub_b, svc_b, reader_b = make_host(log_store, notifier)
+    try:
+        assert await svc_b.get("k") == 0
+        node_b = await capture(lambda: svc_b.get("k"))
+        await hub_a.commander.call(SetValue("k", 7))
+        await asyncio.wait_for(node_b.when_invalidated(), 5.0)
+        assert await svc_b.get("k") == 7
+        assert log_store.last_index() == 1
+    finally:
+        await reader_a.stop()
+        await reader_b.stop()
+        log_store.close()
+
+
+async def test_restarted_host_replays_from_watermark(tmp_path):
+    """Checkpoint/resume: a host that was down during a write catches up
+    when it comes back (watermark semantics, SURVEY §5.4)."""
+    DB.clear()
+    path = str(tmp_path / "ops.sqlite")
+    log_store = SqliteOperationLog(path)
+    hub_a, svc_a, reader_a = make_host(log_store, LocalChangeNotifier())
+    try:
+        await hub_a.commander.call(SetValue("w", 1))
+    finally:
+        await reader_a.stop()
+
+    # "restart" host B reading from position 0 (cold boot replay)
+    DB["w"] = 1
+    hub_b = FusionHub()
+    svc_b = ValueService(hub_b)
+    hub_b.commander.add_service(svc_b)
+    from stl_fusion_tpu.oplog import OperationLogReader
+
+    hub_b.commander.attach_operations_pipeline()
+    reader_b = OperationLogReader(log_store, hub_b.commander.operations, start_from_end=False)
+    try:
+        node = await capture(lambda: svc_b.get("w"))
+        assert node.is_consistent
+        handled = await reader_b.read_new()
+        assert handled == 1  # A's operation replayed
+        assert node.is_invalidated
+    finally:
+        await reader_b.stop()
+        log_store.close()
+
+
+async def test_own_operations_not_replayed():
+    DB.clear()
+    log_store = InMemoryOperationLog()
+    notifier = LocalChangeNotifier()
+    hub_a, svc_a, reader_a = make_host(log_store, notifier)
+    try:
+        await hub_a.commander.call(SetValue("self", 1))
+        await asyncio.sleep(0.1)
+        assert reader_a.external_seen == 0  # own agent ops filtered
+        assert log_store.last_index() == 1
+    finally:
+        await reader_a.stop()
+
+
+async def test_log_trim():
+    log_store = InMemoryOperationLog()
+    from stl_fusion_tpu.oplog import OperationRecord
+
+    for i in range(5):
+        log_store.append(OperationRecord(f"op{i}", "agent", float(i), None, ()))
+    assert log_store.trim_before(3.0) == 3
+    assert len(log_store.read_after(0)) == 2
