@@ -64,6 +64,15 @@ def _command_type_of(fn: Callable) -> Type:
     if not params:
         raise TypeError(f"{fn.__qualname__}: command handlers need a command parameter")
     ann = params[0].annotation
+    if isinstance(ann, str):
+        # `from __future__ import annotations` stringifies annotations
+        import typing
+
+        try:
+            hints = typing.get_type_hints(fn)
+            ann = hints.get(params[0].name, ann)
+        except Exception:  # noqa: BLE001
+            pass
     if ann is inspect.Parameter.empty or not isinstance(ann, type):
         raise TypeError(
             f"{fn.__qualname__}: the command parameter must be annotated with the command type"

@@ -1,0 +1,4 @@
+"""Diagnostics (SURVEY.md §5.1): registry monitoring + hit-ratio reports."""
+from .monitor import FusionMonitor
+
+__all__ = ["FusionMonitor"]

@@ -1,0 +1,66 @@
+"""FusionMonitor — registry access sampling + periodic stats.
+
+Re-expression of src/Stl.Fusion/Diagnostics/FusionMonitor.cs:7-100: samples
+ComputedRegistry events (access = reads, register = computes) and reports
+hit ratios; the number the reference's benchmark brags about is exactly
+``hits / accesses``.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:
+    from ..core.hub import FusionHub
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["FusionMonitor"]
+
+
+class FusionMonitor:
+    def __init__(self, hub: "FusionHub", report_period: float = 60.0):
+        self.hub = hub
+        self.report_period = report_period
+        self.accesses = 0
+        self.registrations = 0
+        self.invalidations = 0
+        self._started_at = time.monotonic()
+        self._last_report = self._started_at
+        hub.registry.on_access.append(self._on_access)
+        hub.registry.on_register.append(self._on_register)
+        hub.invalidated_hooks.append(self._on_invalidated)
+
+    # computes (misses) register; everything else that probed was a hit
+    @property
+    def hits(self) -> int:
+        return max(self.accesses - self.registrations, 0)
+
+    @property
+    def hit_ratio(self) -> float:
+        return self.hits / self.accesses if self.accesses else 0.0
+
+    def _on_access(self, _input) -> None:
+        self.accesses += 1
+        now = time.monotonic()
+        if now - self._last_report >= self.report_period:
+            self._last_report = now
+            log.info("fusion stats: %s", self.report())
+
+    def _on_register(self, _computed) -> None:
+        self.registrations += 1
+
+    def _on_invalidated(self, _computed) -> None:
+        self.invalidations += 1
+
+    def report(self) -> dict:
+        elapsed = time.monotonic() - self._started_at
+        return {
+            "accesses": self.accesses,
+            "computes": self.registrations,
+            "invalidations": self.invalidations,
+            "hit_ratio": round(self.hit_ratio, 4),
+            "registry_size": len(self.hub.registry),
+            "accesses_per_sec": round(self.accesses / elapsed, 1) if elapsed else 0.0,
+        }

@@ -1,0 +1,31 @@
+"""Extension services (SURVEY.md §2.8): time, KV store, auth, sessions,
+peer monitoring."""
+from .auth import (
+    EditUserCommand,
+    InMemoryAuthService,
+    SessionInfo,
+    SignInCommand,
+    SignOutCommand,
+    User,
+)
+from .fusion_time import FusionTime
+from .kv_store import KeyValueStore, RemoveCommand, SetCommand
+from .peer_monitor import RpcPeerState, RpcPeerStateMonitor
+from .session import Session, SessionResolver
+
+__all__ = [
+    "EditUserCommand",
+    "InMemoryAuthService",
+    "SessionInfo",
+    "SignInCommand",
+    "SignOutCommand",
+    "User",
+    "FusionTime",
+    "KeyValueStore",
+    "RemoveCommand",
+    "SetCommand",
+    "RpcPeerState",
+    "RpcPeerStateMonitor",
+    "Session",
+    "SessionResolver",
+]

@@ -1,0 +1,142 @@
+"""Auth — session-keyed authentication as a compute service.
+
+Re-expression of src/Stl.Fusion.Ext.Contracts/Authentication/IAuth.cs +
+Ext.Services InMemoryAuthService: ``get_user``/``get_session_info`` are
+compute methods (so UIs LIVE-update on sign-in/out anywhere in the cluster),
+sign-in/sign-out/edit are commands whose replay invalidates exactly the
+affected session/user reads.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..commands.handlers import command_handler
+from ..core.context import is_invalidating
+from ..core.hub import FusionHub
+from ..core.service import ComputeService, compute_method
+from ..utils.serialization import wire_type
+from .session import Session
+
+__all__ = ["User", "SessionInfo", "SignInCommand", "SignOutCommand", "EditUserCommand", "InMemoryAuthService"]
+
+
+@wire_type("AuthUser")
+@dataclasses.dataclass(frozen=True)
+class User:
+    id: str
+    name: str
+    claims: tuple = ()  # ((key, value), ...)
+
+    @property
+    def is_authenticated(self) -> bool:
+        return bool(self.id)
+
+
+@wire_type("SessionInfo")
+@dataclasses.dataclass(frozen=True)
+class SessionInfo:
+    session_id: str
+    user_id: str = ""
+    created_at: float = 0.0
+    last_seen_at: float = 0.0
+
+    @property
+    def is_authenticated(self) -> bool:
+        return bool(self.user_id)
+
+
+@wire_type("SignIn")
+@dataclasses.dataclass(frozen=True)
+class SignInCommand:
+    session: Session
+    user: User
+
+
+@wire_type("SignOut")
+@dataclasses.dataclass(frozen=True)
+class SignOutCommand:
+    session: Session
+    force: bool = False
+
+
+@wire_type("EditUser")
+@dataclasses.dataclass(frozen=True)
+class EditUserCommand:
+    session: Session
+    name: str
+
+
+class InMemoryAuthService(ComputeService):
+    """IAuth + IAuthBackend in one in-memory service."""
+
+    def __init__(self, hub: Optional[FusionHub] = None):
+        super().__init__(hub)
+        self._sessions: Dict[str, SessionInfo] = {}
+        self._users: Dict[str, User] = {}
+
+    # ------------------------------------------------------------------ reads (IAuth)
+    @compute_method
+    async def get_session_info(self, session: Session) -> Optional[SessionInfo]:
+        return self._sessions.get(session.id)
+
+    @compute_method
+    async def get_user(self, session: Session) -> Optional[User]:
+        info = await self.get_session_info(session)
+        if info is None or not info.user_id:
+            return None
+        return self._users.get(info.user_id)
+
+    @compute_method
+    async def is_sign_out_forced(self, session: Session) -> bool:
+        info = self._sessions.get(session.id)
+        return info is None and session.id in getattr(self, "_forced_out", set())
+
+    @compute_method
+    async def get_user_sessions(self, user_id: str) -> tuple:
+        return tuple(sorted(sid for sid, i in self._sessions.items() if i.user_id == user_id))
+
+    # ------------------------------------------------------------------ commands
+    @command_handler
+    async def sign_in(self, command: SignInCommand):
+        if is_invalidating():
+            await self._invalidate_session(command.session)
+            await self.get_user_sessions(command.user.id)
+            return
+        now = time.time()
+        self._users[command.user.id] = command.user
+        self._sessions[command.session.id] = SessionInfo(
+            session_id=command.session.id,
+            user_id=command.user.id,
+            created_at=now,
+            last_seen_at=now,
+        )
+
+    @command_handler
+    async def sign_out(self, command: SignOutCommand):
+        if is_invalidating():
+            await self._invalidate_session(command.session)
+            return
+        info = self._sessions.pop(command.session.id, None)
+        if command.force:
+            if not hasattr(self, "_forced_out"):
+                self._forced_out = set()
+            self._forced_out.add(command.session.id)
+        _ = info
+
+    @command_handler
+    async def edit_user(self, command: EditUserCommand):
+        if is_invalidating():
+            await self._invalidate_session(command.session)
+            return
+        info = self._sessions.get(command.session.id)
+        if info is None or not info.user_id:
+            raise PermissionError("not signed in")
+        user = self._users[info.user_id]
+        self._users[info.user_id] = dataclasses.replace(user, name=command.name)
+
+    async def _invalidate_session(self, session: Session) -> None:
+        await self.get_session_info(session)
+        await self.get_user(session)
+        await self.is_sign_out_forced(session)

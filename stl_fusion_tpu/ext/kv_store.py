@@ -1,0 +1,94 @@
+"""KeyValueStore — invalidation-aware KV storage.
+
+Re-expression of src/Stl.Fusion.Ext.Services/Extensions/ — IKeyValueStore /
+DbKeyValueStore / SandboxedKeyValueStore: reads are compute methods, writes
+are commands whose completion invalidates exactly the touched keys (+ the
+affected prefix listings), with optional expiration handled by a trimmer.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..commands.handlers import command_handler
+from ..core.context import is_invalidating
+from ..core.hub import FusionHub
+from ..core.service import ComputeService, compute_method
+from ..utils.serialization import wire_type
+
+__all__ = ["KeyValueStore", "SetCommand", "RemoveCommand"]
+
+
+@wire_type("KvSet")
+@dataclasses.dataclass(frozen=True)
+class SetCommand:
+    key: str
+    value: str
+    expires_at: Optional[float] = None
+
+
+@wire_type("KvRemove")
+@dataclasses.dataclass(frozen=True)
+class RemoveCommand:
+    key: str
+
+
+class KeyValueStore(ComputeService):
+    def __init__(self, hub: Optional[FusionHub] = None):
+        super().__init__(hub)
+        self._data: Dict[str, Tuple[str, Optional[float]]] = {}
+
+    # ------------------------------------------------------------------ reads
+    @compute_method
+    async def get(self, key: str) -> Optional[str]:
+        entry = self._data.get(key)
+        if entry is None:
+            return None
+        value, expires_at = entry
+        if expires_at is not None and expires_at <= time.time():
+            return None
+        return value
+
+    @compute_method
+    async def count_by_prefix(self, prefix: str) -> int:
+        return sum(1 for k in self._data if k.startswith(prefix))
+
+    @compute_method
+    async def list_key_suffixes(self, prefix: str) -> tuple:
+        return tuple(sorted(k[len(prefix):] for k in self._data if k.startswith(prefix)))
+
+    # ------------------------------------------------------------------ writes
+    @command_handler
+    async def set(self, command: SetCommand):
+        if is_invalidating():
+            await self._invalidate_key(command.key)
+            return
+        self._data[command.key] = (command.value, command.expires_at)
+
+    @command_handler
+    async def remove(self, command: RemoveCommand):
+        if is_invalidating():
+            await self._invalidate_key(command.key)
+            return
+        self._data.pop(command.key, None)
+
+    async def _invalidate_key(self, key: str) -> None:
+        await self.get(key)
+        # prefix listings that could include this key
+        for i in range(len(key) + 1):
+            await self.count_by_prefix(key[:i])
+            await self.list_key_suffixes(key[:i])
+
+    # ------------------------------------------------------------------ trimmer
+    async def trim_expired(self) -> int:
+        """Expiration sweep (≈ DbKeyValueStore's trimmer worker)."""
+        now = time.time()
+        expired = [k for k, (_v, exp) in self._data.items() if exp is not None and exp <= now]
+        from ..core.context import invalidating
+
+        for k in expired:
+            del self._data[k]
+            with invalidating():
+                await self._invalidate_key(k)
+        return len(expired)

@@ -1,0 +1,47 @@
+"""RpcPeerStateMonitor — connection state as a reactive state.
+
+Re-expression of src/Stl.Fusion/Extensions/RpcPeerStateMonitor.cs:6-70:
+exposes a peer's connection state (+ reconnects-at) as a MutableState so
+UIs can render "reconnecting in 3s…" banners that live-update.
+"""
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Optional
+
+from ..core.hub import FusionHub
+from ..rpc.peer import RpcClientPeer
+from ..state.mutable import MutableState
+from ..utils.async_chain import WorkerBase
+
+__all__ = ["RpcPeerState", "RpcPeerStateMonitor"]
+
+
+@dataclass(frozen=True)
+class RpcPeerState:
+    is_connected: bool
+    error: Optional[str] = None
+    reconnects_at: Optional[float] = None
+
+
+class RpcPeerStateMonitor(WorkerBase):
+    def __init__(self, peer: RpcClientPeer, hub: Optional[FusionHub] = None):
+        super().__init__(f"peer-monitor:{peer.ref}")
+        self.peer = peer
+        self.state: MutableState = MutableState(
+            RpcPeerState(is_connected=False), hub, name=f"peer-state:{peer.ref}"
+        )
+
+    async def on_run(self) -> None:
+        ev = self.peer.connection_state
+        while True:
+            s = ev.value
+            self.state.set(
+                RpcPeerState(
+                    is_connected=s.is_connected,
+                    error=str(s.error) if s.error else None,
+                    reconnects_at=getattr(self.peer, "reconnects_at", None),
+                )
+            )
+            ev = await ev.when_next()

@@ -1,0 +1,5 @@
+"""UI layer (SURVEY.md §2.7): live components + action tracking."""
+from .action_tracker import UIActionTracker, UICommander
+from .live_component import LiveComponent, MixedStateComponent
+
+__all__ = ["UIActionTracker", "UICommander", "LiveComponent", "MixedStateComponent"]

@@ -1,0 +1,67 @@
+"""UIActionTracker + UICommander — instant-update windows after user actions.
+
+Re-expression of src/Stl.Fusion/UI/ — UIActionTracker.cs:3-60 and
+UICommander.cs: when the user triggers a command, states watching through an
+UpdateDelayer skip their debounce (the "instant updates right after my own
+action" UX rule). The tracker counts running actions and exposes awaitable
+action/result events.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Optional
+
+from ..utils.async_utils import AsyncEvent
+
+__all__ = ["UIActionTracker", "UICommander"]
+
+
+class UIActionTracker:
+    def __init__(self, instant_update_period: float = 0.3):
+        self.instant_update_period = instant_update_period
+        self.running_action_count = 0
+        self._action_event: AsyncEvent = AsyncEvent(None)
+        self._result_event: AsyncEvent = AsyncEvent(None)
+        self._last_action_at: float = 0.0
+
+    @property
+    def are_instant_updates_enabled(self) -> bool:
+        if self.running_action_count > 0:
+            return True
+        return (time.monotonic() - self._last_action_at) < self.instant_update_period
+
+    def action_started(self, command: Any) -> None:
+        self.running_action_count += 1
+        self._last_action_at = time.monotonic()
+        self._action_event = self._action_event.latest().create_next(command)
+
+    def action_completed(self, command: Any, error: Optional[BaseException]) -> None:
+        self.running_action_count = max(0, self.running_action_count - 1)
+        self._last_action_at = time.monotonic()
+        self._result_event = self._result_event.latest().create_next((command, error))
+
+    async def when_action(self) -> Any:
+        return (await self._action_event.latest().when_next()).value
+
+    async def when_result(self) -> Any:
+        return (await self._result_event.latest().when_next()).value
+
+
+class UICommander:
+    """Commander facade that reports into the action tracker."""
+
+    def __init__(self, commander, tracker: Optional[UIActionTracker] = None):
+        self.commander = commander
+        self.tracker = tracker or UIActionTracker()
+
+    async def call(self, command: Any) -> Any:
+        self.tracker.action_started(command)
+        error: Optional[BaseException] = None
+        try:
+            return await self.commander.call(command)
+        except BaseException as e:
+            error = e
+            raise
+        finally:
+            self.tracker.action_completed(command, error)

@@ -1,0 +1,197 @@
+"""Extension services + UI layer tests (FusionTime, KeyValueStore, Auth,
+Session, LiveComponent, UIActionTracker, FusionMonitor)."""
+import asyncio
+
+import pytest
+
+from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method, set_default_hub
+from stl_fusion_tpu.diagnostics import FusionMonitor
+from stl_fusion_tpu.ext import (
+    FusionTime,
+    InMemoryAuthService,
+    KeyValueStore,
+    RemoveCommand,
+    Session,
+    SessionResolver,
+    SetCommand,
+    SignInCommand,
+    SignOutCommand,
+    User,
+)
+from stl_fusion_tpu.state import MutableState
+from stl_fusion_tpu.ui import LiveComponent, UIActionTracker, UICommander
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub():
+    hub = FusionHub()
+    hub.commander.attach_operations_pipeline()
+    old = set_default_hub(hub)
+    yield hub
+    set_default_hub(old)
+
+
+# ------------------------------------------------------------------ FusionTime
+
+async def test_fusion_time_auto_invalidates(fresh_hub):
+    ft = FusionTime(fresh_hub)
+    node = await capture(lambda: ft.get_utc_now())
+    assert node.is_consistent
+    # auto_invalidation_delay=1.0 — the timer wheel invalidates it
+    await asyncio.wait_for(node.when_invalidated(), 5.0)
+    assert (await ft.get_utc_now()) >= node.output.value
+
+
+async def test_moments_ago_formatting(fresh_hub):
+    import time
+
+    ft = FusionTime(fresh_hub)
+    assert "second" in await ft.get_moments_ago(time.time())
+    assert "minute" in await ft.get_moments_ago(time.time() - 120)
+    assert "2 hours ago" == await ft.get_moments_ago(time.time() - 7201)
+
+
+# ------------------------------------------------------------------ KV store
+
+async def test_kv_store_invalidates_reads_and_listings(fresh_hub):
+    kv = KeyValueStore(fresh_hub)
+    fresh_hub.commander.add_service(kv)
+    assert await kv.get("user/alice") is None
+    listing = await capture(lambda: kv.count_by_prefix("user/"))
+    await fresh_hub.commander.call(SetCommand("user/alice", "1"))
+    assert await kv.get("user/alice") == "1"
+    assert listing.is_invalidated
+    assert await kv.count_by_prefix("user/") == 1
+    assert await kv.list_key_suffixes("user/") == ("alice",)
+    await fresh_hub.commander.call(RemoveCommand("user/alice"))
+    assert await kv.get("user/alice") is None
+    assert await kv.count_by_prefix("user/") == 0
+
+
+async def test_kv_store_expiration(fresh_hub):
+    import time
+
+    kv = KeyValueStore(fresh_hub)
+    fresh_hub.commander.add_service(kv)
+    await fresh_hub.commander.call(SetCommand("tmp", "v", expires_at=time.time() + 0.05))
+    assert await kv.get("tmp") == "v"
+    await asyncio.sleep(0.1)
+    assert await kv.trim_expired() == 1
+    assert await kv.get("tmp") is None
+
+
+# ------------------------------------------------------------------ auth + session
+
+def test_session_semantics():
+    s = Session.new("acme")
+    assert not s.is_default and s.tenant_id == "acme"
+    assert Session.default().is_default
+    with pytest.raises(ValueError):
+        Session("short")
+    resolver = SessionResolver()
+    real = resolver.resolve(Session.default())
+    assert not real.is_default
+    explicit = Session.new()
+    assert resolver.resolve(explicit) is explicit
+
+
+async def test_auth_live_sign_in_out(fresh_hub):
+    auth = InMemoryAuthService(fresh_hub)
+    fresh_hub.commander.add_service(auth)
+    session = Session.new()
+    assert await auth.get_user(session) is None
+    user_node = await capture(lambda: auth.get_user(session))
+
+    await fresh_hub.commander.call(SignInCommand(session, User("u1", "Alice")))
+    assert user_node.is_invalidated  # live auth state
+    user = await auth.get_user(session)
+    assert user is not None and user.name == "Alice"
+    assert await auth.get_user_sessions("u1") == (session.id,)
+
+    await fresh_hub.commander.call(SignOutCommand(session))
+    assert await auth.get_user(session) is None
+
+
+# ------------------------------------------------------------------ UI
+
+async def test_live_component_rerenders_on_invalidation(fresh_hub):
+    source = MutableState(1, fresh_hub)
+    renders = []
+
+    class Counter(LiveComponent):
+        async def compute_state(self):
+            return await source.use() * 10
+
+        def render(self, value):
+            renders.append(value)
+
+    comp = Counter(hub=fresh_hub).mount()
+    try:
+        await comp.when_rendered(1)
+        source.set(2)
+        await comp.when_rendered(2)
+        assert renders[:2] == [10, 20]
+    finally:
+        await comp.unmount()
+
+
+async def test_live_component_parameter_comparer(fresh_hub):
+    computes = []
+
+    class Param(LiveComponent):
+        async def compute_state(self):
+            computes.append(1)
+            return self.parameters.get("x", 0)
+
+        def render(self, value):
+            pass
+
+    comp = Param(hub=fresh_hub).mount()
+    try:
+        await comp.when_rendered(1)
+        n0 = len(computes)
+        await comp.set_parameters(x=5)  # changed → recompute
+        await comp.when_rendered(2)
+        await comp.set_parameters(x=5)  # unchanged → NO recompute
+        await asyncio.sleep(0.05)
+        assert len(computes) == n0 + 1
+    finally:
+        await comp.unmount()
+
+
+async def test_ui_action_tracker_instant_updates(fresh_hub):
+    class Svc:
+        from stl_fusion_tpu.commands import command_handler
+
+        @command_handler
+        async def do(self, command: str) -> str:
+            return command
+
+    fresh_hub.commander.add_service(Svc())
+    tracker = UIActionTracker(instant_update_period=0.2)
+    ui = UICommander(fresh_hub.commander, tracker)
+    assert not tracker.are_instant_updates_enabled
+    assert await ui.call("go") == "go"
+    assert tracker.are_instant_updates_enabled  # window after the action
+    await asyncio.sleep(0.25)
+    assert not tracker.are_instant_updates_enabled
+
+
+# ------------------------------------------------------------------ diagnostics
+
+async def test_fusion_monitor_hit_ratio(fresh_hub):
+    monitor = FusionMonitor(fresh_hub)
+
+    class S(ComputeService):
+        @compute_method
+        async def get(self, k: str) -> str:
+            return k
+
+    svc = S(fresh_hub)
+    await svc.get("a")
+    for _ in range(9):
+        await svc.get("a")
+    report = monitor.report()
+    assert report["computes"] >= 1
+    assert report["accesses"] >= 10
+    assert report["hit_ratio"] > 0.5
