@@ -1,0 +1,118 @@
+"""WebSocket transport — the real-network counterpart of the test channels.
+
+Re-expression of src/Stl.Rpc/WebSockets/WebSocketChannel.cs:11-120 +
+Rpc.Server/RpcWebSocketServer.cs:32-64 + Clients/RpcWebSocketClient.cs:
+messages ride binary frames (wire-serialized RpcMessage); the client sends a
+stable ``clientId`` query parameter so a re-dialed connection lands on the
+SAME server peer — which is what makes reconnect dedup/re-send work across
+physical connections (SessionBoundRpcConnection analogue).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import secrets
+import urllib.parse
+from typing import Optional
+
+from ..utils.serialization import dumps, loads
+from .hub import RpcHub
+from .message import RpcMessage
+from .peer import RpcClientPeer
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["RpcWebSocketServer", "websocket_client_connector"]
+
+RPC_PATH = "/rpc/ws"
+
+
+class _WsAdapter:
+    """Adapts a websockets connection to the peer's reader/writer protocol."""
+
+    class _Reader:
+        def __init__(self, ws):
+            self._ws = ws
+
+        async def receive(self) -> RpcMessage:
+            try:
+                frame = await self._ws.recv()
+            except Exception as e:  # noqa: BLE001 — closed/aborted
+                raise ConnectionError(str(e)) from e
+            return loads(frame if isinstance(frame, bytes) else frame.encode())
+
+    class _Writer:
+        def __init__(self, ws):
+            self._ws = ws
+
+        async def send(self, message: RpcMessage) -> None:
+            try:
+                await self._ws.send(dumps(message))
+            except Exception as e:  # noqa: BLE001
+                raise ConnectionError(str(e)) from e
+
+    def __init__(self, ws):
+        self._ws = ws
+        self.reader = _WsAdapter._Reader(ws)
+        self.writer = _WsAdapter._Writer(ws)
+
+    def close(self, error: Optional[BaseException] = None) -> None:
+        asyncio.ensure_future(self._ws.close())
+
+
+class RpcWebSocketServer:
+    """Hosts an RpcHub over websockets (≈ RpcWebSocketServer + route map)."""
+
+    def __init__(self, hub: RpcHub, host: str = "127.0.0.1", port: int = 0):
+        self.hub = hub
+        self.host = host
+        self.port = port
+        self._server = None
+
+    async def start(self) -> "RpcWebSocketServer":
+        from websockets.asyncio.server import serve
+
+        self._server = await serve(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.debug("rpc websocket server on %s:%d", self.host, self.port)
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"ws://{self.host}:{self.port}{RPC_PATH}"
+
+    async def _handle(self, ws) -> None:
+        path = ws.request.path if ws.request is not None else RPC_PATH
+        query = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+        client_id = (query.get("clientId") or [f"anon-{secrets.token_hex(4)}"])[0]
+        peer = self.hub.server_peer(f"ws:{client_id}")
+        peer.connect(_WsAdapter(ws))
+        # hold the handler open until the socket dies (websockets closes on return)
+        try:
+            await ws.wait_closed()
+        except Exception:  # noqa: BLE001
+            pass
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+
+def websocket_client_connector(url: str, client_id: Optional[str] = None):
+    """Client connector factory: ``hub.client_connector = websocket_client_connector(url)``.
+
+    The generated clientId is stable per connector, so reconnects resume the
+    same server peer (reconnect dedup).
+    """
+    cid = client_id or f"c-{secrets.token_hex(8)}"
+
+    async def connect(peer: RpcClientPeer):
+        from websockets.asyncio.client import connect as ws_connect
+
+        sep = "&" if "?" in url else "?"
+        ws = await ws_connect(f"{url}{sep}clientId={cid}:{peer.ref}", max_size=64 * 1024 * 1024)
+        return _WsAdapter(ws)
+
+    return connect

@@ -1,0 +1,89 @@
+"""Real-network transport tests: RPC + fusion compute calls over actual
+websockets in-process (the reference's RpcWebHost pattern — real Kestrel +
+real sockets, tests/Stl.Tests/RpcWebHost.cs)."""
+import asyncio
+
+import pytest
+
+from stl_fusion_tpu.client import compute_client, install_compute_call_type
+from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method, invalidating
+from stl_fusion_tpu.rpc import RpcHub
+from stl_fusion_tpu.rpc.websocket import RpcWebSocketServer, websocket_client_connector
+
+
+class Echo:
+    async def echo(self, text: str) -> str:
+        return f"ws:{text}"
+
+
+async def test_rpc_over_real_websocket():
+    server_hub = RpcHub("ws-server")
+    server_hub.add_service("echo", Echo())
+    server = await RpcWebSocketServer(server_hub).start()
+    client_hub = RpcHub("ws-client")
+    client_hub.client_connector = websocket_client_connector(server.url)
+    try:
+        proxy = client_hub.client("echo", "default")
+        assert await proxy.echo("hello") == "ws:hello"
+        results = await asyncio.gather(*(proxy.echo(str(i)) for i in range(20)))
+        assert results == [f"ws:{i}" for i in range(20)]
+    finally:
+        await client_hub.stop()
+        await server.stop()
+
+
+class Counters(ComputeService):
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self.data = {}
+
+    @compute_method
+    async def get(self, key: str) -> int:
+        return self.data.get(key, 0)
+
+    async def increment(self, key: str):
+        self.data[key] = self.data.get(key, 0) + 1
+        with invalidating():
+            await self.get(key)
+
+
+async def test_fusion_invalidation_over_real_websocket():
+    server_fusion = FusionHub()
+    server_rpc = RpcHub("ws-server")
+    install_compute_call_type(server_rpc)
+    svc = Counters(server_fusion)
+    server_rpc.add_service("counters", svc)
+    server = await RpcWebSocketServer(server_rpc).start()
+
+    client_rpc = RpcHub("ws-client")
+    install_compute_call_type(client_rpc)
+    client_rpc.client_connector = websocket_client_connector(server.url)
+    client_fusion = FusionHub()
+    client = compute_client("counters", client_rpc, client_fusion)
+    try:
+        assert await client.get("a") == 0
+        node = await capture(lambda: client.get("a"))
+        await svc.increment("a")
+        await asyncio.wait_for(node.when_invalidated(), 5.0)  # $sys-c over the wire
+        assert await client.get("a") == 1
+    finally:
+        await client_rpc.stop()
+        await server.stop()
+
+
+async def test_websocket_reconnect_resumes_same_server_peer():
+    server_hub = RpcHub("ws-server")
+    server_hub.add_service("echo", Echo())
+    server = await RpcWebSocketServer(server_hub).start()
+    client_hub = RpcHub("ws-client")
+    client_hub.client_connector = websocket_client_connector(server.url)
+    try:
+        proxy = client_hub.client("echo", "default")
+        assert await proxy.echo("one") == "ws:one"
+        n_peers = len(server_hub.peers)
+        await client_hub.peers["default"].disconnect()
+        assert await asyncio.wait_for(proxy.echo("two"), 5.0) == "ws:two"
+        assert len(server_hub.peers) == n_peers  # same peer resumed, no new one
+    finally:
+        await client_hub.stop()
+        await server.stop()
