@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""TodoApp multi-host — port of the reference's multi-host sample
+(samples/TodoApp + Run-TodoApp-MultiHost.cmd): two "hosts" share a sqlite
+operation log; a client watches host B over a REAL websocket while todos are
+edited on host A. The edit propagates A → (op log) → B → ($sys-c push) →
+client, with zero polling anywhere.
+
+Run: python examples/todo_multihost.py
+"""
+import asyncio
+import dataclasses
+import os
+import sys
+import tempfile
+from typing import Dict, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stl_fusion_tpu.client import compute_client, install_compute_call_type
+from stl_fusion_tpu.commands import command_handler
+from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method, is_invalidating
+from stl_fusion_tpu.ext import Session
+from stl_fusion_tpu.oplog import LocalChangeNotifier, SqliteOperationLog, attach_operation_log
+from stl_fusion_tpu.rpc import RpcHub
+from stl_fusion_tpu.rpc.websocket import RpcWebSocketServer, websocket_client_connector
+from stl_fusion_tpu.utils.serialization import wire_type
+
+
+# shared "database" both hosts read (the reference shares a DB between hosts)
+TODOS: Dict[str, dict] = {}
+
+
+@wire_type
+@dataclasses.dataclass(frozen=True)
+class AddOrUpdateTodo:
+    session: Session
+    id: str
+    title: str
+    done: bool = False
+
+
+class TodoService(ComputeService):
+    @compute_method
+    async def get(self, todo_id: str) -> Optional[dict]:
+        return TODOS.get(todo_id)
+
+    @compute_method
+    async def list_ids(self) -> tuple:
+        return tuple(sorted(TODOS))
+
+    @compute_method
+    async def summary(self) -> str:
+        ids = await self.list_ids()
+        done = 0
+        for tid in ids:
+            todo = await self.get(tid)
+            if todo and todo["done"]:
+                done += 1
+        return f"{done}/{len(ids)} done"
+
+    @command_handler
+    async def add_or_update(self, command: AddOrUpdateTodo):
+        if is_invalidating():
+            await self.get(command.id)
+            await self.list_ids()
+            return
+        TODOS[command.id] = {"id": command.id, "title": command.title, "done": command.done}
+
+
+def make_host(name: str, log_store, notifier):
+    fusion = FusionHub()
+    svc = TodoService(fusion)
+    fusion.commander.add_service(svc)
+    reader = attach_operation_log(fusion.commander, log_store, notifier)
+    rpc = RpcHub(name)
+    install_compute_call_type(rpc)
+    rpc.add_service("todos", svc)
+    return fusion, svc, reader, rpc
+
+
+async def main():
+    path = os.path.join(tempfile.mkdtemp(), "todo-ops.sqlite")
+    log_store = SqliteOperationLog(path)
+    notifier = LocalChangeNotifier()
+
+    fusion_a, svc_a, reader_a, rpc_a = make_host("host-a", log_store, notifier)
+    fusion_b, svc_b, reader_b, rpc_b = make_host("host-b", log_store, notifier)
+    server_b = await RpcWebSocketServer(rpc_b).start()
+
+    # a client connected to host B over a real websocket
+    client_rpc = RpcHub("client")
+    install_compute_call_type(client_rpc)
+    client_rpc.client_connector = websocket_client_connector(server_b.url)
+    client_fusion = FusionHub()
+    todos = compute_client("todos", client_rpc, client_fusion)
+
+    session = Session.new()
+    print("summary (via host B):", await todos.summary())
+    summary_node = await capture(lambda: todos.summary())
+
+    # edits land on HOST A; the client watches HOST B
+    await fusion_a.commander.call(AddOrUpdateTodo(session, "t1", "port HelloCart"))
+    await asyncio.wait_for(summary_node.when_invalidated(), 5.0)
+    print("after add on host A:", await todos.summary())
+
+    summary_node = await capture(lambda: todos.summary())
+    await fusion_a.commander.call(AddOrUpdateTodo(session, "t1", "port HelloCart", done=True))
+    await asyncio.wait_for(summary_node.when_invalidated(), 5.0)
+    print("after done on host A:", await todos.summary())
+
+    print("cross-host chain A → oplog → B → websocket push → client: OK")
+    await client_rpc.stop()
+    await server_b.stop()
+    await reader_a.stop()
+    await reader_b.stop()
+    log_store.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

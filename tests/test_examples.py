@@ -1,0 +1,32 @@
+"""The reference's sample ports run green as smoke tests (BASELINE configs:
+HelloCart, TodoApp multi-host)."""
+import asyncio
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script: str) -> str:
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    return out.stdout
+
+
+def test_hello_cart_sample():
+    stdout = _run("hello_cart.py")
+    assert "watcher sees total = 6.5" in stdout
+    assert "done: every edit cascaded" in stdout
+
+
+def test_todo_multihost_sample():
+    stdout = _run("todo_multihost.py")
+    assert "after add on host A: 0/1 done" in stdout
+    assert "after done on host A: 1/1 done" in stdout
