@@ -2,8 +2,11 @@
 from .commander import Commander, LocalCommand
 from .context import CommandContext, current_command_context
 from .handlers import CommandHandler, HandlerRegistry, command_filter, command_handler
+from .tracer import CommandTracer, attach_command_tracer
 
 __all__ = [
+    "CommandTracer",
+    "attach_command_tracer",
     "Commander",
     "LocalCommand",
     "CommandContext",

@@ -1,4 +1,23 @@
-"""Diagnostics (SURVEY.md §5.1): registry monitoring + hit-ratio reports."""
+"""Diagnostics (SURVEY.md §5.1): registry monitoring + hit-ratio reports,
+activity-style tracing spans."""
 from .monitor import FusionMonitor
+from .tracing import (
+    ActivitySource,
+    Span,
+    add_listener,
+    current_span,
+    get_activity_source,
+    recent_spans,
+    remove_listener,
+)
 
-__all__ = ["FusionMonitor"]
+__all__ = [
+    "FusionMonitor",
+    "ActivitySource",
+    "Span",
+    "add_listener",
+    "current_span",
+    "get_activity_source",
+    "recent_spans",
+    "remove_listener",
+]

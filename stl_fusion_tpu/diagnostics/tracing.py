@@ -1,0 +1,114 @@
+"""Activity-style tracing (SURVEY §5.1; src/Stl/Diagnostics/).
+
+The reference hangs a ``System.Diagnostics.ActivitySource`` off every
+component (registry prune spans, op-log reader reads, invalidation replays,
+RPC inbound calls). Here a module-level ``ActivitySource`` registry produces
+``Span`` context managers that record (name, tags, duration, error) into a
+bounded in-process buffer and notify listeners; exporters (logging, test
+assertions) subscribe via ``add_listener``.
+
+Spans nest via a contextvar, so a trace tree can be reconstructed from
+``parent_id`` — the analogue of Activity.Current parenting.
+"""
+from __future__ import annotations
+
+import contextvars
+import itertools
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+log = logging.getLogger("stl_fusion_tpu.tracing")
+
+__all__ = ["Span", "ActivitySource", "get_activity_source", "add_listener", "remove_listener", "recent_spans"]
+
+_span_ids = itertools.count(1)
+_current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "fusion_current_span", default=None
+)
+_listeners: List[Callable[["Span"], None]] = []
+_recent: Deque["Span"] = deque(maxlen=2048)
+_sources: Dict[str, "ActivitySource"] = {}
+
+
+@dataclass
+class Span:
+    source: str
+    name: str
+    tags: Dict[str, Any] = field(default_factory=dict)
+    span_id: int = 0
+    parent_id: Optional[int] = None
+    started_at: float = 0.0
+    duration: Optional[float] = None
+    # error is recorded as (type name, message) — keeping the live exception
+    # here would pin its traceback frames in the span buffer
+    error_type: Optional[str] = None
+    error_message: Optional[str] = None
+    _token: Any = None
+
+    @property
+    def failed(self) -> bool:
+        return self.error_type is not None
+
+    def set_tag(self, key: str, value: Any) -> "Span":
+        self.tags[key] = value
+        return self
+
+    def __enter__(self) -> "Span":
+        self.span_id = next(_span_ids)
+        parent = _current_span.get()
+        self.parent_id = parent.span_id if parent is not None else None
+        self.started_at = time.perf_counter()
+        self._token = _current_span.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.duration = time.perf_counter() - self.started_at
+        if exc is not None:
+            self.error_type = type(exc).__name__
+            self.error_message = str(exc)
+        _current_span.reset(self._token)
+        _recent.append(self)
+        for listener in list(_listeners):
+            try:
+                listener(self)
+            except Exception:  # noqa: BLE001 — listeners never break traced code
+                log.exception("trace listener failed")
+
+
+class ActivitySource:
+    def __init__(self, name: str):
+        self.name = name
+
+    def span(self, name: str, **tags: Any) -> Span:
+        return Span(self.name, name, tags)
+
+
+def get_activity_source(name: str) -> ActivitySource:
+    source = _sources.get(name)
+    if source is None:
+        source = _sources[name] = ActivitySource(name)
+    return source
+
+
+def current_span() -> Optional[Span]:
+    return _current_span.get()
+
+
+def add_listener(listener: Callable[[Span], None]) -> None:
+    _listeners.append(listener)
+
+
+def remove_listener(listener: Callable[[Span], None]) -> None:
+    if listener in _listeners:
+        _listeners.remove(listener)
+
+
+def recent_spans(source: Optional[str] = None, name: Optional[str] = None) -> List[Span]:
+    return [
+        s
+        for s in _recent
+        if (source is None or s.source == source) and (name is None or s.name == name)
+    ]

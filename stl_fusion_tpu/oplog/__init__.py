@@ -1,5 +1,7 @@
 """Durable operation log + multi-host invalidation (SURVEY.md §2.6)."""
+from .entity_resolver import EntityResolver
 from .log import InMemoryOperationLog, OperationLog, OperationRecord, SqliteOperationLog
+from .trimmer import OperationLogTrimmer
 from .reader import (
     FileChangeNotifier,
     LocalChangeNotifier,
@@ -8,6 +10,7 @@ from .reader import (
 )
 
 __all__ = [
+    "EntityResolver",
     "InMemoryOperationLog",
     "OperationLog",
     "OperationRecord",
@@ -15,5 +18,6 @@ __all__ = [
     "FileChangeNotifier",
     "LocalChangeNotifier",
     "OperationLogReader",
+    "OperationLogTrimmer",
     "attach_operation_log",
 ]

@@ -1,0 +1,57 @@
+"""OperationLogTrimmer — background op-log GC.
+
+Re-expression of src/Stl.Fusion.EntityFramework/Operations/
+DbOperationLogTrimmer.cs: a periodic worker that drops operation records
+older than ``max_age`` so the durable log stays bounded. Readers keep
+commit-time watermarks (reader.py), so trimming behind every host's
+watermark is safe; ``max_age`` should exceed the reader's max commit age.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from ..utils.async_chain import WorkerBase
+from ..utils.moment import MomentClock
+from .log import OperationLog
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["OperationLogTrimmer"]
+
+
+class OperationLogTrimmer(WorkerBase):
+    def __init__(
+        self,
+        log_store: OperationLog,
+        max_age: float = 600.0,
+        check_period: float = 60.0,
+        clock: Optional[MomentClock] = None,
+    ):
+        super().__init__(name="oplog-trimmer")
+        self.log_store = log_store
+        self.max_age = max_age
+        self.check_period = check_period
+        self.clock = clock
+        self.trimmed_total = 0
+
+    def _now(self) -> float:
+        return self.clock.now() if self.clock is not None else time.time()
+
+    def trim_once(self) -> int:
+        removed = self.log_store.trim_before(self._now() - self.max_age)
+        self.trimmed_total += removed
+        if removed:
+            log.debug("oplog trimmer removed %d records", removed)
+        return removed
+
+    async def on_run(self) -> None:
+        import asyncio
+
+        while True:
+            self.trim_once()
+            if self.clock is not None:
+                await self.clock.delay(self.check_period)  # TestClock-drivable
+            else:
+                await asyncio.sleep(self.check_period)

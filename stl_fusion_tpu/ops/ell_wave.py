@@ -120,6 +120,16 @@ class EllWaveState(NamedTuple):
     invalid: "object"  # bool[n_tot+1]
 
 
+class EllGraphArrays(NamedTuple):
+    """Device-resident ELL adjacency, passed to the kernel as runtime args
+    (never jit-closure captures — a 10M-node table embedded as an HLO
+    constant makes the compile payload hundreds of MB; see pull_wave.py)."""
+
+    ell_dst: "object"  # int32[n_tot+1, k]
+    ell_epoch: "object"  # int32[n_tot+1, k]
+    is_real: "object"  # bool[n_tot+1]
+
+
 def build_ell_wave(
     graph: EllGraph,
     f_max: Optional[int] = None,
@@ -130,7 +140,9 @@ def build_ell_wave(
     Returns (initial_state, wave_fn) where
     ``wave_fn(seed_ids_padded, state) -> (state, real_invalidated_count)``;
     ``seed_ids_padded`` is int32[seed_cap] padded with -1. The whole wave —
-    all levels, bucket switching, dedup — runs in one XLA program.
+    all levels, bucket switching, dedup — runs in one XLA program. The
+    device adjacency is exposed as ``wave_fn.garrays`` / raw jitted kernel
+    as ``wave_fn.step`` for callers composing a larger jitted program.
     """
     import jax
     import jax.numpy as jnp
@@ -149,16 +161,18 @@ def build_ell_wave(
         buckets.append(f_max)
     buckets = [min(b, f_max) for b in buckets]
 
-    ell_dst = jnp.asarray(graph.ell_dst)
-    ell_epoch = jnp.asarray(graph.ell_epoch)
-    is_real = jnp.asarray(graph.is_real)
+    garrays = EllGraphArrays(
+        ell_dst=jnp.asarray(graph.ell_dst),
+        ell_epoch=jnp.asarray(graph.ell_epoch),
+        is_real=jnp.asarray(graph.is_real),
+    )
 
     def init_state() -> EllWaveState:
         node_epoch = jnp.zeros(n_tot + 1, dtype=jnp.int32).at[n_tot].set(-2)
         invalid = jnp.zeros(n_tot + 1, dtype=jnp.bool_)
         return EllWaveState(node_epoch, invalid)
 
-    def _level(bsize: int, F, invalid, node_epoch):
+    def _level(bsize: int, F, invalid, node_epoch, ell_dst, ell_epoch, is_real):
         """Expand F[:bsize] one level; returns (F_next, nF_next, invalid, newly_real)."""
         Fb = lax.slice(F, (0,), (bsize,))
         rows = ell_dst[Fb]  # (bsize, k) row gather; pad rows → n_tot
@@ -190,14 +204,15 @@ def build_ell_wave(
         functools.partial(_level, b) for b in buckets
     ]
 
-    def level_switch(F, nF, invalid, node_epoch):
+    def level_switch(F, nF, invalid, node_epoch, ell_dst, ell_epoch, is_real):
         # smallest bucket that fits nF
         bidx = jnp.searchsorted(jnp.asarray(buckets, dtype=jnp.int32), nF, side="left")
         bidx = jnp.minimum(bidx, len(buckets) - 1)
-        return lax.switch(bidx, branches, F, invalid, node_epoch)
+        return lax.switch(bidx, branches, F, invalid, node_epoch, ell_dst, ell_epoch, is_real)
 
     @jax.jit
-    def wave(seed_ids: "jax.Array", state: EllWaveState):
+    def step(g: EllGraphArrays, seed_ids: "jax.Array", state: EllWaveState):
+        ell_dst, ell_epoch, is_real = g
         node_epoch, invalid = state.node_epoch, state.invalid
         # seed frontier: pad -1 → n_tot slot; only fresh (not-invalid) seeds,
         # deduped by the same claim trick (first occurrence wins)
@@ -226,10 +241,17 @@ def build_ell_wave(
 
         def body(carry):
             F, nF, invalid, cnt = carry
-            F2, nF2, invalid, newly = level_switch(F, nF, invalid, node_epoch)
+            F2, nF2, invalid, newly = level_switch(
+                F, nF, invalid, node_epoch, ell_dst, ell_epoch, is_real
+            )
             return F2, nF2, invalid, cnt + newly
 
         _F, _nF, invalid, count = lax.while_loop(cond, body, (F0, nF0, invalid, count0))
         return EllWaveState(node_epoch, invalid), count
 
+    def wave(seed_ids, state):
+        return step(garrays, seed_ids, state)
+
+    wave.garrays = garrays
+    wave.step = step
     return init_state(), wave

@@ -20,16 +20,33 @@ work-efficient single-wave path). Two ideas compose:
 
 Wave depth becomes max over the batch, and all 32 waves share one epoch
 snapshot (graph consistent at batch start) — the batching contract.
+
+The graph arrays travel as RUNTIME ARGUMENTS (``PullGraphArrays``), never
+as jit closure captures: at 10M nodes the in-edge table is ~320MB, and a
+closure capture would embed it as an HLO constant — blowing up the compile
+payload (and this environment's remote-compile relay rejects it outright).
+Passing them as device-resident args keeps the compiled program
+shape-parameterized and the upload a one-time ``device_put``.
 """
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+import functools
+from typing import NamedTuple
 
 import numpy as np
 
 from .ell_wave import EllGraph, build_ell
 
-__all__ = ["build_pull_graph", "build_pull_wave32", "seeds_to_bits"]
+__all__ = [
+    "PullGraphArrays",
+    "PullState",
+    "build_pull_graph",
+    "build_pull_wave32",
+    "pull_wave32_step",
+    "pull_graph_arrays",
+    "pull_init_state",
+    "seeds_to_bits",
+]
 
 
 def build_pull_graph(src: np.ndarray, dst: np.ndarray, n_nodes: int, k: int = 8) -> EllGraph:
@@ -47,59 +64,102 @@ def seeds_to_bits(n_tot: int, seed_ids_per_wave) -> np.ndarray:
     return bits
 
 
+class PullGraphArrays(NamedTuple):
+    """Device-resident graph structure, passed to the kernel per call."""
+
+    in_src: "object"  # int32[n_tot+1, k]: row d's dependencies
+    edge_epoch: "object"  # int32[n_tot+1, k]: captured dependency epochs
+    is_real: "object"  # bool[n_tot+1]: False for virtual OR-collectors
+
+
+class PullState(NamedTuple):
+    node_epoch: "object"  # int32[n_tot+1]
+    invalid_bits: "object"  # int32[n_tot+1]
+
+
+def pull_graph_arrays(graph: EllGraph) -> PullGraphArrays:
+    """One-time upload of the packed in-edge table to device HBM."""
+    import jax.numpy as jnp
+
+    return PullGraphArrays(
+        in_src=jnp.asarray(graph.ell_dst),
+        edge_epoch=jnp.asarray(graph.ell_epoch),
+        is_real=jnp.asarray(graph.is_real),
+    )
+
+
+def pull_init_state(n_tot: int) -> PullState:
+    import jax.numpy as jnp
+
+    return PullState(
+        jnp.zeros(n_tot + 1, dtype=jnp.int32).at[n_tot].set(-2),
+        jnp.zeros(n_tot + 1, dtype=jnp.int32),
+    )
+
+
+def _pull_wave32_impl(garrays: PullGraphArrays, seed_bits, state: PullState):
+    import jax.numpy as jnp
+    from jax import lax
+
+    in_src, edge_epoch, is_real = garrays
+    n_tot = in_src.shape[0] - 1
+    k = in_src.shape[1]
+
+    node_epoch, invalid = state.node_epoch, state.invalid_bits
+    live = edge_epoch == node_epoch[:, None]  # (n_tot+1, k) contiguous
+    frontier = seed_bits & ~invalid
+    invalid = invalid | frontier
+
+    def cond(carry):
+        _frontier, _inv, go = carry
+        return go
+
+    def body(carry):
+        frontier, invalid, _go = carry
+        f = frontier[in_src]  # (n_tot+1, k) — the one arbitrary gather
+        contrib = jnp.where(live, f, 0)
+        fire = contrib[:, 0]
+        for j in range(1, k):  # static small k: unrolled OR-fold
+            fire = fire | contrib[:, j]
+        fire = (fire & ~invalid).at[n_tot].set(0)
+        invalid = invalid | fire
+        return fire, invalid, (fire != 0).any()
+
+    _f, invalid, _go = lax.while_loop(cond, body, (frontier, invalid, (frontier != 0).any()))
+    counts = lax.population_count(jnp.where(is_real, invalid, 0))
+    return PullState(node_epoch, invalid), counts.sum(dtype=jnp.int32)
+
+
+@functools.lru_cache(maxsize=1)
+def pull_wave32_step():
+    """The jitted 32-wave kernel: ``step(garrays, seed_bits, state)``.
+
+    Module-level (cached) so composing programs — e.g. the benchmark's
+    lax.scan over seed batches — can call it inside their own jit while
+    threading ``garrays`` through as parameters.
+    """
+    import jax
+
+    return jax.jit(_pull_wave32_impl)
+
+
 def build_pull_wave32(graph: EllGraph):
-    """Compile the 32-wave bit-packed cascade.
+    """Compile the 32-wave bit-packed cascade for one graph.
 
     Returns (state0, wave32) where
     ``wave32(seed_bits, state) -> (state, real_invalidation_count)``:
     ``seed_bits`` is int32[n_tot+1]; the count sums popcounts over REAL nodes
-    (virtual collectors excluded) across all 32 waves.
+    (virtual collectors excluded) across all 32 waves. The device graph is
+    exposed as ``wave32.garrays`` (and the raw kernel as ``wave32.step``)
+    for callers that fuse the wave into a larger jitted program.
     """
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
+    garrays = pull_graph_arrays(graph)
+    step = pull_wave32_step()
 
-    n_tot = graph.n_tot
-    in_src = jnp.asarray(graph.ell_dst)  # (n_tot+1, k): row d's dependencies
-    edge_epoch = jnp.asarray(graph.ell_epoch)
-    is_real = jnp.asarray(graph.is_real)
+    def wave32(seed_bits, state):
+        return step(garrays, seed_bits, state)
 
-    class PullState(NamedTuple):
-        node_epoch: jax.Array  # int32[n_tot+1]
-        invalid_bits: jax.Array  # int32[n_tot+1]
-
-    def init_state():
-        return PullState(
-            jnp.zeros(n_tot + 1, dtype=jnp.int32).at[n_tot].set(-2),
-            jnp.zeros(n_tot + 1, dtype=jnp.int32),
-        )
-
-    @jax.jit
-    def wave32(seed_bits: jax.Array, state):
-        node_epoch, invalid = state.node_epoch, state.invalid_bits
-        live = edge_epoch == node_epoch[:, None]  # (n_tot+1, k) contiguous
-        frontier = seed_bits & ~invalid
-        invalid = invalid | frontier
-
-        def cond(carry):
-            frontier, _inv, go = carry
-            return go
-
-        k = in_src.shape[1]
-
-        def body(carry):
-            frontier, invalid, _go = carry
-            f = frontier[in_src]  # (n_tot+1, k) — the one arbitrary gather
-            contrib = jnp.where(live, f, 0)
-            fire = contrib[:, 0]
-            for j in range(1, k):  # static small k: unrolled OR-fold
-                fire = fire | contrib[:, j]
-            fire = (fire & ~invalid).at[n_tot].set(0)
-            invalid = invalid | fire
-            return fire, invalid, (fire != 0).any()
-
-        _f, invalid, _go = lax.while_loop(cond, body, (frontier, invalid, (frontier != 0).any()))
-        counts = lax.population_count(jnp.where(is_real, invalid, 0))
-        return PullState(node_epoch, invalid), counts.sum(dtype=jnp.int32)
-
-    return init_state(), wave32
+    wave32.garrays = garrays
+    wave32.step = step
+    wave32.impl = _pull_wave32_impl
+    return pull_init_state(graph.n_tot), wave32

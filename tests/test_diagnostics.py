@@ -1,0 +1,182 @@
+"""Tracing spans, CommandTracer filter, and batched EntityResolver tests
+(SURVEY §5.1 tracing; §2.3 CommandTracer; §2.6 DbEntityResolver)."""
+import asyncio
+from dataclasses import dataclass
+
+import pytest
+
+from stl_fusion_tpu.commands import attach_command_tracer, command_handler
+from stl_fusion_tpu.core import FusionHub, set_default_hub
+from stl_fusion_tpu.diagnostics import (
+    add_listener,
+    current_span,
+    get_activity_source,
+    recent_spans,
+    remove_listener,
+)
+from stl_fusion_tpu.oplog import EntityResolver
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub():
+    hub = FusionHub()
+    hub.commander.attach_operations_pipeline()
+    old = set_default_hub(hub)
+    yield hub
+    set_default_hub(old)
+
+
+class TestTracing:
+    def test_span_records_duration_and_tags(self):
+        src = get_activity_source("test.src")
+        with src.span("work", key=1) as span:
+            assert current_span() is span
+        assert span.duration is not None and span.duration >= 0
+        assert span.tags == {"key": 1}
+        assert current_span() is None
+
+    def test_span_nesting_builds_parent_chain(self):
+        src = get_activity_source("test.src")
+        with src.span("outer") as outer:
+            with src.span("inner") as inner:
+                assert inner.parent_id == outer.span_id
+        assert outer.parent_id is None
+
+    def test_listener_and_error_capture(self):
+        seen = []
+        add_listener(seen.append)
+        try:
+            src = get_activity_source("test.src")
+            with pytest.raises(ValueError):
+                with src.span("boom"):
+                    raise ValueError("x")
+        finally:
+            remove_listener(seen.append)
+        assert any(s.name == "boom" and s.error_type == "ValueError" and s.error_message == "x" for s in seen)
+
+    def test_recent_spans_filter(self):
+        src = get_activity_source("test.filter")
+        with src.span("alpha"):
+            pass
+        spans = recent_spans(source="test.filter", name="alpha")
+        assert spans and spans[-1].name == "alpha"
+
+
+@dataclass(frozen=True)
+class Ping:
+    n: int
+
+
+class TestCommandTracer:
+    async def test_traces_commands(self, fresh_hub):
+        class Svc:
+            @command_handler
+            async def ping(self, command: Ping) -> int:
+                return command.n + 1
+
+        fresh_hub.commander.add_service(Svc())
+        attach_command_tracer(fresh_hub.commander)
+        assert await fresh_hub.commander.call(Ping(1)) == 2
+        spans = recent_spans(source="stl_fusion_tpu.commands", name="run:Ping")
+        assert spans and not spans[-1].failed
+
+    async def test_traces_errors(self, fresh_hub):
+        @dataclass(frozen=True)
+        class Fail:
+            pass
+
+        class Svc:
+            @command_handler
+            async def fail(self, command: Fail):
+                raise RuntimeError("nope")
+
+        fresh_hub.commander.add_service(Svc())
+        attach_command_tracer(fresh_hub.commander)
+        with pytest.raises(RuntimeError):
+            await fresh_hub.commander.call(Fail())
+        spans = [s for s in recent_spans(source="stl_fusion_tpu.commands") if s.name == "run:Fail"]
+        assert spans and spans[-1].tags.get("error_type") == "RuntimeError"
+
+
+class TestEntityResolver:
+    async def test_concurrent_resolves_coalesce_into_one_batch(self):
+        backend_calls = []
+
+        async def fetch_many(keys):
+            backend_calls.append(sorted(keys))
+            return {k: f"user-{k}" for k in keys}
+
+        resolver = EntityResolver(fetch_many)
+        results = await asyncio.gather(*(resolver.resolve(i) for i in range(8)))
+        assert results == [f"user-{i}" for i in range(8)]
+        assert resolver.batches == 1
+        assert backend_calls == [list(range(8))]
+
+    async def test_same_key_shares_one_fetch(self):
+        count = [0]
+
+        async def fetch_many(keys):
+            count[0] += len(keys)
+            return {k: k for k in keys}
+
+        resolver = EntityResolver(fetch_many)
+        results = await asyncio.gather(*(resolver.resolve("a") for _ in range(5)))
+        assert results == ["a"] * 5
+        assert count[0] == 1
+
+    async def test_missing_keys_resolve_none(self):
+        async def fetch_many(keys):
+            return {}
+
+        resolver = EntityResolver(fetch_many)
+        assert await resolver.resolve("ghost") is None
+
+    async def test_batch_size_cap(self):
+        sizes = []
+
+        async def fetch_many(keys):
+            sizes.append(len(keys))
+            return {k: k for k in keys}
+
+        resolver = EntityResolver(fetch_many, max_batch_size=3)
+        await asyncio.gather(*(resolver.resolve(i) for i in range(8)))
+        assert all(s <= 3 for s in sizes)
+        assert sum(sizes) == 8
+
+    async def test_backend_error_propagates_to_all_waiters(self):
+        async def fetch_many(keys):
+            raise TimeoutError("db down")
+
+        resolver = EntityResolver(fetch_many)
+        results = await asyncio.gather(
+            *(resolver.resolve(i) for i in range(3)), return_exceptions=True
+        )
+        assert all(isinstance(r, TimeoutError) for r in results)
+
+    async def test_resolve_many(self):
+        async def fetch_many(keys):
+            return {k: k * 2 for k in keys if k != 3}
+
+        resolver = EntityResolver(fetch_many)
+        out = await resolver.resolve_many([1, 2, 3])
+        assert out == {1: 2, 2: 4, 3: None}
+
+
+class TestOperationLogTrimmer:
+    async def test_trims_old_records(self):
+        import time as _time
+
+        from stl_fusion_tpu.oplog import InMemoryOperationLog, OperationRecord
+        from stl_fusion_tpu.oplog.trimmer import OperationLogTrimmer
+
+        store = InMemoryOperationLog()
+        now = _time.time()
+        for i in range(5):
+            store.append(OperationRecord(f"op{i}", "agent", now - 1000 + i, None, ()))
+        store.append(OperationRecord("fresh", "agent", now, None, ()))
+        trimmer = OperationLogTrimmer(store, max_age=600.0)
+        removed = trimmer.trim_once()
+        assert removed == 5
+        assert trimmer.trimmed_total == 5
+        remaining = store.read_after(-1)
+        assert [r.id for r in remaining] == ["fresh"]

@@ -1,0 +1,240 @@
+"""Tests for base-library gap-fill: Symbol, Requirement, caches, StochasticCounter.
+
+Mirrors the reference's unit-test strategy for src/Stl primitives
+(tests/Stl.Tests — SURVEY.md §4).
+"""
+import asyncio
+import random
+
+import pytest
+
+from stl_fusion_tpu.utils import (
+    MUST_EXIST,
+    ComputingCache,
+    FastComputingCache,
+    FileSystemCache,
+    Requirement,
+    RequirementError,
+    StochasticCounter,
+    Symbol,
+    must_exist,
+)
+
+
+class TestSymbol:
+    def test_identity_and_equality(self):
+        a = Symbol("users.Get")
+        b = Symbol("users." + "Get")
+        assert a == b
+        assert a is not None
+        assert str(a) == "users.Get"
+        assert a.value == "users.Get"
+
+    def test_empty(self):
+        assert Symbol("").is_empty
+        assert Symbol("") == Symbol.EMPTY
+        assert not Symbol("x").is_empty
+
+    def test_idempotent_wrap(self):
+        a = Symbol("k")
+        assert Symbol(a) is a
+
+    def test_usable_as_dict_key_with_str(self):
+        d = {Symbol("a"): 1}
+        assert d["a"] == 1
+        assert Symbol("a") in d
+
+
+class TestRequirement:
+    def test_must_exist(self):
+        assert MUST_EXIST.check("x") == "x"
+        assert MUST_EXIST.check(0) == 0  # zero is a value, not "missing"
+        with pytest.raises(RequirementError):
+            MUST_EXIST.check(None)
+        with pytest.raises(RequirementError):
+            MUST_EXIST.check("")
+
+    def test_must_exist_helper_names_value(self):
+        with pytest.raises(RequirementError, match="user"):
+            must_exist(None, "user")
+        assert must_exist(5, "n") == 5
+
+    def test_func_requirement_and_combination(self):
+        positive = Requirement(lambda v: v > 0, description="positive")
+        even = Requirement(lambda v: v % 2 == 0, description="even")
+        both = positive & even
+        assert both.check(4) == 4
+        with pytest.raises(Exception):
+            both.check(3)
+        with pytest.raises(Exception):
+            both.check(-2)
+
+    def test_custom_error(self):
+        class MissingUser(Exception):
+            pass
+
+        req = MUST_EXIST.with_error(lambda v: MissingUser())
+        with pytest.raises(MissingUser):
+            req.check(None)
+
+
+class TestComputingCache:
+    def test_single_flight(self):
+        async def go():
+            calls = []
+
+            async def compute(key):
+                calls.append(key)
+                await asyncio.sleep(0.01)
+                return key * 2
+
+            cache = ComputingCache(compute)
+            results = await asyncio.gather(*(cache.get(7) for _ in range(10)))
+            assert results == [14] * 10
+            assert calls == [7]  # computed exactly once
+            assert cache.try_get(7) == 14
+
+        asyncio.run(go())
+
+    def test_errors_not_cached(self):
+        async def go():
+            attempts = []
+
+            async def compute(key):
+                attempts.append(key)
+                if len(attempts) == 1:
+                    raise RuntimeError("transient")
+                return key
+
+            cache = FastComputingCache(compute)
+            with pytest.raises(RuntimeError):
+                await cache.get(1)
+            assert await cache.get(1) == 1
+            assert len(attempts) == 2
+
+        asyncio.run(go())
+
+    def test_invalidate(self):
+        async def go():
+            count = [0]
+
+            async def compute(key):
+                count[0] += 1
+                return count[0]
+
+            cache = ComputingCache(compute)
+            assert await cache.get("k") == 1
+            assert await cache.get("k") == 1
+            cache.invalidate("k")
+            assert await cache.get("k") == 2
+
+        asyncio.run(go())
+
+    def test_capacity_eviction(self):
+        async def go():
+            cache = ComputingCache(lambda k: _ret(k), capacity=2)
+            for i in range(4):
+                await cache.get(i)
+            assert len(cache) <= 2
+
+        async def _ret(k):
+            return k
+
+        asyncio.run(go())
+
+
+class TestFileSystemCache:
+    def test_roundtrip(self, tmp_path):
+        cache = FileSystemCache(str(tmp_path / "fs"))
+        assert cache.try_get("a") is None
+        cache.set("a", b"hello")
+        assert cache.try_get("a") == b"hello"
+        cache.set("a", b"world")  # overwrite
+        assert cache.try_get("a") == b"world"
+        cache.remove("a")
+        assert cache.try_get("a") is None
+
+    def test_clear_and_tuple_keys(self, tmp_path):
+        cache = FileSystemCache(str(tmp_path / "fs"))
+        cache.set(("svc", "method", 1), b"x")
+        assert cache.try_get(("svc", "method", 1)) == b"x"
+        cache.clear()
+        assert cache.try_get(("svc", "method", 1)) is None
+
+
+class TestStochasticCounter:
+    def test_sampled_increments_approximate_total(self):
+        c = StochasticCounter(sample_period_log2=3, rng=random.Random(42))
+        n = 10_000
+        for _ in range(n):
+            c.increment()
+        # approximate: within 20% of true count for this many samples
+        assert abs(c.approximate_value - n) / n < 0.2
+
+    def test_period_zero_counts_exactly(self):
+        c = StochasticCounter(sample_period_log2=0)
+        for _ in range(100):
+            assert c.increment() is not None
+        assert c.approximate_value == 100
+
+    def test_decrement_floors_at_zero(self):
+        c = StochasticCounter(sample_period_log2=0)
+        c.decrement()
+        assert c.approximate_value == 0
+
+
+class TestReviewFixes:
+    def test_must_exist_rejects_empty_collections(self):
+        for empty in ([], {}, set(), ()):
+            with pytest.raises(RequirementError):
+                MUST_EXIST.check(empty)
+        assert MUST_EXIST.check([1]) == [1]
+        assert MUST_EXIST.check(0.0) == 0.0
+
+    def test_symbol_interning_identity_and_collectability(self):
+        import gc
+
+        a = Symbol("dyn-key-1")
+        assert Symbol("dyn-key-1") is a
+        key_count = len(Symbol._interned)
+        del a
+        gc.collect()
+        assert len(Symbol._interned) <= key_count
+
+    def test_computing_cache_leader_cancel_does_not_poison_waiters(self):
+        async def go():
+            started = asyncio.Event()
+
+            async def compute(key):
+                started.set()
+                await asyncio.sleep(0.05)
+                return key * 10
+
+            cache = ComputingCache(compute)
+            leader = asyncio.ensure_future(cache.get(4))
+            await started.wait()
+            waiter = asyncio.ensure_future(cache.get(4))
+            await asyncio.sleep(0)
+            leader.cancel()
+            # waiter still gets the value: the compute survives the leader
+            assert await waiter == 40
+
+        asyncio.run(go())
+
+    def test_fs_cache_concurrent_writers_same_key(self, tmp_path):
+        import threading
+
+        cache = FileSystemCache(str(tmp_path / "fs"))
+        payloads = [bytes([i]) * 4096 for i in range(8)]
+
+        def write(p):
+            for _ in range(20):
+                cache.set("k", p)
+
+        threads = [threading.Thread(target=write, args=(p,)) for p in payloads]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        final = cache.try_get("k")
+        assert final in payloads  # never torn/interleaved
