@@ -7,8 +7,12 @@ from .cache import (
 )
 from .client_function import ClientComputed, ClientComputeMethodFunction, FusionClient, compute_client
 from .compute_call import RpcInboundComputeCall, RpcOutboundComputeCall, install_compute_call_type
+from .service_modes import RoutingComputeProxy, RpcServiceMode, add_fusion_service
 
 __all__ = [
+    "RoutingComputeProxy",
+    "RpcServiceMode",
+    "add_fusion_service",
     "ClientComputedCache",
     "FileClientComputedCache",
     "InMemoryClientComputedCache",

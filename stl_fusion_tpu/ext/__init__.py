@@ -10,8 +10,25 @@ from .auth import (
 )
 from .fusion_time import FusionTime
 from .kv_store import KeyValueStore, RemoveCommand, SetCommand
+from .multitenancy import (
+    PerTenantWorkerHost,
+    Tenant,
+    TenantNotFoundError,
+    TenantRegistry,
+    TenantResolver,
+)
 from .peer_monitor import RpcPeerState, RpcPeerStateMonitor
+from .plugins import PluginHost, PluginInfo, PluginSetInfo, find_plugins, plugin
 from .session import Session, SessionResolver
+from .streams import (
+    BrokerChangeNotifier,
+    InMemoryBroker,
+    MessageBroker,
+    PubSub,
+    SequenceSet,
+    Streamer,
+    TypedQueue,
+)
 
 __all__ = [
     "EditUserCommand",
@@ -24,8 +41,25 @@ __all__ = [
     "KeyValueStore",
     "RemoveCommand",
     "SetCommand",
+    "PerTenantWorkerHost",
+    "Tenant",
+    "TenantNotFoundError",
+    "TenantRegistry",
+    "TenantResolver",
     "RpcPeerState",
     "RpcPeerStateMonitor",
     "Session",
     "SessionResolver",
+    "PluginHost",
+    "PluginInfo",
+    "PluginSetInfo",
+    "find_plugins",
+    "plugin",
+    "BrokerChangeNotifier",
+    "InMemoryBroker",
+    "MessageBroker",
+    "PubSub",
+    "SequenceSet",
+    "Streamer",
+    "TypedQueue",
 ]

@@ -4,7 +4,8 @@ from .hub import RpcClientProxy, RpcHub, consistent_hash_router
 from .message import COMPUTE_SYSTEM_SERVICE, SYSTEM_SERVICE, VERSION_HEADER, RpcMessage
 from .peer import ConnectionState, RpcClientPeer, RpcPeer, RpcServerPeer
 from .registry import RpcMethodDef, RpcServiceDef, RpcServiceRegistry, rpc_no_wait
-from .testing import RpcTestTransport
+from .http_gateway import FusionHttpServer, RestClient, RestError
+from .testing import RpcMultiServerTestTransport, RpcTestTransport
 
 __all__ = [
     "RpcCallTypeRegistry",
@@ -26,4 +27,8 @@ __all__ = [
     "RpcServiceRegistry",
     "rpc_no_wait",
     "RpcTestTransport",
+    "RpcMultiServerTestTransport",
+    "FusionHttpServer",
+    "RestClient",
+    "RestError",
 ]

@@ -1,0 +1,180 @@
+"""HTTP/REST surface for compute services + typed REST client.
+
+The analogue of the reference's REST story: Stl.Fusion.Server's MVC
+controllers/endpoints expose compute services over plain HTTP, and
+Stl.RestEase generates typed clients for them (src/Stl.RestEase/,
+Fusion.Server/Endpoints/ — SURVEY §2.7, §2.8). Protocol:
+
+    GET  /fusion/{service}/{method}?args=<json-array>   — reads
+    POST /fusion/{service}/{method}   (json-array body) — commands/writes
+
+Responses are ``{"ok": value}`` or ``{"error": {"type", "message"}}``.
+Unlike the RPC/websocket channel this surface carries NO invalidation
+subscription — it is the integration path for plain HTTP consumers
+(curl, dashboards, other stacks), exactly the niche REST fills in the
+reference. Implemented on asyncio streams (stdlib only).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import urllib.parse
+from typing import Any, Optional
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["FusionHttpServer", "RestClient", "RestError"]
+
+PATH_PREFIX = "/fusion/"
+
+
+class RestError(Exception):
+    def __init__(self, type_name: str, message: str):
+        super().__init__(f"{type_name}: {message}")
+        self.type_name = type_name
+
+
+class FusionHttpServer:
+    """Serves registered services of an RpcHub (or any object registry with
+    ``service_registry.invoke``) over HTTP."""
+
+    def __init__(self, rpc_hub, host: str = "127.0.0.1", port: int = 0):
+        self.rpc_hub = rpc_hub
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> "FusionHttpServer":
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            request_line = (await reader.readline()).decode("latin1").strip()
+            if not request_line:
+                return
+            method, target, _version = request_line.split(" ", 2)
+            content_length = 0
+            while True:
+                line = (await reader.readline()).decode("latin1").strip()
+                if not line:
+                    break
+                name, _, value = line.partition(":")
+                if name.lower() == "content-length":
+                    content_length = int(value.strip())
+            body = await reader.readexactly(content_length) if content_length else b""
+            status, payload = await self._dispatch(method, target, body)
+            try:
+                data = json.dumps(payload).encode()
+            except (TypeError, ValueError) as e:
+                # the service returned something JSON can't carry — a real
+                # error response beats a silently-dropped connection
+                status = "500 Internal Server Error"
+                data = json.dumps(
+                    {"error": {"type": "NotSerializable", "message": str(e)}}
+                ).encode()
+            writer.write(
+                f"HTTP/1.1 {status}\r\nContent-Type: application/json\r\n"
+                f"Content-Length: {len(data)}\r\nConnection: close\r\n\r\n".encode() + data
+            )
+            await writer.drain()
+        except Exception:  # noqa: BLE001 — one bad request never kills the server
+            log.exception("http gateway request failed")
+        finally:
+            writer.close()
+
+    async def _dispatch(self, http_method: str, target: str, body: bytes):
+        parsed = urllib.parse.urlsplit(target)
+        if not parsed.path.startswith(PATH_PREFIX):
+            return "404 Not Found", {"error": {"type": "NotFound", "message": parsed.path}}
+        parts = parsed.path[len(PATH_PREFIX):].split("/")
+        if len(parts) != 2:
+            return "404 Not Found", {"error": {"type": "NotFound", "message": parsed.path}}
+        service, method = parts
+        try:
+            if http_method == "GET":
+                query = urllib.parse.parse_qs(parsed.query)
+                raw_args = query.get("args", ["[]"])[0]
+            elif http_method == "POST":
+                raw_args = body.decode() or "[]"
+            else:
+                return "405 Method Not Allowed", {
+                    "error": {"type": "MethodNotAllowed", "message": http_method}
+                }
+            try:
+                args = json.loads(raw_args)
+                if not isinstance(args, list):
+                    raise ValueError("args must be a JSON array")
+            except ValueError as e:
+                return "400 Bad Request", {"error": {"type": "BadRequest", "message": str(e)}}
+            result = await self.rpc_hub.service_registry.invoke(service, method, args)
+            return "200 OK", {"ok": result}
+        except LookupError as e:
+            return "404 Not Found", {"error": {"type": type(e).__name__, "message": str(e)}}
+        except Exception as e:  # noqa: BLE001 — service errors travel as payloads
+            return "500 Internal Server Error", {
+                "error": {"type": type(e).__name__, "message": str(e)}
+            }
+
+
+class _RestMethod:
+    def __init__(self, client: "RestClient", method: str):
+        self._client = client
+        self._method = method
+
+    async def __call__(self, *args):
+        return await self._client.call(self._method, list(args))
+
+    async def post(self, *args):
+        return await self._client.call(self._method, list(args), http_method="POST")
+
+
+class RestClient:
+    """Typed REST client for a served compute service (≈ Stl.RestEase
+    clients): attribute access → GET call; ``.post`` for commands."""
+
+    def __init__(self, base_url: str, service: str):
+        parsed = urllib.parse.urlsplit(base_url)
+        self.host = parsed.hostname
+        self.port = parsed.port or 80
+        self.service = service
+
+    def __getattr__(self, method: str) -> _RestMethod:
+        if method.startswith("_"):
+            raise AttributeError(method)
+        return _RestMethod(self, method)
+
+    async def call(self, method: str, args: list, http_method: str = "GET") -> Any:
+        path = f"{PATH_PREFIX}{self.service}/{method}"
+        body = b""
+        if http_method == "GET":
+            path += "?args=" + urllib.parse.quote(json.dumps(args))
+        else:
+            body = json.dumps(args).encode()
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            writer.write(
+                f"{http_method} {path} HTTP/1.1\r\nHost: {self.host}\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode() + body
+            )
+            await writer.drain()
+            raw = await reader.read()
+        finally:
+            writer.close()
+        _headers, _, payload = raw.partition(b"\r\n\r\n")
+        response = json.loads(payload.decode())
+        if "error" in response:
+            raise RestError(response["error"]["type"], response["error"]["message"])
+        return response["ok"]

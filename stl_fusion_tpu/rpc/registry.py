@@ -47,6 +47,19 @@ class RpcServiceDef:
 
     def method(self, name: str) -> RpcMethodDef:
         m = self.methods.get(name)
+        if m is None and not name.startswith("_") and getattr(
+            self.implementation, "__rpc_dynamic__", False
+        ):
+            # dynamic services (routing proxies) synthesize methods via
+            # __getattr__. Never cached: remote callers control `name`, and
+            # caching would let them grow this dict without bound.
+            try:
+                fn = getattr(self.implementation, name)
+            except AttributeError:
+                fn = None
+            if fn is None or not inspect.iscoroutinefunction(fn):
+                raise LookupError(f"method {self.name}.{name} is not registered")
+            return RpcMethodDef(name, fn)
         if m is None:
             raise LookupError(f"method {self.name}.{name} is not registered")
         return m

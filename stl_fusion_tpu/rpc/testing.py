@@ -16,24 +16,28 @@ from ..utils.async_utils import ChannelPair, create_twisted_pair
 from .hub import RpcHub
 from .peer import RpcClientPeer, RpcServerPeer
 
-__all__ = ["RpcTestTransport"]
+__all__ = ["RpcTestTransportBase", "RpcTestTransport", "RpcMultiServerTestTransport"]
 
 
-class RpcTestTransport:
-    """Wires a client hub to a server hub through channel pairs."""
+class RpcTestTransportBase:
+    """Channel-pair transport plumbing shared by the single- and
+    multi-server variants; subclasses pick the server hub per peer ref."""
 
-    def __init__(self, client_hub: RpcHub, server_hub: RpcHub):
+    def __init__(self, client_hub: RpcHub):
         self.client_hub = client_hub
-        self.server_hub = server_hub
         self.connect_count: Dict[str, int] = {}
         self._blocked = False
         client_hub.client_connector = self._connect
 
+    def _server_for(self, peer_ref: str) -> RpcHub:
+        raise NotImplementedError
+
     async def _connect(self, peer: RpcClientPeer) -> ChannelPair:
         if self._blocked:
             raise ConnectionError("test transport is blocked")
+        server_hub = self._server_for(peer.ref)
         client_end, server_end = create_twisted_pair()
-        self.server_hub.server_peer(f"client:{peer.ref}").connect(server_end)
+        server_hub.server_peer(f"client:{peer.ref}").connect(server_end)
         self.connect_count[peer.ref] = self.connect_count.get(peer.ref, 0) + 1
         return client_end
 
@@ -50,3 +54,30 @@ class RpcTestTransport:
     async def wait_connected(self, peer_ref: str = "default", timeout: float = 5.0) -> None:
         peer = self.client_hub.client_peer(peer_ref)
         await asyncio.wait_for(peer.when_connected(), timeout)
+
+
+class RpcTestTransport(RpcTestTransportBase):
+    """Wires a client hub to a server hub through channel pairs."""
+
+    def __init__(self, client_hub: RpcHub, server_hub: RpcHub):
+        super().__init__(client_hub)
+        self.server_hub = server_hub
+
+    def _server_for(self, peer_ref: str) -> RpcHub:
+        return self.server_hub
+
+
+class RpcMultiServerTestTransport(RpcTestTransportBase):
+    """Wires one client hub to MANY server hubs, selected by peer ref —
+    the in-memory analogue of the MultiServerRpc sample's server pool
+    (samples/MultiServerRpc/Program.cs:58-76): peer ref = pool member."""
+
+    def __init__(self, client_hub: RpcHub, servers: Dict[str, RpcHub]):
+        super().__init__(client_hub)
+        self.servers = dict(servers)
+
+    def _server_for(self, peer_ref: str) -> RpcHub:
+        server_hub = self.servers.get(peer_ref)
+        if server_hub is None:
+            raise ConnectionError(f"no server for peer ref {peer_ref!r}")
+        return server_hub

@@ -1,0 +1,303 @@
+"""Plugins host, broker-backed stream helpers, and the HTTP/REST gateway
+(SURVEY §2.8: Stl.Plugins, Stl.Redis, Stl.RestEase analogues)."""
+import asyncio
+
+import pytest
+
+from stl_fusion_tpu.core import ComputeService, FusionHub, compute_method, invalidating
+from stl_fusion_tpu.ext import (
+    BrokerChangeNotifier,
+    InMemoryBroker,
+    PluginHost,
+    PluginSetInfo,
+    PubSub,
+    SequenceSet,
+    Streamer,
+    TypedQueue,
+    plugin,
+)
+from stl_fusion_tpu.rpc import FusionHttpServer, RestClient, RestError, RpcHub
+
+
+# ------------------------------------------------------------------ plugins
+
+@plugin(capabilities=["store"])
+class SqliteStorePlugin:
+    pass
+
+
+@plugin(name="cache", capabilities=["store", "cache"], dependencies=["SqliteStorePlugin"])
+class CachePlugin:
+    pass
+
+
+@plugin(dependencies=["cache"])
+class ApiPlugin:
+    pass
+
+
+class TestPlugins:
+    def _infos(self):
+        return [
+            getattr(cls, "__plugin_info__")
+            for cls in (ApiPlugin, CachePlugin, SqliteStorePlugin)
+        ]
+
+    def test_start_order_respects_dependencies(self):
+        ordered = PluginSetInfo(self._infos()).start_order()
+        names = [p.name for p in ordered]
+        assert names.index("SqliteStorePlugin") < names.index("cache") < names.index("ApiPlugin")
+
+    def test_host_instantiates_and_queries_capabilities(self):
+        host = PluginHost(self._infos())
+        assert len(host) == 3
+        assert isinstance(host.get("cache"), CachePlugin)
+        assert isinstance(host.get(ApiPlugin), ApiPlugin)
+        stores = host.with_capability("store")
+        assert {type(s) for s in stores} == {SqliteStorePlugin, CachePlugin}
+        assert "cache" in host
+        with pytest.raises(LookupError):
+            host.get("ghost")
+
+    def test_cycle_detection(self):
+        @plugin(name="a", dependencies=["b"])
+        class A:
+            pass
+
+        @plugin(name="b", dependencies=["a"])
+        class B:
+            pass
+
+        with pytest.raises(ValueError, match="cycle"):
+            PluginSetInfo([A.__plugin_info__, B.__plugin_info__]).start_order()
+
+    def test_missing_dependency(self):
+        @plugin(name="solo", dependencies=["ghost"])
+        class Solo:
+            pass
+
+        with pytest.raises(LookupError):
+            PluginSetInfo([Solo.__plugin_info__]).start_order()
+
+    def test_find_plugins_scans_this_module(self):
+        from stl_fusion_tpu.ext import find_plugins
+
+        infos = find_plugins(["tests.test_ext_plugins_streams"], recurse=False)
+        assert {i.name for i in infos} >= {"SqliteStorePlugin", "cache", "ApiPlugin"}
+
+
+# ------------------------------------------------------------------ streams
+
+class TestStreams:
+    async def test_pubsub_typed_roundtrip(self):
+        broker = InMemoryBroker()
+        channel = PubSub(broker, "events")
+        got = []
+        unsub = channel.subscribe(got.append)
+        channel.publish({"id": 1, "kind": "created"})
+        channel.publish({"id": 2, "kind": "removed"})
+        assert got == [{"id": 1, "kind": "created"}, {"id": 2, "kind": "removed"}]
+        unsub()
+        channel.publish({"id": 3})
+        assert len(got) == 2
+
+    async def test_queue_each_item_consumed_once(self):
+        broker = InMemoryBroker()
+        q = TypedQueue(broker, "work")
+        for i in range(6):
+            q.enqueue(i)
+        items = [await q.dequeue(timeout=1.0) for _ in range(6)]
+        assert sorted(items) == list(range(6))
+        with pytest.raises(asyncio.TimeoutError):
+            await q.dequeue(timeout=0.05)
+        q.close()
+
+    async def test_streamer_replays_backlog_then_follows(self):
+        broker = InMemoryBroker()
+        s = Streamer(broker, "log")
+        s.append("a")
+        s.append("b")
+
+        got = []
+
+        async def read_all():
+            async for item in s.read(from_start=True):
+                got.append(item)
+
+        task = asyncio.ensure_future(read_all())
+        await asyncio.sleep(0.01)
+        assert got == ["a", "b"]  # backlog replayed
+        s.append("c")
+        await asyncio.sleep(0.01)
+        assert got == ["a", "b", "c"]  # live follow
+        s.complete()
+        await asyncio.wait_for(task, 1.0)
+        s.close()
+
+    def test_sequence_set_monotone(self):
+        broker = InMemoryBroker()
+        seq = SequenceSet(broker)
+        assert seq.next("invoices") == 1
+        assert seq.next("invoices") == 2
+        assert seq.next("invoices", at_least=100) == 101
+        assert seq.next("orders") == 1  # independent keys
+        seq.reset("invoices")
+        assert seq.next("invoices") == 1
+
+    async def test_broker_change_notifier_wakes_subscribers(self):
+        broker = InMemoryBroker()
+        notifier_a = BrokerChangeNotifier(broker)
+        notifier_b = BrokerChangeNotifier(broker)
+        event = notifier_b.subscribe()
+        assert not event.is_set()
+        notifier_a.notify()  # "host A committed an operation"
+        assert event.is_set()
+
+
+# ------------------------------------------------------------------ http/rest
+
+class ProductService(ComputeService):
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self.prices = {"apple": 2}
+
+    @compute_method
+    async def price(self, name: str) -> int:
+        return self.prices.get(name, 0)
+
+    async def set_price(self, name: str, value: int):
+        self.prices[name] = value
+        with invalidating():
+            await self.price(name)
+        return value
+
+
+class TestHttpGateway:
+    async def test_rest_roundtrip_and_errors(self):
+        fusion = FusionHub()
+        rpc = RpcHub("http-server")
+        svc = ProductService(fusion)
+        rpc.add_service("products", svc)
+        server = await FusionHttpServer(rpc).start()
+        try:
+            client = RestClient(server.url, "products")
+            assert await client.price("apple") == 2
+            assert await client.price("ghost") == 0
+
+            # POST (command-style) write, then read sees it
+            assert await client.set_price.post("apple", 5) == 5
+            assert await client.price("apple") == 5
+
+            # unknown method → RestError, server stays up
+            with pytest.raises(RestError):
+                await client.nope()
+            assert await client.price("apple") == 5
+
+            # unknown service → RestError
+            with pytest.raises(RestError):
+                await RestClient(server.url, "ghosts").anything()
+        finally:
+            await server.stop()
+            await rpc.stop()
+
+
+class TestReviewFixes:
+    async def test_queue_distinct_delivery_across_instances(self):
+        broker = InMemoryBroker()
+        q1 = TypedQueue(broker, "jobs")
+        q2 = TypedQueue(broker, "jobs")  # second worker, same queue
+        for i in range(10):
+            q1.enqueue(i)
+        a = [await q1.dequeue(timeout=1.0) for _ in range(5)]
+        b = [await q2.dequeue(timeout=1.0) for _ in range(5)]
+        assert sorted(a + b) == list(range(10))  # once each, never doubled
+
+    async def test_streamer_slow_reader_skips_trimmed_not_misindexed(self):
+        broker = InMemoryBroker()
+        s = Streamer(broker, "tight", max_backlog=4)
+        for i in range(3):
+            s.append(i)
+        got = []
+
+        async def read_some():
+            async for item in s.read(from_start=True):
+                got.append(item)
+
+        task = asyncio.ensure_future(read_some())
+        await asyncio.sleep(0.01)
+        assert got == [0, 1, 2]
+        # push far past the backlog while reader is idle at pos 3
+        for i in range(3, 20):
+            s.append(i)
+        s.complete()
+        await asyncio.wait_for(task, 1.0)
+        # reader skipped the trimmed gap but got the retained tail in order
+        assert got[:3] == [0, 1, 2]
+        assert got[3:] == sorted(got[3:])
+        assert got[-1] == 19
+        s.close()
+
+    async def test_dynamic_service_rejects_non_methods_and_does_not_cache(self):
+        from stl_fusion_tpu.rpc.registry import RpcServiceDef
+
+        class Router:
+            __rpc_dynamic__ = True
+            service_name = "not-a-method"
+
+            def __getattr__(self, name):
+                if name.startswith("_"):
+                    raise AttributeError(name)
+
+                async def call(*args):
+                    return name
+
+                return call
+
+        sd = RpcServiceDef("r", Router())
+        before = len(sd.methods)
+        assert await sd.method("anything").fn() == "anything"
+        assert len(sd.methods) == before  # dynamic defs never cached
+        with pytest.raises(LookupError):
+            sd.method("service_name")  # attribute exists but isn't async
+
+    async def test_gateway_unserializable_result_returns_500(self):
+        fusion = FusionHub()
+        rpc = RpcHub("http-server-2")
+
+        class Raw:
+            async def blob(self):
+                return b"\x00\x01"  # bytes aren't JSON
+
+        rpc.add_service("raw", Raw())
+        server = await FusionHttpServer(rpc).start()
+        try:
+            with pytest.raises(RestError, match="NotSerializable"):
+                await RestClient(server.url, "raw").blob()
+        finally:
+            await server.stop()
+            await rpc.stop()
+
+    async def test_tenant_removed_off_loop_worker_stopped_at_host_stop(self):
+        import threading
+
+        from stl_fusion_tpu.ext import PerTenantWorkerHost, Tenant, TenantRegistry
+        from stl_fusion_tpu.utils import WorkerBase
+
+        class W(WorkerBase):
+            def __init__(self, tenant):
+                super().__init__(name=f"w-{tenant.id}")
+
+            async def on_run(self):
+                await asyncio.Event().wait()
+
+        reg = TenantRegistry(single_tenant=False)
+        reg.add(Tenant("t1"))
+        host = PerTenantWorkerHost(reg, W).start()
+        worker = host.workers["t1"]
+        t = threading.Thread(target=lambda: reg.remove("t1"))  # off-loop removal
+        t.start()
+        t.join()
+        assert "t1" not in host.workers
+        assert worker.is_running  # parked as orphan, not leaked silently
+        await host.stop()
+        assert not worker.is_running

@@ -1,0 +1,152 @@
+"""Multitenancy: registry/resolver semantics, per-tenant workers, and
+tenant-isolated cross-host invalidation (SURVEY §2.1 multitenancy hooks,
+§2.6 per-tenant workers — ITenantRegistry/DefaultTenantResolver,
+DbTenantWorkerBase)."""
+import asyncio
+import dataclasses
+
+import pytest
+
+from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method, is_invalidating
+from stl_fusion_tpu.commands import command_handler
+from stl_fusion_tpu.ext import (
+    PerTenantWorkerHost,
+    Session,
+    Tenant,
+    TenantNotFoundError,
+    TenantRegistry,
+    TenantResolver,
+)
+from stl_fusion_tpu.oplog import InMemoryOperationLog, LocalChangeNotifier, attach_operation_log
+from stl_fusion_tpu.utils.serialization import wire_type
+
+
+class TestTenantRegistry:
+    def test_single_tenant_mode(self):
+        reg = TenantRegistry()
+        assert reg.get("").is_default
+        with pytest.raises(ValueError):
+            reg.add(Tenant("acme"))
+        with pytest.raises(TenantNotFoundError):
+            reg.get("acme")
+
+    def test_multi_tenant_add_remove(self):
+        reg = TenantRegistry(single_tenant=False)
+        changes = []
+        reg.on_change(lambda t, c: changes.append((t.id, c)))
+        reg.add(Tenant("acme", "Acme Inc"))
+        reg.add(Tenant("zen", is_active=False))
+        assert {t.id for t in reg.all_tenants} == {"", "acme", "zen"}
+        assert {t.id for t in reg.active_tenants} == {"", "acme"}
+        reg.remove("zen")
+        assert changes == [("acme", "added"), ("zen", "added"), ("zen", "removed")]
+        with pytest.raises(ValueError):
+            reg.remove("")
+
+    def test_resolver_uses_session_suffix(self):
+        reg = TenantRegistry(single_tenant=False)
+        reg.add(Tenant("acme"))
+        resolver = TenantResolver(reg)
+        assert resolver.resolve(None).is_default
+        assert resolver.resolve(Session.new()).is_default
+        assert resolver.resolve(Session.new("acme")).id == "acme"
+        with pytest.raises(TenantNotFoundError):
+            resolver.resolve(Session.new("ghost"))
+
+
+class TestPerTenantWorkers:
+    async def test_one_worker_per_tenant_and_follows_changes(self):
+        from stl_fusion_tpu.utils import WorkerBase
+
+        class TenantWorker(WorkerBase):
+            def __init__(self, tenant):
+                super().__init__(name=f"w-{tenant.id}")
+                self.tenant = tenant
+
+            async def on_run(self):
+                await asyncio.Event().wait()  # run until stopped
+
+        reg = TenantRegistry(single_tenant=False)
+        reg.add(Tenant("a"))
+        host = PerTenantWorkerHost(reg, TenantWorker).start()
+        try:
+            assert set(host.workers) == {"", "a"}
+            reg.add(Tenant("b"))
+            assert set(host.workers) == {"", "a", "b"}
+            assert all(w.is_running for w in host.workers.values())
+            stopped = host.workers["b"]
+            reg.remove("b")
+            await asyncio.sleep(0.01)
+            assert set(host.workers) == {"", "a"}
+            assert not stopped.is_running
+        finally:
+            await host.stop()
+        assert not host.workers
+
+
+# ---------------------------------------------------------------- isolation
+
+TENANT_DB = {"acme": {}, "zen": {}}
+
+
+@wire_type("TenantSet")
+@dataclasses.dataclass(frozen=True)
+class TenantSet:
+    tenant: str
+    key: str
+    value: int
+
+
+def make_tenant_service(tenant_id):
+    class TenantValueService(ComputeService):
+        @compute_method
+        async def get(self, key: str) -> int:
+            return TENANT_DB[tenant_id].get(key, 0)
+
+        @command_handler
+        async def set_value(self, command: TenantSet):
+            if is_invalidating():
+                await self.get(command.key)
+                return
+            TENANT_DB[command.tenant][command.key] = command.value
+
+    return TenantValueService
+
+
+async def test_tenant_isolated_cross_host_invalidation():
+    """Two tenants, two hosts: each tenant has its OWN op log + reader; a
+    command in tenant acme propagates to host B's acme graph but never
+    touches zen's."""
+    for db in TENANT_DB.values():
+        db.clear()
+    logs = {t: InMemoryOperationLog() for t in ("acme", "zen")}
+    notifiers = {t: LocalChangeNotifier() for t in ("acme", "zen")}
+
+    def make_host():
+        hubs, svcs, readers = {}, {}, {}
+        for t in ("acme", "zen"):
+            hub = FusionHub()
+            svc = make_tenant_service(t)(hub)
+            hub.commander.add_service(svc)
+            readers[t] = attach_operation_log(hub.commander, logs[t], notifiers[t])
+            hubs[t], svcs[t] = hub, svc
+        return hubs, svcs, readers
+
+    hubs_a, svcs_a, readers_a = make_host()
+    hubs_b, svcs_b, readers_b = make_host()
+    try:
+        assert await svcs_b["acme"].get("x") == 0
+        acme_node = await capture(lambda: svcs_b["acme"].get("x"))
+        zen_node = await capture(lambda: svcs_b["zen"].get("x"))
+
+        await hubs_a["acme"].commander.call(TenantSet("acme", "x", 7))
+        await asyncio.wait_for(acme_node.when_invalidated(), 5.0)
+        assert await svcs_b["acme"].get("x") == 7
+
+        # zen's graph untouched: still consistent, still 0
+        await asyncio.sleep(0.05)
+        assert zen_node.is_consistent
+        assert await svcs_b["zen"].get("x") == 0
+    finally:
+        for r in list(readers_a.values()) + list(readers_b.values()):
+            await r.stop()

@@ -1,0 +1,175 @@
+"""Service-mode tests — the MultiServerRpc sample semantics
+(samples/MultiServerRpc/Program.cs:58-76 consistent-hash routing;
+RpcServiceMode.cs / FusionBuilder.cs:222-320 mode dispatch): per-call
+routing across a server pool, local fallback, and a serving router
+(gateway) that forwards invalidation pushes end-to-end."""
+import asyncio
+
+import pytest
+
+from stl_fusion_tpu.client import (
+    RoutingComputeProxy,
+    RpcServiceMode,
+    add_fusion_service,
+    install_compute_call_type,
+)
+from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method, invalidating
+from stl_fusion_tpu.rpc import RpcHub, RpcMultiServerTestTransport, consistent_hash_router
+
+
+class ShardService(ComputeService):
+    def __init__(self, hub, shard_name):
+        super().__init__(hub)
+        self.shard_name = shard_name
+        self.values = {}
+        self.calls = 0
+
+    @compute_method
+    async def get(self, key: str) -> str:
+        self.calls += 1
+        return f"{self.shard_name}:{self.values.get(key, 0)}"
+
+    async def set_value(self, key: str, value: int):
+        self.values[key] = value
+        with invalidating():
+            await self.get(key)
+
+
+def make_pool(n_shards=2):
+    """n server hubs, one client hub with a consistent-hash router."""
+    shards, servers = [], {}
+    for i in range(n_shards):
+        fusion = FusionHub()
+        rpc = RpcHub(f"server{i}")
+        install_compute_call_type(rpc)
+        svc = ShardService(fusion, f"shard{i}")
+        rpc.add_service("shards", svc)
+        shards.append(svc)
+        servers[f"shard{i}"] = rpc
+
+    client_fusion = FusionHub()
+    client_rpc = RpcHub("client")
+    install_compute_call_type(client_rpc)
+    client_rpc.call_router = consistent_hash_router(list(servers.keys()))
+    transport = RpcMultiServerTestTransport(client_rpc, servers)
+    return shards, servers, client_fusion, client_rpc, transport
+
+
+def routed_keys(n_shards, want_per_shard=1):
+    """Find keys that the consistent-hash router sends to distinct shards."""
+    router = consistent_hash_router([f"shard{i}" for i in range(n_shards)])
+    found = {}
+    i = 0
+    while len(found) < n_shards and i < 10_000:
+        key = f"key{i}"
+        ref = router("shards", "get", (key,))
+        found.setdefault(ref, key)
+        i += 1
+    return found  # ref -> key
+
+
+async def test_router_mode_routes_by_key_and_memoizes():
+    shards, servers, cf, crpc, _t = make_pool()
+    try:
+        router = add_fusion_service(
+            RpcServiceMode.ROUTER, "shards", crpc, cf
+        )
+        by_ref = routed_keys(2)
+        assert len(by_ref) == 2, "hash router should spread keys over both shards"
+        k0, k1 = by_ref["shard0"], by_ref["shard1"]
+
+        assert (await router.get(k0)).startswith("shard0:")
+        assert (await router.get(k1)).startswith("shard1:")
+        # memoized client-side per shard
+        await router.get(k0)
+        await router.get(k0)
+        assert shards[0].calls == 1
+        assert shards[1].calls == 1
+    finally:
+        await crpc.stop()
+        for s in servers.values():
+            await s.stop()
+
+
+async def test_router_invalidation_pushes_from_owning_shard():
+    shards, servers, cf, crpc, _t = make_pool()
+    try:
+        router = add_fusion_service(RpcServiceMode.ROUTER, "shards", crpc, cf)
+        by_ref = routed_keys(2)
+        k0 = by_ref["shard0"]
+
+        assert await router.get(k0) == "shard0:0"
+        node = await capture(lambda: router.get(k0))
+
+        await shards[0].set_value(k0, 42)
+        await asyncio.wait_for(node.when_invalidated(), 5.0)
+        assert await router.get(k0) == "shard0:42"
+    finally:
+        await crpc.stop()
+        for s in servers.values():
+            await s.stop()
+
+
+async def test_router_local_fallback():
+    local_fusion = FusionHub()
+    local = ShardService(local_fusion, "local")
+    crpc = RpcHub("client")
+    install_compute_call_type(crpc)
+    crpc.call_router = lambda service, method, args: None  # everything local
+    try:
+        router = add_fusion_service(
+            RpcServiceMode.ROUTER, "shards", crpc, local_fusion, local_service=local
+        )
+        assert await router.get("k") == "local:0"
+        assert local.calls == 1
+
+        # no local service + local route = explicit error
+        bare = RoutingComputeProxy("shards", crpc, local_fusion)
+        with pytest.raises(LookupError):
+            await bare.get("k")
+    finally:
+        await crpc.stop()
+
+
+async def test_serving_router_gateway_chains_invalidation():
+    """client → gateway (SERVING_ROUTER) → owning shard; a shard-side
+    write pushes invalidation through the gateway to the end client."""
+    shards, servers, gw_fusion, gw_rpc, _t1 = make_pool()
+    end_fusion = FusionHub()
+    end_rpc = RpcHub("end-client")
+    install_compute_call_type(end_rpc)
+    from stl_fusion_tpu.rpc import RpcTestTransport
+
+    try:
+        # gateway: routes onward by hash AND serves the service itself
+        add_fusion_service(RpcServiceMode.SERVING_ROUTER, "shards", gw_rpc, gw_fusion)
+        _t2 = RpcTestTransport(end_rpc, gw_rpc)
+        end_client = add_fusion_service(RpcServiceMode.CLIENT, "shards", end_rpc, end_fusion)
+
+        by_ref = routed_keys(2)
+        k1 = by_ref["shard1"]
+        assert await end_client.get(k1) == "shard1:0"
+        node = await capture(lambda: end_client.get(k1))
+
+        await shards[1].set_value(k1, 9)
+        await asyncio.wait_for(node.when_invalidated(), 5.0)
+        assert await end_client.get(k1) == "shard1:9"
+    finally:
+        await end_rpc.stop()
+        await gw_rpc.stop()
+        for s in servers.values():
+            await s.stop()
+
+
+async def test_server_and_local_modes():
+    fusion = FusionHub()
+    rpc = RpcHub("s")
+    svc = ShardService(fusion, "s")
+    assert add_fusion_service(RpcServiceMode.LOCAL, "shards", rpc, fusion, local_service=svc) is svc
+    assert (
+        add_fusion_service(RpcServiceMode.SERVER, "shards2", rpc, fusion, local_service=svc) is svc
+    )
+    assert rpc.service_registry.get("shards2") is not None
+    with pytest.raises(ValueError):
+        add_fusion_service(RpcServiceMode.SERVER, "x", rpc, fusion)
+    await rpc.stop()
