@@ -33,8 +33,11 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
-    """Primary path: bit-packed pull-mode kernel — 32 independent waves per
-    pass (ops/pull_wave.py). The work-efficient single-wave kernel
+    """Primary path: bit-packed 32-wave kernel. Default is the hybrid
+    dense/sparse-level kernel (ops/hybrid_wave.py) — dense pull for wide
+    levels, candidate-pull for the near-empty tail levels that dominate
+    wave depth; FUSION_BENCH_KERNEL=pull selects the pure pull kernel
+    (ops/pull_wave.py). The work-efficient single-wave kernel
     (ops/ell_wave.py) serves the low-latency path and is exercised by the
     p50/p99 latency samples below."""
     import jax
@@ -43,14 +46,25 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
 
     from stl_fusion_tpu.graph.synthetic import power_law_dag
     from stl_fusion_tpu.ops.ell_wave import build_ell, build_ell_wave
+    from stl_fusion_tpu.ops.hybrid_wave import build_hybrid_graph, build_hybrid_wave32
     from stl_fusion_tpu.ops.pull_wave import build_pull_graph, build_pull_wave32, seeds_to_bits
 
+    kernel = os.environ.get("FUSION_BENCH_KERNEL", "hybrid")
+    if kernel not in ("hybrid", "pull"):
+        raise SystemExit(f"FUSION_BENCH_KERNEL must be 'hybrid' or 'pull', got {kernel!r}")
     t0 = time.time()
     src, dst = power_law_dag(n_nodes, avg_degree=avg_deg, seed=7)
-    graph = build_pull_graph(src, dst, n_nodes, k=8)
+    if kernel == "hybrid":
+        graph = build_hybrid_graph(src, dst, n_nodes, k_in=4, k_out=8)
+        tail_cap = int(os.environ.get("FUSION_BENCH_TAIL_CAP", 32768))
+    else:
+        graph = build_pull_graph(src, dst, n_nodes, k=8)
     build_s = time.time() - t0
 
-    state0, wave32 = build_pull_wave32(graph)
+    if kernel == "hybrid":
+        state0, wave32 = build_hybrid_wave32(graph, tail_cap=tail_cap)
+    else:
+        state0, wave32 = build_pull_wave32(graph)
     garrays = wave32.garrays  # device-resident; threaded through jit as args
     # (closure-captured graph constants would ride the compile payload —
     # hundreds of MB at 10M nodes — and overflow the remote-compile relay)
@@ -125,6 +139,7 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
     return {
         "total_invalidated": total,
         "elapsed_s": max(elapsed, 1e-9),
+        "kernel": kernel,
         "wave_ms_p50": float(np.percentile(np.asarray(lat) * 1e3, 50)),
         "wave_ms_p99": float(np.percentile(np.asarray(lat) * 1e3, 99)),
         "edges": int(len(src)),

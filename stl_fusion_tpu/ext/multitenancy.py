@@ -127,6 +127,7 @@ class PerTenantWorkerHost:
         self.worker_factory = worker_factory
         self.workers: Dict[str, WorkerBase] = {}
         self._orphans: List[WorkerBase] = []  # removed off-loop; stopped in stop()
+        self._pending_adds: List[Tenant] = []  # added off-loop; started by flush_pending()
         self._started = False
         registry.on_change(self._on_tenant_change)
 
@@ -134,7 +135,15 @@ class PerTenantWorkerHost:
         self._started = True
         for tenant in self.registry.active_tenants:
             self._start_worker(tenant)
+        self.flush_pending()
         return self
+
+    def flush_pending(self) -> None:
+        """Start workers for tenants added from outside the event loop
+        (call from loop context, e.g. a periodic maintenance task)."""
+        pending, self._pending_adds = self._pending_adds, []
+        for tenant in pending:
+            self._start_worker(tenant)
 
     async def stop(self) -> None:
         self._started = False
@@ -145,6 +154,14 @@ class PerTenantWorkerHost:
 
     def _start_worker(self, tenant: Tenant) -> None:
         if tenant.id in self.workers:
+            return
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            # registry mutated off-loop: a worker can't start here — park
+            # the tenant until flush_pending() runs in loop context
+            self._pending_adds.append(tenant)
+            log.warning("tenant %s added off-loop; worker starts at flush_pending()", tenant.id)
             return
         worker = self.worker_factory(tenant)
         self.workers[tenant.id] = worker

@@ -185,8 +185,12 @@ class Streamer(Generic[T]):
         self._events.append(event)
         try:
             while True:
-                pos = max(pos, self._base)  # trimmed past us: skip forward
-                while pos < self._base + len(self._backlog):
+                while True:
+                    # re-clamp EVERY iteration: the producer may trim while
+                    # this reader's consumer is suspended at the yield
+                    pos = max(pos, self._base)
+                    if pos >= self._base + len(self._backlog):
+                        break
                     item = self._backlog[pos - self._base]
                     pos += 1
                     yield item

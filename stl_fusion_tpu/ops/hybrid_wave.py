@@ -1,0 +1,241 @@
+"""Hybrid 32-wave kernel: dense pull for big levels, sparse pull for the tail.
+
+Why: the pure pull kernel (pull_wave.py) costs O(n·k) gathers EVERY level.
+Measured on the bench DAG class, a full cascade runs ~6 wide levels and then
+a long tail of near-empty ones — half the levels carry <0.1% of the work but
+each still pays the full-graph gather. This kernel switches per level:
+
+- **dense level** (frontier words > tail_cap): one `frontier[eff_in]`
+  gather over all rows — the pull kernel, but with the epoch-liveness test
+  FOLDED into the index table once per batch (`eff_in` redirects dead edges
+  to the null row), removing the per-level `live` load and select.
+- **sparse level** (≤ tail_cap active words): the next frontier can only
+  appear on out-neighbors of active nodes, so: gather the active rows'
+  out-slots (candidates), pull each candidate's in-row, OR, and scatter the
+  new words back. Cost O(active · (k_out + k_in)) instead of O(n·k).
+  Scatters use plain `set`: duplicate candidates compute identical values
+  (a pull depends only on the candidate), so drops are benign.
+
+Graph form: ONE augmented node space shared by both directions.
+`build_hybrid_graph` first bounds out-degree at k_out with virtual
+forwarding trees (hubs fan out over log_{k_out} levels — build_ell), then
+bounds in-degree at k_in with virtual OR-collector trees (symmetric pass on
+the dst side), then packs in-ELL and out-ELL from the SAME final edge list
+— so dense and sparse levels traverse the identical graph and can alternate
+freely (a hub firing late re-widens the frontier; the level switch handles
+it). Reference semantics preserved: versioned edges (per-slot epoch vs row
+epoch), invalidation idempotent/monotone (Computed.cs:162-230).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Tuple
+
+import numpy as np
+
+from .ell_wave import build_ell
+
+__all__ = [
+    "HybridGraph",
+    "HybridGraphArrays",
+    "HybridState",
+    "build_hybrid_graph",
+    "hybrid_graph_arrays",
+    "hybrid_init_state",
+    "build_hybrid_wave32",
+]
+
+
+class HybridGraph(NamedTuple):
+    """Host-built dual-ELL graph over one augmented node space."""
+
+    in_src: np.ndarray  # int32[n_tot+1, k_in] — row d's in-neighbors; pad n_tot
+    in_epoch: np.ndarray  # int32[n_tot+1, k_in] — captured epochs; pad -1
+    out_dst: np.ndarray  # int32[n_tot+1, k_out] — row s's out-neighbors; pad n_tot
+    is_real: np.ndarray  # bool[n_tot+1]
+    n_real: int
+    n_tot: int
+    k_in: int
+    k_out: int
+
+
+def _bound_in_degree(
+    src: np.ndarray, dst: np.ndarray, n_start: int, k_in: int
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Layered in-collector construction: while any dst row exceeds k_in,
+    chunk its in-edges under fresh virtual OR-collectors (in-degree ≤ k_in,
+    out-degree 1). Mirrors build_ell's out-side loop, but keeps the edge
+    LIST so both ELLs can pack from the final graph."""
+    next_id = n_start
+    cur_src, cur_dst = src.astype(np.int64), dst.astype(np.int64)
+    final_src: List[np.ndarray] = []
+    final_dst: List[np.ndarray] = []
+    while len(cur_dst):
+        order = np.argsort(cur_dst, kind="stable")
+        s, d = cur_src[order], cur_dst[order]
+        uniq, starts, counts = np.unique(d, return_index=True, return_counts=True)
+        rank = np.arange(len(d)) - np.repeat(starts, counts)
+        deg = np.repeat(counts, counts)
+        small = deg <= k_in
+        final_src.append(s[small])
+        final_dst.append(d[small])
+        bs, bd, brank = s[~small], d[~small], rank[~small]
+        if len(bs) == 0:
+            break
+        chunk = brank // k_in
+        key = bd * (chunk.max() + 1) + chunk
+        _, grp_first, grp_inv = np.unique(key, return_index=True, return_inverse=True)
+        n_virtual = len(grp_first)
+        virtual_ids = next_id + np.arange(n_virtual)
+        next_id += n_virtual
+        # source → collector (≤ k_in per collector by chunking)
+        final_src.append(bs)
+        final_dst.append(virtual_ids[grp_inv])
+        # next round: collector → original dst (collectors may still exceed k_in)
+        cur_src = virtual_ids
+        cur_dst = bd[grp_first]
+    return np.concatenate(final_src), np.concatenate(final_dst), next_id
+
+
+def build_hybrid_graph(
+    src: np.ndarray, dst: np.ndarray, n_nodes: int, k_in: int = 4, k_out: int = 8
+) -> HybridGraph:
+    # pass 1: bound out-degree with forwarding trees (build_ell's loop);
+    # its augmented edge list is (row → ell_dst slot) pairs
+    out_ell = build_ell(src, dst, n_nodes, k=k_out)
+    rows = np.repeat(np.arange(out_ell.n_tot + 1), out_ell.k)
+    targets = out_ell.ell_dst.reshape(-1).astype(np.int64)
+    valid = targets < out_ell.n_tot
+    aug_src, aug_dst = rows[valid], targets[valid]
+
+    # pass 2: bound in-degree with OR-collector trees on the same list
+    aug_src, aug_dst, n_tot = _bound_in_degree(aug_src, aug_dst, out_ell.n_tot, k_in)
+
+    def pack(rows_of: np.ndarray, vals_of: np.ndarray, k: int) -> np.ndarray:
+        table = np.full((n_tot + 1, k), n_tot, dtype=np.int32)
+        order = np.argsort(rows_of, kind="stable")
+        r, v = rows_of[order], vals_of[order]
+        uniq, starts, counts = np.unique(r, return_index=True, return_counts=True)
+        slot = np.arange(len(r)) - np.repeat(starts, counts)
+        assert slot.max() < k if len(slot) else True, "degree bound failed"
+        table[r, slot] = v
+        return table
+
+    in_src = pack(aug_dst, aug_src, k_in)
+    out_dst = pack(aug_src, aug_dst, k_out)
+    in_epoch = np.where(in_src < n_tot, 0, -1).astype(np.int32)
+    is_real = np.zeros(n_tot + 1, dtype=bool)
+    is_real[:n_nodes] = True
+    return HybridGraph(in_src, in_epoch, out_dst, is_real, n_nodes, n_tot, k_in, k_out)
+
+
+class HybridGraphArrays(NamedTuple):
+    in_src: "object"
+    in_epoch: "object"
+    out_dst: "object"
+    is_real: "object"
+
+
+class HybridState(NamedTuple):
+    node_epoch: "object"  # int32[n_tot+1]
+    invalid_bits: "object"  # int32[n_tot+1]
+
+
+def hybrid_graph_arrays(graph: HybridGraph) -> HybridGraphArrays:
+    import jax.numpy as jnp
+
+    return HybridGraphArrays(
+        in_src=jnp.asarray(graph.in_src),
+        in_epoch=jnp.asarray(graph.in_epoch),
+        out_dst=jnp.asarray(graph.out_dst),
+        is_real=jnp.asarray(graph.is_real),
+    )
+
+
+def hybrid_init_state(n_tot: int) -> HybridState:
+    import jax.numpy as jnp
+
+    return HybridState(
+        jnp.zeros(n_tot + 1, dtype=jnp.int32).at[n_tot].set(-2),
+        jnp.zeros(n_tot + 1, dtype=jnp.int32),
+    )
+
+
+def _hybrid_wave32_impl(tail_cap: int, garrays: HybridGraphArrays, seed_bits, state: HybridState):
+    import jax.numpy as jnp
+    from jax import lax
+
+    in_src, in_epoch, out_dst, is_real = garrays
+    n_tot = in_src.shape[0] - 1
+    k_in = in_src.shape[1]
+    k_out = out_dst.shape[1]
+
+    node_epoch, invalid = state.node_epoch, state.invalid_bits
+    invalid_before = invalid
+    # fold liveness into the index table once per batch: dead edges (epoch
+    # mismatch) point at the null row, whose frontier word is always 0
+    eff_in = jnp.where(in_epoch == node_epoch[:, None], in_src, n_tot)
+
+    frontier = (seed_bits & ~invalid).at[n_tot].set(0)
+    invalid = invalid | frontier
+
+    def or_fold(mat):
+        acc = mat[:, 0]
+        for j in range(1, mat.shape[1]):
+            acc = acc | mat[:, j]
+        return acc
+
+    def dense_level(frontier, invalid):
+        fire = or_fold(frontier[eff_in])
+        fire = (fire & ~invalid).at[n_tot].set(0)
+        return fire, invalid | fire
+
+    def sparse_level(frontier, invalid):
+        (active,) = jnp.nonzero(frontier, size=tail_cap, fill_value=n_tot)
+        cand = out_dst[active].reshape(-1)  # (tail_cap * k_out,)
+        fire = or_fold(frontier[eff_in[cand]])
+        fire = fire & ~invalid[cand]
+        fire = jnp.where(cand < n_tot, fire, 0)
+        # duplicate candidates carry identical values → set-with-drop is safe
+        invalid = invalid.at[cand].set(invalid[cand] | fire, mode="drop")
+        frontier = jnp.zeros_like(frontier).at[cand].set(fire, mode="drop")
+        return frontier, invalid
+
+    def cond(carry):
+        _f, _inv, go = carry
+        return go
+
+    def body(carry):
+        frontier, invalid, _go = carry
+        n_active = (frontier != 0).sum(dtype=jnp.int32)
+        frontier, invalid = lax.cond(
+            n_active <= tail_cap, sparse_level, dense_level, frontier, invalid
+        )
+        return frontier, invalid, (frontier != 0).any()
+
+    _f, invalid, _go = lax.while_loop(cond, body, (frontier, invalid, (frontier != 0).any()))
+    newly = lax.population_count(jnp.where(is_real, invalid & ~invalid_before, 0))
+    return HybridState(node_epoch, invalid), newly.sum(dtype=jnp.int32)
+
+
+@functools.lru_cache(maxsize=4)
+def hybrid_wave32_step(tail_cap: int = 8192):
+    """Jitted hybrid kernel: ``step(garrays, seed_bits, state)``; graph
+    arrays are runtime args (see pull_wave.py on compile payloads)."""
+    import jax
+
+    return jax.jit(functools.partial(_hybrid_wave32_impl, tail_cap))
+
+
+def build_hybrid_wave32(graph: HybridGraph, tail_cap: int = 8192):
+    """(state0, wave32) for one graph; same contract as build_pull_wave32."""
+    garrays = hybrid_graph_arrays(graph)
+    step = hybrid_wave32_step(tail_cap)
+
+    def wave32(seed_bits, state):
+        return step(garrays, seed_bits, state)
+
+    wave32.garrays = garrays
+    wave32.step = step
+    wave32.impl = functools.partial(_hybrid_wave32_impl, tail_cap)
+    return hybrid_init_state(graph.n_tot), wave32

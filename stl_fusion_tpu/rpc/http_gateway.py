@@ -163,18 +163,30 @@ class RestClient:
             path += "?args=" + urllib.parse.quote(json.dumps(args))
         else:
             body = json.dumps(args).encode()
-        reader, writer = await asyncio.open_connection(self.host, self.port)
         try:
-            writer.write(
-                f"{http_method} {path} HTTP/1.1\r\nHost: {self.host}\r\n"
-                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode() + body
-            )
-            await writer.drain()
-            raw = await reader.read()
-        finally:
-            writer.close()
-        _headers, _, payload = raw.partition(b"\r\n\r\n")
-        response = json.loads(payload.decode())
+            reader, writer = await asyncio.open_connection(self.host, self.port)
+            try:
+                writer.write(
+                    f"{http_method} {path} HTTP/1.1\r\nHost: {self.host}\r\n"
+                    f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode() + body
+                )
+                await writer.drain()
+                raw = await reader.read()
+            finally:
+                writer.close()
+        except OSError as e:
+            # refused/reset/aborted — one uniform error type for callers
+            raise RestError("BadResponse", f"connection failed: {e}") from None
+        headers, _, payload = raw.partition(b"\r\n\r\n")
+        status_line = headers.split(b"\r\n", 1)[0].decode("latin1", "replace")
+        if not payload:
+            # server closed without a body (request never parsed, handler
+            # crashed before write) — surface as RestError, not a JSON error
+            raise RestError("BadResponse", f"empty response ({status_line or 'no status'})")
+        try:
+            response = json.loads(payload.decode())
+        except ValueError as e:
+            raise RestError("BadResponse", f"{status_line}: {e}") from None
         if "error" in response:
             raise RestError(response["error"]["type"], response["error"]["message"])
         return response["ok"]

@@ -301,3 +301,65 @@ class TestReviewFixes:
         assert worker.is_running  # parked as orphan, not leaked silently
         await host.stop()
         assert not worker.is_running
+
+    async def test_streamer_trim_while_reader_suspended_mid_batch(self):
+        broker = InMemoryBroker()
+        s = Streamer(broker, "midtrim", max_backlog=4)
+        for i in range(4):
+            s.append(i)
+        got = []
+        resume = asyncio.Event()
+
+        async def slow_read():
+            async for item in s.read(from_start=True):
+                got.append(item)
+                if item == 0:
+                    await resume.wait()  # suspended MID-batch at the yield
+
+        task = asyncio.ensure_future(slow_read())
+        await asyncio.sleep(0.01)
+        assert got == [0]
+        for i in range(4, 30):
+            s.append(i)  # trims far past the reader's position
+        s.complete()
+        resume.set()
+        await asyncio.wait_for(task, 1.0)
+        assert got == sorted(got)  # in order, no negative-index replays
+        assert got[-1] == 29
+        s.close()
+
+    async def test_tenant_added_off_loop_starts_via_flush_pending(self):
+        import threading
+
+        from stl_fusion_tpu.ext import PerTenantWorkerHost, Tenant, TenantRegistry
+        from stl_fusion_tpu.utils import WorkerBase
+
+        class W(WorkerBase):
+            def __init__(self, tenant):
+                super().__init__(name=f"w-{tenant.id}")
+
+            async def on_run(self):
+                await asyncio.Event().wait()
+
+        reg = TenantRegistry(single_tenant=False)
+        host = PerTenantWorkerHost(reg, W).start()
+        t = threading.Thread(target=lambda: reg.add(Tenant("late")))
+        t.start()
+        t.join()
+        assert "late" not in host.workers  # couldn't start off-loop...
+        host.flush_pending()
+        assert host.workers["late"].is_running  # ...starts once on-loop
+        await host.stop()
+
+    async def test_rest_client_empty_response_is_rest_error(self):
+        async def close_immediately(reader, writer):
+            writer.close()
+
+        server = await asyncio.start_server(close_immediately, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            with pytest.raises(RestError, match="BadResponse"):
+                await RestClient(f"http://127.0.0.1:{port}", "svc").anything()
+        finally:
+            server.close()
+            await server.wait_closed()
