@@ -143,10 +143,14 @@ class PerTenantWorkerHost:
         (call from loop context, e.g. a periodic maintenance task)."""
         pending, self._pending_adds = self._pending_adds, []
         for tenant in pending:
-            self._start_worker(tenant)
+            # re-check against the registry: the tenant may have been
+            # removed (or the host stopped) since it was parked
+            if self._started and self.registry.try_get(tenant.id) is not None:
+                self._start_worker(tenant)
 
     async def stop(self) -> None:
         self._started = False
+        self._pending_adds.clear()
         workers, self.workers = list(self.workers.values()), {}
         orphans, self._orphans = self._orphans, []
         for w in workers + orphans:
@@ -173,6 +177,7 @@ class PerTenantWorkerHost:
         if change == "added" and tenant.is_active:
             self._start_worker(tenant)
         elif change == "removed":
+            self._pending_adds = [t for t in self._pending_adds if t.id != tenant.id]
             worker = self.workers.pop(tenant.id, None)
             if worker is None:
                 return

@@ -1,0 +1,111 @@
+"""Native runtime components (C++ via ctypes).
+
+The performance-critical host-side pieces of the framework — where the
+reference leans on the .NET runtime's optimized primitives, this build uses
+C++ compiled on first use (g++ is in the image; no pip/pybind needed):
+
+- ``graphpack``: the dual-ELL graph packer feeding the hybrid invalidation
+  kernel (counting-sort degree bounding; ~10x the numpy path at 10M nodes).
+
+Every native entry point has a numpy fallback — ``load_graphpack()``
+returning None means "use the Python path", never a hard failure.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["load_graphpack", "native_build_hybrid_tables"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "graphpack.cpp")
+_LIB = os.path.join(_DIR, "_graphpack.so")
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _compile() -> bool:
+    # no -march=native: a cached .so must run on any host this package is
+    # copied to (counting sorts are memory-bound; vector ISA gains nothing)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC]
+    try:
+        result = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("graphpack native compile unavailable: %s", e)
+        return False
+    if result.returncode != 0:
+        log.warning("graphpack native compile failed:\n%s", result.stderr[-2000:])
+        return False
+    return True
+
+
+def load_graphpack():
+    """The ctypes lib, compiling on first use; None → use the numpy path."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _compile():
+                _lib_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            log.warning("graphpack load failed: %s", e)
+            _lib_failed = True
+            return None
+        lib.gp_build_hybrid.restype = ctypes.c_void_p
+        lib.gp_build_hybrid.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.gp_n_tot.restype = ctypes.c_int64
+        lib.gp_n_tot.argtypes = [ctypes.c_void_p]
+        lib.gp_n_edges.restype = ctypes.c_int64
+        lib.gp_n_edges.argtypes = [ctypes.c_void_p]
+        lib.gp_fill.restype = ctypes.c_int32
+        lib.gp_fill.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.gp_free.restype = None
+        lib.gp_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_build_hybrid_tables(src, dst, n_nodes: int, k_in: int, k_out: int):
+    """(in_src, out_dst, n_tot) via the native packer, or None → fallback."""
+    import numpy as np
+
+    lib = load_graphpack()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(src, dtype=np.int32)
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    handle = lib.gp_build_hybrid(
+        src.ctypes.data_as(ctypes.c_void_p),
+        dst.ctypes.data_as(ctypes.c_void_p),
+        len(src), n_nodes, k_in, k_out,
+    )
+    try:
+        n_tot = lib.gp_n_tot(handle)
+        in_src = np.empty((n_tot + 1, k_in), dtype=np.int32)
+        out_dst = np.empty((n_tot + 1, k_out), dtype=np.int32)
+        rc = lib.gp_fill(
+            handle,
+            in_src.ctypes.data_as(ctypes.c_void_p),
+            out_dst.ctypes.data_as(ctypes.c_void_p),
+        )
+        if rc != 0:
+            log.error("graphpack degree bound violated (rc=%d); using numpy path", rc)
+            return None
+        return in_src, out_dst, int(n_tot)
+    finally:
+        lib.gp_free(handle)

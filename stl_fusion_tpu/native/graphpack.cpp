@@ -1,0 +1,154 @@
+// graphpack — native dual-ELL graph packer for the hybrid invalidation kernel.
+//
+// C++ counterpart of stl_fusion_tpu/ops/hybrid_wave.py::build_hybrid_graph
+// (which is itself the TPU-shaped replacement for the reference's
+// ComputedRegistry edge store — SURVEY §2.1). The Python/numpy path costs
+// multiple argsort+unique passes over the 30M-edge list; this packer uses
+// counting sorts (O(E+N) per round) and runs the whole two-phase
+// degree-bounding + table-packing pipeline in a few hundred ms at 10M nodes.
+//
+// Pipeline (identical contract to the numpy path; virtual-id NUMBERING may
+// differ, reachability semantics are equal — tests cross-check both):
+//   phase 1: bound OUT-degree at k_out with virtual forwarding trees
+//            (hub fan-out spread over log_k levels)
+//   phase 2: bound IN-degree at k_in with virtual OR-collector trees
+//   phase 3: pack in-ELL (n_tot+1, k_in) and out-ELL (n_tot+1, k_out),
+//            pad slots pointing at the null row n_tot.
+//
+// C ABI (ctypes): gp_build_hybrid / gp_n_tot / gp_fill / gp_free.
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct EdgeList {
+  std::vector<int64_t> src;
+  std::vector<int64_t> dst;
+};
+
+struct Handle {
+  int64_t n_tot = 0;
+  int k_in = 0, k_out = 0;
+  EdgeList edges;  // final bounded edge list
+};
+
+// Group edge indices by key (counting sort). offsets has n_keys+1 entries.
+void group_by(const std::vector<int64_t>& key, int64_t n_keys,
+              std::vector<int64_t>& order, std::vector<int64_t>& offsets) {
+  offsets.assign(static_cast<size_t>(n_keys) + 1, 0);
+  for (int64_t k : key) offsets[static_cast<size_t>(k) + 1]++;
+  for (int64_t i = 0; i < n_keys; i++) offsets[i + 1] += offsets[i];
+  order.resize(key.size());
+  std::vector<int64_t> cursor(offsets.begin(), offsets.end() - 1);
+  for (int64_t e = 0; e < static_cast<int64_t>(key.size()); e++)
+    order[cursor[key[e]]++] = e;
+}
+
+// Bound the degree of one side by layered chunking under fresh virtual ids.
+// bound_src=true bounds out-degree (forwarding trees): big source s with
+// targets {d_i} emits final (virtual_j -> d_i) chunks and requeues
+// (s -> virtual_j). bound_src=false bounds in-degree (collector trees): big
+// dest x with sources {s_i} emits final (s_i -> collector_j) chunks and
+// requeues (collector_j -> x).
+void bound_degree(EdgeList& cur, int64_t& n_tot, int k, bool bound_src,
+                  EdgeList& out_final) {
+  std::vector<int64_t> order, offsets;
+  while (!cur.src.empty()) {
+    const std::vector<int64_t>& key = bound_src ? cur.src : cur.dst;
+    // snapshot the id space: n_tot grows as chunks mint virtual ids, but
+    // this round's groups (and offsets) only cover ids < n_before
+    const int64_t n_before = n_tot;
+    group_by(key, n_before, order, offsets);
+    EdgeList next;
+    for (int64_t g = 0; g < n_before; g++) {
+      int64_t begin = offsets[g], end = offsets[g + 1];
+      int64_t deg = end - begin;
+      if (deg == 0) continue;
+      if (deg <= k) {
+        for (int64_t i = begin; i < end; i++) {
+          int64_t e = order[i];
+          out_final.src.push_back(cur.src[e]);
+          out_final.dst.push_back(cur.dst[e]);
+        }
+        continue;
+      }
+      // chunk under virtual ids
+      for (int64_t off = begin; off < end; off += k) {
+        int64_t v = n_tot++;
+        int64_t stop = off + k < end ? off + k : end;
+        for (int64_t i = off; i < stop; i++) {
+          int64_t e = order[i];
+          if (bound_src) {
+            out_final.src.push_back(v);          // virtual -> target (≤ k out)
+            out_final.dst.push_back(cur.dst[e]);
+          } else {
+            out_final.src.push_back(cur.src[e]);  // source -> collector (≤ k in)
+            out_final.dst.push_back(v);
+          }
+        }
+        if (bound_src) {
+          next.src.push_back(g);  // s -> virtual, rebound next round
+          next.dst.push_back(v);
+        } else {
+          next.src.push_back(v);  // collector -> x, rebound next round
+          next.dst.push_back(g);
+        }
+      }
+    }
+    cur = std::move(next);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* gp_build_hybrid(const int32_t* src, const int32_t* dst, int64_t m,
+                      int64_t n_nodes, int k_in, int k_out) {
+  Handle* h = new Handle();
+  h->k_in = k_in;
+  h->k_out = k_out;
+  h->n_tot = n_nodes;
+
+  EdgeList cur;
+  cur.src.assign(src, src + m);
+  cur.dst.assign(dst, dst + m);
+
+  EdgeList after_out;
+  bound_degree(cur, h->n_tot, k_out, /*bound_src=*/true, after_out);
+  bound_degree(after_out, h->n_tot, k_in, /*bound_src=*/false, h->edges);
+  return h;
+}
+
+int64_t gp_n_tot(void* handle) { return static_cast<Handle*>(handle)->n_tot; }
+
+int64_t gp_n_edges(void* handle) {
+  return static_cast<int64_t>(static_cast<Handle*>(handle)->edges.src.size());
+}
+
+// Fill caller-allocated tables: in_src[(n_tot+1)*k_in], out_dst[(n_tot+1)*k_out].
+// Returns 0 on success, -1 if a degree bound was violated (internal bug).
+int32_t gp_fill(void* handle, int32_t* in_src, int32_t* out_dst) {
+  Handle* h = static_cast<Handle*>(handle);
+  const int64_t n_tot = h->n_tot;
+  const int64_t rows = n_tot + 1;
+  const int32_t pad = static_cast<int32_t>(n_tot);
+  std::fill(in_src, in_src + rows * h->k_in, pad);
+  std::fill(out_dst, out_dst + rows * h->k_out, pad);
+
+  std::vector<int32_t> in_slot(static_cast<size_t>(rows), 0);
+  std::vector<int32_t> out_slot(static_cast<size_t>(rows), 0);
+  const size_t m = h->edges.src.size();
+  for (size_t e = 0; e < m; e++) {
+    int64_t s = h->edges.src[e], d = h->edges.dst[e];
+    if (out_slot[s] >= h->k_out || in_slot[d] >= h->k_in) return -1;
+    out_dst[s * h->k_out + out_slot[s]++] = static_cast<int32_t>(d);
+    in_src[d * h->k_in + in_slot[d]++] = static_cast<int32_t>(s);
+  }
+  return 0;
+}
+
+void gp_free(void* handle) { delete static_cast<Handle*>(handle); }
+
+}  // extern "C"

@@ -98,8 +98,25 @@ def _bound_in_degree(
 
 
 def build_hybrid_graph(
-    src: np.ndarray, dst: np.ndarray, n_nodes: int, k_in: int = 4, k_out: int = 8
+    src: np.ndarray,
+    dst: np.ndarray,
+    n_nodes: int,
+    k_in: int = 4,
+    k_out: int = 8,
+    use_native: bool = True,
 ) -> HybridGraph:
+    if use_native:
+        from ..native import native_build_hybrid_tables
+
+        tables = native_build_hybrid_tables(src, dst, n_nodes, k_in, k_out)
+        if tables is not None:
+            in_src, out_dst, n_tot = tables
+            in_epoch = np.where(in_src < n_tot, 0, -1).astype(np.int32)
+            is_real = np.zeros(n_tot + 1, dtype=bool)
+            is_real[:n_nodes] = True
+            return HybridGraph(in_src, in_epoch, out_dst, is_real, n_nodes, n_tot, k_in, k_out)
+
+    # numpy fallback path
     # pass 1: bound out-degree with forwarding trees (build_ell's loop);
     # its augmented edge list is (row → ell_dst slot) pairs
     out_ell = build_ell(src, dst, n_nodes, k=k_out)

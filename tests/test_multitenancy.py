@@ -150,3 +150,35 @@ async def test_tenant_isolated_cross_host_invalidation():
     finally:
         for r in list(readers_a.values()) + list(readers_b.values()):
             await r.stop()
+
+
+async def test_pending_add_cancelled_by_removal_and_stop():
+    import threading
+
+    from stl_fusion_tpu.utils import WorkerBase
+
+    class W(WorkerBase):
+        def __init__(self, tenant):
+            super().__init__(name=f"w-{tenant.id}")
+
+        async def on_run(self):
+            import asyncio as _a
+
+            await _a.Event().wait()
+
+    reg = TenantRegistry(single_tenant=False)
+    host = PerTenantWorkerHost(reg, W).start()
+    # off-loop add then remove before any flush: must not start a worker
+    t = threading.Thread(target=lambda: (reg.add(Tenant("ghost")), reg.remove("ghost")))
+    t.start()
+    t.join()
+    host.flush_pending()
+    assert "ghost" not in host.workers
+
+    # off-loop add, then host stops: a later flush must not resurrect it
+    t2 = threading.Thread(target=lambda: reg.add(Tenant("late2")))
+    t2.start()
+    t2.join()
+    await host.stop()
+    host.flush_pending()
+    assert not host.workers
