@@ -57,6 +57,36 @@ PRIORITY_NESTED_LOGGER = 80
 PRIORITY_POST_COMPLETION_INVALIDATOR = 50
 
 
+class InvalidationInfoProvider:
+    """Decides whether a completed command's invalidation replay should run
+    (≈ Operations/InvalidationInfoProvider.cs:20-46). Replay is skipped when
+    the final handler is bound to a remote proxy (FusionClient /
+    RoutingComputeProxy) — the OWNING host replays and pushes invalidation
+    over RPC, so a local replay would double-invalidate through stale local
+    state — or when the command type opts out via
+    ``__requires_invalidation__ = False``."""
+
+    def __init__(self, commander: "Commander"):
+        self.commander = commander
+
+    def requires_invalidation(self, command: Any) -> bool:
+        if getattr(type(command), "__requires_invalidation__", True) is False:
+            return False
+        try:
+            chain = self.commander.registry.resolve(command)
+        except LookupError:
+            return False
+        final_fn = chain[-1].fn
+        target = getattr(final_fn, "__self__", None)
+        wrapped = getattr(final_fn, "__wrapped__", None)
+        if wrapped is not None:
+            target = getattr(wrapped, "__self__", target)
+        from ..client.client_function import FusionClient
+        from ..client.service_modes import RoutingComputeProxy
+
+        return not isinstance(target, (FusionClient, RoutingComputeProxy))
+
+
 class OperationsHost:
     """Per-hub operations services: agent identity, completion notifier,
     completion listeners (the op-log writer subscribes here too)."""
@@ -65,6 +95,7 @@ class OperationsHost:
         self.commander = commander
         self.agent = AgentInfo()
         self._seen = RecentlySeenMap(capacity=100_000, max_age=600.0)
+        self.invalidation_info = InvalidationInfoProvider(commander)
         #: listeners: async (operation, is_local) — CompletionProducer + op-log
         self.completion_listeners: List[Callable] = [self._completion_producer]
         #: called just before a local operation completes (op-log persistence)
@@ -145,6 +176,8 @@ def attach_operations(commander: "Commander") -> OperationsHost:
     # --------------------------------------------------- PostCompletionInvalidator
     async def post_completion_invalidator(completion: Completion, context: "CommandContext"):
         operation = completion.operation
+        if not commander.operations.invalidation_info.requires_invalidation(operation.command):
+            return await context.invoke_remaining_handlers()
         with invalidating():
             await _replay(commander, operation.command)
             for nested in operation.items:

@@ -65,3 +65,37 @@ class UICommander:
             raise
         finally:
             self.tracker.action_completed(command, error)
+
+
+class UIActionFailureTracker:
+    """Bounded list of recent failed UI actions (≈ UI/UIActionFailureTracker
+    in the reference): UIs bind it to render error toasts/banners; entries
+    clear individually (user dismissed) or wholesale (navigation)."""
+
+    def __init__(self, tracker: UIActionTracker, max_failures: int = 16):
+        self.tracker = tracker
+        self.max_failures = max_failures
+        self.failures: list = []  # (command, error) newest-last
+        self._listeners: list = []
+        tracker.on_completed.append(self._on_completed)
+
+    def _on_completed(self, command, error) -> None:
+        if error is None:
+            return
+        self.failures.append((command, error))
+        del self.failures[: max(0, len(self.failures) - self.max_failures)]
+        for listener in list(self._listeners):
+            listener(command, error)
+
+    def on_failure(self, listener) -> None:
+        self._listeners.append(listener)
+
+    def dismiss(self, index: int) -> None:
+        if 0 <= index < len(self.failures):
+            del self.failures[index]
+
+    def clear(self) -> None:
+        self.failures.clear()
+
+    def __len__(self) -> int:
+        return len(self.failures)
