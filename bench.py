@@ -48,20 +48,29 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
     from stl_fusion_tpu.ops.ell_wave import build_ell, build_ell_wave
     from stl_fusion_tpu.ops.hybrid_wave import build_hybrid_graph, build_hybrid_wave32
     from stl_fusion_tpu.ops.pull_wave import build_pull_graph, build_pull_wave32, seeds_to_bits
+    from stl_fusion_tpu.ops.topo_wave import (
+        build_topo_graph,
+        build_topo_wave32,
+        topo_seeds_to_bits,
+    )
 
-    kernel = os.environ.get("FUSION_BENCH_KERNEL", "hybrid")
-    if kernel not in ("hybrid", "pull"):
-        raise SystemExit(f"FUSION_BENCH_KERNEL must be 'hybrid' or 'pull', got {kernel!r}")
+    kernel = os.environ.get("FUSION_BENCH_KERNEL", "topo")
+    if kernel not in ("topo", "hybrid", "pull"):
+        raise SystemExit(f"FUSION_BENCH_KERNEL must be 'topo', 'hybrid' or 'pull', got {kernel!r}")
     t0 = time.time()
     src, dst = power_law_dag(n_nodes, avg_degree=avg_deg, seed=7)
-    if kernel == "hybrid":
+    if kernel == "topo":
+        graph = build_topo_graph(src, dst, n_nodes, k=4)
+    elif kernel == "hybrid":
         graph = build_hybrid_graph(src, dst, n_nodes, k_in=4, k_out=8)
         tail_cap = int(os.environ.get("FUSION_BENCH_TAIL_CAP", 32768))
     else:
         graph = build_pull_graph(src, dst, n_nodes, k=8)
     build_s = time.time() - t0
 
-    if kernel == "hybrid":
+    if kernel == "topo":
+        state0, wave32 = build_topo_wave32(graph)
+    elif kernel == "hybrid":
         state0, wave32 = build_hybrid_wave32(graph, tail_cap=tail_cap)
     else:
         state0, wave32 = build_pull_wave32(graph)
@@ -69,10 +78,15 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
     # (closure-captured graph constants would ride the compile payload —
     # hundreds of MB at 10M nodes — and overflow the remote-compile relay)
     n_batches = max(n_waves // 32, 1)
+
+    def make_seed_bits(seed_lists):
+        if kernel == "topo":
+            return topo_seeds_to_bits(graph, seed_lists)
+        return seeds_to_bits(graph.n_tot, seed_lists)
+
     seed_mats = np.stack(
         [
-            seeds_to_bits(
-                graph.n_tot,
+            make_seed_bits(
                 [rng.choice(n_nodes, size=seeds_per_wave, replace=False) for _ in range(32)],
             )
             for _ in range(n_batches)
@@ -144,6 +158,7 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
         "wave_ms_p99": float(np.percentile(np.asarray(lat) * 1e3, 99)),
         "edges": int(len(src)),
         "virtual_nodes": graph.n_tot - graph.n_real,
+        "levels": len(graph.level_starts) - 1 if kernel == "topo" else None,
         "graph_build_s": round(build_s, 2),
         "compile_s": round(compile_s, 2),
         "sync_overhead_ms": round(sync_overhead * 1e3, 1),

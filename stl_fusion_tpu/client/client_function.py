@@ -227,6 +227,7 @@ class FusionClient:
             return await function.invoke_and_strip(input, used_by, context)
 
         call.__name__ = method
+        call.__fusion_remote_proxy__ = self  # invalidation replay is the owner's job
         return call
 
 

@@ -84,6 +84,7 @@ class RoutingComputeProxy:
             return await getattr(self.client_for(ref), method)(*args)
 
         call.__name__ = method
+        call.__fusion_remote_proxy__ = self  # invalidation replay is the owner's job
         return call
 
     def __repr__(self) -> str:

@@ -19,6 +19,7 @@ from typing import Callable, List, Optional
 from ..utils.ltag import LTagVersionGenerator, VersionGenerator
 from ..utils.moment import MomentClockSet
 from .registry import ComputedRegistry
+from .settings import settings
 from .timeouts import Timeouts
 
 log = logging.getLogger("stl_fusion_tpu")
@@ -31,11 +32,13 @@ class FusionHub:
         self,
         clocks: Optional[MomentClockSet] = None,
         version_generator: Optional[VersionGenerator] = None,
-        timer_quanta: float = 0.05,
+        timer_quanta: Optional[float] = None,
     ):
         self.clocks = clocks or MomentClockSet()
         self.version_generator = version_generator or LTagVersionGenerator()
         self.registry = ComputedRegistry()
+        if timer_quanta is None:
+            timer_quanta = settings.timer_quanta
         self.timeouts = Timeouts(self.clocks.cpu, quanta=timer_quanta)
         #: hooks feeding the device CSR mirror + diagnostics
         self.invalidated_hooks: List[Callable] = []

@@ -62,8 +62,10 @@ class FusionSettings:
 
     @property
     def timer_quanta(self) -> float:
-        """Shared timer-wheel tick (the reference uses 0.2s quanta)."""
-        return 0.2
+        """Shared timer-wheel tick. The reference uses 0.2s quanta
+        (Internal/Timeouts.cs); this build defaults finer — asyncio timers
+        are cheap and sub-100ms invalidation delays are common in tests."""
+        return 0.05
 
     @property
     def timer_concurrency(self) -> int:

@@ -20,7 +20,7 @@ import threading
 
 log = logging.getLogger("stl_fusion_tpu")
 
-__all__ = ["load_graphpack", "native_build_hybrid_tables"]
+__all__ = ["load_graphpack", "native_build_hybrid_tables", "native_topo_levels"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "graphpack.cpp")
@@ -63,6 +63,22 @@ def load_graphpack():
             log.warning("graphpack load failed: %s", e)
             _lib_failed = True
             return None
+        if not hasattr(lib, "gp_topo_levels"):
+            # stale cached .so predating newer entry points (mtime ties defeat
+            # the staleness check): rebuild once, else fall back to numpy
+            if not _compile():
+                _lib_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB)
+            except OSError as e:
+                log.warning("graphpack reload failed: %s", e)
+                _lib_failed = True
+                return None
+            if not hasattr(lib, "gp_topo_levels"):
+                log.warning("graphpack .so lacks gp_topo_levels after rebuild; numpy path")
+                _lib_failed = True
+                return None
         lib.gp_build_hybrid.restype = ctypes.c_void_p
         lib.gp_build_hybrid.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
@@ -76,8 +92,35 @@ def load_graphpack():
         lib.gp_fill.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
         lib.gp_free.restype = None
         lib.gp_free.argtypes = [ctypes.c_void_p]
+        lib.gp_topo_levels.restype = ctypes.c_int32
+        lib.gp_topo_levels.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+        ]
         _lib = lib
         return _lib
+
+
+def native_topo_levels(in_src, n: int, k: int):
+    """Kahn longest-path levels over a packed in-ELL table, or None → fallback.
+
+    ``in_src`` is int32[(n+1), k] (row d's in-neighbors, entries >= n are
+    pads); returns int32[n] with level[d] = 1 + max(level of in-neighbors).
+    """
+    import numpy as np
+
+    lib = load_graphpack()
+    if lib is None:
+        return None
+    in_src = np.ascontiguousarray(in_src, dtype=np.int32)
+    level = np.empty(n, dtype=np.int32)
+    rc = lib.gp_topo_levels(
+        in_src.ctypes.data_as(ctypes.c_void_p), n, k,
+        level.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        log.error("gp_topo_levels found a cycle (rc=%d); using numpy path", rc)
+        return None
+    return level
 
 
 def native_build_hybrid_tables(src, dst, n_nodes: int, k_in: int, k_out: int):

@@ -151,4 +151,57 @@ int32_t gp_fill(void* handle, int32_t* in_src, int32_t* out_dst) {
 
 void gp_free(void* handle) { delete static_cast<Handle*>(handle); }
 
+// Topological longest-path levels over a packed in-ELL table (Kahn sweep).
+//
+// in_src: int32[(n+1) * k] — row d's in-neighbors; entries >= n are pads.
+// level (out): int32[n] — level[d] = 0 for source rows, else
+//              1 + max(level of in-neighbors). Returns 0 on success,
+//              -1 if the table contains a cycle (caller falls back).
+//
+// This feeds the topo-sweep invalidation kernel (ops/topo_wave.py): nodes
+// renumbered in level order make the whole 32-wave cascade a single pass
+// over the edge table instead of one full-graph gather per BFS level.
+int32_t gp_topo_levels(const int32_t* in_src, int64_t n, int32_t k,
+                       int32_t* level) {
+  // out-adjacency via counting sort: edge (p -> d) per live entry
+  std::vector<int64_t> off(static_cast<size_t>(n) + 1, 0);
+  std::vector<int32_t> indeg(static_cast<size_t>(n), 0);
+  for (int64_t d = 0; d < n; d++) {
+    for (int32_t j = 0; j < k; j++) {
+      int32_t p = in_src[d * k + j];
+      if (p >= 0 && p < n) {
+        off[static_cast<size_t>(p) + 1]++;
+        indeg[d]++;
+      }
+    }
+  }
+  for (int64_t i = 0; i < n; i++) off[i + 1] += off[i];
+  std::vector<int32_t> child(static_cast<size_t>(off[n]));
+  {
+    std::vector<int64_t> cursor(off.begin(), off.end() - 1);
+    for (int64_t d = 0; d < n; d++)
+      for (int32_t j = 0; j < k; j++) {
+        int32_t p = in_src[d * k + j];
+        if (p >= 0 && p < n) child[cursor[p]++] = static_cast<int32_t>(d);
+      }
+  }
+  std::vector<int32_t> queue;
+  queue.reserve(static_cast<size_t>(n));
+  for (int64_t d = 0; d < n; d++) {
+    level[d] = 0;
+    if (indeg[d] == 0) queue.push_back(static_cast<int32_t>(d));
+  }
+  size_t head = 0;
+  while (head < queue.size()) {
+    int32_t u = queue[head++];
+    int32_t lu = level[u];
+    for (int64_t e = off[u]; e < off[u + 1]; e++) {
+      int32_t d = child[e];
+      if (level[d] < lu + 1) level[d] = lu + 1;
+      if (--indeg[d] == 0) queue.push_back(d);
+    }
+  }
+  return head == static_cast<size_t>(n) ? 0 : -1;
+}
+
 }  // extern "C"

@@ -77,10 +77,15 @@ class InvalidationInfoProvider:
         except LookupError:
             return False
         final_fn = chain[-1].fn
-        target = getattr(final_fn, "__self__", None)
-        wrapped = getattr(final_fn, "__wrapped__", None)
-        if wrapped is not None:
-            target = getattr(wrapped, "__self__", target)
+        # remote-proxy methods are __getattr__ closures tagged with
+        # __fusion_remote_proxy__ (client_function.py / service_modes.py);
+        # bound methods of a proxy-ish object are covered by __self__
+        target = getattr(final_fn, "__fusion_remote_proxy__", None)
+        if target is None:
+            target = getattr(final_fn, "__self__", None)
+            wrapped = getattr(final_fn, "__wrapped__", None)
+            if wrapped is not None:
+                target = getattr(wrapped, "__self__", target)
         from ..client.client_function import FusionClient
         from ..client.service_modes import RoutingComputeProxy
 
@@ -176,12 +181,18 @@ def attach_operations(commander: "Commander") -> OperationsHost:
     # --------------------------------------------------- PostCompletionInvalidator
     async def post_completion_invalidator(completion: Completion, context: "CommandContext"):
         operation = completion.operation
-        if not commander.operations.invalidation_info.requires_invalidation(operation.command):
-            return await context.invoke_remaining_handlers()
-        with invalidating():
-            await _replay(commander, operation.command)
-            for nested in operation.items:
-                await _replay(commander, nested)
+        info = commander.operations.invalidation_info
+        # gate per command: a top-level command that opts out (or routes to a
+        # remote proxy) must not suppress replay of nested commands that DO
+        # require local invalidation (reference PostCompletionInvalidator
+        # replays each logged command on its own merits)
+        to_replay = [
+            c for c in (operation.command, *operation.items) if info.requires_invalidation(c)
+        ]
+        if to_replay:
+            with invalidating():
+                for c in to_replay:
+                    await _replay(commander, c)
         return await context.invoke_remaining_handlers()
 
     # ------------------------------------------------------- CompletionTerminator

@@ -1,5 +1,11 @@
 """UI layer (SURVEY.md §2.7): live components + action tracking."""
-from .action_tracker import UIActionTracker, UICommander
+from .action_tracker import UIActionFailureTracker, UIActionTracker, UICommander
 from .live_component import LiveComponent, MixedStateComponent
 
-__all__ = ["UIActionTracker", "UICommander", "LiveComponent", "MixedStateComponent"]
+__all__ = [
+    "UIActionTracker",
+    "UIActionFailureTracker",
+    "UICommander",
+    "LiveComponent",
+    "MixedStateComponent",
+]

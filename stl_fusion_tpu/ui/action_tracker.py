@@ -14,7 +14,7 @@ from typing import Any, Optional
 
 from ..utils.async_utils import AsyncEvent
 
-__all__ = ["UIActionTracker", "UICommander"]
+__all__ = ["UIActionTracker", "UICommander", "UIActionFailureTracker"]
 
 
 class UIActionTracker:
@@ -24,6 +24,8 @@ class UIActionTracker:
         self._action_event: AsyncEvent = AsyncEvent(None)
         self._result_event: AsyncEvent = AsyncEvent(None)
         self._last_action_at: float = 0.0
+        #: sync listeners ``(command, error) -> None`` fired on completion
+        self.on_completed: list = []
 
     @property
     def are_instant_updates_enabled(self) -> bool:
@@ -40,6 +42,8 @@ class UIActionTracker:
         self.running_action_count = max(0, self.running_action_count - 1)
         self._last_action_at = time.monotonic()
         self._result_event = self._result_event.latest().create_next((command, error))
+        for listener in list(self.on_completed):
+            listener(command, error)
 
     async def when_action(self) -> Any:
         return (await self._action_event.latest().when_next()).value

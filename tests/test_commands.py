@@ -241,3 +241,35 @@ async def test_nested_command_replay(fresh_hub):
     # nested commands run inside ONE outer operation; replay must reach both
     await fresh_hub.commander.call(EditBoth(Product("x", 3.0), Product("y", 4.0)))
     assert await carts.get_total("c") == 7.0
+
+
+@dataclass(frozen=True)
+class OptOutEditBoth:
+    """Top-level command that opts OUT of invalidation replay — its nested
+    EditProduct commands must still replay on their own merits."""
+
+    __requires_invalidation__ = False
+    a: Product
+    b: Product
+
+
+async def test_nested_replay_survives_top_level_opt_out(fresh_hub):
+    products = ProductService()
+    carts = CartService(products)
+
+    class BulkService(ComputeService):
+        @command_handler
+        async def edit_both(self, command: OptOutEditBoth, context) -> None:
+            await fresh_hub.commander.call(EditProduct(command.a))
+            await fresh_hub.commander.call(EditProduct(command.b))
+
+    fresh_hub.commander.add_service(products)
+    fresh_hub.commander.add_service(BulkService())
+
+    await fresh_hub.commander.call(EditProduct(Product("x", 1.0)))
+    await fresh_hub.commander.call(EditProduct(Product("y", 1.0)))
+    carts.add_cart(Cart("c", ("x", "y")))
+    assert await carts.get_total("c") == 2.0
+
+    await fresh_hub.commander.call(OptOutEditBoth(Product("x", 3.0), Product("y", 4.0)))
+    assert await carts.get_total("c") == 7.0  # nested invalidation NOT lost

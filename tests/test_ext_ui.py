@@ -177,6 +177,33 @@ async def test_ui_action_tracker_instant_updates(fresh_hub):
     assert not tracker.are_instant_updates_enabled
 
 
+async def test_ui_action_failure_tracker_collects_errors(fresh_hub):
+    from stl_fusion_tpu.commands import command_handler
+    from stl_fusion_tpu.ui import UIActionFailureTracker
+
+    class Svc:
+        @command_handler
+        async def boom(self, command: int) -> None:
+            raise ValueError(f"bad {command}")
+
+    fresh_hub.commander.add_service(Svc())
+    tracker = UIActionTracker()
+    failures = UIActionFailureTracker(tracker, max_failures=2)
+    seen = []
+    failures.on_failure(lambda cmd, err: seen.append(cmd))
+    ui = UICommander(fresh_hub.commander, tracker)
+    for i in range(3):
+        with pytest.raises(ValueError):
+            await ui.call(i)
+    assert len(failures) == 2  # bounded, newest kept
+    assert [cmd for cmd, _ in failures.failures] == [1, 2]
+    assert seen == [0, 1, 2]
+    failures.dismiss(0)
+    assert [cmd for cmd, _ in failures.failures] == [2]
+    failures.clear()
+    assert len(failures) == 0
+
+
 # ------------------------------------------------------------------ diagnostics
 
 async def test_fusion_monitor_hit_ratio(fresh_hub):

@@ -1,0 +1,158 @@
+"""Topo-ordered single-sweep 32-wave kernel vs host BFS oracle (ops/topo_wave.py).
+
+Same oracle strategy as test_pull_wave/test_hybrid_wave, plus checks that
+the level renumbering round-trips ids and that the native Kahn level pass
+agrees with the numpy relaxation.
+"""
+import numpy as np
+
+from stl_fusion_tpu.graph.synthetic import power_law_dag
+from stl_fusion_tpu.ops.topo_wave import (
+    _levels_numpy,
+    build_topo_graph,
+    build_topo_wave32,
+    topo_seeds_to_bits,
+)
+
+
+def host_reachable(src, dst, n, seeds):
+    adj = {}
+    for s, d in zip(src, dst):
+        adj.setdefault(int(s), []).append(int(d))
+    seen = set(int(s) for s in seeds)
+    stack = list(seen)
+    while stack:
+        u = stack.pop()
+        for v in adj.get(u, ()):
+            if v not in seen:
+                seen.add(v)
+                stack.append(v)
+    return seen
+
+
+def run_waves(graph, seed_lists):
+    import jax.numpy as jnp
+
+    state0, wave32 = build_topo_wave32(graph)
+    seed_bits = jnp.asarray(topo_seeds_to_bits(graph, seed_lists))
+    state, count = wave32(seed_bits, state0)
+    return np.asarray(state.invalid_bits), int(count)
+
+
+def check_against_oracle(src, dst, n, seed_lists, k=4, use_native=True):
+    graph = build_topo_graph(src, dst, n, k=k, use_native=use_native)
+    invalid_bits, count = run_waves(graph, seed_lists)
+    # results live in new-id space: row i is original node graph.perm[i]
+    total = 0
+    for w, seeds in enumerate(seed_lists):
+        expected = host_reachable(src, dst, n, seeds)
+        bit = np.int64(1) << w
+        got = {
+            int(graph.perm[i])
+            for i in range(graph.n_tot)
+            if (invalid_bits[i] & bit) and graph.is_real[i]
+        }
+        assert got == expected, f"wave {w}: {len(got)} vs {len(expected)} nodes"
+        total += len(expected)
+    assert count == total
+    return graph
+
+
+def test_matches_oracle_on_power_law_dag():
+    src, dst = power_law_dag(3000, avg_degree=3.0, seed=11)
+    rng = np.random.default_rng(0)
+    seed_lists = [rng.choice(3000, size=5, replace=False) for _ in range(32)]
+    check_against_oracle(src, dst, 3000, seed_lists)
+
+
+def test_levels_are_topological():
+    src, dst = power_law_dag(2000, avg_degree=3.0, seed=4)
+    g = build_topo_graph(src, dst, 2000, k=4)
+    # every live in-edge must point at a strictly earlier row
+    live = g.in_src < g.n_tot
+    rows = np.arange(g.n_tot + 1)[:, None]
+    assert (g.in_src[live] < np.broadcast_to(rows, g.in_src.shape)[live]).all()
+    # level slices are contiguous and cover all rows
+    assert g.level_starts[0] == 0 and g.level_starts[-1] == g.n_tot
+
+
+def test_high_fan_in_through_collector_trees():
+    """500 sources feeding one sink ≫ k: the collector tree must be placed
+    on correct (deeper) levels so every source's signal arrives in one sweep."""
+    n = 502
+    edges = [(i, 500) for i in range(500)] + [(500, 501)]
+    src = np.array([e[0] for e in edges])
+    dst = np.array([e[1] for e in edges])
+    graph = build_topo_graph(src, dst, n, k=4)
+    assert graph.n_tot > n  # collector nodes exist
+    for probe in (0, 1, 250, 499):
+        inv, _ = run_waves(graph, [[probe]])
+        new_sink = int(graph.inv_perm[500])
+        new_tail = int(graph.inv_perm[501])
+        assert inv[new_sink] & 1, f"source {probe} lost through collectors"
+        assert inv[new_tail] & 1
+
+
+def test_deep_chain_single_sweep():
+    """A 900-deep chain completes in ONE sweep (the level-synchronized
+    kernels would need 900 iterations)."""
+    n = 900
+    src = np.arange(n - 1)
+    dst = np.arange(1, n)
+    graph = check_against_oracle(src, dst, n, [[0]] + [[i] for i in range(1, 32)])
+    assert len(graph.level_starts) - 1 == n  # one level per chain link
+
+
+def test_idempotent_and_epoch_gating():
+    import jax.numpy as jnp
+
+    src, dst = power_law_dag(500, avg_degree=3.0, seed=3)
+    graph = build_topo_graph(src, dst, 500)
+    state0, wave32 = build_topo_wave32(graph)
+    seed_bits = jnp.asarray(topo_seeds_to_bits(graph, [[1, 2, 3]]))
+    state1, c1 = wave32(seed_bits, state0)
+    assert int(c1) > 0
+    state2, c2 = wave32(seed_bits, state1)
+    assert int(c2) == 0  # already invalid: nothing new
+
+    # bump a node's epoch: its in-edges (captured at epoch 0) go dead, so
+    # the cascade can't pass through it (version-consistent edges,
+    # Computed.cs:213-215)
+    reach = host_reachable(src, dst, 500, [1])
+    blocked = sorted(reach - {1})
+    if blocked:
+        b_new = int(graph.inv_perm[blocked[0]])
+        bumped = state0._replace(node_epoch=state0.node_epoch.at[b_new].set(1))
+        state3, _ = wave32(jnp.asarray(topo_seeds_to_bits(graph, [[1]])), bumped)
+        assert not (np.asarray(state3.invalid_bits)[b_new] & 1)
+
+
+def test_native_levels_match_numpy():
+    from stl_fusion_tpu.native import native_topo_levels
+    from stl_fusion_tpu.ops.ell_wave import build_ell
+
+    src, dst = power_law_dag(4000, avg_degree=3.0, seed=17)
+    ell = build_ell(dst, src, 4000, k=4)
+    lv_nat = native_topo_levels(ell.ell_dst, ell.n_tot, 4)
+    assert lv_nat is not None
+    lv_np = _levels_numpy(ell.ell_dst, ell.n_tot, 4)
+    assert np.array_equal(lv_nat, lv_np)
+
+
+def test_agrees_with_hybrid_kernel():
+    from stl_fusion_tpu.ops.hybrid_wave import build_hybrid_graph, build_hybrid_wave32
+    from stl_fusion_tpu.ops.pull_wave import seeds_to_bits
+
+    import jax.numpy as jnp
+
+    src, dst = power_law_dag(2500, avg_degree=3.0, seed=8)
+    rng = np.random.default_rng(5)
+    seed_lists = [rng.choice(2500, size=10, replace=False) for _ in range(32)]
+
+    tg = build_topo_graph(src, dst, 2500)
+    inv_t, c_t = run_waves(tg, seed_lists)
+
+    hg = build_hybrid_graph(src, dst, 2500)
+    h_state0, h_wave = build_hybrid_wave32(hg, tail_cap=64)
+    h_state, c_h = h_wave(jnp.asarray(seeds_to_bits(hg.n_tot, seed_lists)), h_state0)
+    assert c_t == int(c_h)
