@@ -13,6 +13,7 @@ returning None means "use the Python path", never a hard failure.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import logging
 import os
 import subprocess
@@ -24,16 +25,26 @@ __all__ = ["load_graphpack", "native_build_hybrid_tables", "native_topo_levels"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "graphpack.cpp")
-_LIB = os.path.join(_DIR, "_graphpack.so")
 _lock = threading.Lock()
 _lib = None
 _lib_failed = False
 
 
-def _compile() -> bool:
+def _lib_path() -> str:
+    # Content-keyed path: a source change produces a NEW .so path, so a
+    # stale cached library can never be picked up, and we never need to
+    # dlopen the same path twice (glibc dedupes dlopen by path, which would
+    # silently return the old mapping instead of the rebuilt one).
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_DIR, f"_graphpack_{digest}.so")
+
+
+def _compile(lib_path: str) -> bool:
     # no -march=native: a cached .so must run on any host this package is
     # copied to (counting sorts are memory-bound; vector ISA gains nothing)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC]
+    tmp = lib_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC]
     try:
         result = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:
@@ -42,6 +53,7 @@ def _compile() -> bool:
     if result.returncode != 0:
         log.warning("graphpack native compile failed:\n%s", result.stderr[-2000:])
         return False
+    os.replace(tmp, lib_path)  # atomic: concurrent processes race safely
     return True
 
 
@@ -53,32 +65,17 @@ def load_graphpack():
     with _lock:
         if _lib is not None or _lib_failed:
             return _lib
-        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-            if not _compile():
+        lib_path = _lib_path()
+        if not os.path.exists(lib_path):
+            if not _compile(lib_path):
                 _lib_failed = True
                 return None
         try:
-            lib = ctypes.CDLL(_LIB)
+            lib = ctypes.CDLL(lib_path)
         except OSError as e:
             log.warning("graphpack load failed: %s", e)
             _lib_failed = True
             return None
-        if not hasattr(lib, "gp_topo_levels"):
-            # stale cached .so predating newer entry points (mtime ties defeat
-            # the staleness check): rebuild once, else fall back to numpy
-            if not _compile():
-                _lib_failed = True
-                return None
-            try:
-                lib = ctypes.CDLL(_LIB)
-            except OSError as e:
-                log.warning("graphpack reload failed: %s", e)
-                _lib_failed = True
-                return None
-            if not hasattr(lib, "gp_topo_levels"):
-                log.warning("graphpack .so lacks gp_topo_levels after rebuild; numpy path")
-                _lib_failed = True
-                return None
         lib.gp_build_hybrid.restype = ctypes.c_void_p
         lib.gp_build_hybrid.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
@@ -118,8 +115,10 @@ def native_topo_levels(in_src, n: int, k: int):
         level.ctypes.data_as(ctypes.c_void_p),
     )
     if rc != 0:
-        log.error("gp_topo_levels found a cycle (rc=%d); using numpy path", rc)
-        return None
+        # A cycle is a hard invariant violation of the dependency DAG, not a
+        # native-path miss: falling back would grind through the numpy
+        # relaxation's full non-convergence loop before failing anyway.
+        raise ValueError(f"dependency graph contains a cycle (gp_topo_levels rc={rc})")
     return level
 
 

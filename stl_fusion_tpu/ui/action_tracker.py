@@ -9,10 +9,13 @@ action/result events.
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from typing import Any, Optional
 
 from ..utils.async_utils import AsyncEvent
+
+log = logging.getLogger("stl_fusion_tpu")
 
 __all__ = ["UIActionTracker", "UICommander", "UIActionFailureTracker"]
 
@@ -43,7 +46,13 @@ class UIActionTracker:
         self._last_action_at = time.monotonic()
         self._result_event = self._result_event.latest().create_next((command, error))
         for listener in list(self.on_completed):
-            listener(command, error)
+            # a raising listener must not mask the command's real outcome
+            # (action_completed runs in UICommander.call's finally) or
+            # starve the remaining listeners
+            try:
+                listener(command, error)
+            except Exception:
+                log.exception("on_completed listener failed")
 
     async def when_action(self) -> Any:
         return (await self._action_event.latest().when_next()).value
@@ -89,7 +98,10 @@ class UIActionFailureTracker:
         self.failures.append((command, error))
         del self.failures[: max(0, len(self.failures) - self.max_failures)]
         for listener in list(self._listeners):
-            listener(command, error)
+            try:
+                listener(command, error)
+            except Exception:
+                log.exception("on_failure listener failed")
 
     def on_failure(self, listener) -> None:
         self._listeners.append(listener)
