@@ -29,3 +29,9 @@ from .utils import (  # noqa: F401
     TestClock,
     TransientError,
 )
+from .checkpoint import (  # noqa: F401
+    CheckpointManager,
+    HubCheckpoint,
+    load_graph,
+    save_graph,
+)

@@ -1,0 +1,314 @@
+"""Checkpoint/resume — durable snapshots of the computed graph.
+
+The reference has no training-style checkpoints; its two restart-survival
+mechanisms are (a) the persistent client computed cache, version-flushed and
+synchronized after boot (Client/Caching/ClientComputedCache.cs:10-49), and
+(b) the DB operation log as the durable source of invalidation truth, replayed
+from a commit-time watermark (Operations/DbOperationLogReader.cs:36-77).
+SURVEY §5.4 maps both onto the TPU build as: **checkpoint = snapshot of
+(graph + versions + values) plus op-log offset**. This module implements that:
+
+- :func:`save_graph` / :func:`load_graph` — raw DeviceGraph array snapshots
+  (npz) for standalone bench-scale graphs with no host registry.
+- :class:`HubCheckpoint` — warm-boot snapshots of a FusionHub's computed
+  state: every live, consistent, serializable compute-method result with its
+  version, the host dependency edges between them, and the op-log position.
+  ``restore`` re-creates the nodes as CONSISTENT computeds (reads hit warm
+  immediately), re-links the dependency edges (so cascading invalidation
+  works from turn one), and returns the op-log position to resume the
+  reader from — replaying external operations committed after the snapshot
+  invalidates exactly the entries that went stale while the host was down.
+- :class:`CheckpointManager` — numbered snapshots in a directory with
+  ``latest()`` lookup, the orbax-style save/restore loop without the
+  training-framework dependency surface.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.computed import Computed
+from ..core.hub import FusionHub
+from ..core.inputs import ComputeMethodInput
+from ..graph.device_graph import DeviceGraph
+from ..utils.ltag import LTag
+from ..utils.result import Result
+from ..utils.serialization import dumps, encode, decode, loads
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = [
+    "save_graph",
+    "load_graph",
+    "HubCheckpoint",
+    "RestoreResult",
+    "CheckpointManager",
+]
+
+_FORMAT_VERSION = 1
+
+
+# ---------------------------------------------------------------- device graph
+def save_graph(graph: DeviceGraph, path: str) -> None:
+    """Snapshot a DeviceGraph's authoritative host arrays (live prefixes only)."""
+    np.savez_compressed(
+        path,
+        format=np.int32(_FORMAT_VERSION),
+        n_nodes=np.int64(graph.n_nodes),
+        n_edges=np.int64(graph.n_edges),
+        edge_src=graph._h_edge_src[: graph.n_edges],
+        edge_dst=graph._h_edge_dst[: graph.n_edges],
+        edge_dst_epoch=graph._h_edge_dst_epoch[: graph.n_edges],
+        node_epoch=graph._h_node_epoch[: graph.n_nodes],
+        invalid=graph._h_invalid[: graph.n_nodes],
+    )
+
+
+def load_graph(path: str) -> DeviceGraph:
+    """Rebuild a DeviceGraph from :func:`save_graph` output. Device arrays
+    re-materialize lazily on first use (the mirror derives from host state)."""
+    with np.load(path) as z:
+        n_nodes = int(z["n_nodes"])
+        n_edges = int(z["n_edges"])
+        graph = DeviceGraph(node_capacity=max(n_nodes, 16), edge_capacity=max(n_edges, 16))
+        graph.add_nodes(n_nodes)
+        graph._h_node_epoch[:n_nodes] = z["node_epoch"]
+        graph._h_invalid[:n_nodes] = z["invalid"]
+        # edges carry their recorded capture epochs (stale edges stay stale);
+        # any entry at/above the old capacity was a dummy-slot pad — re-point
+        # it at the NEW dummy slot
+        src = z["edge_src"].copy()
+        dst = z["edge_dst"].copy()
+        src[src >= n_nodes] = graph.n_cap
+        dst[dst >= n_nodes] = graph.n_cap
+        graph.add_edges(src, dst, dst_epoch=z["edge_dst_epoch"])
+    graph._dirty = True
+    return graph
+
+
+# ---------------------------------------------------------------- hub snapshot
+def _service_name(hub: FusionHub, service: Any) -> str:
+    """Stable name for a service: explicit str key in the hub container,
+    else its type name (deterministic across restarts for one-instance-per-
+    class services, which is the framework's normal shape)."""
+    for key, svc in hub._services.items():
+        if svc is service:
+            return key if isinstance(key, str) else key.__name__
+    return type(service).__name__
+
+
+def _services_by_name(hub: FusionHub) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, svc in hub._services.items():
+        out[key if isinstance(key, str) else key.__name__] = svc
+    return out
+
+
+@dataclass
+class RestoreResult:
+    """Outcome of :meth:`HubCheckpoint.restore`.
+
+    Holds STRONG references to the restored computeds — the registry interns
+    weakly, so drop this object only once something else (keep-alive timers,
+    dependents, states) anchors the entries you care about.
+    """
+
+    computeds: List[Computed] = field(default_factory=list)
+    skipped: int = 0
+    edges: int = 0
+    oplog_position: int = 0
+    saved_at: float = 0.0
+
+    @property
+    def count(self) -> int:
+        return len(self.computeds)
+
+
+class HubCheckpoint:
+    """Snapshot/restore of a hub's warm computed state (SURVEY §5.4)."""
+
+    @staticmethod
+    def snapshot(hub: FusionHub, oplog_position: int = 0) -> dict:
+        """Capture every live CONSISTENT compute-method node whose arguments
+        and value serialize. Error outputs and mid-compute nodes are skipped
+        (they recompute cold — same rule as the reference's client cache,
+        which only persists successful results)."""
+        nodes: List[dict] = []
+        index_of: Dict[Any, int] = {}
+        live = hub.registry.live_computeds()
+        skipped = 0
+        for c in live:
+            if not c.is_consistent or not isinstance(c.input, ComputeMethodInput):
+                skipped += 1
+                continue
+            out = c._output
+            if out is None or out.has_error:
+                skipped += 1
+                continue
+            service = c.input.service
+            svc_name = _service_name(hub, service)
+            method_name = c.input.method_def.original.__name__
+            try:
+                entry = {
+                    "s": svc_name,
+                    "m": method_name,
+                    "a": encode(list(c.input.args)),
+                    "v": int(c.version),
+                    "o": encode(out.value),
+                }
+            except TypeError:
+                skipped += 1  # unserializable args/value — recomputes cold
+                continue
+            index_of[c.input] = len(nodes)
+            nodes.append(entry)
+        # host dependency edges among snapshot nodes: (dependent, used,
+        # used-version) — the version lets restore detect that a LIVE node
+        # displaced the snapshotted dependency and the dependent is stale
+        edges: List[Tuple[int, int, int]] = []
+        for c in live:
+            di = index_of.get(c.input)
+            if di is None:
+                continue
+            for used in c.used:
+                ui = index_of.get(used.input)
+                if ui is not None:
+                    edges.append((di, ui, int(used.version)))
+        return {
+            "format": _FORMAT_VERSION,
+            "saved_at": time.time(),
+            "oplog_position": int(oplog_position),
+            "nodes": nodes,
+            "edges": edges,
+            "skipped": skipped,
+        }
+
+    @staticmethod
+    def save(hub: FusionHub, path: str, oplog_position: int = 0) -> dict:
+        snap = HubCheckpoint.snapshot(hub, oplog_position)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(dumps(snap))
+        os.replace(tmp, path)
+        return snap
+
+    @staticmethod
+    def restore(
+        hub: FusionHub,
+        path: str,
+        services: Optional[Dict[str, Any]] = None,
+    ) -> RestoreResult:
+        """Warm-boot ``hub`` from a snapshot file.
+
+        Each snapshot node becomes a registered CONSISTENT computed carrying
+        its ORIGINAL version, so op-log replay's version-matched invalidation
+        semantics hold across the restart. Dependency edges re-link through
+        the normal ``add_used`` path, which also feeds the device mirror
+        hooks — the TPU CSR rebuilds itself from restored host truth.
+
+        ``services`` maps snapshot service names to live instances; defaults
+        to the hub's service container keyed by type name.
+        """
+        with open(path, "rb") as f:
+            snap = loads(f.read())
+        if snap.get("format") != _FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint format {snap.get('format')!r}")
+        if services is None:
+            services = _services_by_name(hub)
+        result = RestoreResult(
+            oplog_position=int(snap.get("oplog_position", 0)),
+            saved_at=float(snap.get("saved_at", 0.0)),
+        )
+        restored: List[Optional[Computed]] = []
+        for entry in snap["nodes"]:
+            c = HubCheckpoint._restore_node(hub, services, entry)
+            restored.append(c)
+            if c is None:
+                result.skipped += 1
+            else:
+                result.computeds.append(c)
+        for di, ui, used_version in snap.get("edges", ()):
+            dep, used = restored[di], restored[ui]
+            if dep is None or used is None:
+                continue
+            dep.add_used(used)
+            result.edges += 1
+            if int(used.version) != used_version:
+                # a live computed displaced the snapshotted dependency: the
+                # dependent's warm value was produced against a version that
+                # no longer exists — it is provably stale
+                dep.invalidate(immediately=True)
+        return result
+
+    @staticmethod
+    def _restore_node(hub: FusionHub, services: Dict[str, Any], entry: dict) -> Optional[Computed]:
+        service = services.get(entry["s"])
+        if service is None:
+            log.warning("checkpoint: service %r not registered; node skipped", entry["s"])
+            return None
+        method = getattr(service, entry["m"], None)
+        method_def = getattr(method, "__compute_method_def__", None)
+        if method_def is None:
+            log.warning("checkpoint: %s.%s is not a compute method; node skipped",
+                        entry["s"], entry["m"])
+            return None
+        args = tuple(decode(entry["a"]))
+        input = ComputeMethodInput(method_def, service, args)
+        existing = hub.registry.get(input)
+        if existing is not None and existing.is_consistent:
+            return existing  # live state wins over the snapshot
+        computed = Computed(input, LTag(entry["v"]), method_def.options)
+        computed.try_set_output(Result.ok(decode(entry["o"])))
+        hub.registry.register(computed)
+        computed.renew_timeouts(True)  # arm keep-alive so warm entries survive
+        return computed
+
+
+# ---------------------------------------------------------------- manager
+class CheckpointManager:
+    """Numbered hub snapshots in a directory: ``fusion-ckpt-{n}.bin``."""
+
+    _PATTERN = re.compile(r"fusion-ckpt-(\d+)\.bin$")
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    def _steps(self) -> List[int]:
+        steps = []
+        for name in os.listdir(self.directory):
+            m = self._PATTERN.match(name)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def path_of(self, step: int) -> str:
+        return os.path.join(self.directory, f"fusion-ckpt-{step}.bin")
+
+    def latest_step(self) -> Optional[int]:
+        steps = self._steps()
+        return steps[-1] if steps else None
+
+    def save(self, hub: FusionHub, oplog_position: int = 0) -> int:
+        step = (self.latest_step() or 0) + 1
+        HubCheckpoint.save(hub, self.path_of(step), oplog_position)
+        for old in self._steps()[: -self.keep]:
+            try:
+                os.remove(self.path_of(old))
+            except OSError:
+                pass
+        return step
+
+    def restore_latest(
+        self, hub: FusionHub, services: Optional[Dict[str, Any]] = None
+    ) -> Optional[RestoreResult]:
+        step = self.latest_step()
+        if step is None:
+            return None
+        return HubCheckpoint.restore(hub, self.path_of(step), services)

@@ -87,6 +87,7 @@ class OperationLogReader(WorkerBase):
         poll_period: float = 0.25,
         start_from_end: bool = True,
         batch_size: int = 1024,
+        start_position: Optional[int] = None,
     ):
         super().__init__("oplog-reader")
         self.log_store = log_store
@@ -94,7 +95,11 @@ class OperationLogReader(WorkerBase):
         self.notifier = notifier
         self.poll_period = poll_period
         self.batch_size = batch_size
-        self.watermark = log_store.last_index() if start_from_end else 0
+        # explicit position (checkpoint resume) > tail-from-end > full replay
+        if start_position is not None:
+            self.watermark = start_position
+        else:
+            self.watermark = log_store.last_index() if start_from_end else 0
         self.external_seen = 0
 
     async def on_run(self) -> None:
@@ -140,6 +145,7 @@ def attach_operation_log(
     log_store: OperationLog,
     notifier=None,
     start_reader: bool = True,
+    start_position: Optional[int] = None,
 ) -> OperationLogReader:
     """Wire a commander's operations pipeline to a durable log:
     - local completions append to the log (+ notify),
@@ -161,7 +167,7 @@ def attach_operation_log(
             notifier.notify()
 
     operations.commit_listeners.append(persist)
-    reader = OperationLogReader(log_store, operations, notifier)
+    reader = OperationLogReader(log_store, operations, notifier, start_position=start_position)
     if start_reader:
         reader.start()
     return reader

@@ -1,0 +1,220 @@
+"""Checkpoint/resume (SURVEY §5.4): snapshot of (graph + versions + values)
+plus op-log offset. Covers the DeviceGraph array snapshot, hub warm-boot with
+restored dependency edges, and the restart-resumes-from-watermark flow the
+reference gets from its client cache + DB operation log."""
+import asyncio
+import dataclasses
+
+import numpy as np
+
+from stl_fusion_tpu.checkpoint import (
+    CheckpointManager,
+    HubCheckpoint,
+    load_graph,
+    save_graph,
+)
+from stl_fusion_tpu.commands import command_handler
+from stl_fusion_tpu.core import (
+    ComputeService,
+    FusionHub,
+    capture,
+    compute_method,
+    invalidating,
+    is_invalidating,
+)
+from stl_fusion_tpu.graph.device_graph import DeviceGraph
+from stl_fusion_tpu.oplog import InMemoryOperationLog, LocalChangeNotifier, attach_operation_log
+from stl_fusion_tpu.utils.serialization import wire_type
+
+
+# ---------------------------------------------------------------- device graph
+def test_device_graph_snapshot_roundtrip(tmp_path):
+    g = DeviceGraph(node_capacity=16, edge_capacity=16)
+    g.add_nodes(6)
+    # chain 0 -> 1 -> 2, fan 0 -> {3, 4}; 5 isolated
+    g.add_edges(np.array([0, 1, 0, 0]), np.array([1, 2, 3, 4]))
+    g.bump_epochs(np.array([5]))
+    g.run_wave([0])
+    path = str(tmp_path / "graph.npz")
+    save_graph(g, path)
+
+    g2 = load_graph(path)
+    assert g2.n_nodes == g.n_nodes and g2.n_edges == g.n_edges
+    np.testing.assert_array_equal(g2.invalid_mask(), g.invalid_mask())
+    np.testing.assert_array_equal(
+        g2._h_node_epoch[: g2.n_nodes], g._h_node_epoch[: g.n_nodes]
+    )
+    # the restored graph keeps cascading: waves are idempotent on restored state
+    assert g2.run_wave([0]) == 0
+    g2.bump_epochs(np.array([1, 2]))
+    g2.add_edges(np.array([1]), np.array([2]))
+    assert g2.run_wave([1]) >= 1
+
+
+# ---------------------------------------------------------------- hub warm boot
+PRICES = {"apple": 2.0, "pear": 3.0}
+
+
+class CartService(ComputeService):
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self.compute_calls = 0
+
+    @compute_method
+    async def price(self, pid: str) -> float:
+        self.compute_calls += 1
+        return PRICES.get(pid, 0.0)
+
+    @compute_method
+    async def total(self) -> float:
+        self.compute_calls += 1
+        return (await self.price("apple")) + (await self.price("pear"))
+
+
+async def test_hub_checkpoint_warm_boot_and_edges(tmp_path):
+    PRICES.update({"apple": 2.0, "pear": 3.0})
+    hub = FusionHub()
+    svc = hub.add_service(CartService(hub))
+    assert await svc.total() == 5.0
+    path = str(tmp_path / "hub.bin")
+    snap = HubCheckpoint.save(hub, path, oplog_position=7)
+    assert len(snap["nodes"]) == 3  # total + 2 prices
+    assert len(snap["edges"]) == 2
+
+    # "restart": fresh hub + fresh service instance, no computations yet
+    hub2 = FusionHub()
+    svc2 = hub2.add_service(CartService(hub2))
+    result = HubCheckpoint.restore(hub2, path)
+    assert result.count == 3 and result.edges == 2
+    assert result.oplog_position == 7
+
+    # warm read: no compute bodies run
+    assert await svc2.total() == 5.0
+    assert svc2.compute_calls == 0
+
+    # restored dependency edges cascade: invalidating a price kills the total
+    total_node = await capture(lambda: svc2.total())
+    PRICES["apple"] = 10.0
+    with invalidating():
+        await svc2.price("apple")
+    assert total_node.is_invalidated
+    assert await svc2.total() == 13.0
+    assert svc2.compute_calls == 2  # total + apple recomputed; pear stayed warm
+
+
+async def test_restore_skips_unknown_and_prefers_live(tmp_path):
+    PRICES.update({"apple": 2.0, "pear": 3.0})
+    hub = FusionHub()
+    svc = hub.add_service(CartService(hub))
+    await svc.total()
+    path = str(tmp_path / "hub.bin")
+    HubCheckpoint.save(hub, path)
+
+    hub2 = FusionHub()
+    svc2 = hub2.add_service(CartService(hub2))
+    # a live computed beats the snapshot entry
+    PRICES["apple"] = 99.0
+    assert await svc2.price("apple") == 99.0
+    result = HubCheckpoint.restore(hub2, path)
+    assert await svc2.price("apple") == 99.0  # live value survived
+    assert await svc2.total() == 99.0 + 3.0  # total recomputes: version mismatch
+    # restoring with no matching services skips everything gracefully
+    hub3 = FusionHub()
+    r3 = HubCheckpoint.restore(hub3, path, services={})
+    assert r3.count == 0 and r3.skipped == len(result.computeds)
+
+
+# ---------------------------------------------------------------- oplog resume
+DB = {}
+
+
+@wire_type("CkptSet")
+@dataclasses.dataclass(frozen=True)
+class CkptSet:
+    key: str
+    value: int
+
+
+class ValueService(ComputeService):
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self.compute_calls = 0
+
+    @compute_method
+    async def get(self, key: str) -> int:
+        self.compute_calls += 1
+        return DB.get(key, 0)
+
+    @command_handler
+    async def set_value(self, command: CkptSet):
+        if is_invalidating():
+            await self.get(command.key)
+            return
+        DB[command.key] = command.value
+
+
+async def test_checkpoint_plus_oplog_resume(tmp_path):
+    DB.clear()
+    DB.update({"x": 1, "y": 2})
+    log_store = InMemoryOperationLog()
+    notifier = LocalChangeNotifier()
+
+    # host A stays up the whole time
+    hub_a = FusionHub()
+    svc_a = hub_a.add_service(ValueService(hub_a))
+    hub_a.commander.add_service(svc_a)
+    reader_a = attach_operation_log(hub_a.commander, log_store, notifier)
+
+    # host B computes, checkpoints (values + log position), then "dies"
+    hub_b = FusionHub()
+    svc_b = hub_b.add_service(ValueService(hub_b))
+    hub_b.commander.add_service(svc_b)
+    reader_b = attach_operation_log(hub_b.commander, log_store, notifier)
+    assert await svc_b.get("x") == 1 and await svc_b.get("y") == 2
+    path = str(tmp_path / "b.bin")
+    HubCheckpoint.save(hub_b, path, oplog_position=reader_b.watermark)
+    await reader_b.stop()
+    del hub_b, svc_b
+
+    # while B is down, A mutates x (appends to the shared log)
+    await hub_a.commander.call(CkptSet("x", 42))
+
+    # B restarts from the checkpoint: warm values + replay from watermark
+    hub_b2 = FusionHub()
+    svc_b2 = hub_b2.add_service(ValueService(hub_b2))
+    hub_b2.commander.add_service(svc_b2)
+    restored = HubCheckpoint.restore(hub_b2, path)
+    assert restored.count == 2
+    node_x = await capture(lambda: svc_b2.get("x"))
+    assert node_x.value == 1 and svc_b2.compute_calls == 0  # warm (stale) boot
+    reader_b2 = attach_operation_log(
+        hub_b2.commander, log_store, notifier, start_position=restored.oplog_position
+    )
+    try:
+        await asyncio.wait_for(node_x.when_invalidated(), 5.0)
+        assert await svc_b2.get("x") == 42  # replay invalidated the stale entry
+        assert await svc_b2.get("y") == 2
+        assert svc_b2.compute_calls == 1  # only x recomputed; y stayed warm
+    finally:
+        await reader_b2.stop()
+        await reader_a.stop()
+
+
+# ---------------------------------------------------------------- manager
+async def test_checkpoint_manager_rotation(tmp_path):
+    PRICES.update({"apple": 2.0, "pear": 3.0})
+    hub = FusionHub()
+    svc = hub.add_service(CartService(hub))
+    await svc.total()
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=2)
+    assert mgr.restore_latest(FusionHub()) is None
+    s1 = mgr.save(hub, oplog_position=1)
+    s2 = mgr.save(hub, oplog_position=2)
+    s3 = mgr.save(hub, oplog_position=3)
+    assert (s1, s2, s3) == (1, 2, 3)
+    assert mgr._steps() == [2, 3]  # keep=2 pruned the oldest
+
+    hub2 = FusionHub()
+    hub2.add_service(CartService(hub2))
+    result = mgr.restore_latest(hub2)
+    assert result is not None and result.oplog_position == 3 and result.count == 3
