@@ -1,5 +1,7 @@
-"""Diagnostics (SURVEY.md §5.1): registry monitoring + hit-ratio reports,
-activity-style tracing spans."""
+"""Diagnostics (SURVEY.md §5.1-5.2): registry monitoring + hit-ratio
+reports, activity-style tracing spans, and explicit graph-invariant sweeps
+(the build's race-detection story)."""
+from .invariants import InvariantReport, InvariantViolation, validate_hub, validate_mirror
 from .monitor import FusionMonitor
 from .tracing import (
     ActivitySource,
@@ -13,6 +15,10 @@ from .tracing import (
 
 __all__ = [
     "FusionMonitor",
+    "InvariantReport",
+    "InvariantViolation",
+    "validate_hub",
+    "validate_mirror",
     "ActivitySource",
     "Span",
     "add_listener",

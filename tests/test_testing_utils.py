@@ -1,0 +1,77 @@
+"""The test toolkit itself (≈ src/Stl.Testing/): TestWebHost composes a full
+in-proc stack over a real socket; RandomTimeSpan jitters; CI detection."""
+import asyncio
+import random
+
+from stl_fusion_tpu.core import ComputeService, capture, compute_method, invalidating
+from stl_fusion_tpu.testing import RandomTimeSpan, TestWebHost, is_build_agent
+
+
+class CounterService(ComputeService):
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self.counters = {}
+
+    @compute_method
+    async def get(self, key: str) -> int:
+        return self.counters.get(key, 0)
+
+    async def increment(self, key: str):
+        self.counters[key] = self.counters.get(key, 0) + 1
+        with invalidating():
+            await self.get(key)
+
+
+async def test_test_web_host_end_to_end():
+    async with TestWebHost() as host:
+        svc = host.add_service("counters", CounterService(host.fusion))
+        client = await host.new_client("counters")
+        assert await client.get("a") == 0
+        node = await capture(lambda: client.get("a"))
+
+        # server-side mutation pushes invalidation through the real socket
+        await svc.increment("a")
+        await asyncio.wait_for(node.when_invalidated(), 5.0)
+        assert await client.get("a") == 1
+
+
+async def test_test_web_host_isolated_clients():
+    async with TestWebHost() as host:
+        svc = host.add_service("counters", CounterService(host.fusion))
+        c1 = await host.new_client("counters")
+        c2 = await host.new_client("counters")
+        assert await c1.get("x") == 0 and await c2.get("x") == 0
+        n1 = await capture(lambda: c1.get("x"))
+        n2 = await capture(lambda: c2.get("x"))
+        await svc.increment("x")
+        await asyncio.wait_for(
+            asyncio.gather(n1.when_invalidated(), n2.when_invalidated()), 5.0
+        )
+        assert await c1.get("x") == 1 and await c2.get("x") == 1
+
+
+async def test_test_web_host_http_gateway():
+    from stl_fusion_tpu.rpc.http_gateway import RestClient
+
+    async with TestWebHost(use_http_gateway=True) as host:
+        host.add_service("counters", CounterService(host.fusion))
+        rest = RestClient(host.http_url, "counters")
+        assert await rest.get("a") == 0
+
+
+def test_random_time_span():
+    rng = random.Random(7)
+    rt = RandomTimeSpan(1.0, 0.25)
+    vals = [rt.next(rng) for _ in range(100)]
+    assert all(rt.min <= v <= rt.max for v in vals)
+    assert len(set(round(v, 6) for v in vals)) > 1  # actually jitters
+    assert RandomTimeSpan(0.5).next() == 0.5  # no delta → deterministic
+    assert RandomTimeSpan(0.1, 0.5).next(rng) >= 0.0  # clamped at zero
+
+
+def test_is_build_agent_env(monkeypatch):
+    for k in ("CI", "GITHUB_ACTIONS", "BUILD_ID", "TF_BUILD"):
+        monkeypatch.delenv(k, raising=False)
+    assert not is_build_agent()
+    monkeypatch.setenv("CI", "true")
+    assert is_build_agent()
