@@ -61,3 +61,31 @@ def test_sharded_wave_idempotent():
     assert sg.run_wave([0]) == 0
     sg.clear_invalid()
     assert sg.run_wave([2]) == 2  # 2 and 3 only
+
+
+@pytest.mark.parametrize("seed", [1, 13])
+def test_packed_exchange_matches_bool(seed):
+    rng = np.random.default_rng(seed)
+    n = 613  # deliberately not a multiple of 32*n_dev
+    edges = random_dag(rng, n, avg_deg=3.0)
+    arr = np.asarray(edges, dtype=np.int32)
+    packed = ShardedDeviceGraph(arr[:, 0], arr[:, 1], n, exchange="packed")
+    plain = ShardedDeviceGraph(arr[:, 0], arr[:, 1], n, exchange="bool")
+    for _ in range(3):
+        seeds = rng.choice(n, size=5, replace=False).tolist()
+        c1 = packed.run_wave(seeds)
+        c2 = plain.run_wave(seeds)
+        assert c1 == c2
+        np.testing.assert_array_equal(packed.invalid_mask(), plain.invalid_mask())
+
+
+def test_ring_exchange_matches_bool():
+    rng = np.random.default_rng(5)
+    n = 500
+    edges = random_dag(rng, n, avg_deg=3.0)
+    arr = np.asarray(edges, dtype=np.int32)
+    ring = ShardedDeviceGraph(arr[:, 0], arr[:, 1], n, exchange="ring")
+    plain = ShardedDeviceGraph(arr[:, 0], arr[:, 1], n, exchange="bool")
+    seeds = rng.choice(n, size=6, replace=False).tolist()
+    assert ring.run_wave(seeds) == plain.run_wave(seeds)
+    np.testing.assert_array_equal(ring.invalid_mask(), plain.invalid_mask())
