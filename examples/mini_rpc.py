@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""MiniRpc — port of the reference sample (samples/MiniRpc/Program.cs,
+Service.cs): a chat compute service served over a real websocket. The client
+posts messages through its LOCAL commander (command types bridged over RPC to
+the server's commander — samples/MiniRpc/Program.cs:52-56), while two
+`changes()` observers watch `get_recent_messages` and `get_word_count`; every
+post pushes an invalidation to the client over the socket ($sys-c) with zero
+polling. `get_word_count` never reads state directly — it calls
+`get_recent_messages`, so its staleness is purely a captured dependency
+(samples/MiniRpc/Service.cs:37-42).
+
+Run: python examples/mini_rpc.py
+"""
+import asyncio
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stl_fusion_tpu.client import compute_client, install_compute_call_type
+from stl_fusion_tpu.commands import bridge_commands, command_handler, expose_commander
+from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method, is_invalidating
+from stl_fusion_tpu.rpc import RpcHub
+from stl_fusion_tpu.rpc.websocket import RpcWebSocketServer, websocket_client_connector
+from stl_fusion_tpu.utils.serialization import wire_type
+
+
+@wire_type
+@dataclasses.dataclass(frozen=True)
+class ChatPost:
+    message: str
+
+
+class Chat(ComputeService):
+    """≈ Samples.MiniRpc.Chat (samples/MiniRpc/Service.cs:27-60)."""
+
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self._posts: tuple = ()
+
+    @compute_method
+    async def get_recent_messages(self) -> tuple:
+        return self._posts
+
+    @compute_method
+    async def get_word_count(self) -> int:
+        # get_recent_messages becomes a dependency of this node, so it gets
+        # invalidated automatically (Service.cs:38-40)
+        messages = await self.get_recent_messages()
+        return sum(len(m.split()) for m in messages)
+
+    @command_handler
+    async def post(self, command: ChatPost):
+        if is_invalidating():
+            await self.get_recent_messages()  # no need to invalidate get_word_count
+            return
+        self._posts = (self._posts + (command.message,))[-10:]
+
+
+async def main():
+    # --- server (≈ RunServer, Program.cs:18-36) ---------------------------
+    server_fusion = FusionHub()
+    server_fusion.commander.attach_operations_pipeline()
+    chat = Chat(server_fusion)
+    server_fusion.commander.add_service(chat)
+    server_rpc = RpcHub("mini-rpc-server")
+    install_compute_call_type(server_rpc)
+    server_rpc.add_service("chat", chat)
+    expose_commander(server_rpc, server_fusion.commander)
+    server = await RpcWebSocketServer(server_rpc).start()
+
+    # --- client (≈ RunClient, Program.cs:38-75) ---------------------------
+    client_rpc = RpcHub("mini-rpc-client")
+    install_compute_call_type(client_rpc)
+    client_rpc.client_connector = websocket_client_connector(server.url)
+    client_fusion = FusionHub()
+    remote_chat = compute_client("chat", client_rpc, client_fusion)
+    bridge_commands(client_fusion.commander, client_rpc, [ChatPost])
+
+    seen_messages: list = []
+    seen_counts: list = []
+    done = asyncio.Event()
+
+    async def observe_messages():
+        c_messages = await capture(lambda: remote_chat.get_recent_messages())
+        async for c in c_messages.changes():
+            print(f"Messages changed (version: {c.version}):")
+            for message in c.output.value:
+                print(f"- {message}")
+            seen_messages.append(c.output.value)
+            if len(c.output.value) >= 3:
+                break
+
+    async def observe_word_count():
+        c_count = await capture(lambda: remote_chat.get_word_count())
+        async for c in c_count.changes():
+            print(f"Word count changed: {c.output.value}")
+            seen_counts.append(c.output.value)
+            if c.output.value >= 8:
+                done.set()
+                break
+
+    observers = [
+        asyncio.ensure_future(observe_messages()),
+        asyncio.ensure_future(observe_word_count()),
+    ]
+    await asyncio.sleep(0.1)
+
+    for message in ("hello fusion", "tpu graphs cascade", "zero polling here"):
+        await client_fusion.commander.call(ChatPost(message))
+        await asyncio.sleep(0.1)
+
+    await asyncio.wait_for(done.wait(), 10.0)
+    await asyncio.wait_for(asyncio.gather(*observers), 10.0)
+    assert seen_counts[-1] == 8, seen_counts
+    print("mini-rpc OK: commands bridged over RPC, invalidations pushed back")
+
+    await client_rpc.stop()
+    await server.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

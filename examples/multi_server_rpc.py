@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""MultiServerRpc — port of the reference sample
+(samples/MultiServerRpc/Program.cs, Service.cs): TWO chat servers, each with
+its own state, and one client whose call router consistent-hashes every call
+— compute reads AND posted commands — to the server that owns the chat id
+(Program.cs:58-76). Observers watch two chats that land on different
+servers; each server only ever sees its own chat's traffic, and invalidation
+pushes arrive from the right server's socket.
+
+Run: python examples/multi_server_rpc.py
+"""
+import asyncio
+import dataclasses
+import hashlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stl_fusion_tpu.client import (
+    RpcServiceMode,
+    add_fusion_service,
+    install_compute_call_type,
+)
+from stl_fusion_tpu.commands import (
+    COMMANDER_SERVICE,
+    bridge_commands,
+    command_handler,
+    expose_commander,
+)
+from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method, is_invalidating
+from stl_fusion_tpu.rpc import RpcHub
+from stl_fusion_tpu.rpc.websocket import RpcWebSocketServer, websocket_multi_connector
+from stl_fusion_tpu.utils.serialization import wire_type
+
+SERVER_COUNT = 2
+SERVER_REFS = [f"server{i}" for i in range(SERVER_COUNT)]
+
+
+@wire_type
+@dataclasses.dataclass(frozen=True)
+class ChatPost:
+    chat_id: str
+    message: str
+
+
+class Chat(ComputeService):
+    """≈ Samples.MultiServerRpc.Chat (Service.cs:33-76) — keyed by chat id."""
+
+    def __init__(self, server_id: str, hub=None):
+        super().__init__(hub)
+        self.server_id = server_id
+        self.seen_commands = 0
+        self._chats: dict = {}
+
+    @compute_method
+    async def get_recent_messages(self, chat_id: str) -> tuple:
+        return self._chats.get(chat_id, ())
+
+    @compute_method
+    async def get_word_count(self, chat_id: str) -> int:
+        messages = await self.get_recent_messages(chat_id)
+        return sum(len(m.split()) for m in messages)
+
+    @command_handler
+    async def post(self, command: ChatPost):
+        if is_invalidating():
+            await self.get_recent_messages(command.chat_id)
+            return
+        self.seen_commands += 1
+        print(f"{self.server_id}: got {command}")
+        posts = (self._chats.get(command.chat_id, ()) + (command.message,))[-10:]
+        self._chats[command.chat_id] = posts
+
+
+def stable_hash(key: str) -> int:
+    # the reference uses Djb2 because string.GetHashCode changes run to run
+    # (Program.cs:64-66); any run-stable hash has the same property
+    return int.from_bytes(hashlib.sha1(key.encode()).digest()[:4], "little")
+
+
+def chat_router(service: str, method: str, args: tuple):
+    """Route chat reads by arg0 and bridged posts by command.chat_id."""
+    if service == "chat":
+        return SERVER_REFS[stable_hash(args[0]) % SERVER_COUNT]
+    if service == COMMANDER_SERVICE and isinstance(args[0], ChatPost):
+        return SERVER_REFS[stable_hash(args[0].chat_id) % SERVER_COUNT]
+    return "default"
+
+
+async def run_server(ref: str):
+    fusion = FusionHub()
+    fusion.commander.attach_operations_pipeline()
+    chat = Chat(ref, fusion)
+    fusion.commander.add_service(chat)
+    rpc = RpcHub(ref)
+    install_compute_call_type(rpc)
+    rpc.add_service("chat", chat)
+    expose_commander(rpc, fusion.commander)
+    server = await RpcWebSocketServer(rpc).start()
+    return chat, server
+
+
+async def main():
+    chats, servers = [], []
+    for ref in SERVER_REFS:
+        chat, server = await run_server(ref)
+        chats.append(chat)
+        servers.append(server)
+
+    client_rpc = RpcHub("client")
+    install_compute_call_type(client_rpc)
+    client_rpc.call_router = chat_router
+    client_rpc.client_connector = websocket_multi_connector(
+        {ref: server.url for ref, server in zip(SERVER_REFS, servers)}
+    )
+    client_fusion = FusionHub()
+    chat_client = add_fusion_service(RpcServiceMode.ROUTER, "chat", client_rpc, client_fusion)
+    bridge_commands(client_fusion.commander, client_rpc, [ChatPost], peer_ref=None)
+
+    # find two chat ids that land on different servers
+    by_ref: dict = {}
+    i = 0
+    while len(by_ref) < SERVER_COUNT:
+        chat_id = f"chat{i}"
+        by_ref.setdefault(chat_router("chat", "get", (chat_id,)), chat_id)
+        i += 1
+    chat_a, chat_b = by_ref["server0"], by_ref["server1"]
+    print(f"chat {chat_a!r} → server0, chat {chat_b!r} → server1")
+
+    counts = {chat_a: [], chat_b: []}
+
+    async def observe(chat_id: str, stop_at: int):
+        node = await capture(lambda: chat_client.get_word_count(chat_id))
+        async for c in node.changes():
+            print(f"[{chat_id}] word count changed: {c.output.value}")
+            counts[chat_id].append(c.output.value)
+            if c.output.value >= stop_at:
+                break
+
+    observers = [
+        asyncio.ensure_future(observe(chat_a, 4)),
+        asyncio.ensure_future(observe(chat_b, 2)),
+    ]
+    await asyncio.sleep(0.1)
+
+    commander = client_fusion.commander
+    await commander.call(ChatPost(chat_a, "hello from the hash ring"))
+    await commander.call(ChatPost(chat_b, "other shard"))
+    await asyncio.sleep(0.1)
+
+    await asyncio.wait_for(asyncio.gather(*observers), 10.0)
+    assert counts[chat_a][-1] == 5 and counts[chat_b][-1] == 2, counts
+    assert chats[0].seen_commands == 1 and chats[1].seen_commands == 1, (
+        chats[0].seen_commands,
+        chats[1].seen_commands,
+    )
+    print("multi-server OK: reads and commands sharded by chat id, pushes from the owning server")
+
+    await client_rpc.stop()
+    for server in servers:
+        await server.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

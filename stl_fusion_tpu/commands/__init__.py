@@ -2,9 +2,14 @@
 from .commander import Commander, LocalCommand
 from .context import CommandContext, current_command_context
 from .handlers import CommandHandler, HandlerRegistry, command_filter, command_handler
+from .rpc_bridge import COMMANDER_SERVICE, CommanderFacade, bridge_commands, expose_commander
 from .tracer import CommandTracer, attach_command_tracer
 
 __all__ = [
+    "COMMANDER_SERVICE",
+    "CommanderFacade",
+    "bridge_commands",
+    "expose_commander",
     "CommandTracer",
     "attach_command_tracer",
     "Commander",

@@ -22,7 +22,7 @@ from .peer import RpcClientPeer
 
 log = logging.getLogger("stl_fusion_tpu")
 
-__all__ = ["RpcWebSocketServer", "websocket_client_connector"]
+__all__ = ["RpcWebSocketServer", "websocket_client_connector", "websocket_multi_connector"]
 
 RPC_PATH = "/rpc/ws"
 
@@ -109,10 +109,37 @@ def websocket_client_connector(url: str, client_id: Optional[str] = None):
     cid = client_id or f"c-{secrets.token_hex(8)}"
 
     async def connect(peer: RpcClientPeer):
-        from websockets.asyncio.client import connect as ws_connect
-
-        sep = "&" if "?" in url else "?"
-        ws = await ws_connect(f"{url}{sep}clientId={cid}:{peer.ref}", max_size=64 * 1024 * 1024)
-        return _WsAdapter(ws)
+        return await _dial(url, cid, peer)
 
     return connect
+
+
+def websocket_multi_connector(url_by_ref, client_id: Optional[str] = None):
+    """Connector for a server pool: resolve the peer ref to its host URL
+    (≈ ``RpcWebSocketClient.Options.HostUrlResolver`` where the peer ref IS
+    the host url, samples/MultiServerRpc/Program.cs:52-55). ``url_by_ref``
+    maps peer refs to websocket URLs; together with a ``call_router`` over
+    the same refs this gives per-call sharding across servers.
+    """
+    cid = client_id or f"c-{secrets.token_hex(8)}"
+
+    async def connect(peer: RpcClientPeer):
+        # an unknown ref is a config error, not a transient network failure —
+        # fail loudly instead of entering the reconnect/backoff loop
+        url = url_by_ref.get(peer.ref)
+        if url is None:
+            raise LookupError(
+                f"no websocket URL for peer ref {peer.ref!r}; "
+                f"known refs: {sorted(url_by_ref)}"
+            )
+        return await _dial(url, cid, peer)
+
+    return connect
+
+
+async def _dial(url: str, cid: str, peer: RpcClientPeer) -> _WsAdapter:
+    from websockets.asyncio.client import connect as ws_connect
+
+    sep = "&" if "?" in url else "?"
+    ws = await ws_connect(f"{url}{sep}clientId={cid}:{peer.ref}", max_size=64 * 1024 * 1024)
+    return _WsAdapter(ws)

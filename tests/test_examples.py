@@ -30,3 +30,16 @@ def test_todo_multihost_sample():
     stdout = _run("todo_multihost.py")
     assert "after add on host A: 0/1 done" in stdout
     assert "after done on host A: 1/1 done" in stdout
+
+
+def test_mini_rpc_sample():
+    stdout = _run("mini_rpc.py")
+    assert "Word count changed: 8" in stdout
+    assert "mini-rpc OK" in stdout
+
+
+def test_multi_server_rpc_sample():
+    stdout = _run("multi_server_rpc.py")
+    assert "server0: got ChatPost" in stdout
+    assert "server1: got ChatPost" in stdout
+    assert "multi-server OK" in stdout
