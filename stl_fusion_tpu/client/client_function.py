@@ -219,11 +219,11 @@ class FusionClient:
         function = self._function
 
         async def call(*args):
-            from ..core.context import CallOptions, get_current
+            from ..core.context import OPT_INVALIDATE_BIT, get_current
 
             input = ClientComputeMethodInput(function, method, args)
             context = ComputeContext.current()
-            used_by = None if context.call_options & CallOptions.INVALIDATE else get_current()
+            used_by = None if context.call_options & OPT_INVALIDATE_BIT else get_current()
             return await function.invoke_and_strip(input, used_by, context)
 
         call.__name__ = method

@@ -32,7 +32,7 @@ from typing import TYPE_CHECKING, Any, AsyncIterator, Callable, Generic, List, O
 from ..utils.ltag import LTag
 from ..utils.result import Result
 from .consistency import ConsistencyState
-from .context import CallOptions, ComputeContext, get_current
+from .context import OPT_GET_EXISTING, CallOptions, ComputeContext, get_current
 from .options import ComputedOptions
 
 if TYPE_CHECKING:
@@ -343,7 +343,7 @@ class Computed(Generic[T]):
         """Value of the latest consistent node, registering a dependency edge
         from the currently-computing node (reference Use, Computed.cs:297-305)."""
         ctx = ComputeContext.current()
-        if ctx.call_options & CallOptions.GET_EXISTING:
+        if ctx.call_options & OPT_GET_EXISTING:
             raise RuntimeError("Computed.use() is not allowed inside a peek/invalidate scope")
         usedby = get_current()
         if self.is_consistent:

@@ -49,20 +49,31 @@ class CallOptions(enum.IntFlag):
     CAPTURE = 4
 
 
+# plain-int mirrors of the flag bits for the hot path — IntFlag's operator
+# dispatch costs ~1 µs per `&`, which dominates the memoized-hit read
+OPT_GET_EXISTING = 1
+OPT_INVALIDATE_BIT = 2  # the bit that distinguishes INVALIDATE from GET_EXISTING
+OPT_CAPTURE = 4
+
+
 class ComputeContext:
-    """Flags + a capture slot. Flyweight DEFAULT for the common case."""
+    """Flags + a capture slot. Flyweight DEFAULT for the common case.
+
+    ``call_options`` is stored as a plain ``int`` (not the IntFlag) so flag
+    tests on the hot read path are single int ops.
+    """
 
     __slots__ = ("call_options", "_captured")
 
     DEFAULT: "ComputeContext"
 
     def __init__(self, call_options: CallOptions = CallOptions.NONE):
-        self.call_options = call_options
+        self.call_options = int(call_options)
         self._captured: Optional["Computed"] = None
 
     # -- capture ----------------------------------------------------------
     def try_capture(self, computed: "Computed") -> None:
-        if self.call_options & CallOptions.CAPTURE and self._captured is None:
+        if self.call_options & OPT_CAPTURE and self._captured is None:
             self._captured = computed
 
     @property
@@ -83,7 +94,7 @@ class ComputeContext:
             _current_context.reset(token)
 
     def __repr__(self) -> str:
-        return f"ComputeContext({self.call_options!r})"
+        return f"ComputeContext({CallOptions(self.call_options)!r})"
 
 
 ComputeContext.DEFAULT = ComputeContext()
@@ -133,7 +144,7 @@ def suspend_dependency_capture():
 
 
 def is_invalidating() -> bool:
-    return bool(_current_context.get().call_options & CallOptions.INVALIDATE)
+    return bool(_current_context.get().call_options & OPT_INVALIDATE_BIT)
 
 
 class _InvalidatingScope:

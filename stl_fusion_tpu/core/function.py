@@ -27,7 +27,13 @@ from typing import TYPE_CHECKING, Optional
 from ..utils.ltag import LTag
 from ..utils.result import Result
 from .computed import Computed
-from .context import CallOptions, ComputeContext, change_current
+from .context import (
+    OPT_GET_EXISTING,
+    OPT_INVALIDATE_BIT,
+    CallOptions,
+    ComputeContext,
+    change_current,
+)
 from .options import ComputedOptions
 
 if TYPE_CHECKING:
@@ -56,7 +62,7 @@ class FunctionBase:
         # READ
         existing = self.hub.registry.get(input)
         hit = self._try_use_existing(existing, context, used_by)
-        if hit is not None or context.call_options & CallOptions.GET_EXISTING:
+        if hit is not None or context.call_options & OPT_GET_EXISTING:
             return hit
 
         # LOCK
@@ -81,7 +87,7 @@ class FunctionBase:
         computed = await self.invoke(input, used_by, context)
         if computed is None:
             return None
-        if context.call_options & CallOptions.GET_EXISTING:
+        if context.call_options & OPT_GET_EXISTING:
             # peek/invalidate modes return the (possibly stale) value without
             # raising memoized errors; callers wanting the node use capture
             out = computed._output
@@ -96,12 +102,12 @@ class FunctionBase:
         used_by: Optional[Computed],
     ) -> Optional[Computed]:
         opts = context.call_options
-        if opts & CallOptions.INVALIDATE == CallOptions.INVALIDATE:
+        if opts & OPT_INVALIDATE_BIT:
             if existing is not None:
                 existing.invalidate()
                 context.try_capture(existing)
             return existing
-        if opts & CallOptions.GET_EXISTING:
+        if opts & OPT_GET_EXISTING:
             if existing is not None:
                 context.try_capture(existing)
                 existing.renew_timeouts(False)

@@ -38,17 +38,21 @@ class ComputeMethodInput(ComputedInput):
     decorator already strips non-key args (reference skips CancellationToken,
     ComputeMethodInput.cs:20-23)."""
 
-    __slots__ = ("method_def", "service", "args")
+    __slots__ = ("method_def", "service", "args", "_function")
 
-    def __init__(self, method_def, service: Any, args: Tuple):
+    def __init__(self, method_def, service: Any, args: Tuple, function=None):
         self.method_def = method_def
         self.service = service
         self.args = args
         self._hash = hash((id(method_def), id(service), args))
+        self._function = function
 
     @property
     def function(self) -> "FunctionBase":
-        return self.method_def.get_function(self.service)
+        fn = self._function
+        if fn is None:
+            fn = self._function = self.method_def.get_function(self.service)
+        return fn
 
     async def invoke_original(self):
         """Call the user's method body (≈ InvokeOriginalFunction,

@@ -35,7 +35,9 @@ class Timeouts:
         )
 
     def keep_alive(self, computed: "Computed", duration: float) -> None:
-        self._keep_alive.add_or_update_to_later(computed, self.clock.now() + duration)
+        self._keep_alive.add_or_update_to_later(
+            computed, self.clock.now() + duration, grid=duration / 64.0
+        )
 
     def schedule_invalidate(self, computed: "Computed", delay: float) -> None:
         self._invalidate.add_or_update(computed, self.clock.now() + delay)

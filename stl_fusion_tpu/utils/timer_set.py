@@ -57,13 +57,26 @@ class ConcurrentTimerSet(Generic[T]):
     def add_or_update(self, item: T, fire_at: float) -> None:
         seq = next(self._seq)
         self._entries[item] = (seq, fire_at)
+        was_empty = not self._heap
         heapq.heappush(self._heap, (fire_at, seq, item))
         self._ensure_running()
-        if self._wake is not None:
+        # the loop ticks every quantum while the heap is non-empty; a wake
+        # is only needed to un-park it from the empty-heap idle wait
+        if was_empty and self._wake is not None:
             self._wake.set()
 
-    def add_or_update_to_later(self, item: T, fire_at: float) -> None:
-        """Only move the deadline forward (keep-alive renewal semantics)."""
+    def add_or_update_to_later(self, item: T, fire_at: float, grid: float = 0.0) -> None:
+        """Only move the deadline forward (keep-alive renewal semantics).
+
+        Deadlines snap up to a grid — at least the quantum, or the caller's
+        coarser ``grid`` — so renewals inside one grid cell are a dict probe
+        + compare with no heap churn (the reference's ConcurrentTimer
+        quantum dedup, ConcurrentTimerSet.cs:12-38). Keep-alive callers pass
+        ``grid = duration/64``: firing up to ~1.6% late is invisible there,
+        and it caps heap pushes at 64 per item per lifetime.
+        """
+        q = self._quanta if grid < self._quanta else grid
+        fire_at = (fire_at // q + 1.0) * q
         cur = self._entries.get(item)
         if cur is None or fire_at > cur[1]:
             self.add_or_update(item, fire_at)

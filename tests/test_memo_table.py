@@ -1,0 +1,82 @@
+"""MemoTable — the vectorized memoized-read path (the TPU-first re-design
+of the reference's READ benchmark hot path, PerformanceTest.cs:32-144)."""
+import numpy as np
+import pytest
+
+from stl_fusion_tpu.ops.memo_table import MemoTable
+
+
+def make_table(n=256, row_shape=()):
+    calls = []
+
+    def compute(ids):
+        calls.append(np.array(ids))
+        if row_shape:
+            return np.stack([np.full(row_shape, i, dtype=np.float32) * 2.0 for i in ids])
+        return ids.astype(np.float32) * 2.0
+
+    return MemoTable(n, compute, row_shape=row_shape), calls
+
+
+def test_read_batch_computes_once_then_gathers():
+    table, calls = make_table()
+    ids = np.array([3, 7, 3, 11], dtype=np.int32)
+    out = np.asarray(table.read_batch(ids))
+    np.testing.assert_allclose(out, [6.0, 14.0, 6.0, 22.0])
+    assert len(calls) == 1 and sorted(calls[0].tolist()) == [3, 7, 11]  # deduped
+    # all-fresh read: no recompute
+    out2 = np.asarray(table.read_batch([7, 11]))
+    np.testing.assert_allclose(out2, [14.0, 22.0])
+    assert len(calls) == 1
+
+
+def test_invalidate_triggers_refresh_on_next_read():
+    table, calls = make_table()
+    table.read_batch([1, 2, 3])
+    v0 = table.version
+    table.invalidate([2])
+    assert table.version > v0
+    assert table.stale_count() == 256 - 3 + 1
+    table.read_batch([1, 2, 3])
+    assert len(calls) == 2 and calls[1].tolist() == [2]
+
+
+def test_on_invalidate_bridges_to_subscribers():
+    table, _ = make_table()
+    seen = []
+    table.on_invalidate.append(lambda ids: seen.append(ids.tolist()))
+    table.read_batch([5])
+    table.invalidate([5, 9])
+    assert seen == [[5, 9]]
+    table.invalidate_all()
+    assert len(seen[1]) == 256
+
+
+def test_valid_bits_pack_matches_mask():
+    table, _ = make_table(n=70)
+    table.refresh([0, 31, 32, 69])
+    bits = np.asarray(table.valid_bits())
+    assert bits.shape == (3,)
+    assert bits[0] == (1 | (1 << 31))
+    assert bits[1] == 1
+    assert bits[2] == 1 << (69 - 64)
+    mask = np.asarray(table.valid_mask)
+    assert mask.sum() == 4 and mask[[0, 31, 32, 69]].all()
+
+
+def test_matrix_rows():
+    table, calls = make_table(n=16, row_shape=(4,))
+    out = np.asarray(table.read_batch([2, 5]))
+    assert out.shape == (2, 4)
+    np.testing.assert_allclose(out[0], 4.0)
+    np.testing.assert_allclose(out[1], 10.0)
+
+
+async def test_changed_event_stream():
+    import asyncio
+
+    table, _ = make_table()
+    ev = table.changed
+    table.refresh([1])
+    nxt = await asyncio.wait_for(ev.when_next(), 1.0)
+    assert nxt.value == table.version

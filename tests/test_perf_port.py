@@ -1,0 +1,27 @@
+"""The ComputedPerformanceTest port runs green in --quick mode and the
+memoization orderings hold (the reference gates the full run the same way:
+[Fact(Skip="Performance")], PerformanceTest.cs:31; numbers live in PERF.md)."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_read_throughput_quick():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "perf", "read_throughput.py"), "--quick"],
+        capture_output=True,
+        text=True,
+        timeout=400,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    # memoized scalar reads beat raw DB reads; the device-chained columnar
+    # path beats everything by orders of magnitude
+    assert summary["fusion_scalar"] > summary["no_fusion"]
+    assert summary["fusion_device_chained"] > 10 * summary["fusion_scalar"]
+    # ~1000 distinct keys + occasional churn → DB reads stay near key count
+    assert summary["speedup_scalar_vs_none"] > 1.0
