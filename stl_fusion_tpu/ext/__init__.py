@@ -6,10 +6,17 @@ from .auth import (
     SessionInfo,
     SignInCommand,
     SignOutCommand,
+    SqliteAuthService,
     User,
 )
 from .fusion_time import FusionTime
-from .kv_store import KeyValueStore, RemoveCommand, SetCommand
+from .kv_store import (
+    KeyValueStore,
+    RemoveCommand,
+    SandboxedKeyValueStore,
+    SetCommand,
+    SqliteKeyValueStore,
+)
 from .multitenancy import (
     PerTenantWorkerHost,
     Tenant,
@@ -40,7 +47,10 @@ __all__ = [
     "FusionTime",
     "KeyValueStore",
     "RemoveCommand",
+    "SandboxedKeyValueStore",
     "SetCommand",
+    "SqliteAuthService",
+    "SqliteKeyValueStore",
     "PerTenantWorkerHost",
     "Tenant",
     "TenantNotFoundError",

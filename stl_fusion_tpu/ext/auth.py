@@ -19,7 +19,15 @@ from ..core.service import ComputeService, compute_method
 from ..utils.serialization import wire_type
 from .session import Session
 
-__all__ = ["User", "SessionInfo", "SignInCommand", "SignOutCommand", "EditUserCommand", "InMemoryAuthService"]
+__all__ = [
+    "User",
+    "SessionInfo",
+    "SignInCommand",
+    "SignOutCommand",
+    "EditUserCommand",
+    "InMemoryAuthService",
+    "SqliteAuthService",
+]
 
 
 @wire_type("AuthUser")
@@ -41,6 +49,11 @@ class SessionInfo:
     user_id: str = ""
     created_at: float = 0.0
     last_seen_at: float = 0.0
+    # forced sign-out is a flag ON the session row, exactly like the
+    # reference (DbSessionInfo.IsSignOutForced): the row survives sign-out,
+    # sign-in throws while it's set, sign-out no-ops while it's set
+    # (DbAuthService.cs:84-92, DbAuthService.Backend.cs:42-43)
+    is_sign_out_forced: bool = False
 
     @property
     def is_authenticated(self) -> bool:
@@ -69,33 +82,53 @@ class EditUserCommand:
 
 
 class InMemoryAuthService(ComputeService):
-    """IAuth + IAuthBackend in one in-memory service."""
+    """IAuth + IAuthBackend in one service. In-memory by default; the
+    storage hooks are the override surface for durable backends
+    (`SqliteAuthService` ≈ DbAuthService)."""
 
     def __init__(self, hub: Optional[FusionHub] = None):
         super().__init__(hub)
         self._sessions: Dict[str, SessionInfo] = {}
         self._users: Dict[str, User] = {}
 
+    # ---------------------------------------------------------- storage hooks
+    def _load_session(self, session_id: str) -> Optional[SessionInfo]:
+        return self._sessions.get(session_id)
+
+    def _store_session(self, info: SessionInfo) -> None:
+        self._sessions[info.session_id] = info
+
+    def _load_user(self, user_id: str) -> Optional[User]:
+        return self._users.get(user_id)
+
+    def _store_user(self, user: User) -> None:
+        self._users[user.id] = user
+
+    def _session_ids_of(self, user_id: str) -> tuple:
+        return tuple(
+            sorted(sid for sid, i in self._sessions.items() if user_id and i.user_id == user_id)
+        )
+
     # ------------------------------------------------------------------ reads (IAuth)
     @compute_method
     async def get_session_info(self, session: Session) -> Optional[SessionInfo]:
-        return self._sessions.get(session.id)
+        return self._load_session(session.id)
 
     @compute_method
     async def get_user(self, session: Session) -> Optional[User]:
         info = await self.get_session_info(session)
         if info is None or not info.user_id:
             return None
-        return self._users.get(info.user_id)
+        return self._load_user(info.user_id)
 
     @compute_method
     async def is_sign_out_forced(self, session: Session) -> bool:
-        info = self._sessions.get(session.id)
-        return info is None and session.id in getattr(self, "_forced_out", set())
+        info = self._load_session(session.id)
+        return info is not None and info.is_sign_out_forced
 
     @compute_method
     async def get_user_sessions(self, user_id: str) -> tuple:
-        return tuple(sorted(sid for sid, i in self._sessions.items() if i.user_id == user_id))
+        return self._session_ids_of(user_id)
 
     # ------------------------------------------------------------------ commands
     @command_handler
@@ -105,12 +138,19 @@ class InMemoryAuthService(ComputeService):
             await self.get_user_sessions(command.user.id)
             return
         now = time.time()
-        self._users[command.user.id] = command.user
-        self._sessions[command.session.id] = SessionInfo(
-            session_id=command.session.id,
-            user_id=command.user.id,
-            created_at=now,
-            last_seen_at=now,
+        existing = self._load_session(command.session.id)
+        if existing is not None and existing.is_sign_out_forced:
+            # a force-signed-out session is permanently unavailable
+            # (DbAuthService.Backend.cs:42-43, Errors.SessionUnavailable)
+            raise PermissionError("session is unavailable (forced sign-out)")
+        self._store_user(command.user)
+        self._store_session(
+            SessionInfo(
+                session_id=command.session.id,
+                user_id=command.user.id,
+                created_at=existing.created_at if existing is not None else now,
+                last_seen_at=now,
+            )
         )
 
     @command_handler
@@ -118,25 +158,107 @@ class InMemoryAuthService(ComputeService):
         if is_invalidating():
             await self._invalidate_session(command.session)
             return
-        info = self._sessions.pop(command.session.id, None)
-        if command.force:
-            if not hasattr(self, "_forced_out"):
-                self._forced_out = set()
-            self._forced_out.add(command.session.id)
-        _ = info
+        info = self._load_session(command.session.id)
+        if info is not None and info.is_sign_out_forced:
+            return  # already forced out — no-op (DbAuthService.cs:84-85)
+        now = time.time()
+        base = info if info is not None else SessionInfo(command.session.id, created_at=now)
+        self._store_session(
+            dataclasses.replace(
+                base, user_id="", last_seen_at=now, is_sign_out_forced=command.force
+            )
+        )
 
     @command_handler
     async def edit_user(self, command: EditUserCommand):
         if is_invalidating():
             await self._invalidate_session(command.session)
             return
-        info = self._sessions.get(command.session.id)
+        info = self._load_session(command.session.id)
         if info is None or not info.user_id:
             raise PermissionError("not signed in")
-        user = self._users[info.user_id]
-        self._users[info.user_id] = dataclasses.replace(user, name=command.name)
+        user = self._load_user(info.user_id)
+        self._store_user(dataclasses.replace(user, name=command.name))
 
     async def _invalidate_session(self, session: Session) -> None:
         await self.get_session_info(session)
         await self.get_user(session)
         await self.is_sign_out_forced(session)
+
+
+class SqliteAuthService(InMemoryAuthService):
+    """Durable auth over stdlib sqlite (≈ DbAuthService,
+    Ext.Services/Authentication/Services/DbAuthService.cs — store-agnostic
+    because no external DB exists in-image). Sessions and users survive
+    restarts; the compute/command surface and invalidation semantics are
+    inherited unchanged — only the storage hooks differ."""
+
+    def __init__(self, path: str, hub: Optional[FusionHub] = None):
+        import json
+        import sqlite3
+
+        super().__init__(hub)
+        self._json = json
+        self._db = sqlite3.connect(path)
+        self._db.executescript(
+            "CREATE TABLE IF NOT EXISTS auth_users ("
+            " id TEXT PRIMARY KEY, name TEXT, claims TEXT);"
+            "CREATE TABLE IF NOT EXISTS auth_sessions ("
+            " session_id TEXT PRIMARY KEY, user_id TEXT,"
+            " created_at REAL, last_seen_at REAL, is_sign_out_forced INTEGER);"
+        )
+        self._db.commit()
+
+    def _load_session(self, session_id: str) -> Optional[SessionInfo]:
+        row = self._db.execute(
+            "SELECT session_id, user_id, created_at, last_seen_at, is_sign_out_forced"
+            " FROM auth_sessions WHERE session_id=?",
+            (session_id,),
+        ).fetchone()
+        if row is None:
+            return None
+        return SessionInfo(row[0], row[1], row[2], row[3], bool(row[4]))
+
+    def _store_session(self, info: SessionInfo) -> None:
+        # full-row upsert in ONE statement: the session row (incl. the
+        # forced flag) can never be torn by a crash between writes
+        self._db.execute(
+            "INSERT OR REPLACE INTO auth_sessions VALUES (?,?,?,?,?)",
+            (
+                info.session_id,
+                info.user_id,
+                info.created_at,
+                info.last_seen_at,
+                int(info.is_sign_out_forced),
+            ),
+        )
+        self._db.commit()
+
+    def _load_user(self, user_id: str) -> Optional[User]:
+        row = self._db.execute(
+            "SELECT id, name, claims FROM auth_users WHERE id=?", (user_id,)
+        ).fetchone()
+        if row is None:
+            return None
+        claims = tuple(tuple(c) for c in self._json.loads(row[2] or "[]"))
+        return User(row[0], row[1], claims)
+
+    def _store_user(self, user: User) -> None:
+        self._db.execute(
+            "INSERT INTO auth_users VALUES (?,?,?) ON CONFLICT(id) DO UPDATE SET"
+            " name=excluded.name, claims=excluded.claims",
+            (user.id, user.name, self._json.dumps([list(c) for c in user.claims])),
+        )
+        self._db.commit()
+
+    def _session_ids_of(self, user_id: str) -> tuple:
+        if not user_id:
+            return ()
+        rows = self._db.execute(
+            "SELECT session_id FROM auth_sessions WHERE user_id=? ORDER BY session_id",
+            (user_id,),
+        ).fetchall()
+        return tuple(r[0] for r in rows)
+
+    def close(self) -> None:
+        self._db.close()

@@ -1,11 +1,13 @@
 """Device kernels: the invalidation-wave BFS (jit) + pallas variants +
 the vectorized memoization table."""
+from .memo_bridge import MemoTableBridge
 from .memo_table import MemoTable
 from .wave import GraphArrays, run_wave, run_wave_with_stats, seeds_to_frontier, wave_step
 
 __all__ = [
     "GraphArrays",
     "MemoTable",
+    "MemoTableBridge",
     "run_wave",
     "run_wave_with_stats",
     "seeds_to_frontier",

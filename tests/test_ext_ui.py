@@ -222,3 +222,108 @@ async def test_fusion_monitor_hit_ratio(fresh_hub):
     assert report["computes"] >= 1
     assert report["accesses"] >= 10
     assert report["hit_ratio"] > 0.5
+
+
+# ------------------------------------------------------------------ durable variants
+
+async def test_sqlite_kv_store_survives_restart(fresh_hub, tmp_path):
+    from stl_fusion_tpu.ext import SqliteKeyValueStore
+
+    path = str(tmp_path / "kv.sqlite")
+    kv = SqliteKeyValueStore(path, fresh_hub)
+    fresh_hub.commander.add_service(kv)
+    listing = await capture(lambda: kv.count_by_prefix("user/"))
+    await fresh_hub.commander.call(SetCommand("user/alice", "1"))
+    assert await kv.get("user/alice") == "1"
+    assert listing.is_invalidated
+    kv.close()
+
+    # a fresh hub + store over the same file sees the data (warm boot)
+    hub2 = FusionHub()
+    hub2.commander.attach_operations_pipeline()
+    kv2 = SqliteKeyValueStore(path, hub2)
+    hub2.commander.add_service(kv2)
+    assert await kv2.get("user/alice") == "1"
+    assert await kv2.list_key_suffixes("user/") == ("alice",)
+    await hub2.commander.call(RemoveCommand("user/alice"))
+    assert await kv2.get("user/alice") is None
+    kv2.close()
+
+
+async def test_sandboxed_kv_store_isolates_sessions(fresh_hub):
+    from stl_fusion_tpu.ext import SandboxedKeyValueStore
+
+    kv = KeyValueStore(fresh_hub)
+    fresh_hub.commander.add_service(kv)
+    alice = SandboxedKeyValueStore(kv, Session.new())
+    bob = SandboxedKeyValueStore(kv, Session.new())
+
+    await alice.set("theme", "dark")
+    await bob.set("theme", "light")
+    assert await alice.get("theme") == "dark"
+    assert await bob.get("theme") == "light"
+    assert await alice.list_keys() == ("theme",)
+
+    # invalidation flows through the sandbox view (writes ride the commander)
+    node = await capture(lambda: kv.get(alice.prefix + "theme"))
+    await alice.set("theme", "solar")
+    assert node.is_invalidated
+    assert await alice.get("theme") == "solar"
+    await alice.remove("theme")
+    assert await alice.get("theme") is None
+    assert await bob.get("theme") == "light"
+
+
+async def test_sqlite_auth_survives_restart(fresh_hub, tmp_path):
+    from stl_fusion_tpu.ext import SqliteAuthService
+
+    path = str(tmp_path / "auth.sqlite")
+    auth = SqliteAuthService(path, fresh_hub)
+    fresh_hub.commander.add_service(auth)
+    session = Session.new()
+    user_node = await capture(lambda: auth.get_user(session))
+    assert user_node.value is None
+    await fresh_hub.commander.call(
+        SignInCommand(session, User("u1", "Alice", (("role", "admin"),)))
+    )
+    assert user_node.is_invalidated
+    user = await auth.get_user(session)
+    assert user.name == "Alice" and user.claims == (("role", "admin"),)
+    assert await auth.get_user_sessions("u1") == (session.id,)
+    auth.close()
+
+    hub2 = FusionHub()
+    hub2.commander.attach_operations_pipeline()
+    auth2 = SqliteAuthService(path, hub2)
+    hub2.commander.add_service(auth2)
+    user = await auth2.get_user(session)  # session survived the restart
+    assert user is not None and user.name == "Alice"
+    await hub2.commander.call(SignOutCommand(session, force=True))
+    assert await auth2.get_user(session) is None
+    assert await auth2.is_sign_out_forced(session)
+    auth2.close()
+
+
+async def test_forced_sign_out_semantics(fresh_hub):
+    """The reference's rules (DbAuthService.cs:84-92, Backend.cs:42-43):
+    the forced flag lives on the session row; sign-in throws while set;
+    plain sign-out does not set it; created_at survives re-sign-in."""
+    auth = InMemoryAuthService(fresh_hub)
+    fresh_hub.commander.add_service(auth)
+    session = Session.new()
+
+    await fresh_hub.commander.call(SignInCommand(session, User("u1", "Alice")))
+    info1 = await auth.get_session_info(session)
+    await fresh_hub.commander.call(SignOutCommand(session))  # plain sign-out
+    assert not await auth.is_sign_out_forced(session)
+    await fresh_hub.commander.call(SignInCommand(session, User("u1", "Alice")))
+    info2 = await auth.get_session_info(session)
+    assert info2.created_at == info1.created_at  # row survived, not recreated
+
+    await fresh_hub.commander.call(SignOutCommand(session, force=True))
+    assert await auth.is_sign_out_forced(session)
+    with pytest.raises(PermissionError):
+        await fresh_hub.commander.call(SignInCommand(session, User("u1", "Alice")))
+    # repeated sign-out of a forced-out session is a no-op, flag stays
+    await fresh_hub.commander.call(SignOutCommand(session))
+    assert await auth.is_sign_out_forced(session)

@@ -80,3 +80,84 @@ async def test_changed_event_stream():
     table.refresh([1])
     nxt = await asyncio.wait_for(ev.when_next(), 1.0)
     assert nxt.value == table.version
+
+
+async def test_bridge_row_deps_cascade_into_scalar_graph():
+    import asyncio
+
+    from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method
+    from stl_fusion_tpu.ops import MemoTableBridge
+
+    table, _ = make_table()
+    hub = FusionHub()
+    bridge = MemoTableBridge(table, hub)
+
+    class Aggregates(ComputeService):
+        @compute_method
+        async def sum_of(self, *ids) -> float:
+            await bridge.use_rows(ids)
+            return float(np.asarray(table.read_batch(list(ids))).sum())
+
+    agg = Aggregates(hub)
+    node = await capture(lambda: agg.sum_of(2, 4))
+    assert node.value == 4.0 + 8.0
+    assert bridge.live_row_leaves() == 2
+
+    # invalidating a row the aggregate used cascades into the scalar graph
+    table.invalidate([4])
+    await asyncio.wait_for(node.when_invalidated(), 1.0)
+    assert await agg.sum_of(2, 4) == 12.0
+
+    # invalidating an unrelated row does NOT invalidate the aggregate
+    node2 = await capture(lambda: agg.sum_of(2, 4))
+    assert node2.is_consistent
+    table.invalidate([50])
+    await asyncio.sleep(0.05)
+    assert node2.is_consistent
+
+
+async def test_bridge_table_dep_cascades_on_any_row():
+    import asyncio
+
+    from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method
+    from stl_fusion_tpu.ops import MemoTableBridge
+
+    table, _ = make_table(n=64)
+    hub = FusionHub()
+    bridge = MemoTableBridge(table, hub)
+
+    class Aggregates(ComputeService):
+        @compute_method
+        async def grand_total(self) -> float:
+            await bridge.use_table()
+            return float(np.asarray(table.read_batch(np.arange(64))).sum())
+
+    agg = Aggregates(hub)
+    node = await capture(lambda: agg.grand_total())
+    first = node.value
+    table.invalidate([63])
+    await asyncio.wait_for(node.when_invalidated(), 1.0)
+    assert await agg.grand_total() == first  # same data, recomputed fresh
+
+
+async def test_bridge_detach_stops_cascading():
+    from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method
+    from stl_fusion_tpu.ops import MemoTableBridge
+
+    table, _ = make_table()
+    hub = FusionHub()
+    bridge = MemoTableBridge(table, hub)
+
+    class Aggregates(ComputeService):
+        @compute_method
+        async def one(self) -> float:
+            await bridge.use_rows([3])
+            return float(np.asarray(table.read_batch([3]))[0])
+
+    agg = Aggregates(hub)
+    node = await capture(lambda: agg.one())
+    bridge.detach()
+    table.invalidate([3])
+    assert node.is_consistent  # detached: no cascade
+    assert bridge.live_row_leaves() == 0
+    assert len(table.on_invalidate) == 0
