@@ -50,7 +50,10 @@ class ShardedGraphArrays(NamedTuple):
 def build_sharded_wave(mesh: Mesh, n_global: int, exchange: str = "packed"):
     """Compile the sharded wave for a mesh + node capacity.
 
-    Returns ``wave(seed_frontier, g) -> (g, newly_invalidated_count)``.
+    Returns a ``(wave, wave_chain)`` pair:
+    - ``wave(seed_frontier, g) -> (g, newly_invalidated_count)`` — one wave;
+    - ``wave_chain(seed_mat, g, reset_between) -> (g, total, counts)`` —
+      ``seed_mat.shape[0]`` waves in one compiled program (single readback).
 
     ``exchange`` selects the per-level frontier collective:
     - ``"packed"`` (default): the local frontier bit-packs into uint32 words
@@ -143,7 +146,26 @@ def build_sharded_wave(mesh: Mesh, n_global: int, exchange: str = "packed"):
         )
         return g._replace(invalid=invalid, node_epoch=node_epoch), count
 
-    return wave
+    @functools.partial(jax.jit, static_argnums=2)
+    def wave_chain(seed_mat: jax.Array, g: ShardedGraphArrays, reset_between: bool):
+        """W waves in ONE compiled program with a single readback — the
+        multi-chip analogue of the single-chip bench's lax.scan batching
+        (per-wave host dispatch pays a relay/dispatch round trip each; the
+        chain pays it once). ``reset_between`` clears ``invalid`` before
+        each wave (the bench's churn model: the graph is re-consistent
+        between waves)."""
+
+        def body(carry, seeds):
+            g, total = carry
+            if reset_between:
+                g = g._replace(invalid=jnp.zeros_like(g.invalid))
+            g, count = wave(seeds, g)
+            return (g, total + count), count
+
+        (g, total), counts = lax.scan(body, (g, jnp.int32(0)), seed_mat)
+        return g, total, counts
+
+    return wave, wave_chain
 
 
 class ShardedDeviceGraph:
@@ -207,7 +229,9 @@ class ShardedDeviceGraph:
             invalid=jax.device_put(np.zeros(self.n_global, dtype=bool), node_sh),
         )
         self._node_sharding = node_sh
-        self._wave = build_sharded_wave(self.mesh, self.n_global, exchange=exchange)
+        self._wave, self._wave_chain = build_sharded_wave(
+            self.mesh, self.n_global, exchange=exchange
+        )
 
     # ------------------------------------------------------------------ waves
     def seeds_to_frontier(self, seed_ids: Sequence[int]) -> jax.Array:
@@ -222,6 +246,27 @@ class ShardedDeviceGraph:
     def run_wave_frontier(self, frontier: jax.Array) -> int:
         self.g, count = self._wave(frontier, self.g)
         return int(count)
+
+    def prepare_seed_mat(self, seed_mat: np.ndarray) -> jax.Array:
+        """Pad a bool[W, n_nodes] seed matrix to the mesh capacity and
+        upload it sharded — call once, outside any timed region."""
+        W, n = seed_mat.shape
+        if n < self.n_global:
+            seed_mat = np.pad(seed_mat, ((0, 0), (0, self.n_global - n)))
+        sharding = NamedSharding(self.mesh, P(None, GRAPH_AXIS))
+        return jax.device_put(np.asarray(seed_mat, dtype=bool), sharding)
+
+    def run_waves_chained(
+        self, seed_mat, reset_between: bool = True
+    ) -> Tuple[int, np.ndarray]:
+        """Run ``seed_mat.shape[0]`` waves in one compiled program; returns
+        (total, per-wave counts). ``seed_mat`` is bool[W, n_nodes-or-global]
+        (numpy, uploaded per call) or a device array from
+        ``prepare_seed_mat`` (no transfer cost)."""
+        if isinstance(seed_mat, np.ndarray):
+            seed_mat = self.prepare_seed_mat(seed_mat)
+        self.g, total, counts = self._wave_chain(seed_mat, self.g, reset_between)
+        return int(total), np.asarray(counts)
 
     # ------------------------------------------------------------------ readback
     def invalid_mask(self) -> np.ndarray:

@@ -89,3 +89,28 @@ def test_ring_exchange_matches_bool():
     seeds = rng.choice(n, size=6, replace=False).tolist()
     assert ring.run_wave(seeds) == plain.run_wave(seeds)
     np.testing.assert_array_equal(ring.invalid_mask(), plain.invalid_mask())
+
+
+def test_chained_waves_match_per_wave_runs():
+    """run_waves_chained == W separate run_wave calls with resets."""
+    from stl_fusion_tpu.graph.synthetic import power_law_dag
+
+    n = 512
+    (src, dst) = power_law_dag(n, avg_degree=3.0, seed=3)
+    rng = np.random.default_rng(5)
+    seed_mat = np.zeros((4, n), dtype=bool)
+    for i in range(4):
+        seed_mat[i, rng.choice(n, size=16, replace=False)] = True
+
+    a = ShardedDeviceGraph(src, dst, n, mesh=graph_mesh())
+    per_wave = []
+    for i in range(4):
+        a.clear_invalid()
+        per_wave.append(a.run_wave(np.flatnonzero(seed_mat[i]).tolist()))
+
+    b = ShardedDeviceGraph(src, dst, n, mesh=graph_mesh())
+    total, counts = b.run_waves_chained(seed_mat)
+    assert counts.tolist() == per_wave
+    assert total == sum(per_wave)
+    # final invalid mask equals the last per-wave run's mask
+    np.testing.assert_array_equal(b.invalid_mask(), a.invalid_mask())
