@@ -20,7 +20,10 @@ cascading invalidations/sec on this graph class.)
 
 Env knobs: FUSION_BENCH_NODES (default 10_000_000), FUSION_BENCH_DEG (3),
 FUSION_BENCH_SEEDS (100_000 per wave), FUSION_BENCH_WAVES (20),
-FUSION_BENCH_SHARDED=1 → mesh-sharded dense wave over all devices.
+FUSION_BENCH_WORDS (topo row width in uint32 lanes, default 16 = 512 packed
+waves per sweep), FUSION_BENCH_LATENCY=1 → on-device single-wave latency
+sampling (second long compile), FUSION_BENCH_SHARDED=1 → mesh-sharded dense
+wave over all devices.
 """
 import json
 import os
@@ -57,6 +60,12 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
     kernel = os.environ.get("FUSION_BENCH_KERNEL", "topo")
     if kernel not in ("topo", "hybrid", "pull"):
         raise SystemExit(f"FUSION_BENCH_KERNEL must be 'topo', 'hybrid' or 'pull', got {kernel!r}")
+    # waves packed per sweep word-row (topo only): 16 words = 512 waves/pass.
+    # The sweep is bound by random row fetches; wider rows ride the same HBM
+    # transactions, multiplying invalidation throughput at ~the same time
+    # (measured at 10M nodes: W=1 → 1.0B inv/s, W=8 → 4.0B, W=16 → 7.7B,
+    # W=32 → 8.3B but 2x the pass time — W=16 is the knee).
+    words = int(os.environ.get("FUSION_BENCH_WORDS", 16)) if kernel == "topo" else 1
     t0 = time.time()
     src, dst = power_law_dag(n_nodes, avg_degree=avg_deg, seed=7)
     if kernel == "topo":
@@ -69,7 +78,7 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
     build_s = time.time() - t0
 
     if kernel == "topo":
-        state0, wave32 = build_topo_wave32(graph)
+        state0, wave32 = build_topo_wave32(graph, words=words)
     elif kernel == "hybrid":
         state0, wave32 = build_hybrid_wave32(graph, tail_cap=tail_cap)
     else:
@@ -77,35 +86,40 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
     garrays = wave32.garrays  # device-resident; threaded through jit as args
     # (closure-captured graph constants would ride the compile payload —
     # hundreds of MB at 10M nodes — and overflow the remote-compile relay)
-    n_batches = max(n_waves // 32, 1)
+    waves_per_batch = 32 * words
+    n_batches = max(n_waves // waves_per_batch, 1)
 
     def make_seed_bits(seed_lists):
         if kernel == "topo":
-            return topo_seeds_to_bits(graph, seed_lists)
+            return topo_seeds_to_bits(graph, seed_lists, words=words)
         return seeds_to_bits(graph.n_tot, seed_lists)
 
     seed_mats = np.stack(
         [
             make_seed_bits(
-                [rng.choice(n_nodes, size=seeds_per_wave, replace=False) for _ in range(32)],
+                [
+                    rng.choice(n_nodes, size=seeds_per_wave, replace=False)
+                    for _ in range(waves_per_batch)
+                ],
             )
             for _ in range(n_batches)
         ]
     )
     seed_mats = jnp.asarray(seed_mats)
-    n_waves = n_batches * 32
+    n_waves = n_batches * waves_per_batch
 
     @jax.jit
     def run_all(garrays, seed_mats, state):
-        def body(carry, seed_bits):
-            state, total = carry
+        def body(state, seed_bits):
             # churn model: the graph is fully consistent before each batch
             # (nodes "recomputed" between batches), so every wave cascades
             state = state._replace(invalid_bits=jnp.zeros_like(state.invalid_bits))
             state, count = wave32.impl(garrays, seed_bits, state)
-            return (state, total + count), count
-        (state, total), counts = lax.scan(body, (state, jnp.int32(0)), seed_mats)
-        return state, total, counts
+            return state, count
+        # counts: [batches] (scalar kernels) or [batches, words]; per-word
+        # counts are int32-safe, the TOTAL may not be — summed in int64 host-side
+        state, counts = lax.scan(body, state, seed_mats)
+        return state, counts
 
     # measure host-sync overhead of this environment (relay round trip)
     x = jnp.zeros(8)
@@ -117,14 +131,14 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
 
     # warmup / compile
     t0 = time.time()
-    _, total, _ = run_all(garrays, seed_mats, state0)
-    total = int(total)
+    _, counts = run_all(garrays, seed_mats, state0)
+    total = int(np.asarray(counts, dtype=np.int64).sum())
     compile_s = time.time() - t0
 
     # timed run: one readback for the whole run
     t0 = time.perf_counter()
-    _, total, counts = run_all(garrays, seed_mats, state0)
-    total = int(total)
+    _, counts = run_all(garrays, seed_mats, state0)
+    total = int(np.asarray(counts, dtype=np.int64).sum())
     raw_elapsed = time.perf_counter() - t0
     # subtracting the measured relay RTT is only meaningful when the run
     # dwarfs it (the default 10M-node config does); on tiny smoke configs
@@ -136,27 +150,43 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
         # low-latency path a lone invalidate() takes) — opt-in: it costs a
         # second long compile at 10M scale. Seeds are shallow nodes (high
         # ids = few transitive dependents), the shape of a typical edit.
+        # Per-dispatch timing through this environment's relay measures the
+        # tunnel (multiple ~70ms RTTs), so the wave is REPEATED inside one
+        # jit (lax.scan) and elapsed/reps is the on-device wave latency.
         ell = build_ell(src, dst, n_nodes, k=4)
         ell_state, ell_wave = build_ell_wave(ell)
         lat_seeds = jnp.asarray(
             (n_nodes - 1 - rng.choice(n_nodes // 100, size=min(256, n_nodes // 100), replace=False)).astype(np.int32)
         )
-        st, c = ell_wave(lat_seeds, ell_state)  # compile
-        int(c)
+        ell_garrays = ell_wave.garrays
+        reps = int(os.environ.get("FUSION_BENCH_LATENCY_REPS", 64))
+
+        @jax.jit
+        def lat_chain(garrays, seeds, state):
+            def body(st, _):
+                st = st._replace(invalid=jnp.zeros_like(st.invalid))
+                st, c = ell_wave.step(garrays, seeds, st)
+                return st, c
+
+            return lax.scan(body, state, None, length=reps)
+
+        _st, cs = lat_chain(ell_garrays, lat_seeds, ell_state)  # compile
+        int(cs[0])
         lat = []
-        for _ in range(5):
-            st = st._replace(invalid=jnp.zeros_like(st.invalid))
+        for _ in range(3):
             t0 = time.perf_counter()
-            st, c = ell_wave(lat_seeds, st)
-            int(c)
-            lat.append(max(time.perf_counter() - t0 - sync_overhead, 1e-6))
+            _st, cs = lat_chain(ell_garrays, lat_seeds, ell_state)
+            int(cs[0])
+            lat.append(max((time.perf_counter() - t0 - sync_overhead) / reps, 1e-9))
     else:
-        # amortized per-wave time from the timed run (32 waves ride a batch)
-        lat = [elapsed / max(n_batches, 1) / 32] * 3
+        # amortized per-wave time from the timed run (a batch carries
+        # waves_per_batch packed waves)
+        lat = [elapsed / max(n_batches, 1) / waves_per_batch] * 3
 
     return {
         "total_invalidated": total,
         "elapsed_s": max(elapsed, 1e-9),
+        "waves": n_waves,
         "kernel": kernel,
         "wave_ms_p50": float(np.percentile(np.asarray(lat) * 1e3, 50)),
         "wave_ms_p99": float(np.percentile(np.asarray(lat) * 1e3, 99)),
@@ -166,8 +196,12 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
         "graph_build_s": round(build_s, 2),
         "compile_s": round(compile_s, 2),
         "sync_overhead_ms": round(sync_overhead * 1e3, 1),
-        "batches_of_32": n_batches,
-        "counts_head": [int(c) for c in np.asarray(counts)[:3]],
+        "batches": n_batches,
+        "waves_per_batch": waves_per_batch,
+        "counts_head": [
+            int(c)
+            for c in np.asarray(counts, dtype=np.int64).reshape(n_batches, -1).sum(axis=1)[:3]
+        ],
     }
 
 
@@ -200,6 +234,7 @@ def run_sharded(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
     return {
         "total_invalidated": total,
         "elapsed_s": elapsed,
+        "waves": n_waves,
         "wave_ms_p50": elapsed / n_waves * 1e3,
         "wave_ms_p99": elapsed / n_waves * 1e3,
         "edges": int(len(src)),
@@ -227,11 +262,13 @@ def main() -> None:
     inv_per_sec = detail["total_invalidated"] / detail["elapsed_s"]
     detail.update(
         nodes=n_nodes,
-        waves=n_waves,
         seeds_per_wave=seeds_per_wave,
         n_devices=len(jax.devices()),
         device=str(jax.devices()[0]),
     )
+    # the runner reports the EFFECTIVE wave count (word packing rounds the
+    # requested count up to a whole batch); fall back to the request
+    detail.setdefault("waves", n_waves)
     result = {
         "metric": "cascading_invalidations_per_sec",
         "value": round(inv_per_sec, 1),

@@ -125,7 +125,9 @@ class TopoGraphArrays(NamedTuple):
 
 class TopoState(NamedTuple):
     node_epoch: "object"  # int32[n_tot+1] (new order)
-    invalid_bits: "object"  # int32[n_tot+1]
+    #: int32[n_tot+1] (words=1) or int32[n_tot+1, words] — each uint32 lane
+    #: packs 32 independent waves; see topo_init_state(words=...)
+    invalid_bits: "object"
 
 
 def topo_graph_arrays(graph: TopoGraph) -> TopoGraphArrays:
@@ -138,24 +140,37 @@ def topo_graph_arrays(graph: TopoGraph) -> TopoGraphArrays:
     )
 
 
-def topo_init_state(n_tot: int) -> TopoState:
+def topo_init_state(n_tot: int, words: int = 1) -> TopoState:
+    """``words`` packs ``32*words`` independent waves per sweep: the random
+    row access that bounds the kernel fetches a full HBM transaction either
+    way, so wider rows are nearly free throughput (32 B rows = 8 words)."""
     import jax.numpy as jnp
 
+    if 32 * (n_tot + 1) >= 2**31:
+        # per-word counts are summed in int32 on device (jax x64 is off);
+        # beyond ~67M rows one word's count could silently wrap
+        raise ValueError(
+            f"topo sweep count tracking is int32-limited to <{2**31 // 32} rows; "
+            f"got {n_tot + 1} — shard the graph (parallel/sharded_wave.py) instead"
+        )
+    shape = (n_tot + 1,) if words == 1 else (n_tot + 1, words)
     return TopoState(
         jnp.zeros(n_tot + 1, dtype=jnp.int32).at[n_tot].set(-2),
-        jnp.zeros(n_tot + 1, dtype=jnp.int32),
+        jnp.zeros(shape, dtype=jnp.int32),
     )
 
 
-def topo_seeds_to_bits(graph: TopoGraph, seed_ids_per_wave) -> np.ndarray:
-    """≤32 seed-id arrays (ORIGINAL node ids) → int32 bit vector in NEW id
-    space, ready for the sweep."""
-    bits = np.zeros(graph.n_tot + 1, dtype=np.int32)
-    for w, ids in enumerate(seed_ids_per_wave[:32]):
+def topo_seeds_to_bits(graph: TopoGraph, seed_ids_per_wave, words: int = 1) -> np.ndarray:
+    """≤``32*words`` seed-id arrays (ORIGINAL node ids) → int32 bit
+    vector[s] in NEW id space, ready for the sweep (1-D for ``words=1``,
+    else [n_tot+1, words])."""
+    bits = np.zeros((graph.n_tot + 1, words), dtype=np.int32)
+    for i, ids in enumerate(seed_ids_per_wave[: 32 * words]):
+        w, lane = divmod(i, 32)
         new_ids = graph.inv_perm[np.asarray(ids, dtype=np.int64)]
-        bits[new_ids] |= np.int32(1 << w) if w < 31 else np.int32(-(1 << 31))
+        bits[new_ids, w] |= np.int32(1 << lane) if lane < 31 else np.int32(-(1 << 31))
     bits[graph.n_tot] = 0
-    return bits
+    return bits[:, 0] if words == 1 else bits
 
 
 def _topo_sweep_impl(level_starts, garrays: TopoGraphArrays, seed_bits, state: TopoState):
@@ -167,6 +182,20 @@ def _topo_sweep_impl(level_starts, garrays: TopoGraphArrays, seed_bits, state: T
     k = in_src.shape[1]
 
     node_epoch, invalid = state.node_epoch, state.invalid_bits
+    # normalize to [n_tot+1, W]: W uint32 lanes = 32*W packed waves per pass
+    squeeze = invalid.ndim == 1
+    if squeeze:
+        invalid = invalid[:, None]
+    if seed_bits.ndim == 1:
+        seed_bits = seed_bits[:, None]
+    W = invalid.shape[1]
+    if seed_bits.shape[1] != W:
+        # broadcasting a mismatched width would silently duplicate seeds
+        # into every lane (or drop lanes on the squeeze path)
+        raise ValueError(
+            f"seed_bits width {seed_bits.shape[1]} != state width {W}; "
+            f"pass words= consistently to topo_seeds_to_bits/build_topo_wave32"
+        )
     invalid_before = invalid
     invalid = (invalid | seed_bits).at[n_tot].set(0)
 
@@ -182,15 +211,23 @@ def _topo_sweep_impl(level_starts, garrays: TopoGraphArrays, seed_bits, state: T
         # null row, whose word is always 0 (version-consistent edges,
         # Computed.cs:213-215)
         eff = jnp.where(epochs == own[:, None], rows, n_tot)
-        f = invalid[eff]  # (b-a, k) gather from earlier levels
+        f = invalid[eff]  # (b-a, k, W) gather from earlier levels
         fire = f[:, 0]
         for j in range(1, k):
             fire = fire | f[:, j]
-        cur = lax.slice(invalid, (a,), (b,))
-        invalid = lax.dynamic_update_slice(invalid, cur | fire, (a,))
+        cur = lax.slice(invalid, (a, 0), (b, W))
+        invalid = lax.dynamic_update_slice(invalid, cur | fire, (a, 0))
 
-    newly = lax.population_count(jnp.where(is_real, invalid & ~invalid_before, 0))
-    return TopoState(node_epoch, invalid), newly.sum(dtype=jnp.int32)
+    newly = lax.population_count(
+        jnp.where(is_real[:, None], invalid & ~invalid_before, 0)
+    )
+    # per-WORD counts: one word's count is ≤ 32*n (int32-safe); the total
+    # across many packed waves can exceed int32, so callers sum in int64
+    counts = newly.sum(axis=0, dtype=jnp.int32)
+    if squeeze:
+        invalid = invalid[:, 0]
+        return TopoState(node_epoch, invalid), counts[0]
+    return TopoState(node_epoch, invalid), counts
 
 
 @functools.lru_cache(maxsize=8)
@@ -204,10 +241,10 @@ def topo_sweep_step(level_starts: Tuple[int, ...]):
     return jax.jit(functools.partial(_topo_sweep_impl, level_starts))
 
 
-def build_topo_wave32(graph: TopoGraph):
+def build_topo_wave32(graph: TopoGraph, words: int = 1):
     """(state0, wave32) — same contract as build_pull_wave32, but the whole
-    32-wave cascade costs one table pass. ``wave32(seed_bits, state)`` →
-    (state, newly-invalidated count over real nodes)."""
+    ``32*words``-wave cascade costs one table pass. ``wave32(seed_bits,
+    state)`` → (state, newly-invalidated count over real nodes)."""
     garrays = topo_graph_arrays(graph)
     step = topo_sweep_step(graph.level_starts)
 
@@ -217,4 +254,4 @@ def build_topo_wave32(graph: TopoGraph):
     wave32.garrays = garrays
     wave32.step = step
     wave32.impl = functools.partial(_topo_sweep_impl, graph.level_starts)
-    return topo_init_state(graph.n_tot), wave32
+    return topo_init_state(graph.n_tot, words), wave32

@@ -104,7 +104,27 @@ class RpcInboundCall:
 
     def start(self) -> None:
         self.peer.inbound_calls[self.call_id] = self
-        self._task = asyncio.get_event_loop().create_task(self._run())
+        self._task = asyncio.get_event_loop().create_task(self._run_gated())
+
+    async def _run_gated(self) -> None:
+        # per-peer inbound concurrency limit (system calls never come through
+        # here, so they are exempt — reference RpcPeer.cs:100-110)
+        semaphore = self.peer.inbound_semaphore
+        if semaphore is None:
+            await self._run()
+            return
+        try:
+            await semaphore.acquire()
+        except asyncio.CancelledError:
+            # cancelled while QUEUED: _run never starts, so its cleanup
+            # never runs — unregister here or the stale entry swallows any
+            # post-reconnect re-send of this call id forever
+            self.peer.inbound_calls.pop(self.call_id, None)
+            raise
+        try:
+            await self._run()
+        finally:
+            semaphore.release()
 
     def restart(self) -> None:
         """Duplicate delivery (client re-sent after reconnect): re-send the

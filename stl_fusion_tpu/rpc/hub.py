@@ -37,6 +37,9 @@ class RpcHub:
         #: transport factory for client peers: async (peer) -> ChannelPair
         self.client_connector: Optional[Callable[[RpcClientPeer], Awaitable[ChannelPair]]] = None
         self.call_router: RpcCallRouter = lambda service, method, args: "default"
+        #: 0 = unlimited; n ≥ 1 serializes non-system inbound calls per peer
+        #: through an n-permit gate (≈ InboundConcurrencyLevel, RpcPeer.cs:20)
+        self.inbound_concurrency_level: int = 0
         self.max_connect_attempts = 10_000
         #: $sys-c dispatch hook, installed by the fusion client layer
         self.compute_system_handler: Optional[Callable[[RpcPeer, RpcMessage], None]] = None

@@ -56,6 +56,13 @@ class RpcPeer(WorkerBase):
         self.connection_state: AsyncEvent[ConnectionState] = AsyncEvent(
             ConnectionState(ConnectionState.DISCONNECTED)
         )
+        # 0 = unlimited; n ≥ 1 gates non-system inbound calls through a
+        # semaphore of n permits (≈ InboundConcurrencyLevel, RpcPeer.cs:20,
+        # 100-110); configured per hub before its peers are created
+        level = hub.inbound_concurrency_level
+        self.inbound_semaphore: Optional[asyncio.Semaphore] = (
+            asyncio.Semaphore(level) if level > 0 else None
+        )
         self.outbound_calls: Dict[int, Any] = {}
         self.inbound_calls: Dict[int, Any] = {}
         self._completed_inbound = RecentlySeenMap(capacity=10_000, max_age=600.0)

@@ -225,3 +225,45 @@ async def test_router_local_fallback():
         assert await proxy.echo("x") == "local:x"
     finally:
         await hub.stop()
+
+
+async def test_inbound_concurrency_level_gates_calls():
+    """InboundConcurrencyLevel semantics (RpcPeer.cs:20, 100-110): with a
+    1-permit gate the server runs inbound calls one at a time."""
+    class Tracker:
+        def __init__(self):
+            self.active = 0
+            self.max_active = 0
+
+        async def work(self, delay: float) -> int:
+            self.active += 1
+            self.max_active = max(self.max_active, self.active)
+            await asyncio.sleep(delay)
+            self.active -= 1
+            return self.max_active
+
+    server_hub = RpcHub("server")
+    server_hub.inbound_concurrency_level = 1  # per-hub option, set before peers exist
+    client_hub = RpcHub("client")
+    tracker = Tracker()
+    server_hub.add_service("t", tracker)
+    RpcTestTransport(client_hub, server_hub)
+    try:
+        proxy = client_hub.client("t", "default")
+        await asyncio.gather(*(proxy.work(0.02) for _ in range(5)))
+        assert tracker.max_active == 1  # serialized by the gate
+    finally:
+        await _shutdown(client_hub, server_hub)
+
+    # unlimited (default): calls overlap
+    server_hub = RpcHub("server2")
+    client_hub = RpcHub("client2")
+    tracker = Tracker()
+    server_hub.add_service("t", tracker)
+    RpcTestTransport(client_hub, server_hub)
+    try:
+        proxy = client_hub.client("t", "default")
+        await asyncio.gather(*(proxy.work(0.02) for _ in range(5)))
+        assert tracker.max_active > 1
+    finally:
+        await _shutdown(client_hub, server_hub)

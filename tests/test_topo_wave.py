@@ -156,3 +156,40 @@ def test_agrees_with_hybrid_kernel():
     h_state0, h_wave = build_hybrid_wave32(hg, tail_cap=64)
     h_state, c_h = h_wave(jnp.asarray(seeds_to_bits(hg.n_tot, seed_lists)), h_state0)
     assert c_t == int(c_h)
+
+
+def test_multiword_packing_matches_oracle():
+    """words=2 packs 64 waves in one sweep; every lane's closure must equal
+    the host oracle, and the count must sum across all lanes."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    n = 400
+    edges = sorted({(int(a), int(b)) for a, b in zip(
+        rng.integers(0, n - 1, 1200), rng.integers(1, n, 1200)) if a < b})
+    src = np.array([e[0] for e in edges], dtype=np.int32)
+    dst = np.array([e[1] for e in edges], dtype=np.int32)
+
+    seed_lists = [rng.choice(n, size=4, replace=False).tolist() for _ in range(64)]
+    graph = build_topo_graph(src, dst, n, k=4)
+    state0, wave = build_topo_wave32(graph, words=2)
+    seed_bits = jnp.asarray(topo_seeds_to_bits(graph, seed_lists, words=2))
+    state, count = wave(seed_bits, state0)
+    invalid = np.asarray(state.invalid_bits)
+    assert invalid.shape == (graph.n_tot + 1, 2)
+    assert np.asarray(count).shape == (2,)  # per-word counts (int32-safe)
+    count = int(np.asarray(count, dtype=np.int64).sum())
+
+    total = 0
+    for i, seeds in enumerate(seed_lists):
+        w, lane = divmod(i, 32)
+        expected = host_reachable(src, dst, n, seeds)
+        bit = np.int64(1) << lane
+        got = {
+            int(graph.perm[r])
+            for r in range(graph.n_tot)
+            if (np.int64(invalid[r, w]) & bit) and graph.is_real[r]
+        }
+        assert got == expected, f"wave {i}: {len(got)} vs {len(expected)}"
+        total += len(expected)
+    assert count == total
