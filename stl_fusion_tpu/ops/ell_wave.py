@@ -19,13 +19,16 @@ Two TPU-specific problems and their solutions:
 
 2. **Frontier sizes vary wildly** (SURVEY.md §7 hard parts). Static shapes
    would force every level to pay the worst-case frontier. Instead the
-   kernel compiles a ladder of frontier **buckets** (16k → … → F_max) and
+   kernel compiles a ladder of frontier **buckets** (1k → … → F_max) and
    `lax.switch`es per level into the smallest bucket that fits — so a
-   1k-node level costs a 16k-slot program, not a 10M-slot one.
+   1k-node level costs a 1k-slot program, not a 10M-slot one.
 
-Dedup inside a level uses a claim-by-scatter-max trick (first edge slot to
-claim a destination wins) instead of sort+unique — one scatter + one gather
-over active slots, no host round trips anywhere in the wave.
+Dedup inside a level picks its strategy per bucket at build time: small
+buckets sort the fired destinations (touches only O(frontier·k) elements —
+the lone-wave latency path), wide buckets use a claim-by-scatter-max trick
+(first edge slot to claim a destination wins; one O(n_tot) fill costs less
+than sorting a near-graph-sized frontier). No host round trips anywhere in
+the wave.
 """
 from __future__ import annotations
 
@@ -154,8 +157,8 @@ def build_ell_wave(
         f_max = 1 << int(np.ceil(np.log2(max(n_tot, 1 << 14))))
     if buckets is None:
         buckets = []
-        b = 1 << 14
-        while b < f_max:
+        b = 1 << 10  # small head buckets keep shallow lone waves on the
+        while b < f_max:  # sort-dedup path (µs-scale levels)
             buckets.append(b)
             b <<= 3
         buckets.append(f_max)
@@ -173,7 +176,18 @@ def build_ell_wave(
         return EllWaveState(node_epoch, invalid)
 
     def _level(bsize: int, F, invalid, node_epoch, ell_dst, ell_epoch, is_real):
-        """Expand F[:bsize] one level; returns (F_next, nF_next, invalid, newly_real)."""
+        """Expand F[:bsize] one level; returns (F_next, nF_next, invalid, newly_real).
+
+        Dedup strategy is picked per bucket at build time:
+        - small buckets SORT the fired dsts (O(m log² m), m = bsize*k) — no
+          full-graph array is touched, so a shallow lone wave costs µs, not
+          an O(n_tot) zero-fill per level;
+        - wide buckets use the claim scatter (O(n_tot)) where the sort
+          would cost more than the fill.
+        F is updated IN PLACE: stale entries beyond nF_next are ids from
+        earlier frontiers, whose eligible dsts are already invalid, so
+        re-expanding them can never re-fire (fire tests ~invalid[dst]).
+        """
         Fb = lax.slice(F, (0,), (bsize,))
         rows = ell_dst[Fb]  # (bsize, k) row gather; pad rows → n_tot
         eps = ell_epoch[Fb]
@@ -183,21 +197,30 @@ def build_ell_wave(
         flat_dst = rows.reshape(-1)
         flat_fire = fire.reshape(-1)
         invalid = invalid.at[flat_dst].max(flat_fire)
-        # claim dedup: first firing slot per destination wins
-        slot_id = jnp.arange(flat_dst.shape[0], dtype=jnp.int32) + 1
-        claim = (
-            jnp.zeros(n_tot + 1, dtype=jnp.int32)
-            .at[flat_dst]
-            .max(jnp.where(flat_fire, slot_id, 0))
-        )
-        win = flat_fire & (claim[flat_dst] == slot_id)
-        pos = jnp.cumsum(win.astype(jnp.int32)) - 1
-        nF_next = win.sum(dtype=jnp.int32)
-        scatter_pos = jnp.where(win, pos, f_max + 1)  # OOB → dropped
-        F_next = jnp.full(f_max, n_tot, dtype=jnp.int32).at[scatter_pos].set(
-            flat_dst.astype(jnp.int32), mode="drop"
-        )
-        newly_real = (win & is_real[flat_dst]).sum(dtype=jnp.int32)
+        m = bsize * k
+        if m * max(int(np.log2(m)), 1) < n_tot:
+            # sort-based dedup: first of each run of equal ids wins
+            keys = jnp.where(flat_fire, flat_dst, n_tot).astype(jnp.int32)
+            skeys = jnp.sort(keys)
+            isnew = (skeys < n_tot) & jnp.concatenate(
+                [jnp.ones(1, dtype=bool), skeys[1:] != skeys[:-1]]
+            )
+            winners = skeys
+        else:
+            # claim dedup: first firing slot per destination wins
+            slot_id = jnp.arange(m, dtype=jnp.int32) + 1
+            claim = (
+                jnp.zeros(n_tot + 1, dtype=jnp.int32)
+                .at[flat_dst]
+                .max(jnp.where(flat_fire, slot_id, 0))
+            )
+            isnew = flat_fire & (claim[flat_dst] == slot_id)
+            winners = flat_dst.astype(jnp.int32)
+        pos = jnp.cumsum(isnew.astype(jnp.int32)) - 1
+        nF_next = isnew.sum(dtype=jnp.int32)
+        scatter_pos = jnp.where(isnew, pos, f_max + 1)  # OOB → dropped
+        F_next = F.at[scatter_pos].set(winners, mode="drop")
+        newly_real = (isnew & is_real[winners]).sum(dtype=jnp.int32)
         return F_next, nF_next, invalid, newly_real
 
     branches = [

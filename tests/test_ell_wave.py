@@ -62,3 +62,29 @@ def test_ell_wave_idempotent_and_seed_dedup():
     assert int(count) == 3
     state, count = wave(seeds, state)
     assert int(count) == 0  # idempotent
+
+
+@pytest.mark.parametrize("seed", [1, 4])
+def test_ell_wave_sort_dedup_path_matches_oracle(seed):
+    """Tiny custom buckets force the sort-based dedup branch (m*log2(m) <
+    n_tot), which default buckets only reach on >1M-node graphs."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    n = 3000
+    src, dst = power_law_dag(n, avg_degree=3.0, seed=seed)
+    g = build_ell(src, dst, n, k=4)
+    state, wave = build_ell_wave(g, buckets=[16, 128, 1 << 14])
+    # 16*4*log2(64)=384 < n_tot and 128*4*log2(512)=4608 > n_tot at n=3000:
+    # levels route through BOTH dedup branches within one wave
+    seeds = rng.choice(n, size=12, replace=False)
+    padded = np.full(16, -1, dtype=np.int32)
+    padded[:12] = seeds
+    state, count = wave(jnp.asarray(padded), state)
+    edges = list(zip(src.tolist(), dst.tolist()))
+    want = python_wave_oracle(
+        n, edges, [0] * len(edges), np.zeros(n, np.int32), np.zeros(n, bool), seeds.tolist()
+    )
+    got = np.asarray(state.invalid)[: g.n_real]
+    np.testing.assert_array_equal(got, want)
+    assert int(count) == int(want.sum())
