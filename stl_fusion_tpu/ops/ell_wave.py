@@ -175,6 +175,16 @@ def build_ell_wave(
         invalid = jnp.zeros(n_tot + 1, dtype=jnp.bool_)
         return EllWaveState(node_epoch, invalid)
 
+    def _sort_dedup(mask, ids):
+        """(winners, isnew): sort ``ids`` (masked-out → null), keep the
+        first of each run of equal ids. Touches only O(len(ids)) elements —
+        the small-bucket / seed-stage dedup."""
+        skeys = jnp.sort(jnp.where(mask, ids, n_tot).astype(jnp.int32))
+        isnew = (skeys < n_tot) & jnp.concatenate(
+            [jnp.ones(1, dtype=bool), skeys[1:] != skeys[:-1]]
+        )
+        return skeys, isnew
+
     def _level(bsize: int, F, invalid, node_epoch, ell_dst, ell_epoch, is_real):
         """Expand F[:bsize] one level; returns (F_next, nF_next, invalid, newly_real).
 
@@ -199,13 +209,7 @@ def build_ell_wave(
         invalid = invalid.at[flat_dst].max(flat_fire)
         m = bsize * k
         if m * max(int(np.log2(m)), 1) < n_tot:
-            # sort-based dedup: first of each run of equal ids wins
-            keys = jnp.where(flat_fire, flat_dst, n_tot).astype(jnp.int32)
-            skeys = jnp.sort(keys)
-            isnew = (skeys < n_tot) & jnp.concatenate(
-                [jnp.ones(1, dtype=bool), skeys[1:] != skeys[:-1]]
-            )
-            winners = skeys
+            winners, isnew = _sort_dedup(flat_fire, flat_dst)
         else:
             # claim dedup: first firing slot per destination wins
             slot_id = jnp.arange(m, dtype=jnp.int32) + 1
@@ -237,24 +241,20 @@ def build_ell_wave(
     def step(g: EllGraphArrays, seed_ids: "jax.Array", state: EllWaveState):
         ell_dst, ell_epoch, is_real = g
         node_epoch, invalid = state.node_epoch, state.invalid
-        # seed frontier: pad -1 → n_tot slot; only fresh (not-invalid) seeds,
-        # deduped by the same claim trick (first occurrence wins)
+        # seed frontier: pad -1 → n_tot slot; only fresh (not-invalid)
+        # seeds, deduped by sorting the (small) seed vector — a claim
+        # scatter here would cost an O(n_tot) zero-fill per wave, the
+        # dominant term of a shallow lone wave's latency at 10M nodes
         safe = jnp.where(seed_ids >= 0, seed_ids, n_tot).astype(jnp.int32)
         candidate = (safe < n_tot) & ~invalid[safe]
-        seed_slot = jnp.arange(safe.shape[0], dtype=jnp.int32) + 1
-        seed_claim = (
-            jnp.zeros(n_tot + 1, dtype=jnp.int32)
-            .at[safe]
-            .max(jnp.where(candidate, seed_slot, 0))
-        )
-        fresh = candidate & (seed_claim[safe] == seed_slot)
-        invalid = invalid.at[safe].max(fresh)
-        count0 = (fresh & is_real[safe]).sum(dtype=jnp.int32)
+        skeys, fresh = _sort_dedup(candidate, safe)
+        invalid = invalid.at[skeys].max(fresh)
+        count0 = (fresh & is_real[skeys]).sum(dtype=jnp.int32)
         pos = jnp.cumsum(fresh.astype(jnp.int32)) - 1
         F0 = (
             jnp.full(f_max, n_tot, dtype=jnp.int32)
             .at[jnp.where(fresh, pos, f_max + 1)]
-            .set(safe, mode="drop")
+            .set(skeys, mode="drop")
         )
         nF0 = fresh.sum(dtype=jnp.int32)
 

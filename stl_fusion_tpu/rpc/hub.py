@@ -41,6 +41,13 @@ class RpcHub:
         #: through an n-permit gate (≈ InboundConcurrencyLevel, RpcPeer.cs:20)
         self.inbound_concurrency_level: int = 0
         self.max_connect_attempts = 10_000
+        #: connect errors this returns True for abort the reconnect loop at
+        #: once instead of backing off (≈ RpcUnrecoverableErrorDetector,
+        #: Configuration/RpcDefaultDelegates.cs; RpcPeer.cs:268-274).
+        #: Default: config/programming errors are terminal, I/O is transient.
+        self.unrecoverable_error_detector: Callable[[BaseException], bool] = (
+            lambda e: isinstance(e, (LookupError, TypeError, ValueError))
+        )
         #: $sys-c dispatch hook, installed by the fusion client layer
         self.compute_system_handler: Optional[Callable[[RpcPeer, RpcMessage], None]] = None
         #: local service fallback for routing proxies

@@ -35,6 +35,9 @@ __all__ = ["RpcPeer", "RpcClientPeer", "RpcServerPeer", "ConnectionState"]
 class ConnectionState:
     DISCONNECTED = "disconnected"
     CONNECTED = "connected"
+    #: terminal: the peer gave up (unrecoverable connect error or attempt
+    #: cap); waiters re-raise the error instead of parking forever
+    TERMINATED = "terminated"
 
     def __init__(self, kind: str, error: Optional[BaseException] = None):
         self.kind = kind
@@ -87,7 +90,13 @@ class RpcPeer(WorkerBase):
         ev = self.connection_state.latest()
         if not ev.value.is_connected:
             self.start()
-            ev = await ev.when(lambda s: s.is_connected)
+            ev = await ev.when(
+                lambda s: s.is_connected or s.kind == ConnectionState.TERMINATED
+            )
+            if ev.value.kind == ConnectionState.TERMINATED:
+                raise ev.value.error or ConnectionError(
+                    f"peer {self.ref} terminated without a connection"
+                )
 
     # ------------------------------------------------------------------ transport
     async def acquire_connection(self) -> ChannelPair:
@@ -103,7 +112,11 @@ class RpcPeer(WorkerBase):
                 raise
             except Exception as e:  # noqa: BLE001 — unrecoverable connect error
                 log.debug("peer %s: terminal connect failure: %s", self.ref, e)
-                self._set_state(ConnectionState.DISCONNECTED, e)
+                # fail everything parked on this peer: when_connected waiters
+                # re-raise via the TERMINATED state; registered calls error out
+                self._set_state(ConnectionState.TERMINATED, e)
+                for call in list(self.outbound_calls.values()):
+                    call.set_error(e)
                 return
             self._conn = conn
             self._set_state(ConnectionState.CONNECTED)
@@ -221,6 +234,10 @@ class RpcClientPeer(RpcPeer):
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001
+                if self.hub.unrecoverable_error_detector(e):
+                    # config/programming error — retrying can never succeed
+                    # (≈ RpcUnrecoverableErrorDetector, RpcPeer.cs:268-274)
+                    raise
                 failures += 1
                 if failures > self.hub.max_connect_attempts:
                     raise

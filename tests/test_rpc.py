@@ -267,3 +267,26 @@ async def test_inbound_concurrency_level_gates_calls():
         assert tracker.max_active > 1
     finally:
         await _shutdown(client_hub, server_hub)
+
+
+async def test_unrecoverable_connect_error_aborts_reconnect_loop():
+    """Config errors abort the reconnect loop immediately instead of backing
+    off for max_connect_attempts (RpcUnrecoverableErrorDetector semantics)."""
+    hub = RpcHub("client")
+    attempts = []
+
+    async def bad_connector(peer):
+        attempts.append(1)
+        raise LookupError("no URL configured for this ref")
+
+    hub.client_connector = bad_connector
+    try:
+        proxy = hub.client("echo", "default")
+        # the config error must SURFACE to the caller promptly — a hang
+        # until some outer timeout would mean the terminal state is not
+        # propagating to when_connected waiters
+        with pytest.raises(LookupError, match="no URL configured"):
+            await asyncio.wait_for(proxy.echo("x"), 2.0)
+        assert len(attempts) == 1  # no retry storm
+    finally:
+        await _shutdown(hub)
