@@ -21,7 +21,12 @@ import threading
 
 log = logging.getLogger("stl_fusion_tpu")
 
-__all__ = ["load_graphpack", "native_build_hybrid_tables", "native_topo_levels"]
+__all__ = [
+    "load_graphpack",
+    "native_build_ell",
+    "native_build_hybrid_tables",
+    "native_topo_levels",
+]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "graphpack.cpp")
@@ -93,6 +98,13 @@ def load_graphpack():
         lib.gp_topo_levels.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
         ]
+        lib.gp_build_ell.restype = ctypes.c_void_p
+        lib.gp_build_ell.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int32,
+        ]
+        lib.gp_fill_out.restype = ctypes.c_int32
+        lib.gp_fill_out.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32]
         _lib = lib
         return _lib
 
@@ -149,5 +161,32 @@ def native_build_hybrid_tables(src, dst, n_nodes: int, k_in: int, k_out: int):
             log.error("graphpack degree bound violated (rc=%d); using numpy path", rc)
             return None
         return in_src, out_dst, int(n_tot)
+    finally:
+        lib.gp_free(handle)
+
+
+def native_build_ell(src, dst, n_nodes: int, k: int):
+    """(ell_dst[(n_tot+1), k], n_tot) bounding OUT-degree at k with virtual
+    forwarding trees, via the native packer; None → numpy fallback."""
+    import numpy as np
+
+    lib = load_graphpack()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(src, dtype=np.int32)
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    handle = lib.gp_build_ell(
+        src.ctypes.data_as(ctypes.c_void_p),
+        dst.ctypes.data_as(ctypes.c_void_p),
+        len(src), n_nodes, k, 1,
+    )
+    try:
+        n_tot = lib.gp_n_tot(handle)
+        ell_dst = np.empty((n_tot + 1, k), dtype=np.int32)
+        rc = lib.gp_fill_out(handle, ell_dst.ctypes.data_as(ctypes.c_void_p), k)
+        if rc != 0:
+            log.error("graphpack ELL degree bound violated (rc=%d); using numpy path", rc)
+            return None
+        return ell_dst, int(n_tot)
     finally:
         lib.gp_free(handle)

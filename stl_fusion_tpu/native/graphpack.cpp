@@ -151,6 +151,41 @@ int32_t gp_fill(void* handle, int32_t* in_src, int32_t* out_dst) {
 
 void gp_free(void* handle) { delete static_cast<Handle*>(handle); }
 
+// Single-sided ELL: bound one side's degree at k (bound_src_flag != 0 →
+// out-degree / forwarding trees, else in-degree / collector trees). The
+// counterpart of ops/ell_wave.py::build_ell, whose numpy path costs
+// repeated argsort+unique passes (~28 s at 10M nodes vs ~1 s here).
+void* gp_build_ell(const int32_t* src, const int32_t* dst, int64_t m,
+                   int64_t n_nodes, int k, int32_t bound_src_flag) {
+  Handle* h = new Handle();
+  h->k_in = k;
+  h->k_out = k;
+  h->n_tot = n_nodes;
+  EdgeList cur;
+  cur.src.assign(src, src + m);
+  cur.dst.assign(dst, dst + m);
+  bound_degree(cur, h->n_tot, k, bound_src_flag != 0, h->edges);
+  return h;
+}
+
+// Fill a caller-allocated out-ELL table out_dst[(n_tot+1)*k]: row s holds
+// its ≤ k targets, pad slots point at the null row n_tot.
+int32_t gp_fill_out(void* handle, int32_t* out_dst, int32_t k) {
+  Handle* h = static_cast<Handle*>(handle);
+  const int64_t n_tot = h->n_tot;
+  const int64_t rows = n_tot + 1;
+  const int32_t pad = static_cast<int32_t>(n_tot);
+  std::fill(out_dst, out_dst + rows * k, pad);
+  std::vector<int32_t> slot(static_cast<size_t>(rows), 0);
+  const size_t m = h->edges.src.size();
+  for (size_t e = 0; e < m; e++) {
+    int64_t s = h->edges.src[e];
+    if (slot[s] >= k) return -1;
+    out_dst[s * k + slot[s]++] = static_cast<int32_t>(h->edges.dst[e]);
+  }
+  return 0;
+}
+
 // Topological longest-path levels over a packed in-ELL table (Kahn sweep).
 //
 // in_src: int32[(n+1) * k] — row d's in-neighbors; entries >= n are pads.

@@ -52,15 +52,30 @@ class EllGraph(NamedTuple):
 
 
 def build_ell(
-    src: np.ndarray, dst: np.ndarray, n_nodes: int, k: int = 4
+    src: np.ndarray, dst: np.ndarray, n_nodes: int, k: int = 4, use_native: bool = True
 ) -> EllGraph:
     """Rewrite an edge list into ELL(k) with virtual forwarding trees.
 
-    Layered construction, fully vectorized: in each round, nodes whose
-    current out-list exceeds ``k`` get their list chunked into groups of
-    ``k`` hung under fresh virtual nodes; the virtual ids become the node's
-    new out-list. Rounds ≈ log_k(max_degree).
+    Native counting-sort packer when available (~1 s at 10M nodes vs ~28 s
+    for the numpy path below); virtual-id NUMBERING may differ between the
+    two, reachability semantics are identical (tests cross-check both).
+
+    Numpy path: layered construction, fully vectorized — in each round,
+    nodes whose current out-list exceeds ``k`` get their list chunked into
+    groups of ``k`` hung under fresh virtual nodes; the virtual ids become
+    the node's new out-list. Rounds ≈ log_k(max_degree).
     """
+    if use_native:
+        from ..native import native_build_ell
+
+        res = native_build_ell(src, dst, n_nodes, k)
+        if res is not None:
+            ell_dst, n_tot = res
+            ell_epoch = np.where(ell_dst != n_tot, 0, -1).astype(np.int32)
+            is_real = np.zeros(n_tot + 1, dtype=bool)
+            is_real[:n_nodes] = True
+            return EllGraph(ell_dst, ell_epoch, is_real, n_nodes, n_tot, k)
+
     src = np.asarray(src, dtype=np.int64)
     dst = np.asarray(dst, dtype=np.int64)
     next_virtual = n_nodes

@@ -89,7 +89,7 @@ def build_topo_graph(
 ) -> TopoGraph:
     """In-ELL (build_ell on reversed edges, bounding in-degree at k with
     virtual OR-collectors) renumbered into topological level order."""
-    ell: EllGraph = build_ell(dst, src, n_nodes, k=k)
+    ell: EllGraph = build_ell(dst, src, n_nodes, k=k, use_native=use_native)
     n_tot = ell.n_tot
     level = None
     if use_native:

@@ -44,9 +44,12 @@ class RpcHub:
         #: connect errors this returns True for abort the reconnect loop at
         #: once instead of backing off (≈ RpcUnrecoverableErrorDetector,
         #: Configuration/RpcDefaultDelegates.cs; RpcPeer.cs:268-274).
-        #: Default: config/programming errors are terminal, I/O is transient.
+        #: Default: config/programming errors are terminal, I/O is transient
+        #: (connectors normalize transport failures to ConnectionError/OSError;
+        #: RuntimeError covers "no client connector configured").
         self.unrecoverable_error_detector: Callable[[BaseException], bool] = (
-            lambda e: isinstance(e, (LookupError, TypeError, ValueError))
+            lambda e: isinstance(e, (LookupError, TypeError, ValueError, RuntimeError))
+            and not isinstance(e, (ConnectionError, OSError, TimeoutError))
         )
         #: $sys-c dispatch hook, installed by the fusion client layer
         self.compute_system_handler: Optional[Callable[[RpcPeer, RpcMessage], None]] = None

@@ -64,6 +64,30 @@ def test_ell_wave_idempotent_and_seed_dedup():
     assert int(count) == 0  # idempotent
 
 
+@pytest.mark.parametrize("seed", [2, 5])
+def test_native_ell_matches_numpy_semantics(seed):
+    """The native counting-sort packer and the numpy layered construction
+    may number virtual nodes differently, but waves over both must
+    invalidate exactly the same REAL nodes."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    n = 1500
+    src, dst = power_law_dag(n, avg_degree=3.0, seed=seed)
+    g_native = build_ell(src, dst, n, k=4, use_native=True)
+    g_numpy = build_ell(src, dst, n, k=4, use_native=False)
+    assert g_native.n_real == g_numpy.n_real == n
+
+    seeds = rng.choice(n, size=9, replace=False).astype(np.int32)
+    masks = []
+    for g in (g_native, g_numpy):
+        state, wave = build_ell_wave(g)
+        state, count = wave(jnp.asarray(seeds), state)
+        masks.append((np.asarray(state.invalid)[:n], int(count)))
+    np.testing.assert_array_equal(masks[0][0], masks[1][0])
+    assert masks[0][1] == masks[1][1]
+
+
 @pytest.mark.parametrize("seed", [1, 4])
 def test_ell_wave_sort_dedup_path_matches_oracle(seed):
     """Tiny custom buckets force the sort-based dedup branch (m*log2(m) <

@@ -290,3 +290,15 @@ async def test_unrecoverable_connect_error_aborts_reconnect_loop():
         assert len(attempts) == 1  # no retry storm
     finally:
         await _shutdown(hub)
+
+
+async def test_missing_connector_fails_fast():
+    """No client_connector configured is a config error: the caller sees it
+    immediately, not after a 10,000-attempt backoff loop."""
+    hub = RpcHub("client")  # no connector
+    try:
+        proxy = hub.client("echo", "default")
+        with pytest.raises(RuntimeError, match="connector"):
+            await asyncio.wait_for(proxy.echo("x"), 2.0)
+    finally:
+        await _shutdown(hub)
