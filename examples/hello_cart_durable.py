@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""HelloCart, durable flavor — the reference sample's v2+ configurations
+(samples/HelloCart: DbProductService over EF + the op-log pipeline) plus the
+SURVEY §5.4 checkpoint/resume story in one run:
+
+1. products live in sqlite (the DAL), edits are commands recorded in a
+   sqlite operation log;
+2. the host computes cart totals (memoized, dependency-captured), then
+   CHECKPOINTS its computed graph (values + versions + edges + op-log
+   watermark) and "dies";
+3. while it is down, another host edits a product (the log is the durable
+   source of invalidation truth);
+4. the host restarts from the checkpoint: reads are warm immediately
+   (zero recomputes), and replaying the log from the watermark invalidates
+   exactly the entries that went stale while it was down — the cart total
+   recomputes to the new price, nothing else does.
+
+Run: python examples/hello_cart_durable.py
+"""
+import asyncio
+import dataclasses
+import os
+import sqlite3
+import sys
+import tempfile
+from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stl_fusion_tpu.checkpoint import HubCheckpoint
+from stl_fusion_tpu.commands import command_handler
+from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method, is_invalidating
+from stl_fusion_tpu.oplog import LocalChangeNotifier, SqliteOperationLog, attach_operation_log
+from stl_fusion_tpu.utils.serialization import wire_type
+
+
+@wire_type
+@dataclasses.dataclass(frozen=True)
+class EditProduct:
+    id: str
+    price: float
+
+
+class ProductDal:
+    """≈ the EF DbContext of samples/HelloCart v2 (sqlite is the in-image DB)."""
+
+    def __init__(self, path: str):
+        self.db = sqlite3.connect(path)
+        self.db.execute("CREATE TABLE IF NOT EXISTS products (id TEXT PRIMARY KEY, price REAL)")
+        self.db.commit()
+
+    def get(self, pid: str) -> Optional[float]:
+        row = self.db.execute("SELECT price FROM products WHERE id=?", (pid,)).fetchone()
+        return row[0] if row else None
+
+    def upsert(self, pid: str, price: float) -> None:
+        self.db.execute(
+            "INSERT INTO products VALUES (?,?) ON CONFLICT(id) DO UPDATE SET price=excluded.price",
+            (pid, price),
+        )
+        self.db.commit()
+
+
+class ProductService(ComputeService):
+    def __init__(self, dal: ProductDal, hub=None):
+        super().__init__(hub)
+        self.dal = dal
+        self.db_reads = 0
+
+    @compute_method
+    async def get_price(self, pid: str) -> float:
+        self.db_reads += 1
+        return self.dal.get(pid) or 0.0
+
+    @command_handler
+    async def edit(self, command: EditProduct):
+        if is_invalidating():
+            await self.get_price(command.id)
+            return
+        self.dal.upsert(command.id, command.price)
+
+
+class CartService(ComputeService):
+    def __init__(self, products: ProductService, hub=None):
+        super().__init__(hub)
+        self.products = products
+
+    @compute_method
+    async def total(self, *pids) -> float:
+        return sum([await self.products.get_price(p) for p in pids])
+
+
+def make_host(db_path, log_store, notifier, start_position=None, start_reader=True):
+    """Fresh hosts tail the log from its end (start_position=None, the
+    library default); a checkpoint-restored host passes its saved watermark
+    instead. ``start_reader=False`` defers the reader entirely so a restart
+    can warm-boot BEFORE any replay runs."""
+    hub = FusionHub()
+    products = hub.add_service(ProductService(ProductDal(db_path), hub))
+    carts = hub.add_service(CartService(products, hub))
+    hub.commander.add_service(products)
+    reader = attach_operation_log(
+        hub.commander,
+        log_store,
+        notifier,
+        start_reader=start_reader,
+        start_position=start_position,
+    )
+    return hub, products, carts, reader
+
+
+async def main():
+    d = tempfile.mkdtemp()
+    db_path = os.path.join(d, "products.sqlite")
+    log_store = SqliteOperationLog(os.path.join(d, "ops.sqlite"))
+    notifier = LocalChangeNotifier()
+    ckpt_path = os.path.join(d, "host.ckpt")
+
+    # --- host 1: compute, checkpoint, die ------------------------------
+    hub1, products1, carts1, reader1 = make_host(db_path, log_store, notifier)
+    await hub1.commander.call(EditProduct("apple", 2.0))
+    await hub1.commander.call(EditProduct("banana", 0.5))
+    total = await carts1.total("apple", "apple", "banana")
+    print(f"host1 total: {total} ({products1.db_reads} DB reads)")
+    # local commits append synchronously, so the log's end IS this host's
+    # up-to-date position (the reader's own watermark only tracks replay)
+    HubCheckpoint.save(hub1, ckpt_path, oplog_position=log_store.last_index())
+    await reader1.stop()
+    del hub1, products1, carts1
+    print("host1 checkpointed and died")
+
+    # --- host 2 edits while host 1 is down -----------------------------
+    hub2, _p2, _c2, reader2 = make_host(db_path, log_store, notifier)
+    await hub2.commander.call(EditProduct("apple", 3.0))
+    await reader2.stop()
+    print("host2 edited apple -> 3.0 while host1 was down")
+
+    # --- host 1 restarts: warm boot FIRST, then replay from watermark --
+    hub1b, products1b, carts1b, reader1b = make_host(
+        db_path, log_store, notifier, start_reader=False
+    )
+    restored = HubCheckpoint.restore(hub1b, ckpt_path)
+    node = await capture(lambda: carts1b.total("apple", "apple", "banana"))
+    assert node.value == 4.5 and products1b.db_reads == 0, "warm boot must not recompute"
+    print(f"restarted warm: {restored.count} nodes, total still {node.value}, 0 DB reads")
+
+    reader1b.watermark = restored.oplog_position
+    reader1b.start()
+    await asyncio.wait_for(node.when_invalidated(), 5.0)  # replay catches up
+    total = await carts1b.total("apple", "apple", "banana")
+    assert total == 6.5
+    assert products1b.db_reads == 1, "only the stale product may recompute"
+    print(f"log replay invalidated exactly the stale entry: total = {total} "
+          f"({products1b.db_reads} DB read since restart — banana stayed warm)")
+    await reader1b.stop()
+    log_store.close()
+    print("durable HelloCart OK: checkpoint warm boot + op-log resume")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

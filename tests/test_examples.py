@@ -32,6 +32,14 @@ def test_todo_multihost_sample():
     assert "after done on host A: 1/1 done" in stdout
 
 
+def test_hello_cart_durable_sample():
+    stdout = _run("hello_cart_durable.py")
+    assert "restarted warm: 3 nodes, total still 4.5, 0 DB reads" in stdout
+    # replay precision: ONE stale product recomputes, the rest stays warm
+    assert "total = 6.5 (1 DB read since restart" in stdout
+    assert "durable HelloCart OK" in stdout
+
+
 def test_mini_rpc_sample():
     stdout = _run("mini_rpc.py")
     assert "Word count changed: 8" in stdout
