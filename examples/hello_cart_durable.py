@@ -90,22 +90,16 @@ class CartService(ComputeService):
         return sum([await self.products.get_price(p) for p in pids])
 
 
-def make_host(db_path, log_store, notifier, start_position=None, start_reader=True):
-    """Fresh hosts tail the log from its end (start_position=None, the
-    library default); a checkpoint-restored host passes its saved watermark
-    instead. ``start_reader=False`` defers the reader entirely so a restart
-    can warm-boot BEFORE any replay runs."""
+def make_host(db_path, log_store, notifier, attach_log=True):
+    """Fresh hosts attach + tail the log from its end (the library
+    default). A restarting host passes ``attach_log=False`` and attaches
+    AFTER its checkpoint warm boot, with ``start_position=<saved
+    watermark>`` — so replay begins only once the restored graph is live."""
     hub = FusionHub()
     products = hub.add_service(ProductService(ProductDal(db_path), hub))
     carts = hub.add_service(CartService(products, hub))
     hub.commander.add_service(products)
-    reader = attach_operation_log(
-        hub.commander,
-        log_store,
-        notifier,
-        start_reader=start_reader,
-        start_position=start_position,
-    )
+    reader = attach_operation_log(hub.commander, log_store, notifier) if attach_log else None
     return hub, products, carts, reader
 
 
@@ -136,16 +130,15 @@ async def main():
     print("host2 edited apple -> 3.0 while host1 was down")
 
     # --- host 1 restarts: warm boot FIRST, then replay from watermark --
-    hub1b, products1b, carts1b, reader1b = make_host(
-        db_path, log_store, notifier, start_reader=False
-    )
+    hub1b, products1b, carts1b, _ = make_host(db_path, log_store, notifier, attach_log=False)
     restored = HubCheckpoint.restore(hub1b, ckpt_path)
     node = await capture(lambda: carts1b.total("apple", "apple", "banana"))
     assert node.value == 4.5 and products1b.db_reads == 0, "warm boot must not recompute"
     print(f"restarted warm: {restored.count} nodes, total still {node.value}, 0 DB reads")
 
-    reader1b.watermark = restored.oplog_position
-    reader1b.start()
+    reader1b = attach_operation_log(
+        hub1b.commander, log_store, notifier, start_position=restored.oplog_position
+    )
     await asyncio.wait_for(node.when_invalidated(), 5.0)  # replay catches up
     total = await carts1b.total("apple", "apple", "banana")
     assert total == 6.5

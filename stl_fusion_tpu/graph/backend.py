@@ -123,13 +123,49 @@ class TpuGraphBackend:
         newly = np.nonzero(after & ~before)[0]
         applied = 0
         for node_id in newly:
-            ref = self._computed_by_id.get(int(node_id))
-            c = ref() if ref is not None else None
+            c = self.computed_for(node_id)
             if c is not None and c.invalidate_local():
                 applied += 1
         self.waves_run += 1
         self.device_invalidations += len(newly)
         return applied
+
+    # ------------------------------------------------------------------ export
+    def to_sharded(self, mesh=None, exchange: str = "packed"):
+        """Snapshot the LIVE mirrored graph as a mesh-sharded wave graph
+        (node epochs, invalid marks, version-carrying edges) — the bridge
+        from the incremental single-chip mirror to the multi-chip path
+        (parallel/sharded_wave.py). Structure-only snapshot: waves run on
+        it must be applied back through the caller (ids are the backend's
+        node ids; resolve via ``computed_for``)."""
+        from ..parallel.sharded_wave import ShardedDeviceGraph
+
+        self.flush()
+        dg = self.graph
+        m = dg.n_edges
+        return ShardedDeviceGraph(
+            dg._h_edge_src[:m].copy(),
+            dg._h_edge_dst[:m].copy(),
+            dg.n_nodes,
+            mesh=mesh,
+            edge_dst_epoch=dg._h_edge_dst_epoch[:m].copy(),
+            exchange=exchange,
+            node_epoch=dg._h_node_epoch,
+            # device-authoritative: run_wave_frontier(sync_host=False) leaves
+            # the host _h_invalid stale; invalid_mask() reads the device copy
+            invalid=dg.invalid_mask(),
+        )
+
+    def computed_for(self, node_id: int):
+        """The live Computed for a backend node id (None if collected)."""
+        ref = self._computed_by_id.get(int(node_id))
+        return ref() if ref is not None else None
+
+    def id_for(self, computed: "Computed") -> Optional[int]:
+        """The backend node id for a live Computed (None if unmirrored) —
+        the seed-id side of the ``to_sharded`` bridge."""
+        with self._lock:
+            return self._id_by_input.get(computed.input)
 
     # ------------------------------------------------------------------ stats
     @property

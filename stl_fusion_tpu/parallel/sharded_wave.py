@@ -180,12 +180,15 @@ class ShardedDeviceGraph:
         mesh: Optional[Mesh] = None,
         edge_dst_epoch: Optional[np.ndarray] = None,
         exchange: str = "packed",
+        node_epoch: Optional[np.ndarray] = None,
+        invalid: Optional[np.ndarray] = None,
     ):
         self.mesh = mesh or graph_mesh()
         n_dev = self.mesh.devices.size
         # n_local rounds up to a multiple of 32 so the packed exchange's
-        # uint32 words tile evenly per device
-        self.n_local = ((n_nodes + n_dev - 1) // n_dev + 31) // 32 * 32
+        # uint32 words tile evenly per device (floor 32: an empty graph
+        # still needs one valid row block per device to compile)
+        self.n_local = max(((n_nodes + n_dev - 1) // n_dev + 31) // 32 * 32, 32)
         self.n_global = self.n_local * n_dev
         self.n_nodes = n_nodes
         self.n_dev = n_dev
@@ -221,12 +224,20 @@ class ShardedDeviceGraph:
 
         node_sh = NamedSharding(self.mesh, P(GRAPH_AXIS))
         edge_sh = NamedSharding(self.mesh, P(GRAPH_AXIS))
+        # optional state import (live-graph snapshots): pad rows beyond
+        # n_nodes keep epoch 0 / not-invalid — they have no edges to fire
+        nep = np.zeros(self.n_global, dtype=np.int32)
+        inv = np.zeros(self.n_global, dtype=bool)
+        if node_epoch is not None:
+            nep[:n_nodes] = np.asarray(node_epoch[:n_nodes], dtype=np.int32)
+        if invalid is not None:
+            inv[:n_nodes] = np.asarray(invalid[:n_nodes], dtype=bool)
         self.g = ShardedGraphArrays(
             edge_src=jax.device_put(esrc, edge_sh),
             edge_dst_local=jax.device_put(edst_local, edge_sh),
             edge_dst_epoch=jax.device_put(eepoch, edge_sh),
-            node_epoch=jax.device_put(np.zeros(self.n_global, dtype=np.int32), node_sh),
-            invalid=jax.device_put(np.zeros(self.n_global, dtype=bool), node_sh),
+            node_epoch=jax.device_put(nep, node_sh),
+            invalid=jax.device_put(inv, node_sh),
         )
         self._node_sharding = node_sh
         self._wave, self._wave_chain = build_sharded_wave(

@@ -187,3 +187,68 @@ async def test_backend_offload_matches_host_semantics():
         assert await svc.doubled() == 4
     finally:
         set_default_hub(old)
+
+
+async def test_backend_sharded_export_cascades_on_mesh():
+    """to_sharded bridges the LIVE incremental graph to the multi-chip wave:
+    the mesh cascade must equal the single-chip backend cascade."""
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        capture,
+        compute_method,
+        set_default_hub,
+    )
+    from stl_fusion_tpu.graph import TpuGraphBackend
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        backend = TpuGraphBackend(hub)
+
+        class S(ComputeService):
+            def __init__(self):
+                super().__init__()
+                self.data = {"a": 1, "b": 2}
+
+            @compute_method
+            async def get(self, k: str) -> int:
+                return self.data[k]
+
+            @compute_method
+            async def total(self) -> int:
+                return await self.get("a") + await self.get("b")
+
+            @compute_method
+            async def doubled(self) -> int:
+                return 2 * await self.total()
+
+        svc = S()
+        assert await svc.doubled() == 6
+        c_a = await capture(lambda: svc.get("a"))
+        c_b = await capture(lambda: svc.get("b"))
+        c_total = await capture(lambda: svc.total())
+        c_doubled = await capture(lambda: svc.doubled())
+
+        sharded = backend.to_sharded()  # 8-device CPU mesh (conftest)
+        ids = {name: backend.id_for(c) for name, c in
+               [("a", c_a), ("b", c_b), ("total", c_total), ("doubled", c_doubled)]}
+        count = sharded.run_wave([ids["a"]])
+        assert count == 3  # a, total, doubled — b untouched
+        mask = sharded.invalid_mask()
+        assert mask[ids["a"]] and mask[ids["total"]] and mask[ids["doubled"]]
+        assert not mask[ids["b"]]
+        # the live nodes map back through computed_for
+        assert backend.computed_for(ids["total"]) is c_total
+
+        # stale edges (old epochs) must not fire after a recompute bump:
+        # recompute everything, export again, wave from the NEW a-node
+        svc.data["a"] = 10
+        backend.invalidate_cascade(c_a)
+        assert await svc.doubled() == 24
+        c_a2 = await capture(lambda: svc.get("a"))
+        sharded2 = backend.to_sharded()
+        count2 = sharded2.run_wave([backend.id_for(c_a2)])
+        assert count2 == 3  # fresh epoch edges cascade; dead ones don't refire
+    finally:
+        set_default_hub(old)
