@@ -36,7 +36,7 @@ from ..utils.ltag import LTag
 from ..utils.result import Result
 from ..utils.serialization import dumps, loads
 from .cache import ClientComputedCache, RpcCacheKey
-from .compute_call import RpcOutboundComputeCall
+from .compute_call import ResultMissedError, RpcOutboundComputeCall
 
 log = logging.getLogger("stl_fusion_tpu")
 
@@ -179,6 +179,12 @@ class ClientComputeMethodFunction(FunctionBase):
                 output = Result.ok(value)
             except asyncio.CancelledError:
                 raise
+            except ResultMissedError as e:
+                # invalidation overtook the result (reconnect interleaving /
+                # invalidate-only restart answer): just re-issue the call
+                if tries <= 3:
+                    continue
+                output = Result.err(e)
             except Exception as e:  # noqa: BLE001 — errors are memoized
                 output = Result.err(e)
             version = call.result_version or self.hub.version_generator.next()

@@ -37,7 +37,18 @@ if TYPE_CHECKING:
 
 log = logging.getLogger("stl_fusion_tpu")
 
-__all__ = ["RpcOutboundComputeCall", "RpcInboundComputeCall", "install_compute_call_type"]
+__all__ = [
+    "ResultMissedError",
+    "RpcOutboundComputeCall",
+    "RpcInboundComputeCall",
+    "install_compute_call_type",
+]
+
+
+class ResultMissedError(Exception):
+    """An invalidation arrived while the call's result was still pending —
+    no result is coming (e.g. the server answered a re-sent call with
+    invalidate-only). Retriable: the client just re-issues the call."""
 
 
 class RpcOutboundComputeCall(RpcOutboundCall):
@@ -60,9 +71,22 @@ class RpcOutboundComputeCall(RpcOutboundCall):
         self.set_invalidated()  # an errored call can't deliver invalidations
 
     def set_invalidated(self) -> None:
-        self.peer.outbound_calls.pop(self.call_id, None)
+        """Single-connection delivery is ordered (result, then invalidate —
+        the reference leans on that, RpcOutboundComputeCall.cs:71-83), but
+        two of our paths deliver an invalidate while the result future is
+        still pending: the reconnect-riding invalidation sender racing a
+        re-sent result, and the server's restart() answering a re-sent call
+        with invalidate-ONLY when its computed is already stale. No result
+        can be counted on after that, so a pending future fails with the
+        retriable ``ResultMissedError`` (the client's already-invalidated
+        retry loop handles it) instead of parking the caller forever."""
+        if self.future is not None and not self.future.done():
+            self.future.set_exception(
+                ResultMissedError(f"invalidation overtook the result of call {self.call_id}")
+            )
         if not self.when_invalidated.done():
             self.when_invalidated.set_result(None)
+        self.peer.outbound_calls.pop(self.call_id, None)
 
     def unregister(self) -> None:
         self.peer.outbound_calls.pop(self.call_id, None)

@@ -180,3 +180,47 @@ async def test_client_cache_boots_and_synchronizes():
         assert await client2.get("c") == 5
     finally:
         await _stop(crpc2, srpc2)
+
+
+async def test_result_arriving_already_invalidated_retries_and_converges():
+    """The reference retries ≤3 when a result lands already-invalidated
+    (ClientComputeMethodFunction.cs:99-126). The race is forced
+    deterministically: the client holds the FIRST result message until the
+    server's $sys-c invalidate for that call has been processed, so the
+    result lands on an already-invalidated computed and the client must
+    transparently retry — the caller sees the POST-invalidation value."""
+    from stl_fusion_tpu.rpc.message import COMPUTE_SYSTEM_SERVICE, SYSTEM_SERVICE
+
+    svc, client, _t, client_rpc, server_rpc, _cf = make_stack()
+    try:
+        assert await client.get("warm") == 0  # establish the peer
+        peer = client_rpc.peers["default"]
+        orig = peer.process_message
+        held = []
+        arm = [True]
+
+        async def holding(message):
+            if arm[0] and message.service == SYSTEM_SERVICE and message.method == "ok":
+                arm[0] = False
+                held.append(message)  # park the result...
+                return
+            await orig(message)
+            if held and message.service == COMPUTE_SYSTEM_SERVICE:
+                await orig(held.pop())  # ...deliver it AFTER the invalidate
+
+        peer.process_message = holding
+
+        task = asyncio.ensure_future(client.get("race"))
+        for _ in range(500):  # wait for the server-side compute
+            if svc.compute_count >= 2:
+                break
+            await asyncio.sleep(0.01)
+        assert svc.compute_count >= 2, "server never computed get('race')"
+        await svc.increment("race")  # pushes $sys-c; releases the held result
+
+        # the retry fetched the fresh value — the caller never sees the
+        # stale result that lost the race
+        assert await asyncio.wait_for(task, 5.0) == 1
+        assert svc.compute_count >= 3  # warm, race (stale), race (retry)
+    finally:
+        await _stop(client_rpc, server_rpc)
