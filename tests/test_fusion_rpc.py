@@ -224,3 +224,53 @@ async def test_result_arriving_already_invalidated_retries_and_converges():
         assert svc.compute_count >= 3  # warm, race (stale), race (retry)
     finally:
         await _stop(client_rpc, server_rpc)
+
+
+async def test_invalidate_only_restart_answer_retries():
+    """The OTHER invalidation-overtakes-result path: the link dies before
+    the result reaches the client, the server's computed is invalidated
+    during the outage, and on reconnect the server answers the re-sent call
+    with $sys-c.invalidate ONLY (compute_call.py restart()). The client must
+    re-issue the call instead of waiting forever for a result that will
+    never come."""
+    server_fusion = FusionHub()
+    client_fusion = FusionHub()
+    server_rpc = RpcHub("server")
+    client_rpc = RpcHub("client")
+    install_compute_call_type(server_rpc)
+    install_compute_call_type(client_rpc)
+
+    class Slow(ComputeService):
+        def __init__(self, hub=None):
+            super().__init__(hub)
+            self.value = 0
+            self.computes = 0
+
+        @compute_method
+        async def get(self) -> int:
+            self.computes += 1
+            await asyncio.sleep(0.2)
+            return self.value
+
+        async def bump(self):
+            self.value += 1
+            with invalidating():
+                await self.get()
+
+    svc = Slow(server_fusion)
+    server_rpc.add_service("slow", svc)
+    transport = RpcTestTransport(client_rpc, server_rpc)
+    client = compute_client("slow", client_rpc, client_fusion)
+    try:
+        task = asyncio.ensure_future(client.get())
+        await asyncio.sleep(0.05)  # server is mid-compute
+        transport.block_reconnects(True)
+        await transport.disconnect()  # result will be lost
+        await asyncio.sleep(0.3)  # server finishes compute during the outage
+        await svc.bump()  # ...and the computed dies during the outage
+        transport.block_reconnects(False)
+        # reconnect → re-send → invalidate-only answer → client retries
+        assert await asyncio.wait_for(task, 5.0) == 1
+        assert svc.computes >= 2
+    finally:
+        await _stop(client_rpc, server_rpc)
