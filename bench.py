@@ -206,13 +206,49 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
 
 
 def run_sharded(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
+    """FUSION_BENCH_SHARDED=1. FUSION_BENCH_SHARDED_PACKED=1 additionally
+    selects the bit-packed 32-waves-per-pass mesh kernel
+    (parallel/packed_wave.py) instead of one-wave-at-a-time chaining."""
     import jax
 
     from stl_fusion_tpu.graph.synthetic import power_law_dag
-    from stl_fusion_tpu.parallel import ShardedDeviceGraph, graph_mesh
+    from stl_fusion_tpu.parallel import PackedShardedGraph, ShardedDeviceGraph, graph_mesh
 
     t0 = time.time()
     src, dst = power_law_dag(n_nodes, avg_degree=avg_deg, seed=7)
+    if os.environ.get("FUSION_BENCH_SHARDED_PACKED", "0") == "1":
+        graph = PackedShardedGraph(src, dst, n_nodes, mesh=graph_mesh())
+        build_s = time.time() - t0
+        n_batches = max(n_waves // 32, 1)
+        # pack + upload seeds OUTSIDE the timed region — same convention as
+        # the per-wave sharded path, so the two are comparable
+        batches = [
+            graph.prepare_seeds(
+                [rng.choice(n_nodes, size=seeds_per_wave, replace=False) for _ in range(32)]
+            )
+            for _ in range(n_batches)
+        ]
+        graph.run_waves(batches[0])  # compile
+        graph.clear_invalid()
+        total = 0
+        t_start = time.perf_counter()
+        for batch in batches:
+            graph.clear_invalid()  # cached device zeros: no H2D
+            total += graph.run_waves(batch)
+        elapsed = time.perf_counter() - t_start
+        n_waves = n_batches * 32
+        return {
+            "total_invalidated": total,
+            "elapsed_s": elapsed,
+            "waves": n_waves,
+            "wave_ms_p50": elapsed / n_waves * 1e3,
+            "wave_ms_p99": elapsed / n_waves * 1e3,
+            "edges": int(len(src)),
+            "graph_build_s": round(build_s, 2),
+            "sharded": True,
+            "packed": True,
+            "mesh_devices": graph.mesh.devices.size,
+        }
     graph = ShardedDeviceGraph(src, dst, n_nodes, mesh=graph_mesh())
     build_s = time.time() - t0
 

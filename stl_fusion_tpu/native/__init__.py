@@ -190,3 +190,4 @@ def native_build_ell(src, dst, n_nodes: int, k: int):
         return ell_dst, int(n_tot)
     finally:
         lib.gp_free(handle)
+

@@ -1,11 +1,14 @@
 """Multi-chip execution: mesh helpers + sharded invalidation waves."""
 from .mesh import GRAPH_AXIS, graph_mesh
+from .packed_wave import PackedShardedGraph, build_packed_sharded_wave
 from .sharded_wave import ShardedDeviceGraph, ShardedGraphArrays, build_sharded_wave
 
 __all__ = [
     "GRAPH_AXIS",
     "graph_mesh",
+    "PackedShardedGraph",
     "ShardedDeviceGraph",
     "ShardedGraphArrays",
+    "build_packed_sharded_wave",
     "build_sharded_wave",
 ]

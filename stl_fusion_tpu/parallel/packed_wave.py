@@ -1,0 +1,167 @@
+"""Bit-packed sharded waves — 32 independent waves per mesh pass.
+
+The multi-chip counterpart of the single-chip pull kernel
+(ops/pull_wave.py): node rows block-shard over the mesh's ``graph`` axis,
+each row's ≤ k in-edges live beside it (in-ELL with virtual OR-collector
+trees bounding fan-in, built by the native packer), and each BFS level is:
+
+  1. ONE ``all_gather`` of the newly-lit frontier WORDS over ICI —
+     32 waves ride each uint32 lane, so the per-wave exchange cost is
+     1 bit/node/level;
+  2. a local row gather + epoch-masked OR-fold (the pull pattern: a row
+     pulls from its dependencies, so the scatter-OR that JAX lacks is
+     never needed);
+  3. ``psum`` of the newly-lit count for the loop-continuation flag.
+
+This is the wave the ``ShardedDeviceGraph`` (sharded_wave.py) runs one at a
+time, multiplied 32× per pass — the same packing lever that took the
+single-chip topo sweep from 1B to 7.7B inv/s (PERF.md).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from .mesh import GRAPH_AXIS, graph_mesh
+
+__all__ = ["PackedShardedGraph", "build_packed_sharded_wave"]
+
+
+def build_packed_sharded_wave(mesh: Mesh):
+    """Compile the packed 32-wave sharded kernel for a mesh.
+
+    Returns ``wave32(seed_bits, in_src, edge_epoch, node_epoch, is_real,
+    invalid) -> (invalid, count)`` — all row-sharded arrays (row count must
+    divide evenly over the mesh), seed/invalid as int32 words (32 packed
+    waves); k comes from ``in_src``'s trailing dimension at trace time."""
+    node_spec = P(GRAPH_AXIS)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(node_spec,) * 6,
+        out_specs=(node_spec, P()),
+    )
+    def _wave(seeds_l, in_src_l, eepoch_l, nepoch_l, is_real_l, inv_l):
+        live = eepoch_l == nepoch_l[:, None]  # dead/pad slots never match
+        frontier_l = seeds_l & ~inv_l
+        inv_l = inv_l | frontier_l
+        go0 = lax.psum((frontier_l != 0).any().astype(jnp.int32), GRAPH_AXIS) > 0
+
+        def cond(carry):
+            _f, _inv, go = carry
+            return go
+
+        def body(carry):
+            f_l, inv_l, _go = carry
+            # the ONE collective: newly-lit words, 32 waves per lane
+            f_full = lax.all_gather(f_l, GRAPH_AXIS, tiled=True)
+            f = f_full[in_src_l]  # (n_local, k); pad rows clamp, masked by live
+            contrib = jnp.where(live, f, 0)
+            fire = contrib[:, 0]
+            for j in range(1, contrib.shape[1]):
+                fire = fire | contrib[:, j]
+            fire = fire & ~inv_l
+            inv_l = inv_l | fire
+            go = lax.psum((fire != 0).any().astype(jnp.int32), GRAPH_AXIS) > 0
+            return fire, inv_l, go
+
+        _f, inv_l, _go = lax.while_loop(cond, body, (frontier_l, inv_l, go0))
+        count = lax.psum(
+            lax.population_count(jnp.where(is_real_l, inv_l, 0)).sum(dtype=jnp.int32),
+            GRAPH_AXIS,
+        )
+        return inv_l, count
+
+    @jax.jit
+    def wave32(seed_bits, in_src, edge_epoch, node_epoch, is_real, invalid):
+        return _wave(seed_bits, in_src, edge_epoch, node_epoch, is_real, invalid)
+
+    return wave32
+
+
+class PackedShardedGraph:
+    """Static mesh-sharded graph running 32 packed waves per pass."""
+
+    def __init__(
+        self,
+        edges_src: np.ndarray,
+        edges_dst: np.ndarray,
+        n_nodes: int,
+        mesh: Optional[Mesh] = None,
+        k: int = 8,
+    ):
+        # build_pull_graph = build_ell on reversed edges, which routes
+        # through the native packer itself — one packer path to maintain
+        from ..ops.pull_wave import build_pull_graph
+
+        self.mesh = mesh or graph_mesh()
+        n_dev = self.mesh.devices.size
+
+        ell = build_pull_graph(edges_src, edges_dst, n_nodes, k=k)
+        in_src, n_tot = ell.ell_dst, ell.n_tot
+        self.n_nodes = n_nodes
+        self.n_tot = n_tot
+        self.k = k
+        # pad rows to the mesh grid; pads are inert (epoch -1 slots)
+        self.n_local = max(-(-(n_tot + 1) // n_dev), 1)
+        self.n_global = self.n_local * n_dev
+
+        rows = np.full((self.n_global, k), n_tot, dtype=np.int32)
+        rows[: n_tot + 1] = in_src
+        edge_epoch = np.full((self.n_global, k), -1, dtype=np.int32)
+        edge_epoch[: n_tot + 1][in_src != n_tot] = 0
+        node_epoch = np.zeros(self.n_global, dtype=np.int32)
+        node_epoch[n_tot:] = -2  # null + pad rows never match any edge epoch
+        is_real = np.zeros(self.n_global, dtype=bool)
+        is_real[:n_nodes] = True
+
+        sh = NamedSharding(self.mesh, P(GRAPH_AXIS))
+        sh2 = NamedSharding(self.mesh, P(GRAPH_AXIS, None))
+        self.in_src = jax.device_put(rows, sh2)
+        self.edge_epoch = jax.device_put(edge_epoch, sh2)
+        self.node_epoch = jax.device_put(node_epoch, sh)
+        self.is_real = jax.device_put(is_real, sh)
+        self.invalid = jax.device_put(np.zeros(self.n_global, dtype=np.int32), sh)
+        self._sharding = sh
+        self._zero_words = jax.device_put(np.zeros(self.n_global, dtype=np.int32), sh)
+        self._wave32 = build_packed_sharded_wave(self.mesh)
+
+    # ------------------------------------------------------------------ waves
+    def seeds_to_bits(self, seed_ids_per_wave: Sequence[Sequence[int]]) -> np.ndarray:
+        bits = np.zeros(self.n_global, dtype=np.int32)
+        for w, ids in enumerate(seed_ids_per_wave[:32]):
+            mask = np.int32(1 << w) if w < 31 else np.int32(-(1 << 31))
+            bits[np.asarray(ids, dtype=np.int64)] |= mask
+        return bits
+
+    def prepare_seeds(self, seed_ids_per_wave: Sequence[Sequence[int]]):
+        """Pack + upload seed words once, outside any timed region."""
+        return jax.device_put(self.seeds_to_bits(seed_ids_per_wave), self._sharding)
+
+    def run_waves(self, seeds) -> int:
+        """Run ≤32 packed waves; ``seeds`` is a list of per-wave id lists or
+        a device array from ``prepare_seeds``. Returns total real
+        invalidations (popcount over all lanes)."""
+        if isinstance(seeds, (list, tuple)):
+            seeds = self.prepare_seeds(seeds)
+        self.invalid, count = self._wave32(
+            seeds, self.in_src, self.edge_epoch, self.node_epoch, self.is_real, self.invalid
+        )
+        return int(count)
+
+    def clear_invalid(self) -> None:
+        # a cached device-zero array: no per-clear H2D transfer
+        self.invalid = self._zero_words
+
+    def invalid_mask(self, wave: int = 0) -> np.ndarray:
+        """bool[n_nodes] for one packed wave lane."""
+        bit = np.int64(1) << wave
+        return (np.asarray(self.invalid[: self.n_nodes]).astype(np.int64) & bit) != 0
