@@ -29,6 +29,7 @@ table versions for reactive `ComputedState`-style consumers.
 """
 from __future__ import annotations
 
+import functools
 from typing import Callable, List, Optional, Sequence, Union
 
 import numpy as np
@@ -65,7 +66,7 @@ class MemoTable:
         self._packed_cache: Optional[tuple] = None  # (version, packed bits)
         self.on_invalidate: List[Callable[[np.ndarray], None]] = []
         self.changed: AsyncEvent = AsyncEvent(0)
-        self._jit_cache = _build_kernels(jnp)
+        self._jit_cache = _kernels()  # shared: tables reuse one compile cache
         if eager:
             self.refresh(np.arange(self.n_rows))
 
@@ -143,8 +144,12 @@ class MemoTable:
         return f"MemoTable({self.n_rows} rows, {self.stale_count()} stale, v{self.version})"
 
 
-def _build_kernels(jnp):
+@functools.lru_cache(maxsize=1)
+def _kernels():
+    """Module-level jitted kernels: per-instance closures would give every
+    MemoTable its own compile cache and recompile identical programs."""
     import jax
+    import jax.numpy as jnp
 
     @jax.jit
     def gather(values, ids):

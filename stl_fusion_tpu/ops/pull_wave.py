@@ -55,11 +55,26 @@ def build_pull_graph(src: np.ndarray, dst: np.ndarray, n_nodes: int, k: int = 8)
     return build_ell(dst, src, n_nodes, k=k)
 
 
+def pack_seed_words(
+    n_rows: int, seed_ids_per_wave, words: int = 1, id_map: "np.ndarray" = None
+) -> np.ndarray:
+    """≤``32*words`` seed-id lists → int32 bit words (host-side prep):
+    1-D [n_rows] for ``words=1``, else [n_rows, words]. ``id_map`` remaps
+    seed ids first (e.g. topo's original→level-order permutation). The
+    shared packer behind every bit-packed kernel's seed path."""
+    bits = np.zeros((n_rows, words), dtype=np.int32)
+    for i, ids in enumerate(seed_ids_per_wave[: 32 * words]):
+        w, lane = divmod(i, 32)
+        ids = np.asarray(ids, dtype=np.int64)
+        if id_map is not None:
+            ids = id_map[ids]
+        bits[ids, w] |= np.int32(1 << lane) if lane < 31 else np.int32(-(1 << 31))
+    return bits[:, 0] if words == 1 else bits
+
+
 def seeds_to_bits(n_tot: int, seed_ids_per_wave) -> np.ndarray:
     """List of ≤32 seed-id arrays → int32 bitmask vector (host-side prep)."""
-    bits = np.zeros(n_tot + 1, dtype=np.int32)
-    for w, ids in enumerate(seed_ids_per_wave[:32]):
-        bits[np.asarray(ids, dtype=np.int64)] |= np.int32(1 << w) if w < 31 else np.int32(-(1 << 31))
+    bits = pack_seed_words(n_tot + 1, seed_ids_per_wave)
     bits[n_tot] = 0
     return bits
 

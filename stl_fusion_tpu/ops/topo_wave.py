@@ -37,6 +37,7 @@ from typing import NamedTuple, Tuple
 import numpy as np
 
 from .ell_wave import EllGraph, build_ell
+from .pull_wave import pack_seed_words
 
 __all__ = [
     "TopoGraph",
@@ -164,13 +165,11 @@ def topo_seeds_to_bits(graph: TopoGraph, seed_ids_per_wave, words: int = 1) -> n
     """≤``32*words`` seed-id arrays (ORIGINAL node ids) → int32 bit
     vector[s] in NEW id space, ready for the sweep (1-D for ``words=1``,
     else [n_tot+1, words])."""
-    bits = np.zeros((graph.n_tot + 1, words), dtype=np.int32)
-    for i, ids in enumerate(seed_ids_per_wave[: 32 * words]):
-        w, lane = divmod(i, 32)
-        new_ids = graph.inv_perm[np.asarray(ids, dtype=np.int64)]
-        bits[new_ids, w] |= np.int32(1 << lane) if lane < 31 else np.int32(-(1 << 31))
+    bits = pack_seed_words(
+        graph.n_tot + 1, seed_ids_per_wave, words=words, id_map=graph.inv_perm
+    )
     bits[graph.n_tot] = 0
-    return bits[:, 0] if words == 1 else bits
+    return bits
 
 
 def _topo_sweep_impl(level_starts, garrays: TopoGraphArrays, seed_bits, state: TopoState):

@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from ..ops.pull_wave import pack_seed_words
 from .mesh import GRAPH_AXIS, graph_mesh
 
 __all__ = ["PackedShardedGraph", "build_packed_sharded_wave"]
@@ -136,11 +137,7 @@ class PackedShardedGraph:
 
     # ------------------------------------------------------------------ waves
     def seeds_to_bits(self, seed_ids_per_wave: Sequence[Sequence[int]]) -> np.ndarray:
-        bits = np.zeros(self.n_global, dtype=np.int32)
-        for w, ids in enumerate(seed_ids_per_wave[:32]):
-            mask = np.int32(1 << w) if w < 31 else np.int32(-(1 << 31))
-            bits[np.asarray(ids, dtype=np.int64)] |= mask
-        return bits
+        return pack_seed_words(self.n_global, seed_ids_per_wave)
 
     def prepare_seeds(self, seed_ids_per_wave: Sequence[Sequence[int]]):
         """Pack + upload seed words once, outside any timed region."""
