@@ -217,26 +217,33 @@ def run_sharded(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
     t0 = time.time()
     src, dst = power_law_dag(n_nodes, avg_degree=avg_deg, seed=7)
     if os.environ.get("FUSION_BENCH_SHARDED_PACKED", "0") == "1":
-        graph = PackedShardedGraph(src, dst, n_nodes, mesh=graph_mesh())
+        words = int(os.environ.get("FUSION_BENCH_WORDS", 16))
+        graph = PackedShardedGraph(src, dst, n_nodes, mesh=graph_mesh(), words=words)
         build_s = time.time() - t0
-        n_batches = max(n_waves // 32, 1)
+        wpb = 32 * words
+        n_batches = max(n_waves // wpb, 1)
         # pack + upload seeds OUTSIDE the timed region — same convention as
         # the per-wave sharded path, so the two are comparable
-        batches = [
-            graph.prepare_seeds(
-                [rng.choice(n_nodes, size=seeds_per_wave, replace=False) for _ in range(32)]
-            )
-            for _ in range(n_batches)
-        ]
-        graph.run_waves(batches[0])  # compile
+        stacked = np.stack(
+            [
+                np.asarray(
+                    graph.seeds_to_bits(
+                        [
+                            rng.choice(n_nodes, size=seeds_per_wave, replace=False)
+                            for _ in range(wpb)
+                        ]
+                    )
+                )
+                for _ in range(n_batches)
+            ]
+        )
+        seeds_dev = graph.prepare_seed_batches(stacked)
+        total, _ = graph.run_wave_batches(seeds_dev)  # compile
         graph.clear_invalid()
-        total = 0
         t_start = time.perf_counter()
-        for batch in batches:
-            graph.clear_invalid()  # cached device zeros: no H2D
-            total += graph.run_waves(batch)
+        total, counts = graph.run_wave_batches(seeds_dev)
         elapsed = time.perf_counter() - t_start
-        n_waves = n_batches * 32
+        n_waves = n_batches * wpb
         return {
             "total_invalidated": total,
             "elapsed_s": elapsed,
@@ -245,8 +252,10 @@ def run_sharded(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
             "wave_ms_p99": elapsed / n_waves * 1e3,
             "edges": int(len(src)),
             "graph_build_s": round(build_s, 2),
+            "counts_head": [int(c) for c in counts[:3]],
             "sharded": True,
             "packed": True,
+            "words": words,
             "mesh_devices": graph.mesh.devices.size,
         }
     graph = ShardedDeviceGraph(src, dst, n_nodes, mesh=graph_mesh())

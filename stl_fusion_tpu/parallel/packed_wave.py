@@ -1,4 +1,4 @@
-"""Bit-packed sharded waves — 32 independent waves per mesh pass.
+"""Bit-packed sharded waves — ``32*words`` independent waves per mesh pass.
 
 The multi-chip counterpart of the single-chip pull kernel
 (ops/pull_wave.py): node rows block-shard over the mesh's ``graph`` axis,
@@ -13,9 +13,10 @@ trees bounding fan-in, built by the native packer), and each BFS level is:
      never needed);
   3. ``psum`` of the newly-lit count for the loop-continuation flag.
 
-This is the wave the ``ShardedDeviceGraph`` (sharded_wave.py) runs one at a
-time, multiplied 32× per pass — the same packing lever that took the
-single-chip topo sweep from 1B to 7.7B inv/s (PERF.md).
+``words`` packs W uint32 lanes per row — the same transaction-width lever
+that took the single-chip topo sweep from 1B to 7.7B inv/s (PERF.md);
+``run_wave_batches`` chains batches in one compiled program with a single
+readback (per-batch host dispatch pays a relay round trip each).
 """
 from __future__ import annotations
 
@@ -36,19 +37,22 @@ __all__ = ["PackedShardedGraph", "build_packed_sharded_wave"]
 
 
 def build_packed_sharded_wave(mesh: Mesh):
-    """Compile the packed 32-wave sharded kernel for a mesh.
+    """Compile the packed sharded kernel for a mesh.
 
-    Returns ``wave32(seed_bits, in_src, edge_epoch, node_epoch, is_real,
-    invalid) -> (invalid, count)`` — all row-sharded arrays (row count must
-    divide evenly over the mesh), seed/invalid as int32 words (32 packed
-    waves); k comes from ``in_src``'s trailing dimension at trace time."""
+    Returns ``wave(seed_bits, in_src, edge_epoch, node_epoch, is_real,
+    invalid) -> (invalid, counts)`` — row-sharded arrays (row count must
+    divide evenly over the mesh); seeds/invalid are int32 words
+    [rows, W] (32 packed waves per lane); ``counts`` is int32[W] per-word
+    (one word's count is ≤ 32·rows, int32-safe — totals are summed in
+    int64 host-side). k and W come from array shapes at trace time."""
     node_spec = P(GRAPH_AXIS)
+    word_spec = P(GRAPH_AXIS, None)
 
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(node_spec,) * 6,
-        out_specs=(node_spec, P()),
+        in_specs=(word_spec, word_spec, word_spec, node_spec, node_spec, word_spec),
+        out_specs=(word_spec, P()),
     )
     def _wave(seeds_l, in_src_l, eepoch_l, nepoch_l, is_real_l, inv_l):
         live = eepoch_l == nepoch_l[:, None]  # dead/pad slots never match
@@ -64,8 +68,8 @@ def build_packed_sharded_wave(mesh: Mesh):
             f_l, inv_l, _go = carry
             # the ONE collective: newly-lit words, 32 waves per lane
             f_full = lax.all_gather(f_l, GRAPH_AXIS, tiled=True)
-            f = f_full[in_src_l]  # (n_local, k); pad rows clamp, masked by live
-            contrib = jnp.where(live, f, 0)
+            f = f_full[in_src_l]  # (n_local, k, W); pad rows clamp, masked by live
+            contrib = jnp.where(live[:, :, None], f, 0)
             fire = contrib[:, 0]
             for j in range(1, contrib.shape[1]):
                 fire = fire | contrib[:, j]
@@ -75,21 +79,23 @@ def build_packed_sharded_wave(mesh: Mesh):
             return fire, inv_l, go
 
         _f, inv_l, _go = lax.while_loop(cond, body, (frontier_l, inv_l, go0))
-        count = lax.psum(
-            lax.population_count(jnp.where(is_real_l, inv_l, 0)).sum(dtype=jnp.int32),
+        counts = lax.psum(
+            lax.population_count(jnp.where(is_real_l[:, None], inv_l, 0)).sum(
+                axis=0, dtype=jnp.int32
+            ),
             GRAPH_AXIS,
         )
-        return inv_l, count
+        return inv_l, counts
 
     @jax.jit
-    def wave32(seed_bits, in_src, edge_epoch, node_epoch, is_real, invalid):
+    def wave(seed_bits, in_src, edge_epoch, node_epoch, is_real, invalid):
         return _wave(seed_bits, in_src, edge_epoch, node_epoch, is_real, invalid)
 
-    return wave32
+    return wave
 
 
 class PackedShardedGraph:
-    """Static mesh-sharded graph running 32 packed waves per pass."""
+    """Static mesh-sharded graph running ``32*words`` packed waves per pass."""
 
     def __init__(
         self,
@@ -98,6 +104,7 @@ class PackedShardedGraph:
         n_nodes: int,
         mesh: Optional[Mesh] = None,
         k: int = 8,
+        words: int = 1,
     ):
         # build_pull_graph = build_ell on reversed edges, which routes
         # through the native packer itself — one packer path to maintain
@@ -111,6 +118,7 @@ class PackedShardedGraph:
         self.n_nodes = n_nodes
         self.n_tot = n_tot
         self.k = k
+        self.words = words
         # pad rows to the mesh grid; pads are inert (epoch -1 slots)
         self.n_local = max(-(-(n_tot + 1) // n_dev), 1)
         self.n_global = self.n_local * n_dev
@@ -130,29 +138,69 @@ class PackedShardedGraph:
         self.edge_epoch = jax.device_put(edge_epoch, sh2)
         self.node_epoch = jax.device_put(node_epoch, sh)
         self.is_real = jax.device_put(is_real, sh)
-        self.invalid = jax.device_put(np.zeros(self.n_global, dtype=np.int32), sh)
-        self._sharding = sh
-        self._zero_words = jax.device_put(np.zeros(self.n_global, dtype=np.int32), sh)
-        self._wave32 = build_packed_sharded_wave(self.mesh)
+        self._word_sharding = sh2
+        self._zero_words = jax.device_put(
+            np.zeros((self.n_global, words), dtype=np.int32), sh2
+        )
+        self.invalid = self._zero_words
+        self._wave = build_packed_sharded_wave(self.mesh)
+        self._chain = None  # compiled lazily per batch shape
 
     # ------------------------------------------------------------------ waves
     def seeds_to_bits(self, seed_ids_per_wave: Sequence[Sequence[int]]) -> np.ndarray:
-        return pack_seed_words(self.n_global, seed_ids_per_wave)
+        bits = pack_seed_words(self.n_global, seed_ids_per_wave, words=self.words)
+        return bits[:, None] if self.words == 1 else bits
 
     def prepare_seeds(self, seed_ids_per_wave: Sequence[Sequence[int]]):
         """Pack + upload seed words once, outside any timed region."""
-        return jax.device_put(self.seeds_to_bits(seed_ids_per_wave), self._sharding)
+        return jax.device_put(self.seeds_to_bits(seed_ids_per_wave), self._word_sharding)
 
     def run_waves(self, seeds) -> int:
-        """Run ≤32 packed waves; ``seeds`` is a list of per-wave id lists or
-        a device array from ``prepare_seeds``. Returns total real
-        invalidations (popcount over all lanes)."""
+        """Run ≤``32*words`` packed waves; ``seeds`` is a list of per-wave id
+        lists or a device array from ``prepare_seeds``. Returns total real
+        invalidations (popcount over all lanes, int64-summed)."""
         if isinstance(seeds, (list, tuple)):
             seeds = self.prepare_seeds(seeds)
-        self.invalid, count = self._wave32(
+        self.invalid, counts = self._wave(
             seeds, self.in_src, self.edge_epoch, self.node_epoch, self.is_real, self.invalid
         )
-        return int(count)
+        return int(np.asarray(counts, dtype=np.int64).sum())
+
+    def prepare_seed_batches(self, seed_batches: np.ndarray):
+        """Upload stacked seed batches [B, n_global, W] sharded — call once,
+        outside any timed region."""
+        return jax.device_put(
+            seed_batches, NamedSharding(self.mesh, P(None, GRAPH_AXIS, None))
+        )
+
+    def run_wave_batches(self, seed_batches) -> Tuple[int, np.ndarray]:
+        """Chain B batches (each ``32*words`` waves, invalid reset between —
+        the bench churn model) in ONE compiled program with a single
+        readback. ``seed_batches``: [B, n_global, W] numpy (uploaded per
+        call) or a device array from ``prepare_seed_batches``. Returns
+        (total, per-batch counts int64[B])."""
+        if isinstance(seed_batches, np.ndarray):
+            seed_batches = self.prepare_seed_batches(seed_batches)
+        if self._chain is None:
+            wave = self._wave
+
+            @jax.jit
+            def chain(seed_batches, in_src, edge_epoch, node_epoch, is_real, invalid):
+                def body(inv, seeds):
+                    inv = jnp.zeros_like(inv)
+                    inv, counts = wave(seeds, in_src, edge_epoch, node_epoch, is_real, inv)
+                    return inv, counts
+
+                inv, counts = lax.scan(body, invalid, seed_batches)
+                return inv, counts
+
+            self._chain = chain
+        self.invalid, counts = self._chain(
+            seed_batches, self.in_src, self.edge_epoch, self.node_epoch,
+            self.is_real, self.invalid,
+        )
+        counts = np.asarray(counts, dtype=np.int64)
+        return int(counts.sum()), counts.sum(axis=1)
 
     def clear_invalid(self) -> None:
         # a cached device-zero array: no per-clear H2D transfer
@@ -160,5 +208,6 @@ class PackedShardedGraph:
 
     def invalid_mask(self, wave: int = 0) -> np.ndarray:
         """bool[n_nodes] for one packed wave lane."""
-        bit = np.int64(1) << wave
-        return (np.asarray(self.invalid[: self.n_nodes]).astype(np.int64) & bit) != 0
+        w, lane = divmod(wave, 32)
+        col = np.asarray(self.invalid[: self.n_nodes, w]).astype(np.int64)
+        return (col & (np.int64(1) << lane)) != 0

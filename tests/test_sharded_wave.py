@@ -159,3 +159,41 @@ def test_packed_sharded_wave_idempotent_and_incremental():
     pg.clear_invalid()
     assert pg.run_waves([[1]]) == 2  # 1 and 3 only
     assert not pg.invalid_mask()[0] and not pg.invalid_mask()[2]
+
+
+def test_packed_sharded_multiword_and_chained():
+    """words=2 packs 64 waves per pass; chained batches equal separate runs."""
+    from stl_fusion_tpu.parallel import PackedShardedGraph
+
+    rng = np.random.default_rng(21)
+    n = 300
+    edges = random_dag(rng, n, avg_deg=3.0)
+    arr = np.asarray(edges, dtype=np.int32)
+    src, dst = arr[:, 0], arr[:, 1]
+    seed_lists = [rng.choice(n, size=4, replace=False).tolist() for _ in range(64)]
+
+    pg = PackedShardedGraph(src, dst, n, mesh=graph_mesh(), words=2)
+    total = pg.run_waves(seed_lists)
+    expected = 0
+    for i, seeds in enumerate(seed_lists):
+        want = python_wave_oracle(
+            n, list(zip(src.tolist(), dst.tolist())), [0] * len(src),
+            np.zeros(n, np.int32), np.zeros(n, bool), seeds,
+        )
+        np.testing.assert_array_equal(pg.invalid_mask(wave=i), want, err_msg=f"wave {i}")
+        expected += int(want.sum())
+    assert total == expected
+
+    # chained batches: 2 batches of 64 == two separate cleared runs
+    pg2 = PackedShardedGraph(src, dst, n, mesh=graph_mesh(), words=2)
+    batch2_lists = [rng.choice(n, size=4, replace=False).tolist() for _ in range(64)]
+    stacked = np.stack(
+        [np.asarray(pg2.seeds_to_bits(seed_lists)), np.asarray(pg2.seeds_to_bits(batch2_lists))]
+    )
+    chained_total, per_batch = pg2.run_wave_batches(stacked)
+    pg3 = PackedShardedGraph(src, dst, n, mesh=graph_mesh(), words=2)
+    t1 = pg3.run_waves(seed_lists)
+    pg3.clear_invalid()
+    t2 = pg3.run_waves(batch2_lists)
+    assert per_batch.tolist() == [t1, t2]
+    assert chained_total == t1 + t2
