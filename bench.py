@@ -23,7 +23,8 @@ FUSION_BENCH_SEEDS (100_000 per wave), FUSION_BENCH_WAVES (20),
 FUSION_BENCH_WORDS (topo row width in uint32 lanes, default 16 = 512 packed
 waves per sweep), FUSION_BENCH_LATENCY=1 → on-device single-wave latency
 sampling (second long compile), FUSION_BENCH_SHARDED=1 → mesh-sharded dense
-wave over all devices.
+wave over all devices, +FUSION_BENCH_SHARDED_PACKED=1 → the bit-packed
+32*WORDS-waves-per-pass mesh kernel (parallel/packed_wave.py).
 """
 import json
 import os

@@ -23,6 +23,9 @@ class RpcPeerState:
     is_connected: bool
     error: Optional[str] = None
     reconnects_at: Optional[float] = None
+    #: the peer gave up (unrecoverable connect error): no reconnect is
+    #: coming, so UIs should render a hard failure, not a retry banner
+    is_terminated: bool = False
 
 
 class RpcPeerStateMonitor(WorkerBase):
@@ -41,7 +44,12 @@ class RpcPeerStateMonitor(WorkerBase):
                 RpcPeerState(
                     is_connected=s.is_connected,
                     error=str(s.error) if s.error else None,
-                    reconnects_at=getattr(self.peer, "reconnects_at", None),
+                    # a terminated peer never retries: suppress any stale
+                    # retry timestamp so UIs don't render a reconnect banner
+                    reconnects_at=(
+                        None if s.is_terminated else getattr(self.peer, "reconnects_at", None)
+                    ),
+                    is_terminated=s.is_terminated,
                 )
             )
             ev = await ev.when_next()

@@ -47,6 +47,10 @@ class ConnectionState:
     def is_connected(self) -> bool:
         return self.kind == ConnectionState.CONNECTED
 
+    @property
+    def is_terminated(self) -> bool:
+        return self.kind == ConnectionState.TERMINATED
+
     def __repr__(self) -> str:
         return f"ConnectionState({self.kind})"
 
@@ -90,10 +94,8 @@ class RpcPeer(WorkerBase):
         ev = self.connection_state.latest()
         if not ev.value.is_connected:
             self.start()
-            ev = await ev.when(
-                lambda s: s.is_connected or s.kind == ConnectionState.TERMINATED
-            )
-            if ev.value.kind == ConnectionState.TERMINATED:
+            ev = await ev.when(lambda s: s.is_connected or s.is_terminated)
+            if ev.value.is_terminated:
                 raise ev.value.error or ConnectionError(
                     f"peer {self.ref} terminated without a connection"
                 )

@@ -363,3 +363,34 @@ class TestReviewFixes:
         finally:
             server.close()
             await server.wait_closed()
+
+
+async def test_peer_monitor_reports_terminated_state():
+    """An unrecoverable connect error surfaces as is_terminated (a hard
+    failure for UIs, not a retry banner)."""
+    from stl_fusion_tpu.ext import RpcPeerStateMonitor
+    from stl_fusion_tpu.rpc import RpcHub
+
+    hub = RpcHub("client")
+
+    async def bad_connector(peer):
+        raise LookupError("not configured")
+
+    hub.client_connector = bad_connector
+    peer = hub.client_peer("default")
+    monitor = RpcPeerStateMonitor(peer)
+    monitor.start()
+    try:
+        with pytest.raises(LookupError):
+            await asyncio.wait_for(peer.when_connected(), 2.0)
+        for _ in range(100):
+            if monitor.state.value.is_terminated:
+                break
+            await asyncio.sleep(0.01)
+        state = monitor.state.value
+        assert state.is_terminated and not state.is_connected
+        assert state.reconnects_at is None  # no retry banner for a dead peer
+        assert "not configured" in state.error
+    finally:
+        await monitor.stop()
+        await hub.stop()
