@@ -25,6 +25,7 @@ __all__ = [
     "SignInCommand",
     "SignOutCommand",
     "EditUserCommand",
+    "InvalidateUserSessionsCommand",
     "InMemoryAuthService",
     "SqliteAuthService",
 ]
@@ -79,6 +80,20 @@ class SignOutCommand:
 class EditUserCommand:
     session: Session
     name: str
+
+
+@wire_type("InvalidateUserSessions")
+@dataclasses.dataclass(frozen=True)
+class InvalidateUserSessionsCommand:
+    """Replay-only marker stashed into the enclosing Operation's items when
+    a command changes which user a session belongs to: the pre-command
+    user_id is captured at execution time (the reference captures the old
+    SessionInfo via Operation Items, DbAuthService.cs:54-58) so the
+    invalidation replay can reach ``get_user_sessions(old_user_id)`` after
+    the session row no longer mentions that user. Execution branch is a
+    no-op; it also rides the op log, so other hosts invalidate too."""
+
+    user_id: str
 
 
 class InMemoryAuthService(ComputeService):
@@ -143,6 +158,10 @@ class InMemoryAuthService(ComputeService):
             # a force-signed-out session is permanently unavailable
             # (DbAuthService.Backend.cs:42-43, Errors.SessionUnavailable)
             raise PermissionError("session is unavailable (forced sign-out)")
+        if existing is not None and existing.user_id and existing.user_id != command.user.id:
+            # the session is being reassigned: the OLD user's session list
+            # changes too — capture their id for the replay
+            self._capture_user_sessions_invalidation(existing.user_id)
         self._store_user(command.user)
         self._store_session(
             SessionInfo(
@@ -161,6 +180,12 @@ class InMemoryAuthService(ComputeService):
         info = self._load_session(command.session.id)
         if info is not None and info.is_sign_out_forced:
             return  # already forced out — no-op (DbAuthService.cs:84-85)
+        if info is not None and info.user_id:
+            # the replay can't recover the old user_id from the (by then
+            # rewritten) session row — capture it now, like the reference's
+            # SignOut invalidating GetUserSessions via the operation-captured
+            # SessionInfo (DbAuthService.cs:54-58)
+            self._capture_user_sessions_invalidation(info.user_id)
         now = time.time()
         base = info if info is not None else SessionInfo(command.session.id, created_at=now)
         self._store_session(
@@ -179,6 +204,20 @@ class InMemoryAuthService(ComputeService):
             raise PermissionError("not signed in")
         user = self._load_user(info.user_id)
         self._store_user(dataclasses.replace(user, name=command.name))
+
+    @command_handler
+    async def _invalidate_user_sessions(self, command: InvalidateUserSessionsCommand):
+        if is_invalidating():
+            await self.get_user_sessions(command.user_id)
+        # execution branch: nothing to do — the marker only exists to be
+        # replayed (it enters the pipeline via Operation.items, not call())
+
+    def _capture_user_sessions_invalidation(self, user_id: str) -> None:
+        from ..operations.pipeline import current_operation
+
+        op = current_operation()
+        if op is not None:
+            op.items.append(InvalidateUserSessionsCommand(user_id))
 
     async def _invalidate_session(self, session: Session) -> None:
         await self.get_session_info(session)

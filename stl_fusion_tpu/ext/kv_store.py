@@ -175,8 +175,14 @@ class SandboxedKeyValueStore:
     invalidation flow through unchanged."""
 
     def __init__(self, store: KeyValueStore, session):
+        from urllib.parse import quote
+
         self.store = store
-        self.prefix = f"@sandbox/{session.id}/"
+        # the session id is URL-encoded (no unescaped '/') so a crafted id
+        # like "a/b" cannot alias session "a"'s sandbox with key "b/..."
+        # — the reference formats keys the same way
+        # (SandboxedKeyValueStore.cs key formatting)
+        self.prefix = f"@sandbox/{quote(session.id, safe='')}/"
 
     def _k(self, key: str) -> str:
         return self.prefix + key

@@ -48,7 +48,7 @@ if TYPE_CHECKING:
 
 log = logging.getLogger("stl_fusion_tpu")
 
-__all__ = ["OperationsHost", "attach_operations"]
+__all__ = ["OperationsHost", "attach_operations", "current_operation"]
 
 # priority constants (higher runs earlier), mirroring the reference ordering
 PRIORITY_REPROCESSOR = 100
@@ -216,6 +216,18 @@ def attach_operations(commander: "Commander") -> OperationsHost:
     )
     commander.registry.add_function(completion_terminator, command_type=Completion)
     return host
+
+
+def current_operation() -> Optional[Operation]:
+    """The Operation enclosing the ambient command context, if any — the
+    hook handlers use to stash pre-command state for the invalidation
+    replay (≈ the reference's ``Operation.Items`` capture,
+    DbAuthService.cs:54-58): append a marker command to ``op.items`` during
+    execution and it is replayed inside ``invalidating()`` both locally and
+    on other hosts (operation items ride the op log)."""
+    from ..commands.context import current_command_context
+
+    return _enclosing_operation(current_command_context())
 
 
 def _enclosing_operation(context: Optional["CommandContext"]) -> Optional[Operation]:

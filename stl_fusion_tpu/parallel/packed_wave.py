@@ -55,6 +55,7 @@ def build_packed_sharded_wave(mesh: Mesh):
         out_specs=(word_spec, P()),
     )
     def _wave(seeds_l, in_src_l, eepoch_l, nepoch_l, is_real_l, inv_l):
+        inv_in_l = inv_l  # counts report only bits newly lit by THIS call
         live = eepoch_l == nepoch_l[:, None]  # dead/pad slots never match
         frontier_l = seeds_l & ~inv_l
         inv_l = inv_l | frontier_l
@@ -80,9 +81,9 @@ def build_packed_sharded_wave(mesh: Mesh):
 
         _f, inv_l, _go = lax.while_loop(cond, body, (frontier_l, inv_l, go0))
         counts = lax.psum(
-            lax.population_count(jnp.where(is_real_l[:, None], inv_l, 0)).sum(
-                axis=0, dtype=jnp.int32
-            ),
+            lax.population_count(
+                jnp.where(is_real_l[:, None], inv_l & ~inv_in_l, 0)
+            ).sum(axis=0, dtype=jnp.int32),
             GRAPH_AXIS,
         )
         return inv_l, counts
@@ -122,6 +123,16 @@ class PackedShardedGraph:
         # pad rows to the mesh grid; pads are inert (epoch -1 slots)
         self.n_local = max(-(-(n_tot + 1) // n_dev), 1)
         self.n_global = self.n_local * n_dev
+        if 32 * self.n_global >= 2**31:
+            # per-word counts popcount-sum 32 lanes in int32 on device
+            # before the psum (jax x64 off); beyond ~67M global rows one
+            # word's count could silently wrap — same guard as
+            # topo_init_state (ops/topo_wave.py)
+            raise ValueError(
+                f"packed sharded count tracking is int32-limited to "
+                f"<{2**31 // 32} global rows; got {self.n_global} — "
+                f"use ShardedDeviceGraph (one wave per pass) at this scale"
+            )
 
         rows = np.full((self.n_global, k), n_tot, dtype=np.int32)
         rows[: n_tot + 1] = in_src
@@ -157,8 +168,10 @@ class PackedShardedGraph:
 
     def run_waves(self, seeds) -> int:
         """Run ≤``32*words`` packed waves; ``seeds`` is a list of per-wave id
-        lists or a device array from ``prepare_seeds``. Returns total real
-        invalidations (popcount over all lanes, int64-summed)."""
+        lists or a device array from ``prepare_seeds``. Returns the real
+        invalidations NEWLY lit by this call (bits already set in the
+        persistent cumulative mask are not re-counted — same semantics as
+        ``ShardedDeviceGraph.run_wave``; int64-summed over lanes)."""
         if isinstance(seeds, (list, tuple)):
             seeds = self.prepare_seeds(seeds)
         self.invalid, counts = self._wave(

@@ -22,10 +22,21 @@ from .registry import RpcServiceRegistry
 
 log = logging.getLogger("stl_fusion_tpu")
 
-__all__ = ["RpcHub", "RpcClientProxy", "consistent_hash_router"]
+__all__ = ["RpcHub", "RpcClientProxy", "RpcConfigurationError", "consistent_hash_router"]
 
 #: router: (service, method, args) -> peer ref (str) or None for local
 RpcCallRouter = Callable[[str, str, tuple], Optional[str]]
+
+
+class RpcConfigurationError(RuntimeError):
+    """A peer cannot ever connect because the hub is misconfigured (no
+    client connector, unknown peer ref, ...). The default
+    ``unrecoverable_error_detector`` treats exactly this class (plus the
+    ``LookupError`` connectors raise for unknown refs) as terminal — a
+    transient transport failure surfacing as a broad builtin such as
+    ``RuntimeError`` keeps the reconnect loop alive, matching the
+    reference's narrow connection-unrecoverable set
+    (Configuration/RpcDefaultDelegates.cs)."""
 
 
 class RpcHub:
@@ -44,11 +55,14 @@ class RpcHub:
         #: connect errors this returns True for abort the reconnect loop at
         #: once instead of backing off (≈ RpcUnrecoverableErrorDetector,
         #: Configuration/RpcDefaultDelegates.cs; RpcPeer.cs:268-274).
-        #: Default: config/programming errors are terminal, I/O is transient
-        #: (connectors normalize transport failures to ConnectionError/OSError;
-        #: RuntimeError covers "no client connector configured").
+        #: Default: ONLY declared configuration errors are terminal —
+        #: RpcConfigurationError ("no client connector") and the
+        #: LookupError connectors raise for unknown peer refs
+        #: (websocket_multi_connector). Everything else, including
+        #: RuntimeError/ValueError bubbling out of third-party transport
+        #: internals, is treated as transient and retried with backoff.
         self.unrecoverable_error_detector: Callable[[BaseException], bool] = (
-            lambda e: isinstance(e, (LookupError, TypeError, ValueError, RuntimeError))
+            lambda e: isinstance(e, (RpcConfigurationError, LookupError))
             and not isinstance(e, (ConnectionError, OSError, TimeoutError))
         )
         #: $sys-c dispatch hook, installed by the fusion client layer
@@ -81,7 +95,9 @@ class RpcHub:
 
     async def connect_client(self, peer: RpcClientPeer) -> ChannelPair:
         if self.client_connector is None:
-            raise RuntimeError(f"hub {self.name!r} has no client connector configured")
+            raise RpcConfigurationError(
+                f"hub {self.name!r} has no client connector configured"
+            )
         return await self.client_connector(peer)
 
     def client(self, service_name: str, peer_ref: Optional[str] = None) -> "RpcClientProxy":

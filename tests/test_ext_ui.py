@@ -112,6 +112,36 @@ async def test_auth_live_sign_in_out(fresh_hub):
     assert await auth.get_user(session) is None
 
 
+async def test_sign_out_invalidates_user_session_list(fresh_hub):
+    """After sign-out the session must vanish from the user's REACTIVE
+    session list even though the session row no longer mentions the user —
+    the pre-command user_id is operation-captured (ADVICE r1; reference
+    DbAuthService.cs:54-58)."""
+    auth = InMemoryAuthService(fresh_hub)
+    fresh_hub.commander.add_service(auth)
+    session = Session.new()
+    await fresh_hub.commander.call(SignInCommand(session, User("u1", "Alice")))
+    sessions_node = await capture(lambda: auth.get_user_sessions("u1"))
+    assert await auth.get_user_sessions("u1") == (session.id,)
+
+    await fresh_hub.commander.call(SignOutCommand(session))
+    assert sessions_node.is_invalidated
+    assert await auth.get_user_sessions("u1") == ()
+
+
+async def test_sign_in_reassignment_invalidates_old_user_sessions(fresh_hub):
+    auth = InMemoryAuthService(fresh_hub)
+    fresh_hub.commander.add_service(auth)
+    session = Session.new()
+    await fresh_hub.commander.call(SignInCommand(session, User("u1", "Alice")))
+    old_node = await capture(lambda: auth.get_user_sessions("u1"))
+
+    await fresh_hub.commander.call(SignInCommand(session, User("u2", "Bob")))
+    assert old_node.is_invalidated
+    assert await auth.get_user_sessions("u1") == ()
+    assert await auth.get_user_sessions("u2") == (session.id,)
+
+
 # ------------------------------------------------------------------ UI
 
 async def test_live_component_rerenders_on_invalidation(fresh_hub):
@@ -272,6 +302,24 @@ async def test_sandboxed_kv_store_isolates_sessions(fresh_hub):
     await alice.remove("theme")
     assert await alice.get("theme") is None
     assert await bob.get("theme") == "light"
+
+
+async def test_sandboxed_kv_store_rejects_slash_aliasing(fresh_hub):
+    """A crafted session id containing '/' must not alias another session's
+    key space (ADVICE r1): session 'abcdefgh/x' + key 'k' must land in a
+    different namespace than session 'abcdefgh' + key 'x/k'."""
+    from stl_fusion_tpu.ext import SandboxedKeyValueStore
+
+    kv = KeyValueStore(fresh_hub)
+    fresh_hub.commander.add_service(kv)
+    honest = SandboxedKeyValueStore(kv, Session("abcdefgh"))
+    crafted = SandboxedKeyValueStore(kv, Session("abcdefgh/x"))
+
+    await honest.set("x/k", "honest-value")
+    assert await crafted.get("k") is None  # no aliasing
+    await crafted.set("k", "crafted-value")
+    assert await honest.get("x/k") == "honest-value"
+    assert crafted.prefix != "@sandbox/abcdefgh/x/"
 
 
 async def test_sqlite_auth_survives_restart(fresh_hub, tmp_path):

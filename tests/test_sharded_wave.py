@@ -155,7 +155,10 @@ def test_packed_sharded_wave_idempotent_and_incremental():
     dst = np.array([1, 2, 3], dtype=np.int32)
     pg = PackedShardedGraph(src, dst, 4, mesh=graph_mesh())
     assert pg.run_waves([[0]]) == 4
-    assert pg.run_waves([[0]]) == 4  # idempotent: nothing new lights up
+    # idempotent AND newly-lit counting: the second run lights nothing new,
+    # so it reports 0 (cumulative bits are not re-counted — ADVICE r1)
+    assert pg.run_waves([[0]]) == 0
+    assert pg.invalid_mask().sum() == 4  # the cumulative mask is unchanged
     pg.clear_invalid()
     assert pg.run_waves([[1]]) == 2  # 1 and 3 only
     assert not pg.invalid_mask()[0] and not pg.invalid_mask()[2]
