@@ -21,8 +21,11 @@ cascading invalidations/sec on this graph class.)
 Env knobs: FUSION_BENCH_NODES (default 10_000_000), FUSION_BENCH_DEG (3),
 FUSION_BENCH_SEEDS (100_000 per wave), FUSION_BENCH_WAVES (20),
 FUSION_BENCH_WORDS (topo row width in uint32 lanes, default 16 = 512 packed
-waves per sweep), FUSION_BENCH_LATENCY=1 → on-device single-wave latency
-sampling (second long compile), FUSION_BENCH_SHARDED=1 → mesh-sharded dense
+waves per sweep), FUSION_BENCH_LATENCY=0 → DISABLE the (default-on)
+lone-wave latency sampling (it costs two extra compiles at 10M scale; the
+p50/p99 fields then report None rather than a fake distribution),
+FUSION_BENCH_LATENCY_SAMPLES (64), FUSION_BENCH_LAT_LCAP/LAT_CAP (512/4096
+latency-kernel capacities), FUSION_BENCH_SHARDED=1 → mesh-sharded dense
 wave over all devices, +FUSION_BENCH_SHARDED_PACKED=1 → the bit-packed
 32*WORDS-waves-per-pass mesh kernel (parallel/packed_wave.py).
 """
@@ -49,7 +52,7 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
     from jax import lax
 
     from stl_fusion_tpu.graph.synthetic import power_law_dag
-    from stl_fusion_tpu.ops.ell_wave import build_ell, build_ell_wave
+    from stl_fusion_tpu.ops.ell_wave import build_ell
     from stl_fusion_tpu.ops.hybrid_wave import build_hybrid_graph, build_hybrid_wave32
     from stl_fusion_tpu.ops.pull_wave import build_pull_graph, build_pull_wave32, seeds_to_bits
     from stl_fusion_tpu.ops.topo_wave import (
@@ -146,51 +149,114 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
     # keep at least 5% of wall time so the rate stays finite and honest
     elapsed = max(raw_elapsed - sync_overhead, raw_elapsed * 0.05)
 
-    if os.environ.get("FUSION_BENCH_LATENCY", "0") == "1":
-        # single-wave latency on the work-efficient bucketed kernel (the
-        # low-latency path a lone invalidate() takes) — opt-in: it costs a
-        # second long compile at 10M scale. Seeds are shallow nodes (high
-        # ids = few transitive dependents), the shape of a typical edit.
-        # Per-dispatch timing through this environment's relay measures the
-        # tunnel (multiple ~70ms RTTs), so the wave is REPEATED inside one
-        # jit (lax.scan) and elapsed/reps is the on-device wave latency.
+    lat_fields = {}
+    if os.environ.get("FUSION_BENCH_LATENCY", "1") != "0":
+        # lone-wave latency on the work-efficient bucketed kernel (the
+        # low-latency path a lone invalidate() takes) — DEFAULT-ON; the
+        # p50/p99 fields come from a REAL distribution of independently
+        # timed samples, never an amortized clone of one number.
+        # Seeds are shallow nodes (high ids = few transitive dependents),
+        # the shape of a typical edit; churn between waves is an O(1)
+        # epoch bump (advance_epoch), not an O(n) mask fill.
+        #
+        # Measurement: per-dispatch timing through this environment's relay
+        # measures the tunnel (~70-110 ms RTT, and block_until_ready does
+        # not truly block through it), so each SAMPLE is the timing
+        # DIFFERENCE between a long chain (r_long waves in one jit, one
+        # readback) and a short chain (r_short) of fresh seed batches:
+        # lat_i = (t_long_i - t_short_i) / (r_long - r_short). The RTT
+        # constant cancels per sample; jitter is attenuated by 1/128.
+        # the scatter-free small-wave kernel: sorts replace all in-loop
+        # scatters (a 256-lane scatter into a 16M array costs ~31 µs on
+        # v5e and scales with lanes; sorts of ≤64K cost 12-55 µs), so the
+        # per-level floor is gathers+sorts, not scatter lane count
+        from stl_fusion_tpu.ops.ell_wave import advance_epoch, build_ell_lat_wave
+
         ell = build_ell(src, dst, n_nodes, k=4)
-        ell_state, ell_wave = build_ell_wave(ell)
-        lat_seeds = jnp.asarray(
-            (n_nodes - 1 - rng.choice(n_nodes // 100, size=min(256, n_nodes // 100), replace=False)).astype(np.int32)
+        lat_lcap = int(os.environ.get("FUSION_BENCH_LAT_LCAP", 512))
+        lat_cap = int(os.environ.get("FUSION_BENCH_LAT_CAP", 4096))
+        ell_state, ell_wave = build_ell_lat_wave(
+            ell, lcap=lat_lcap, cap=lat_cap, assume_static_epochs=True
         )
         ell_garrays = ell_wave.garrays
-        reps = int(os.environ.get("FUSION_BENCH_LATENCY_REPS", 64))
+        n_samples = int(os.environ.get("FUSION_BENCH_LATENCY_SAMPLES", 64))
+        r_short, r_long = 8, 136
+        seed_pool = n_nodes // 100
+        n_seed = min(256, seed_pool)
+
+        def seed_mat(reps):
+            return jnp.asarray(
+                np.stack(
+                    [
+                        (
+                            n_nodes
+                            - 1
+                            - rng.choice(seed_pool, size=n_seed, replace=False)
+                        ).astype(np.int32)
+                        for _ in range(reps)
+                    ]
+                )
+            )
 
         @jax.jit
-        def lat_chain(garrays, seeds, state):
-            def body(st, _):
-                st = st._replace(invalid=jnp.zeros_like(st.invalid))
-                st, c = ell_wave.step(garrays, seeds, st)
-                return st, c
+        def lat_chain(garrays, seed_rows, state):
+            def body(st, seeds):
+                st = advance_epoch(st)  # churn model, O(1)
+                st, c, over = ell_wave.step(garrays, seeds, st)
+                return st, jnp.where(over, -(10**9), c)  # overflow poisons counts
 
-            return lax.scan(body, state, None, length=reps)
+            return lax.scan(body, state, seed_rows)
 
-        _st, cs = lat_chain(ell_garrays, lat_seeds, ell_state)  # compile
-        int(cs[0])
-        lat = []
-        for _ in range(3):
+        # pre-build + upload all seed batches outside the timed region
+        shorts = [seed_mat(r_short) for _ in range(n_samples)]
+        longs = [seed_mat(r_long) for _ in range(n_samples)]
+        # the poison check reads the MIN over every wave of a chain — a
+        # single overflowed wave anywhere would silently shrink a sample
+        _st, cs = lat_chain(ell_garrays, shorts[0], ell_state)  # compile short
+        assert int(np.asarray(cs).min()) >= 0, "lat kernel overflow — caps too small"
+        _st, cs = lat_chain(ell_garrays, longs[0], ell_state)  # compile long
+        assert int(np.asarray(cs).min()) >= 0, "lat kernel overflow — caps too small"
+        samples_ms = []
+        min_count = 1
+        for i in range(n_samples):
             t0 = time.perf_counter()
-            _st, cs = lat_chain(ell_garrays, lat_seeds, ell_state)
-            int(cs[0])
-            lat.append(max((time.perf_counter() - t0 - sync_overhead) / reps, 1e-9))
+            _st, cs = lat_chain(ell_garrays, shorts[i], ell_state)
+            min_count = min(min_count, int(np.asarray(cs).min()))  # sync readback
+            t_short = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            _st, cs = lat_chain(ell_garrays, longs[i], ell_state)
+            min_count = min(min_count, int(np.asarray(cs).min()))
+            t_long = time.perf_counter() - t0
+            samples_ms.append((t_long - t_short) / (r_long - r_short) * 1e3)
+        assert min_count >= 0, "lat kernel overflow during sampling — results invalid"
+        arr = np.asarray(samples_ms)
+        lat_fields = {
+            "wave_ms_p50": float(np.percentile(arr, 50)),
+            "wave_ms_p99": float(np.percentile(arr, 99)),
+            "wave_ms_samples": n_samples,
+            "wave_ms_method": (
+                f"chain-difference: per sample, (t[{r_long} waves] - "
+                f"t[{r_short} waves]) / {r_long - r_short}, fresh shallow "
+                f"seed batches per wave, one readback per chain"
+            ),
+            "wave_ms_min": float(arr.min()),
+            "wave_ms_max": float(arr.max()),
+        }
     else:
-        # amortized per-wave time from the timed run (a batch carries
-        # waves_per_batch packed waves)
-        lat = [elapsed / max(n_batches, 1) / waves_per_batch] * 3
+        # latency sampling disabled: report ONLY the honest amortized
+        # number, never a fake distribution
+        lat_fields = {
+            "wave_ms_p50": None,
+            "wave_ms_p99": None,
+            "wave_ms_amortized": elapsed / max(n_batches, 1) / waves_per_batch * 1e3,
+        }
 
     return {
         "total_invalidated": total,
         "elapsed_s": max(elapsed, 1e-9),
         "waves": n_waves,
         "kernel": kernel,
-        "wave_ms_p50": float(np.percentile(np.asarray(lat) * 1e3, 50)),
-        "wave_ms_p99": float(np.percentile(np.asarray(lat) * 1e3, 99)),
+        **lat_fields,
         "edges": int(len(src)),
         "virtual_nodes": graph.n_tot - graph.n_real,
         "levels": len(graph.level_starts) - 1 if kernel == "topo" else None,

@@ -59,6 +59,7 @@ class Computed(Generic[T]):
         "_invalidate_on_set_output",
         "_delayed_invalidation_pending",
         "_lock",
+        "_backend_nid",
         "__weakref__",
     )
 
@@ -74,19 +75,35 @@ class Computed(Generic[T]):
         self._invalidate_on_set_output = False
         self._delayed_invalidation_pending = False
         self._lock = threading.Lock()
+        self._backend_nid: Optional[int] = None  # device-mirror node id
 
     # ------------------------------------------------------------------ state
+    def _pending_probe(self) -> bool:
+        """True iff a device wave invalidated this node but the host hasn't
+        materialized it yet (graph/backend.py lazy tier). Near-free when no
+        device mirror is attached (``_backend_nid is None``)."""
+        nid = self._backend_nid
+        if nid is None:
+            return False
+        backend = self.input.function.hub._graph_backend
+        return backend is not None and bool(backend._pending[nid])
+
     @property
     def consistency_state(self) -> ConsistencyState:
+        if self._state == ConsistencyState.CONSISTENT and self._pending_probe():
+            return ConsistencyState.INVALIDATED
         return ConsistencyState(self._state)
 
     @property
     def is_consistent(self) -> bool:
-        return self._state == ConsistencyState.CONSISTENT
+        return self._state == ConsistencyState.CONSISTENT and not self._pending_probe()
 
     @property
     def is_invalidated(self) -> bool:
-        return self._state == ConsistencyState.INVALIDATED
+        s = self._state
+        return s == ConsistencyState.INVALIDATED or (
+            s == ConsistencyState.CONSISTENT and self._pending_probe()
+        )
 
     @property
     def output(self) -> Result:
@@ -149,6 +166,11 @@ class Computed(Generic[T]):
         """
         if self._state == ConsistencyState.INVALIDATED:
             return False
+        if self._state == ConsistencyState.CONSISTENT and self._pending_probe():
+            # a device wave already computed this node's transitive closure
+            # (version-matched dependents included) — materialize locally,
+            # no host cascade needed
+            return self.invalidate_local()
         delay = self.options.invalidation_delay
         if not immediately and delay > 0:
             with self._lock:
@@ -230,6 +252,10 @@ class Computed(Generic[T]):
 
     def on_invalidated(self, handler: Callable[["Computed"], None]) -> None:
         """Attach an invalidation handler; fires immediately if already invalid."""
+        if self._state == ConsistencyState.CONSISTENT and self._pending_probe():
+            # materialize the pending device invalidation so the handler
+            # observes (and fires on) the real state
+            self.invalidate_local()
         fire_now = False
         with self._lock:
             if self._state == ConsistencyState.INVALIDATED:
@@ -238,6 +264,12 @@ class Computed(Generic[T]):
                 if self._invalidated_handlers is None:
                     self._invalidated_handlers = []
                 self._invalidated_handlers.append(handler)
+        if not fire_now and self._backend_nid is not None:
+            # device waves must apply this node eagerly now that someone
+            # is observing it (graph/backend.py two-tier application)
+            backend = self._hub().graph_backend
+            if backend is not None:
+                backend.mark_watched(self)
         if fire_now:
             try:
                 handler(self)

@@ -113,6 +113,10 @@ class FunctionBase:
                 existing.renew_timeouts(False)
             return existing
         if existing is None or not existing.is_consistent:
+            # note: is_consistent is pending-aware — a device-wave-invalidated
+            # node reads as inconsistent here without host materialization;
+            # the recompute's register() displacement finishes the cleanup
+            # (graph/backend.py two-tier application)
             return None
         self._use_existing(existing, context, used_by)
         return existing

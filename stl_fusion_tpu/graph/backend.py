@@ -13,13 +13,32 @@ with a monotonically growing pending list and flushed under a single lock
 before any wave runs, so a wave never observes half an edge batch. Epoch
 bumps happen at node *registration* (compute start), matching the host rule
 that edges captured during a compute belong to the new version.
+
+Applying a device wave back to host (r2 redesign, VERDICT.md weak #2): the
+device returns the newly-invalidated ids COMPACTED (O(wave) readback, not
+two O(graph) mask snapshots), and the host materializes invalidation in two
+tiers:
+
+- **watched nodes** (anything with an invalidation handler — states, RPC
+  push subscriptions, ``when_invalidated`` waiters) are invalidated EAGERLY
+  so observers fire promptly;
+- **unwatched nodes** get a bit in a host-side ``pending`` mask; the read
+  path (FunctionBase via ``hub.graph_read_filter``) materializes the
+  invalidation lazily on next access. An unread cached value burns zero
+  host time per wave — the host cost of a wave is O(watched ∩ wave), not
+  O(wave).
+
+A recompute (epoch bump) clears the node's pending bit: the wave targeted
+the previous version, and on device the new epoch's edges never matched —
+the same version-match rule the reference applies per-edge
+(Computed.cs:213-215).
 """
 from __future__ import annotations
 
 import logging
 import threading
 import weakref
-from typing import TYPE_CHECKING, Dict, List, Optional, Tuple
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -46,6 +65,16 @@ class TpuGraphBackend:
         # ("invalid", nid). Order preserves causality — an invalidation mark
         # buffered before a node's recompute-bump must not survive it.
         self._journal: List[Tuple[str, object]] = []
+        # host-side wave-application state (see module docstring):
+        # pending = device-invalidated, not yet materialized on host;
+        # watched = has invalidation observers → apply eagerly
+        self._pending = np.zeros(self.graph.n_cap + 1, dtype=bool)
+        self._watched = np.zeros(self.graph.n_cap + 1, dtype=bool)
+        # nids whose invalidation is CURRENTLY being applied from a device
+        # wave — only those skip the journal echo; a handler that host-led
+        # invalidates some OTHER node during application must still journal
+        # (a global flag here would silently desync the device mask)
+        self._applying_ids: set = set()
         self.waves_run = 0
         self.device_invalidations = 0
         hub.registry.on_register.append(self._on_register)
@@ -58,13 +87,30 @@ class TpuGraphBackend:
         input = computed.input
         with self._lock:
             nid = self._id_by_input.get(input)
+            old = None
             if nid is None:
                 nid = int(self.graph.add_nodes(1)[0])
                 self._id_by_input[input] = nid
+                self._ensure_host_masks()
             else:
-                # recompute: next epoch; stale in-edges die, invalid clears
+                # recompute: next epoch; stale in-edges die, invalid clears.
+                # A pending device invalidation of the PREVIOUS version must
+                # be materialized on ITS Computed before the bit clears —
+                # otherwise the displaced node would read as consistent
+                # again (zombie) once the bit is gone.
                 self._journal.append(("bump", nid))
+                if self._pending[nid]:
+                    self._pending[nid] = False
+                    old_ref = self._computed_by_id.get(nid)
+                    old = old_ref() if old_ref is not None else None
             self._computed_by_id[nid] = weakref.ref(computed)
+            computed._backend_nid = nid
+        if old is not None:
+            self._applying_ids.add(nid)
+            try:
+                old.invalidate_local()
+            finally:
+                self._applying_ids.discard(nid)
 
     def _on_edge_added(self, dependent: "Computed", used: "Computed") -> None:
         with self._lock:
@@ -75,10 +121,31 @@ class TpuGraphBackend:
             self._journal.append(("edge", (uid, did)))
 
     def _on_invalidated(self, computed: "Computed") -> None:
+        nid = getattr(computed, "_backend_nid", None)
+        if nid is not None and nid in self._applying_ids:
+            return  # the device already knows — this IS a wave application
         with self._lock:
             nid = self._id_by_input.get(computed.input)
             if nid is not None:
                 self._journal.append(("invalid", nid))
+                self._pending[nid] = False  # host led; nothing left to materialize
+
+    def mark_watched(self, computed: "Computed") -> None:
+        """An invalidation observer attached: device waves must apply this
+        node EAGERLY (hub routes ``Computed.on_invalidated`` here)."""
+        nid = getattr(computed, "_backend_nid", None)
+        if nid is not None:
+            self._watched[nid] = True
+
+    def _ensure_host_masks(self) -> None:
+        need = self.graph.n_cap + 1
+        if len(self._pending) < need:
+            for name in ("_pending", "_watched"):
+                old = getattr(self, name)
+                arr = np.zeros(need, dtype=bool)
+                arr[: len(old)] = old
+                setattr(self, name, arr)
+
 
     # ------------------------------------------------------------------ flush
     def flush(self) -> None:
@@ -109,26 +176,66 @@ class TpuGraphBackend:
             i = j
 
     # ------------------------------------------------------------------ offload
-    def invalidate_cascade(self, computed: "Computed") -> int:
+    def invalidate_cascade(self, computed: "Computed", collect_cap: int = 8192) -> int:
         """Run the invalidation wave for ``computed`` ON DEVICE, then apply
-        the closure to host nodes. Returns nodes invalidated."""
+        the closure to host state. Returns nodes the device invalidated.
+
+        The device compacts the newly-invalid ids (O(wave) readback);
+        host application is two-tier — eager for watched nodes, a pending
+        bit for the rest (materialized on next read). See module docstring."""
         self.flush()
         nid = self._id_by_input.get(computed.input)
         if nid is None:
             computed.invalidate(immediately=True)
             return 1
-        before = self.graph.invalid_mask().copy()
-        self.graph.run_wave([nid])
-        after = self.graph.invalid_mask()
-        newly = np.nonzero(after & ~before)[0]
-        applied = 0
-        for node_id in newly:
-            c = self.computed_for(node_id)
-            if c is not None and c.invalidate_local():
-                applied += 1
+        count, newly_ids = self.graph.run_wave_collect([nid], cap=collect_cap)
+        self._apply_newly(newly_ids)
         self.waves_run += 1
-        self.device_invalidations += len(newly)
-        return applied
+        self.device_invalidations += count
+        return count
+
+    def invalidate_cascade_batch(self, computeds: Sequence["Computed"]) -> int:
+        """Cascade MANY seed invalidations in one device dispatch + one
+        readback (the burst shape: a batch of commands completing together).
+        Each seed's wave runs over the state the previous left — exactly the
+        sequential semantics, minus W-1 relay round trips. Returns the total
+        newly-invalidated count."""
+        self.flush()
+        seeds: List[List[int]] = []
+        fallback = 0
+        for c in computeds:
+            nid = self._id_by_input.get(c.input)
+            if nid is None:
+                c.invalidate(immediately=True)
+                fallback += 1
+            else:
+                seeds.append([nid])
+        if not seeds:
+            return fallback
+        counts, newly_ids = self.graph.run_waves_chained(seeds)
+        self._apply_newly(newly_ids)
+        self.waves_run += len(seeds)
+        total = int(counts.sum())
+        self.device_invalidations += total
+        return total + fallback
+
+    def _apply_newly(self, newly_ids: np.ndarray) -> None:
+        if len(newly_ids) == 0:
+            return
+        watched = newly_ids[self._watched[newly_ids]]
+        self._pending[newly_ids] = True
+        for node_id in watched:
+            node_id = int(node_id)
+            self._pending[node_id] = False
+            self._watched[node_id] = False
+            c = self.computed_for(node_id)
+            if c is None:
+                continue
+            self._applying_ids.add(node_id)
+            try:
+                c.invalidate_local()
+            finally:
+                self._applying_ids.discard(node_id)
 
     # ------------------------------------------------------------------ export
     def to_sharded(self, mesh=None, exchange: str = "packed"):

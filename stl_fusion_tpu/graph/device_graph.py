@@ -19,7 +19,14 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..ops.wave import GraphArrays, run_wave, run_wave_with_stats, seeds_to_frontier
+from ..ops.wave import (
+    GraphArrays,
+    run_wave,
+    run_wave_collect,
+    run_wave_with_stats,
+    run_waves_chained,
+    seeds_to_frontier,
+)
 
 __all__ = ["DeviceGraph"]
 
@@ -165,6 +172,60 @@ class DeviceGraph:
         self._g, count = run_wave(seeds, g)
         self._sync_invalid_back()
         return int(count)
+
+    def run_wave_collect(
+        self, seed_ids: Sequence[int], cap: int = 8192
+    ) -> Tuple[int, np.ndarray]:
+        """Cascade from ``seed_ids`` and return (count, newly-invalidated
+        node ids) with an O(wave) readback: ids are compacted ON DEVICE into
+        a ``cap``-sized buffer; only on overflow (count > cap, rare wide
+        waves) does this fall back to one full-mask readback. The host
+        ``_h_invalid`` copy is patched from the ids — never re-fetched."""
+        import jax
+
+        jnp = self._jnp
+        g = self.device_arrays()
+        seeds = seeds_to_frontier(
+            self.n_cap, jnp.asarray(np.asarray(seed_ids, dtype=np.int32))
+        )
+        self._g, count, ids, overflow = run_wave_collect(seeds, g, cap)
+        # ONE batched transfer — three sequential readbacks would pay the
+        # relay RTT three times on the lone-wave path
+        count, ids, overflow = jax.device_get((count, ids, overflow))
+        count = int(count)
+        if bool(overflow):
+            newly = np.asarray(self._g.invalid) & ~self._h_invalid
+            newly_ids = np.nonzero(newly)[0].astype(np.int32)
+            self._h_invalid |= newly
+        else:
+            newly_ids = ids[:count] if count else np.empty(0, np.int32)
+            self._h_invalid[newly_ids] = True
+        return count, newly_ids
+
+    def run_waves_chained(self, seed_id_lists: Sequence[Sequence[int]]):
+        """Chain many seed waves in ONE dispatch (the live burst path).
+        Returns (per-wave counts int64[W], union newly ids). W and the seed
+        width are padded to powers of two (a -1 row is a no-op wave, count
+        0) so bursts of varying size reuse one compiled program instead of
+        retracing the full-graph scan per shape; counts + the union mask
+        come back in one batched transfer."""
+        import jax
+
+        jnp = self._jnp
+        g = self.device_arrays()
+        n_real_waves = len(seed_id_lists)
+        width = _round_up_pow2(max((len(s) for s in seed_id_lists), default=1))
+        n_rows = _round_up_pow2(max(n_real_waves, 1))
+        mat = np.full((n_rows, width), -1, dtype=np.int32)
+        for i, s in enumerate(seed_id_lists):
+            mat[i, : len(s)] = np.asarray(s, dtype=np.int32)
+        self._g, counts, newly = run_waves_chained(jnp.asarray(mat), g)
+        counts, newly = jax.device_get((counts, newly))
+        self._h_invalid |= newly
+        return (
+            counts[:n_real_waves].astype(np.int64),
+            np.nonzero(newly)[0].astype(np.int32),
+        )
 
     def run_wave_frontier(self, seed_frontier, sync_host: bool = False) -> int:
         """Wave from a prebuilt boolean frontier (bench hot path — host copy

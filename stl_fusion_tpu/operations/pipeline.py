@@ -161,8 +161,11 @@ def attach_operations(commander: "Commander") -> OperationsHost:
         operation = Operation(command=command, agent_id=host.agent.id)
         context.items.set(operation, key=Operation)
         result = await context.invoke_remaining_handlers()
-        # success ⇒ commit + notify (errors propagate, no completion)
-        operation.commit_time = time.time()
+        # success ⇒ commit + notify (errors propagate, no completion);
+        # a DB operation scope (oplog/scope.py) stamps commit_time at its
+        # actual transaction commit — don't overwrite it
+        if operation.commit_time is None:
+            operation.commit_time = time.time()
         for listener in list(host.commit_listeners):
             await listener(operation)
         await host.notify_completed(operation, is_local=True)

@@ -2,6 +2,12 @@
 from .entity_resolver import EntityResolver
 from .log import InMemoryOperationLog, OperationLog, OperationRecord, SqliteOperationLog
 from .trimmer import OperationLogTrimmer
+from .scope import (
+    ScopedSqliteDb,
+    SqliteOperationScope,
+    attach_db_operation_scope,
+    current_operation_scope,
+)
 from .reader import (
     FileChangeNotifier,
     LocalChangeNotifier,
@@ -20,4 +26,8 @@ __all__ = [
     "OperationLogReader",
     "OperationLogTrimmer",
     "attach_operation_log",
+    "ScopedSqliteDb",
+    "SqliteOperationScope",
+    "attach_db_operation_scope",
+    "current_operation_scope",
 ]

@@ -23,7 +23,49 @@ from typing import Any, List, Optional
 
 from ..utils.serialization import decode, encode
 
-__all__ = ["OperationRecord", "OperationLog", "SqliteOperationLog", "InMemoryOperationLog"]
+__all__ = [
+    "OperationRecord",
+    "OperationLog",
+    "SqliteOperationLog",
+    "InMemoryOperationLog",
+    "ensure_operations_schema",
+    "insert_operation_row",
+]
+
+
+def ensure_operations_schema(conn: sqlite3.Connection) -> None:
+    """Create the operations table (shared between SqliteOperationLog and
+    the atomic SqliteOperationScope, which writes the row inside the SAME
+    transaction as the command's DAL writes — oplog/scope.py)."""
+    conn.execute(
+        """CREATE TABLE IF NOT EXISTS operations (
+            idx INTEGER PRIMARY KEY AUTOINCREMENT,
+            id TEXT UNIQUE,
+            agent_id TEXT,
+            commit_time REAL,
+            command_json TEXT,
+            items_json TEXT
+        )"""
+    )
+    conn.execute(
+        "CREATE INDEX IF NOT EXISTS ix_operations_commit ON operations(commit_time)"
+    )
+
+
+def insert_operation_row(conn: sqlite3.Connection, record: "OperationRecord"):
+    """INSERT the record (id-deduped) WITHOUT committing — the caller owns
+    the transaction."""
+    return conn.execute(
+        "INSERT OR IGNORE INTO operations (id, agent_id, commit_time, command_json, items_json)"
+        " VALUES (?, ?, ?, ?, ?)",
+        (
+            record.id,
+            record.agent_id,
+            record.commit_time,
+            json.dumps(encode(record.command)),
+            json.dumps(encode(list(record.items))),
+        ),
+    )
 
 
 @dataclass(frozen=True)
@@ -94,35 +136,13 @@ class SqliteOperationLog(OperationLog):
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
-        self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._conn.execute(
-            """CREATE TABLE IF NOT EXISTS operations (
-                idx INTEGER PRIMARY KEY AUTOINCREMENT,
-                id TEXT UNIQUE,
-                agent_id TEXT,
-                commit_time REAL,
-                command_json TEXT,
-                items_json TEXT
-            )"""
-        )
-        self._conn.execute(
-            "CREATE INDEX IF NOT EXISTS ix_operations_commit ON operations(commit_time)"
-        )
+        self._conn = sqlite3.connect(path, check_same_thread=False, timeout=30.0)
+        ensure_operations_schema(self._conn)
         self._conn.commit()
 
     def append(self, record: OperationRecord) -> OperationRecord:
         with self._lock:
-            cur = self._conn.execute(
-                "INSERT OR IGNORE INTO operations (id, agent_id, commit_time, command_json, items_json)"
-                " VALUES (?, ?, ?, ?, ?)",
-                (
-                    record.id,
-                    record.agent_id,
-                    record.commit_time,
-                    json.dumps(encode(record.command)),
-                    json.dumps(encode(list(record.items))),
-                ),
-            )
+            cur = insert_operation_row(self._conn, record)
             self._conn.commit()
             idx = cur.lastrowid or 0
             return OperationRecord(

@@ -37,7 +37,15 @@ from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["EllGraph", "build_ell", "build_ell_wave"]
+__all__ = [
+    "EllGraph",
+    "EllWaveState",
+    "advance_epoch",
+    "build_ell",
+    "build_ell_lat_wave",
+    "build_ell_wave",
+    "invalid_mask",
+]
 
 
 class EllGraph(NamedTuple):
@@ -134,8 +142,30 @@ def build_ell(
 
 
 class EllWaveState(NamedTuple):
+    """Persistent wave state. ``invalid`` is epoch-stamped rather than a
+    bool mask: node x is invalid iff ``inv_stamp[x] == epoch``. Marking the
+    whole graph consistent again (the churn model between waves, or a bulk
+    recompute) is then ``epoch + 1`` — O(1) instead of an O(n) device fill,
+    which WAS the 10M lone-wave latency floor (PERF.md r1). ``frontier`` is
+    the persistent scratch frontier buffer: levels only ever read slots
+    below the live count (masked in-kernel), so it is never cleared — the
+    other O(f_max) per-wave fill the r1 kernel paid."""
+
     node_epoch: "object"  # int32[n_tot+1]
-    invalid: "object"  # bool[n_tot+1]
+    inv_stamp: "object"  # int32[n_tot+1] — last epoch each node was invalidated in
+    epoch: "object"  # int32 scalar — current consistency epoch (≥ 1)
+    frontier: "object"  # int32[f_max] scratch; slots ≥ live count are stale
+
+
+def advance_epoch(state: EllWaveState) -> EllWaveState:
+    """All nodes consistent again (bulk 'recompute') in O(1): stale stamps
+    from earlier epochs can never equal the new epoch."""
+    return state._replace(epoch=state.epoch + 1)
+
+
+def invalid_mask(state: EllWaveState) -> np.ndarray:
+    """bool[n_tot+1] — the materialized invalid set (readback helper)."""
+    return np.asarray(state.inv_stamp) == int(state.epoch)
 
 
 class EllGraphArrays(NamedTuple):
@@ -187,8 +217,14 @@ def build_ell_wave(
 
     def init_state() -> EllWaveState:
         node_epoch = jnp.zeros(n_tot + 1, dtype=jnp.int32).at[n_tot].set(-2)
-        invalid = jnp.zeros(n_tot + 1, dtype=jnp.bool_)
-        return EllWaveState(node_epoch, invalid)
+        inv_stamp = jnp.zeros(n_tot + 1, dtype=jnp.int32)
+        # epoch starts at 1 so the zero-initialized stamps mean "consistent"
+        return EllWaveState(
+            node_epoch,
+            inv_stamp,
+            jnp.asarray(1, dtype=jnp.int32),
+            jnp.full(f_max, n_tot, dtype=jnp.int32),  # the ONLY f_max fill, ever
+        )
 
     def _sort_dedup(mask, ids):
         """(winners, isnew): sort ``ids`` (masked-out → null), keep the
@@ -200,8 +236,10 @@ def build_ell_wave(
         )
         return skeys, isnew
 
-    def _level(bsize: int, F, invalid, node_epoch, ell_dst, ell_epoch, is_real):
-        """Expand F[:bsize] one level; returns (F_next, nF_next, invalid, newly_real).
+    NEVER = jnp.asarray(np.int32(-(2**31)), dtype=jnp.int32)  # stamp scatter filler
+
+    def _level(bsize: int, F, nF, inv_stamp, epoch, node_epoch, ell_dst, ell_epoch, is_real):
+        """Expand F[:bsize] one level; returns (F_next, nF_next, inv_stamp, newly_real).
 
         Dedup strategy is picked per bucket at build time:
         - small buckets SORT the fired dsts (O(m log² m), m = bsize*k) — no
@@ -209,19 +247,21 @@ def build_ell_wave(
           an O(n_tot) zero-fill per level;
         - wide buckets use the claim scatter (O(n_tot)) where the sort
           would cost more than the fill.
-        F is updated IN PLACE: stale entries beyond nF_next are ids from
-        earlier frontiers, whose eligible dsts are already invalid, so
-        re-expanding them can never re-fire (fire tests ~invalid[dst]).
+        F persists across levels AND waves: slots ≥ nF hold stale ids from
+        earlier frontiers, and the slot mask below keeps them from firing —
+        so F never needs an O(f_max) re-fill, whatever happens to the
+        invalid set between waves (epoch bumps included).
         """
         Fb = lax.slice(F, (0,), (bsize,))
+        slot_live = jnp.arange(bsize, dtype=jnp.int32) < nF
         rows = ell_dst[Fb]  # (bsize, k) row gather; pad rows → n_tot
         eps = ell_epoch[Fb]
         cur = node_epoch[rows]
-        inv = invalid[rows]
-        fire = (cur == eps) & ~inv & (rows < n_tot)
+        inv = inv_stamp[rows] == epoch
+        fire = slot_live[:, None] & (cur == eps) & ~inv & (rows < n_tot)
         flat_dst = rows.reshape(-1)
         flat_fire = fire.reshape(-1)
-        invalid = invalid.at[flat_dst].max(flat_fire)
+        inv_stamp = inv_stamp.at[flat_dst].max(jnp.where(flat_fire, epoch, NEVER))
         m = bsize * k
         if m * max(int(np.log2(m)), 1) < n_tot:
             winners, isnew = _sort_dedup(flat_fire, flat_dst)
@@ -240,37 +280,35 @@ def build_ell_wave(
         scatter_pos = jnp.where(isnew, pos, f_max + 1)  # OOB → dropped
         F_next = F.at[scatter_pos].set(winners, mode="drop")
         newly_real = (isnew & is_real[winners]).sum(dtype=jnp.int32)
-        return F_next, nF_next, invalid, newly_real
+        return F_next, nF_next, inv_stamp, newly_real
 
     branches = [
         functools.partial(_level, b) for b in buckets
     ]
 
-    def level_switch(F, nF, invalid, node_epoch, ell_dst, ell_epoch, is_real):
+    def level_switch(F, nF, inv_stamp, epoch, node_epoch, ell_dst, ell_epoch, is_real):
         # smallest bucket that fits nF
         bidx = jnp.searchsorted(jnp.asarray(buckets, dtype=jnp.int32), nF, side="left")
         bidx = jnp.minimum(bidx, len(buckets) - 1)
-        return lax.switch(bidx, branches, F, invalid, node_epoch, ell_dst, ell_epoch, is_real)
+        return lax.switch(
+            bidx, branches, F, nF, inv_stamp, epoch, node_epoch, ell_dst, ell_epoch, is_real
+        )
 
     @jax.jit
     def step(g: EllGraphArrays, seed_ids: "jax.Array", state: EllWaveState):
         ell_dst, ell_epoch, is_real = g
-        node_epoch, invalid = state.node_epoch, state.invalid
+        node_epoch, inv_stamp, epoch, F = state
         # seed frontier: pad -1 → n_tot slot; only fresh (not-invalid)
         # seeds, deduped by sorting the (small) seed vector — a claim
         # scatter here would cost an O(n_tot) zero-fill per wave, the
         # dominant term of a shallow lone wave's latency at 10M nodes
         safe = jnp.where(seed_ids >= 0, seed_ids, n_tot).astype(jnp.int32)
-        candidate = (safe < n_tot) & ~invalid[safe]
+        candidate = (safe < n_tot) & (inv_stamp[safe] != epoch)
         skeys, fresh = _sort_dedup(candidate, safe)
-        invalid = invalid.at[skeys].max(fresh)
+        inv_stamp = inv_stamp.at[skeys].max(jnp.where(fresh, epoch, NEVER))
         count0 = (fresh & is_real[skeys]).sum(dtype=jnp.int32)
         pos = jnp.cumsum(fresh.astype(jnp.int32)) - 1
-        F0 = (
-            jnp.full(f_max, n_tot, dtype=jnp.int32)
-            .at[jnp.where(fresh, pos, f_max + 1)]
-            .set(skeys, mode="drop")
-        )
+        F0 = F.at[jnp.where(fresh, pos, f_max + 1)].set(skeys, mode="drop")
         nF0 = fresh.sum(dtype=jnp.int32)
 
         def cond(carry):
@@ -278,14 +316,14 @@ def build_ell_wave(
             return nF > 0
 
         def body(carry):
-            F, nF, invalid, cnt = carry
-            F2, nF2, invalid, newly = level_switch(
-                F, nF, invalid, node_epoch, ell_dst, ell_epoch, is_real
+            F, nF, inv_stamp, cnt = carry
+            F2, nF2, inv_stamp, newly = level_switch(
+                F, nF, inv_stamp, epoch, node_epoch, ell_dst, ell_epoch, is_real
             )
-            return F2, nF2, invalid, cnt + newly
+            return F2, nF2, inv_stamp, cnt + newly
 
-        _F, _nF, invalid, count = lax.while_loop(cond, body, (F0, nF0, invalid, count0))
-        return EllWaveState(node_epoch, invalid), count
+        F, _nF, inv_stamp, count = lax.while_loop(cond, body, (F0, nF0, inv_stamp, count0))
+        return EllWaveState(node_epoch, inv_stamp, epoch, F), count
 
     def wave(seed_ids, state):
         return step(garrays, seed_ids, state)
@@ -293,3 +331,150 @@ def build_ell_wave(
     wave.garrays = garrays
     wave.step = step
     return init_state(), wave
+
+
+def build_ell_lat_wave(
+    graph: EllGraph,
+    lcap: int = 1024,
+    cap: int = 16384,
+    assume_static_epochs: bool = False,
+):
+    """The LONE-WAVE latency kernel: a shallow edit's cascade in O(wave)
+    device work with NO scatters inside the level loop.
+
+    Measured on v5e (op_probe, r2): a scatter of even 256 lanes into a
+    16M-element array costs ~31 µs and grows with lane count (~276 µs at
+    4096), while sorts of ≤64K elements cost 12-55 µs and small gathers
+    ~21 µs — so the general kernel's per-level scatter pair (stamp mark +
+    frontier compaction) IS the 10M lone-wave latency floor (~1.2 ms per
+    level). This kernel therefore:
+
+    - keeps the level frontier COMPACT (int32[lcap] ids, not a mask);
+    - dedups and tests membership by TAGGED MERGE-SORT against the sorted
+      accumulated-wave id list (int32[cap]) — a sort replaces both the
+      stamp scatter and the claim scatter;
+    - compacts the next frontier by sorting candidate ids (new ids first,
+      pads last) and slicing — a sort replaces the position scatter;
+    - commits ``inv_stamp`` ONCE at wave end (a single scatter).
+
+    Capacity overflow (wave wider than ``lcap`` per level or ``cap`` total)
+    aborts WITHOUT touching state and reports ``overflow=True``; the caller
+    re-runs the wave on the general bucketed kernel. Shares ``EllWaveState``
+    with ``build_ell_wave`` (the persistent ``frontier`` scratch is unused
+    here).
+
+    ``assume_static_epochs=True`` additionally elides the per-level epoch
+    gathers — valid ONLY for graphs whose topology never mutates after
+    build (all captured edge epochs stay equal to their node epochs, e.g.
+    the synthetic bench graphs); the builder verifies the precondition.
+
+    Returns (initial_state, lat_wave) with
+    ``lat_wave(seed_ids, state) -> (state, count, overflow)``; the raw
+    jitted kernel is ``lat_wave.step``, device adjacency ``lat_wave.garrays``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_tot, k = graph.n_tot, graph.k
+    if 2 * (n_tot + 1) >= 2**31:
+        raise ValueError("tagged-sort keys need 2*(n_tot+1) < 2^31")
+    if assume_static_epochs:
+        live_slots = graph.ell_dst != n_tot
+        if not (graph.ell_epoch[live_slots] == 0).all():
+            raise ValueError(
+                "assume_static_epochs requires all captured edge epochs == 0"
+            )
+
+    garrays = EllGraphArrays(
+        ell_dst=jnp.asarray(graph.ell_dst),
+        ell_epoch=jnp.asarray(graph.ell_epoch),
+        is_real=jnp.asarray(graph.is_real),
+    )
+    NEVER = jnp.asarray(np.int32(-(2**31)), dtype=jnp.int32)
+
+    def init_state() -> EllWaveState:
+        node_epoch = jnp.zeros(n_tot + 1, dtype=jnp.int32).at[n_tot].set(-2)
+        return EllWaveState(
+            node_epoch,
+            jnp.zeros(n_tot + 1, dtype=jnp.int32),
+            jnp.asarray(1, dtype=jnp.int32),
+            jnp.zeros(0, dtype=jnp.int32),  # frontier scratch unused
+        )
+
+    def _dedup_first(sorted_ids):
+        prev = jnp.concatenate([jnp.full(1, -1, jnp.int32), sorted_ids[:-1]])
+        return sorted_ids != prev
+
+    @jax.jit
+    def step(g: EllGraphArrays, seed_ids: "jax.Array", state: EllWaveState):
+        ell_dst, ell_epoch, is_real = g
+        node_epoch, inv_stamp, epoch, scratch = state
+
+        # ---- seed stage: dedup by sort, no graph-sized work
+        safe = jnp.where(seed_ids >= 0, seed_ids, n_tot).astype(jnp.int32)
+        ok = (safe < n_tot) & (inv_stamp[safe] != epoch)
+        skeys = jnp.sort(jnp.where(ok, safe, n_tot))
+        fresh = _dedup_first(skeys) & (skeys < n_tot)
+        nF0 = fresh.sum(dtype=jnp.int32)
+        F0 = lax.dynamic_slice_in_dim(
+            jnp.sort(jnp.where(fresh, skeys, n_tot)), 0, min(lcap, skeys.shape[0])
+        )
+        if F0.shape[0] < lcap:
+            F0 = jnp.concatenate([F0, jnp.full(lcap - F0.shape[0], n_tot, jnp.int32)])
+        acc0 = jnp.full(cap, n_tot, dtype=jnp.int32).at[: skeys.shape[0]].set(
+            jnp.where(fresh, skeys, n_tot)
+        )
+        acc0 = jnp.sort(acc0)
+        over0 = nF0 > lcap
+
+        def cond(carry):
+            _F, nF, _acc, _nacc, over = carry
+            return (nF > 0) & ~over
+
+        def body(carry):
+            F, nF, acc, n_acc, over = carry
+            slot_live = jnp.arange(lcap, dtype=jnp.int32) < nF
+            rows = ell_dst[F]  # [lcap, k]
+            stamp = inv_stamp[rows]
+            live = (stamp != epoch) & (rows < n_tot)
+            if not assume_static_epochs:
+                # live-graph version matching; on an immutable-topology
+                # graph every slot's captured epoch equals the node epoch,
+                # so both gathers are elided (two fewer gathers per level —
+                # the gathers are the level cost floor, see op_probe r2)
+                eps = ell_epoch[F]
+                cur = node_epoch[rows]
+                live = live & (cur == eps)
+            cand_ok = slot_live[:, None] & live
+            cand = jnp.where(cand_ok, rows, n_tot).reshape(-1)
+            # tagged merge: acc entries (even) sort before candidates (odd)
+            keys = jnp.sort(jnp.concatenate([acc * 2, cand * 2 + 1]))
+            ids = keys >> 1
+            first = _dedup_first(ids) & (ids < n_tot)
+            isnew = first & ((keys & 1) == 1)
+            nF_next = isnew.sum(dtype=jnp.int32)
+            F_next = jnp.sort(jnp.where(isnew, ids, n_tot))[:lcap]
+            n_all = first.sum(dtype=jnp.int32)
+            acc_next = jnp.sort(jnp.where(first, ids, n_tot))[:cap]
+            over = over | (nF_next > lcap) | (n_all > cap)
+            return F_next, nF_next, acc_next, n_all, over
+
+        _F, _nF, acc, _nacc, over = lax.while_loop(
+            cond, body, (F0, nF0, acc0, nF0, over0)
+        )
+
+        # ---- single commit: stamp the whole wave at once (masked on overflow)
+        valid = (acc < n_tot) & ~over
+        inv_stamp = inv_stamp.at[jnp.where(valid, acc, n_tot)].max(
+            jnp.where(valid, epoch, NEVER), mode="drop"
+        )
+        count = jnp.where(over, 0, (valid & is_real[acc]).sum(dtype=jnp.int32))
+        return EllWaveState(node_epoch, inv_stamp, epoch, scratch), count, over
+
+    def lat_wave(seed_ids, state):
+        return step(garrays, seed_ids, state)
+
+    lat_wave.garrays = garrays
+    lat_wave.step = step
+    return init_state(), lat_wave

@@ -40,7 +40,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["GraphArrays", "wave_step", "run_wave", "run_wave_with_stats", "seeds_to_frontier"]
+__all__ = [
+    "GraphArrays",
+    "wave_step",
+    "run_wave",
+    "run_wave_collect",
+    "run_wave_with_stats",
+    "run_waves_chained",
+    "seeds_to_frontier",
+]
 
 
 class GraphArrays(NamedTuple):
@@ -92,8 +100,13 @@ def run_wave(seed_frontier: jax.Array, g: GraphArrays) -> Tuple[GraphArrays, jax
     # seeds invalidate unconditionally (they're the nodes invalidate() was
     # called on), but already-invalid seeds don't re-expand
     fresh_seeds = seed_frontier & ~g.invalid
-    invalid0 = g.invalid | fresh_seeds
-    g = g._replace(invalid=invalid0)
+    g = g._replace(invalid=g.invalid | fresh_seeds)
+    return _expand_to_fixpoint(fresh_seeds, g)
+
+
+def _expand_to_fixpoint(fresh_seeds: jax.Array, g: GraphArrays):
+    """Shared wave loop: expand fresh (already-marked) seeds until empty.
+    Returns (g, newly-invalidated count incl. the seeds)."""
 
     def cond(carry):
         frontier, _g, _count = carry
@@ -104,10 +117,62 @@ def run_wave(seed_frontier: jax.Array, g: GraphArrays) -> Tuple[GraphArrays, jax
         nxt, g = wave_step(frontier, g)
         return nxt, g, count + nxt.sum(dtype=jnp.int32)
 
-    frontier, g, count = lax.while_loop(
+    _f, g, count = lax.while_loop(
         cond, body, (fresh_seeds, g, fresh_seeds.sum(dtype=jnp.int32))
     )
     return g, count
+
+
+@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
+def run_wave_collect(
+    seed_frontier: jax.Array, g: GraphArrays, cap: int
+) -> Tuple[GraphArrays, jax.Array, jax.Array, jax.Array]:
+    """run_wave that also COMPACTS the newly-invalidated node ids on device.
+
+    Returns (g, count, ids: int32[cap] padded with -1, overflow: bool).
+    The live path (graph/backend.py) reads back only ``count`` and the id
+    buffer — O(wave size), not O(graph size) — instead of diffing two full
+    invalid-mask snapshots on host (the r1 design VERDICT.md weak #2).
+    When ``count > cap`` the buffer holds the first ``cap`` ids by node id
+    and ``overflow`` is set; the caller falls back to a mask readback.
+    """
+    inv_before = g.invalid
+    fresh_seeds = seed_frontier & ~g.invalid
+    g = g._replace(invalid=g.invalid | fresh_seeds)
+    g, count = _expand_to_fixpoint(fresh_seeds, g)
+    newly = g.invalid & ~inv_before
+    pos = jnp.cumsum(newly.astype(jnp.int32)) - 1
+    scatter_pos = jnp.where(newly & (pos < cap), pos, cap)  # OOB → dropped
+    ids = (
+        jnp.full(cap, -1, dtype=jnp.int32)
+        .at[scatter_pos]
+        .set(jnp.arange(newly.shape[0], dtype=jnp.int32), mode="drop")
+    )
+    return g, count, ids, count > cap
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def run_waves_chained(
+    seed_ids_mat: jax.Array, g: GraphArrays
+) -> Tuple[GraphArrays, jax.Array, jax.Array]:
+    """Chain W seed-id waves (int32[W, S], -1-padded) in ONE program.
+
+    Each wave cascades over the state the previous one left (the live
+    burst shape: many commands completing back-to-back get ONE dispatch +
+    ONE readback instead of W relay round trips). Returns
+    (g, per-wave newly-invalidated counts int32[W], union newly mask).
+    """
+    inv_before = g.invalid
+    n_cap = g.n_cap
+
+    def body(g, seed_ids):
+        fresh = seeds_to_frontier(n_cap, seed_ids) & ~g.invalid
+        g = g._replace(invalid=g.invalid | fresh)
+        g, count = _expand_to_fixpoint(fresh, g)
+        return g, count
+
+    g, counts = lax.scan(body, g, seed_ids_mat)
+    return g, counts, g.invalid & ~inv_before
 
 
 @functools.partial(jax.jit, donate_argnums=(1,))

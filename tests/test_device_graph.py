@@ -252,3 +252,167 @@ async def test_backend_sharded_export_cascades_on_mesh():
         assert count2 == 3  # fresh epoch edges cascade; dead ones don't refire
     finally:
         set_default_hub(old)
+
+
+def test_run_wave_collect_and_chained_match_oracle():
+    """run_wave_collect returns exactly the newly-invalidated ids (O(wave)
+    readback path); run_waves_chained equals running the waves one at a
+    time."""
+    rng = np.random.default_rng(11)
+    n = 400
+    edges = random_dag(rng, n)
+    arr = np.asarray(edges, dtype=np.int32)
+
+    def fresh():
+        g = DeviceGraph(node_capacity=n, edge_capacity=len(edges) + 1)
+        g.add_nodes(n)
+        g.add_edges(arr[:, 0], arr[:, 1])
+        return g
+
+    seeds1 = rng.choice(n, size=5, replace=False).tolist()
+    seeds2 = rng.choice(n, size=5, replace=False).tolist()
+
+    g = fresh()
+    count, ids = g.run_wave_collect(seeds1, cap=8)  # tiny cap → overflow path
+    want1 = python_wave_oracle(
+        n, edges, [0] * len(edges), np.zeros(n, np.int32), np.zeros(n, bool), seeds1
+    )
+    assert count == int(want1.sum())
+    np.testing.assert_array_equal(np.sort(ids), np.nonzero(want1)[0])
+
+    g2 = fresh()
+    count2, ids2 = g2.run_wave_collect(seeds1, cap=1024)  # compacted path
+    assert count2 == count
+    np.testing.assert_array_equal(np.sort(ids2), np.sort(ids))
+    # incremental second wave only reports NEW ids
+    count3, ids3 = g2.run_wave_collect(seeds2, cap=1024)
+    want_u = python_wave_oracle(
+        n, edges, [0] * len(edges), np.zeros(n, np.int32), want1.copy(), seeds2
+    )
+    newly = want_u & ~want1
+    assert count3 == int(newly.sum())
+    np.testing.assert_array_equal(np.sort(ids3), np.nonzero(newly)[0])
+
+    # chained = sequential
+    g3 = fresh()
+    counts, union_ids = g3.run_waves_chained([seeds1, seeds2])
+    assert counts.tolist() == [count, count3]
+    np.testing.assert_array_equal(np.sort(union_ids), np.nonzero(want_u)[0])
+
+
+async def test_backend_two_tier_application():
+    """Watched nodes (invalidation observers) apply EAGERLY after a device
+    wave; unwatched nodes go pending and materialize on next touch — both
+    read as invalidated through the public API the whole time."""
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        ConsistencyState,
+        FusionHub,
+        capture,
+        compute_method,
+        set_default_hub,
+    )
+    from stl_fusion_tpu.graph import TpuGraphBackend
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        backend = TpuGraphBackend(hub)
+
+        class S(ComputeService):
+            def __init__(self):
+                super().__init__()
+                self.data = {"a": 1, "b": 2}
+
+            @compute_method
+            async def get(self, k: str) -> int:
+                return self.data[k]
+
+            @compute_method
+            async def total(self) -> int:
+                return await self.get("a") + await self.get("b")
+
+            @compute_method
+            async def doubled(self) -> int:
+                return 2 * await self.total()
+
+        svc = S()
+        assert await svc.doubled() == 6
+        c_a = await capture(lambda: svc.get("a"))
+        c_total = await capture(lambda: svc.total())
+        c_doubled = await capture(lambda: svc.doubled())
+
+        fired = []
+        c_doubled.on_invalidated(lambda c: fired.append(c))  # → watched
+
+        svc.data["a"] = 10
+        backend.invalidate_cascade(c_a)
+        # watched: materialized eagerly, handler fired
+        assert fired == [c_doubled]
+        assert c_doubled._state == int(ConsistencyState.INVALIDATED)
+        # unwatched: pending (raw state untouched) but the public API is
+        # already truthful
+        assert c_total._state == int(ConsistencyState.CONSISTENT)
+        assert c_total.is_invalidated and not c_total.is_consistent
+        assert c_total.consistency_state == ConsistencyState.INVALIDATED
+
+        # a read sees the miss and recomputes; the displaced node is
+        # materialized by the register-time bump (no zombies)
+        assert await svc.total() == 12
+        assert c_total._state == int(ConsistencyState.INVALIDATED)
+        assert await svc.doubled() == 24
+
+        # direct invalidate() on a pending node materializes locally
+        c_a2 = await capture(lambda: svc.get("a"))
+        backend.invalidate_cascade(c_a2)
+        assert c_a2.invalidate() is True
+        assert c_a2._state == int(ConsistencyState.INVALIDATED)
+    finally:
+        set_default_hub(old)
+
+
+async def test_backend_batch_cascade():
+    """invalidate_cascade_batch: many seeds, one dispatch, sequential
+    semantics."""
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        capture,
+        compute_method,
+        set_default_hub,
+    )
+    from stl_fusion_tpu.graph import TpuGraphBackend
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        backend = TpuGraphBackend(hub)
+
+        class S(ComputeService):
+            def __init__(self):
+                super().__init__()
+                self.data = {k: i for i, k in enumerate("abcd")}
+
+            @compute_method
+            async def get(self, k: str) -> int:
+                return self.data[k]
+
+            @compute_method
+            async def pair(self, a: str, b: str) -> int:
+                return await self.get(a) + await self.get(b)
+
+        svc = S()
+        assert await svc.pair("a", "b") == 1
+        assert await svc.pair("c", "d") == 5
+        c_a = await capture(lambda: svc.get("a"))
+        c_c = await capture(lambda: svc.get("c"))
+        c_ab = await capture(lambda: svc.pair("a", "b"))
+        c_cd = await capture(lambda: svc.pair("c", "d"))
+
+        total = backend.invalidate_cascade_batch([c_a, c_c])
+        assert total == 4  # a, pair(a,b), c, pair(c,d)
+        assert c_ab.is_invalidated and c_cd.is_invalidated
+        svc.data["a"] = 100
+        assert await svc.pair("a", "b") == 101
+    finally:
+        set_default_hub(old)

@@ -4,7 +4,7 @@ import numpy as np
 import pytest
 
 from stl_fusion_tpu.graph.synthetic import power_law_dag
-from stl_fusion_tpu.ops.ell_wave import build_ell, build_ell_wave
+from stl_fusion_tpu.ops.ell_wave import advance_epoch, build_ell, build_ell_wave, invalid_mask
 
 from test_device_graph import python_wave_oracle
 
@@ -24,7 +24,7 @@ def test_build_ell_bounds_degree():
     seeds = jnp.asarray(np.array([0], dtype=np.int32))
     state, count = wave(jnp.pad(seeds, (0, 7), constant_values=-1), state)
     assert int(count) == 101  # hub + 100 dependents (virtual nodes not counted)
-    mask = np.asarray(state.invalid)[: g.n_real]
+    mask = invalid_mask(state)[: g.n_real]
     assert mask.all()
 
 
@@ -40,7 +40,7 @@ def test_ell_wave_matches_oracle(seed):
 
     seeds = rng.choice(n, size=11, replace=False).astype(np.int32)
     state, count = wave(jnp.asarray(seeds), state)
-    got = np.asarray(state.invalid)[:n]
+    got = invalid_mask(state)[:n]
 
     edges = list(zip(src.tolist(), dst.tolist()))
     want = python_wave_oracle(
@@ -63,6 +63,32 @@ def test_ell_wave_idempotent_and_seed_dedup():
     state, count = wave(seeds, state)
     assert int(count) == 0  # idempotent
 
+    # advance_epoch = everything consistent again in O(1); the same seeds
+    # re-cascade fully (the bench churn model rides this)
+    state = advance_epoch(state)
+    state, count = wave(seeds, state)
+    assert int(count) == 3
+
+
+def test_ell_wave_stale_frontier_never_refires():
+    """The frontier buffer persists across waves and epoch bumps; stale
+    slots beyond the live count must never fire — a big wave followed by an
+    epoch bump and a tiny DISJOINT wave is the adversarial shape."""
+    import jax.numpy as jnp
+
+    # two disjoint chains: 0→1→2 and 3→4
+    src = np.array([0, 1, 3], dtype=np.int32)
+    dst = np.array([1, 2, 4], dtype=np.int32)
+    g = build_ell(src, dst, 5, k=4)
+    state, wave = build_ell_wave(g, buckets=[16, 1 << 14])
+    state, count = wave(jnp.asarray(np.array([0, -1], dtype=np.int32)), state)
+    assert int(count) == 3  # 0,1,2 — frontier scratch now holds their ids
+    state = advance_epoch(state)
+    state, count = wave(jnp.asarray(np.array([3, -1], dtype=np.int32)), state)
+    assert int(count) == 2  # 3,4 only
+    got = invalid_mask(state)[: g.n_real]
+    np.testing.assert_array_equal(got, [False, False, False, True, True])
+
 
 @pytest.mark.parametrize("seed", [2, 5])
 def test_native_ell_matches_numpy_semantics(seed):
@@ -83,7 +109,7 @@ def test_native_ell_matches_numpy_semantics(seed):
     for g in (g_native, g_numpy):
         state, wave = build_ell_wave(g)
         state, count = wave(jnp.asarray(seeds), state)
-        masks.append((np.asarray(state.invalid)[:n], int(count)))
+        masks.append((invalid_mask(state)[:n], int(count)))
     np.testing.assert_array_equal(masks[0][0], masks[1][0])
     assert masks[0][1] == masks[1][1]
 
@@ -109,6 +135,74 @@ def test_ell_wave_sort_dedup_path_matches_oracle(seed):
     want = python_wave_oracle(
         n, edges, [0] * len(edges), np.zeros(n, np.int32), np.zeros(n, bool), seeds.tolist()
     )
-    got = np.asarray(state.invalid)[: g.n_real]
+    got = invalid_mask(state)[: g.n_real]
     np.testing.assert_array_equal(got, want)
     assert int(count) == int(want.sum())
+
+
+@pytest.mark.parametrize("seed", [0, 6])
+def test_lat_wave_matches_general_kernel(seed):
+    """The scatter-free latency kernel invalidates exactly the same real
+    nodes as the general bucketed kernel, including incremental waves and
+    epoch churn."""
+    from stl_fusion_tpu.ops.ell_wave import build_ell_lat_wave
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    n = 2500
+    src, dst = power_law_dag(n, avg_degree=3.0, seed=seed)
+    g = build_ell(src, dst, n, k=4)
+    state_g, wave_g = build_ell_wave(g)
+    # caps above n: random (non-shallow) seeds cascade through most of a
+    # power-law graph, so levels can be graph-wide here
+    state_l, wave_l = build_ell_lat_wave(g, lcap=4096, cap=8192)
+
+    for wave_i in range(3):
+        seeds = rng.choice(n, size=9, replace=False).astype(np.int32)
+        state_g, count_g = wave_g(jnp.asarray(seeds), state_g)
+        state_l, count_l, over = wave_l(jnp.asarray(seeds), state_l)
+        assert not bool(over)
+        assert int(count_l) == int(count_g)
+        np.testing.assert_array_equal(
+            invalid_mask(state_l)[:n], invalid_mask(state_g)[:n], err_msg=f"wave {wave_i}"
+        )
+        if wave_i == 1:  # churn: everything consistent again, O(1)
+            state_g, state_l = advance_epoch(state_g), advance_epoch(state_l)
+
+
+def test_lat_wave_overflow_aborts_cleanly():
+    """A wave wider than the caps must abort WITHOUT touching state."""
+    from stl_fusion_tpu.ops.ell_wave import build_ell_lat_wave
+
+    import jax.numpy as jnp
+
+    # one hub with 300 dependents, caps far below that
+    src = np.zeros(300, dtype=np.int32)
+    dst = np.arange(1, 301, dtype=np.int32)
+    g = build_ell(src, dst, 301, k=4)
+    state, wave = build_ell_lat_wave(g, lcap=64, cap=128)
+    before = np.asarray(state.inv_stamp).copy()
+    state, count, over = wave(jnp.asarray(np.array([0], dtype=np.int32)), state)
+    assert bool(over)
+    assert int(count) == 0
+    np.testing.assert_array_equal(np.asarray(state.inv_stamp), before)
+    assert not invalid_mask(state)[:301].any()
+
+
+def test_lat_wave_static_epoch_mode_matches_general(seed=3):
+    from stl_fusion_tpu.ops.ell_wave import build_ell_lat_wave
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    n = 2000
+    src, dst = power_law_dag(n, avg_degree=3.0, seed=seed)
+    g = build_ell(src, dst, n, k=4)
+    st_a, wave_a = build_ell_lat_wave(g, lcap=4096, cap=8192)
+    st_b, wave_b = build_ell_lat_wave(g, lcap=4096, cap=8192, assume_static_epochs=True)
+    seeds = rng.choice(n, size=7, replace=False).astype(np.int32)
+    st_a, c_a, _ = wave_a(jnp.asarray(seeds), st_a)
+    st_b, c_b, _ = wave_b(jnp.asarray(seeds), st_b)
+    assert int(c_a) == int(c_b)
+    np.testing.assert_array_equal(invalid_mask(st_a)[:n], invalid_mask(st_b)[:n])
