@@ -26,8 +26,8 @@ lone-wave latency sampling (it costs two extra compiles at 10M scale; the
 p50/p99 fields then report None rather than a fake distribution),
 FUSION_BENCH_LATENCY_SAMPLES (64), FUSION_BENCH_LAT_LCAP/LAT_CAP (512/4096
 latency-kernel capacities), FUSION_BENCH_SHARDED=1 → mesh-sharded dense
-wave over all devices, +FUSION_BENCH_SHARDED_PACKED=1 → the bit-packed
-32*WORDS-waves-per-pass mesh kernel (parallel/packed_wave.py).
+wave over all devices (bit-packed 32*WORDS-waves-per-pass kernel by
+default; FUSION_BENCH_SHARDED_PACKED=0 → one-wave-at-a-time chaining).
 """
 import json
 import os
@@ -273,9 +273,11 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
 
 
 def run_sharded(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
-    """FUSION_BENCH_SHARDED=1. FUSION_BENCH_SHARDED_PACKED=1 additionally
-    selects the bit-packed 32-waves-per-pass mesh kernel
-    (parallel/packed_wave.py) instead of one-wave-at-a-time chaining."""
+    """FUSION_BENCH_SHARDED=1. The bit-packed 32·WORDS-waves-per-pass mesh
+    kernel (parallel/packed_wave.py) is the DEFAULT multi-chip mode (it is
+    the throughput path, ~37x the per-wave chaining on the validation
+    mesh); FUSION_BENCH_SHARDED_PACKED=0 selects one-wave-at-a-time
+    chaining instead (the latency-shaped path)."""
     import jax
 
     from stl_fusion_tpu.graph.synthetic import power_law_dag
@@ -283,7 +285,7 @@ def run_sharded(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
 
     t0 = time.time()
     src, dst = power_law_dag(n_nodes, avg_degree=avg_deg, seed=7)
-    if os.environ.get("FUSION_BENCH_SHARDED_PACKED", "0") == "1":
+    if os.environ.get("FUSION_BENCH_SHARDED_PACKED", "1") == "1":
         words = int(os.environ.get("FUSION_BENCH_WORDS", 16))
         graph = PackedShardedGraph(src, dst, n_nodes, mesh=graph_mesh(), words=words)
         build_s = time.time() - t0

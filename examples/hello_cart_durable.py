@@ -20,7 +20,6 @@ Run: python examples/hello_cart_durable.py
 import asyncio
 import dataclasses
 import os
-import sqlite3
 import sys
 import tempfile
 from typing import Optional
@@ -30,7 +29,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from stl_fusion_tpu.checkpoint import HubCheckpoint
 from stl_fusion_tpu.commands import command_handler
 from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method, is_invalidating
-from stl_fusion_tpu.oplog import LocalChangeNotifier, SqliteOperationLog, attach_operation_log
+from stl_fusion_tpu.oplog import (
+    LocalChangeNotifier,
+    ScopedSqliteDb,
+    SqliteOperationLog,
+    attach_db_operation_scope,
+    attach_operation_log,
+)
 from stl_fusion_tpu.utils.serialization import wire_type
 
 
@@ -42,12 +47,17 @@ class EditProduct:
 
 
 class ProductDal:
-    """≈ the EF DbContext of samples/HelloCart v2 (sqlite is the in-image DB)."""
+    """≈ the EF DbContext of samples/HelloCart v2 (sqlite is the in-image
+    DB). Built on ScopedSqliteDb: inside a command, writes enroll in the
+    ambient SqliteOperationScope and commit ATOMICALLY with the operation
+    record (≈ DbOperationScope.cs:25-130) — a crash can never persist the
+    price edit without its invalidation record or vice versa."""
 
     def __init__(self, path: str):
-        self.db = sqlite3.connect(path)
-        self.db.execute("CREATE TABLE IF NOT EXISTS products (id TEXT PRIMARY KEY, price REAL)")
-        self.db.commit()
+        self.db = ScopedSqliteDb(path)
+        self.db.executescript(
+            "CREATE TABLE IF NOT EXISTS products (id TEXT PRIMARY KEY, price REAL)"
+        )
 
     def get(self, pid: str) -> Optional[float]:
         row = self.db.execute("SELECT price FROM products WHERE id=?", (pid,)).fetchone()
@@ -58,7 +68,7 @@ class ProductDal:
             "INSERT INTO products VALUES (?,?) ON CONFLICT(id) DO UPDATE SET price=excluded.price",
             (pid, price),
         )
-        self.db.commit()
+        self.db.commit()  # no-op inside a scope — the scope commits once
 
 
 class ProductService(ComputeService):
@@ -94,19 +104,25 @@ def make_host(db_path, log_store, notifier, attach_log=True):
     """Fresh hosts attach + tail the log from its end (the library
     default). A restarting host passes ``attach_log=False`` and attaches
     AFTER its checkpoint warm boot, with ``start_position=<saved
-    watermark>`` — so replay begins only once the restored graph is live."""
+    watermark>`` — so replay begins only once the restored graph is live.
+    Products and operations share ONE sqlite file, and
+    ``attach_db_operation_scope`` makes every command's writes + op record
+    one transaction (the scope's row dedupes the log listener's append)."""
     hub = FusionHub()
     products = hub.add_service(ProductService(ProductDal(db_path), hub))
     carts = hub.add_service(CartService(products, hub))
     hub.commander.add_service(products)
+    attach_db_operation_scope(hub.commander, db_path)
     reader = attach_operation_log(hub.commander, log_store, notifier) if attach_log else None
     return hub, products, carts, reader
 
 
 async def main():
     d = tempfile.mkdtemp()
-    db_path = os.path.join(d, "products.sqlite")
-    log_store = SqliteOperationLog(os.path.join(d, "ops.sqlite"))
+    # ONE file: the DAL tables and the operation log live in the same
+    # transaction domain — the precondition for atomic operation scopes
+    db_path = os.path.join(d, "shared.sqlite")
+    log_store = SqliteOperationLog(db_path)
     notifier = LocalChangeNotifier()
     ckpt_path = os.path.join(d, "host.ckpt")
 

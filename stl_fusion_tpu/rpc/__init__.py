@@ -4,7 +4,13 @@ from .hub import RpcClientProxy, RpcHub, consistent_hash_router
 from .message import COMPUTE_SYSTEM_SERVICE, SYSTEM_SERVICE, VERSION_HEADER, RpcMessage
 from .peer import ConnectionState, RpcClientPeer, RpcPeer, RpcServerPeer
 from .registry import RpcMethodDef, RpcServiceDef, RpcServiceRegistry, rpc_no_wait
-from .http_gateway import FusionHttpServer, RestClient, RestError
+from .http_gateway import FusionHttpServer, HttpSessionMiddleware, RestClient, RestError
+from .middleware import (
+    bind_peer_session,
+    call_logging_middleware,
+    default_session_replacer_middleware,
+    peer_session,
+)
 from .testing import RpcMultiServerTestTransport, RpcTestTransport
 
 __all__ = [
@@ -29,6 +35,11 @@ __all__ = [
     "RpcTestTransport",
     "RpcMultiServerTestTransport",
     "FusionHttpServer",
+    "HttpSessionMiddleware",
     "RestClient",
     "RestError",
+    "bind_peer_session",
+    "call_logging_middleware",
+    "default_session_replacer_middleware",
+    "peer_session",
 ]

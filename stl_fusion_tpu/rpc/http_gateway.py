@@ -13,6 +13,15 @@ Unlike the RPC/websocket channel this surface carries NO invalidation
 subscription — it is the integration path for plain HTTP consumers
 (curl, dashboards, other stacks), exactly the niche REST fills in the
 reference. Implemented on asyncio streams (stdlib only).
+
+Arguments and results travel in the wire-type encoding
+(utils/serialization: plain JSON for plain values, ``{"$t": ...}`` for
+registered types), so typed values — Sessions included — round-trip.
+With a :class:`HttpSessionMiddleware` attached the gateway issues/resolves
+a cookie-based Session per browser and substitutes it for the
+default-session placeholder in call arguments
+(≈ Fusion.Server/Middlewares/SessionMiddleware.cs +
+DefaultSessionReplacerRpcMiddleware.cs).
 """
 from __future__ import annotations
 
@@ -20,13 +29,56 @@ import asyncio
 import json
 import logging
 import urllib.parse
-from typing import Any, Optional
+from typing import Any, Dict, Optional, Tuple
+
+from ..utils.serialization import decode, encode
 
 log = logging.getLogger("stl_fusion_tpu")
 
-__all__ = ["FusionHttpServer", "RestClient", "RestError"]
+__all__ = ["FusionHttpServer", "HttpSessionMiddleware", "RestClient", "RestError"]
 
 PATH_PREFIX = "/fusion/"
+
+
+class HttpSessionMiddleware:
+    """Cookie-based Session issue/resolve for the HTTP gateway
+    (≈ SessionMiddleware.cs): a request without a valid session cookie gets
+    a fresh session issued via ``Set-Cookie``; default-placeholder Session
+    arguments are replaced with the cookie session before dispatch."""
+
+    def __init__(self, cookie_name: str = "FusionSession", tenant_id: str = ""):
+        from ..ext.session import Session
+
+        self.cookie_name = cookie_name
+        self.tenant_id = tenant_id
+        self._session_cls = Session
+
+    def resolve(self, cookie_header: str):
+        """(session, set_cookie_value_or_None) for a request's Cookie header."""
+        for part in cookie_header.split(";"):
+            name, _, value = part.strip().partition("=")
+            if name == self.cookie_name and value:
+                try:
+                    session = self._session_cls(urllib.parse.unquote(value))
+                    if not session.is_default:
+                        return session, None
+                    # a crafted '~' cookie must not smuggle the shared
+                    # placeholder identity past issuance
+                except ValueError:
+                    pass
+                break  # malformed or placeholder id: issue a fresh one
+        session = self._session_cls.new(self.tenant_id)
+        cookie = (
+            f"{self.cookie_name}={urllib.parse.quote(session.id, safe='')};"
+            f" Path=/; HttpOnly; SameSite=Lax"
+        )
+        return session, cookie
+
+    def replace_default_sessions(self, args: list, session) -> list:
+        s_cls = self._session_cls
+        return [
+            session if isinstance(a, s_cls) and a.is_default else a for a in args
+        ]
 
 
 class RestError(Exception):
@@ -39,10 +91,17 @@ class FusionHttpServer:
     """Serves registered services of an RpcHub (or any object registry with
     ``service_registry.invoke``) over HTTP."""
 
-    def __init__(self, rpc_hub, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        rpc_hub,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        session_middleware: Optional[HttpSessionMiddleware] = None,
+    ):
         self.rpc_hub = rpc_hub
         self.host = host
         self.port = port
+        self.session_middleware = session_middleware
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> "FusionHttpServer":
@@ -67,15 +126,21 @@ class FusionHttpServer:
                 return
             method, target, _version = request_line.split(" ", 2)
             content_length = 0
+            cookie_header = ""
             while True:
                 line = (await reader.readline()).decode("latin1").strip()
                 if not line:
                     break
                 name, _, value = line.partition(":")
-                if name.lower() == "content-length":
+                lname = name.lower()
+                if lname == "content-length":
                     content_length = int(value.strip())
+                elif lname == "cookie":
+                    cookie_header = value.strip()
             body = await reader.readexactly(content_length) if content_length else b""
-            status, payload = await self._dispatch(method, target, body)
+            status, payload, extra_headers = await self._dispatch(
+                method, target, body, cookie_header
+            )
             try:
                 data = json.dumps(payload).encode()
             except (TypeError, ValueError) as e:
@@ -85,8 +150,10 @@ class FusionHttpServer:
                 data = json.dumps(
                     {"error": {"type": "NotSerializable", "message": str(e)}}
                 ).encode()
+            header_block = "".join(f"{k}: {v}\r\n" for k, v in extra_headers)
             writer.write(
                 f"HTTP/1.1 {status}\r\nContent-Type: application/json\r\n"
+                f"{header_block}"
                 f"Content-Length: {len(data)}\r\nConnection: close\r\n\r\n".encode() + data
             )
             await writer.drain()
@@ -95,14 +162,18 @@ class FusionHttpServer:
         finally:
             writer.close()
 
-    async def _dispatch(self, http_method: str, target: str, body: bytes):
+    async def _dispatch(
+        self, http_method: str, target: str, body: bytes, cookie_header: str = ""
+    ) -> Tuple[str, Any, list]:
         parsed = urllib.parse.urlsplit(target)
+        not_found = ("404 Not Found", {"error": {"type": "NotFound", "message": parsed.path}}, [])
         if not parsed.path.startswith(PATH_PREFIX):
-            return "404 Not Found", {"error": {"type": "NotFound", "message": parsed.path}}
+            return not_found
         parts = parsed.path[len(PATH_PREFIX):].split("/")
         if len(parts) != 2:
-            return "404 Not Found", {"error": {"type": "NotFound", "message": parsed.path}}
+            return not_found
         service, method = parts
+        extra_headers: list = []
         try:
             if http_method == "GET":
                 query = urllib.parse.parse_qs(parsed.query)
@@ -112,21 +183,34 @@ class FusionHttpServer:
             else:
                 return "405 Method Not Allowed", {
                     "error": {"type": "MethodNotAllowed", "message": http_method}
-                }
+                }, []
             try:
                 args = json.loads(raw_args)
                 if not isinstance(args, list):
                     raise ValueError("args must be a JSON array")
-            except ValueError as e:
-                return "400 Bad Request", {"error": {"type": "BadRequest", "message": str(e)}}
+                args = [decode(a) for a in args]  # wire-typed args round-trip
+            except (ValueError, TypeError) as e:
+                # TypeError: unknown "$t" wire tag — still the CLIENT's bad
+                # input, not a server fault
+                return "400 Bad Request", {
+                    "error": {"type": "BadRequest", "message": str(e)}
+                }, []
+            mw = self.session_middleware
+            if mw is not None:
+                session, set_cookie = mw.resolve(cookie_header)
+                if set_cookie is not None:
+                    extra_headers.append(("Set-Cookie", set_cookie))
+                args = mw.replace_default_sessions(args, session)
             result = await self.rpc_hub.service_registry.invoke(service, method, args)
-            return "200 OK", {"ok": result}
+            return "200 OK", {"ok": encode(result)}, extra_headers
         except LookupError as e:
-            return "404 Not Found", {"error": {"type": type(e).__name__, "message": str(e)}}
+            return "404 Not Found", {
+                "error": {"type": type(e).__name__, "message": str(e)}
+            }, extra_headers
         except Exception as e:  # noqa: BLE001 — service errors travel as payloads
             return "500 Internal Server Error", {
                 "error": {"type": type(e).__name__, "message": str(e)}
-            }
+            }, extra_headers
 
 
 class _RestMethod:
@@ -143,13 +227,16 @@ class _RestMethod:
 
 class RestClient:
     """Typed REST client for a served compute service (≈ Stl.RestEase
-    clients): attribute access → GET call; ``.post`` for commands."""
+    clients): attribute access → GET call; ``.post`` for commands. Args and
+    results use the wire-type encoding; a cookie jar carries the gateway's
+    session cookie across calls (≈ a browser talking to SessionMiddleware)."""
 
     def __init__(self, base_url: str, service: str):
         parsed = urllib.parse.urlsplit(base_url)
         self.host = parsed.hostname
         self.port = parsed.port or 80
         self.service = service
+        self.cookies: Dict[str, str] = {}
 
     def __getattr__(self, method: str) -> _RestMethod:
         if method.startswith("_"):
@@ -158,16 +245,23 @@ class RestClient:
 
     async def call(self, method: str, args: list, http_method: str = "GET") -> Any:
         path = f"{PATH_PREFIX}{self.service}/{method}"
+        wire_args = json.dumps([encode(a) for a in args])
         body = b""
         if http_method == "GET":
-            path += "?args=" + urllib.parse.quote(json.dumps(args))
+            path += "?args=" + urllib.parse.quote(wire_args)
         else:
-            body = json.dumps(args).encode()
+            body = wire_args.encode()
+        cookie_line = (
+            "Cookie: " + "; ".join(f"{k}={v}" for k, v in self.cookies.items()) + "\r\n"
+            if self.cookies
+            else ""
+        )
         try:
             reader, writer = await asyncio.open_connection(self.host, self.port)
             try:
                 writer.write(
                     f"{http_method} {path} HTTP/1.1\r\nHost: {self.host}\r\n"
+                    f"{cookie_line}"
                     f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode() + body
                 )
                 await writer.drain()
@@ -179,6 +273,13 @@ class RestClient:
             raise RestError("BadResponse", f"connection failed: {e}") from None
         headers, _, payload = raw.partition(b"\r\n\r\n")
         status_line = headers.split(b"\r\n", 1)[0].decode("latin1", "replace")
+        for line in headers.split(b"\r\n")[1:]:
+            name, _, value = line.decode("latin1", "replace").partition(":")
+            if name.lower() == "set-cookie":
+                cookie = value.strip().split(";", 1)[0]
+                cname, _, cvalue = cookie.partition("=")
+                if cname:
+                    self.cookies[cname] = cvalue
         if not payload:
             # server closed without a body (request never parsed, handler
             # crashed before write) — surface as RestError, not a JSON error
@@ -189,4 +290,4 @@ class RestClient:
             raise RestError("BadResponse", f"{status_line}: {e}") from None
         if "error" in response:
             raise RestError(response["error"]["type"], response["error"]["message"])
-        return response["ok"]
+        return decode(response["ok"])

@@ -67,6 +67,15 @@ class RpcHub:
         )
         #: $sys-c dispatch hook, installed by the fusion client layer
         self.compute_system_handler: Optional[Callable[[RpcPeer, RpcMessage], None]] = None
+        #: composable middleware chains (≈ RpcInboundMiddleware /
+        #: RpcOutboundMiddleware, Stl.Rpc/Infrastructure/): each entry is
+        #: ``async (peer, message, nxt)`` where ``await nxt(message)``
+        #: continues the chain (pass a modified message to rewrite).
+        #: Inbound runs around message dispatch; outbound around ``send``
+        #: (first sends only — reconnect re-sends replay the original call
+        #: messages without re-running the chain).
+        self.inbound_middlewares: List[Callable] = []
+        self.outbound_middlewares: List[Callable] = []
         #: local service fallback for routing proxies
         self.local_services: Dict[str, Any] = {}
 

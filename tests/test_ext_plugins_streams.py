@@ -266,13 +266,18 @@ class TestReviewFixes:
 
         class Raw:
             async def blob(self):
-                return b"\x00\x01"  # bytes aren't JSON
+                return b"\x00\x01"  # bytes RIDE the wire encoding (TextOrBytes)
+
+            async def alien(self):
+                return object()  # nothing can serialize this
 
         rpc.add_service("raw", Raw())
         server = await FusionHttpServer(rpc).start()
         try:
-            with pytest.raises(RestError, match="NotSerializable"):
-                await RestClient(server.url, "raw").blob()
+            # the wire-typed gateway round-trips bytes now (r2)
+            assert await RestClient(server.url, "raw").blob() == b"\x00\x01"
+            with pytest.raises(RestError, match="NotSerializable|wire-registered"):
+                await RestClient(server.url, "raw").alien()
         finally:
             await server.stop()
             await rpc.stop()
@@ -394,3 +399,45 @@ async def test_peer_monitor_reports_terminated_state():
     finally:
         await monitor.stop()
         await hub.stop()
+
+
+async def test_http_session_middleware_cookie_flow():
+    """Cookie-based session issue/resolve on the gateway
+    (≈ Fusion.Server/Middlewares/SessionMiddleware.cs): first request
+    issues Set-Cookie; later requests resolve the same session; the
+    default placeholder in args is replaced by the cookie session."""
+    from stl_fusion_tpu.ext import Session
+    from stl_fusion_tpu.rpc import HttpSessionMiddleware
+
+    rpc = RpcHub("http-sessions")
+    seen = []
+
+    class Whoami:
+        async def whoami(self, session: Session) -> Session:
+            seen.append(session)
+            return session
+
+    rpc.add_service("who", Whoami())
+    server = await FusionHttpServer(
+        rpc, session_middleware=HttpSessionMiddleware()
+    ).start()
+    try:
+        client = RestClient(server.url, "who")
+        s1 = await client.whoami(Session.default())
+        assert "FusionSession" in client.cookies  # issued via Set-Cookie
+        assert not s1.is_default and len(s1.id) >= 8
+        s2 = await client.whoami(Session.default())
+        assert s2 == s1  # cookie resolves to the SAME session
+        assert all(not s.is_default for s in seen)
+
+        # a different client (no cookie jar sharing) gets a different session
+        other = RestClient(server.url, "who")
+        s3 = await other.whoami(Session.default())
+        assert s3 != s1
+
+        # an explicit session wins over the cookie
+        explicit = Session.new()
+        assert await client.whoami(explicit) == explicit
+    finally:
+        await server.stop()
+        await rpc.stop()

@@ -302,3 +302,139 @@ async def test_missing_connector_fails_fast():
             await asyncio.wait_for(proxy.echo("x"), 2.0)
     finally:
         await _shutdown(hub)
+
+
+async def test_resend_batch_survives_link_death_mid_batch():
+    """Kill the link in the MIDDLE of the reconnect re-send batch (the
+    half-open shape: sends fail, the reader hangs): every registered
+    outbound call must still complete — the peer must treat the failed
+    re-send as a dead link and reconnect, not park the unsent tail
+    (VERDICT r1 weak #7; reference RpcPeer.cs:116-119)."""
+    server_hub = RpcHub("server")
+    client_hub = RpcHub("client")
+    gate = asyncio.Event()
+
+    class GatedService:
+        async def gated(self, value: int) -> int:
+            await gate.wait()
+            return value * 10
+
+    server_hub.add_service("gated", GatedService())
+    transport = RpcTestTransport(client_hub, server_hub)
+    try:
+        proxy = client_hub.client("gated", "default")
+        futures = [asyncio.ensure_future(proxy.gated(i)) for i in range(5)]
+        await asyncio.sleep(0.05)  # all five registered + delivered
+
+        # next connection's writer dies after 2 sends — mid-re-send-batch
+        transport.fail_next_connection_after(2)
+        await transport.disconnect()
+        await asyncio.sleep(0.2)  # first reconnect dies mid-batch, second completes
+
+        gate.set()
+        results = await asyncio.wait_for(asyncio.gather(*futures), 5.0)
+        assert results == [0, 10, 20, 30, 40]
+        # at least: initial + flaky + the recovery connection
+        assert transport.connect_count["default"] >= 3
+    finally:
+        await _shutdown(client_hub, server_hub)
+
+
+async def test_inbound_outbound_middleware_chain():
+    """Composable middleware pipeline (≈ RpcInbound/OutboundMiddleware):
+    cross-cutting behavior attaches to the hub lists without editing
+    peers; middlewares can observe AND rewrite messages."""
+    from stl_fusion_tpu.rpc import RpcMessage
+
+    client_hub, server_hub, svc, _t = make_pair()
+    seen_out, seen_in = [], []
+
+    async def log_out(peer, message, nxt):
+        seen_out.append((message.service, message.method))
+        await nxt(message)
+
+    async def log_in(peer, message, nxt):
+        seen_in.append((message.service, message.method))
+        await nxt(message)
+
+    async def rewrite_in(peer, message, nxt):
+        # rewrite: echo("mw") → echo("rewritten") on the way in
+        if message.method == "echo":
+            from stl_fusion_tpu.utils.serialization import dumps, loads
+
+            args = loads(message.argument_data)
+            if args == ["mw"]:
+                message = RpcMessage(
+                    message.call_type_id, message.call_id, message.service,
+                    message.method, dumps(["rewritten"]), message.headers,
+                )
+        await nxt(message)
+
+    client_hub.outbound_middlewares.append(log_out)
+    server_hub.inbound_middlewares.append(log_in)
+    server_hub.inbound_middlewares.append(rewrite_in)
+    try:
+        proxy = client_hub.client("echo", "default")
+        assert await proxy.echo("mw") == "server:rewritten"
+        assert ("echo", "echo") in seen_out
+        assert ("echo", "echo") in seen_in
+    finally:
+        await _shutdown(client_hub, server_hub)
+
+
+async def test_default_session_replacer_middleware():
+    """Inbound default-session placeholder is replaced with the
+    connection's bound session (≈ DefaultSessionReplacerRpcMiddleware):
+    the client never learns the real id, yet the service sees a stable
+    per-connection session."""
+    from stl_fusion_tpu.ext import Session
+    from stl_fusion_tpu.rpc import default_session_replacer_middleware
+
+    server_hub = RpcHub("server")
+    client_hub = RpcHub("client")
+    seen = []
+
+    class SessionService:
+        async def whoami(self, session: Session) -> str:
+            seen.append(session)
+            return session.id
+
+    server_hub.add_service("auth", SessionService())
+    server_hub.inbound_middlewares.append(default_session_replacer_middleware())
+    transport = RpcTestTransport(client_hub, server_hub)
+    try:
+        proxy = client_hub.client("auth", "default")
+        sid1 = await proxy.whoami(Session.default())
+        sid2 = await proxy.whoami(Session.default())
+        assert sid1 == sid2 and sid1 != "~"  # stable real session substituted
+        assert all(not s.is_default for s in seen)
+        explicit = Session.new()
+        assert await proxy.whoami(explicit) == explicit.id  # explicit passes through
+    finally:
+        await _shutdown(client_hub, server_hub)
+
+
+async def test_middleware_rejection_is_isolated_per_call():
+    """An auth middleware rejecting one call (PermissionError — an OSError
+    subclass the pump must NOT misread as transport death) errors that call
+    only; the connection stays up and later calls succeed."""
+    client_hub, server_hub, svc, transport = make_pair()
+
+    async def auth(peer, message, nxt):
+        from stl_fusion_tpu.utils.serialization import loads
+
+        if message.method == "echo" and loads(message.argument_data) == ["forbidden"]:
+            raise PermissionError("no")
+        await nxt(message)
+
+    server_hub.inbound_middlewares.append(auth)
+    try:
+        proxy = client_hub.client("echo", "default")
+        assert await proxy.echo("ok") == "server:ok"
+        with pytest.raises(PermissionError):
+            await asyncio.wait_for(proxy.echo("forbidden"), 2.0)
+        # the healthy connection survived the rejection
+        assert await proxy.echo("still-up") == "server:still-up"
+        assert transport.connect_count["default"] == 1
+    finally:
+        await _shutdown(client_hub, server_hub)

@@ -238,3 +238,32 @@ class TestReviewFixes:
             t.join()
         final = cache.try_get("k")
         assert final in payloads  # never torn/interleaved
+
+
+def test_native_rebuilds_from_source(tmp_path, monkeypatch):
+    """No committed binaries: the content-hashed .so must rebuild from
+    graphpack.cpp on demand (VERDICT r1 #9). Simulated by pointing the
+    module at a copy of the source in an empty directory."""
+    import shutil
+
+    import numpy as np
+
+    import stl_fusion_tpu.native as native
+
+    src_copy = tmp_path / "graphpack.cpp"
+    shutil.copy(native._SRC, src_copy)
+    monkeypatch.setattr(native, "_DIR", str(tmp_path))
+    monkeypatch.setattr(native, "_SRC", str(src_copy))
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_lib_failed", False)
+
+    lib = native.load_graphpack()
+    assert lib is not None, "rebuild from source failed"
+    assert list(tmp_path.glob("_graphpack_*.so")), "no content-hashed artifact built"
+
+    src = np.array([0, 0, 1], dtype=np.int32)
+    dst = np.array([1, 2, 3], dtype=np.int32)
+    res = native.native_build_ell(src, dst, 4, 4)
+    assert res is not None
+    ell_dst, n_tot = res
+    assert n_tot >= 4 and ell_dst.shape[1] == 4
