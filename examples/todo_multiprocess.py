@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""TodoApp multi-host, REAL processes — the reference's multi-host deployment
+(samples/Run-TodoApp-MultiHost.cmd: two ASP.NET host processes sharing one
+database) as two OS processes sharing one sqlite file:
+
+- **host process** ("host-b"): owns a FusionHub over the shared sqlite DB,
+  tails the operation log via :class:`FileChangeNotifier` (touch-file wakeup,
+  ≈ FileBasedDbOperationLogChangeNotifier), and serves compute methods over a
+  real websocket.
+- **writer process** ("host-a"): a separate ``python`` process with its own
+  hub + agent id. Its command runs under the atomic
+  :class:`SqliteOperationScope` — the todo row and the operation record
+  commit in ONE transaction (DbOperationScope.cs:25-130 semantics).
+- **this parent process**: a websocket compute client of host B. It captures
+  ``summary()`` and waits for the push — proving the full chain
+  ``A(write) → shared sqlite op log → touch file → B(log reader → replay
+  invalidation) → $sys-c websocket push → client`` with no shared memory
+  anywhere between A and B.
+
+Run: python examples/todo_multiprocess.py
+Roles (internal): ``... host <db>`` serves, ``... writer <db> <id> <title>
+[done]`` applies one command and exits.
+"""
+import asyncio
+import dataclasses
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stl_fusion_tpu.commands import command_handler
+from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method, is_invalidating
+from stl_fusion_tpu.oplog import (
+    FileChangeNotifier,
+    ScopedSqliteDb,
+    SqliteOperationLog,
+    attach_db_operation_scope,
+    attach_operation_log,
+)
+from stl_fusion_tpu.utils.serialization import wire_type
+
+
+@wire_type
+@dataclasses.dataclass(frozen=True)
+class AddOrUpdateTodo:
+    id: str
+    title: str
+    done: bool = False
+
+
+class TodoDal:
+    """≈ the EF DbContext both host processes point at one database.
+    ScopedSqliteDb writes enroll in the ambient operation scope, so the
+    todo upsert and its operation record are one atomic commit."""
+
+    def __init__(self, path: str):
+        self.db = ScopedSqliteDb(path)
+        self.db.executescript(
+            "CREATE TABLE IF NOT EXISTS todos (id TEXT PRIMARY KEY, title TEXT, done INTEGER)"
+        )
+
+    def get(self, tid: str) -> Optional[dict]:
+        row = self.db.execute(
+            "SELECT id, title, done FROM todos WHERE id=?", (tid,)
+        ).fetchone()
+        return {"id": row[0], "title": row[1], "done": bool(row[2])} if row else None
+
+    def list_ids(self) -> tuple:
+        return tuple(r[0] for r in self.db.execute("SELECT id FROM todos ORDER BY id"))
+
+    def upsert(self, tid: str, title: str, done: bool) -> None:
+        self.db.execute(
+            "INSERT INTO todos VALUES (?,?,?) ON CONFLICT(id) DO UPDATE"
+            " SET title=excluded.title, done=excluded.done",
+            (tid, title, int(done)),
+        )
+        self.db.commit()  # no-op inside a scope — the scope commits once
+
+
+class TodoService(ComputeService):
+    def __init__(self, dal: TodoDal, hub=None):
+        super().__init__(hub)
+        self.dal = dal
+
+    @compute_method
+    async def get(self, todo_id: str) -> Optional[dict]:
+        return self.dal.get(todo_id)
+
+    @compute_method
+    async def list_ids(self) -> tuple:
+        return self.dal.list_ids()
+
+    @compute_method
+    async def summary(self) -> str:
+        ids = await self.list_ids()
+        done = 0
+        for tid in ids:
+            todo = await self.get(tid)
+            if todo and todo["done"]:
+                done += 1
+        return f"{done}/{len(ids)} done"
+
+    @command_handler
+    async def add_or_update(self, command: AddOrUpdateTodo):
+        if is_invalidating():
+            await self.get(command.id)
+            await self.list_ids()
+            return
+        self.dal.upsert(command.id, command.title, command.done)
+
+
+def make_host(db_path: str, poll_period: float = 0.05):
+    """One per-process host over the SHARED sqlite file; cross-process
+    wakeups ride the touch file next to it."""
+    fusion = FusionHub()
+    svc = TodoService(TodoDal(db_path), fusion)
+    fusion.add_service(svc)
+    fusion.commander.add_service(svc)
+    attach_db_operation_scope(fusion.commander, db_path)
+    log_store = SqliteOperationLog(db_path)
+    notifier = FileChangeNotifier(db_path + ".touch")
+    reader = attach_operation_log(fusion.commander, log_store, notifier)
+    reader.poll_period = poll_period
+    return fusion, svc, reader, log_store
+
+
+# --------------------------------------------------------------------- roles
+async def run_host(db_path: str) -> None:
+    """Host B: serve the todo service over a websocket until stdin closes."""
+    from stl_fusion_tpu.client import install_compute_call_type
+    from stl_fusion_tpu.rpc import RpcHub
+    from stl_fusion_tpu.rpc.websocket import RpcWebSocketServer
+
+    fusion, svc, reader, log_store = make_host(db_path)
+    rpc = RpcHub("host-b")
+    install_compute_call_type(rpc)
+    rpc.add_service("todos", svc)
+    server = await RpcWebSocketServer(rpc).start()
+    print(f"URL {server.url}", flush=True)  # the parent parses this line
+    # serve until the parent closes our stdin (clean cross-platform signal)
+    await asyncio.get_running_loop().run_in_executor(None, sys.stdin.read)
+    await server.stop()
+    await reader.stop()
+    log_store.close()
+
+
+async def run_writer(db_path: str, tid: str, title: str, done: bool) -> None:
+    """Host A: apply ONE command atomically (todo row + op record) and exit."""
+    fusion, _svc, reader, log_store = make_host(db_path)
+    await fusion.commander.call(AddOrUpdateTodo(tid, title, done))
+    await reader.stop()
+    log_store.close()
+    print("writer committed", flush=True)
+
+
+async def run_parent() -> None:
+    from stl_fusion_tpu.client import compute_client, install_compute_call_type
+    from stl_fusion_tpu.rpc import RpcHub
+    from stl_fusion_tpu.rpc.websocket import websocket_client_connector
+
+    d = tempfile.mkdtemp()
+    db_path = os.path.join(d, "todos.sqlite")
+    script = os.path.abspath(__file__)
+
+    host = subprocess.Popen(
+        [sys.executable, script, "host", db_path],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        url_line = await asyncio.get_running_loop().run_in_executor(
+            None, host.stdout.readline
+        )
+        assert url_line.startswith("URL "), f"host failed to start: {url_line!r}"
+        url = url_line.split(None, 1)[1].strip()
+
+        client_rpc = RpcHub("client")
+        install_compute_call_type(client_rpc)
+        client_rpc.client_connector = websocket_client_connector(url)
+        client_fusion = FusionHub()
+        todos = compute_client("todos", client_rpc, client_fusion)
+
+        print("summary (via host B process):", await todos.summary())
+
+        async def edit_and_wait(tid, title, done, expect):
+            node = await capture(lambda: todos.summary())
+            writer = subprocess.run(
+                [sys.executable, script, "writer", db_path, tid, title]
+                + (["done"] if done else []),
+                capture_output=True, text=True, timeout=60,
+            )
+            assert writer.returncode == 0, writer.stderr
+            await asyncio.wait_for(node.when_invalidated(), 10.0)
+            value = await todos.summary()
+            assert value == expect, f"expected {expect!r}, got {value!r}"
+            print(f"after writer process ({tid!r}, done={done}): {value}")
+
+        await edit_and_wait("t1", "port TodoApp", False, "0/1 done")
+        await edit_and_wait("t1", "port TodoApp", True, "1/1 done")
+
+        print("cross-PROCESS chain A(write) -> sqlite oplog -> touch file -> "
+              "B(replay) -> websocket push -> client: OK")
+        await client_rpc.stop()
+    finally:
+        if host.stdin:
+            host.stdin.close()  # asks the host to exit
+        try:
+            host.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            host.kill()
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "host":
+        asyncio.run(run_host(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "writer":
+        asyncio.run(run_writer(
+            sys.argv[2], sys.argv[3], sys.argv[4], "done" in sys.argv[5:]
+        ))
+    else:
+        asyncio.run(run_parent())

@@ -14,7 +14,7 @@ from typing import Optional
 
 from ..utils.serialization import register_wire_type
 
-__all__ = ["Session", "SessionResolver"]
+__all__ = ["Session", "SessionResolver", "replace_default_sessions"]
 
 DEFAULT_PLACEHOLDER = "~"
 MIN_ID_LENGTH = 8
@@ -51,6 +51,15 @@ class Session:
 
 
 register_wire_type(Session, "Session", lambda s: {"id": s.id}, lambda d: Session(d["id"]))
+
+
+def replace_default_sessions(args: list, session: Session, session_cls: type = Session) -> list:
+    """THE default-session substitution: swap every default-placeholder
+    Session in an args list for the caller-bound real one. Shared by the
+    HTTP session middleware, the RPC inbound middleware, and resolver-based
+    flows so the replacement semantics can never drift apart
+    (≈ DefaultSessionReplacerRpcMiddleware.cs)."""
+    return [session if isinstance(a, session_cls) and a.is_default else a for a in args]
 
 
 class SessionResolver:

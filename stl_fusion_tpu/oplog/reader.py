@@ -104,15 +104,20 @@ class OperationLogReader(WorkerBase):
 
     async def on_run(self) -> None:
         wake = self.notifier.subscribe() if self.notifier is not None else None
+        # file-backed notifiers only learn about OTHER processes' commits by
+        # polling the touch-file mtime, so they poll at poll_period; purely
+        # local notifiers wake on the event and keep a 4x safety poll only
+        pollable = hasattr(self.notifier, "poll")
         while True:
             await self.read_new()
             if wake is not None:
+                timeout = self.poll_period if pollable else self.poll_period * 4
                 try:
-                    await asyncio.wait_for(wake.wait(), self.poll_period * 4)
+                    await asyncio.wait_for(wake.wait(), timeout)
                 except asyncio.TimeoutError:
                     pass  # safety poll: progress even on missed notifications
                 wake.clear()
-                if hasattr(self.notifier, "poll"):
+                if pollable:
                     self.notifier.poll()
             else:
                 await asyncio.sleep(self.poll_period)

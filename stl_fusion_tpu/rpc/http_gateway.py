@@ -75,10 +75,9 @@ class HttpSessionMiddleware:
         return session, cookie
 
     def replace_default_sessions(self, args: list, session) -> list:
-        s_cls = self._session_cls
-        return [
-            session if isinstance(a, s_cls) and a.is_default else a for a in args
-        ]
+        from ..ext.session import replace_default_sessions
+
+        return replace_default_sessions(args, session, self._session_cls)
 
 
 class RestError(Exception):
@@ -189,8 +188,9 @@ class FusionHttpServer:
                 if not isinstance(args, list):
                     raise ValueError("args must be a JSON array")
                 args = [decode(a) for a in args]  # wire-typed args round-trip
-            except (ValueError, TypeError) as e:
-                # TypeError: unknown "$t" wire tag — still the CLIENT's bad
+            except (ValueError, TypeError, KeyError) as e:
+                # TypeError: unknown "$t" wire tag; KeyError: a known tag
+                # missing its payload fields — still the CLIENT's bad
                 # input, not a server fault
                 return "400 Bad Request", {
                     "error": {"type": "BadRequest", "message": str(e)}

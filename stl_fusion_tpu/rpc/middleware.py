@@ -21,7 +21,7 @@ from __future__ import annotations
 import logging
 from typing import Callable, Optional
 
-from ..ext.session import Session, SessionResolver
+from ..ext.session import Session, SessionResolver, replace_default_sessions
 from ..utils.serialization import dumps, loads
 from .message import COMPUTE_SYSTEM_SERVICE, SYSTEM_SERVICE, RpcMessage
 from .peer import RpcPeer
@@ -98,7 +98,7 @@ def default_session_replacer_middleware(
                 real = resolver_for_peer(peer).session
             else:
                 real = peer_session(peer)
-            args = [real if isinstance(a, Session) and a.is_default else a for a in args]
+            args = replace_default_sessions(args, real)
             message = RpcMessage(
                 message.call_type_id,
                 message.call_id,

@@ -90,7 +90,6 @@ class RpcPeer(WorkerBase):
         self._completed_inbound = RecentlySeenMap(capacity=10_000, max_age=600.0)
         self._call_id_counter = itertools.count(1)
         self._conn: Optional[ChannelPair] = None
-        self._send_lock = asyncio.Lock()
         self._resend_failures = 0  # consecutive connect-then-die-on-resend
 
     # ------------------------------------------------------------------ id/state
@@ -148,7 +147,10 @@ class RpcPeer(WorkerBase):
             resend_failure: Optional[BaseException] = None
             for call in list(self.outbound_calls.values()):
                 try:
-                    await self._send_raw(call.to_message())
+                    # through send(), not _send_raw: outbound middlewares
+                    # (auth tokens, session replacement) must rewrite a
+                    # redelivered call exactly like the original send
+                    await self.send(call.to_message())
                 except asyncio.CancelledError:
                     conn.close()
                     raise
@@ -222,7 +224,19 @@ class RpcPeer(WorkerBase):
                 "peer %s: processing %s.%s #%d failed",
                 self.ref, message.service, message.method, message.call_id,
             )
-            if message.service not in (SYSTEM_SERVICE, COMPUTE_SYSTEM_SERVICE) and message.call_id:
+            if message.service == SYSTEM_SERVICE:
+                # a completion ($sys.ok/.error) that failed to process must
+                # not leave the awaiting caller parked forever on a healthy-
+                # looking link — surface the failure to the call itself
+                call = self.outbound_calls.get(message.call_id)
+                if call is not None:
+                    call.set_error(e)
+            elif message.service == COMPUTE_SYSTEM_SERVICE:
+                # a dropped invalidation push would mean stale-forever; tear
+                # the link down so the reconnect re-send/re-register cycle
+                # restores consistency (the pre-middleware pump behavior)
+                await self.disconnect(e)
+            elif message.call_id:
                 try:
                     await self.send(
                         RpcMessage(

@@ -40,6 +40,15 @@ def test_hello_cart_durable_sample():
     assert "durable HelloCart OK" in stdout
 
 
+def test_todo_multiprocess_sample():
+    """Real cross-process multi-host: writer and serving host are separate
+    OS processes sharing one sqlite file, wired by FileChangeNotifier."""
+    stdout = _run("todo_multiprocess.py")
+    assert "after writer process ('t1', done=False): 0/1 done" in stdout
+    assert "after writer process ('t1', done=True): 1/1 done" in stdout
+    assert "websocket push -> client: OK" in stdout
+
+
 def test_mini_rpc_sample():
     stdout = _run("mini_rpc.py")
     assert "Word count changed: 8" in stdout

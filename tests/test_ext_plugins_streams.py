@@ -441,3 +441,29 @@ async def test_http_session_middleware_cookie_flow():
     finally:
         await server.stop()
         await rpc.stop()
+
+
+async def test_gateway_malformed_wire_args_are_400():
+    """A known wire tag missing its payload fields (KeyError inside
+    decode) is the CLIENT's bad input → 400, not a 500."""
+    import json
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    rpc = RpcHub("http-server-400")
+    rpc.add_service("products", ProductService(FusionHub()))
+    server = await FusionHttpServer(rpc).start()
+    try:
+        for bad_args in ('[{"$t":"Session"}]', '[{"$t":"dict"}]', '{"not":"a list"}'):
+            url = f"{server.url}/fusion/products/price?args={urllib.parse.quote(bad_args)}"
+            try:
+                await asyncio.to_thread(urllib.request.urlopen, url)
+                raise AssertionError(f"{bad_args}: expected an HTTP error")
+            except urllib.error.HTTPError as e:
+                body = json.loads(e.read().decode())
+                assert e.code == 400, f"{bad_args}: got {e.code} {body}"
+                assert body["error"]["type"] == "BadRequest"
+    finally:
+        await server.stop()
+        await rpc.stop()

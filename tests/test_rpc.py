@@ -438,3 +438,70 @@ async def test_middleware_rejection_is_isolated_per_call():
         assert transport.connect_count["default"] == 1
     finally:
         await _shutdown(client_hub, server_hub)
+
+
+async def test_failing_middleware_on_completion_unblocks_caller():
+    """An inbound middleware that raises while a $sys completion is being
+    processed must surface the failure to the awaiting call — not swallow
+    it and leave the caller parked forever on a healthy-looking link."""
+    client_hub, server_hub, svc, _t = make_pair()
+
+    async def broken(peer, message, nxt):
+        if message.service == "$sys" and message.method == "ok":
+            raise RuntimeError("middleware bug")
+        await nxt(message)
+
+    client_hub.inbound_middlewares.append(broken)
+    try:
+        proxy = client_hub.client("echo", "default")
+        with pytest.raises(RuntimeError, match="middleware bug"):
+            await asyncio.wait_for(proxy.echo("x"), 2.0)
+    finally:
+        await _shutdown(client_hub, server_hub)
+
+
+async def test_resend_applies_outbound_middlewares():
+    """Reconnect re-send must go through the outbound middleware chain:
+    a rewrite applied on first send (auth token, session substitution)
+    must equally apply to the redelivered call."""
+    server_hub = RpcHub("server")
+    client_hub = RpcHub("client")
+    gate = asyncio.Event()
+    seen_args = []
+
+    class GatedService:
+        async def gated(self, text: str) -> str:
+            seen_args.append(text)
+            await gate.wait()
+            return f"got:{text}"
+
+    async def rewrite_out(peer, message, nxt):
+        from stl_fusion_tpu.rpc import RpcMessage
+        from stl_fusion_tpu.utils.serialization import dumps, loads
+
+        if message.method == "gated":
+            args = loads(message.argument_data)
+            message = RpcMessage(
+                message.call_type_id, message.call_id, message.service,
+                message.method, dumps([f"{args[0]}+token"]), message.headers,
+            )
+        await nxt(message)
+
+    server_hub.add_service("gated", GatedService())
+    client_hub.outbound_middlewares.append(rewrite_out)
+    transport = RpcTestTransport(client_hub, server_hub)
+    try:
+        proxy = client_hub.client("gated", "default")
+        fut = asyncio.ensure_future(proxy.gated("hello"))
+        await asyncio.sleep(0.05)  # delivered (rewritten), parked on the gate
+
+        await transport.disconnect()  # force a reconnect + re-send
+        await asyncio.sleep(0.2)
+
+        gate.set()
+        assert await asyncio.wait_for(fut, 5.0) == "got:hello+token"
+        # both the original send AND the redelivery carried the rewrite
+        assert seen_args == ["hello+token"]
+        assert transport.connect_count["default"] >= 2
+    finally:
+        await _shutdown(client_hub, server_hub)
