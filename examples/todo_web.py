@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""TodoApp, browser edition — the reference's Blazor TodoApp UI analogue
+(samples/TodoApp/UI over ComputedStateComponent.cs:27-132), served to a REAL
+browser:
+
+- **service host**: TodoService compute methods + the add/toggle command,
+  exposed over a fusion RPC websocket (the backend).
+- **web frontend** (same process, the Blazor-server analogue): a compute
+  CLIENT of the service host; each connected browser gets its own
+  ``TodoListComponent`` (a LiveComponent) whose ComputedState reads through
+  the client proxy — so a server-side invalidation rides
+  ``$sys-c push → client computed invalidated → ComputedState recompute →
+  render()`` and the browser's DOM updates with ZERO polling.
+- **browser side**: one ``<script>`` of vanilla JS — a websocket that swaps
+  ``innerHTML`` on every pushed render, and ``fetch()`` POSTs to the HTTP
+  gateway for commands. No framework, nothing to build.
+
+Run: ``python examples/todo_web.py`` then open the printed URL.
+``--check`` runs the same flow headlessly (a websocket client instead of a
+browser) and asserts that a pushed invalidation changes the rendered HTML.
+"""
+import asyncio
+import dataclasses
+import html
+import json
+import os
+import re
+import sys
+from typing import Dict, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stl_fusion_tpu.client import compute_client, install_compute_call_type
+from stl_fusion_tpu.commands import command_handler
+from stl_fusion_tpu.core import ComputeService, FusionHub, compute_method, is_invalidating
+from stl_fusion_tpu.rpc import RpcHub
+from stl_fusion_tpu.rpc.http_gateway import FusionHttpServer, RestClient
+from stl_fusion_tpu.rpc.websocket import RpcWebSocketServer, websocket_client_connector
+from stl_fusion_tpu.ui import HtmlComponent, LiveViewServer
+from stl_fusion_tpu.utils.serialization import wire_type
+
+
+@wire_type
+@dataclasses.dataclass(frozen=True)
+class AddOrUpdateTodo:
+    id: str
+    title: str
+    done: bool = False
+
+
+TODOS: Dict[str, dict] = {}
+
+
+class TodoService(ComputeService):
+    @compute_method
+    async def get(self, todo_id: str) -> Optional[dict]:
+        return TODOS.get(todo_id)
+
+    @compute_method
+    async def list_ids(self) -> tuple:
+        return tuple(sorted(TODOS))
+
+    @compute_method
+    async def summary(self) -> str:
+        ids = await self.list_ids()
+        done = sum(1 for t in [await self.get(i) for i in ids] if t and t["done"])
+        return f"{done}/{len(ids)} done"
+
+    @command_handler
+    async def add_or_update(self, command: AddOrUpdateTodo):
+        if is_invalidating():
+            await self.get(command.id)
+            await self.list_ids()
+            return
+        TODOS[command.id] = {"id": command.id, "title": command.title, "done": command.done}
+
+
+class TodoApi:
+    """Browser-facing command surface on the HTTP gateway: plain JSON args
+    in, commands through the commander (≈ the TodoApp MVC controllers)."""
+
+    def __init__(self, commander, todos: TodoService):
+        self.commander = commander
+        self.todos = todos
+
+    async def add(self, tid: str, title: str) -> str:
+        # ids land inside an onclick JS string — only safe characters pass
+        if not re.fullmatch(r"[A-Za-z0-9_-]{1,32}", tid):
+            raise ValueError("todo id must be 1-32 chars of [A-Za-z0-9_-]")
+        await self.commander.call(AddOrUpdateTodo(tid, title, False))
+        return "ok"
+
+    async def toggle(self, tid: str) -> str:
+        todo = TODOS.get(tid)
+        if todo is not None:
+            await self.commander.call(
+                AddOrUpdateTodo(tid, todo["title"], not todo["done"])
+            )
+        return "ok"
+
+
+class TodoListComponent(HtmlComponent):
+    """≈ TodoApp's TodoPage: reactive reads THROUGH THE COMPUTE CLIENT, so
+    this component works identically when the service host is a remote
+    process."""
+
+    def __init__(self, push, todos_proxy, **kwargs):
+        super().__init__(push, **kwargs)
+        self.todos = todos_proxy
+
+    async def compute_state(self) -> dict:
+        ids = await self.todos.list_ids()
+        items = [await self.todos.get(i) for i in ids]
+        return {"summary": await self.todos.summary(), "items": items}
+
+    def to_html(self, value: dict) -> str:
+        rows = "".join(
+            f'<li class="{"done" if t["done"] else ""}" '
+            f'onclick="toggle(\'{html.escape(t["id"], quote=True)}\')">'
+            f'{html.escape(t["title"])}</li>'
+            for t in value["items"] if t
+        )
+        return f'<p id="summary">{value["summary"]}</p><ul>{rows}</ul>'
+
+
+PAGE = """<!doctype html>
+<html><head><title>Fusion TPU — live todos</title><style>
+body {{ font: 16px system-ui; max-width: 480px; margin: 3em auto; }}
+li {{ cursor: pointer; padding: 2px 0; }} li.done {{ text-decoration: line-through; opacity: .5; }}
+input {{ font: inherit; padding: 4px; width: 70%; }}
+</style></head><body>
+<h2>Live todos</h2>
+<input id="title" placeholder="what needs doing?">
+<button onclick="addTodo()">add</button>
+<div id="view"><em>connecting…</em></div>
+<script>
+const ws = new WebSocket("{live_url}");
+ws.onmessage = e => {{ document.getElementById("view").innerHTML = JSON.parse(e.data).html; }};
+async function addTodo() {{
+  const el = document.getElementById("title");
+  if (!el.value) return;
+  const id = Math.random().toString(36).slice(2, 10);
+  await fetch("/fusion/api/add", {{method: "POST", body: JSON.stringify([id, el.value])}});
+  el.value = "";
+}}
+async function toggle(id) {{
+  await fetch("/fusion/api/toggle", {{method: "POST", body: JSON.stringify([id])}});
+}}
+</script></body></html>
+"""
+
+
+async def start_app():
+    """Boot the whole stack; returns (http_server, live_server, stop)."""
+    # --- service host -------------------------------------------------
+    fusion = FusionHub()
+    todos = TodoService(fusion)
+    fusion.add_service(todos)
+    fusion.commander.add_service(todos)
+    # the operations pipeline runs each completed command's invalidation
+    # replay — without it add_or_update would write but never invalidate
+    fusion.commander.attach_operations_pipeline()
+    backend_rpc = RpcHub("todo-backend")
+    install_compute_call_type(backend_rpc)
+    backend_rpc.add_service("todos", todos)
+    backend_ws = await RpcWebSocketServer(backend_rpc).start()
+
+    # --- web frontend: a compute CLIENT of the host -------------------
+    client_rpc = RpcHub("todo-frontend")
+    install_compute_call_type(client_rpc)
+    client_rpc.client_connector = websocket_client_connector(backend_ws.url)
+    client_fusion = FusionHub()
+    todos_proxy = compute_client("todos", client_rpc, client_fusion)
+
+    live = await LiveViewServer(
+        lambda push: TodoListComponent(push, todos_proxy, hub=client_fusion)
+    ).start()
+
+    gateway_rpc = RpcHub("todo-gateway")
+    gateway_rpc.add_service("api", TodoApi(fusion.commander, todos))
+    http = FusionHttpServer(gateway_rpc)
+    await http.start()
+    http.static_routes["/"] = ("text/html", PAGE.format(live_url=live.url))
+
+    async def stop():
+        await live.stop()
+        await http.stop()
+        await client_rpc.stop()
+        await backend_ws.stop()
+        await backend_rpc.stop()
+
+    return http, live, stop
+
+
+async def run_check() -> None:
+    """Headless browser-equivalent: assert a pushed invalidation changes
+    the rendered payload."""
+    from websockets.asyncio.client import connect
+
+    http, live, stop = await start_app()
+    try:
+        async with connect(live.url) as ws:
+            first = json.loads(await asyncio.wait_for(ws.recv(), 5.0))
+            assert "0/0 done" in first["html"], first
+            print("initial render pushed:", first["html"].split("</p>")[0])
+
+            api = RestClient(http.url, "api")
+            assert await api.add.post("t1", "ship the browser sample") == "ok"
+            nxt = json.loads(await asyncio.wait_for(ws.recv(), 5.0))
+            assert "ship the browser sample" in nxt["html"], nxt
+            assert "0/1 done" in nxt["html"]
+            print("after add, push rendered:", nxt["html"].split("</p>")[0])
+
+            assert await api.toggle.post("t1") == "ok"
+            nxt = json.loads(await asyncio.wait_for(ws.recv(), 5.0))
+            assert "1/1 done" in nxt["html"], nxt
+            print("after toggle, push rendered:", nxt["html"].split("</p>")[0])
+        print("browser live view OK: invalidation -> $sys-c push -> "
+              "LiveComponent render -> websocket -> DOM payload")
+    finally:
+        await stop()
+
+
+async def serve_forever() -> None:
+    http, live, stop = await start_app()
+    print(f"live todos at {http.url}/  (live view: {live.url})", flush=True)
+    try:
+        await asyncio.get_running_loop().run_in_executor(None, sys.stdin.read)
+    except KeyboardInterrupt:
+        pass
+    await stop()
+
+
+if __name__ == "__main__":
+    if "--check" in sys.argv:
+        asyncio.run(run_check())
+    else:
+        asyncio.run(serve_forever())

@@ -33,6 +33,10 @@ import os
 import sys
 import time
 
+
+def note(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -87,11 +91,14 @@ async def main() -> None:
         svc = DagService(starts, src_s, hub)
 
         # -------- build the live graph (bottom-up: deps always cached)
+        note(f"building {n}-node live graph through the hub...")
         t0 = time.perf_counter()
         for i in range(n):
             await svc.node(i)
         build_s = time.perf_counter() - t0
+        note(f"built in {build_s:.1f}s; flushing journal to device...")
         backend.flush()
+        note("flushed")
         assert backend.node_count == n, (backend.node_count, n)
 
         # relay RTT floor of this environment (single readback)
@@ -108,7 +115,9 @@ async def main() -> None:
         # seeds: the shape of a typical edit), RTT-inclusive by design
         shallow = [n - 1 - int(i) for i in rng.choice(n // 100, size=lat_waves, replace=False)]
         computeds = [await capture(lambda i=i: svc.node(i)) for i in shallow]
+        note("compiling the collect kernel (first invalidate_cascade)...")
         backend.invalidate_cascade(computeds[0])  # compile the collect kernel
+        note("collect kernel compiled; timing lone waves...")
         lat = []
         for c in computeds[1:]:
             t0 = time.perf_counter()
@@ -122,7 +131,9 @@ async def main() -> None:
         # warm the chained program with no-op waves of the same padded
         # shape (a -1 seed row invalidates nothing) — compile time is not
         # a per-burst cost
-        backend.graph.run_waves_chained([[-1]] * n_waves)
+        note("compiling the union burst program...")
+        backend.graph.run_waves_union([[-1]] * n_waves)
+        note("burst program compiled; running the timed burst...")
         t0 = time.perf_counter()
         total = backend.invalidate_cascade_batch(deep)
         burst_s = time.perf_counter() - t0
@@ -143,10 +154,16 @@ async def main() -> None:
             rng.choice(n, size=max(n // 100, 1), replace=False) for _ in range(32 * words)
         ]
         bits = jnp.asarray(topo_seeds_to_bits(topo, seed_lists, words=words))
-        st, counts = wave32.impl(wave32.garrays, bits, state0)  # compile
+        note("compiling the static topo export...")
+        # the JITTED step (graph arrays as runtime args) — the raw
+        # ``wave32.impl`` executes EAGERLY, which through the axon relay
+        # means one round trip per level slice: minutes at 100K nodes and a
+        # worker OOM at 1M (each eager op materializes a fresh intermediate)
+        st, counts = wave32(bits, state0)  # compile
         int(np.asarray(counts, dtype=np.int64).sum())
+        note("static export compiled; timing...")
         t0 = time.perf_counter()
-        st, counts = wave32.impl(wave32.garrays, bits, state0)
+        st, counts = wave32(bits, state0)
         static_total = int(np.asarray(counts, dtype=np.int64).sum())
         static_s = time.perf_counter() - t0
 

@@ -10,13 +10,18 @@ does a read-modify-write every 10 ms. Three modes:
 - ``fusion``     — the scalar `@compute_method` path (one node per key);
 - ``none``       — no memoization, every read hits sqlite (the reference's
                    "without Stl.Fusion" rows);
-- ``vectorized`` — the TPU-first path (`ops/memo_table.py`): readers draw
-                   random id BATCHES and one jitted device gather serves the
-                   whole batch; stale rows (mutator invalidations) refresh
-                   vectorized from sqlite. Each element read counts as one
-                   op, matching the reference's per-read accounting.
+- ``vectorized`` — the TPU-first path through the PUBLIC service API: the
+                   service declares ``@compute_method(table=TableBacking)``
+                   and readers call ``memo_table_of(users.get).read_batch``;
+                   the mutator is the ordinary scalar command path, whose
+                   ``invalidating()`` replay transparently marks table rows
+                   stale. Each element read counts as one op, matching the
+                   reference's per-read accounting.
 
-Run: python perf/read_throughput.py [--quick]
+Run: python perf/read_throughput.py [--quick] [--workers N]
+``--workers N`` additionally runs the scalar bench as N OS processes
+sharing the sqlite DAL — the thread-parity comparison to the reference's
+multi-threaded runs (one asyncio loop ≈ one thread).
 Prints one line per mode + a JSON summary; committed numbers live in PERF.md.
 """
 import argparse
@@ -33,7 +38,14 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from stl_fusion_tpu.core import ComputeService, FusionHub, compute_method, invalidating
+from stl_fusion_tpu.core import (
+    ComputeService,
+    FusionHub,
+    TableBacking,
+    compute_method,
+    invalidating,
+    memo_table_of,
+)
 
 USER_COUNT = 1000
 
@@ -77,13 +89,19 @@ class UserDal:
 
 
 class FusionUserService(ComputeService):
-    """≈ UserService with [ComputeMethod] Get (the "with Stl.Fusion" rows)."""
+    """≈ UserService with [ComputeMethod] Get (the "with Stl.Fusion" rows).
+    The ``table=`` backing adds the columnar read path WITHOUT changing the
+    service's API: scalar gets keep per-key nodes, bulk reads ride
+    ``memo_table_of(svc.get).read_batch`` refreshed through ``get_rows``."""
 
     def __init__(self, dal: UserDal, hub=None):
         super().__init__(hub)
         self.dal = dal
 
-    @compute_method
+    def get_rows(self, ids: np.ndarray) -> np.ndarray:
+        return self.dal.get_many(ids)
+
+    @compute_method(table=TableBacking(rows=USER_COUNT, batch="get_rows", row_shape=(2,)))
     async def get(self, uid: int):
         return self.dal.get(uid)
 
@@ -148,12 +166,18 @@ async def run_scalar(service, readers: int, iterations: int, mutate: bool):
     return readers * iterations, elapsed
 
 
-async def run_vectorized(dal: UserDal, readers: int, iterations: int, batch: int, mutate: bool):
-    """Same workload, columnar: each reader iteration reads a random id
-    BATCH via one device gather; the mutator invalidates single rows."""
-    from stl_fusion_tpu.ops import MemoTable
+async def run_vectorized(service: FusionUserService, readers: int, iterations: int,
+                         batch: int, mutate: bool, device_ids: bool = False):
+    """Same workload, columnar — ALL through the public service API: bulk
+    reads via the table behind ``@compute_method(table=...)``; the mutator
+    is the ordinary scalar write path, whose ``invalidating()`` replay
+    transparently marks the stale table row.
 
-    table = MemoTable(USER_COUNT, dal.get_many, row_shape=(2,))
+    ``device_ids=True`` is the TPU-native reader shape: id batches are
+    drawn ON DEVICE (jax PRNG) and never cross the host boundary, so the
+    read loop is pure async dispatch (host-id batches pay a ~1 MB relay
+    upload per call in this environment — transfer-bound, not read-bound)."""
+    table = memo_table_of(service.get)
     table.read_batch(np.arange(USER_COUNT))  # warm table + compile
     stop = asyncio.Event()
 
@@ -163,14 +187,13 @@ async def run_vectorized(dal: UserDal, readers: int, iterations: int, batch: int
         while not stop.is_set():
             uid = rnd.randrange(USER_COUNT)
             count += 1
-            dal.update_email(uid, f"{count}@counter.org")
-            table.invalidate([uid])
+            await service.update_email(uid, f"{count}@counter.org")
             try:
                 await asyncio.wait_for(stop.wait(), 0.01)
             except asyncio.TimeoutError:
                 pass
 
-    async def reader(n: int) -> int:
+    async def reader_host(n: int) -> int:
         rng = np.random.default_rng(n)
         ok = 0
         for i in range(iterations):
@@ -181,6 +204,25 @@ async def run_vectorized(dal: UserDal, readers: int, iterations: int, batch: int
                 await asyncio.sleep(0)  # yield so the mutator runs
         return ok
 
+    async def reader_device(n: int) -> int:
+        import jax
+        import jax.numpy as jnp
+
+        draw = jax.jit(
+            lambda key: jax.random.randint(key, (batch,), 0, USER_COUNT, dtype=jnp.int32)
+        )
+        key = jax.random.PRNGKey(n)
+        keys = jax.random.split(key, iterations)
+        ok = 0
+        for i in range(iterations):
+            ids = draw(keys[i])          # device-resident batch
+            out = table.read_batch(ids)  # public API, pure dispatch
+            ok += out.shape[0]
+            if i % 8 == 0:
+                await asyncio.sleep(0)  # yield so the mutator runs
+        return ok
+
+    reader = reader_device if device_ids else reader_host
     await reader(100)  # warmup
     mut = asyncio.ensure_future(mutator()) if mutate else None
     t0 = time.perf_counter()
@@ -224,10 +266,55 @@ def run_device_chained(table, n_chained: int, batch: int):
     return n_chained * batch, elapsed
 
 
+async def run_scalar_worker(path: str, iterations: int, seed: int) -> None:
+    """One OS-process worker of the multi-process scalar run: its own hub,
+    its own memo cache, 4 readers + 1 mutator over the SHARED sqlite file —
+    process-parity with one of the reference's reader threads."""
+    random.seed(seed)
+    hub = FusionHub()
+    dal = UserDal(path)
+    service = FusionUserService(dal, hub)
+    ops, dt = await run_scalar(service, readers=4, iterations=iterations, mutate=True)
+    print(json.dumps({"ops": ops, "elapsed": dt, "db_reads": dal.reads}))
+
+
+def run_multi_worker_scalar(path: str, workers: int, iterations: int):
+    """Spawn N scalar workers as OS processes against one sqlite DAL (the
+    fair thread-parity shape: one asyncio loop ≈ one reference thread).
+    Throughput = total ops / the SLOWEST worker's own measured loop time —
+    interpreter startup, imports, and finish skew are not benchmark work."""
+    import subprocess
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--scalar-worker", path,
+             str(iterations), str(w)],
+            stdout=subprocess.PIPE, text=True,
+        )
+        for w in range(workers)
+    ]
+    total_ops, slowest = 0, 0.0
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        assert p.returncode == 0
+        stats = json.loads(out.strip().splitlines()[-1])
+        total_ops += stats["ops"]
+        slowest = max(slowest, stats["elapsed"])
+    return total_ops, slowest
+
+
 async def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true", help="~10x fewer iterations")
+    parser.add_argument("--workers", type=int, default=0,
+                        help="also run the scalar bench as N OS processes")
+    parser.add_argument("--scalar-worker", nargs=3, metavar=("PATH", "ITERS", "SEED"),
+                        help="internal: one multi-process scalar worker")
     args = parser.parse_args()
+    if args.scalar_worker:
+        path, iters, seed = args.scalar_worker
+        await run_scalar_worker(path, int(iters), int(seed))
+        return
     scale = 10 if args.quick else 1
 
     path = os.path.join(tempfile.mkdtemp(), "perf-users.sqlite")
@@ -241,6 +328,11 @@ async def main() -> None:
     results["fusion_scalar"] = ops / dt
     print(f"fusion (scalar):        {ops / dt / 1e3:12,.1f} K ops/sec  ({ops} ops, {dt:.2f}s, {dal.reads} DB reads)")
 
+    if args.workers:
+        ops, dt = run_multi_worker_scalar(path, args.workers, 250_000 // scale)
+        results["fusion_scalar_multiworker"] = ops / dt
+        print(f"fusion (scalar, {args.workers} procs): {ops / dt / 1e3:10,.1f} K ops/sec  ({ops} ops, {dt:.2f}s slowest worker loop)")
+
     dal2 = UserDal(path)
     plain_users = PlainUserService(dal2)
     ops, dt = await run_scalar(plain_users, readers=4, iterations=20_000 // scale, mutate=True)
@@ -248,15 +340,23 @@ async def main() -> None:
     print(f"without fusion:         {ops / dt / 1e3:12,.1f} K ops/sec  ({ops} ops, {dt:.2f}s)")
 
     dal3 = UserDal(path)
-    from stl_fusion_tpu.ops import MemoTable
-
+    vec_users = FusionUserService(dal3, FusionHub())
     ops, dt = await run_vectorized(
-        dal3, readers=4, iterations=250 // scale, batch=262_144 // scale, mutate=True
+        vec_users, readers=4, iterations=100 // scale, batch=262_144 // scale, mutate=True
     )
     results["fusion_vectorized"] = ops / dt
     print(f"fusion (vectorized):    {ops / dt / 1e3:12,.1f} K ops/sec  ({ops} ops, {dt:.2f}s, {dal3.reads} DB reads)")
 
-    table = MemoTable(USER_COUNT, dal3.get_many, row_shape=(2,))
+    dal4 = UserDal(path)
+    dev_users = FusionUserService(dal4, FusionHub())
+    ops, dt = await run_vectorized(
+        dev_users, readers=4, iterations=64 // scale, batch=1_048_576 // scale,
+        mutate=True, device_ids=True,
+    )
+    results["fusion_vectorized_device_ids"] = ops / dt
+    print(f"fusion (vec, dev ids):  {ops / dt / 1e3:12,.1f} K ops/sec  ({ops} ops, {dt:.2f}s, {dal4.reads} DB reads)")
+
+    table = memo_table_of(vec_users.get)
     table.read_batch(np.arange(USER_COUNT))
     ops, dt = run_device_chained(table, n_chained=64, batch=1_048_576 // scale)
     results["fusion_device_chained"] = ops / dt

@@ -24,7 +24,14 @@ from .inputs import ComputedInput, ComputeMethodInput
 from .options import ComputedOptions
 from .pruner import ComputedGraphPruner
 from .registry import ComputedRegistry
-from .service import ComputeMethodDef, ComputeService, compute_method, hub_of
+from .service import (
+    ComputeMethodDef,
+    ComputeService,
+    TableBacking,
+    compute_method,
+    hub_of,
+    memo_table_of,
+)
 from .timeouts import Timeouts
 
 __all__ = [
@@ -53,7 +60,9 @@ __all__ = [
     "ComputedRegistry",
     "ComputeMethodDef",
     "ComputeService",
+    "TableBacking",
     "compute_method",
+    "memo_table_of",
     "hub_of",
     "Timeouts",
 ]

@@ -181,6 +181,28 @@ class ComputeMethodFunction(FunctionBase):
         super().__init__(hub, method_def.options)
         self.method_def = method_def
 
+    def create_computed(self, input, version):
+        computed = super().create_computed(input, version)
+        method_def = self.method_def
+        if method_def.table is not None:
+            args = getattr(input, "args", ())
+            if len(args) == 1 and isinstance(args[0], int):
+                # scalar → table coherence rides the NODE, so every
+                # invalidation path (invalidating() replay, dependency
+                # cascade, timed/auto invalidation) marks the columnar row
+                # stale — not just explicit replays. The table's own
+                # handler finds this node already invalid, so no cycle.
+                key = args[0]
+                service = input.service
+
+                def mark_row_stale(_node) -> None:
+                    table = method_def.peek_table(service)
+                    if table is not None:
+                        table.invalidate([key])
+
+                computed.on_invalidated(mark_row_stale)
+        return computed
+
     async def produce_value(self, input, computed):
         return await input.invoke_original()
 

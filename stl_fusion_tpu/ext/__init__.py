@@ -4,11 +4,13 @@ from .auth import (
     EditUserCommand,
     InMemoryAuthService,
     SessionInfo,
+    SetupSessionCommand,
     SignInCommand,
     SignOutCommand,
     SqliteAuthService,
     User,
 )
+from .server_auth import Principal, ServerAuthHelper, principal_from_headers
 from .fusion_time import FusionTime
 from .kv_store import (
     KeyValueStore,
@@ -40,10 +42,14 @@ from .streams import (
 __all__ = [
     "EditUserCommand",
     "InMemoryAuthService",
+    "Principal",
+    "ServerAuthHelper",
     "SessionInfo",
+    "SetupSessionCommand",
     "SignInCommand",
     "SignOutCommand",
     "User",
+    "principal_from_headers",
     "FusionTime",
     "KeyValueStore",
     "RemoveCommand",

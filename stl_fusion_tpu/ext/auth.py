@@ -22,6 +22,7 @@ from .session import Session
 __all__ = [
     "User",
     "SessionInfo",
+    "SetupSessionCommand",
     "SignInCommand",
     "SignOutCommand",
     "EditUserCommand",
@@ -50,6 +51,11 @@ class SessionInfo:
     user_id: str = ""
     created_at: float = 0.0
     last_seen_at: float = 0.0
+    # where the session lives (≈ SessionInfo.IPAddress/UserAgent): set by
+    # SetupSession from the transport, drives ServerAuthHelper's
+    # "must re-setup" check when a session moves networks/browsers
+    ip_address: str = ""
+    user_agent: str = ""
     # forced sign-out is a flag ON the session row, exactly like the
     # reference (DbSessionInfo.IsSignOutForced): the row survives sign-out,
     # sign-in throws while it's set, sign-out no-ops while it's set
@@ -59,6 +65,18 @@ class SessionInfo:
     @property
     def is_authenticated(self) -> bool:
         return bool(self.user_id)
+
+
+@wire_type("SetupSession")
+@dataclasses.dataclass(frozen=True)
+class SetupSessionCommand:
+    """Create/refresh the session row with transport facts
+    (≈ AuthBackend_SetupSession). Empty ip/user_agent mean "keep current"
+    — which is how presence updates ride the same command."""
+
+    session: Session
+    ip_address: str = ""
+    user_agent: str = ""
 
 
 @wire_type("SignIn")
@@ -105,6 +123,10 @@ class InMemoryAuthService(ComputeService):
         super().__init__(hub)
         self._sessions: Dict[str, SessionInfo] = {}
         self._users: Dict[str, User] = {}
+        #: injectable timestamps (≈ MomentClockSet): ServerAuthHelper's
+        #: staleness checks and this service's last_seen stamps must share
+        #: one clock, or tests with a fake clock diverge from reality
+        self.clock = time.time
 
     # ---------------------------------------------------------- storage hooks
     def _load_session(self, session_id: str) -> Optional[SessionInfo]:
@@ -147,12 +169,35 @@ class InMemoryAuthService(ComputeService):
 
     # ------------------------------------------------------------------ commands
     @command_handler
+    async def setup_session(self, command: SetupSessionCommand):
+        """Create or refresh the session row with transport facts
+        (≈ AuthBackend_SetupSession in DbAuthService.Backend.cs): user
+        binding and the forced flag are preserved; empty ip/agent keep the
+        stored values (the presence-update shape)."""
+        if is_invalidating():
+            await self._invalidate_session(command.session)
+            return
+        now = self.clock()
+        existing = self._load_session(command.session.id)
+        base = existing if existing is not None else SessionInfo(
+            command.session.id, created_at=now
+        )
+        self._store_session(
+            dataclasses.replace(
+                base,
+                last_seen_at=now,
+                ip_address=command.ip_address or base.ip_address,
+                user_agent=command.user_agent or base.user_agent,
+            )
+        )
+
+    @command_handler
     async def sign_in(self, command: SignInCommand):
         if is_invalidating():
             await self._invalidate_session(command.session)
             await self.get_user_sessions(command.user.id)
             return
-        now = time.time()
+        now = self.clock()
         existing = self._load_session(command.session.id)
         if existing is not None and existing.is_sign_out_forced:
             # a force-signed-out session is permanently unavailable
@@ -163,13 +208,11 @@ class InMemoryAuthService(ComputeService):
             # changes too — capture their id for the replay
             self._capture_user_sessions_invalidation(existing.user_id)
         self._store_user(command.user)
+        base = existing if existing is not None else SessionInfo(
+            command.session.id, created_at=now
+        )
         self._store_session(
-            SessionInfo(
-                session_id=command.session.id,
-                user_id=command.user.id,
-                created_at=existing.created_at if existing is not None else now,
-                last_seen_at=now,
-            )
+            dataclasses.replace(base, user_id=command.user.id, last_seen_at=now)
         )
 
     @command_handler
@@ -186,7 +229,7 @@ class InMemoryAuthService(ComputeService):
             # SignOut invalidating GetUserSessions via the operation-captured
             # SessionInfo (DbAuthService.cs:54-58)
             self._capture_user_sessions_invalidation(info.user_id)
-        now = time.time()
+        now = self.clock()
         base = info if info is not None else SessionInfo(command.session.id, created_at=now)
         self._store_session(
             dataclasses.replace(
@@ -244,31 +287,45 @@ class SqliteAuthService(InMemoryAuthService):
             " id TEXT PRIMARY KEY, name TEXT, claims TEXT);"
             "CREATE TABLE IF NOT EXISTS auth_sessions ("
             " session_id TEXT PRIMARY KEY, user_id TEXT,"
-            " created_at REAL, last_seen_at REAL, is_sign_out_forced INTEGER);"
+            " created_at REAL, last_seen_at REAL, is_sign_out_forced INTEGER,"
+            " ip_address TEXT DEFAULT '', user_agent TEXT DEFAULT '');"
         )
+        # migrate pre-r2 databases lacking the transport columns
+        cols = {r[1] for r in self._db.execute("PRAGMA table_info(auth_sessions)")}
+        for col in ("ip_address", "user_agent"):
+            if col not in cols:
+                self._db.execute(
+                    f"ALTER TABLE auth_sessions ADD COLUMN {col} TEXT DEFAULT ''"
+                )
         self._db.commit()
 
     def _load_session(self, session_id: str) -> Optional[SessionInfo]:
         row = self._db.execute(
-            "SELECT session_id, user_id, created_at, last_seen_at, is_sign_out_forced"
+            "SELECT session_id, user_id, created_at, last_seen_at, is_sign_out_forced,"
+            " ip_address, user_agent"
             " FROM auth_sessions WHERE session_id=?",
             (session_id,),
         ).fetchone()
         if row is None:
             return None
-        return SessionInfo(row[0], row[1], row[2], row[3], bool(row[4]))
+        return SessionInfo(
+            row[0], row[1], row[2], row[3],
+            is_sign_out_forced=bool(row[4]), ip_address=row[5], user_agent=row[6],
+        )
 
     def _store_session(self, info: SessionInfo) -> None:
         # full-row upsert in ONE statement: the session row (incl. the
         # forced flag) can never be torn by a crash between writes
         self._db.execute(
-            "INSERT OR REPLACE INTO auth_sessions VALUES (?,?,?,?,?)",
+            "INSERT OR REPLACE INTO auth_sessions VALUES (?,?,?,?,?,?,?)",
             (
                 info.session_id,
                 info.user_id,
                 info.created_at,
                 info.last_seen_at,
                 int(info.is_sign_out_forced),
+                info.ip_address,
+                info.user_agent,
             ),
         )
         self._db.commit()

@@ -197,8 +197,10 @@ class TpuGraphBackend:
     def invalidate_cascade_batch(self, computeds: Sequence["Computed"]) -> int:
         """Cascade MANY seed invalidations in one device dispatch + one
         readback (the burst shape: a batch of commands completing together).
-        Each seed's wave runs over the state the previous left — exactly the
-        sequential semantics, minus W-1 relay round trips. Returns the total
+        All seeds expand in ONE union BFS — identical final state to
+        running them sequentially (invalidation is idempotent, and the host
+        applies only the union of newly-invalid nodes), at O(edges × depth)
+        instead of O(edges × depth × batch). Returns the total
         newly-invalidated count."""
         self.flush()
         seeds: List[List[int]] = []
@@ -212,10 +214,9 @@ class TpuGraphBackend:
                 seeds.append([nid])
         if not seeds:
             return fallback
-        counts, newly_ids = self.graph.run_waves_chained(seeds)
+        total, newly_ids = self.graph.run_waves_union(seeds)
         self._apply_newly(newly_ids)
         self.waves_run += len(seeds)
-        total = int(counts.sum())
         self.device_invalidations += total
         return total + fallback
 

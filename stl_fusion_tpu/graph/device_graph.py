@@ -25,6 +25,7 @@ from ..ops.wave import (
     run_wave_collect,
     run_wave_with_stats,
     run_waves_chained,
+    run_waves_union,
     seeds_to_frontier,
 )
 
@@ -226,6 +227,25 @@ class DeviceGraph:
             counts[:n_real_waves].astype(np.int64),
             np.nonzero(newly)[0].astype(np.int32),
         )
+
+    def run_waves_union(self, seed_id_lists: Sequence[Sequence[int]]):
+        """Union cascade for a burst of seed waves: ONE BFS expansion from
+        all seeds together (the live batch path applies only the union, and
+        invalidation is idempotent — see ops/wave.py::run_waves_union).
+        Returns (total newly count, union newly ids). Seed count is padded
+        to a power of two so varying burst sizes reuse one program."""
+        import jax
+
+        jnp = self._jnp
+        g = self.device_arrays()
+        flat = [int(i) for s in seed_id_lists for i in s]
+        width = _round_up_pow2(max(len(flat), 1))
+        ids = np.full(width, -1, dtype=np.int32)
+        ids[: len(flat)] = np.asarray(flat, dtype=np.int32)
+        self._g, count, newly = run_waves_union(jnp.asarray(ids), g)
+        count, newly = jax.device_get((count, newly))
+        self._h_invalid |= newly
+        return int(count), np.nonzero(newly)[0].astype(np.int32)
 
     def run_wave_frontier(self, seed_frontier, sync_host: bool = False) -> int:
         """Wave from a prebuilt boolean frontier (bench hot path — host copy

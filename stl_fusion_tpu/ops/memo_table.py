@@ -50,8 +50,10 @@ class MemoTable:
         dtype=None,
         eager: bool = False,
     ):
+        import jax
         import jax.numpy as jnp
 
+        self._jax = jax
         self._jnp = jnp
         self.n_rows = int(n_rows)
         self.compute_fn = compute_fn
@@ -62,6 +64,7 @@ class MemoTable:
         # unpacked bool row mask (scatter of 0/1 is duplicate-safe, unlike a
         # packed-word RMW which loses bits when two ids share a word)
         self._stale_host = np.ones(self.n_rows, dtype=bool)
+        self._stale_count = self.n_rows  # exact count, O(batch) to maintain
         self._valid_dev = jnp.zeros(self.n_rows, dtype=jnp.bool_)
         self._packed_cache: Optional[tuple] = None  # (version, packed bits)
         self.on_invalidate: List[Callable[[np.ndarray], None]] = []
@@ -73,7 +76,24 @@ class MemoTable:
     # ------------------------------------------------------------------ reads
     def read_batch(self, ids: Ids):
         """Values for ``ids`` (device array [k, ...]); refreshes stale rows
-        first. The all-fresh fast path is one gather — no host↔device sync."""
+        first. The all-fresh fast path is one gather — no host↔device sync.
+
+        ``ids`` may be a DEVICE array (jax): then the batch never crosses
+        the host boundary — instead of gathering per-id staleness on the
+        host (which would force a device→host readback), the ENTIRE current
+        stale set (host-known, typically a handful of mutator-invalidated
+        rows) is refreshed before the gather. Correct for any stale-set
+        size, and the right trade when invalidations are sparse: the hot
+        read loop stays pure async device dispatch, which is what lets
+        batched reads pipeline at the kernel rate instead of the
+        host-transfer rate."""
+        if isinstance(ids, self._jax.Array):
+            # device-resident ids (positive detection — every other
+            # sequence type keeps the original np.asarray host contract):
+            # refresh-all-stale, then one pure gather
+            if self._stale_count:
+                self.refresh(np.nonzero(self._stale_host)[0])
+            return self._jit_cache["gather"](self._values, ids)
         ids_np = np.asarray(ids, dtype=np.int32)
         stale = self._stale_host[ids_np]
         if stale.any():
@@ -99,22 +119,26 @@ class MemoTable:
 
     # ------------------------------------------------------------------ writes
     def refresh(self, ids: Ids) -> None:
-        """Vectorized recompute + scatter for ``ids`` (marks them fresh)."""
-        ids_np = np.asarray(ids, dtype=np.int32)
+        """Vectorized recompute + scatter for ``ids`` (marks them fresh).
+        Ids are deduped: compute_fn sees each row once."""
+        ids_np = np.unique(np.asarray(ids, dtype=np.int32))
         if ids_np.size == 0:
             return
         rows = self.compute_fn(ids_np)
         jids = self._jnp.asarray(ids_np)
         self._values = self._jit_cache["scatter"](self._values, jids, self._jnp.asarray(rows))
         self._valid_dev = self._jit_cache["set_mask"](self._valid_dev, jids, True)
+        self._stale_count -= int(np.count_nonzero(self._stale_host[ids_np]))
         self._stale_host[ids_np] = False
         self._bump()
 
     def invalidate(self, ids: Ids) -> None:
-        """Mark rows stale; notifies subscribers (the cascade entry point)."""
-        ids_np = np.asarray(ids, dtype=np.int32)
+        """Mark rows stale; notifies subscribers (the cascade entry point).
+        Ids are deduped: on_invalidate handlers see each row once."""
+        ids_np = np.unique(np.asarray(ids, dtype=np.int32))
         if ids_np.size == 0:
             return
+        self._stale_count += int(np.count_nonzero(~self._stale_host[ids_np]))
         self._stale_host[ids_np] = True
         self._valid_dev = self._jit_cache["set_mask"](
             self._valid_dev, self._jnp.asarray(ids_np), False
@@ -125,6 +149,7 @@ class MemoTable:
 
     def invalidate_all(self) -> None:
         self._stale_host[:] = True
+        self._stale_count = self.n_rows
         self._valid_dev = self._jnp.zeros_like(self._valid_dev)
         self._bump()
         if self.on_invalidate:
@@ -138,7 +163,7 @@ class MemoTable:
 
     # ------------------------------------------------------------------ misc
     def stale_count(self) -> int:
-        return int(self._stale_host.sum())
+        return self._stale_count
 
     def __repr__(self) -> str:
         return f"MemoTable({self.n_rows} rows, {self.stale_count()} stale, v{self.version})"

@@ -47,6 +47,7 @@ __all__ = [
     "run_wave_collect",
     "run_wave_with_stats",
     "run_waves_chained",
+    "run_waves_union",
     "seeds_to_frontier",
 ]
 
@@ -173,6 +174,27 @@ def run_waves_chained(
 
     g, counts = lax.scan(body, g, seed_ids_mat)
     return g, counts, g.invalid & ~inv_before
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def run_waves_union(
+    seed_ids: jax.Array, g: GraphArrays
+) -> Tuple[GraphArrays, jax.Array, jax.Array]:
+    """Union cascade: ALL seeds (int32[...], -1-padded) expand in ONE BFS.
+
+    Invalidation is idempotent and the live batch path applies only the
+    UNION of newly-invalid nodes (graph/backend.py::invalidate_cascade_batch
+    reads counts.sum() + the union mask) — so chaining W sequential waves
+    (O(edges × depth × W), which at 1M nodes × 64 waves ran long enough to
+    get the TPU worker killed mid-program) collapses to one expansion,
+    O(edges × depth) total. Returns (g, newly count, union newly mask).
+    """
+    inv_before = g.invalid
+    frontier = seeds_to_frontier(g.n_cap, seed_ids.reshape(-1))
+    fresh = frontier & ~g.invalid
+    g = g._replace(invalid=g.invalid | fresh)
+    g, count = _expand_to_fixpoint(fresh, g)
+    return g, count, g.invalid & ~inv_before
 
 
 @functools.partial(jax.jit, donate_argnums=(1,))

@@ -101,6 +101,14 @@ class FusionHttpServer:
         self.host = host
         self.port = port
         self.session_middleware = session_middleware
+        #: optional ext.server_auth.ServerAuthHelper: when set (requires
+        #: session_middleware), every request reconciles the transport's
+        #: principal (trusted proxy headers) with the fusion session's user
+        #: (≈ ServerAuthHelper.UpdateAuthState called from the host filter)
+        self.auth_helper = None
+        #: path → (content_type, body): static pages served next to the
+        #: JSON API (the sample-UI host path, ≈ MapBlazorHub + index.html)
+        self.static_routes: dict = {}
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> "FusionHttpServer":
@@ -126,19 +134,34 @@ class FusionHttpServer:
             method, target, _version = request_line.split(" ", 2)
             content_length = 0
             cookie_header = ""
+            headers: dict = {}
             while True:
                 line = (await reader.readline()).decode("latin1").strip()
                 if not line:
                     break
                 name, _, value = line.partition(":")
                 lname = name.lower()
+                headers[lname] = value.strip()
                 if lname == "content-length":
                     content_length = int(value.strip())
                 elif lname == "cookie":
                     cookie_header = value.strip()
             body = await reader.readexactly(content_length) if content_length else b""
+            peer = writer.get_extra_info("peername")
+            headers["_ip"] = peer[0] if peer else ""
+            static = self.static_routes.get(urllib.parse.urlsplit(target).path)
+            if static is not None and method == "GET":
+                ctype, content = static
+                raw = content.encode() if isinstance(content, str) else content
+                writer.write(
+                    f"HTTP/1.1 200 OK\r\nContent-Type: {ctype}\r\n"
+                    f"Content-Length: {len(raw)}\r\nConnection: close\r\n\r\n".encode()
+                    + raw
+                )
+                await writer.drain()
+                return
             status, payload, extra_headers = await self._dispatch(
-                method, target, body, cookie_header
+                method, target, body, cookie_header, headers
             )
             try:
                 data = json.dumps(payload).encode()
@@ -162,7 +185,12 @@ class FusionHttpServer:
             writer.close()
 
     async def _dispatch(
-        self, http_method: str, target: str, body: bytes, cookie_header: str = ""
+        self,
+        http_method: str,
+        target: str,
+        body: bytes,
+        cookie_header: str = "",
+        headers: Optional[dict] = None,
     ) -> Tuple[str, Any, list]:
         parsed = urllib.parse.urlsplit(target)
         not_found = ("404 Not Found", {"error": {"type": "NotFound", "message": parsed.path}}, [])
@@ -201,6 +229,18 @@ class FusionHttpServer:
                 if set_cookie is not None:
                     extra_headers.append(("Set-Cookie", set_cookie))
                 args = mw.replace_default_sessions(args, session)
+                if self.auth_helper is not None:
+                    # ≈ ServerAuthHelper.UpdateAuthState per request: sync
+                    # the transport principal into the fusion session
+                    from ..ext.server_auth import principal_from_headers
+
+                    h = headers or {}
+                    await self.auth_helper.update_auth_state(
+                        session,
+                        principal_from_headers(h),
+                        ip_address=h.get("_ip", ""),
+                        user_agent=h.get("user-agent", ""),
+                    )
             result = await self.rpc_hub.service_registry.invoke(service, method, args)
             return "200 OK", {"ok": encode(result)}, extra_headers
         except LookupError as e:
@@ -231,12 +271,14 @@ class RestClient:
     results use the wire-type encoding; a cookie jar carries the gateway's
     session cookie across calls (≈ a browser talking to SessionMiddleware)."""
 
-    def __init__(self, base_url: str, service: str):
+    def __init__(self, base_url: str, service: str, headers: Optional[Dict[str, str]] = None):
         parsed = urllib.parse.urlsplit(base_url)
         self.host = parsed.hostname
         self.port = parsed.port or 80
         self.service = service
         self.cookies: Dict[str, str] = {}
+        #: extra headers on every request (e.g. trusted-proxy auth headers)
+        self.headers: Dict[str, str] = dict(headers or {})
 
     def __getattr__(self, method: str) -> _RestMethod:
         if method.startswith("_"):
@@ -256,6 +298,7 @@ class RestClient:
             if self.cookies
             else ""
         )
+        cookie_line += "".join(f"{k}: {v}\r\n" for k, v in self.headers.items())
         try:
             reader, writer = await asyncio.open_connection(self.host, self.port)
             try:

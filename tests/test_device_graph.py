@@ -299,6 +299,16 @@ def test_run_wave_collect_and_chained_match_oracle():
     assert counts.tolist() == [count, count3]
     np.testing.assert_array_equal(np.sort(union_ids), np.nonzero(want_u)[0])
 
+    # union = one BFS from all seeds, same final state + total (the live
+    # batch path: O(edges x depth), not x batch size)
+    g4 = fresh()
+    total, union_ids2 = g4.run_waves_union([seeds1, seeds2])
+    assert total == count + count3
+    np.testing.assert_array_equal(np.sort(union_ids2), np.nonzero(want_u)[0])
+    # a second union call reports nothing new (idempotent)
+    total2, ids_again = g4.run_waves_union([seeds1, seeds2])
+    assert total2 == 0 and len(ids_again) == 0
+
 
 async def test_backend_two_tier_application():
     """Watched nodes (invalidation observers) apply EAGERLY after a device

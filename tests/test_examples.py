@@ -8,9 +8,9 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(script: str) -> str:
+def _run(script: str, *args: str) -> str:
     out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "examples", script)],
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
         capture_output=True,
         text=True,
         timeout=120,
@@ -47,6 +47,15 @@ def test_todo_multiprocess_sample():
     assert "after writer process ('t1', done=False): 0/1 done" in stdout
     assert "after writer process ('t1', done=True): 1/1 done" in stdout
     assert "websocket push -> client: OK" in stdout
+
+
+def test_todo_web_sample():
+    """Browser-facing live view: a pushed invalidation changes the rendered
+    HTML payload on a plain websocket (the Blazor TodoApp UI analogue)."""
+    stdout = _run("todo_web.py", "--check")
+    assert "after add, push rendered" in stdout
+    assert "1/1 done" in stdout
+    assert "browser live view OK" in stdout
 
 
 def test_mini_rpc_sample():

@@ -375,3 +375,271 @@ async def test_forced_sign_out_semantics(fresh_hub):
     # repeated sign-out of a forced-out session is a no-op, flag stays
     await fresh_hub.commander.call(SignOutCommand(session))
     assert await auth.is_sign_out_forced(session)
+
+
+# ------------------------------------------------------------ browser push
+
+async def test_live_view_server_pushes_renders_per_connection():
+    """LiveViewServer: each websocket gets its own component instance;
+    an invalidation re-renders and the payload reaches the socket as JSON;
+    disconnect unmounts (a closed tab stops consuming invalidations)."""
+    import json
+
+    from websockets.asyncio.client import connect
+
+    from stl_fusion_tpu.state import MutableState
+    from stl_fusion_tpu.ui import HtmlComponent, LiveViewServer
+
+    hub = FusionHub()
+    source = MutableState(1, hub)
+
+    class Counter(HtmlComponent):
+        async def compute_state(self) -> int:
+            return await source.use()
+
+        def to_html(self, value: int) -> str:
+            return f"<b>{value}</b>"
+
+    server = await LiveViewServer(lambda push: Counter(push, hub=hub)).start()
+    try:
+        async with connect(server.url) as ws1, connect(server.url) as ws2:
+            first = json.loads(await asyncio.wait_for(ws1.recv(), 5.0))
+            assert first == {"html": "<b>1</b>"}
+            json.loads(await asyncio.wait_for(ws2.recv(), 5.0))
+            assert server.connections == 2
+
+            source.set(2)  # one invalidation -> BOTH browsers re-render
+            assert json.loads(await asyncio.wait_for(ws1.recv(), 5.0)) == {"html": "<b>2</b>"}
+            assert json.loads(await asyncio.wait_for(ws2.recv(), 5.0)) == {"html": "<b>2</b>"}
+
+        async def gone():
+            while server.connections:
+                await asyncio.sleep(0.01)
+
+        await asyncio.wait_for(gone(), 5.0)  # disconnect unmounted both
+    finally:
+        await server.stop()
+
+
+async def test_live_view_component_error_payload():
+    """A failing compute pushes an error payload instead of dying silently."""
+    import json
+
+    from websockets.asyncio.client import connect
+
+    from stl_fusion_tpu.state import MutableState
+    from stl_fusion_tpu.ui import HtmlComponent, LiveViewServer
+
+    hub = FusionHub()
+    source = MutableState(1, hub)
+
+    class Fragile(HtmlComponent):
+        async def compute_state(self) -> int:
+            value = await source.use()
+            if value < 0:
+                raise ValueError("negative")
+            return value
+
+        def to_html(self, value: int) -> str:
+            return str(value)
+
+    server = await LiveViewServer(lambda push: Fragile(push, hub=hub)).start()
+    try:
+        async with connect(server.url) as ws:
+            assert json.loads(await asyncio.wait_for(ws.recv(), 5.0)) == {"html": "1"}
+            source.set(-1)
+            payload = json.loads(await asyncio.wait_for(ws.recv(), 5.0))
+            assert "ValueError" in payload["error"]
+            source.set(3)  # recovers: the state keeps updating
+            assert json.loads(await asyncio.wait_for(ws.recv(), 5.0)) == {"html": "3"}
+    finally:
+        await server.stop()
+
+
+# --------------------------------------------- ServerAuthHelper + AuthState
+
+class CountingCommander:
+    """Wraps a commander to record which commands the helper issues."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.calls = []
+
+    async def call(self, command):
+        self.calls.append(command)
+        return await self.inner.call(command)
+
+    def of(self, cmd_type) -> list:
+        return [c for c in self.calls if type(c).__name__ == cmd_type]
+
+
+async def test_server_auth_helper_decision_tree(fresh_hub):
+    """≈ ServerAuthHelper.UpdateAuthState (ServerAuthHelper.cs:73-113):
+    setup-when-stale, sign-in on new principal, no-op on same principal,
+    sign-out on anonymous transport, keep_signed_in suppresses it."""
+    from stl_fusion_tpu.ext import Principal, ServerAuthHelper
+
+    now = [1000.0]
+    auth = InMemoryAuthService(fresh_hub)
+    auth.clock = lambda: now[0]  # one clock shared with the helper
+    fresh_hub.commander.add_service(auth)
+    commander = CountingCommander(fresh_hub.commander)
+    helper = ServerAuthHelper(
+        auth, commander, session_info_update_period=30.0, clock=lambda: now[0]
+    )
+    session = Session.new()
+    alice = Principal("oidc", "alice", "Alice")
+
+    # fresh session + anonymous transport: setup only, nobody signed in
+    await helper.update_auth_state(session, None, "10.0.0.1", "ua1")
+    assert len(commander.of("SetupSessionCommand")) == 1
+    info = await auth.get_session_info(session)
+    assert (info.ip_address, info.user_agent) == ("10.0.0.1", "ua1")
+    assert await auth.get_user(session) is None
+
+    # authenticated transport: helper signs the fusion session in
+    await helper.update_auth_state(session, alice, "10.0.0.1", "ua1")
+    user = await auth.get_user(session)
+    assert user is not None and user.name == "Alice"
+    assert ("identity", "oidc/alice") in user.claims
+    assert len(commander.of("SignInCommand")) == 1
+
+    # same principal again: NO duplicate sign-in, NO setup (fresh row)
+    await helper.update_auth_state(session, alice, "10.0.0.1", "ua1")
+    assert len(commander.of("SignInCommand")) == 1
+    assert len(commander.of("SetupSessionCommand")) == 1
+
+    # the session moved networks: must re-setup
+    await helper.update_auth_state(session, alice, "10.9.9.9", "ua1")
+    assert len(commander.of("SetupSessionCommand")) == 2
+    assert (await auth.get_session_info(session)).ip_address == "10.9.9.9"
+
+    # presence goes stale: setup again even with nothing else changed
+    now[0] += 60.0
+    await helper.update_auth_state(session, alice, "10.9.9.9", "ua1")
+    assert len(commander.of("SetupSessionCommand")) == 3
+
+    # transport went anonymous: fusion signs out
+    await helper.update_auth_state(session, None, "10.9.9.9", "ua1")
+    assert await auth.get_user(session) is None
+    assert len(commander.of("SignOutCommand")) == 1
+
+    # keep_signed_in: anonymous transport does NOT sign out
+    keep = ServerAuthHelper(auth, commander, keep_signed_in=True, clock=lambda: now[0])
+    await keep.update_auth_state(session, alice, "10.9.9.9", "ua1")
+    await keep.update_auth_state(session, None, "10.9.9.9", "ua1")
+    assert await auth.get_user(session) is not None
+    assert len(commander.of("SignOutCommand")) == 1
+
+
+async def test_auth_state_provider_live_updates(fresh_hub):
+    """≈ Blazor AuthStateProvider: sign-in/out anywhere notifies the UI."""
+    from stl_fusion_tpu.ext import SignInCommand, SignOutCommand, User
+    from stl_fusion_tpu.ui import AuthState, AuthStateProvider
+
+    auth = InMemoryAuthService(fresh_hub)
+    fresh_hub.commander.add_service(auth)
+    session = Session.new()
+    provider = AuthStateProvider(auth, session, fresh_hub)
+    changes: list = []
+    provider.changed_handlers.append(changes.append)
+    try:
+        state = await provider.get()
+        assert isinstance(state, AuthState) and not state.is_authenticated
+
+        await fresh_hub.commander.call(SignInCommand(session, User("u1", "Alice")))
+
+        async def until(pred):
+            while not pred():
+                await asyncio.sleep(0.005)
+
+        await asyncio.wait_for(
+            until(lambda: changes and changes[-1].is_authenticated), 5.0
+        )
+        assert changes[-1].user.name == "Alice"
+
+        await fresh_hub.commander.call(SignOutCommand(session))
+        await asyncio.wait_for(
+            until(lambda: changes and not changes[-1].is_authenticated), 5.0
+        )
+    finally:
+        await provider.dispose()
+
+
+async def test_gateway_auth_sync_end_to_end(fresh_hub):
+    """Cookie session + trusted proxy headers → fusion sign-in, visible to
+    a live AuthStateProvider; dropping the headers signs the session out.
+    The full ServerAuthHelper-on-the-gateway story (VERDICT §2.7)."""
+    from stl_fusion_tpu.ext import ServerAuthHelper
+    from stl_fusion_tpu.rpc import HttpSessionMiddleware, RpcHub
+    from stl_fusion_tpu.rpc.http_gateway import FusionHttpServer, RestClient
+    from stl_fusion_tpu.ui import AuthStateProvider
+
+    auth = InMemoryAuthService(fresh_hub)
+    fresh_hub.commander.add_service(auth)
+
+    class Api:
+        async def ping(self) -> str:
+            return "pong"
+
+    rpc = RpcHub("auth-gateway")
+    rpc.add_service("api", Api())
+    rpc.add_service("auth", auth)
+    server = await FusionHttpServer(rpc, session_middleware=HttpSessionMiddleware()).start()
+    server.auth_helper = ServerAuthHelper(auth, fresh_hub.commander)
+    try:
+        client = RestClient(
+            server.url, "api",
+            headers={"X-Auth-Request-User": "bob", "X-Auth-Request-Preferred-Username": "Bob"},
+        )
+        assert await client.ping() == "pong"
+        cookie = client.cookies["FusionSession"]
+        import urllib.parse
+
+        session = Session(urllib.parse.unquote(cookie))
+        user = await auth.get_user(session)
+        assert user is not None and user.name == "Bob"
+
+        provider = AuthStateProvider(auth, session, fresh_hub)
+        changes: list = []
+        provider.changed_handlers.append(changes.append)
+
+        # same cookie jar, headers gone (proxy session expired) → sign-out
+        client.headers.clear()
+        assert await client.ping() == "pong"
+        assert await auth.get_user(session) is None
+
+        async def until(pred):
+            while not pred():
+                await asyncio.sleep(0.005)
+
+        await asyncio.wait_for(
+            until(lambda: changes and not changes[-1].is_authenticated), 5.0
+        )
+        await provider.dispose()
+    finally:
+        await server.stop()
+        await rpc.stop()
+
+
+async def test_auth_helper_forced_signout_never_signs_in(fresh_hub):
+    """A force-closed session stays signed out even while the transport
+    still presents an authenticated principal — the helper must NOT issue
+    SignIn (which the service rejects with PermissionError and would 500
+    every request)."""
+    from stl_fusion_tpu.ext import Principal, ServerAuthHelper, SignInCommand, SignOutCommand, User
+
+    auth = InMemoryAuthService(fresh_hub)
+    fresh_hub.commander.add_service(auth)
+    helper = ServerAuthHelper(auth, fresh_hub.commander)
+    session = Session.new()
+    alice = Principal("oidc", "alice", "Alice")
+
+    await helper.update_auth_state(session, alice, "ip", "ua")
+    assert await auth.get_user(session) is not None
+    await fresh_hub.commander.call(SignOutCommand(session, force=True))
+
+    # no exception, and the session remains signed out
+    await helper.update_auth_state(session, alice, "ip", "ua")
+    assert await auth.get_user(session) is None
+    assert await auth.is_sign_out_forced(session)

@@ -161,3 +161,192 @@ async def test_bridge_detach_stops_cascading():
     assert node.is_consistent  # detached: no cascade
     assert bridge.live_row_leaves() == 0
     assert len(table.on_invalidate) == 0
+
+
+# ---------------------------------------------- transparent table backing
+
+def make_backed_service():
+    from stl_fusion_tpu.core import ComputeService, FusionHub, TableBacking, compute_method
+
+    class Users(ComputeService):
+        """An ordinary service whose dense-int-key read is table-backed:
+        the scalar path keeps per-key Computed nodes, the columnar path
+        rides MemoTable through the service's own batch method."""
+
+        def __init__(self, hub=None):
+            super().__init__(hub)
+            self.data = {i: float(i) * 2.0 for i in range(64)}
+            self.scalar_reads = 0
+            self.batch_reads = []
+
+        def get_many(self, ids):
+            self.batch_reads.append(np.array(ids))
+            return np.array([self.data[int(i)] for i in ids], dtype=np.float32)
+
+        @compute_method(table=TableBacking(rows=64, batch="get_many"))
+        async def get(self, uid: int) -> float:
+            self.scalar_reads += 1
+            return self.data[uid]
+
+    return Users(FusionHub())
+
+
+async def test_table_backed_scalar_path_unchanged():
+    svc = make_backed_service()
+    assert await svc.get(3) == 6.0
+    assert await svc.get(3) == 6.0  # memoized: one scalar read
+    assert svc.scalar_reads == 1
+    assert svc.batch_reads == []  # scalar calls never materialize the table
+
+
+async def test_table_backed_batch_read_via_public_api():
+    from stl_fusion_tpu.core import memo_table_of
+
+    svc = make_backed_service()
+    table = memo_table_of(svc.get)
+    assert memo_table_of(svc.get) is table  # stable per (service, hub)
+    out = np.asarray(table.read_batch([1, 2, 3]))
+    np.testing.assert_allclose(out, [2.0, 4.0, 6.0])
+    assert len(svc.batch_reads) == 1  # one vectorized refresh
+    np.asarray(table.read_batch([1, 2, 3]))
+    assert len(svc.batch_reads) == 1  # fresh rows: pure gather
+
+
+async def test_scalar_invalidation_marks_table_row_stale():
+    from stl_fusion_tpu.core import invalidating, memo_table_of
+
+    svc = make_backed_service()
+    table = memo_table_of(svc.get)
+    table.read_batch([5, 6])
+    svc.data[5] = 99.0
+    with invalidating():
+        await svc.get(5)
+    out = np.asarray(table.read_batch([5, 6]))
+    np.testing.assert_allclose(out, [99.0, 12.0])
+    # only the invalidated row refreshed
+    assert svc.batch_reads[-1].tolist() == [5]
+
+
+async def test_table_invalidation_reaches_live_scalar_nodes():
+    from stl_fusion_tpu.core import capture, memo_table_of
+
+    svc = make_backed_service()
+    node = await capture(lambda: svc.get(7))
+    assert node.is_consistent
+    table = memo_table_of(svc.get)
+    svc.data[7] = -1.0
+    table.invalidate([7, 8])  # 8 has no scalar node: must cost nothing
+    assert not node.is_consistent
+    assert await svc.get(7) == -1.0
+
+
+async def test_two_way_invalidation_has_no_cycle():
+    from stl_fusion_tpu.core import capture, invalidating, memo_table_of
+
+    svc = make_backed_service()
+    table = memo_table_of(svc.get)
+    table.read_batch([4])
+    await capture(lambda: svc.get(4))
+    v0 = table.version
+    with invalidating():
+        await svc.get(4)  # scalar → table → (already-invalid scalar) stops
+    assert table.version == v0 + 1  # exactly ONE table invalidation
+
+
+def test_read_batch_device_resident_ids():
+    """Device-resident id batches never cross the host boundary: the whole
+    stale set refreshes first, then the read is one pure gather."""
+    import jax.numpy as jnp
+
+    table, calls = make_table()
+    table.read_batch([1, 2])  # partial warm: 254 rows still stale
+    ids = jnp.asarray(np.array([1, 5, 9], dtype=np.int32))
+    out = np.asarray(table.read_batch(ids))
+    np.testing.assert_allclose(out, [2.0, 10.0, 18.0])
+    assert table.stale_count() == 0  # device path refreshed ALL stale rows
+    n = len(calls)
+    np.asarray(table.read_batch(jnp.asarray(np.array([3], dtype=np.int32))))
+    assert len(calls) == n  # fresh table: pure gather, no recompute
+    # a single-row invalidation refreshes exactly that row on the next read
+    table.invalidate([7])
+    np.asarray(table.read_batch(ids))
+    assert calls[-1].tolist() == [7]
+
+
+def test_stale_count_is_exact_under_repeats():
+    table, _ = make_table(n=16)
+    table.read_batch(np.arange(16))
+    assert table.stale_count() == 0
+    table.invalidate([3, 3, 5])   # duplicate ids must not double-count
+    assert table.stale_count() == 2
+    table.invalidate([5])         # already stale: no change
+    assert table.stale_count() == 2
+    table.refresh([3, 3])
+    assert table.stale_count() == 1
+    table.refresh([3])            # already fresh: no change
+    assert table.stale_count() == 1
+    table.invalidate_all()
+    assert table.stale_count() == 16
+
+
+def test_read_batch_accepts_any_host_sequence():
+    """range / generators-turned-lists keep the original host contract —
+    only real jax arrays take the device-resident path."""
+    table, calls = make_table()
+    out = np.asarray(table.read_batch(range(4)))
+    np.testing.assert_allclose(out, [0.0, 2.0, 4.0, 6.0])
+    assert table.stale_count() == 256 - 4  # host path: only touched rows refresh
+
+
+async def test_dependency_cascade_marks_table_row_stale():
+    """Scalar⇄columnar coherence must hold for EVERY invalidation path:
+    invalidating an UPSTREAM dependency cascades into the table-backed
+    node, which must mark its columnar row stale too (review finding)."""
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        TableBacking,
+        compute_method,
+        invalidating,
+        memo_table_of,
+    )
+
+    hub = FusionHub()
+
+    class Source(ComputeService):
+        def __init__(self, hub=None):
+            super().__init__(hub)
+            self.factor = 2.0
+
+        @compute_method
+        async def get_factor(self) -> float:
+            return self.factor
+
+    class Users(ComputeService):
+        def __init__(self, source, hub=None):
+            super().__init__(hub)
+            self.source = source
+
+        def get_many(self, ids):
+            # batch fn reads the CURRENT factor directly
+            return np.array([float(i) * self.source.factor for i in ids], dtype=np.float32)
+
+        @compute_method(table=TableBacking(rows=32, batch="get_many"))
+        async def get(self, uid: int) -> float:
+            return float(uid) * await self.source.get_factor()
+
+    source = Source(hub)
+    users = Users(source, hub)
+    table = memo_table_of(users.get)
+
+    assert await users.get(3) == 6.0          # scalar node exists, depends on factor
+    np.asarray(table.read_batch([3]))         # row 3 fresh
+    assert table.stale_count() == 32 - 1
+
+    source.factor = 10.0
+    with invalidating():
+        await source.get_factor()             # upstream only — cascades into get(3)
+
+    assert await users.get(3) == 30.0         # scalar recomputed
+    out = np.asarray(table.read_batch([3]))   # row must have refreshed too
+    np.testing.assert_allclose(out, [30.0])
