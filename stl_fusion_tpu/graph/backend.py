@@ -220,6 +220,16 @@ class TpuGraphBackend:
         self.device_invalidations += total
         return total + fallback
 
+    def build_topo_mirror(self, k: int = 4, cap: int = 65536) -> dict:
+        """Build/refresh the packed topo mirror of the live graph: while
+        topology stays stable, ``invalidate_cascade_batch`` bursts run ONE
+        depth-free level-ordered sweep (the flagship kernel) instead of a
+        level-by-level BFS — the difference between O(edges·depth) and
+        O(edges) on deep graphs. Any live-edge change routes bursts back to
+        the dense path until this is called again (fingerprint check)."""
+        self.flush()
+        return self.graph.build_topo_mirror(k=k, cap=cap)
+
     def _apply_newly(self, newly_ids: np.ndarray) -> None:
         if len(newly_ids) == 0:
             return

@@ -57,6 +57,11 @@ class DeviceGraph:
         self._h_invalid = np.zeros(self.n_cap + 1, dtype=bool)  # host-authoritative
         self._g: Optional[GraphArrays] = None  # device copy, built lazily
         self._dirty = True
+        self._topo_mirror: Optional[dict] = None  # see build_topo_mirror
+        # structure mutated since the mirror was last validated → the next
+        # mirror-routed burst re-checks the fingerprint (O(edges)) ONCE;
+        # stable-topology bursts pay O(1)
+        self._mirror_maybe_stale = True
 
     # ------------------------------------------------------------------ build
     def add_nodes(self, count: int) -> np.ndarray:
@@ -90,6 +95,7 @@ class DeviceGraph:
         self._h_edge_dst_epoch[sl] = np.asarray(dst_epoch, dtype=np.int32)
         self.n_edges += k
         self._dirty = True
+        self._mirror_maybe_stale = True
 
     def bump_epochs(self, node_ids: np.ndarray) -> None:
         """Nodes recomputed: new epoch ⇒ their stale in-edges go dead, and
@@ -97,6 +103,7 @@ class DeviceGraph:
         node_ids = np.asarray(node_ids, dtype=np.int32)
         self._h_node_epoch[node_ids] += 1
         self._h_invalid[node_ids] = False
+        self._mirror_maybe_stale = True
         if self._g is not None and not self._dirty:
             jnp = self._jnp
             ids = jnp.asarray(node_ids)
@@ -228,12 +235,31 @@ class DeviceGraph:
             np.nonzero(newly)[0].astype(np.int32),
         )
 
-    def run_waves_union(self, seed_id_lists: Sequence[Sequence[int]]):
+    def run_waves_union(self, seed_id_lists: Sequence[Sequence[int]], mirror: str = "auto"):
         """Union cascade for a burst of seed waves: ONE BFS expansion from
         all seeds together (the live batch path applies only the union, and
         invalidation is idempotent — see ops/wave.py::run_waves_union).
         Returns (total newly count, union newly ids). Seed count is padded
-        to a power of two so varying burst sizes reuse one program."""
+        to a power of two so varying burst sizes reuse one program.
+
+        ``mirror``: "auto" rides the packed topo mirror when one was built
+        with :meth:`build_topo_mirror` and the live topology still matches
+        its fingerprint (depth-free: one level-ordered sweep instead of a
+        level-by-level BFS — the difference between O(edges·depth) and
+        O(edges) on deep graphs); "off" forces the dense BFS path."""
+        if mirror == "auto" and self._topo_mirror is not None:
+            if self._mirror_maybe_stale:
+                # one O(edges) re-validation after a mutation; bursts on a
+                # stable topology skip straight to the mirror
+                _, _, fp = self._live_edge_fingerprint()
+                if fp == self._topo_mirror["fp"]:
+                    self._mirror_maybe_stale = False
+            if not self._mirror_maybe_stale:
+                m_nodes = self._topo_mirror["n_nodes"]
+                if all(0 <= int(i) < m_nodes for s in seed_id_lists for i in s):
+                    return self._run_mirror_union(seed_id_lists)
+                # out-of-contract seed ids (unallocated slots): the dense
+                # path can represent them, the mirror cannot — fall through
         import jax
 
         jnp = self._jnp
@@ -246,6 +272,110 @@ class DeviceGraph:
         count, newly = jax.device_get((count, newly))
         self._h_invalid |= newly
         return int(count), np.nonzero(newly)[0].astype(np.int32)
+
+    # ------------------------------------------------------------------ topo mirror
+    def _live_edge_fingerprint(self):
+        """(live src, live dst, fingerprint) of the CURRENT live edge set
+        (epoch-matched edges only). Order-sensitive by design: any append,
+        epoch bump that kills an in-edge, or compact changes it — a
+        mismatch just means the mirror falls back to the dense path."""
+        import hashlib
+
+        m = self.n_edges
+        live = (
+            self._h_node_epoch[self._h_edge_dst[:m]] == self._h_edge_dst_epoch[:m]
+        )
+        src = self._h_edge_src[:m][live]
+        dst = self._h_edge_dst[:m][live]
+        h = hashlib.blake2b(digest_size=16)
+        h.update(np.int64(self.n_nodes).tobytes())
+        h.update(src.tobytes())
+        h.update(dst.tobytes())
+        return src, dst, h.digest()
+
+    def build_topo_mirror(self, k: int = 4, cap: int = 65536) -> dict:
+        """Build (or refresh) the packed topo mirror of the LIVE edge set:
+        the level-ordered in-ELL (ops/topo_wave.py) that runs a whole burst
+        in ONE depth-free sweep. Rebuilt only when the live-edge fingerprint
+        changes; per-burst the mirror reads the dense device invalid state
+        directly (no host upload) and writes newly bits back into it, so
+        the two device states never diverge. Epoch checks are unnecessary
+        inside the mirror — it contains exactly the currently-live edges,
+        and any change to the LIVE edge sequence (an append, an epoch bump
+        that kills an in-edge) changes the fingerprint, routing bursts back
+        to the dense path until the mirror is rebuilt. Operations that
+        preserve the live set — compact() drops only dead edges — keep the
+        fingerprint, and the mirror stays valid because the semantics are
+        unchanged."""
+        from ..ops.topo_wave import (
+            build_topo_graph,
+            topo_graph_arrays,
+            topo_mirror_burst_step,
+        )
+
+        jnp = self._jnp
+        src, dst, fp = self._live_edge_fingerprint()
+        cached = self._topo_mirror
+        if (
+            cached is not None
+            and cached["fp"] == fp
+            and cached["cap"] == cap
+            and cached["k"] == k
+        ):
+            self._mirror_maybe_stale = False
+            return cached
+        topo = build_topo_graph(src, dst, self.n_nodes, k=k)
+        n_tot = topo.n_tot
+        node_epoch0 = jnp.zeros(n_tot + 1, dtype=jnp.int32).at[n_tot].set(-2)
+        # original id per topo row, clipped into the dense arrays (virtual
+        # rows would index past n_cap; is_real masks them in the program)
+        perm_clipped = jnp.asarray(
+            np.clip(topo.perm, 0, self.n_cap).astype(np.int32)
+        )
+        self._topo_mirror = {
+            "fp": fp,
+            "cap": cap,
+            "k": k,
+            "n_nodes": self.n_nodes,
+            "n_tot": n_tot,
+            "inv_perm": topo.inv_perm,
+            "garrays": topo_graph_arrays(topo),
+            "node_epoch0": node_epoch0,
+            "perm_clipped": perm_clipped,
+            "burst": topo_mirror_burst_step(topo.level_starts, cap, n_tot),
+            "levels": len(topo.level_starts) - 1,
+        }
+        return self._topo_mirror
+
+    def _run_mirror_union(self, seed_id_lists: Sequence[Sequence[int]]):
+        import jax
+
+        jnp = self._jnp
+        m = self._topo_mirror
+        n_tot = m["n_tot"]
+        flat = np.asarray(
+            [int(i) for s in seed_id_lists for i in s], dtype=np.int64
+        )
+        new_ids = m["inv_perm"][flat] if len(flat) else np.empty(0, np.int64)
+        width = _round_up_pow2(max(len(new_ids), 1))
+        ids = np.full(width, n_tot, dtype=np.int32)  # pad = null row
+        ids[: len(new_ids)] = new_ids.astype(np.int32)
+        g = self.device_arrays()
+        g_invalid2, count, out_ids, overflow = m["burst"](
+            m["garrays"], m["node_epoch0"], m["perm_clipped"], g.invalid,
+            jnp.asarray(ids),
+        )
+        count, out_ids, overflow = jax.device_get((count, out_ids, overflow))
+        self._g = g._replace(invalid=g_invalid2)
+        count = int(count)
+        if bool(overflow):
+            newly = np.asarray(g_invalid2) & ~self._h_invalid
+            newly_ids = np.nonzero(newly)[0].astype(np.int32)
+            self._h_invalid |= newly
+        else:
+            newly_ids = out_ids[:count] if count else np.empty(0, np.int32)
+            self._h_invalid[newly_ids] = True
+        return count, newly_ids
 
     def run_wave_frontier(self, seed_frontier, sync_host: bool = False) -> int:
         """Wave from a prebuilt boolean frontier (bench hot path — host copy
@@ -293,4 +423,7 @@ class DeviceGraph:
             arr[k : self.n_edges] = pad_val
         self.n_edges = k
         self._dirty = True
+        # compact preserves the live edge sequence (fp unchanged), but one
+        # cheap re-validation beats reasoning about it here
+        self._mirror_maybe_stale = True
         return removed

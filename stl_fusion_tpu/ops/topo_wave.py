@@ -47,6 +47,7 @@ __all__ = [
     "topo_graph_arrays",
     "topo_init_state",
     "build_topo_wave32",
+    "topo_mirror_burst_step",
     "topo_seeds_to_bits",
 ]
 
@@ -227,6 +228,53 @@ def _topo_sweep_impl(level_starts, garrays: TopoGraphArrays, seed_bits, state: T
         invalid = invalid[:, 0]
         return TopoState(node_epoch, invalid), counts[0]
     return TopoState(node_epoch, invalid), counts
+
+
+@functools.lru_cache(maxsize=8)
+def topo_mirror_burst_step(level_starts: Tuple[int, ...], cap: int, n_tot: int):
+    """Jitted LIVE-burst program over a topo mirror (graph/device_graph.py
+    ``build_topo_mirror``): project the dense live invalid state into topo
+    order (device gather — no host upload), run ONE sweep from the burst's
+    seeds, compact the newly-invalidated ORIGINAL ids to ``cap``, and
+    scatter them back into the dense invalid array — all in one dispatch
+    with an O(cap) readback. ``perm_clipped[j]`` is the original id of topo
+    row ``j`` (clipped into the dense array for virtual rows, which
+    ``is_real`` masks out)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def burst(garrays: TopoGraphArrays, node_epoch0, perm_clipped, g_invalid, seed_new_ids):
+        is_real = garrays.is_real
+        state_bits = (
+            jnp.where(is_real, g_invalid[perm_clipped], False)
+            .astype(jnp.int32)
+            .at[n_tot]
+            .set(0)
+        )
+        seed_bits = (
+            jnp.zeros(n_tot + 1, jnp.int32).at[seed_new_ids].set(1).at[n_tot].set(0)
+        )
+        state2, _ = _topo_sweep_impl(
+            level_starts, garrays, seed_bits, TopoState(node_epoch0, state_bits)
+        )
+        newly = (state2.invalid_bits & ~state_bits).astype(bool) & is_real
+        count = newly.sum(dtype=jnp.int32)
+        pos = jnp.cumsum(newly.astype(jnp.int32)) - 1
+        scatter_pos = jnp.where(newly & (pos < cap), pos, cap)  # OOB → dropped
+        ids = (
+            jnp.full(cap, -1, dtype=jnp.int32)
+            .at[scatter_pos]
+            .set(perm_clipped, mode="drop")
+        )
+        # dense-state writeback: newly bits land on their ORIGINAL slots
+        oob = g_invalid.shape[0]
+        g_invalid2 = g_invalid.at[jnp.where(newly, perm_clipped, oob)].set(
+            True, mode="drop"
+        )
+        return g_invalid2, count, ids, count > cap
+
+    return burst
 
 
 @functools.lru_cache(maxsize=8)

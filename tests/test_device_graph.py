@@ -426,3 +426,116 @@ async def test_backend_batch_cascade():
         assert await svc.pair("a", "b") == 101
     finally:
         set_default_hub(old)
+
+
+def test_topo_mirror_burst_matches_dense_union():
+    """The packed topo mirror (depth-free burst path) produces the SAME
+    newly-invalidated set, host state, and device state as the dense union
+    BFS — including across epoch churn (recomputes kill the fingerprint and
+    route bursts back to the dense path) and already-invalid seeds."""
+    rng = np.random.default_rng(17)
+    n = 500
+    edges = random_dag(rng, n, avg_deg=3.0)
+    arr = np.asarray(edges, dtype=np.int32)
+
+    def fresh():
+        g = DeviceGraph(node_capacity=n, edge_capacity=len(edges) * 2)
+        g.add_nodes(n)
+        g.add_edges(arr[:, 0], arr[:, 1])
+        return g
+
+    seeds1 = rng.choice(n, size=6, replace=False).tolist()
+    seeds2 = rng.choice(n, size=6, replace=False).tolist()
+
+    dense = fresh()
+    c1, ids1 = dense.run_waves_union([seeds1], mirror="off")
+
+    mirrored = fresh()
+    info = mirrored.build_topo_mirror(k=4, cap=1024)
+    assert info["levels"] >= 1
+    c1m, ids1m = mirrored.run_waves_union([seeds1])  # auto → mirror path
+    assert c1m == c1
+    np.testing.assert_array_equal(np.sort(ids1m), np.sort(ids1))
+    np.testing.assert_array_equal(mirrored._h_invalid, dense._h_invalid)
+    np.testing.assert_array_equal(  # device states agree too
+        np.asarray(mirrored.device_arrays().invalid),
+        np.asarray(dense.device_arrays().invalid),
+    )
+
+    # second burst over the SAME mirror: incremental (already-invalid nodes
+    # don't recount), still equals the dense path
+    c2, ids2 = dense.run_waves_union([seeds2], mirror="off")
+    c2m, ids2m = mirrored.run_waves_union([seeds2])
+    assert c2m == c2
+    np.testing.assert_array_equal(np.sort(ids2m), np.sort(ids2))
+
+    # re-running the same seeds: nothing new on either path
+    assert mirrored.run_waves_union([seeds1])[0] == 0
+    assert dense.run_waves_union([seeds1], mirror="off")[0] == 0
+
+
+def test_topo_mirror_fingerprint_staleness_and_rebuild():
+    """Epoch bumps / new edges change the live-edge fingerprint: bursts
+    fall back to the dense path (still correct), and a rebuild restores the
+    mirror route."""
+    rng = np.random.default_rng(23)
+    n = 200
+    edges = random_dag(rng, n, avg_deg=2.5)
+    arr = np.asarray(edges, dtype=np.int32)
+
+    g = DeviceGraph(node_capacity=n, edge_capacity=len(edges) * 4)
+    g.add_nodes(n)
+    g.add_edges(arr[:, 0], arr[:, 1])
+    g.build_topo_mirror(k=4, cap=512)
+    fp0 = g._topo_mirror["fp"]
+
+    # a recompute: epoch bump kills that node's in-edges → fp changes
+    victim = int(arr[:, 1][len(arr) // 2])
+    g.bump_epochs([victim])
+    _, _, fp1 = g._live_edge_fingerprint()
+    assert fp1 != fp0
+
+    seeds = rng.choice(n, size=4, replace=False).tolist()
+    # burst still works (dense fallback), equals an explicit dense run
+    twin = DeviceGraph(node_capacity=n, edge_capacity=len(edges) * 4)
+    twin.add_nodes(n)
+    twin.add_edges(arr[:, 0], arr[:, 1])
+    twin.bump_epochs([victim])
+    c_auto, ids_auto = g.run_waves_union([seeds])
+    c_dense, ids_dense = twin.run_waves_union([seeds], mirror="off")
+    assert c_auto == c_dense
+    np.testing.assert_array_equal(np.sort(ids_auto), np.sort(ids_dense))
+
+    # rebuild picks up the new topology; mirror route is correct again
+    g.clear_invalid()
+    twin.clear_invalid()
+    info = g.build_topo_mirror(k=4, cap=512)
+    assert info["fp"] != fp0
+    c_m, ids_m = g.run_waves_union([seeds])
+    c_d, ids_d = twin.run_waves_union([seeds], mirror="off")
+    assert c_m == c_d
+    np.testing.assert_array_equal(np.sort(ids_m), np.sort(ids_d))
+
+
+def test_topo_mirror_overflow_falls_back_to_mask_diff():
+    """A burst bigger than the id buffer still applies fully (full-mask
+    diff fallback), identical to the dense path."""
+    rng = np.random.default_rng(29)
+    n = 300
+    edges = random_dag(rng, n, avg_deg=3.0)
+    arr = np.asarray(edges, dtype=np.int32)
+
+    g = DeviceGraph(node_capacity=n, edge_capacity=len(edges) + 1)
+    g.add_nodes(n)
+    g.add_edges(arr[:, 0], arr[:, 1])
+    g.build_topo_mirror(k=4, cap=4)  # tiny buffer → overflow path
+    twin = DeviceGraph(node_capacity=n, edge_capacity=len(edges) + 1)
+    twin.add_nodes(n)
+    twin.add_edges(arr[:, 0], arr[:, 1])
+
+    seeds = list(range(0, 20))
+    c_m, ids_m = g.run_waves_union([seeds])
+    c_d, ids_d = twin.run_waves_union([seeds], mirror="off")
+    assert c_m == c_d and c_m > 4
+    np.testing.assert_array_equal(np.sort(ids_m), np.sort(ids_d))
+    np.testing.assert_array_equal(g._h_invalid, twin._h_invalid)
