@@ -142,7 +142,10 @@ async def main() -> None:
         # -------- the same burst over the cached topo mirror (depth-free)
         note("building the topo mirror of the live graph...")
         t0 = time.perf_counter()
-        info = backend.build_topo_mirror(cap=1 << 20)
+        # default cap: waves larger than it take the mask-diff readback
+        # (1 byte/node) instead of a full id-buffer transfer (4 bytes/slot),
+        # which through the relay is the cheaper path for huge bursts
+        info = backend.build_topo_mirror()
         mirror_build_s = time.perf_counter() - t0
         note(f"mirror built ({info['levels']} levels); compiling the burst program...")
         # warm with the REAL seed shape (the program is specialized on the

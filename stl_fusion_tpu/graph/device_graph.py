@@ -58,16 +58,19 @@ class DeviceGraph:
         self._g: Optional[GraphArrays] = None  # device copy, built lazily
         self._dirty = True
         self._topo_mirror: Optional[dict] = None  # see build_topo_mirror
-        # structure mutated since the mirror was last validated → the next
-        # mirror-routed burst re-checks the fingerprint (O(edges)) ONCE;
-        # stable-topology bursts pay O(1)
-        self._mirror_maybe_stale = True
+        # bumped on every structural mutation; the mirror remembers both the
+        # version it was last VALIDATED at and the version it last MISSED
+        # at, so stable-topology bursts pay O(1) and a stale mirror pays the
+        # O(edges) fingerprint re-check at most once per mutation
+        self._struct_version = 0
+        self.mirror_bursts = 0  # observability: bursts served by the mirror
 
     # ------------------------------------------------------------------ build
     def add_nodes(self, count: int) -> np.ndarray:
         """Allocate ``count`` dense node ids."""
         start = self.n_nodes
         self.n_nodes += count
+        self._struct_version += 1  # n_nodes is part of the fingerprint
         if self.n_nodes > self.n_cap:
             self._grow_nodes(self.n_nodes)
         return np.arange(start, self.n_nodes, dtype=np.int32)
@@ -95,7 +98,7 @@ class DeviceGraph:
         self._h_edge_dst_epoch[sl] = np.asarray(dst_epoch, dtype=np.int32)
         self.n_edges += k
         self._dirty = True
-        self._mirror_maybe_stale = True
+        self._struct_version += 1
 
     def bump_epochs(self, node_ids: np.ndarray) -> None:
         """Nodes recomputed: new epoch ⇒ their stale in-edges go dead, and
@@ -103,7 +106,7 @@ class DeviceGraph:
         node_ids = np.asarray(node_ids, dtype=np.int32)
         self._h_node_epoch[node_ids] += 1
         self._h_invalid[node_ids] = False
-        self._mirror_maybe_stale = True
+        self._struct_version += 1
         if self._g is not None and not self._dirty:
             jnp = self._jnp
             ids = jnp.asarray(node_ids)
@@ -201,14 +204,20 @@ class DeviceGraph:
         # relay RTT three times on the lone-wave path
         count, ids, overflow = jax.device_get((count, ids, overflow))
         count = int(count)
-        if bool(overflow):
+        return count, self._patch_host_invalid(count, ids, bool(overflow))
+
+    def _patch_host_invalid(self, count: int, ids: np.ndarray, overflow: bool) -> np.ndarray:
+        """Apply a compacted-wave readback to ``_h_invalid``: the id buffer
+        when it fit, otherwise a full mask diff against the (already
+        updated) device invalid state. Returns the newly-invalid ids."""
+        if overflow:
             newly = np.asarray(self._g.invalid) & ~self._h_invalid
             newly_ids = np.nonzero(newly)[0].astype(np.int32)
             self._h_invalid |= newly
         else:
             newly_ids = ids[:count] if count else np.empty(0, np.int32)
             self._h_invalid[newly_ids] = True
-        return count, newly_ids
+        return newly_ids
 
     def run_waves_chained(self, seed_id_lists: Sequence[Sequence[int]]):
         """Chain many seed waves in ONE dispatch (the live burst path).
@@ -247,19 +256,12 @@ class DeviceGraph:
         its fingerprint (depth-free: one level-ordered sweep instead of a
         level-by-level BFS — the difference between O(edges·depth) and
         O(edges) on deep graphs); "off" forces the dense BFS path."""
-        if mirror == "auto" and self._topo_mirror is not None:
-            if self._mirror_maybe_stale:
-                # one O(edges) re-validation after a mutation; bursts on a
-                # stable topology skip straight to the mirror
-                _, _, fp = self._live_edge_fingerprint()
-                if fp == self._topo_mirror["fp"]:
-                    self._mirror_maybe_stale = False
-            if not self._mirror_maybe_stale:
-                m_nodes = self._topo_mirror["n_nodes"]
-                if all(0 <= int(i) < m_nodes for s in seed_id_lists for i in s):
-                    return self._run_mirror_union(seed_id_lists)
-                # out-of-contract seed ids (unallocated slots): the dense
-                # path can represent them, the mirror cannot — fall through
+        if mirror == "auto" and self._mirror_valid():
+            m_nodes = self._topo_mirror["n_nodes"]
+            if all(0 <= int(i) < m_nodes for s in seed_id_lists for i in s):
+                return self._run_mirror_union(seed_id_lists)
+            # out-of-contract seed ids (unallocated slots): the dense
+            # path can represent them, the mirror cannot — fall through
         import jax
 
         jnp = self._jnp
@@ -274,6 +276,26 @@ class DeviceGraph:
         return int(count), np.nonzero(newly)[0].astype(np.int32)
 
     # ------------------------------------------------------------------ topo mirror
+    def _mirror_valid(self) -> bool:
+        """Is the cached mirror usable RIGHT NOW? O(1) on a topology the
+        mirror has already been validated (or known stale) against; the
+        O(edges) fingerprint re-check runs at most once per structural
+        mutation — a stale-and-never-rebuilt mirror costs nothing per burst."""
+        m = self._topo_mirror
+        if m is None:
+            return False
+        sv = self._struct_version
+        if m["validated_at"] == sv:
+            return True
+        if m.get("missed_at") == sv:
+            return False
+        _, _, fp = self._live_edge_fingerprint()
+        if fp == m["fp"]:
+            m["validated_at"] = sv
+            return True
+        m["missed_at"] = sv
+        return False
+
     def _live_edge_fingerprint(self):
         """(live src, live dst, fingerprint) of the CURRENT live edge set
         (epoch-matched edges only). Order-sensitive by design: any append,
@@ -322,7 +344,7 @@ class DeviceGraph:
             and cached["cap"] == cap
             and cached["k"] == k
         ):
-            self._mirror_maybe_stale = False
+            cached["validated_at"] = self._struct_version
             return cached
         topo = build_topo_graph(src, dst, self.n_nodes, k=k)
         n_tot = topo.n_tot
@@ -336,6 +358,9 @@ class DeviceGraph:
             "fp": fp,
             "cap": cap,
             "k": k,
+            # the builder just computed fp from the CURRENT structure — the
+            # first burst must not re-hash to learn what we already know
+            "validated_at": self._struct_version,
             "n_nodes": self.n_nodes,
             "n_tot": n_tot,
             "inv_perm": topo.inv_perm,
@@ -367,15 +392,9 @@ class DeviceGraph:
         )
         count, out_ids, overflow = jax.device_get((count, out_ids, overflow))
         self._g = g._replace(invalid=g_invalid2)
+        self.mirror_bursts += 1
         count = int(count)
-        if bool(overflow):
-            newly = np.asarray(g_invalid2) & ~self._h_invalid
-            newly_ids = np.nonzero(newly)[0].astype(np.int32)
-            self._h_invalid |= newly
-        else:
-            newly_ids = out_ids[:count] if count else np.empty(0, np.int32)
-            self._h_invalid[newly_ids] = True
-        return count, newly_ids
+        return count, self._patch_host_invalid(count, out_ids, bool(overflow))
 
     def run_wave_frontier(self, seed_frontier, sync_host: bool = False) -> int:
         """Wave from a prebuilt boolean frontier (bench hot path — host copy
@@ -425,5 +444,5 @@ class DeviceGraph:
         self._dirty = True
         # compact preserves the live edge sequence (fp unchanged), but one
         # cheap re-validation beats reasoning about it here
-        self._mirror_maybe_stale = True
+        self._struct_version += 1
         return removed

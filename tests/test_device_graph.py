@@ -454,6 +454,7 @@ def test_topo_mirror_burst_matches_dense_union():
     info = mirrored.build_topo_mirror(k=4, cap=1024)
     assert info["levels"] >= 1
     c1m, ids1m = mirrored.run_waves_union([seeds1])  # auto → mirror path
+    assert mirrored.mirror_bursts == 1  # the mirror actually served it
     assert c1m == c1
     np.testing.assert_array_equal(np.sort(ids1m), np.sort(ids1))
     np.testing.assert_array_equal(mirrored._h_invalid, dense._h_invalid)
@@ -472,6 +473,7 @@ def test_topo_mirror_burst_matches_dense_union():
     # re-running the same seeds: nothing new on either path
     assert mirrored.run_waves_union([seeds1])[0] == 0
     assert dense.run_waves_union([seeds1], mirror="off")[0] == 0
+    assert mirrored.mirror_bursts == 3 and dense.mirror_bursts == 0
 
 
 def test_topo_mirror_fingerprint_staleness_and_rebuild():
@@ -505,6 +507,10 @@ def test_topo_mirror_fingerprint_staleness_and_rebuild():
     c_dense, ids_dense = twin.run_waves_union([seeds], mirror="off")
     assert c_auto == c_dense
     np.testing.assert_array_equal(np.sort(ids_auto), np.sort(ids_dense))
+    assert g.mirror_bursts == 0  # stale mirror: dense fallback served it
+    # ...and the failed validation is remembered: another burst on the same
+    # (unchanged) topology must not re-hash (missed_at == struct_version)
+    assert g._topo_mirror["missed_at"] == g._struct_version
 
     # rebuild picks up the new topology; mirror route is correct again
     g.clear_invalid()
@@ -513,7 +519,7 @@ def test_topo_mirror_fingerprint_staleness_and_rebuild():
     assert info["fp"] != fp0
     c_m, ids_m = g.run_waves_union([seeds])
     c_d, ids_d = twin.run_waves_union([seeds], mirror="off")
-    assert c_m == c_d
+    assert c_m == c_d and g.mirror_bursts == 1
     np.testing.assert_array_equal(np.sort(ids_m), np.sort(ids_d))
 
 
@@ -536,6 +542,6 @@ def test_topo_mirror_overflow_falls_back_to_mask_diff():
     seeds = list(range(0, 20))
     c_m, ids_m = g.run_waves_union([seeds])
     c_d, ids_d = twin.run_waves_union([seeds], mirror="off")
-    assert c_m == c_d and c_m > 4
+    assert c_m == c_d and c_m > 4 and g.mirror_bursts == 1
     np.testing.assert_array_equal(np.sort(ids_m), np.sort(ids_d))
     np.testing.assert_array_equal(g._h_invalid, twin._h_invalid)
