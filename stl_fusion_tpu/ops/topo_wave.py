@@ -234,32 +234,48 @@ def _topo_sweep_impl(level_starts, garrays: TopoGraphArrays, seed_bits, state: T
 def topo_mirror_burst_step(level_starts: Tuple[int, ...], cap: int, n_tot: int):
     """Jitted LIVE-burst program over a topo mirror (graph/device_graph.py
     ``build_topo_mirror``): project the dense live invalid state into topo
-    order (device gather — no host upload), run ONE sweep from the burst's
-    seeds, compact the newly-invalidated ORIGINAL ids to ``cap``, and
-    scatter them back into the dense invalid array — all in one dispatch
-    with an O(cap) readback. ``perm_clipped[j]`` is the original id of topo
-    row ``j`` (clipped into the dense array for virtual rows, which
-    ``is_real`` masks out)."""
+    order (device gather — no host upload), run ONE gated fire sweep from
+    the burst's seeds (dense-BFS semantics: pre-existing invalid nodes
+    neither re-fire nor count), compact the newly-invalidated ORIGINAL ids
+    to ``cap``, and scatter them back into the dense invalid array — all in
+    one dispatch with an O(cap) readback. ``perm_clipped[j]`` is the
+    original id of topo row ``j`` (clipped into the dense array for virtual
+    rows, which ``is_real`` masks out)."""
     import jax
     import jax.numpy as jnp
 
     @jax.jit
     def burst(garrays: TopoGraphArrays, node_epoch0, perm_clipped, g_invalid, seed_new_ids):
         is_real = garrays.is_real
-        state_bits = (
+        # FIRE-lane sweep gated by the pre-existing invalid state — the
+        # exact dense-BFS rule (ops/wave.py::wave_step): an already-invalid
+        # node neither re-fires its dependents nor counts as newly. A plain
+        # closure sweep over (invalid | seeds) would also propagate
+        # PRE-EXISTING invalidity (e.g. a host-led mark_invalid whose
+        # cascade the host already applied), diverging from the dense path.
+        # The gate is expressed THROUGH the sweep's own epoch machinery so
+        # _topo_sweep_impl is reused verbatim: a blocked (already-invalid)
+        # row gets epoch -3, so none of its in-edges (captured at epoch 0)
+        # version-match — it can never fire; and its bit starts 0 and is
+        # never seeded, so nothing propagates THROUGH it either.
+        blocked = (
             jnp.where(is_real, g_invalid[perm_clipped], False)
             .astype(jnp.int32)
             .at[n_tot]
             .set(0)
         )
+        node_epoch = jnp.where(blocked.astype(bool), -3, node_epoch0)
         seed_bits = (
             jnp.zeros(n_tot + 1, jnp.int32).at[seed_new_ids].set(1).at[n_tot].set(0)
+            & ~blocked
         )
-        state2, _ = _topo_sweep_impl(
-            level_starts, garrays, seed_bits, TopoState(node_epoch0, state_bits)
+        state2, count = _topo_sweep_impl(
+            level_starts,
+            garrays,
+            seed_bits,
+            TopoState(node_epoch, jnp.zeros(n_tot + 1, dtype=jnp.int32)),
         )
-        newly = (state2.invalid_bits & ~state_bits).astype(bool) & is_real
-        count = newly.sum(dtype=jnp.int32)
+        newly = state2.invalid_bits.astype(bool) & is_real
         pos = jnp.cumsum(newly.astype(jnp.int32)) - 1
         scatter_pos = jnp.where(newly & (pos < cap), pos, cap)  # OOB → dropped
         ids = (
