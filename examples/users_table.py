@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Table-backed compute methods — the columnar read path in ~60 lines.
+
+The r2 answer to the reference's read benchmark (PerformanceTest.cs:32-144):
+an ordinary ``@compute_method`` service declares ``table=TableBacking(...)``
+and gains a MemoTable twin. Scalar calls keep per-key Computed nodes (the
+reference's read pipeline); bulk reads ride ONE device gather through the
+public API; and the two stay coherent on every invalidation path — a scalar
+``invalidating()`` replay marks the columnar row stale, a row invalidation
+reaches any live scalar node.
+
+Run: python examples/users_table.py
+"""
+import asyncio
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stl_fusion_tpu.core import (
+    ComputeService,
+    FusionHub,
+    TableBacking,
+    capture,
+    compute_method,
+    invalidating,
+    memo_table_of,
+)
+
+N_USERS = 1000
+
+
+class Users(ComputeService):
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self.balances = {i: float(i) for i in range(N_USERS)}
+        self.db_reads = 0
+
+    def load_rows(self, ids: np.ndarray) -> np.ndarray:
+        """The vectorized loader the table refreshes stale rows through."""
+        self.db_reads += len(ids)
+        return np.array([self.balances[int(i)] for i in ids], dtype=np.float32)
+
+    @compute_method(table=TableBacking(rows=N_USERS, batch="load_rows"))
+    async def balance(self, uid: int) -> float:
+        self.db_reads += 1
+        return self.balances[uid]
+
+    async def deposit(self, uid: int, amount: float) -> None:
+        self.balances[uid] += amount
+        with invalidating():
+            await self.balance(uid)  # scalar replay → table row goes stale too
+
+
+async def main():
+    users = Users(FusionHub())
+
+    # scalar path: ordinary memoized reads, one node per key
+    assert await users.balance(7) == 7.0
+    assert await users.balance(7) == 7.0  # memoized
+    node = await capture(lambda: users.balance(7))
+    print(f"scalar read memoized ({users.db_reads} loads so far)")
+
+    # columnar path: the SAME service, bulk reads as one device gather
+    table = memo_table_of(users.balance)
+    everyone = np.asarray(table.read_batch(np.arange(N_USERS)))
+    print(f"bulk read of {N_USERS} balances in one gather: "
+          f"total = {everyone.sum():.0f} ({users.db_reads} loads: one vectorized refresh)")
+
+    # coherence, scalar → columnar: the ordinary write invalidates BOTH
+    await users.deposit(7, 100.0)
+    assert node.is_invalidated
+    row = float(np.asarray(table.read_batch([7]))[0])
+    assert row == 107.0, row
+    print(f"after deposit: scalar node invalidated, table row refreshed to {row}")
+
+    # coherence, columnar → scalar: a row invalidation reaches live nodes
+    node2 = await capture(lambda: users.balance(7))
+    users.balances[7] = 0.0
+    table.invalidate([7])
+    assert node2.is_invalidated
+    assert await users.balance(7) == 0.0
+    print("table.invalidate reached the live scalar node")
+    print("table-backed service OK: one API, both read shapes, coherent both ways")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -40,6 +40,13 @@ def test_hello_cart_durable_sample():
     assert "durable HelloCart OK" in stdout
 
 
+def test_users_table_sample():
+    stdout = _run("users_table.py")
+    assert "one vectorized refresh" in stdout
+    assert "table row refreshed to 107.0" in stdout
+    assert "table-backed service OK" in stdout
+
+
 def test_todo_multiprocess_sample():
     """Real cross-process multi-host: writer and serving host are separate
     OS processes sharing one sqlite file, wired by FileChangeNotifier."""
