@@ -60,6 +60,7 @@ class Computed(Generic[T]):
         "_delayed_invalidation_pending",
         "_lock",
         "_backend_nid",
+        "_ka_renewed_until",
         "__weakref__",
     )
 
@@ -76,6 +77,7 @@ class Computed(Generic[T]):
         self._delayed_invalidation_pending = False
         self._lock = threading.Lock()
         self._backend_nid: Optional[int] = None  # device-mirror node id
+        self._ka_renewed_until = 0.0  # keep-alive renewal throttle window
 
     # ------------------------------------------------------------------ state
     def _pending_probe(self) -> bool:
@@ -357,12 +359,24 @@ class Computed(Generic[T]):
 
     # ------------------------------------------------------------------ access
     def renew_timeouts(self, is_new: bool) -> None:
-        """Refresh keep-alive on every access (reference Computed.cs:248-262)."""
+        """Refresh keep-alive on access (reference Computed.cs:248-262).
+
+        Throttled: the timer wheel already snaps deadlines to a duration/64
+        grid, so renewals inside one grid cell cannot move the deadline —
+        skipping them here (one monotonic() compare) keeps the memoized-hit
+        fast path out of the timer plumbing. Worst case the deadline lags
+        one grid cell (~1.6% of the duration), same slack the wheel's
+        quantization already allows."""
         if self._state == ConsistencyState.INVALIDATED:
             return
         d = self.options.min_cache_duration
         if d > 0:
-            self._hub().timeouts.keep_alive(self, d)
+            timeouts = self._hub().timeouts
+            now = timeouts.clock.now()  # the HUB clock — TestClock-coherent
+            if not is_new and now < self._ka_renewed_until:
+                return
+            self._ka_renewed_until = now + d / 64.0
+            timeouts.keep_alive(self, d, now=now)
 
     async def update(self) -> "Computed[T]":
         """Return the latest consistent node for this input, recomputing if

@@ -9,7 +9,7 @@ error invalidation.
 """
 from __future__ import annotations
 
-from typing import TYPE_CHECKING
+from typing import TYPE_CHECKING, Optional
 
 from ..utils.moment import MomentClock
 from ..utils.timer_set import ConcurrentTimerSet
@@ -34,9 +34,11 @@ class Timeouts:
             name="invalidate",
         )
 
-    def keep_alive(self, computed: "Computed", duration: float) -> None:
+    def keep_alive(self, computed: "Computed", duration: float, now: Optional[float] = None) -> None:
+        if now is None:
+            now = self.clock.now()
         self._keep_alive.add_or_update_to_later(
-            computed, self.clock.now() + duration, grid=duration / 64.0
+            computed, now + duration, grid=duration / 64.0
         )
 
     def schedule_invalidate(self, computed: "Computed", delay: float) -> None:

@@ -336,3 +336,28 @@ async def test_anonymous_source_as_dependency():
     src.invalidate()  # cascades into doubled()
     assert doubled.is_invalidated
     assert await svc.doubled() == 10
+
+
+async def test_invalidation_delay_debounces(fresh_hub):
+    """``invalidation_delay`` (≈ ComputedOptions.InvalidationDelay): an
+    invalidate() call schedules the real wave after the delay; repeated
+    calls within the window coalesce; ``immediately=True`` bypasses it."""
+
+    class S(ComputeService):
+        @compute_method(invalidation_delay=0.05)
+        async def get(self) -> int:
+            return 1
+
+    svc = S(fresh_hub)
+    node = await capture(lambda: svc.get())
+
+    assert node.invalidate() is True      # scheduled, not yet applied
+    assert node.is_consistent
+    assert node.invalidate() is False     # debounced: already pending
+    await asyncio.wait_for(node.when_invalidated(), 2.0)
+    assert node.is_invalidated
+
+    # immediately=True bypasses the delay entirely
+    node2 = await capture(lambda: svc.get())
+    assert node2.invalidate(immediately=True) is True
+    assert node2.is_invalidated
