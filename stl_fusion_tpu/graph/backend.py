@@ -75,6 +75,7 @@ class TpuGraphBackend:
         # invalidates some OTHER node during application must still journal
         # (a global flag here would silently desync the device mask)
         self._applying_ids: set = set()
+        self._sharded_mirror: Optional[dict] = None  # see sharded_mirror
         self.waves_run = 0
         self.device_invalidations = 0
         hub.registry.on_register.append(self._on_register)
@@ -273,6 +274,80 @@ class TpuGraphBackend:
             # the host _h_invalid stale; invalid_mask() reads the device copy
             invalid=dg.invalid_mask(),
         )
+
+    def sharded_mirror(self, mesh=None, exchange: str = "packed"):
+        """Fingerprint-cached :meth:`to_sharded` — the LIVE bridge to the
+        multi-chip path. Cached by the full structural state (edges, edge
+        epochs, node epochs, n_nodes) using the same struct-version
+        shortcut as the topo mirror, so stable-topology calls are O(1);
+        ANY bump/append rebuilds on next use. Between mesh bursts the
+        single-chip dense state stays authoritative — callers sync invalid
+        state through ``invalidate_cascade_batch_sharded``."""
+        import hashlib
+
+        from .device_graph import check_structure_cache
+
+        self.flush()
+        dg = self.graph
+        sv = dg._struct_version
+        key = (id(mesh), exchange)
+
+        def fingerprint() -> bytes:
+            m = dg.n_edges
+            h = hashlib.blake2b(digest_size=16)
+            h.update(np.int64(dg.n_nodes).tobytes())
+            h.update(dg._h_edge_src[:m].tobytes())
+            h.update(dg._h_edge_dst[:m].tobytes())
+            h.update(dg._h_edge_dst_epoch[:m].tobytes())
+            h.update(dg._h_node_epoch[: dg.n_nodes].tobytes())
+            return h.digest()
+
+        cached = self._sharded_mirror
+        if (
+            cached is not None
+            and cached["key"] == key
+            and check_structure_cache(cached, sv, fingerprint)
+        ):
+            return cached["graph"]
+        sharded = self.to_sharded(mesh=mesh, exchange=exchange)
+        self._sharded_mirror = {
+            "fp": fingerprint(),
+            "key": key,
+            "validated_at": sv,
+            "graph": sharded,
+        }
+        return sharded
+
+    def invalidate_cascade_batch_sharded(self, computeds: Sequence["Computed"], mesh=None) -> int:
+        """The live multi-chip burst: expand ALL seeds in one union wave on
+        the MESH (frontier all-gather over ICI — parallel/sharded_wave.py),
+        then apply the newly-invalidated set back to the live hub exactly
+        like the single-chip path (dense mirror + two-tier host
+        application). Per-burst cost includes an O(n_nodes) invalid-state
+        sync each way — the bridge shape for burst-heavy stable topologies,
+        validated on the virtual CPU mesh (tests + dryrun)."""
+        sharded = self.sharded_mirror(mesh=mesh)
+        seeds: List[int] = []
+        fallback = 0
+        for c in computeds:
+            nid = self._id_by_input.get(c.input)
+            if nid is None:
+                c.invalidate(immediately=True)
+                fallback += 1
+            else:
+                seeds.append(nid)
+        if not seeds:
+            return fallback
+        before = self.graph.invalid_mask()
+        sharded.set_invalid(before)  # dense state is authoritative
+        count = sharded.run_wave(seeds)
+        newly = sharded.invalid_mask() & ~before
+        newly_ids = np.nonzero(newly)[0].astype(np.int32)
+        self.graph.mark_invalid(newly_ids)  # dense device + host mirror
+        self._apply_newly(newly_ids)
+        self.waves_run += 1
+        self.device_invalidations += count
+        return count + fallback
 
     def computed_for(self, node_id: int):
         """The live Computed for a backend node id (None if collected)."""

@@ -39,6 +39,23 @@ def _round_up_pow2(x: int) -> int:
     return n
 
 
+def check_structure_cache(entry: dict, struct_version: int, fp_fn) -> bool:
+    """THE shared freshness check for structure-fingerprint caches (the topo
+    mirror here, the sharded mirror in graph/backend.py): O(1) when the
+    entry was already validated — or already known stale — at this
+    struct_version, at most one O(edges) fingerprint hash per structural
+    mutation otherwise. Mutates ``entry['validated_at']``/``['missed_at']``."""
+    if entry["validated_at"] == struct_version:
+        return True
+    if entry.get("missed_at") == struct_version:
+        return False
+    if fp_fn() == entry["fp"]:
+        entry["validated_at"] = struct_version
+        return True
+    entry["missed_at"] = struct_version
+    return False
+
+
 class DeviceGraph:
     def __init__(self, node_capacity: int = 1024, edge_capacity: int = 4096):
         import jax.numpy as jnp
@@ -284,17 +301,9 @@ class DeviceGraph:
         m = self._topo_mirror
         if m is None:
             return False
-        sv = self._struct_version
-        if m["validated_at"] == sv:
-            return True
-        if m.get("missed_at") == sv:
-            return False
-        _, _, fp = self._live_edge_fingerprint()
-        if fp == m["fp"]:
-            m["validated_at"] = sv
-            return True
-        m["missed_at"] = sv
-        return False
+        return check_structure_cache(
+            m, self._struct_version, lambda: self._live_edge_fingerprint()[2]
+        )
 
     def _live_edge_fingerprint(self):
         """(live src, live dst, fingerprint) of the CURRENT live edge set

@@ -283,6 +283,16 @@ class ShardedDeviceGraph:
     def invalid_mask(self) -> np.ndarray:
         return np.asarray(self.g.invalid)[: self.n_nodes]
 
+    def set_invalid(self, mask: np.ndarray) -> None:
+        """Replace the sharded invalid state from a host mask[n_nodes-or-
+        global] (the live-mirror sync path: the single-chip dense state is
+        authoritative between mesh bursts)."""
+        inv = np.zeros(self.n_global, dtype=bool)
+        inv[: len(mask)] = np.asarray(mask[: self.n_global], dtype=bool)
+        self.g = self.g._replace(
+            invalid=jax.device_put(inv, self._node_sharding)
+        )
+
     def clear_invalid(self) -> None:
         self.g = self.g._replace(
             invalid=jax.device_put(np.zeros(self.n_global, dtype=bool), self._node_sharding)

@@ -602,3 +602,75 @@ def test_topo_mirror_random_interleaving_stress():
         np.asarray(g.device_arrays().invalid), np.asarray(twin.device_arrays().invalid)
     )
     assert mirror_served >= 3, f"mirror served only {mirror_served} bursts"
+
+
+async def test_live_sharded_burst_applies_to_hub():
+    """The LIVE multi-chip bridge end to end: a burst expanded on the
+    8-device mesh invalidates real Computeds in the hub, the dense
+    single-chip mirror stays coherent, and the sharded export is
+    fingerprint-cached (rebuilt only when topology changes)."""
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        capture,
+        compute_method,
+        set_default_hub,
+    )
+    from stl_fusion_tpu.graph import TpuGraphBackend
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        backend = TpuGraphBackend(hub)
+
+        class S(ComputeService):
+            def __init__(self):
+                super().__init__()
+                self.data = {k: i for i, k in enumerate("abcdef")}
+
+            @compute_method
+            async def get(self, k: str) -> int:
+                return self.data[k]
+
+            @compute_method
+            async def pair_sum(self, a: str, b: str) -> int:
+                return await self.get(a) + await self.get(b)
+
+        svc = S()
+        assert await svc.pair_sum("a", "b") == 1
+        assert await svc.pair_sum("c", "d") == 5
+        c_a = await capture(lambda: svc.get("a"))
+        c_c = await capture(lambda: svc.get("c"))
+        c_ab = await capture(lambda: svc.pair_sum("a", "b"))
+        c_cd = await capture(lambda: svc.pair_sum("c", "d"))
+
+        svc.data["a"] = 10
+        svc.data["c"] = 20
+        applied = backend.invalidate_cascade_batch_sharded([c_a, c_c])
+        assert applied == 4  # a, c, and both pair sums
+        assert c_a.is_invalidated and c_c.is_invalidated
+        assert c_ab.is_invalidated and c_cd.is_invalidated
+        b_node = await capture(lambda: svc.get("b"))
+        assert b_node.is_consistent  # untouched branch unaffected
+
+        # the dense mirror saw the mesh burst too: a follow-up single-chip
+        # wave from the same seed finds nothing new to invalidate
+        assert backend.invalidate_cascade(c_a) == 0
+        assert await svc.pair_sum("a", "b") == 11
+
+        # export caching: same topology+epochs → same object; a different
+        # mesh/exchange request or a structural change (a NEW node enters
+        # the graph) rebuilds
+        m1 = backend.sharded_mirror()
+        assert backend.sharded_mirror() is m1
+        assert backend.sharded_mirror(exchange="bool") is not m1
+        await svc.get("e")  # first read: new node + journal entry
+        m2 = backend.sharded_mirror()
+        assert m2 is not m1
+        c_a2 = await capture(lambda: svc.get("a"))
+        svc.data["a"] = 0
+        applied = backend.invalidate_cascade_batch_sharded([c_a2])
+        assert applied >= 2  # a + pair_sum(a,b) again at the new epochs
+        assert await svc.pair_sum("a", "b") == 1
+    finally:
+        set_default_hub(old)
