@@ -124,8 +124,13 @@ class PlainUserService:
         self.dal.update_email(uid, email)
 
 
-async def run_scalar(service, readers: int, iterations: int, mutate: bool):
-    """The reference's Test() body: N readers + 1 mutator."""
+async def run_scalar(service, readers: int, iterations: int, mutate: bool,
+                     mutator_service=None):
+    """The reference's Test() body: N readers + 1 mutator.
+    ``mutator_service`` lets the mutator run against a different surface
+    than the readers (the RPC-client mode reads through the client proxy
+    while writes land on the server service)."""
+    mut_svc = mutator_service or service
     stop = asyncio.Event()
 
     async def mutator():
@@ -133,19 +138,19 @@ async def run_scalar(service, readers: int, iterations: int, mutate: bool):
         count = 0
         while not stop.is_set():
             uid = rnd.randrange(USER_COUNT)
-            user = await service.get(uid)
+            user = await mut_svc.get(uid)
             assert user is not None
             count += 1
-            await service.update_email(uid, f"{count}@counter.org")
+            await mut_svc.update_email(uid, f"{count}@counter.org")
             try:
                 await asyncio.wait_for(stop.wait(), 0.01)
             except asyncio.TimeoutError:
                 pass
 
-    async def reader(n: int) -> int:
+    async def reader(n: int, count: int) -> int:
         rnd = random.Random(n)
         ok = 0
-        for _ in range(iterations):
+        for _ in range(count):
             uid = rnd.randrange(USER_COUNT)
             user = await service.get(uid)
             if user is not None and user["id"] == uid:
@@ -153,11 +158,12 @@ async def run_scalar(service, readers: int, iterations: int, mutate: bool):
         return ok
 
     # warmup (the reference runs iterations/4 first)
-    await asyncio.gather(*(reader(100 + i) for i in range(readers)))
+    warm = max(iterations // 4, 1)
+    await asyncio.gather(*(reader(100 + i, warm) for i in range(readers)))
 
     mut = asyncio.ensure_future(mutator()) if mutate else None
     t0 = time.perf_counter()
-    results = await asyncio.gather(*(reader(i) for i in range(readers)))
+    results = await asyncio.gather(*(reader(i, iterations) for i in range(readers)))
     elapsed = time.perf_counter() - t0
     stop.set()
     if mut:
@@ -266,6 +272,37 @@ def run_device_chained(table, n_chained: int, batch: int):
     return n_chained * batch, elapsed
 
 
+async def run_rpc_client(path: str, readers: int, iterations: int, mutate: bool):
+    """The distributed read path (≈ the reference's 'Fusion + serialization
+    per read' row): a compute CLIENT reads users.get over the in-memory RPC
+    transport. First read of a key pays the wire round trip; repeats are
+    CLIENT-CACHE hits (ClientComputed stays bound until the server pushes
+    an invalidation), so steady-state throughput shows what remote readers
+    actually see — local-hit speed, not wire speed."""
+    from stl_fusion_tpu.client import compute_client, install_compute_call_type
+    from stl_fusion_tpu.rpc import RpcHub, RpcTestTransport
+
+    server_fusion = FusionHub()
+    dal = UserDal(path)
+    service = FusionUserService(dal, server_fusion)
+    server_rpc = RpcHub("perf-server")
+    install_compute_call_type(server_rpc)
+    server_rpc.add_service("users", service)
+
+    client_rpc = RpcHub("perf-client")
+    install_compute_call_type(client_rpc)
+    RpcTestTransport(client_rpc, server_rpc)
+    users = compute_client("users", client_rpc, FusionHub())
+
+    try:
+        return await run_scalar(
+            users, readers, iterations, mutate, mutator_service=service
+        )
+    finally:
+        await client_rpc.stop()
+        await server_rpc.stop()
+
+
 async def run_scalar_worker(path: str, iterations: int, seed: int) -> None:
     """One OS-process worker of the multi-process scalar run: its own hub,
     its own memo cache, 4 readers + 1 mutator over the SHARED sqlite file —
@@ -332,6 +369,10 @@ async def main() -> None:
         ops, dt = run_multi_worker_scalar(path, args.workers, 250_000 // scale)
         results["fusion_scalar_multiworker"] = ops / dt
         print(f"fusion (scalar, {args.workers} procs): {ops / dt / 1e3:10,.1f} K ops/sec  ({ops} ops, {dt:.2f}s slowest worker loop)")
+
+    ops, dt = await run_rpc_client(path, readers=4, iterations=100_000 // scale, mutate=True)
+    results["fusion_rpc_client"] = ops / dt
+    print(f"fusion (rpc client):    {ops / dt / 1e3:12,.1f} K ops/sec  ({ops} ops, {dt:.2f}s)")
 
     dal2 = UserDal(path)
     plain_users = PlainUserService(dal2)
