@@ -115,9 +115,20 @@ class RpcInboundComputeCall(RpcInboundCall):
             self.peer.inbound_calls.pop(self.call_id, None)
             return
         try:
+            # send_ok's delivery swallows TRANSPORT failures itself
+            # (restart() re-sends); what reaches here is a serialization
+            # or middleware failure — the client must error, not hang
             await self.send_ok(out.value if out is not None else None, headers=headers)
-        except Exception:  # noqa: BLE001 — link died; restart() will re-send
-            pass
+        except asyncio.CancelledError:
+            self.peer.inbound_calls.pop(self.call_id, None)
+            raise
+        except Exception as e:  # noqa: BLE001
+            try:
+                await self.send_error(e)
+            except Exception:  # noqa: BLE001
+                pass
+            self.peer.inbound_calls.pop(self.call_id, None)
+            return
         # stay registered; push $sys-c.invalidate when the computed dies
         asyncio.get_event_loop().create_task(self._watch_invalidation(computed))
 

@@ -21,6 +21,7 @@ import asyncio
 import logging
 from typing import TYPE_CHECKING, Any, Dict, Optional, Tuple, Type
 
+from ..utils.async_utils import ChannelClosedError
 from ..utils.errors import ExceptionInfo
 from ..utils.serialization import dumps, loads
 from .message import CALL_TYPE_PLAIN, SYSTEM_SERVICE, RpcMessage
@@ -131,19 +132,46 @@ class RpcInboundCall:
         result if we have one; otherwise the original task is still running
         and will send it."""
         if self.result_message is not None:
-            asyncio.get_event_loop().create_task(self.peer.send(self.result_message))
+            asyncio.get_event_loop().create_task(self._resend_result())
+
+    async def _resend_result(self) -> None:
+        try:
+            await self.peer.send(self.result_message)
+        except Exception:  # noqa: BLE001 — link died again: next reconnect
+            pass  # redelivery will retry; never an orphan task exception
 
     async def _run(self) -> None:
+        # Phase 1 — produce the result MESSAGE. A target failure OR a
+        # result-serialization failure is the call's result (an error the
+        # client must see); ExceptionInfo itself is always wire-safe.
         try:
             result = await self.invoke_target()
-            await self.send_ok(result)
+            self._build_ok(result)
         except asyncio.CancelledError:
             self.peer.inbound_calls.pop(self.call_id, None)
             raise
         except Exception as e:  # noqa: BLE001
-            await self.send_error(e)
-        finally:
-            self.on_completed()
+            self._build_error(e)
+        # Phase 2 — deliver it. TRANSPORT death is NOT a call error: the
+        # stored result_message survives and the post-reconnect redelivery
+        # (restart) re-sends it — overwriting it with the transport
+        # exception (the pre-soak behavior) served the client a RemoteError
+        # for a call that actually succeeded. A NON-transport delivery
+        # failure (e.g. a middleware deterministically rejecting the
+        # message) falls back to a last-resort error reply so the client
+        # errors instead of hanging.
+        try:
+            await self._deliver()
+        except asyncio.CancelledError:
+            self.peer.inbound_calls.pop(self.call_id, None)
+            raise
+        except Exception as e:  # noqa: BLE001
+            try:
+                self._build_error(e)
+                await self._deliver()
+            except Exception:  # noqa: BLE001 — nothing more we can do
+                pass
+        self.on_completed()
 
     async def invoke_target(self) -> Any:
         args = loads(self.message.argument_data)
@@ -159,7 +187,9 @@ class RpcInboundCall:
                 self.message.service, self.message.method, args
             )
 
-    async def send_ok(self, result: Any, headers: tuple = ()) -> None:
+    def _build_ok(self, result: Any, headers: tuple = ()) -> None:
+        """Serialize + store the OK reply (serialization errors propagate —
+        they are CALL errors, the link is fine)."""
         self.result_message = RpcMessage(
             call_type_id=self.message.call_type_id,
             call_id=self.call_id,
@@ -168,9 +198,8 @@ class RpcInboundCall:
             argument_data=dumps(result),
             headers=headers,
         )
-        await self.peer.send(self.result_message)
 
-    async def send_error(self, error: BaseException) -> None:
+    def _build_error(self, error: BaseException) -> None:
         self.result_message = RpcMessage(
             call_type_id=self.message.call_type_id,
             call_id=self.call_id,
@@ -178,7 +207,24 @@ class RpcInboundCall:
             method="error",
             argument_data=dumps(ExceptionInfo.capture(error)),
         )
-        await self.peer.send(self.result_message)
+
+    async def _deliver(self) -> None:
+        """Send the stored result; TRANSPORT failures are swallowed — the
+        post-reconnect redelivery re-sends. Anything else propagates."""
+        try:
+            await self.peer.send(self.result_message)
+        except asyncio.CancelledError:
+            raise
+        except (ChannelClosedError, ConnectionError, OSError):
+            pass
+
+    async def send_ok(self, result: Any, headers: tuple = ()) -> None:
+        self._build_ok(result, headers)
+        await self._deliver()
+
+    async def send_error(self, error: BaseException) -> None:
+        self._build_error(error)
+        await self._deliver()
 
     def on_completed(self) -> None:
         """Plain calls stay registered for redelivery dedup; the peer prunes

@@ -195,7 +195,17 @@ class RpcPeer(WorkerBase):
         conn = self._conn
         if conn is None:
             raise ConnectionError(f"peer {self.ref} is not connected")
-        await conn.writer.send(message)
+        try:
+            await conn.writer.send(message)
+        except asyncio.CancelledError:
+            raise
+        except (ChannelClosedError, ConnectionError, OSError) as e:
+            # a failed SEND means the link is dead even when the reader
+            # still hangs (the half-open shape): tear the connection down
+            # so the pump notices and reconnects — otherwise a parked
+            # registered call waits for a reconnect that never comes
+            await self.disconnect(e)
+            raise
 
     async def send_system(self, method: str, args: list, call_id: int = 0, headers: tuple = ()) -> None:
         await self.send(

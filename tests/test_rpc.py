@@ -505,3 +505,68 @@ async def test_resend_applies_outbound_middlewares():
         assert transport.connect_count["default"] >= 2
     finally:
         await _shutdown(client_hub, server_hub)
+
+
+async def test_randomized_disconnect_soak():
+    """Link chaos: many in-flight calls with disconnects AND half-open
+    flaky connections (writer dies, reader hangs) at random points — every
+    call must still complete with the right answer via re-send + dedup.
+
+    This soak caught two real bugs when first written: (1) a transport
+    failure while DELIVERING a result was memoized as the call's error and
+    served to the client on redelivery; (2) a failed send on a half-open
+    link parked the call without tearing the link down, so the reconnect
+    it was waiting for never came."""
+    import random as _random
+
+    for seed in (11, 12, 13):
+        client_hub, server_hub, svc, transport = make_pair()
+        rnd = _random.Random(seed)
+        try:
+            proxy = client_hub.client("echo", "default")
+            futures = []
+            for i in range(50):
+                if rnd.random() < 0.3:
+                    futures.append(asyncio.ensure_future(proxy.slow(0.003, f"s{i}")))
+                else:
+                    futures.append(asyncio.ensure_future(proxy.add(i, i)))
+                if rnd.random() < 0.25:
+                    await transport.disconnect()
+                if rnd.random() < 0.1:
+                    # half-open: next connection's writer dies after a few
+                    # sends while its reader hangs silently
+                    transport.fail_next_connection_after(rnd.randrange(1, 4))
+                await asyncio.sleep(rnd.random() * 0.005)
+            results = await asyncio.wait_for(asyncio.gather(*futures), 30.0)
+            for i, r in enumerate(results):
+                assert r in (2 * i, f"s{i}"), f"seed {seed} call {i}: {r!r}"
+            assert transport.connect_count["default"] >= 2  # chaos actually hit
+        finally:
+            await _shutdown(client_hub, server_hub)
+
+
+async def test_unserializable_result_errors_instead_of_hanging():
+    """A result that cannot be wire-encoded is a CALL error the client must
+    receive (review finding: the transport-robustness change must not
+    swallow serialization failures — the link is healthy, nothing would
+    ever re-send, and the caller would hang forever)."""
+    server_hub = RpcHub("server")
+    client_hub = RpcHub("client")
+
+    class Raw:
+        async def alien(self):
+            return object()  # nothing can serialize this
+
+        async def fine(self) -> str:
+            return "ok"
+
+    server_hub.add_service("raw", Raw())
+    RpcTestTransport(client_hub, server_hub)
+    try:
+        proxy = client_hub.client("raw", "default")
+        with pytest.raises(Exception, match="serializ|wire|encode|Type"):
+            await asyncio.wait_for(proxy.alien(), 2.0)
+        # the healthy connection survived the bad result
+        assert await asyncio.wait_for(proxy.fine(), 2.0) == "ok"
+    finally:
+        await _shutdown(client_hub, server_hub)
