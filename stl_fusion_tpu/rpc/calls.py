@@ -136,9 +136,11 @@ class RpcInboundCall:
 
     async def _resend_result(self) -> None:
         try:
-            await self.peer.send(self.result_message)
-        except Exception:  # noqa: BLE001 — link died again: next reconnect
-            pass  # redelivery will retry; never an orphan task exception
+            await self._deliver_or_error()
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — never an orphan task exception
+            pass
 
     async def _run(self) -> None:
         # Phase 1 — produce the result MESSAGE. A target failure OR a
@@ -161,16 +163,10 @@ class RpcInboundCall:
         # message) falls back to a last-resort error reply so the client
         # errors instead of hanging.
         try:
-            await self._deliver()
+            await self._deliver_or_error()
         except asyncio.CancelledError:
             self.peer.inbound_calls.pop(self.call_id, None)
             raise
-        except Exception as e:  # noqa: BLE001
-            try:
-                self._build_error(e)
-                await self._deliver()
-            except Exception:  # noqa: BLE001 — nothing more we can do
-                pass
         self.on_completed()
 
     async def invoke_target(self) -> Any:
@@ -210,13 +206,37 @@ class RpcInboundCall:
 
     async def _deliver(self) -> None:
         """Send the stored result; TRANSPORT failures are swallowed — the
-        post-reconnect redelivery re-sends. Anything else propagates."""
+        post-reconnect redelivery re-sends. Anything else propagates.
+
+        Genuine transport deaths tear the connection down in _send_raw
+        before re-raising, so a caught "transport-shaped" exception on a
+        STILL-healthy link is really a middleware failure in disguise
+        (PermissionError from an auth middleware IS an OSError subclass) —
+        swallow it and nothing would ever re-send: the client hangs on a
+        healthy connection. Re-raise those for the error-reply fallback."""
         try:
             await self.peer.send(self.result_message)
         except asyncio.CancelledError:
             raise
         except (ChannelClosedError, ConnectionError, OSError):
-            pass
+            if self.peer._conn is not None:
+                raise
+
+    async def _deliver_or_error(self) -> None:
+        """Deliver the result; a NON-transport failure becomes a
+        last-resort error reply so the client errors instead of hanging."""
+        try:
+            await self._deliver()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            try:
+                self._build_error(e)
+                await self._deliver()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — nothing more we can do
+                pass
 
     async def send_ok(self, result: Any, headers: tuple = ()) -> None:
         self._build_ok(result, headers)

@@ -203,8 +203,11 @@ class RpcPeer(WorkerBase):
             # a failed SEND means the link is dead even when the reader
             # still hangs (the half-open shape): tear the connection down
             # so the pump notices and reconnects — otherwise a parked
-            # registered call waits for a reconnect that never comes
-            await self.disconnect(e)
+            # registered call waits for a reconnect that never comes.
+            # Guarded: a STALE sender waking up after a reconnect must not
+            # tear down the fresh healthy connection that replaced its own.
+            if self._conn is conn:
+                await self.disconnect(e)
             raise
 
     async def send_system(self, method: str, args: list, call_id: int = 0, headers: tuple = ()) -> None:

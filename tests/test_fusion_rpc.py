@@ -274,3 +274,51 @@ async def test_invalidate_only_restart_answer_retries():
         assert svc.computes >= 2
     finally:
         await _stop(client_rpc, server_rpc)
+
+
+async def test_fusion_client_chaos_no_lost_invalidation():
+    """Randomized chaos over the compute client: server-side increments,
+    disconnects, and half-open flaky connections interleave with client
+    reads. THE guarantee under test: no invalidation is ever lost — once
+    the chaos stops, every client read must converge to the server's value
+    (a stale-but-consistent client node that never learned of its
+    invalidation would return the old value forever and fail this)."""
+    import random as _random
+
+    for seed in (5, 6, 7):
+        svc, client, transport, client_rpc, server_rpc, _cf = make_stack()
+        rnd = _random.Random(seed)
+        keys = ["a", "b", "c", "d"]
+        try:
+            for k in keys:
+                assert await client.get(k) == 0  # bind live nodes client-side
+
+            for step in range(60):
+                action = rnd.random()
+                k = rnd.choice(keys)
+                if action < 0.45:
+                    await svc.increment(k)  # server-side write + push
+                elif action < 0.65:
+                    await client.get(k)  # interleaved client read
+                elif action < 0.85:
+                    await transport.disconnect()
+                else:
+                    transport.fail_next_connection_after(rnd.randrange(1, 3))
+                await asyncio.sleep(rnd.random() * 0.004)
+
+            # chaos over: every key must CONVERGE to the server's truth
+            loop = asyncio.get_event_loop()
+            for k in keys:
+                want = svc.counters.get(k, 0)
+                deadline = loop.time() + 10.0
+                while True:
+                    got = await client.get(k)
+                    if got == want:
+                        break
+                    assert loop.time() < deadline, (
+                        f"seed {seed}: client stuck at {k}={got}, server has "
+                        f"{want} — an invalidation was lost"
+                    )
+                    await asyncio.sleep(0.05)
+        finally:
+            await _stop(client_rpc, server_rpc)

@@ -570,3 +570,30 @@ async def test_unserializable_result_errors_instead_of_hanging():
         assert await asyncio.wait_for(proxy.fine(), 2.0) == "ok"
     finally:
         await _shutdown(client_hub, server_hub)
+
+
+async def test_outbound_middleware_rejecting_result_errors_client():
+    """A server-side outbound middleware that deterministically rejects a
+    RESULT message (PermissionError — an OSError subclass that must not be
+    mistaken for transport death on a healthy link) must produce an error
+    reply for the client, not a silent hang."""
+    client_hub, server_hub, svc, transport = make_pair()
+
+    async def censor(peer, message, nxt):
+        from stl_fusion_tpu.utils.serialization import loads
+
+        if message.method == "ok" and loads(message.argument_data) == "server:secret":
+            raise PermissionError("classified")
+        await nxt(message)
+
+    server_hub.outbound_middlewares.append(censor)
+    try:
+        proxy = client_hub.client("echo", "default")
+        assert await proxy.echo("open") == "server:open"
+        with pytest.raises(PermissionError, match="classified"):
+            await asyncio.wait_for(proxy.echo("secret"), 2.0)
+        # the healthy connection survived the rejection
+        assert await proxy.echo("still-open") == "server:still-open"
+        assert transport.connect_count["default"] == 1
+    finally:
+        await _shutdown(client_hub, server_hub)
