@@ -136,11 +136,18 @@ class RpcInboundCall:
 
     async def _resend_result(self) -> None:
         try:
-            await self._deliver_or_error()
+            await self._deliver()
         except asyncio.CancelledError:
             raise
-        except Exception:  # noqa: BLE001 — never an orphan task exception
-            pass
+        except Exception as e:  # noqa: BLE001 — non-transport redelivery
+            # failure: answer THIS redelivery with an error WITHOUT
+            # replacing the stored result — a transient middleware failure
+            # must not permanently poison a successful call (the client's
+            # next redelivery gets the real result again)
+            try:
+                await self.peer.send(self._error_message(e))
+            except Exception:  # noqa: BLE001 — never an orphan task exception
+                pass
 
     async def _run(self) -> None:
         # Phase 1 — produce the result MESSAGE. A target failure OR a
@@ -195,8 +202,8 @@ class RpcInboundCall:
             headers=headers,
         )
 
-    def _build_error(self, error: BaseException) -> None:
-        self.result_message = RpcMessage(
+    def _error_message(self, error: BaseException) -> RpcMessage:
+        return RpcMessage(
             call_type_id=self.message.call_type_id,
             call_id=self.call_id,
             service=SYSTEM_SERVICE,
@@ -204,22 +211,28 @@ class RpcInboundCall:
             argument_data=dumps(ExceptionInfo.capture(error)),
         )
 
+    def _build_error(self, error: BaseException) -> None:
+        self.result_message = self._error_message(error)
+
     async def _deliver(self) -> None:
         """Send the stored result; TRANSPORT failures are swallowed — the
         post-reconnect redelivery re-sends. Anything else propagates.
 
-        Genuine transport deaths tear the connection down in _send_raw
-        before re-raising, so a caught "transport-shaped" exception on a
-        STILL-healthy link is really a middleware failure in disguise
-        (PermissionError from an auth middleware IS an OSError subclass) —
-        swallow it and nothing would ever re-send: the client hangs on a
-        healthy connection. Re-raise those for the error-reply fallback."""
+        Classification: a genuine transport death either tears the
+        connection down in _send_raw before re-raising (current-conn
+        failure → ``peer._conn`` is None here) or is tagged as a STALE
+        sender's failure (the conn it used was already replaced by a
+        reconnect). A caught "transport-shaped" exception that is neither
+        is really a middleware failure in disguise (PermissionError from
+        an auth middleware IS an OSError subclass) — swallow it and
+        nothing would ever re-send: the client hangs on a healthy
+        connection. Re-raise those for the error-reply fallback."""
         try:
             await self.peer.send(self.result_message)
         except asyncio.CancelledError:
             raise
-        except (ChannelClosedError, ConnectionError, OSError):
-            if self.peer._conn is not None:
+        except (ChannelClosedError, ConnectionError, OSError) as e:
+            if self.peer._conn is not None and not getattr(e, "_stale_conn_send", False):
                 raise
 
     async def _deliver_or_error(self) -> None:

@@ -205,9 +205,13 @@ class RpcPeer(WorkerBase):
             # so the pump notices and reconnects — otherwise a parked
             # registered call waits for a reconnect that never comes.
             # Guarded: a STALE sender waking up after a reconnect must not
-            # tear down the fresh healthy connection that replaced its own.
+            # tear down the fresh healthy connection that replaced its own —
+            # its failure is tagged so result-delivery paths classify it as
+            # transport death (redelivery re-sends), not a middleware error.
             if self._conn is conn:
                 await self.disconnect(e)
+            else:
+                e._stale_conn_send = True
             raise
 
     async def send_system(self, method: str, args: list, call_id: int = 0, headers: tuple = ()) -> None:

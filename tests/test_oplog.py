@@ -494,3 +494,74 @@ async def test_cross_process_write_invalidates_host_computed(tmp_path):
     finally:
         await reader.stop()
         log_store.close()
+
+
+# ------------------------------------------------ multi-host chaos
+
+async def test_multihost_chaos_convergence(tmp_path):
+    """Randomized multi-host chaos: commands land on either host while
+    each host's log READER is randomly killed and restarted from its
+    watermark (the crash/recovery shape). Invariant: once the dust
+    settles, BOTH hosts' memoized reads converge to the database — a
+    missed replay (bad watermark resume, dropped notification, dedup
+    overreach) would leave one host stale forever."""
+    import random as _random
+
+    for seed in (3, 4):
+        DB.clear()
+        log_store = InMemoryOperationLog()
+        notifier = LocalChangeNotifier()
+        hub_a, svc_a, reader_a = make_host(log_store, notifier)
+        hub_b, svc_b, reader_b = make_host(log_store, notifier)
+        readers = {"a": reader_a, "b": reader_b}
+        hubs = {"a": hub_a, "b": hub_b}
+        svcs = {"a": svc_a, "b": svc_b}
+        rnd = _random.Random(seed)
+        keys = ["k1", "k2", "k3"]
+        counter = 0
+        try:
+            for host in ("a", "b"):
+                for k in keys:
+                    await svcs[host].get(k)  # live nodes on both hosts
+
+            for step in range(50):
+                action = rnd.random()
+                host = rnd.choice(["a", "b"])
+                k = rnd.choice(keys)
+                if action < 0.5:
+                    counter += 1
+                    await hubs[host].commander.call(SetValue(k, counter))
+                elif action < 0.7:
+                    await svcs[host].get(k)
+                else:
+                    # crash the reader; restart from its watermark (the
+                    # checkpoint/resume shape, mid-stream)
+                    from stl_fusion_tpu.oplog import OperationLogReader
+
+                    old = readers[host]
+                    position = old.watermark
+                    await old.stop()
+                    new = OperationLogReader(
+                        log_store, hubs[host].commander.operations, notifier,
+                        start_position=position,
+                    )
+                    new.poll_period = 0.02
+                    new.start()
+                    readers[host] = new
+                await asyncio.sleep(rnd.random() * 0.003)
+
+            # settle: both hosts must converge to the DB on every key
+            loop = asyncio.get_event_loop()
+            for host in ("a", "b"):
+                for k in keys:
+                    want = DB.get(k, 0)
+                    deadline = loop.time() + 10.0
+                    while (await svcs[host].get(k)) != want:
+                        assert loop.time() < deadline, (
+                            f"seed {seed}: host {host} stuck at {k}="
+                            f"{await svcs[host].get(k)}, DB has {want}"
+                        )
+                        await asyncio.sleep(0.05)
+        finally:
+            for r in readers.values():
+                await r.stop()

@@ -139,3 +139,37 @@ async def test_when_predicate():
     c = await s.when(lambda v: v >= 3)
     assert c.output.value >= 3
     await task
+
+
+async def test_computed_state_invalidation_storm_converges(fresh_hub):
+    """An invalidation storm (rapid source flips racing the update loop,
+    with and without an update delay) must end with the state CONVERGED to
+    the final source value — a stuck update cycle or a swallowed
+    invalidation would leave it stale forever."""
+    for delay in (0.0, 0.005):
+        source = MutableState(0, fresh_hub)
+
+        async def compute():
+            return await source.use() * 2
+
+        delayer = FixedDelayer.ZERO_UNSAFE if delay == 0.0 else FixedDelayer(delay)
+        state = ComputedState(compute, fresh_hub, update_delayer=delayer)
+        state.start()
+        try:
+            await state.when_first_value()
+            for i in range(1, 301):
+                source.set(i)
+                if i % 7 == 0:
+                    await asyncio.sleep(0)  # let the update loop interleave
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + 10.0
+            while True:
+                snap = state.snapshot
+                if snap.computed.is_consistent and state.value == 600:
+                    break
+                assert loop.time() < deadline, (
+                    f"delay={delay}: state stuck at {state.value_or_default}"
+                )
+                await asyncio.sleep(0.01)
+        finally:
+            await state.dispose()
