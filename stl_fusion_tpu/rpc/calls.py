@@ -135,19 +135,11 @@ class RpcInboundCall:
             asyncio.get_event_loop().create_task(self._resend_result())
 
     async def _resend_result(self) -> None:
-        try:
-            await self._deliver()
-        except asyncio.CancelledError:
-            raise
-        except Exception as e:  # noqa: BLE001 — non-transport redelivery
-            # failure: answer THIS redelivery with an error WITHOUT
-            # replacing the stored result — a transient middleware failure
-            # must not permanently poison a successful call (the client's
-            # next redelivery gets the real result again)
-            try:
-                await self.peer.send(self._error_message(e))
-            except Exception:  # noqa: BLE001 — never an orphan task exception
-                pass
+        # a non-transport redelivery failure answers with a one-shot error
+        # (completing the client's re-sent call with it) while the STORED
+        # result stays the true one — a transient middleware failure is
+        # surfaced as that one call's error, never memoized as the result
+        await self._deliver_or_error()
 
     async def _run(self) -> None:
         # Phase 1 — produce the result MESSAGE. A target failure OR a
@@ -218,34 +210,35 @@ class RpcInboundCall:
         """Send the stored result; TRANSPORT failures are swallowed — the
         post-reconnect redelivery re-sends. Anything else propagates.
 
-        Classification: a genuine transport death either tears the
-        connection down in _send_raw before re-raising (current-conn
-        failure → ``peer._conn`` is None here) or is tagged as a STALE
-        sender's failure (the conn it used was already replaced by a
-        reconnect). A caught "transport-shaped" exception that is neither
-        is really a middleware failure in disguise (PermissionError from
-        an auth middleware IS an OSError subclass) — swallow it and
-        nothing would ever re-send: the client hangs on a healthy
-        connection. Re-raise those for the error-reply fallback."""
+        Classification is by the ``_transport_death`` tag the peer stamps
+        on every genuine transport failure AT ITS RAISE SITE (race-free —
+        never by peeking at the peer's mutable connection slot, which a
+        reconnect can refresh before this except clause runs). An
+        OSError-shaped exception WITHOUT the tag is a middleware failure
+        in disguise (PermissionError from an auth middleware IS an OSError
+        subclass) — swallow it and nothing would ever re-send: the client
+        hangs on a healthy connection. Those re-raise for the error-reply
+        fallback."""
         try:
             await self.peer.send(self.result_message)
         except asyncio.CancelledError:
             raise
         except (ChannelClosedError, ConnectionError, OSError) as e:
-            if self.peer._conn is not None and not getattr(e, "_stale_conn_send", False):
+            if not getattr(e, "_transport_death", False):
                 raise
 
     async def _deliver_or_error(self) -> None:
-        """Deliver the result; a NON-transport failure becomes a
-        last-resort error reply so the client errors instead of hanging."""
+        """Deliver the result; a NON-transport failure is answered with a
+        ONE-SHOT error reply so the client errors instead of hanging —
+        WITHOUT overwriting the stored result_message, which must stay the
+        call's true result for any later redelivery."""
         try:
             await self._deliver()
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001
             try:
-                self._build_error(e)
-                await self._deliver()
+                await self.peer.send(self._error_message(e))
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001 — nothing more we can do

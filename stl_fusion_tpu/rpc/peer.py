@@ -182,9 +182,15 @@ class RpcPeer(WorkerBase):
                 continue  # reconnect loop
 
     # ------------------------------------------------------------------ send
+    @staticmethod
+    def _not_connected(ref: str) -> ConnectionError:
+        e = ConnectionError(f"peer {ref} is not connected")
+        e._transport_death = True  # see _send_raw
+        return e
+
     async def send(self, message: RpcMessage) -> None:
         if self._conn is None:
-            raise ConnectionError(f"peer {self.ref} is not connected")
+            raise self._not_connected(self.ref)
         mws = self.hub.outbound_middlewares
         if mws:
             await _run_middlewares(mws, self, message, self._send_raw)
@@ -194,7 +200,7 @@ class RpcPeer(WorkerBase):
     async def _send_raw(self, message: RpcMessage) -> None:
         conn = self._conn
         if conn is None:
-            raise ConnectionError(f"peer {self.ref} is not connected")
+            raise self._not_connected(self.ref)
         try:
             await conn.writer.send(message)
         except asyncio.CancelledError:
@@ -205,13 +211,15 @@ class RpcPeer(WorkerBase):
             # so the pump notices and reconnects — otherwise a parked
             # registered call waits for a reconnect that never comes.
             # Guarded: a STALE sender waking up after a reconnect must not
-            # tear down the fresh healthy connection that replaced its own —
-            # its failure is tagged so result-delivery paths classify it as
-            # transport death (redelivery re-sends), not a middleware error.
+            # tear down the fresh healthy connection that replaced its own.
+            # EVERY genuine transport failure is tagged on the exception at
+            # its raise site: delivery paths classify by this tag (race-
+            # free), never by peeking at the shared mutable _conn — an
+            # OSError-shaped exception WITHOUT the tag is a middleware
+            # failure in disguise.
+            e._transport_death = True
             if self._conn is conn:
                 await self.disconnect(e)
-            else:
-                e._stale_conn_send = True
             raise
 
     async def send_system(self, method: str, args: list, call_id: int = 0, headers: tuple = ()) -> None:

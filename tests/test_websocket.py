@@ -87,3 +87,52 @@ async def test_websocket_reconnect_resumes_same_server_peer():
     finally:
         await client_hub.stop()
         await server.stop()
+
+
+async def test_websocket_chaos_calls_and_invalidation_survive():
+    """Chaos over REAL sockets: server-side connection kills interleave
+    with plain calls AND fusion invalidation pushes. Every call completes;
+    the compute client converges to the server's state (no invalidation
+    lost across reconnects on the real transport)."""
+    import random as _random
+
+    for seed in (1, 2):
+        rnd = _random.Random(seed)
+        server_fusion = FusionHub()
+        svc = Counters(server_fusion)
+        server_hub = RpcHub("ws-chaos-server")
+        install_compute_call_type(server_hub)
+        server_hub.add_service("echo", Echo())
+        server_hub.add_service("counters", svc)
+        server = await RpcWebSocketServer(server_hub).start()
+        client_hub = RpcHub("ws-chaos-client")
+        install_compute_call_type(client_hub)
+        client_hub.client_connector = websocket_client_connector(server.url)
+        counters = compute_client("counters", client_hub, FusionHub())
+        try:
+            proxy = client_hub.client("echo", "default")
+            assert await counters.get("k") == 0
+            futures = []
+            for i in range(30):
+                futures.append(asyncio.ensure_future(proxy.echo(str(i))))
+                action = rnd.random()
+                if action < 0.4:
+                    await svc.increment("k")
+                elif action < 0.6:
+                    # kill the SERVER side of the live connection
+                    for peer in list(server_hub.peers.values()):
+                        await peer.disconnect(ConnectionError("chaos"))
+                await asyncio.sleep(rnd.random() * 0.01)
+            results = await asyncio.wait_for(asyncio.gather(*futures), 30.0)
+            assert results == [f"ws:{i}" for i in range(30)]
+
+            loop = asyncio.get_event_loop()
+            want = svc.data.get("k", 0)
+            deadline = loop.time() + 10.0
+            while (await counters.get("k")) != want:
+                assert loop.time() < deadline, f"seed {seed}: client stuck"
+                await asyncio.sleep(0.05)
+        finally:
+            await client_hub.stop()
+            await server.stop()
+            await server_hub.stop()
