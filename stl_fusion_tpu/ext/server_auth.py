@@ -85,19 +85,32 @@ class ServerAuthHelper:
         principal: Optional[Principal],
         ip_address: str = "",
         user_agent: str = "",
+        principal_authoritative: bool = True,
     ) -> None:
         """The reconciliation decision tree (ServerAuthHelper.cs:73-113)."""
         info = await self.auth.get_session_info(session)
+        # empty incoming ip/user_agent means "transport didn't report one",
+        # mirroring SetupSessionCommand's empty-means-keep write semantics —
+        # comparing it against a stored non-empty value would flag must_setup
+        # on EVERY request while the keep-semantics write never converges
+        # (ADVICE r2), flooding the shared op log
         must_setup = (
             info is None
-            or info.ip_address != ip_address
-            or info.user_agent != user_agent
+            or (bool(ip_address) and info.ip_address != ip_address)
+            or (bool(user_agent) and info.user_agent != user_agent)
             or info.last_seen_at + self.session_info_update_period < self.clock()
         )
         if must_setup:
             await self.commander.call(
                 SetupSessionCommand(session, ip_address, user_agent)
             )
+        if not principal_authoritative:
+            # the transport could not vouch for who is calling (untrusted
+            # peer): neither sign in NOR sign out — an unauthenticated
+            # direct request must not revoke a signed-in session. Session
+            # setup/presence above still ran; they carry no identity.
+            await self._update_presence(session)
+            return
         user = await self.auth.get_user(session)
         try:
             if principal is not None:

@@ -290,7 +290,6 @@ class TpuGraphBackend:
         self.flush()
         dg = self.graph
         sv = dg._struct_version
-        key = (id(mesh), exchange)
 
         def fingerprint() -> bytes:
             m = dg.n_edges
@@ -303,16 +302,28 @@ class TpuGraphBackend:
             return h.digest()
 
         cached = self._sharded_mirror
-        if (
-            cached is not None
-            and cached["key"] == key
-            and check_structure_cache(cached, sv, fingerprint)
-        ):
-            return cached["graph"]
+        # the mesh is compared by IDENTITY via a weakref — keying on a bare
+        # id(mesh) would alias a new mesh that reuses a collected mesh's id
+        # (ADVICE r2), and a strong reference would pin a discarded mesh
+        # (plus its derived graph) for the backend's lifetime; a dead ref
+        # simply misses and rebuilds
+        if cached is not None:
+            cached_ref = cached["mesh"]
+            same_mesh = (
+                cached_ref is None if mesh is None
+                else cached_ref is not None and cached_ref() is mesh
+            )
+            if (
+                same_mesh
+                and cached["exchange"] == exchange
+                and check_structure_cache(cached, sv, fingerprint)
+            ):
+                return cached["graph"]
         sharded = self.to_sharded(mesh=mesh, exchange=exchange)
         self._sharded_mirror = {
             "fp": fingerprint(),
-            "key": key,
+            "mesh": weakref.ref(mesh) if mesh is not None else None,
+            "exchange": exchange,
             "validated_at": sv,
             "graph": sharded,
         }

@@ -40,6 +40,20 @@ __all__ = ["FusionHttpServer", "HttpSessionMiddleware", "RestClient", "RestError
 PATH_PREFIX = "/fusion/"
 
 
+def _normalize_ip(ip: str) -> str:
+    """Canonical peer-address form for allowlist membership: a dual-stack
+    listener reports the loopback sidecar as ``::ffff:127.0.0.1``, which
+    must match a ``127.0.0.1`` allowlist entry."""
+    import ipaddress
+
+    try:
+        addr = ipaddress.ip_address(ip)
+    except ValueError:
+        return ip
+    mapped = getattr(addr, "ipv4_mapped", None)
+    return str(mapped if mapped is not None else addr)
+
+
 class HttpSessionMiddleware:
     """Cookie-based Session issue/resolve for the HTTP gateway
     (≈ SessionMiddleware.cs): a request without a valid session cookie gets
@@ -106,10 +120,32 @@ class FusionHttpServer:
         #: principal (trusted proxy headers) with the fusion session's user
         #: (≈ ServerAuthHelper.UpdateAuthState called from the host filter)
         self.auth_helper = None
+        #: peer IPs allowed to supply ``x-auth-request-*`` principal headers.
+        #: Without this gate any client that can reach the port directly
+        #: could impersonate any user (ADVICE r2). Default = loopback — the
+        #: sidecar reverse-proxy deployment shape; widen explicitly for a
+        #: proxy on another host, or use :attr:`proxy_shared_secret`.
+        self.trusted_proxies: frozenset = frozenset({"127.0.0.1", "::1"})
+        #: when set, proxy trust is decided by this shared secret instead:
+        #: the proxy must stamp it in ``x-auth-request-secret`` (constant-
+        #: time compared); requests without it are treated as anonymous
+        self.proxy_shared_secret: Optional[str] = None
         #: path → (content_type, body): static pages served next to the
         #: JSON API (the sample-UI host path, ≈ MapBlazorHub + index.html)
         self.static_routes: dict = {}
         self._server: Optional[asyncio.AbstractServer] = None
+
+    def _is_trusted_proxy(self, headers: dict) -> bool:
+        if self.proxy_shared_secret is not None:
+            import hmac
+
+            # bytes compare: compare_digest raises on non-ASCII str, which
+            # would 500 the request instead of degrading to anonymous
+            return hmac.compare_digest(
+                headers.get("x-auth-request-secret", "").encode("utf-8", "replace"),
+                self.proxy_shared_secret.encode("utf-8", "replace"),
+            )
+        return _normalize_ip(headers.get("_ip", "")) in self.trusted_proxies
 
     async def start(self) -> "FusionHttpServer":
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
@@ -231,15 +267,26 @@ class FusionHttpServer:
                 args = mw.replace_default_sessions(args, session)
                 if self.auth_helper is not None:
                     # ≈ ServerAuthHelper.UpdateAuthState per request: sync
-                    # the transport principal into the fusion session
+                    # the transport principal into the fusion session.
+                    # Principal headers are honored ONLY from a trusted
+                    # proxy peer — a direct client's copies are ignored, so
+                    # impersonation requires owning the proxy, not just
+                    # reaching the port. Untrusted ≠ anonymous: an untrusted
+                    # peer's request must not sign an existing session OUT
+                    # either (that would let any direct client revoke a
+                    # user's session everywhere via the replicated op log),
+                    # so reconciliation is skipped and only session setup +
+                    # presence run
                     from ..ext.server_auth import principal_from_headers
 
                     h = headers or {}
+                    trusted = self._is_trusted_proxy(h)
                     await self.auth_helper.update_auth_state(
                         session,
-                        principal_from_headers(h),
+                        principal_from_headers(h) if trusted else None,
                         ip_address=h.get("_ip", ""),
                         user_agent=h.get("user-agent", ""),
+                        principal_authoritative=trusted,
                     )
             result = await self.rpc_hub.service_registry.invoke(service, method, args)
             return "200 OK", {"ok": encode(result)}, extra_headers
@@ -298,6 +345,11 @@ class RestClient:
             if self.cookies
             else ""
         )
+        for k, v in self.headers.items():
+            # CR/LF in a header would splice extra headers (or a whole
+            # pipelined request) into the buffer below — reject loudly
+            if "\r" in k or "\n" in k or "\r" in v or "\n" in v:
+                raise ValueError(f"illegal CR/LF in header {k!r}")
         cookie_line += "".join(f"{k}: {v}\r\n" for k, v in self.headers.items())
         try:
             reader, writer = await asyncio.open_connection(self.host, self.port)

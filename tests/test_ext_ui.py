@@ -643,3 +643,167 @@ async def test_auth_helper_forced_signout_never_signs_in(fresh_hub):
     await helper.update_auth_state(session, alice, "ip", "ua")
     assert await auth.get_user(session) is None
     assert await auth.is_sign_out_forced(session)
+
+
+async def test_gateway_ignores_principal_from_untrusted_peer(fresh_hub):
+    """ADVICE r2 (medium): x-auth-request-* headers from a peer outside the
+    trusted-proxy allowlist must be ignored — the request proceeds as
+    anonymous instead of signing the session in as the claimed user."""
+    from stl_fusion_tpu.ext import ServerAuthHelper
+    from stl_fusion_tpu.rpc import HttpSessionMiddleware, RpcHub
+    from stl_fusion_tpu.rpc.http_gateway import FusionHttpServer, RestClient
+
+    auth = InMemoryAuthService(fresh_hub)
+    fresh_hub.commander.add_service(auth)
+
+    class Api:
+        async def ping(self) -> str:
+            return "pong"
+
+    rpc = RpcHub("auth-gate")
+    rpc.add_service("api", Api())
+    server = await FusionHttpServer(rpc, session_middleware=HttpSessionMiddleware()).start()
+    server.auth_helper = ServerAuthHelper(auth, fresh_hub.commander)
+    server.trusted_proxies = frozenset()  # this test's loopback peer is NOT trusted
+    try:
+        client = RestClient(
+            server.url, "api", headers={"X-Auth-Request-User": "mallory"}
+        )
+        assert await client.ping() == "pong"
+        import urllib.parse
+
+        session = Session(urllib.parse.unquote(client.cookies["FusionSession"]))
+        assert await auth.get_user(session) is None  # impersonation rejected
+    finally:
+        await server.stop()
+        await rpc.stop()
+
+
+async def test_gateway_shared_secret_proxy_trust(fresh_hub):
+    """With proxy_shared_secret set, trust is decided by the secret header:
+    the right secret signs in, a missing/wrong one stays anonymous."""
+    from stl_fusion_tpu.ext import ServerAuthHelper
+    from stl_fusion_tpu.rpc import HttpSessionMiddleware, RpcHub
+    from stl_fusion_tpu.rpc.http_gateway import FusionHttpServer, RestClient
+
+    auth = InMemoryAuthService(fresh_hub)
+    fresh_hub.commander.add_service(auth)
+
+    class Api:
+        async def ping(self) -> str:
+            return "pong"
+
+    rpc = RpcHub("auth-secret")
+    rpc.add_service("api", Api())
+    server = await FusionHttpServer(rpc, session_middleware=HttpSessionMiddleware()).start()
+    server.auth_helper = ServerAuthHelper(auth, fresh_hub.commander)
+    server.proxy_shared_secret = "s3cret"
+    try:
+        import urllib.parse
+
+        bad = RestClient(
+            server.url, "api",
+            headers={"X-Auth-Request-User": "mallory", "X-Auth-Request-Secret": "wrong"},
+        )
+        assert await bad.ping() == "pong"
+        bad_session = Session(urllib.parse.unquote(bad.cookies["FusionSession"]))
+        assert await auth.get_user(bad_session) is None
+
+        good = RestClient(
+            server.url, "api",
+            headers={"X-Auth-Request-User": "bob", "X-Auth-Request-Secret": "s3cret"},
+        )
+        assert await good.ping() == "pong"
+        good_session = Session(urllib.parse.unquote(good.cookies["FusionSession"]))
+        user = await auth.get_user(good_session)
+        assert user is not None and user.id == "bob"
+    finally:
+        await server.stop()
+        await rpc.stop()
+
+
+async def test_rest_client_rejects_header_injection(fresh_hub):
+    """ADVICE r2 (low): a CR/LF in an extra header name/value must raise,
+    not splice headers into the request buffer."""
+    from stl_fusion_tpu.rpc.http_gateway import RestClient
+
+    client = RestClient(
+        "http://127.0.0.1:1", "api",
+        headers={"X-Evil": "v\r\nX-Auth-Request-User: root"},
+    )
+    with pytest.raises(ValueError, match="CR/LF"):
+        await client.call("ping", [])
+
+
+async def test_auth_helper_empty_transport_values_converge(fresh_hub):
+    """ADVICE r2 (low): an empty incoming ip/user_agent (transport didn't
+    report one) must not flag must_setup against stored non-empty values —
+    otherwise every request writes a SetupSession op that never converges."""
+    from stl_fusion_tpu.ext import Principal, ServerAuthHelper
+
+    auth = InMemoryAuthService(fresh_hub)
+    fresh_hub.commander.add_service(auth)
+    clock_now = [1000.0]
+    helper = ServerAuthHelper(auth, fresh_hub.commander, clock=lambda: clock_now[0])
+    session = Session.new()
+    alice = Principal("oidc", "alice", "Alice")
+
+    await helper.update_auth_state(session, alice, "1.2.3.4", "agent/1")
+    info = await auth.get_session_info(session)
+    assert info.ip_address == "1.2.3.4"
+
+    # empty transport values, fresh presence → NO SetupSession write
+    seen_before = (await auth.get_session_info(session)).last_seen_at
+    await helper.update_auth_state(session, alice, "", "")
+    info2 = await auth.get_session_info(session)
+    assert info2.ip_address == "1.2.3.4"  # kept, and ...
+    assert info2.last_seen_at == seen_before  # ... presence throttle held: no write
+
+    # a REAL change still triggers setup
+    await helper.update_auth_state(session, alice, "5.6.7.8", "")
+    assert (await auth.get_session_info(session)).ip_address == "5.6.7.8"
+
+
+async def test_untrusted_request_never_signs_out_existing_session(fresh_hub):
+    """Review r3: an untrusted peer's request (no vouchable principal) must
+    not sign an existing session OUT — otherwise any direct client could
+    revoke a user's session everywhere via the replicated op log."""
+    from stl_fusion_tpu.ext import ServerAuthHelper
+    from stl_fusion_tpu.rpc import HttpSessionMiddleware, RpcHub
+    from stl_fusion_tpu.rpc.http_gateway import FusionHttpServer, RestClient
+
+    auth = InMemoryAuthService(fresh_hub)
+    fresh_hub.commander.add_service(auth)
+
+    class Api:
+        async def ping(self) -> str:
+            return "pong"
+
+    rpc = RpcHub("auth-noflap")
+    rpc.add_service("api", Api())
+    server = await FusionHttpServer(rpc, session_middleware=HttpSessionMiddleware()).start()
+    server.auth_helper = ServerAuthHelper(auth, fresh_hub.commander)
+    try:
+        client = RestClient(server.url, "api", headers={"X-Auth-Request-User": "bob"})
+        assert await client.ping() == "pong"  # trusted (loopback default) → signed in
+        import urllib.parse
+
+        session = Session(urllib.parse.unquote(client.cookies["FusionSession"]))
+        assert (await auth.get_user(session)) is not None
+
+        # the SAME session now arrives via an untrusted path (e.g. a direct
+        # hit bypassing the proxy): no principal headers honored — and the
+        # signed-in state must survive
+        server.trusted_proxies = frozenset()
+        client.headers.clear()
+        assert await client.ping() == "pong"
+        user = await auth.get_user(session)
+        assert user is not None and user.id == "bob"
+
+        # back on the trusted path with headers gone → genuine sign-out
+        server.trusted_proxies = frozenset({"127.0.0.1", "::1"})
+        assert await client.ping() == "pong"
+        assert await auth.get_user(session) is None
+    finally:
+        await server.stop()
+        await rpc.stop()
