@@ -112,18 +112,22 @@ async def main() -> None:
         rtt_ms = (time.perf_counter() - t0) / 3 * 1e3
 
         # -------- lone-wave latency through invalidate_cascade (shallow
-        # seeds: the shape of a typical edit), RTT-inclusive by design
-        shallow = [n - 1 - int(i) for i in rng.choice(n // 100, size=lat_waves, replace=False)]
-        computeds = [await capture(lambda i=i: svc.node(i)) for i in shallow]
-        note("compiling the collect kernel (first invalidate_cascade)...")
-        backend.invalidate_cascade(computeds[0])  # compile the collect kernel
-        note("collect kernel compiled; timing lone waves...")
-        lat = []
-        for c in computeds[1:]:
-            t0 = time.perf_counter()
-            backend.invalidate_cascade(c)
-            lat.append((time.perf_counter() - t0) * 1e3)
-        lat_arr = np.asarray(lat)
+        # seeds: the shape of a typical edit), RTT-inclusive by design.
+        # LIVE_LAT_WAVES=0 skips (bench.py's embedded live section does —
+        # the RTT-bound numbers don't change and each wave is a dispatch)
+        lat_arr = None
+        if lat_waves > 1:
+            shallow = [n - 1 - int(i) for i in rng.choice(n // 100, size=lat_waves, replace=False)]
+            computeds = [await capture(lambda i=i: svc.node(i)) for i in shallow]
+            note("compiling the collect kernel (first invalidate_cascade)...")
+            backend.invalidate_cascade(computeds[0])  # compile the collect kernel
+            note("collect kernel compiled; timing lone waves...")
+            lat = []
+            for c in computeds[1:]:
+                t0 = time.perf_counter()
+                backend.invalidate_cascade(c)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            lat_arr = np.asarray(lat)
 
         # -------- burst throughput: deep seeds (hubs) through the batch API
         deep_ids = rng.choice(n // 10, size=n_waves, replace=False).tolist()
@@ -159,34 +163,83 @@ async def main() -> None:
         mirror_burst_s = time.perf_counter() - t0
         assert total_m == total, (total_m, total)  # mirror ≡ dense at scale
 
-        # -------- the same live-built graph on the flagship static kernel
-        from stl_fusion_tpu.ops.topo_wave import (
-            build_topo_graph,
-            build_topo_wave32,
-            topo_seeds_to_bits,
-        )
-
-        dg = backend.graph
-        m = dg.n_edges
-        topo = build_topo_graph(dg._h_edge_src[:m], dg._h_edge_dst[:m], n, k=4)
-        words = 4
-        state0, wave32 = build_topo_wave32(topo, words=words)
-        seed_lists = [
-            rng.choice(n, size=max(n // 100, 1), replace=False) for _ in range(32 * words)
+        # -------- lane-packed burst: THE live headline (VERDICT r2 #1).
+        # Each group = the computeds one command's completion invalidates;
+        # every group cascades INDEPENDENTLY in its own bit lane, 32 groups
+        # per packed word, one mirror sweep per dispatch — the live path at
+        # the static kernel's lane occupancy instead of one union lane.
+        n_groups = int(os.environ.get("LIVE_LANE_GROUPS", 256))
+        seeds_per_group = int(os.environ.get("LIVE_LANE_SEEDS", 8))
+        group_ids = [
+            rng.choice(n // 10, size=seeds_per_group, replace=False).tolist()
+            for _ in range(n_groups)
         ]
-        bits = jnp.asarray(topo_seeds_to_bits(topo, seed_lists, words=words))
-        note("compiling the static topo export...")
-        # the JITTED step (graph arrays as runtime args) — the raw
-        # ``wave32.impl`` executes EAGERLY, which through the axon relay
-        # means one round trip per level slice: minutes at 100K nodes and a
-        # worker OOM at 1M (each eager op materializes a fresh intermediate)
-        st, counts = wave32(bits, state0)  # compile
-        int(np.asarray(counts, dtype=np.int64).sum())
-        note("static export compiled; timing...")
+        group_computeds = [
+            [await capture(lambda i=i: svc.node(i)) for i in ids] for ids in group_ids
+        ]
+        note(f"compiling the lane burst ({n_groups} groups x {seeds_per_group} seeds)...")
+        backend.graph.clear_invalid()
+        backend.invalidate_cascade_batch_lanes(group_computeds)  # compile
+        note("lane program compiled; running the timed lane burst...")
+        backend.graph.clear_invalid()
         t0 = time.perf_counter()
-        st, counts = wave32(bits, state0)
-        static_total = int(np.asarray(counts, dtype=np.int64).sum())
-        static_s = time.perf_counter() - t0
+        lane_counts = backend.invalidate_cascade_batch_lanes(group_computeds)
+        lanes_s = time.perf_counter() - t0
+        lanes_total = int(lane_counts.sum())
+        lanes_union_mask = backend.graph.invalid_mask().copy()
+
+        # mirror ≡ dense, lane semantics: (a) the applied union equals ONE
+        # dense union BFS of all groups' seeds; (b) sampled per-group counts
+        # equal an independent dense run of just that group
+        note("asserting lane ≡ dense equivalence...")
+        backend.graph.clear_invalid()
+        dense_union_count, _ = backend.graph.run_waves_union(
+            [[backend._id_by_input[c.input] for g in group_computeds for c in g]],
+            mirror="off",
+        )
+        dense_union_mask = backend.graph.invalid_mask()
+        assert (dense_union_mask == lanes_union_mask).all(), "lane union != dense union"
+        assert dense_union_count == int(lanes_union_mask.sum())
+        for gi in (0, n_groups // 2, n_groups - 1):
+            backend.graph.clear_invalid()
+            c_dense, _ = backend.graph.run_waves_union(
+                [[backend._id_by_input[c.input] for c in group_computeds[gi]]],
+                mirror="off",
+            )
+            assert c_dense == int(lane_counts[gi]), (gi, c_dense, int(lane_counts[gi]))
+        note("lane ≡ dense: OK")
+
+        # -------- the same live-built graph on the flagship static kernel
+        # (LIVE_STATIC=0 skips — it shares kernels with bench.py's own run)
+        static_total, static_s = 0, 0.0
+        m = backend.graph.n_edges
+        if os.environ.get("LIVE_STATIC", "1") != "0":
+            from stl_fusion_tpu.ops.topo_wave import (
+                build_topo_graph,
+                build_topo_wave32,
+                topo_seeds_to_bits,
+            )
+
+            dg = backend.graph
+            topo = build_topo_graph(dg._h_edge_src[:m], dg._h_edge_dst[:m], n, k=4)
+            words = 4
+            state0, wave32 = build_topo_wave32(topo, words=words)
+            seed_lists = [
+                rng.choice(n, size=max(n // 100, 1), replace=False) for _ in range(32 * words)
+            ]
+            bits = jnp.asarray(topo_seeds_to_bits(topo, seed_lists, words=words))
+            note("compiling the static topo export...")
+            # the JITTED step (graph arrays as runtime args) — the raw
+            # ``wave32.impl`` executes EAGERLY, which through the axon relay
+            # means one round trip per level slice: minutes at 100K nodes and a
+            # worker OOM at 1M (each eager op materializes a fresh intermediate)
+            st, counts = wave32(bits, state0)  # compile
+            int(np.asarray(counts, dtype=np.int64).sum())
+            note("static export compiled; timing...")
+            t0 = time.perf_counter()
+            st, counts = wave32(bits, state0)
+            static_total = int(np.asarray(counts, dtype=np.int64).sum())
+            static_s = time.perf_counter() - t0
 
         result = {
             "metric": "live_path",
@@ -195,16 +248,31 @@ async def main() -> None:
             "build_s": round(build_s, 2),
             "build_nodes_per_s": round(n / build_s, 1),
             "relay_rtt_ms": round(rtt_ms, 1),
-            "live_wave_ms_p50": round(float(np.percentile(lat_arr, 50)), 2),
-            "live_wave_ms_p99": round(float(np.percentile(lat_arr, 99)), 2),
+            "live_wave_ms_p50": (
+                round(float(np.percentile(lat_arr, 50)), 2) if lat_arr is not None else None
+            ),
+            "live_wave_ms_p99": (
+                round(float(np.percentile(lat_arr, 99)), 2) if lat_arr is not None else None
+            ),
             "live_burst_waves": n_waves,
             "live_burst_invalidations": int(total),
-            "live_inv_per_s": round(total / burst_s, 1),
+            # THE live headline: lane-packed burst through the real hub
+            # (invalidate_cascade_batch_lanes), counts summed per group —
+            # the same accounting as the static bench's packed waves
+            "live_inv_per_s": round(lanes_total / lanes_s, 1),
+            "live_lanes_groups": n_groups,
+            "live_lanes_seeds_per_group": seeds_per_group,
+            "live_lanes_total_inv": lanes_total,
+            "live_lanes_union_inv": int(lanes_union_mask.sum()),
+            "live_lanes_s": round(lanes_s, 4),
+            "live_union_dense_inv_per_s": round(total / burst_s, 1),
             "live_mirror_inv_per_s": round(total_m / mirror_burst_s, 1),
             "mirror_build_s": round(mirror_build_s, 2),
             "mirror_levels": info["levels"],
-            "static_export_inv_per_s": round(static_total / max(static_s, 1e-9), 1),
-            "static_export_waves": 32 * words,
+            "static_export_inv_per_s": (
+                round(static_total / static_s, 1) if static_s else None
+            ),
+            "static_export_waves": 128 if static_s else 0,
         }
         print(json.dumps(result))
     finally:

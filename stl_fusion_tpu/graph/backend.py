@@ -221,6 +221,40 @@ class TpuGraphBackend:
         self.device_invalidations += total
         return total + fallback
 
+    def invalidate_cascade_batch_lanes(
+        self, groups: Sequence[Sequence["Computed"]]
+    ) -> np.ndarray:
+        """Lane-packed live burst: each group (the computeds one command's
+        completion invalidates) cascades INDEPENDENTLY in its own bit lane,
+        32 groups per packed word, all in one topo-mirror sweep — the live
+        path running at the static kernel's lane occupancy instead of one
+        union lane per dispatch (VERDICT r2 #1).
+
+        Per-group semantics = a dense BFS from the pre-burst invalid state
+        (snapshot-independent groups, the static bench's accounting); the
+        UNION of the closures is applied to the hub once, two-tier like
+        every other wave path. Returns per-group newly-invalidated counts
+        (int64[len(groups)]; a computed not in the graph falls back to an
+        immediate host invalidation and counts 1 in its group)."""
+        self.flush()
+        seed_lists: List[List[int]] = []
+        fallback = np.zeros(len(groups), dtype=np.int64)
+        for gi, group in enumerate(groups):
+            ids: List[int] = []
+            for c in group:
+                nid = self._id_by_input.get(c.input)
+                if nid is None:
+                    c.invalidate(immediately=True)
+                    fallback[gi] += 1
+                else:
+                    ids.append(nid)
+            seed_lists.append(ids)
+        counts, union_ids = self.graph.run_waves_lanes(seed_lists)
+        self._apply_newly(union_ids)
+        self.waves_run += len(groups)
+        self.device_invalidations += int(counts.sum())
+        return counts + fallback
+
     def build_topo_mirror(self, k: int = 4, cap: int = 65536) -> dict:
         """Build/refresh the packed topo mirror of the live graph: while
         topology stays stable, ``invalidate_cascade_batch`` bursts run ONE

@@ -377,6 +377,7 @@ class DeviceGraph:
             "node_epoch0": node_epoch0,
             "perm_clipped": perm_clipped,
             "burst": topo_mirror_burst_step(topo.level_starts, cap, n_tot),
+            "level_starts": topo.level_starts,
             "levels": len(topo.level_starts) - 1,
         }
         return self._topo_mirror
@@ -404,6 +405,62 @@ class DeviceGraph:
         self.mirror_bursts += 1
         count = int(count)
         return count, self._patch_host_invalid(count, out_ids, bool(overflow))
+
+    def run_waves_lanes(
+        self, seed_id_lists: Sequence[Sequence[int]], max_words: int = 16
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """INDEPENDENT per-group cascades, 32 groups per packed word, one
+        topo-mirror sweep per ≤``32*max_words`` groups (the lane-packed live
+        burst — ops/topo_wave.py::topo_mirror_burst_lanes_step). Builds or
+        revalidates the mirror itself.
+
+        Per-group semantics = a dense BFS from the graph's invalid state at
+        the chunk boundary (groups inside a chunk are snapshot-independent:
+        two groups may both count a node; chunks apply sequentially).
+        Returns (per-group newly counts int64[B], union newly-invalid ids) —
+        the union is what lands in the invalid state, applied once.
+        """
+        import jax
+
+        from ..ops.topo_wave import topo_mirror_burst_lanes_step
+
+        jnp = self._jnp
+        m = self.build_topo_mirror()
+        n_tot = m["n_tot"]
+        B = len(seed_id_lists)
+        counts = np.zeros(B, dtype=np.int64)
+        union_parts = []
+        chunk_size = 32 * max_words
+        for c0 in range(0, B, chunk_size):
+            chunk = seed_id_lists[c0 : c0 + chunk_size]
+            words = _round_up_pow2((len(chunk) + 31) // 32)
+            width = _round_up_pow2(max((len(s) for s in chunk), default=1))
+            mat = np.full((32 * words, width), n_tot, dtype=np.int32)
+            for i, s in enumerate(chunk):
+                ids = np.unique(np.asarray(s, dtype=np.int64))  # lane bits scatter-ADD
+                if len(ids) and (ids[0] < 0 or ids[-1] >= m["n_nodes"]):
+                    raise ValueError(
+                        f"group {c0 + i}: seed ids must be in [0, {m['n_nodes']})"
+                    )
+                mat[i, : len(ids)] = m["inv_perm"][ids].astype(np.int32)
+            g = self.device_arrays()
+            step = topo_mirror_burst_lanes_step(m["level_starts"], m["cap"], n_tot, words)
+            g_invalid2, lane_counts, union_count, ids, overflow = step(
+                m["garrays"], m["node_epoch0"], m["perm_clipped"], g.invalid,
+                jnp.asarray(mat),
+            )
+            lane_counts, union_count, ids, overflow = jax.device_get(
+                (lane_counts, union_count, ids, overflow)
+            )
+            self._g = g._replace(invalid=g_invalid2)
+            self.mirror_bursts += 1
+            counts[c0 : c0 + len(chunk)] = lane_counts[: len(chunk)].astype(np.int64)
+            union_parts.append(
+                self._patch_host_invalid(int(union_count), ids, bool(overflow))
+            )
+        return counts, (
+            np.concatenate(union_parts) if union_parts else np.empty(0, np.int32)
+        )
 
     def run_wave_frontier(self, seed_frontier, sync_host: bool = False) -> int:
         """Wave from a prebuilt boolean frontier (bench hot path — host copy

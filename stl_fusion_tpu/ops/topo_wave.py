@@ -48,6 +48,7 @@ __all__ = [
     "topo_init_state",
     "build_topo_wave32",
     "topo_mirror_burst_step",
+    "topo_mirror_burst_lanes_step",
     "topo_seeds_to_bits",
 ]
 
@@ -289,6 +290,94 @@ def topo_mirror_burst_step(level_starts: Tuple[int, ...], cap: int, n_tot: int):
             True, mode="drop"
         )
         return g_invalid2, count, ids, count > cap
+
+    return burst
+
+
+@functools.lru_cache(maxsize=8)
+def topo_mirror_burst_lanes_step(level_starts: Tuple[int, ...], cap: int, n_tot: int, words: int):
+    """Jitted LANE-PACKED live-burst program: ``32*words`` INDEPENDENT
+    command groups cascade in ONE sweep over the topo mirror.
+
+    The single-lane burst (:func:`topo_mirror_burst_step`) unions a whole
+    burst into one wave — correct, but it leaves 31/32 bits of every fetched
+    row idle while the random row fetch (the kernel's bound) costs a full
+    HBM transaction regardless. Here each group gets its own bit lane:
+    group g seeds word ``g//32`` bit ``g%32``, the W-word sweep computes all
+    closures in the same table pass, and per-lane popcounts come back with
+    the compacted UNION ids in one readback. Semantics per lane = a dense
+    BFS from the graph's CURRENT invalid state (the same gate as the
+    single-lane burst: pre-existing invalid rows neither fire, count, nor
+    conduct) — groups are snapshot-independent, exactly like the static
+    bench's packed waves, and the union is what gets applied.
+
+    ``seed_new_ids`` is int32[32*words, S] of NEW (topo-order) ids, padded
+    with ``n_tot``; ids must be UNIQUE within a lane (seed bits accumulate
+    by scatter-add — the caller dedups, which it does anyway to define a
+    group). Returns (g_invalid2, per-lane counts int32[32*words],
+    union count, compacted union original-ids, overflow)."""
+    import jax
+    import jax.numpy as jnp
+
+    W = words
+    L = 32 * W
+
+    @jax.jit
+    def burst(garrays: TopoGraphArrays, node_epoch0, perm_clipped, g_invalid, seed_new_ids):
+        is_real = garrays.is_real
+        blocked = (
+            jnp.where(is_real, g_invalid[perm_clipped], False)
+            .astype(jnp.int32)
+            .at[n_tot]
+            .set(0)
+        )
+        node_epoch = jnp.where(blocked.astype(bool), -3, node_epoch0)
+        # device-side seed scatter: upload is O(total seeds), never the
+        # O(n·W) bit matrix (16 MB/burst at 1M nodes through the relay)
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        word_of = lanes // 32
+        bit_of = jnp.left_shift(jnp.int32(1), lanes % 32)  # lane 31 wraps negative: same bit pattern
+        flat = seed_new_ids * W + word_of[:, None]  # row-major [n_tot+1, W] index
+        vals = jnp.broadcast_to(bit_of[:, None], seed_new_ids.shape)
+        seed_bits = (
+            jnp.zeros((n_tot + 1) * W, jnp.int32)
+            .at[flat.ravel()]
+            .add(vals.ravel())  # within-lane unique ⇒ add ≡ or (disjoint bits across lanes)
+            .reshape(n_tot + 1, W)
+            .at[n_tot]
+            .set(0)
+        )
+        seed_bits = jnp.where(blocked[:, None].astype(bool), 0, seed_bits)
+        state2, _word_counts = _topo_sweep_impl(
+            level_starts,
+            garrays,
+            seed_bits,
+            TopoState(node_epoch, jnp.zeros((n_tot + 1, W), dtype=jnp.int32)),
+        )
+        newly_bits = jnp.where(is_real[:, None], state2.invalid_bits, 0)
+        # per-lane closure sizes: 32·W length-n reductions, fused by XLA —
+        # never a [n, 32] unpacked intermediate
+        lane_counts = jnp.stack(
+            [
+                ((newly_bits[:, w] >> b) & 1).sum(dtype=jnp.int32)
+                for w in range(W)
+                for b in range(32)
+            ]
+        )
+        union = (newly_bits != 0).any(axis=1)
+        union_count = union.sum(dtype=jnp.int32)
+        pos = jnp.cumsum(union.astype(jnp.int32)) - 1
+        scatter_pos = jnp.where(union & (pos < cap), pos, cap)  # OOB → dropped
+        ids = (
+            jnp.full(cap, -1, dtype=jnp.int32)
+            .at[scatter_pos]
+            .set(perm_clipped, mode="drop")
+        )
+        oob = g_invalid.shape[0]
+        g_invalid2 = g_invalid.at[jnp.where(union, perm_clipped, oob)].set(
+            True, mode="drop"
+        )
+        return g_invalid2, lane_counts, union_count, ids, union_count > cap
 
     return burst
 

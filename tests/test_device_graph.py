@@ -674,3 +674,160 @@ async def test_live_sharded_burst_applies_to_hub():
         assert await svc.pair_sum("a", "b") == 1
     finally:
         set_default_hub(old)
+
+
+# ------------------------------------------------------------------ lane bursts
+
+@pytest.mark.parametrize("seed,n_groups", [(0, 7), (1, 40), (2, 70)])
+def test_lane_burst_matches_per_group_dense(seed, n_groups):
+    """run_waves_lanes: every group's count and the applied union must match
+    INDEPENDENT dense BFS runs from the same pre-burst state — including
+    multi-word packing (>32 groups) and epoch-churned dead edges."""
+    rng = np.random.default_rng(seed)
+    n = 240
+    edges = random_dag(rng, n)
+    arr = np.asarray(edges, dtype=np.int32)
+    bumped = rng.choice(n, size=n // 10, replace=False)
+    pre_invalid = rng.choice(n, size=n // 8, replace=False)
+
+    def fresh():
+        g = DeviceGraph(node_capacity=n, edge_capacity=len(edges) + 1)
+        g.add_nodes(n)
+        g.add_edges(arr[:, 0], arr[:, 1])
+        g.bump_epochs(bumped)
+        g.mark_invalid(pre_invalid)
+        return g
+
+    groups = [
+        rng.choice(n, size=int(rng.integers(1, 6)), replace=False).tolist()
+        for _ in range(n_groups)
+    ]
+    groups[0] = []  # an empty group is a 0-count no-op lane
+
+    lanes = fresh()
+    counts, union_ids = lanes.run_waves_lanes(groups)
+    assert lanes.mirror_bursts >= 1
+
+    union_expected = np.zeros(n, dtype=bool)
+    for gi, group in enumerate(groups):
+        dense = fresh()
+        before = dense.invalid_mask().copy()
+        c, ids = dense.run_waves_union([group], mirror="off") if group else (0, [])
+        assert counts[gi] == c, (gi, counts[gi], c)
+        newly = dense.invalid_mask() & ~before
+        union_expected |= newly
+    # the applied state is pre | union of independent closures
+    base = fresh()
+    np.testing.assert_array_equal(
+        lanes.invalid_mask(), base.invalid_mask() | union_expected
+    )
+    got_union = np.zeros(n, dtype=bool)
+    got_union[union_ids] = True
+    np.testing.assert_array_equal(got_union, union_expected)
+    # host mirror stayed coherent with device state
+    np.testing.assert_array_equal(lanes._h_invalid[:n], lanes.invalid_mask())
+
+
+def test_lane_burst_chunking_applies_sequentially():
+    """Groups beyond 32*max_words are dispatched in chunks; later chunks see
+    earlier chunks' invalidations as pre-existing (documented semantics)."""
+    rng = np.random.default_rng(3)
+    n = 120
+    edges = random_dag(rng, n)
+    arr = np.asarray(edges, dtype=np.int32)
+    g = DeviceGraph(node_capacity=n, edge_capacity=len(edges) + 1)
+    g.add_nodes(n)
+    g.add_edges(arr[:, 0], arr[:, 1])
+
+    groups = [[int(i % n)] for i in rng.integers(0, n, size=80)]
+    counts, union_ids = g.run_waves_lanes(groups, max_words=1)  # 3 chunks of ≤32
+
+    # oracle: chunks of 32, independent inside a chunk, sequential between
+    oracle_invalid = np.zeros(n, dtype=bool)
+    expected = []
+    for c0 in range(0, len(groups), 32):
+        chunk_newly = np.zeros(n, dtype=bool)
+        for group in groups[c0 : c0 + 32]:
+            closure = python_wave_oracle(
+                n, edges, [0] * len(edges), np.zeros(n, np.int32),
+                oracle_invalid.copy(), group,
+            ) & ~oracle_invalid
+            expected.append(int(closure.sum()))
+            chunk_newly |= closure
+        oracle_invalid |= chunk_newly
+    np.testing.assert_array_equal(counts, expected)
+    np.testing.assert_array_equal(g.invalid_mask(), oracle_invalid)
+    got_union = np.zeros(n, dtype=bool)
+    got_union[union_ids] = True
+    np.testing.assert_array_equal(got_union, oracle_invalid)
+
+
+def test_lane_burst_rejects_out_of_range_seeds():
+    g = DeviceGraph(node_capacity=16, edge_capacity=16)
+    g.add_nodes(8)
+    with pytest.raises(ValueError, match="seed ids"):
+        g.run_waves_lanes([[0], [99]])
+
+
+async def test_backend_lane_burst_applies_to_hub():
+    """invalidate_cascade_batch_lanes through a REAL hub: per-group counts
+    match dense per-group runs, watched nodes invalidate eagerly, unwatched
+    lazily, and a missing computed falls back to host invalidation."""
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        capture,
+        compute_method,
+        set_default_hub,
+    )
+    from stl_fusion_tpu.graph import TpuGraphBackend
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        backend = TpuGraphBackend(hub)
+
+        class Chain(ComputeService):
+            @compute_method
+            async def base(self, i: int) -> int:
+                return i
+
+            @compute_method
+            async def mid(self, i: int) -> int:
+                return await self.base(i) + 1
+
+            @compute_method
+            async def top(self, i: int) -> int:
+                return await self.mid(i) + 1
+
+        svc = Chain(hub=hub)
+        tops = [await capture(lambda i=i: svc.top(i)) for i in range(8)]
+        bases = [await capture(lambda i=i: svc.base(i)) for i in range(8)]
+        mids = [await capture(lambda i=i: svc.mid(i)) for i in range(8)]
+
+        # group g invalidates base(g) → chain of 3 (base, mid, top)
+        groups = [[bases[i]] for i in range(6)]
+        counts = backend.invalidate_cascade_batch_lanes(groups)
+        np.testing.assert_array_equal(counts, [3] * 6)
+        for i in range(6):
+            # unwatched nodes are pending (lazy) until read; either way the
+            # invalidation must be visible through the read path: a fresh
+            # capture yields a NEW computed, not the stale cached one
+            assert (
+                bases[i].is_invalidated
+                or backend._pending[backend.id_for(bases[i])]
+            )
+            fresh_top = await capture(lambda i=i: svc.top(i))
+            assert fresh_top is not tops[i]
+        # untouched groups stay consistent and cached
+        assert not tops[7].is_invalidated and not bases[7].is_invalidated
+        assert (await capture(lambda: svc.top(7))) is tops[7]
+
+        # overlapping groups are snapshot-independent: both count the shared
+        # node even though it is applied once
+        await svc.top(7)  # ensure consistent
+        c2 = backend.invalidate_cascade_batch_lanes([[mids[7]], [bases[7]]])
+        assert c2[0] == 2  # mid, top
+        assert c2[1] == 3  # base, mid, top (counts mid+top again)
+    finally:
+        set_default_hub(old)
