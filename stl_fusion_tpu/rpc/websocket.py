@@ -10,8 +10,10 @@ physical connections (SessionBoundRpcConnection analogue).
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
 import secrets
+import struct
 import urllib.parse
 from typing import Optional
 
@@ -28,28 +30,114 @@ RPC_PATH = "/rpc/ws"
 
 
 class _WsAdapter:
-    """Adapts a websockets connection to the peer's reader/writer protocol."""
+    """Adapts a websockets connection to the peer's reader/writer protocol.
+
+    Framing (≈ WebSocketChannel.cs:14-37): one websocket frame carries ONE
+    OR MORE length-prefixed wire-serialized RpcMessages. Small outbound
+    messages that are ready together — an invalidation flood, a re-send
+    burst — coalesce into ~4 KB frames instead of paying per-message frame
+    overhead, with no added latency: the flusher packs only what is already
+    queued when it runs. The outbound buffer is BOUNDED (128 messages);
+    senders block when it is full — backpressure is the overflow policy,
+    never unbounded buffering in the websocket library. Each ``send()``
+    still resolves or raises with its own message's transport outcome, so
+    the peer's re-send / failure-disambiguation logic is unchanged.
+    """
+
+    PACK_BYTES = 4096  # stop adding to a frame once it crosses this
+    MAX_PENDING = 128  # outbound bound (≈ the reference's channel capacity)
 
     class _Reader:
         def __init__(self, ws):
             self._ws = ws
+            self._parsed: "collections.deque[RpcMessage]" = collections.deque()
 
         async def receive(self) -> RpcMessage:
-            try:
-                frame = await self._ws.recv()
-            except Exception as e:  # noqa: BLE001 — closed/aborted
-                raise ConnectionError(str(e)) from e
-            return loads(frame if isinstance(frame, bytes) else frame.encode())
+            while not self._parsed:
+                try:
+                    frame = await self._ws.recv()
+                except Exception as e:  # noqa: BLE001 — closed/aborted
+                    raise ConnectionError(str(e)) from e
+                buf = frame if isinstance(frame, bytes) else frame.encode()
+                off = 0
+                # a malformed pack (truncated frame, corrupt length) is a
+                # TRANSPORT failure: surface it as ConnectionError so the
+                # peer's run loop tears the connection down and reconnects,
+                # instead of an unhandled parse error killing the loop task
+                # with the peer stuck "connected" forever
+                try:
+                    while off < len(buf):
+                        (length,) = struct.unpack_from("<I", buf, off)
+                        off += 4
+                        if length > len(buf) - off:
+                            raise ValueError(
+                                f"frame truncated: {length}B message, "
+                                f"{len(buf) - off}B left"
+                            )
+                        self._parsed.append(loads(bytes(buf[off : off + length])))
+                        off += length
+                except ConnectionError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — corrupt frame
+                    raise ConnectionError(f"malformed frame: {e}") from e
+            return self._parsed.popleft()
 
     class _Writer:
         def __init__(self, ws):
             self._ws = ws
+            self._pending: "collections.deque" = collections.deque()
+            self._wake = asyncio.Event()
+            self._space = asyncio.Event()
+            self._space.set()
+            self._error: Optional[BaseException] = None
+            self._task = asyncio.ensure_future(self._flush_loop())
 
         async def send(self, message: RpcMessage) -> None:
+            data = dumps(message)
+            while self._error is None and len(self._pending) >= _WsAdapter.MAX_PENDING:
+                self._space.clear()
+                await self._space.wait()
+            if self._error is not None:
+                raise ConnectionError(str(self._error)) from self._error
+            fut = asyncio.get_running_loop().create_future()
+            self._pending.append((data, fut))
+            self._wake.set()
+            await fut
+
+        async def _flush_loop(self) -> None:
             try:
-                await self._ws.send(dumps(message))
-            except Exception as e:  # noqa: BLE001
-                raise ConnectionError(str(e)) from e
+                while True:
+                    await self._wake.wait()
+                    self._wake.clear()
+                    while self._pending:
+                        parts, futs, size = [], [], 0
+                        while self._pending and (not parts or size < _WsAdapter.PACK_BYTES):
+                            data, fut = self._pending.popleft()
+                            parts.append(struct.pack("<I", len(data)))
+                            parts.append(data)
+                            futs.append(fut)
+                            size += len(data)
+                        self._space.set()
+                        try:
+                            await self._ws.send(b"".join(parts))
+                        except Exception as e:  # noqa: BLE001
+                            self._fail(e, futs)
+                            return
+                        for fut in futs:
+                            if not fut.done():
+                                fut.set_result(None)
+            except asyncio.CancelledError:
+                self._fail(ConnectionError("transport closed"), [])
+                raise
+
+        def _fail(self, error: BaseException, futs: list) -> None:
+            self._error = error
+            self._space.set()
+            drained = [f for _, f in self._pending]
+            self._pending.clear()
+            for fut in futs + drained:
+                if not fut.done():
+                    fut.set_exception(ConnectionError(str(error)))
 
     def __init__(self, ws):
         self._ws = ws
@@ -57,6 +145,7 @@ class _WsAdapter:
         self.writer = _WsAdapter._Writer(ws)
 
     def close(self, error: Optional[BaseException] = None) -> None:
+        self.writer._task.cancel()
         asyncio.ensure_future(self._ws.close())
 
 
