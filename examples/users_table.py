@@ -85,6 +85,55 @@ async def main():
     print("table.invalidate reached the live scalar node")
     print("table-backed service OK: one API, both read shapes, coherent both ways")
 
+    await string_keys_demo()
+
+
+class NamedUsers(ComputeService):
+    """The same columnar path with REALISTIC keys (r3): string user ids ride
+    TableBacking(keys=True) — an InternKeyCodec assigns dense rows on first
+    read, the batch loader receives the decoded NAMES, and both coherence
+    directions work through the codec."""
+
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self.balances = {f"user-{i}": float(i) for i in range(N_USERS)}
+
+    def load_rows(self, names) -> np.ndarray:
+        return np.array([self.balances[name] for name in names], dtype=np.float32)
+
+    @compute_method(table=TableBacking(rows=N_USERS, batch="load_rows", keys=True))
+    async def balance(self, name: str) -> float:
+        return self.balances[name]
+
+    async def deposit(self, name: str, amount: float) -> None:
+        self.balances[name] += amount
+        with invalidating():
+            await self.balance(name)
+
+
+async def string_keys_demo():
+    users = NamedUsers(FusionHub())
+    table = memo_table_of(users.balance)
+
+    names = [f"user-{i}" for i in range(100)]
+    values = np.asarray(table.read_keys(names))
+    assert values.sum() == sum(range(100))
+    print(f"string-key bulk read: {len(names)} names in one gather")
+
+    # scalar → columnar through the codec
+    node = await capture(lambda: users.balance("user-7"))
+    await users.deposit("user-7", 100.0)
+    assert node.is_invalidated
+    assert float(np.asarray(table.read_keys(["user-7"]))[0]) == 107.0
+
+    # columnar → scalar through the codec
+    node2 = await capture(lambda: users.balance("user-7"))
+    users.balances["user-7"] = 0.0
+    table.invalidate_keys(["user-7"])
+    assert node2.is_invalidated
+    assert await users.balance("user-7") == 0.0
+    print("string-key coherence holds both ways (codec-backed rows)")
+
 
 if __name__ == "__main__":
     asyncio.run(main())

@@ -27,6 +27,7 @@ from .registry import ComputedRegistry
 from .service import (
     ComputeMethodDef,
     ComputeService,
+    InternKeyCodec,
     TableBacking,
     compute_method,
     hub_of,
@@ -60,6 +61,7 @@ __all__ = [
     "ComputedRegistry",
     "ComputeMethodDef",
     "ComputeService",
+    "InternKeyCodec",
     "TableBacking",
     "compute_method",
     "memo_table_of",

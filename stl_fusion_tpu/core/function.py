@@ -186,19 +186,24 @@ class ComputeMethodFunction(FunctionBase):
         method_def = self.method_def
         if method_def.table is not None:
             args = getattr(input, "args", ())
-            if len(args) == 1 and isinstance(args[0], int):
+            if method_def.table.covers(args):
                 # scalar → table coherence rides the NODE, so every
                 # invalidation path (invalidating() replay, dependency
                 # cascade, timed/auto invalidation) marks the columnar row
                 # stale — not just explicit replays. The table's own
                 # handler finds this node already invalid, so no cycle.
-                key = args[0]
+                # The row resolves LAZILY (codec peek, never allocating):
+                # the columnar side may intern this key only after the
+                # node was created — or never, in which case there is no
+                # row to mark.
                 service = input.service
 
                 def mark_row_stale(_node) -> None:
                     table = method_def.peek_table(service)
                     if table is not None:
-                        table.invalidate([key])
+                        row = method_def.row_for_args(args, table)
+                        if row is not None:
+                            table.invalidate([row])
 
                 computed.on_invalidated(mark_row_stale)
         return computed

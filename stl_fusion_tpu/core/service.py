@@ -30,10 +30,56 @@ __all__ = [
     "compute_method",
     "ComputeService",
     "ComputeMethodDef",
+    "InternKeyCodec",
     "TableBacking",
     "hub_of",
     "memo_table_of",
 ]
+
+
+class InternKeyCodec:
+    """Arbitrary hashable call args ⇄ dense MemoTable row ids.
+
+    The bridge that lets realistic key shapes — string user ids, composite
+    (tenant, id) tuples — ride the columnar path (VERDICT r2 #5; ≈ the
+    reference's DbEntityResolver batching arbitrary entity keys into dense
+    batch slots, EntityFramework/DbEntityResolver.cs): keys are interned on
+    first read, ``peek`` never allocates (invalidating a never-read key is
+    a no-op, not a row burn), ``decode`` is the reverse map used by
+    table→scalar invalidation and by the batch-refresh wrapper. Scoped like
+    the MemoTable itself — per (service instance, hub) — so independent
+    service instances with disjoint key universes each get the full row
+    capacity (``TableBacking(keys=True)`` creates one codec per table; pass
+    a codec INSTANCE to share a key→row layout deliberately)."""
+
+    __slots__ = ("capacity", "_row_by_key", "_key_by_row")
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._row_by_key: dict = {}
+        self._key_by_row: list = []
+
+    def peek(self, args: tuple) -> Optional[int]:
+        return self._row_by_key.get(args)
+
+    def acquire(self, args: tuple) -> int:
+        row = self._row_by_key.get(args)
+        if row is None:
+            if len(self._key_by_row) >= self.capacity:
+                raise KeyError(
+                    f"key codec full ({self.capacity} rows interned); "
+                    f"raise TableBacking(rows=...)"
+                )
+            row = len(self._key_by_row)
+            self._row_by_key[args] = row
+            self._key_by_row.append(args)
+        return row
+
+    def decode(self, row: int) -> Optional[tuple]:
+        return self._key_by_row[row] if 0 <= row < len(self._key_by_row) else None
+
+    def __len__(self) -> int:
+        return len(self._key_by_row)
 
 
 class TableBacking:
@@ -61,15 +107,41 @@ class TableBacking:
 
     Bulk reads ride ``memo_table_of(svc.get).read_batch(ids)`` — one device
     gather per batch, the public columnar path the read benchmark measures.
+
+    Non-integer keys: ``keys=True`` (or an explicit codec object) interns
+    arbitrary hashable call args into dense rows via
+    :class:`InternKeyCodec`; bulk reads then go through
+    ``memo_table_of(svc.get).read_keys(["alice", ...])`` and the ``batch``
+    method receives the decoded KEYS (single-arg methods get bare keys,
+    multi-arg methods get args tuples), not row ids.
     """
 
-    __slots__ = ("rows", "batch", "row_shape", "dtype")
+    __slots__ = ("rows", "batch", "row_shape", "dtype", "keys")
 
-    def __init__(self, rows: int, batch: str, row_shape: tuple = (), dtype=None):
+    def __init__(
+        self, rows: int, batch: str, row_shape: tuple = (), dtype=None, keys=False
+    ):
         self.rows = int(rows)
         self.batch = batch
         self.row_shape = tuple(row_shape)
         self.dtype = dtype
+        #: False = dense int keys; True = one InternKeyCodec PER TABLE
+        #: (per service instance × hub); a codec instance = shared layout
+        self.keys = keys
+
+    def make_codec(self) -> Optional["InternKeyCodec"]:
+        if self.keys is True:
+            return InternKeyCodec(self.rows)
+        return self.keys or None
+
+    def covers(self, args: tuple) -> bool:
+        """Could these call args EVER map to a table row? (A cheap shape
+        check at node-creation time; the row itself resolves lazily at
+        invalidation time through the table's codec, which may intern the
+        key only after the node was created.)"""
+        if self.keys:
+            return True
+        return len(args) == 1 and isinstance(args[0], int)
 
 
 class ComputeMethodDef:
@@ -112,9 +184,29 @@ class ComputeMethodDef:
 
             spec = self.table
             batch_fn = getattr(service, spec.batch)
+            codec = spec.make_codec()  # PER-TABLE: instances don't share rows
+            if codec is not None:
+                # codec-backed tables refresh through KEYS: the service's
+                # batch method sees what it declared (string ids, tuples),
+                # never internal row numbers
+                raw_batch = batch_fn
+
+                def batch_fn(ids):
+                    keys = []
+                    for i in ids:
+                        args = codec.decode(int(i))
+                        if args is None:
+                            raise KeyError(
+                                f"row {int(i)} has no interned key — read "
+                                f"codec-backed tables via read_keys()"
+                            )
+                        keys.append(args[0] if len(args) == 1 else args)
+                    return raw_batch(keys)
+
             table = MemoTable(
                 spec.rows, batch_fn, row_shape=spec.row_shape, dtype=spec.dtype
             )
+            table.key_codec = codec
             # table → scalar: a row invalidation reaches any LIVE scalar
             # node for that key (one registry probe per id; nodes that were
             # never read don't exist and cost nothing). node.invalidate()
@@ -125,8 +217,11 @@ class ComputeMethodDef:
 
             def on_invalidate(ids) -> None:
                 for i in ids:
+                    args = method_def.args_for_row(int(i), table)
+                    if args is None:
+                        continue  # never-interned row: no scalar node exists
                     node = registry.get(
-                        ComputeMethodInput(method_def, service, (int(i),), function)
+                        ComputeMethodInput(method_def, service, args, function)
                     )
                     if node is not None:
                         node.invalidate()
@@ -134,6 +229,28 @@ class ComputeMethodDef:
             table.on_invalidate.append(on_invalidate)
             store[key] = table
         return table
+
+    def row_for_args(self, args: tuple, table) -> Optional[int]:
+        """The row these call args map to in ``table``, WITHOUT allocating
+        (invalidation paths: a key the columnar side never read has no row
+        to mark). None when unmapped. The codec lives on the TABLE — it is
+        per (service instance, hub), like the rows it allocates."""
+        if self.table is None or table is None:
+            return None
+        codec = table.key_codec
+        if codec is None:
+            return args[0] if len(args) == 1 and isinstance(args[0], int) else None
+        return codec.peek(tuple(args))
+
+    def args_for_row(self, row: int, table) -> Optional[tuple]:
+        """Canonical call args for a row of ``table`` (the reverse map used
+        by table→scalar invalidation)."""
+        if self.table is None or table is None:
+            return None
+        codec = table.key_codec
+        if codec is None:
+            return (int(row),)
+        return codec.decode(int(row))
 
     def peek_table(self, service: Any):
         """The backing table if it was EVER materialized for this service
@@ -236,8 +353,10 @@ def compute_method(
             result = await function.invoke_and_strip(input, used_by, context)
             if invalidate_mode and method_def.table is not None and not node_existed:
                 tbl = method_def.peek_table(self)
-                if tbl is not None and len(input.args) == 1 and isinstance(input.args[0], int):
-                    tbl.invalidate([input.args[0]])
+                if tbl is not None:
+                    row = method_def.row_for_args(input.args, tbl)
+                    if row is not None:
+                        tbl.invalidate([row])
             return result
 
         wrapper.__compute_method_def__ = method_def  # type: ignore[attr-defined]

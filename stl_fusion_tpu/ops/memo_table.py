@@ -68,6 +68,9 @@ class MemoTable:
         self._valid_dev = jnp.zeros(self.n_rows, dtype=jnp.bool_)
         self._packed_cache: Optional[tuple] = None  # (version, packed bits)
         self.on_invalidate: List[Callable[[np.ndarray], None]] = []
+        #: optional key codec (set by TableBacking wiring): arbitrary
+        #: hashable keys ⇄ dense rows — see read_keys/invalidate_keys
+        self.key_codec = None
         self.changed: AsyncEvent = AsyncEvent(0)
         self._jit_cache = _kernels()  # shared: tables reuse one compile cache
         if eager:
@@ -99,6 +102,40 @@ class MemoTable:
         if stale.any():
             self.refresh(np.unique(ids_np[stale]))
         return self._jit_cache["gather"](self._values, self._jnp.asarray(ids_np))
+
+    def encode_keys(self, keys, allocate: bool = True) -> np.ndarray:
+        """Dense row ids for arbitrary keys via the attached codec (a key is
+        the call-args tuple, or the bare value for single-arg methods).
+        ``allocate=False`` maps only already-interned keys (-1 otherwise)."""
+        codec = self._require_codec()
+        rows = np.empty(len(keys), dtype=np.int32)
+        for j, k in enumerate(keys):
+            args = k if isinstance(k, tuple) else (k,)
+            row = codec.acquire(args) if allocate else codec.peek(args)
+            rows[j] = -1 if row is None else row
+        return rows
+
+    def read_keys(self, keys):
+        """``read_batch`` for codec-backed tables: keys are interned to rows
+        (first read allocates), stale rows refresh through the service's
+        batch method with the DECODED keys, one gather returns the values."""
+        return self.read_batch(self.encode_keys(keys))
+
+    def invalidate_keys(self, keys) -> None:
+        """Mark the rows of already-interned ``keys`` stale (never-read keys
+        have no row and are a no-op, not an allocation)."""
+        rows = self.encode_keys(keys, allocate=False)
+        rows = rows[rows >= 0]
+        if rows.size:
+            self.invalidate(rows)
+
+    def _require_codec(self):
+        if self.key_codec is None:
+            raise TypeError(
+                "this MemoTable has no key codec — declare "
+                "TableBacking(keys=True) or read by integer row ids"
+            )
+        return self.key_codec
 
     @property
     def values(self):

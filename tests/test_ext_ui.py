@@ -807,3 +807,182 @@ async def test_untrusted_request_never_signs_out_existing_session(fresh_hub):
     finally:
         await server.stop()
         await rpc.stop()
+
+
+def test_render_slot_latest_wins():
+    """1000 pushes against a stalled reader hold ONE pending payload;
+    take() yields the newest; intermediates are counted as coalesced."""
+    import asyncio as aio
+
+    from stl_fusion_tpu.ui.web import _RenderSlot
+
+    async def run():
+        slot = _RenderSlot()
+        for i in range(1000):
+            slot.push({"html": str(i)})
+        assert slot.pushed == 1000
+        assert slot.coalesced == 999
+        assert await aio.wait_for(slot.take(), 1.0) == {"html": "999"}
+        # nothing pending now: take() blocks until the next push
+        pending = aio.ensure_future(slot.take())
+        await aio.sleep(0.01)
+        assert not pending.done()
+        slot.push("fresh")
+        assert await aio.wait_for(pending, 1.0) == "fresh"
+        assert slot.take_nowait("default") == "default"
+
+    aio.run(run())
+
+
+async def test_live_view_stalled_reader_gets_newest_only(fresh_hub):
+    """VERDICT r2 #9: renders that land while a connection isn't draining
+    coalesce to the newest payload — a wake-up read sees ONE message, not
+    the 1000 intermediates."""
+    import json
+
+    from websockets.asyncio.client import connect
+
+    from stl_fusion_tpu.state import MutableState
+    from stl_fusion_tpu.ui import HtmlComponent, LiveViewServer
+
+    hub = fresh_hub
+    source = MutableState(1, hub)
+    comps = []
+
+    class Counter(HtmlComponent):
+        async def compute_state(self) -> int:
+            return await source.use()
+
+        def to_html(self, value: int) -> str:
+            return f"<b>{value}</b>"
+
+    def factory(push):
+        c = Counter(push, hub=hub)
+        comps.append(c)
+        return c
+
+    server = await LiveViewServer(factory).start()
+    try:
+        async with connect(server.url) as ws:
+            assert json.loads(await asyncio.wait_for(ws.recv(), 5.0)) == {"html": "<b>1</b>"}
+            # 1000 renders land before the pump can run once (no awaits):
+            # latest-wins delivers exactly the newest
+            for i in range(1000):
+                comps[0].push({"html": str(i)})
+            assert json.loads(await asyncio.wait_for(ws.recv(), 5.0)) == {"html": "999"}
+            # ...and the connection is still live for real renders
+            source.set(2)
+            assert json.loads(await asyncio.wait_for(ws.recv(), 5.0)) == {"html": "<b>2</b>"}
+    finally:
+        await server.stop()
+
+
+async def test_live_view_evicts_stalled_client(fresh_hub):
+    """A browser that stops draining while the transport buffer is full is
+    EVICTED after send_timeout: the component unmounts and stops consuming
+    invalidations, instead of a dead tab pinning it forever.
+
+    The stalled client is a RAW socket that completes the websocket
+    handshake and then never reads — the websockets library client consumes
+    frames into process memory even with max_queue=1/pause_reading, so it
+    cannot model a dead tab; only an un-read socket makes the server's
+    drain() actually block."""
+    import base64
+    import os as _os
+
+    from stl_fusion_tpu.state import MutableState
+    from stl_fusion_tpu.ui import HtmlComponent, LiveViewServer
+
+    hub = fresh_hub
+    source = MutableState(1, hub)
+    comps = []
+
+    class Big(HtmlComponent):
+        async def compute_state(self) -> int:
+            return await source.use()
+
+        def to_html(self, value: int) -> str:
+            return "x" * 300_000  # large frames fill the transport quickly
+
+    def factory(push):
+        c = Big(push, hub=hub)
+        comps.append(c)
+        return c
+
+    server = await LiveViewServer(factory, send_timeout=0.3).start()
+    try:
+        key = base64.b64encode(_os.urandom(16)).decode()
+        reader, writer = await asyncio.open_connection(server.host, server.port)
+        try:
+            writer.write(
+                (
+                    f"GET /live HTTP/1.1\r\nHost: {server.host}:{server.port}\r\n"
+                    f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                    f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+                ).encode()
+            )
+            await writer.drain()
+            await reader.readuntil(b"\r\n\r\n")  # handshake done; now stall
+
+            async def mounted():
+                while not comps:
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(mounted(), 5.0)
+            for _ in range(400):
+                comps[0].push({"html": "x" * 300_000})
+                await asyncio.sleep(0.005)
+                if server.evictions:
+                    break
+            assert server.evictions == 1
+
+            async def unmounted():
+                while server.connections:
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(unmounted(), 5.0)
+        finally:
+            writer.close()
+    finally:
+        await server.stop()
+
+
+async def test_live_view_min_send_interval_rate_limits(fresh_hub):
+    """With min_send_interval set, a burst of renders ships as ONE payload
+    per interval — and it is the newest at send time."""
+    import json
+
+    from websockets.asyncio.client import connect
+
+    from stl_fusion_tpu.state import MutableState
+    from stl_fusion_tpu.ui import HtmlComponent, LiveViewServer
+
+    hub = fresh_hub
+    source = MutableState(1, hub)
+    comps = []
+
+    class Counter(HtmlComponent):
+        async def compute_state(self) -> int:
+            return await source.use()
+
+        def to_html(self, value: int) -> str:
+            return str(value)
+
+    def factory(push):
+        c = Counter(push, hub=hub)
+        comps.append(c)
+        return c
+
+    server = await LiveViewServer(factory, min_send_interval=0.15).start()
+    try:
+        async with connect(server.url) as ws:
+            assert json.loads(await asyncio.wait_for(ws.recv(), 5.0)) == {"html": "1"}
+            # renders spread across the rate window: later ones land while
+            # the pump sleeps and must supersede the taken payload
+            for i in range(10):
+                comps[0].push({"html": f"v{i}"})
+                await asyncio.sleep(0.01)
+            msg = json.loads(await asyncio.wait_for(ws.recv(), 5.0))
+            assert msg == {"html": "v9"}, msg
+    finally:
+        await server.stop()

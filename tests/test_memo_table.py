@@ -350,3 +350,154 @@ async def test_dependency_cascade_marks_table_row_stale():
     assert await users.get(3) == 30.0         # scalar recomputed
     out = np.asarray(table.read_batch([3]))   # row must have refreshed too
     np.testing.assert_allclose(out, [30.0])
+
+
+# ------------------------------------------------------------------ key codec
+
+async def test_string_key_table_coherence_both_ways():
+    """VERDICT r2 #5: TableBacking(keys=True) — string keys ride the
+    columnar path via InternKeyCodec; scalar⇄table invalidation coherence
+    goes through the codec in both directions."""
+    import numpy as np
+
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        TableBacking,
+        capture,
+        compute_method,
+        invalidating,
+        memo_table_of,
+        set_default_hub,
+    )
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        class Users(ComputeService):
+            def __init__(self, hub=None):
+                super().__init__(hub)
+                self.db = {f"u{i}": float(i) for i in range(32)}
+                self.batch_keys = []
+
+            def load(self, names):
+                self.batch_keys.append(list(names))
+                return np.array([self.db[n] for n in names], dtype=np.float32)
+
+            @compute_method(table=TableBacking(rows=32, batch="load", keys=True))
+            async def balance(self, name: str) -> float:
+                return self.db[name]
+
+            async def deposit(self, name, amount):
+                self.db[name] += amount
+                with invalidating():
+                    await self.balance(name)
+
+        users = Users(hub)
+        table = memo_table_of(users.balance)
+
+        vals = np.asarray(table.read_keys(["u3", "u1", "u3"]))
+        np.testing.assert_allclose(vals, [3.0, 1.0, 3.0])
+        # the batch loader saw decoded KEYS, not row numbers
+        assert all(isinstance(k, str) for batch in users.batch_keys for k in batch)
+
+        # scalar replay → row stale through the codec (even with NO live node)
+        await users.deposit("u3", 10.0)
+        assert float(np.asarray(table.read_keys(["u3"]))[0]) == 13.0
+
+        # scalar node → row coherence
+        node = await capture(lambda: users.balance("u1"))
+        await users.deposit("u1", 5.0)
+        assert node.is_invalidated
+        assert float(np.asarray(table.read_keys(["u1"]))[0]) == 6.0
+
+        # table → scalar through the codec
+        node2 = await capture(lambda: users.balance("u1"))
+        users.db["u1"] = 0.0
+        table.invalidate_keys(["u1"])
+        assert node2.is_invalidated
+        assert await users.balance("u1") == 0.0
+
+        # invalidating a NEVER-read key allocates nothing and is a no-op
+        rows_before = len(table.key_codec)
+        table.invalidate_keys(["u31"])
+        assert len(table.key_codec) == rows_before
+    finally:
+        set_default_hub(old)
+
+
+async def test_composite_key_table_and_codec_capacity():
+    """Composite (tenant, id) keys intern as tuples; exceeding rows raises
+    a clear error instead of silently corrupting rows."""
+    import numpy as np
+
+    import pytest as _pytest
+
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        TableBacking,
+        compute_method,
+        memo_table_of,
+        set_default_hub,
+    )
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        class Scores(ComputeService):
+            def load(self, keys):
+                # multi-arg methods receive args TUPLES
+                assert all(isinstance(k, tuple) and len(k) == 2 for k in keys)
+                return np.array([t * 100 + i for t, i in keys], dtype=np.float32)
+
+            @compute_method(table=TableBacking(rows=4, batch="load", keys=True))
+            async def score(self, tenant: int, uid: int) -> float:
+                return float(tenant * 100 + uid)
+
+        svc = Scores(hub)
+        table = memo_table_of(svc.score)
+        vals = np.asarray(table.read_keys([(1, 2), (3, 4)]))
+        np.testing.assert_allclose(vals, [102.0, 304.0])
+
+        table.read_keys([(5, 6), (7, 8)])  # fills the 4 rows
+        with _pytest.raises(KeyError, match="codec full"):
+            table.read_keys([(9, 9)])
+    finally:
+        set_default_hub(old)
+
+
+async def test_codec_is_per_service_instance():
+    """Review r3: two instances of a keys=True service each get the FULL
+    row capacity — the codec is per-table, not shared on the class spec."""
+    import numpy as np
+
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        TableBacking,
+        compute_method,
+        memo_table_of,
+        set_default_hub,
+    )
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        class KV(ComputeService):
+            def load(self, keys):
+                return np.array([float(len(k)) for k in keys], dtype=np.float32)
+
+            @compute_method(table=TableBacking(rows=4, batch="load", keys=True))
+            async def get(self, key: str) -> float:
+                return float(len(key))
+
+        a, b = KV(hub), KV(hub)
+        ta, tb = memo_table_of(a.get), memo_table_of(b.get)
+        assert ta is not tb and ta.key_codec is not tb.key_codec
+        ta.read_keys([f"a{i}" for i in range(4)])  # fills A's 4 rows
+        # B still has its full capacity for a DISJOINT key set
+        vals = np.asarray(tb.read_keys([f"bee{i}" for i in range(4)]))
+        np.testing.assert_allclose(vals, [4.0] * 4)
+    finally:
+        set_default_hub(old)
