@@ -185,10 +185,13 @@ class ComputeMethodDef:
             spec = self.table
             batch_fn = getattr(service, spec.batch)
             codec = spec.make_codec()  # PER-TABLE: instances don't share rows
+            arity = len(self.signature.parameters) - 1  # minus self
             if codec is not None:
                 # codec-backed tables refresh through KEYS: the service's
                 # batch method sees what it declared (string ids, tuples),
-                # never internal row numbers
+                # never internal row numbers. Single-arg methods get bare
+                # keys by DECLARED arity — a tuple-valued key of a 1-arg
+                # method stays one key
                 raw_batch = batch_fn
 
                 def batch_fn(ids):
@@ -200,13 +203,14 @@ class ComputeMethodDef:
                                 f"row {int(i)} has no interned key — read "
                                 f"codec-backed tables via read_keys()"
                             )
-                        keys.append(args[0] if len(args) == 1 else args)
+                        keys.append(args[0] if arity == 1 else args)
                     return raw_batch(keys)
 
             table = MemoTable(
                 spec.rows, batch_fn, row_shape=spec.row_shape, dtype=spec.dtype
             )
             table.key_codec = codec
+            table.key_arity = arity
             # table → scalar: a row invalidation reaches any LIVE scalar
             # node for that key (one registry probe per id; nodes that were
             # never read don't exist and cost nothing). node.invalidate()

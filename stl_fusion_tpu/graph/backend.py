@@ -368,10 +368,18 @@ class TpuGraphBackend:
         the MESH (frontier all-gather over ICI — parallel/sharded_wave.py),
         then apply the newly-invalidated set back to the live hub exactly
         like the single-chip path (dense mirror + two-tier host
-        application). Per-burst cost includes an O(n_nodes) invalid-state
-        sync each way — the bridge shape for burst-heavy stable topologies,
-        validated on the virtual CPU mesh (tests + dryrun)."""
+        application).
+
+        Per-burst host traffic is O(wave), not O(n) (VERDICT r2 #2): the
+        mesh's invalid state stays RESIDENT between bursts — seed ids go
+        up, compacted newly ids come back, and the dense mirror catches up
+        via ``mark_invalid``. The dense invalid_version tracks whether a
+        host-led change (mark_invalid, epoch bump, a single-chip wave)
+        touched the invalid state since the last burst; only then does the
+        bridge pay a full O(n) re-sync. Validated on the virtual CPU mesh
+        (tests + dryrun)."""
         sharded = self.sharded_mirror(mesh=mesh)
+        entry = self._sharded_mirror
         seeds: List[int] = []
         fallback = 0
         for c in computeds:
@@ -383,12 +391,31 @@ class TpuGraphBackend:
                 seeds.append(nid)
         if not seeds:
             return fallback
-        before = self.graph.invalid_mask()
-        sharded.set_invalid(before)  # dense state is authoritative
-        count = sharded.run_wave(seeds)
-        newly = sharded.invalid_mask() & ~before
-        newly_ids = np.nonzero(newly)[0].astype(np.int32)
-        self.graph.mark_invalid(newly_ids)  # dense device + host mirror
+        dg = self.graph
+        if entry.get("invalid_version") != dg.invalid_version:
+            # host-led change since the last burst (or first burst on this
+            # mirror): dense state is authoritative — full sync, once. The
+            # host mirror catches up from the same device read, so the
+            # overflow mask-diff below never compares against a stale
+            # _h_invalid (run_wave_frontier(sync_host=False) leaves it
+            # stale, but it also bumps invalid_version → lands here)
+            mask = dg.invalid_mask()
+            dg._h_invalid[: dg.n_nodes] = mask
+            sharded.set_invalid(mask)
+        # the mesh state is about to advance; until the dense apply below
+        # COMPLETES, the entry must read as out-of-sync — otherwise a
+        # failure between the wave and the apply would leave the mesh
+        # permanently ahead and a retry of the same seeds would find
+        # nothing newly-invalid (a silently dropped cascade)
+        entry.pop("invalid_version", None)
+        count, newly_ids, overflow = sharded.run_wave_collect(seeds)
+        if overflow:
+            # wave larger than the collect buffer: one mask-diff readback
+            # (1 byte/node) against the still-pre-burst dense host mirror
+            newly = sharded.invalid_mask() & ~dg._h_invalid[: sharded.n_nodes]
+            newly_ids = np.nonzero(newly)[0].astype(np.int32)
+        dg.mark_invalid(newly_ids)  # dense device + host mirror catch up
+        entry["invalid_version"] = dg.invalid_version  # in sync again
         self._apply_newly(newly_ids)
         self.waves_run += 1
         self.device_invalidations += count

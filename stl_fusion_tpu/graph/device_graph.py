@@ -80,6 +80,11 @@ class DeviceGraph:
         # at, so stable-topology bursts pay O(1) and a stale mirror pays the
         # O(edges) fingerprint re-check at most once per mutation
         self._struct_version = 0
+        # bumped on every change to the INVALID state (waves, marks, epoch
+        # bumps, clears) — lets the sharded live bridge know whether its
+        # device-resident mirror of the invalid state is still current or a
+        # host-led change forces a full re-sync (VERDICT r2 #2)
+        self.invalid_version = 0
         self.mirror_bursts = 0  # observability: bursts served by the mirror
 
     # ------------------------------------------------------------------ build
@@ -124,6 +129,7 @@ class DeviceGraph:
         self._h_node_epoch[node_ids] += 1
         self._h_invalid[node_ids] = False
         self._struct_version += 1
+        self.invalid_version += 1
         if self._g is not None and not self._dirty:
             jnp = self._jnp
             ids = jnp.asarray(node_ids)
@@ -137,7 +143,10 @@ class DeviceGraph:
     def mark_invalid(self, node_ids: np.ndarray) -> None:
         """Externally-observed invalidations (host-led waves) → mirror state."""
         node_ids = np.asarray(node_ids, dtype=np.int32)
+        if node_ids.size == 0:
+            return
         self._h_invalid[node_ids] = True
+        self.invalid_version += 1
         if self._g is not None and not self._dirty:
             ids = self._jnp.asarray(node_ids)
             self._g = self._g._replace(invalid=self._g.invalid.at[ids].set(True))
@@ -227,6 +236,8 @@ class DeviceGraph:
         """Apply a compacted-wave readback to ``_h_invalid``: the id buffer
         when it fit, otherwise a full mask diff against the (already
         updated) device invalid state. Returns the newly-invalid ids."""
+        if count or overflow:
+            self.invalid_version += 1
         if overflow:
             newly = np.asarray(self._g.invalid) & ~self._h_invalid
             newly_ids = np.nonzero(newly)[0].astype(np.int32)
@@ -255,6 +266,8 @@ class DeviceGraph:
             mat[i, : len(s)] = np.asarray(s, dtype=np.int32)
         self._g, counts, newly = run_waves_chained(jnp.asarray(mat), g)
         counts, newly = jax.device_get((counts, newly))
+        if newly.any():
+            self.invalid_version += 1
         self._h_invalid |= newly
         return (
             counts[:n_real_waves].astype(np.int64),
@@ -289,6 +302,8 @@ class DeviceGraph:
         ids[: len(flat)] = np.asarray(flat, dtype=np.int32)
         self._g, count, newly = run_waves_union(jnp.asarray(ids), g)
         count, newly = jax.device_get((count, newly))
+        if newly.any():
+            self.invalid_version += 1
         self._h_invalid |= newly
         return int(count), np.nonzero(newly)[0].astype(np.int32)
 
@@ -466,6 +481,7 @@ class DeviceGraph:
         """Wave from a prebuilt boolean frontier (bench hot path — host copy
         of invalid state stays stale unless sync_host)."""
         g = self.device_arrays()
+        self.invalid_version += 1
         self._g, count = run_wave(seed_frontier, g)
         if sync_host:
             self._sync_invalid_back()
@@ -473,6 +489,7 @@ class DeviceGraph:
 
     def _sync_invalid_back(self) -> None:
         """After a device wave, the device invalid lane is newer — pull it."""
+        self.invalid_version += 1
         self._h_invalid = np.array(self._g.invalid)  # writable copy
 
     # ------------------------------------------------------------------ readback
@@ -485,6 +502,7 @@ class DeviceGraph:
 
     def clear_invalid(self) -> None:
         jnp = self._jnp
+        self.invalid_version += 1
         g = self.device_arrays()
         self._g = g._replace(invalid=jnp.zeros_like(g.invalid))
         self._h_invalid = np.zeros(self.n_cap + 1, dtype=bool)

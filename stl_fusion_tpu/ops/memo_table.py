@@ -71,6 +71,10 @@ class MemoTable:
         #: optional key codec (set by TableBacking wiring): arbitrary
         #: hashable keys ⇄ dense rows — see read_keys/invalidate_keys
         self.key_codec = None
+        #: declared key arity (set by TableBacking wiring): disambiguates a
+        #: single-arg method whose KEY VALUES are tuples from a multi-arg
+        #: method — a runtime isinstance(key, tuple) check cannot
+        self.key_arity: Optional[int] = None
         self.changed: AsyncEvent = AsyncEvent(0)
         self._jit_cache = _kernels()  # shared: tables reuse one compile cache
         if eager:
@@ -110,10 +114,25 @@ class MemoTable:
         codec = self._require_codec()
         rows = np.empty(len(keys), dtype=np.int32)
         for j, k in enumerate(keys):
-            args = k if isinstance(k, tuple) else (k,)
+            args = self._key_to_args(k)
             row = codec.acquire(args) if allocate else codec.peek(args)
             rows[j] = -1 if row is None else row
         return rows
+
+    def _key_to_args(self, k) -> tuple:
+        """Canonical call-args tuple for a key, by DECLARED arity: a
+        single-arg method's tuple-valued key must intern as ((1, 2),),
+        never be mistaken for two args."""
+        if self.key_arity == 1:
+            return (k,)
+        if self.key_arity is not None:
+            if not isinstance(k, tuple) or len(k) != self.key_arity:
+                raise TypeError(
+                    f"key {k!r} does not match the method's arity "
+                    f"({self.key_arity}): pass an args tuple"
+                )
+            return k
+        return k if isinstance(k, tuple) else (k,)  # standalone-table heuristic
 
     def read_keys(self, keys):
         """``read_batch`` for codec-backed tables: keys are interned to rows

@@ -243,6 +243,7 @@ class ShardedDeviceGraph:
         self._wave, self._wave_chain = build_sharded_wave(
             self.mesh, self.n_global, exchange=exchange
         )
+        self._collect_cache: dict = {}  # (cap, seed_width) → jitted program
 
     # ------------------------------------------------------------------ waves
     def seeds_to_frontier(self, seed_ids: Sequence[int]) -> jax.Array:
@@ -257,6 +258,68 @@ class ShardedDeviceGraph:
     def run_wave_frontier(self, frontier: jax.Array) -> int:
         self.g, count = self._wave(frontier, self.g)
         return int(count)
+
+    def run_wave_collect(
+        self, seed_ids: Sequence[int], cap: int = 65536
+    ) -> Tuple[int, np.ndarray, bool]:
+        """Union wave from ``seed_ids`` with an O(wave) host exchange
+        (VERDICT r2 #2): seed IDS travel up (never an O(n) frontier mask),
+        the newly-invalidated GLOBAL ids come back compacted into a
+        ``cap``-sized buffer, all in one dispatch. Returns (count, newly
+        ids, overflow) — on overflow (count > cap) the id buffer is
+        partial and the caller falls back to a mask diff."""
+        k = len(seed_ids)
+        width = 1
+        while width < max(k, 1):
+            width <<= 1
+        # pad = n_global: dropped as OOB by the scatter (-1 would WRAP to
+        # the last row and invalidate a padding slot)
+        ids = np.full(width, self.n_global, dtype=np.int32)
+        ids[:k] = np.asarray(seed_ids, dtype=np.int32)
+        key = (cap, width)
+        fn = self._collect_cache.get(key)
+        if fn is None:
+            fn = self._build_collect(cap)
+            self._collect_cache[key] = fn
+        self.g, count, out_ids, overflow = fn(jnp.asarray(ids), self.g)
+        count, out_ids, overflow = jax.device_get((count, out_ids, overflow))
+        count = int(count)
+        return count, out_ids[:count] if count <= cap else out_ids, bool(overflow)
+
+    def _build_collect(self, cap: int):
+        node_sh = self._node_sharding
+        n_global = self.n_global
+        n_nodes = self.n_nodes
+        wave = self._wave
+
+        @jax.jit
+        def collect(seed_ids: jax.Array, g: ShardedGraphArrays):
+            frontier = lax.with_sharding_constraint(
+                jnp.zeros(n_global, bool).at[seed_ids].set(True, mode="drop"),
+                node_sh,
+            )
+            inv_before = g.invalid
+            g2, _count = wave(frontier, g)
+            # only REAL rows count/compact — padding rows [n_nodes, n_global)
+            # exist for the mesh tiling, never for the caller
+            newly = (
+                g2.invalid
+                & ~inv_before
+                & (jnp.arange(n_global, dtype=jnp.int32) < n_nodes)
+            )
+            count = newly.sum(dtype=jnp.int32)
+            # global compaction over the sharded mask: XLA lowers the
+            # cumsum/scatter to mesh collectives; host traffic stays O(cap)
+            pos = jnp.cumsum(newly.astype(jnp.int32)) - 1
+            scatter_pos = jnp.where(newly & (pos < cap), pos, cap)
+            out = (
+                jnp.full(cap, -1, dtype=jnp.int32)
+                .at[scatter_pos]
+                .set(jnp.arange(n_global, dtype=jnp.int32), mode="drop")
+            )
+            return g2, count, out, count > cap
+
+        return collect
 
     def prepare_seed_mat(self, seed_mat: np.ndarray) -> jax.Array:
         """Pad a bool[W, n_nodes] seed matrix to the mesh capacity and

@@ -501,3 +501,58 @@ async def test_codec_is_per_service_instance():
         np.testing.assert_allclose(vals, [4.0] * 4)
     finally:
         set_default_hub(old)
+
+
+async def test_single_arg_tuple_valued_keys():
+    """Review r3: a SINGLE-arg method whose key values are tuples must not
+    be mistaken for a multi-arg method — encoding goes by declared arity,
+    and coherence holds both ways."""
+    import numpy as np
+
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        TableBacking,
+        capture,
+        compute_method,
+        memo_table_of,
+        set_default_hub,
+    )
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        class Grid(ComputeService):
+            def __init__(self, hub=None):
+                super().__init__(hub)
+                self.db = {(x, y): float(x * 10 + y) for x in range(4) for y in range(4)}
+
+            def load(self, cells):
+                # arity 1: the loader receives the BARE tuple keys
+                assert all(isinstance(c, tuple) and len(c) == 2 for c in cells)
+                return np.array([self.db[c] for c in cells], dtype=np.float32)
+
+            @compute_method(table=TableBacking(rows=16, batch="load", keys=True))
+            async def cell(self, pos: tuple) -> float:
+                return self.db[pos]
+
+        grid = Grid(hub)
+        table = memo_table_of(grid.cell)
+        vals = np.asarray(table.read_keys([(1, 2), (3, 0)]))
+        np.testing.assert_allclose(vals, [12.0, 30.0])
+
+        # table → scalar: the live node is keyed args ((1, 2),), and the
+        # codec interned the same shape
+        node = await capture(lambda: grid.cell((1, 2)))
+        grid.db[(1, 2)] = 99.0
+        table.invalidate_keys([(1, 2)])
+        assert node.is_invalidated
+        assert await grid.cell((1, 2)) == 99.0
+
+        # scalar → table through the node hook
+        node2 = await capture(lambda: grid.cell((3, 0)))
+        grid.db[(3, 0)] = 7.0
+        node2.invalidate()
+        assert float(np.asarray(table.read_keys([(3, 0)]))[0]) == 7.0
+    finally:
+        set_default_hub(old)

@@ -200,3 +200,268 @@ def test_packed_sharded_multiword_and_chained():
     t2 = pg3.run_waves(batch2_lists)
     assert per_batch.tolist() == [t1, t2]
     assert chained_total == t1 + t2
+
+
+# ------------------------------------------------------------------ O(wave) collect
+
+def test_sharded_collect_matches_wave_and_mask_diff():
+    """run_wave_collect returns exactly the newly-invalidated ids of the
+    equivalent run_wave, with the invalid state carried RESIDENT between
+    calls (the second collect sees the first one's state)."""
+    import numpy as np
+
+    from stl_fusion_tpu.parallel import ShardedDeviceGraph
+
+    rng = np.random.default_rng(11)
+    n = 500
+    edges = []
+    for d in range(1, n):
+        for s in rng.choice(d, size=min(int(rng.integers(0, 4)), d), replace=False):
+            edges.append((int(s), d))
+    arr = np.asarray(edges, dtype=np.int32)
+
+    a = ShardedDeviceGraph(arr[:, 0], arr[:, 1], n)
+    b = ShardedDeviceGraph(arr[:, 0], arr[:, 1], n)
+
+    seeds1 = rng.choice(n, size=6, replace=False).tolist()
+    seeds2 = rng.choice(n, size=6, replace=False).tolist()
+
+    before = a.invalid_mask().copy()
+    c1, ids1, over1 = a.run_wave_collect(seeds1)
+    assert not over1
+    b.run_wave(seeds1)
+    np.testing.assert_array_equal(a.invalid_mask(), b.invalid_mask())
+    want1 = np.nonzero(b.invalid_mask() & ~before)[0]
+    np.testing.assert_array_equal(np.sort(ids1), want1)
+    assert c1 == len(want1)
+
+    # second collect from the RESIDENT state: only genuinely-new ids return
+    before2 = b.invalid_mask().copy()
+    c2, ids2, over2 = a.run_wave_collect(seeds2)
+    b.run_wave(seeds2)
+    want2 = np.nonzero(b.invalid_mask() & ~before2)[0]
+    np.testing.assert_array_equal(np.sort(ids2), want2)
+    assert c2 == len(want2) and not over2
+
+
+def test_sharded_collect_overflow_flag():
+    """count > cap sets overflow; the caller falls back to a mask diff."""
+    import numpy as np
+
+    from stl_fusion_tpu.parallel import ShardedDeviceGraph
+
+    n = 200
+    # a chain: one seed cascades everywhere
+    src = np.arange(n - 1, dtype=np.int32)
+    dst = np.arange(1, n, dtype=np.int32)
+    g = ShardedDeviceGraph(src, dst, n)
+    count, ids, overflow = g.run_wave_collect([0], cap=16)
+    assert count == n and overflow
+    assert g.invalid_mask().all()
+
+
+async def test_sharded_bridge_resident_state_skips_full_sync():
+    """VERDICT r2 #2: consecutive mesh bursts pay NO full invalid-state
+    sync — set_invalid fires only on the first burst and after a host-led
+    invalid-state change; burst results stay equal to the dense path."""
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        capture,
+        compute_method,
+        set_default_hub,
+    )
+    from stl_fusion_tpu.graph import TpuGraphBackend
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        backend = TpuGraphBackend(hub)
+
+        class Chain(ComputeService):
+            @compute_method
+            async def base(self, i: int) -> int:
+                return i
+
+            @compute_method
+            async def mid(self, i: int) -> int:
+                return await self.base(i) + 1
+
+            @compute_method
+            async def top(self, i: int) -> int:
+                return await self.mid(i) + 1
+
+        svc = Chain(hub=hub)
+        tops = [await capture(lambda i=i: svc.top(i)) for i in range(6)]
+        bases = [await capture(lambda i=i: svc.base(i)) for i in range(6)]
+
+        sharded = backend.sharded_mirror()
+        sync_calls = []
+        orig_set_invalid = sharded.set_invalid
+        sharded.set_invalid = lambda mask: (sync_calls.append(1), orig_set_invalid(mask))[1]
+
+        assert backend.invalidate_cascade_batch_sharded([bases[0]]) == 3
+        assert backend.invalidate_cascade_batch_sharded([bases[1]]) == 3
+        assert backend.invalidate_cascade_batch_sharded([bases[2]]) == 3
+        assert len(sync_calls) == 1  # only the FIRST burst synced
+
+        assert bases[0].is_invalidated or backend._pending[backend.id_for(bases[0])]
+        assert tops[1].is_invalidated or backend._pending[backend.id_for(tops[1])]
+
+        # idempotence across the resident state: re-bursting an already
+        # invalid seed finds nothing new
+        assert backend.invalidate_cascade_batch_sharded([bases[0]]) == 0
+        assert len(sync_calls) == 1
+
+        # a NO-OP dense wave (already-invalid seed, nothing newly invalid)
+        # must not force a full re-sync either (review r3)
+        assert backend.invalidate_cascade_batch([bases[0]]) == 0
+        assert backend.invalidate_cascade_batch_sharded([bases[5]]) == 3
+        assert len(sync_calls) == 1
+
+        # a HOST-led invalid-state change → exactly one full re-sync
+        backend.graph.mark_invalid(
+            np.asarray([backend.id_for(bases[3])], dtype=np.int32)
+        )
+        assert backend.invalidate_cascade_batch_sharded([bases[4]]) == 3
+        assert len(sync_calls) == 2
+        # the host-led mark was honored: base(3) reads as already invalid,
+        # and (dense rule) an already-invalid seed does NOT re-expand —
+        # run_wave's `fresh = seeds & ~invalid` gate
+        assert backend.invalidate_cascade_batch_sharded([bases[3]]) == 0
+        assert len(sync_calls) == 2
+    finally:
+        set_default_hub(old)
+
+
+async def test_sharded_bridge_chaos_interleaving():
+    """VERDICT r2 #8: randomized interleaving of live mutations (reads that
+    recompute, host-led invalidations), mirror rebuilds, single-chip bursts,
+    and mesh bursts — with a python BFS oracle asserting EXACT dense-BFS
+    equivalence of every mesh burst, plus failure injection between the
+    mesh wave and the host apply (the bridge must recover by re-syncing
+    from the authoritative dense state)."""
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        capture,
+        compute_method,
+        invalidating,
+        set_default_hub,
+    )
+    from stl_fusion_tpu.graph import TpuGraphBackend
+
+    rng = np.random.default_rng(1234)
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        backend = TpuGraphBackend(hub)
+        K = 24
+
+        class Chain(ComputeService):
+            def __init__(self, hub=None):
+                super().__init__(hub)
+                self.data = {i: i for i in range(K)}
+
+            @compute_method
+            async def base(self, i: int) -> int:
+                return self.data[i]
+
+            @compute_method
+            async def mid(self, i: int) -> int:
+                return await self.base(i) + await self.base((i + 1) % K)
+
+            @compute_method
+            async def top(self, i: int) -> int:
+                return await self.mid(i) + 1
+
+        svc = Chain(hub)
+        for i in range(K):
+            await svc.top(i)
+
+        def oracle_burst(seed_nids):
+            """Expected (count, final invalid mask) of a dense union BFS
+            from the CURRENT live state (post-flush host arrays)."""
+            dg = backend.graph
+            n, m = dg.n_nodes, dg.n_edges
+            edges = list(zip(dg._h_edge_src[:m].tolist(), dg._h_edge_dst[:m].tolist()))
+            final = python_wave_oracle(
+                n, edges, dg._h_edge_dst_epoch[:m].tolist(),
+                dg._h_node_epoch[:n], dg._h_invalid[:n].copy(), seed_nids,
+            )
+            count = int((final & ~dg._h_invalid[:n]).sum())
+            return count, final
+
+        async def live_computed(kind, i):
+            fn = {"base": svc.base, "mid": svc.mid, "top": svc.top}[kind]
+            return await capture(lambda: fn(i))
+
+        injected = [0]
+        for step in range(70):
+            action = rng.choice(["burst", "read", "write", "mark", "mirror", "fail"])
+            i = int(rng.integers(0, K))
+            if action == "read":
+                await svc.top(i)  # recomputes anything invalid → epoch bumps
+            elif action == "write":
+                svc.data[i] += 1
+                with invalidating():
+                    await svc.base(i)  # host-led journal invalidation
+            elif action == "mark":
+                c = await live_computed(str(rng.choice(["base", "mid"])), i)
+                c.invalidate()  # host-led, outside any device wave
+            elif action == "mirror":
+                backend.sharded_mirror()
+            elif action == "fail":
+                # failure INJECTION between mesh wave and host apply: the
+                # mesh state advances but the dense apply never happens;
+                # the bridge must self-heal on the next burst (dense state
+                # is authoritative; the entry version was never updated)
+                c = await live_computed("base", i)
+                sharded = backend.sharded_mirror()
+                orig = sharded.run_wave_collect
+
+                def boom(*a, **k):
+                    sharded.run_wave_collect = orig
+                    orig(*a, **k)  # the mesh wave RUNS...
+                    raise ConnectionError("injected between wave and apply")
+
+                sharded.run_wave_collect = boom
+                with pytest.raises(ConnectionError):
+                    backend.invalidate_cascade_batch_sharded([c])
+                injected[0] += 1
+                # DETERMINISTIC self-heal check (review r3: with the wrong
+                # protocol this only passed when an unrelated action bumped
+                # the version first): retrying the SAME seed immediately
+                # must still produce the oracle cascade — the entry was
+                # marked stale before the wave, so the retry re-syncs from
+                # the authoritative dense state instead of finding the
+                # mesh already-invalid and dropping the cascade
+                backend.flush()
+                want_count, want_mask = oracle_burst([backend.id_for(c)])
+                got = backend.invalidate_cascade_batch_sharded([c])
+                assert got == want_count, (step, "post-injection", got, want_count)
+                np.testing.assert_array_equal(
+                    backend.graph._h_invalid[: backend.graph.n_nodes], want_mask
+                )
+            else:  # burst — the assertion step
+                kinds = rng.choice(["base", "mid", "top"], size=int(rng.integers(1, 4)))
+                cs = [await live_computed(str(k), int(rng.integers(0, K))) for k in kinds]
+                backend.flush()
+                seed_nids = [backend.id_for(c) for c in cs]
+                assert all(s is not None for s in seed_nids)
+                want_count, want_mask = oracle_burst(seed_nids)
+                if rng.random() < 0.5:
+                    got = backend.invalidate_cascade_batch_sharded(cs)
+                else:
+                    got = backend.invalidate_cascade_batch(cs)
+                assert got == want_count, (step, action, got, want_count)
+                dg = backend.graph
+                np.testing.assert_array_equal(
+                    dg._h_invalid[: dg.n_nodes], want_mask, err_msg=f"step {step}"
+                )
+                np.testing.assert_array_equal(
+                    dg.invalid_mask(), want_mask, err_msg=f"step {step} (device)"
+                )
+        assert injected[0] > 0, "chaos run never exercised the failure injection"
+    finally:
+        set_default_hub(old)
