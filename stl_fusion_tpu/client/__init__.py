@@ -7,6 +7,7 @@ from .cache import (
 )
 from .client_function import ClientComputed, ClientComputeMethodFunction, FusionClient, compute_client
 from .compute_call import RpcInboundComputeCall, RpcOutboundComputeCall, install_compute_call_type
+from .remote_table import TABLE_RPC_SERVICE, RemoteTable, RemoteTableHost
 from .service_modes import RoutingComputeProxy, RpcServiceMode, add_fusion_service
 
 __all__ = [
@@ -24,4 +25,7 @@ __all__ = [
     "RpcInboundComputeCall",
     "RpcOutboundComputeCall",
     "install_compute_call_type",
+    "RemoteTable",
+    "RemoteTableHost",
+    "TABLE_RPC_SERVICE",
 ]

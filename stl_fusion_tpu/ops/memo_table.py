@@ -217,6 +217,39 @@ class MemoTable:
         self.version += 1
         self.changed = self.changed.create_next(self.version)
 
+    # ------------------------------------------------------------------ checkpoint
+    def export_state(self) -> dict:
+        """Snapshot of the columnar state (values + per-row validity +
+        version) for checkpoint/resume — the restart-surviving analogue of
+        the reference's persistent client cache
+        (Client/Caching/ClientComputedCache.cs:35-49)."""
+        return {
+            "values": np.asarray(self._values),
+            "valid": (~self._stale_host).copy(),
+            "version": int(self.version),
+        }
+
+    def import_state(self, state: dict) -> None:
+        """Restore :meth:`export_state` output: valid rows read as warm
+        hits immediately; stale rows refresh on first touch. Invalidation
+        wiring (on_invalidate, codec) is the LIVE table's — import only
+        replaces the row data, so post-restore invalidations propagate
+        exactly like pre-snapshot ones."""
+        values = np.asarray(state["values"])
+        if values.shape != tuple(np.asarray(self._values).shape):
+            raise ValueError(
+                f"checkpoint shape {values.shape} != table shape "
+                f"{tuple(np.asarray(self._values).shape)}"
+            )
+        valid = np.asarray(state["valid"], dtype=bool)
+        self._values = self._jnp.asarray(values)
+        self._stale_host = ~valid
+        self._stale_count = int((~valid).sum())
+        self._valid_dev = self._jnp.asarray(valid)
+        self._packed_cache = None
+        self.version = int(state["version"])
+        self._bump()
+
     # ------------------------------------------------------------------ misc
     def stale_count(self) -> int:
         return self._stale_count

@@ -67,6 +67,9 @@ class RpcHub:
         )
         #: $sys-c dispatch hook, installed by the fusion client layer
         self.compute_system_handler: Optional[Callable[[RpcPeer, RpcMessage], None]] = None
+        #: $sys-t dispatch hook (per-table row fences + subscriptions),
+        #: installed by client/remote_table.py on both ends
+        self.table_system_handler: Optional[Callable[[RpcPeer, RpcMessage], None]] = None
         #: composable middleware chains (≈ RpcInboundMiddleware /
         #: RpcOutboundMiddleware, Stl.Rpc/Infrastructure/): each entry is
         #: ``async (peer, message, nxt)`` where ``await nxt(message)``

@@ -23,7 +23,7 @@ from typing import Callable, Optional
 
 from ..ext.session import Session, SessionResolver, replace_default_sessions
 from ..utils.serialization import dumps, loads
-from .message import COMPUTE_SYSTEM_SERVICE, SYSTEM_SERVICE, RpcMessage
+from .message import COMPUTE_SYSTEM_SERVICE, SYSTEM_SERVICE, TABLE_SYSTEM_SERVICE, RpcMessage
 from .peer import RpcPeer
 
 log = logging.getLogger("stl_fusion_tpu")
@@ -79,7 +79,7 @@ def default_session_replacer_middleware(
     unless ``resolver_for_peer`` supplies one)."""
 
     async def middleware(peer: RpcPeer, message: RpcMessage, nxt):
-        if message.service in (SYSTEM_SERVICE, COMPUTE_SYSTEM_SERVICE):
+        if message.service in (SYSTEM_SERVICE, COMPUTE_SYSTEM_SERVICE, TABLE_SYSTEM_SERVICE):
             return await nxt(message)
         # byte-level pre-check: the placeholder serializes as the literal
         # "~" — most calls carry no Session at all and must not pay a full

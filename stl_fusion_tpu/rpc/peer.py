@@ -22,7 +22,7 @@ from ..utils.async_utils import AsyncEvent, Channel, ChannelClosedError, Channel
 from ..utils.collections import RecentlySeenMap
 from ..utils.errors import ExceptionInfo
 from ..utils.serialization import dumps, loads
-from .message import COMPUTE_SYSTEM_SERVICE, SYSTEM_SERVICE, RpcMessage
+from .message import COMPUTE_SYSTEM_SERVICE, SYSTEM_SERVICE, TABLE_SYSTEM_SERVICE, RpcMessage
 
 if TYPE_CHECKING:
     from .hub import RpcHub
@@ -256,10 +256,10 @@ class RpcPeer(WorkerBase):
                 call = self.outbound_calls.get(message.call_id)
                 if call is not None:
                     call.set_error(e)
-            elif message.service == COMPUTE_SYSTEM_SERVICE:
-                # a dropped invalidation push would mean stale-forever; tear
-                # the link down so the reconnect re-send/re-register cycle
-                # restores consistency (the pre-middleware pump behavior)
+            elif message.service in (COMPUTE_SYSTEM_SERVICE, TABLE_SYSTEM_SERVICE):
+                # a dropped invalidation/fence push would mean stale-forever;
+                # tear the link down so the reconnect re-send/re-register (or
+                # invalidate-all-and-resubscribe) cycle restores consistency
                 await self.disconnect(e)
             elif message.call_id:
                 try:
@@ -280,6 +280,10 @@ class RpcPeer(WorkerBase):
             self._process_system(message)
         elif message.service == COMPUTE_SYSTEM_SERVICE:
             handler = self.hub.compute_system_handler
+            if handler is not None:
+                handler(self, message)
+        elif message.service == TABLE_SYSTEM_SERVICE:
+            handler = self.hub.table_system_handler
             if handler is not None:
                 handler(self, message)
         else:

@@ -66,6 +66,29 @@ register_wire_type(
 register_wire_type(LTag, "LTag", lambda v: {"v": int(v)}, lambda d: LTag(d["v"]))
 
 
+def _register_ndarray() -> None:
+    """numpy arrays travel as raw bytes + dtype + shape (the batch-read
+    payload shape — a JSON float list would dominate the wire cost of the
+    vectorized read path)."""
+    import numpy as np
+
+    register_wire_type(
+        np.ndarray,
+        "ndarray",
+        to_dict=lambda a: {
+            "data": a.tobytes(),
+            "dtype": str(a.dtype),
+            "shape": list(a.shape),
+        },
+        from_dict=lambda d: np.frombuffer(d["data"], dtype=np.dtype(d["dtype"]))
+        .reshape(d["shape"])
+        .copy(),
+    )
+
+
+_register_ndarray()
+
+
 def encode(value: Any) -> Any:
     """Value → JSON-compatible structure with $t tags."""
     if value is None or isinstance(value, (bool, int, float, str)):

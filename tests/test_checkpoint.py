@@ -4,6 +4,7 @@ restored dependency edges, and the restart-resumes-from-watermark flow the
 reference gets from its client cache + DB operation log."""
 import asyncio
 import dataclasses
+import os
 
 import numpy as np
 
@@ -218,3 +219,196 @@ async def test_checkpoint_manager_rotation(tmp_path):
     hub2.add_service(CartService(hub2))
     result = mgr.restore_latest(hub2)
     assert result is not None and result.oplog_position == 3 and result.count == 3
+
+
+# ------------------------------------------------------------------ MemoTable
+
+TABLE_SNAPSHOT_SCRIPT = r"""
+import asyncio, os, sys
+sys.path.insert(0, sys.argv[2])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from stl_fusion_tpu.checkpoint import HubCheckpoint
+from stl_fusion_tpu.core import FusionHub, memo_table_of, set_default_hub
+from table_ckpt_service import Users, NamedUsers
+
+async def main():
+    hub = FusionHub(); set_default_hub(hub)
+    users = Users(hub); named = NamedUsers(hub)
+    hub.add_service(users, "users"); hub.add_service(named, "named")
+    table = memo_table_of(users.balance)
+    table.read_batch(np.arange(16))          # warm every row
+    table.invalidate([3])                    # one row deliberately stale
+    memo_table_of(named.balance).read_keys(["alice", "bob"])
+    HubCheckpoint.save(hub, sys.argv[1])
+    print("saved", flush=True)
+
+asyncio.run(main())
+"""
+
+SERVICE_MODULE = '''
+import numpy as np
+from stl_fusion_tpu.core import ComputeService, TableBacking, compute_method
+
+
+class Users(ComputeService):
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self.db = {i: float(i) for i in range(16)}
+        self.loads = 0
+
+    def load(self, ids):
+        self.loads += len(ids)
+        return np.array([self.db[int(i)] for i in ids], dtype=np.float32)
+
+    @compute_method(table=TableBacking(rows=16, batch="load"))
+    async def balance(self, uid: int) -> float:
+        return self.db[uid]
+
+
+class NamedUsers(ComputeService):
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self.db = {"alice": 1.0, "bob": 2.0, "carol": 3.0}
+        self.loads = 0
+
+    def load(self, names):
+        self.loads += len(names)
+        return np.array([self.db[n] for n in names], dtype=np.float32)
+
+    @compute_method(table=TableBacking(rows=8, batch="load", keys=True))
+    async def balance(self, name: str) -> float:
+        return self.db[name]
+'''
+
+
+async def test_memo_table_survives_restart(tmp_path):
+    """VERDICT r2 #6: snapshot in ONE process, restore in ANOTHER — the
+    first read_batch is a warm hit (zero loader calls), the deliberately
+    stale row refreshes on touch, codec-backed key layouts survive, and a
+    POST-restore invalidation still propagates both ways."""
+    import subprocess
+    import sys as _sys
+
+    import numpy as np
+
+    svc_mod = tmp_path / "table_ckpt_service.py"
+    svc_mod.write_text(SERVICE_MODULE)
+    snap_path = tmp_path / "hub.ckpt"
+    script = tmp_path / "save_side.py"
+    script.write_text(TABLE_SNAPSHOT_SCRIPT)
+    env = dict(os.environ, PYTHONPATH=f"{tmp_path}:{os.environ.get('PYTHONPATH', '')}")
+    proc = subprocess.run(
+        [_sys.executable, str(script), str(snap_path), os.getcwd()],
+        capture_output=True, text=True, timeout=120, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert snap_path.exists()
+
+    # ---- the restoring process (THIS one) builds fresh services
+    _sys.path.insert(0, str(tmp_path))
+    try:
+        import importlib
+
+        import table_ckpt_service
+
+        importlib.reload(table_ckpt_service)
+        from stl_fusion_tpu.checkpoint import HubCheckpoint
+        from stl_fusion_tpu.core import FusionHub, capture, memo_table_of, set_default_hub
+
+        hub = FusionHub()
+        old = set_default_hub(hub)
+        try:
+            users = table_ckpt_service.Users(hub)
+            named = table_ckpt_service.NamedUsers(hub)
+            hub.add_service(users, "users")
+            hub.add_service(named, "named")
+            result = HubCheckpoint.restore(hub, str(snap_path))
+            assert result.tables == 2
+
+            table = memo_table_of(users.balance)
+            # warm rows: first read is a HIT — the loader never runs
+            vals = np.asarray(table.read_batch([1, 5, 9]))
+            np.testing.assert_allclose(vals, [1.0, 5.0, 9.0])
+            assert users.loads == 0
+            # the deliberately-stale row refreshes on first touch
+            users.db[3] = 33.0
+            assert float(np.asarray(table.read_batch([3]))[0]) == 33.0
+            assert users.loads == 1
+
+            # codec layout survived: read_keys hits without loading
+            ntable = memo_table_of(named.balance)
+            nvals = np.asarray(ntable.read_keys(["alice", "bob"]))
+            np.testing.assert_allclose(nvals, [1.0, 2.0])
+            assert named.loads == 0
+
+            # POST-restore coherence, table → scalar
+            node = await capture(lambda: users.balance(5))
+            users.db[5] = 55.0
+            table.invalidate([5])
+            assert node.is_invalidated
+            assert float(np.asarray(table.read_batch([5]))[0]) == 55.0
+            # and scalar → table
+            node2 = await capture(lambda: named.balance("alice"))
+            named.db["alice"] = 11.0
+            node2.invalidate()
+            assert float(np.asarray(ntable.read_keys(["alice"]))[0]) == 11.0
+        finally:
+            set_default_hub(old)
+    finally:
+        _sys.path.remove(str(tmp_path))
+
+
+async def test_table_restore_refuses_diverged_key_layout(tmp_path):
+    """Review r3: keys interned BEFORE restore shift the row layout — the
+    restore must leave the table cold (correct refetches) instead of
+    serving other keys' values as warm hits."""
+    import numpy as np
+
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        TableBacking,
+        compute_method,
+        memo_table_of,
+        set_default_hub,
+    )
+
+    class Named(ComputeService):
+        def __init__(self, hub=None):
+            super().__init__(hub)
+            self.db = {"alice": 1.0, "bob": 2.0, "carol": 3.0}
+            self.loads = 0
+
+        def load(self, names):
+            self.loads += len(names)
+            return np.array([self.db[n] for n in names], dtype=np.float32)
+
+        @compute_method(table=TableBacking(rows=8, batch="load", keys=True))
+        async def balance(self, name: str) -> float:
+            return self.db[name]
+
+    hub_a = FusionHub()
+    old = set_default_hub(hub_a)
+    try:
+        a = Named(hub_a)
+        hub_a.add_service(a, "named")
+        memo_table_of(a.balance).read_keys(["alice", "bob"])  # alice=0, bob=1
+        path = str(tmp_path / "snap.bin")
+        HubCheckpoint.save(hub_a, path)
+
+        hub_b = FusionHub()
+        set_default_hub(hub_b)
+        b = Named(hub_b)
+        hub_b.add_service(b, "named")
+        tb = memo_table_of(b.balance)
+        tb.read_keys(["carol"])  # carol grabs row 0 BEFORE the restore
+        result = HubCheckpoint.restore(hub_b, path)
+        assert result.tables == 0  # refused: layout diverged
+
+        # correctness over warmth: every read still returns the right value
+        vals = np.asarray(tb.read_keys(["alice", "bob", "carol"]))
+        np.testing.assert_allclose(vals, [1.0, 2.0, 3.0])
+    finally:
+        set_default_hub(old)

@@ -1,0 +1,250 @@
+"""Vectorized reads over RPC: RemoteTableHost/RemoteTable — one gather per
+batch across the process boundary, per-table row fences, reconnect
+coherence (VERDICT r2 missing #1 / next #4)."""
+import asyncio
+
+import numpy as np
+import pytest
+
+from stl_fusion_tpu.client import RemoteTable, RemoteTableHost
+from stl_fusion_tpu.ops.memo_table import MemoTable
+from stl_fusion_tpu.rpc import RpcHub
+from stl_fusion_tpu.rpc.testing import RpcTestTransport
+
+
+def make_table(n=64):
+    db = {i: float(i) for i in range(n)}
+    loads_count = [0]
+
+    def compute(ids):
+        loads_count[0] += len(ids)
+        return np.array([db[int(i)] for i in ids], dtype=np.float32)
+
+    return MemoTable(n, compute), db, loads_count
+
+
+async def rpc_pair():
+    server = RpcHub("table-server")
+    client = RpcHub("table-client")
+    RpcTestTransport(client, server)
+    return server, client
+
+
+async def test_remote_read_batch_one_rpc_per_stale_batch():
+    server, client = await rpc_pair()
+    table, db, loads_count = make_table()
+    RemoteTableHost(server).expose("users", table)
+    remote = RemoteTable(client, "default", "users")
+    try:
+        vals = await remote.read_batch([3, 1, 3, 7])
+        np.testing.assert_allclose(vals, [3.0, 1.0, 3.0, 7.0])
+        assert remote.remote_reads == 1  # one batched RPC, not per id
+
+        # repeat reads are LOCAL: no new RPC, no server loads
+        loads_before, reads_before = loads_count[0], remote.remote_reads
+        vals = await remote.read_batch([1, 7])
+        np.testing.assert_allclose(vals, [1.0, 7.0])
+        assert remote.remote_reads == reads_before
+        assert loads_count[0] == loads_before
+    finally:
+        remote.dispose()
+        await client.stop()
+        await server.stop()
+
+
+async def test_server_row_invalidation_flips_remote_result():
+    """THE done-criterion: a server-side row invalidation reaches the
+    remote cache via the per-table fence and the next batch read returns
+    the new value — while untouched rows stay local."""
+    server, client = await rpc_pair()
+    table, db, loads_count = make_table()
+    RemoteTableHost(server).expose("users", table)
+    remote = RemoteTable(client, "default", "users")
+    try:
+        vals = await remote.read_batch([5, 6])
+        np.testing.assert_allclose(vals, [5.0, 6.0])
+
+        db[5] = 50.0
+        table.invalidate([5])  # server-side change
+
+        async def fenced():
+            while remote.fences_seen == 0:
+                await asyncio.sleep(0.005)
+
+        await asyncio.wait_for(fenced(), 5.0)
+        reads_before = remote.remote_reads
+        vals = await remote.read_batch([5, 6])
+        np.testing.assert_allclose(vals, [50.0, 6.0])
+        assert remote.remote_reads == reads_before + 1
+        # and ONLY the fenced row was refetched
+        vals = await remote.read_batch([6])
+        assert remote.remote_reads == reads_before + 1
+    finally:
+        remote.dispose()
+        await client.stop()
+        await server.stop()
+
+
+async def test_fence_during_inflight_read_wins():
+    """A fence that lands while a batch read is in flight keeps the row
+    stale: the fetched (pre-invalidation) value is returned once, but the
+    NEXT read refetches."""
+    server, client = await rpc_pair()
+    table, db, loads_count = make_table()
+    host = RemoteTableHost(server)
+    host.expose("users", table)
+    remote = RemoteTable(client, "default", "users")
+    try:
+        await remote.read_batch([0])  # subscribe + warm
+        # make the next fetch slow so we can land a fence mid-flight
+        svc = server.local_services.get("$tables") if hasattr(server, "local_services") else None
+        orig = table.read_batch
+
+        async def read_then_fence():
+            return await remote.read_batch([9])
+
+        def slow_read(ids):
+            result = orig(ids)
+            db[9] = 99.0
+            table.invalidate([9])  # fence fires before the response returns
+            return result
+
+        table.read_batch = slow_read
+        vals = await read_then_fence()
+        table.read_batch = orig
+
+        async def fenced():
+            while remote.fences_seen < 1:
+                await asyncio.sleep(0.005)
+
+        await asyncio.wait_for(fenced(), 5.0)
+        # row 9 must be stale (fence won) → next read refetches 99.0
+        vals = await remote.read_batch([9])
+        np.testing.assert_allclose(vals, [99.0])
+    finally:
+        remote.dispose()
+        await client.stop()
+        await server.stop()
+
+
+async def test_reconnect_invalidates_cache_and_resubscribes():
+    """Fences dropped while the link was down can't strand stale rows: on
+    reconnect the client invalidates everything and resubscribes."""
+    server, client = await rpc_pair()
+    table, db, loads_count = make_table()
+    RemoteTableHost(server).expose("users", table)
+    remote = RemoteTable(client, "default", "users")
+    try:
+        await remote.read_batch([2])
+        peer = client.client_peer("default")
+
+        # sever the link; change the row while disconnected (the fence push
+        # fails and drops the subscription server-side)
+        await peer.disconnect(ConnectionError("chaos"))
+        db[2] = 22.0
+        table.invalidate([2])
+
+        await peer.when_connected()
+
+        async def refreshed():
+            while True:
+                vals = await remote.read_batch([2])
+                if float(vals[0]) == 22.0:
+                    return
+                await asyncio.sleep(0.01)
+
+        await asyncio.wait_for(refreshed(), 10.0)
+
+        # the NEW subscription works too: another server-side change fences
+        db[2] = 222.0
+        table.invalidate([2])
+
+        async def refetched():
+            while float((await remote.read_batch([2]))[0]) != 222.0:
+                await asyncio.sleep(0.01)
+
+        await asyncio.wait_for(refetched(), 10.0)
+    finally:
+        remote.dispose()
+        await client.stop()
+        await server.stop()
+
+
+async def test_fenced_row_revalidates_after_refetch():
+    """Review r3 (off-by-one): after a fence and ONE refetch, subsequent
+    reads of that row are LOCAL again — not a permanent cache miss."""
+    server, client = await rpc_pair()
+    table, db, loads_count = make_table()
+    RemoteTableHost(server).expose("users", table)
+    remote = RemoteTable(client, "default", "users")
+    try:
+        await remote.read_batch([4])
+        db[4] = 44.0
+        table.invalidate([4])
+
+        async def fenced():
+            while remote.fences_seen == 0:
+                await asyncio.sleep(0.005)
+
+        await asyncio.wait_for(fenced(), 5.0)
+        assert float((await remote.read_batch([4]))[0]) == 44.0
+        reads_after_refetch = remote.remote_reads
+        # THE regression: these must be local hits, zero further RPCs
+        for _ in range(3):
+            assert float((await remote.read_batch([4]))[0]) == 44.0
+        assert remote.remote_reads == reads_after_refetch
+    finally:
+        remote.dispose()
+        await client.stop()
+        await server.stop()
+
+
+async def test_host_and_client_roles_coexist_on_one_hub():
+    """Review r3: a middle-tier hub that HOSTS a table and CONSUMES another
+    keeps both $sys-t directions working (composite dispatcher, no
+    last-writer-wins)."""
+    upstream = RpcHub("upstream")
+    middle = RpcHub("middle")
+    from stl_fusion_tpu.rpc.testing import RpcTestTransport
+    RpcTestTransport(middle, upstream)
+
+    up_table, up_db, _ = make_table()
+    RemoteTableHost(upstream).expose("users", up_table)
+    # middle hub: consumes upstream AND hosts its own table
+    mid_table, mid_db, _ = make_table()
+    RemoteTableHost(middle).expose("mids", mid_table)
+    remote = RemoteTable(middle, "default", "users")
+    try:
+        assert float((await remote.read_batch([2]))[0]) == 2.0
+        up_db[2] = 22.0
+        up_table.invalidate([2])  # upstream fence → middle's client side
+
+        async def refetched():
+            while float((await remote.read_batch([2]))[0]) != 22.0:
+                await asyncio.sleep(0.01)
+
+        await asyncio.wait_for(refetched(), 5.0)
+    finally:
+        remote.dispose()
+        await middle.stop()
+        await upstream.stop()
+
+
+async def test_concurrent_readers_single_flight():
+    """Review r3: N concurrent readers of the same stale rows coalesce
+    behind one RPC."""
+    server, client = await rpc_pair()
+    table, db, loads_count = make_table()
+    RemoteTableHost(server).expose("users", table)
+    remote = RemoteTable(client, "default", "users")
+    try:
+        await remote.read_batch([0])  # subscribe + warm plumbing
+        before = remote.remote_reads
+        results = await asyncio.gather(*(remote.read_batch([7, 8]) for _ in range(6)))
+        for vals in results:
+            np.testing.assert_allclose(vals, [7.0, 8.0])
+        assert remote.remote_reads == before + 1  # one coalesced fetch
+    finally:
+        remote.dispose()
+        await client.stop()
+        await server.stop()
