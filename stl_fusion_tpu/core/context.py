@@ -63,12 +63,16 @@ class ComputeContext:
     tests on the hot read path are single int ops.
     """
 
-    __slots__ = ("call_options", "_captured")
+    __slots__ = ("call_options", "_captured", "invalidation_sink")
 
     DEFAULT: "ComputeContext"
 
-    def __init__(self, call_options: CallOptions = CallOptions.NONE):
+    def __init__(self, call_options: CallOptions = CallOptions.NONE, invalidation_sink=None):
         self.call_options = int(call_options)
+        #: when set (batch replay), INVALIDATE-mode hits are COLLECTED here
+        #: instead of cascading host-side immediately — the caller applies
+        #: them as one device lane burst (oplog/reader.py)
+        self.invalidation_sink = invalidation_sink
         self._captured: Optional["Computed"] = None
 
     # -- capture ----------------------------------------------------------
@@ -148,10 +152,13 @@ def is_invalidating() -> bool:
 
 
 class _InvalidatingScope:
-    __slots__ = ("_ctx", "_cm")
+    __slots__ = ("_ctx", "_cm", "_sink")
+
+    def __init__(self, sink=None):
+        self._sink = sink
 
     def __enter__(self):
-        self._ctx = ComputeContext(CallOptions.INVALIDATE)
+        self._ctx = ComputeContext(CallOptions.INVALIDATE, invalidation_sink=self._sink)
         self._cm = self._ctx.activate()
         self._cm.__enter__()
         return self._ctx
@@ -160,10 +167,15 @@ class _InvalidatingScope:
         return self._cm.__exit__(*exc)
 
 
-def invalidating() -> _InvalidatingScope:
+def invalidating(sink=None) -> _InvalidatingScope:
     """``with invalidating(): await service.get(x)`` invalidates the cached
-    node for ``get(x)`` instead of computing it."""
-    return _InvalidatingScope()
+    node for ``get(x)`` instead of computing it.
+
+    ``sink``: a list — INVALIDATE-mode hits are APPENDED instead of
+    cascading immediately; the caller owns applying the collected group
+    (e.g. as one lane of a device burst). Used by the op-log reader to
+    lane-pack a batch of external operations' replays."""
+    return _InvalidatingScope(sink)
 
 
 async def capture(fn: Callable[[], Awaitable[T]]) -> "Computed":

@@ -104,7 +104,13 @@ class FunctionBase:
         opts = context.call_options
         if opts & OPT_INVALIDATE_BIT:
             if existing is not None:
-                existing.invalidate()
+                sink = context.invalidation_sink
+                if sink is not None:
+                    # batch replay: collect; the caller cascades the whole
+                    # group on device in one lane burst
+                    sink.append(existing)
+                else:
+                    existing.invalidate()
                 context.try_capture(existing)
             return existing
         if opts & OPT_GET_EXISTING:

@@ -37,6 +37,9 @@ import logging
 import time
 from typing import TYPE_CHECKING, Any, Callable, List, Optional
 
+import contextlib
+import contextvars
+
 from ..core.context import invalidating, is_invalidating
 from ..utils.collections import RecentlySeenMap
 from ..utils.errors import TransientError
@@ -193,9 +196,18 @@ def attach_operations(commander: "Commander") -> OperationsHost:
             c for c in (operation.command, *operation.items) if info.requires_invalidation(c)
         ]
         if to_replay:
-            with invalidating():
+            # contextvar-scoped: only the BATCH REPLAY task chain (the
+            # op-log reader inside batch_cascade_scope) defers; a local
+            # completion racing the reader on another task sees None and
+            # cascades immediately — read-your-writes holds for local
+            # callers no matter what the reader is doing
+            collector = _batch_cascade_collector.get()
+            group: Optional[List] = [] if collector is not None else None
+            with invalidating(sink=group):
                 for c in to_replay:
                     await _replay(commander, c)
+            if collector is not None:
+                collector(group)
         return await context.invoke_remaining_handlers()
 
     # ------------------------------------------------------- CompletionTerminator
@@ -219,6 +231,25 @@ def attach_operations(commander: "Commander") -> OperationsHost:
     )
     commander.registry.add_function(completion_terminator, command_type=Completion)
     return host
+
+
+_batch_cascade_collector: "contextvars.ContextVar[Optional[Callable]]" = (
+    contextvars.ContextVar("batch_cascade_collector", default=None)
+)
+
+
+@contextlib.contextmanager
+def batch_cascade_scope(collector: Callable[[List], None]):
+    """Within the CURRENT task's await chain, completion replays COLLECT
+    each operation's INVALIDATE-mode hits as one group handed to
+    ``collector`` instead of cascading host-side — the op-log reader wraps
+    a batch in this and applies all groups as one device lane burst.
+    Contextvar-scoped: concurrent tasks are unaffected."""
+    token = _batch_cascade_collector.set(collector)
+    try:
+        yield
+    finally:
+        _batch_cascade_collector.reset(token)
 
 
 def current_operation() -> Optional[Operation]:

@@ -21,7 +21,10 @@ import os
 import time
 from typing import TYPE_CHECKING, Callable, List, Optional
 
+import contextlib
+
 from ..operations.operation import Operation
+from ..operations.pipeline import batch_cascade_scope
 from ..utils.async_chain import WorkerBase
 from .log import OperationLog, OperationRecord
 
@@ -123,26 +126,51 @@ class OperationLogReader(WorkerBase):
                 await asyncio.sleep(self.poll_period)
 
     async def read_new(self) -> int:
-        """Tail from the watermark; feed EXTERNAL operations to completion."""
+        """Tail from the watermark; feed EXTERNAL operations to completion.
+
+        When the hub has a TPU graph backend, a batch of external
+        operations lane-packs: each operation's replay COLLECTS its
+        directly-invalidated computeds (``invalidating(sink=...)``) as one
+        group, and the whole batch cascades in one device lane burst
+        (``invalidate_cascade_batch_lanes``) — the production consumer of
+        the lane path: N external commands cost one mirror sweep, not N
+        host cascades. Without a backend the replay cascades host-side per
+        operation, exactly as before."""
         handled = 0
+        backend = getattr(self.operations.commander.hub, "graph_backend", None)
         while True:
             records = self.log_store.read_after(self.watermark, self.batch_size)
             if not records:
                 return handled
-            for rec in records:
-                self.watermark = max(self.watermark, rec.index)
-                if rec.agent_id == self.operations.agent.id:
-                    continue  # our own operation: already completed locally
-                self.external_seen += 1
-                operation = Operation(
-                    command=rec.command,
-                    agent_id=rec.agent_id,
-                    id=rec.id,
-                    commit_time=rec.commit_time,
-                    items=list(rec.items),
-                )
-                await self.operations.notify_completed(operation, is_local=False)
-                handled += 1
+            groups: List[List] = []
+            scope = (
+                batch_cascade_scope(groups.append)
+                if backend is not None
+                else contextlib.nullcontext()
+            )
+            try:
+                with scope:
+                    for rec in records:
+                        self.watermark = max(self.watermark, rec.index)
+                        if rec.agent_id == self.operations.agent.id:
+                            continue  # our own operation: already completed locally
+                        self.external_seen += 1
+                        operation = Operation(
+                            command=rec.command,
+                            agent_id=rec.agent_id,
+                            id=rec.id,
+                            commit_time=rec.commit_time,
+                            items=list(rec.items),
+                        )
+                        await self.operations.notify_completed(operation, is_local=False)
+                        handled += 1
+            finally:
+                # the watermark has already advanced past collected records —
+                # a cancellation mid-batch (reader.stop()) must still apply
+                # what was collected, or those operations' invalidations
+                # would be lost forever (replay never revisits them)
+                if groups and any(groups):
+                    backend.invalidate_cascade_batch_lanes(groups)
 
 
 def attach_operation_log(

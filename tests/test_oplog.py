@@ -565,3 +565,180 @@ async def test_multihost_chaos_convergence(tmp_path):
         finally:
             for r in readers.values():
                 await r.stop()
+
+
+# ------------------------------------------------------ lane-packed batch replay
+
+async def test_invalidating_sink_collects_without_cascading():
+    """invalidating(sink=...) defers: the hit node is collected, NOT
+    invalidated — the caller owns applying the group."""
+    hub = FusionHub()
+    from stl_fusion_tpu.core import invalidating, set_default_hub
+
+    old = set_default_hub(hub)
+    try:
+        DB.clear()
+        svc = ValueService(hub)
+        hub.commander.add_service(svc)
+        node = await capture(lambda: svc.get("s"))
+        sink = []
+        with invalidating(sink=sink):
+            await svc.get("s")
+        assert sink == [node]
+        assert node.is_consistent  # deferred: nothing cascaded yet
+        node.invalidate()  # the caller applies
+        assert node.is_invalidated
+    finally:
+        set_default_hub(old)
+
+
+async def test_external_batch_replays_as_one_lane_burst():
+    """The production consumer of the lane path (r3): a host with a TPU
+    graph backend replays a BATCH of external operations as ONE device lane
+    burst — direct hits collected per operation, dependents cascaded on
+    device — instead of N host cascades."""
+    from stl_fusion_tpu.core import set_default_hub
+    from stl_fusion_tpu.graph import TpuGraphBackend
+
+    DB.clear()
+    log_store = InMemoryOperationLog()
+    notifier = LocalChangeNotifier()
+    hub_a, svc_a, reader_a = make_host(log_store, notifier)
+
+    # host B carries the device mirror
+    hub_b = FusionHub()
+    old = set_default_hub(hub_b)
+    backend = TpuGraphBackend(hub_b)
+    svc_b = ValueService(hub_b)
+    hub_b.commander.add_service(svc_b)
+    reader_b = attach_operation_log(hub_b.commander, log_store, notifier, start_reader=False)
+    try:
+        # B: computed per key + a dependent aggregate (must cascade ON DEVICE)
+        keys = [f"k{i}" for i in range(8)]
+
+        class Agg(ComputeService):
+            @compute_method
+            async def total(self) -> int:
+                return sum([await svc_b.get(k) for k in keys])
+
+        agg = Agg(hub_b)
+        total_node = await capture(lambda: agg.total())
+        nodes = {k: await capture(lambda k=k: svc_b.get(k)) for k in keys}
+
+        # host A commits a BATCH of commands while B's reader is idle
+        for i, k in enumerate(keys[:5]):
+            await hub_a.commander.call(SetValue(k, 100 + i))
+
+        waves_before = backend.waves_run
+        dev_before = backend.device_invalidations
+        handled = await reader_b.read_new()
+        assert handled == 5
+        # ONE lane burst served the whole batch (5 groups = 5 lanes)
+        assert backend.waves_run == waves_before + 5
+        assert backend.device_invalidations > dev_before
+
+        # every written key's node died; the AGGREGATE cascaded on device
+        for k in keys[:5]:
+            assert nodes[k].is_invalidated or backend._pending[backend.id_for(nodes[k])]
+        assert total_node.is_invalidated  # dependent: watched → eager apply
+        assert not nodes["k7"].is_invalidated  # untouched keys live on
+        assert await agg.total() == sum(100 + i for i in range(5))
+    finally:
+        await reader_a.stop()
+        await reader_b.stop()
+        set_default_hub(old)
+
+
+async def test_concurrent_local_command_cascades_despite_reader_batch():
+    """Review r3: the batch-replay deferral is scoped to the READER's task
+    chain — a local command completing while another task sits inside
+    batch_cascade_scope still cascades immediately (read-your-writes)."""
+    from stl_fusion_tpu.core import set_default_hub
+    from stl_fusion_tpu.operations.pipeline import batch_cascade_scope
+
+    DB.clear()
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        hub.commander.attach_operations_pipeline()
+        svc = ValueService(hub)
+        hub.commander.add_service(svc)
+        node = await capture(lambda: svc.get("rw"))
+
+        entered = asyncio.Event()
+        release = asyncio.Event()
+
+        async def fake_reader():
+            groups = []
+            with batch_cascade_scope(groups.append):
+                entered.set()
+                await release.wait()  # parked mid-batch, scope ACTIVE
+
+        task = asyncio.ensure_future(fake_reader())
+        await asyncio.wait_for(entered.wait(), 5.0)
+        # local command on ANOTHER task: must invalidate NOW, not defer
+        await hub.commander.call(SetValue("rw", 9))
+        assert node.is_invalidated
+        assert await svc.get("rw") == 9  # read-your-writes
+        release.set()
+        await task
+    finally:
+        set_default_hub(old)
+
+
+async def test_reader_cancellation_mid_batch_applies_collected_groups():
+    """Review r3: a cancellation mid-batch (reader.stop()) must still apply
+    the already-collected groups — the watermark has advanced past those
+    records and replay never revisits them."""
+    from stl_fusion_tpu.core import set_default_hub
+    from stl_fusion_tpu.graph import TpuGraphBackend
+
+    DB.clear()
+    log_store = InMemoryOperationLog()
+    hub_a, svc_a, reader_a = make_host(log_store, None)
+    await reader_a.stop()  # only used to write records
+
+    hub_b = FusionHub()
+    old = set_default_hub(hub_b)
+    backend = TpuGraphBackend(hub_b)
+    svc_b = ValueService(hub_b)
+    hub_b.commander.add_service(svc_b)
+    reader_b = attach_operation_log(hub_b.commander, log_store, None, start_reader=False)
+    try:
+        nodes = {k: await capture(lambda k=k: svc_b.get(k)) for k in ("c1", "c2", "c3")}
+        for k in ("c1", "c2", "c3"):
+            await hub_a.commander.call(SetValue(k, 5))
+
+        # block the batch after the SECOND record via a completion listener
+        blocked = asyncio.Event()
+        release = asyncio.Event()
+        seen = [0]
+
+        async def blocker(operation, is_local):
+            if not is_local:
+                seen[0] += 1
+                if seen[0] == 2:
+                    blocked.set()
+                    await release.wait()
+
+        hub_b.commander.operations.completion_listeners.append(blocker)
+        task = asyncio.ensure_future(reader_b.read_new())
+        await asyncio.wait_for(blocked.wait(), 5.0)
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+        # records 1..2 were collected before the cancel — their
+        # invalidations must have been applied by the finally-flush
+        # (record 2's replay completed before the blocker parked)
+        for k in ("c1", "c2"):
+            assert (
+                nodes[k].is_invalidated
+                or backend._pending[backend.id_for(nodes[k])]
+            ), k
+        assert await svc_b.get("c1") == 5
+    finally:
+        await reader_b.stop()
+        set_default_hub(old)
