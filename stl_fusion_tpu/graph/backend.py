@@ -76,6 +76,7 @@ class TpuGraphBackend:
         # (a global flag here would silently desync the device mask)
         self._applying_ids: set = set()
         self._sharded_mirror: Optional[dict] = None  # see sharded_mirror
+        self._packed_mirror: Optional[dict] = None  # see packed_mirror
         self.waves_run = 0
         self.device_invalidations = 0
         hub.registry.on_register.append(self._on_register)
@@ -420,6 +421,94 @@ class TpuGraphBackend:
         self.waves_run += 1
         self.device_invalidations += count
         return count + fallback
+
+    def packed_mirror(self, mesh=None) -> dict:
+        """Fingerprint-cached packed mesh mirror of the LIVE edge set — the
+        multi-chip lane-burst bridge (PackedShardedGraph over the currently
+        live, epoch-matched edges + a device-resident blocked mask mirroring
+        the invalid state). Rebuilt when the live-edge fingerprint changes;
+        the blocked mask re-syncs from the dense state only after host-led
+        invalid-state changes (same invalid_version protocol as the union
+        bridge)."""
+        import jax
+
+        from ..parallel.packed_wave import PackedShardedGraph
+        from .device_graph import check_structure_cache
+
+        self.flush()
+        dg = self.graph
+        sv = dg._struct_version
+        cached = self._packed_mirror
+        if cached is not None:
+            cached_ref = cached["mesh_ref"]
+            same_mesh = (
+                cached_ref is None if mesh is None
+                else cached_ref is not None and cached_ref() is mesh
+            )
+            if same_mesh and check_structure_cache(
+                cached, sv, lambda: dg._live_edge_fingerprint()[2]
+            ):
+                return cached
+        src, dst, fp = dg._live_edge_fingerprint()
+        pg = PackedShardedGraph(src, dst, dg.n_nodes, mesh=mesh)
+        self._packed_mirror = {
+            "fp": fp,
+            "validated_at": sv,
+            "mesh_ref": weakref.ref(mesh) if mesh is not None else None,
+            "graph": pg,
+            "blocked": pg.put_blocked(),
+            # absent invalid_version ⇒ next burst full-syncs from dense
+        }
+        return self._packed_mirror
+
+    def invalidate_cascade_batch_lanes_sharded(
+        self, groups: Sequence[Sequence["Computed"]], mesh=None
+    ) -> np.ndarray:
+        """Lane-packed live burst ON THE MESH: each command group cascades
+        independently in its own bit lane over the device mesh (packed
+        frontier words ride one all-gather per level —
+        parallel/packed_wave.py), gated by the live graph's invalid state,
+        with the union applied back to the hub exactly like the
+        single-chip lane path. The blocked mask stays device-resident
+        between bursts (invalid_version protocol, exception-safe: the
+        entry reads out-of-sync until the dense apply completes).
+        Returns per-group newly counts (missing computeds fall back to
+        immediate host invalidation, counting 1)."""
+        import jax
+
+        entry = self.packed_mirror(mesh=mesh)
+        pg = entry["graph"]
+        seed_lists: List[List[int]] = []
+        fallback = np.zeros(len(groups), dtype=np.int64)
+        for gi, group in enumerate(groups):
+            ids: List[int] = []
+            for c in group:
+                nid = self._id_by_input.get(c.input)
+                if nid is None:
+                    c.invalidate(immediately=True)
+                    fallback[gi] += 1
+                else:
+                    ids.append(nid)
+            seed_lists.append(ids)
+        dg = self.graph
+        if entry.get("invalid_version") != dg.invalid_version:
+            mask = dg.invalid_mask()
+            dg._h_invalid[: dg.n_nodes] = mask
+            entry["blocked"] = pg.put_blocked(mask)
+        entry.pop("invalid_version", None)  # out-of-sync until apply completes
+        counts, union_ids, blocked2, overflow = pg.run_gated_lanes(
+            seed_lists, entry["blocked"]
+        )
+        entry["blocked"] = blocked2
+        if overflow:
+            newly = np.asarray(blocked2)[: dg.n_nodes] & ~dg._h_invalid[: dg.n_nodes]
+            union_ids = np.nonzero(newly)[0].astype(np.int32)
+        dg.mark_invalid(union_ids)
+        entry["invalid_version"] = dg.invalid_version
+        self._apply_newly(union_ids)
+        self.waves_run += len(groups)
+        self.device_invalidations += int(counts.sum())
+        return counts + fallback
 
     def computed_for(self, node_id: int):
         """The live Computed for a backend node id (None if collected)."""

@@ -437,6 +437,7 @@ class DeviceGraph:
         """
         import jax
 
+        from ..ops.pull_wave import pack_lane_matrix
         from ..ops.topo_wave import topo_mirror_burst_lanes_step
 
         jnp = self._jnp
@@ -448,16 +449,10 @@ class DeviceGraph:
         chunk_size = 32 * max_words
         for c0 in range(0, B, chunk_size):
             chunk = seed_id_lists[c0 : c0 + chunk_size]
-            words = _round_up_pow2((len(chunk) + 31) // 32)
-            width = _round_up_pow2(max((len(s) for s in chunk), default=1))
-            mat = np.full((32 * words, width), n_tot, dtype=np.int32)
-            for i, s in enumerate(chunk):
-                ids = np.unique(np.asarray(s, dtype=np.int64))  # lane bits scatter-ADD
-                if len(ids) and (ids[0] < 0 or ids[-1] >= m["n_nodes"]):
-                    raise ValueError(
-                        f"group {c0 + i}: seed ids must be in [0, {m['n_nodes']})"
-                    )
-                mat[i, : len(ids)] = m["inv_perm"][ids].astype(np.int32)
+            mat, words = pack_lane_matrix(
+                chunk, pad_id=n_tot, n_valid=m["n_nodes"],
+                id_map=m["inv_perm"], base_index=c0,
+            )
             g = self.device_arrays()
             step = topo_mirror_burst_lanes_step(m["level_starts"], m["cap"], n_tot, words)
             g_invalid2, lane_counts, union_count, ids, overflow = step(

@@ -38,6 +38,7 @@ import numpy as np
 from .ell_wave import EllGraph, build_ell
 
 __all__ = [
+    "pack_lane_matrix",
     "PullGraphArrays",
     "PullState",
     "build_pull_graph",
@@ -70,6 +71,33 @@ def pack_seed_words(
             ids = id_map[ids]
         bits[ids, w] |= np.int32(1 << lane) if lane < 31 else np.int32(-(1 << 31))
     return bits[:, 0] if words == 1 else bits
+
+
+def pack_lane_matrix(groups, pad_id: int, n_valid: int, id_map=None, base_index: int = 0):
+    """Per-group seed ids → (int32[32*words, width] lane matrix, words):
+    row i holds group i's UNIQUE ids (uniqueness matters — lane bits are
+    scatter-ADDed on device), padded with ``pad_id``; words and width round
+    up to powers of two so varying burst shapes reuse compiled programs.
+    ``id_map`` optionally remaps ids (e.g. topo original→level-order); ids
+    must lie in [0, n_valid). THE shared packer behind both lane-burst
+    faces (DeviceGraph.run_waves_lanes, PackedShardedGraph.run_gated_lanes)."""
+    words = 1
+    while words < (len(groups) + 31) // 32:
+        words <<= 1
+    width = 1
+    while width < max((len(s) for s in groups), default=1):
+        width <<= 1
+    mat = np.full((32 * words, width), pad_id, dtype=np.int32)
+    for i, s in enumerate(groups):
+        ids = np.unique(np.asarray(s, dtype=np.int64))
+        if len(ids) and (ids[0] < 0 or ids[-1] >= n_valid):
+            raise ValueError(
+                f"group {base_index + i}: seed ids must be in [0, {n_valid})"
+            )
+        if id_map is not None:
+            ids = id_map[ids]
+        mat[i, : len(ids)] = ids.astype(np.int32)
+    return mat, words
 
 
 def seeds_to_bits(n_tot: int, seed_ids_per_wave) -> np.ndarray:

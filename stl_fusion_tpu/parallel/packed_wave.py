@@ -95,6 +95,75 @@ def build_packed_sharded_wave(mesh: Mesh):
     return wave
 
 
+def _build_gated_lane_burst(mesh, cap: int, n_global: int, n_nodes: int, words: int):
+    """Jitted LIVE lane burst on the mesh (the multi-chip analogue of
+    ops/topo_wave.py::topo_mirror_burst_lanes_step): ``32*words``
+    independent command groups cascade over the mesh in one pass, gated by
+    a RESIDENT blocked mask (the live graph's invalid state) — blocked
+    rows neither fire, count, nor conduct, expressed through the kernel's
+    own epoch machinery (epoch -3 never matches a live edge's 0).
+
+    Cached PER PackedShardedGraph instance (not a module lru_cache): the
+    program's lifetime then matches the graph that owns the mesh, instead
+    of pinning discarded meshes process-wide.
+    Returns ``burst(seed_ids, in_src, edge_epoch, node_epoch0, is_real,
+    blocked) -> (blocked2, lane_counts int32[32*words], union_count,
+    compacted union ids, overflow)`` with the union folded back into the
+    blocked mask (device-resident between bursts)."""
+    wave = build_packed_sharded_wave(mesh)
+    W = words
+    L = 32 * W
+    node_sh = NamedSharding(mesh, P(GRAPH_AXIS))
+    word_sh = NamedSharding(mesh, P(GRAPH_AXIS, None))
+
+    @jax.jit
+    def burst(seed_ids, in_src, edge_epoch, node_epoch0, is_real, blocked):
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        word_of = lanes // 32
+        bit_of = jnp.left_shift(jnp.int32(1), lanes % 32)
+        flat = seed_ids * W + word_of[:, None]  # pad id = n_global → dropped
+        vals = jnp.broadcast_to(bit_of[:, None], seed_ids.shape)
+        seeds = (
+            jnp.zeros(n_global * W, jnp.int32)
+            .at[flat.ravel()]
+            .add(vals.ravel(), mode="drop")
+            .reshape(n_global, W)
+        )
+        seeds = lax.with_sharding_constraint(
+            jnp.where(blocked[:, None], 0, seeds), word_sh
+        )
+        node_epoch = lax.with_sharding_constraint(
+            jnp.where(blocked, -3, node_epoch0), node_sh
+        )
+        inv, _word_counts = wave(
+            seeds, in_src, edge_epoch, node_epoch, is_real,
+            lax.with_sharding_constraint(jnp.zeros_like(seeds), word_sh),
+        )
+        newly = jnp.where(is_real[:, None], inv, 0)
+        lane_counts = jnp.stack(
+            [
+                ((newly[:, w] >> b) & 1).sum(dtype=jnp.int32)
+                for w in range(W)
+                for b in range(32)
+            ]
+        )
+        union = (newly != 0).any(axis=1) & (
+            jnp.arange(n_global, dtype=jnp.int32) < n_nodes
+        )
+        union_count = union.sum(dtype=jnp.int32)
+        pos = jnp.cumsum(union.astype(jnp.int32)) - 1
+        scatter_pos = jnp.where(union & (pos < cap), pos, cap)
+        ids = (
+            jnp.full(cap, -1, dtype=jnp.int32)
+            .at[scatter_pos]
+            .set(jnp.arange(n_global, dtype=jnp.int32), mode="drop")
+        )
+        blocked2 = lax.with_sharding_constraint(blocked | union, node_sh)
+        return blocked2, lane_counts, union_count, ids, union_count > cap
+
+    return burst
+
+
 class PackedShardedGraph:
     """Static mesh-sharded graph running ``32*words`` packed waves per pass."""
 
@@ -156,6 +225,7 @@ class PackedShardedGraph:
         self.invalid = self._zero_words
         self._wave = build_packed_sharded_wave(self.mesh)
         self._chain = None  # compiled lazily per batch shape
+        self._gated_lanes: dict = {}  # (cap, words) → jitted gated burst
 
     # ------------------------------------------------------------------ waves
     def seeds_to_bits(self, seed_ids_per_wave: Sequence[Sequence[int]]) -> np.ndarray:
@@ -214,6 +284,70 @@ class PackedShardedGraph:
         )
         counts = np.asarray(counts, dtype=np.int64)
         return int(counts.sum()), counts.sum(axis=1)
+
+    def run_gated_lanes(
+        self,
+        seed_id_lists: Sequence[Sequence[int]],
+        blocked,
+        cap: int = 65536,
+        max_words: int = 16,
+    ):
+        """INDEPENDENT per-group cascades over the mesh, gated by a
+        device-resident ``blocked`` mask (bool[n_global] — the live graph's
+        invalid state): the multi-chip face of
+        ``DeviceGraph.run_waves_lanes``. Chunks of ≤``32*max_words`` groups
+        per dispatch (later chunks see earlier chunks' union as blocked).
+        Returns (per-group counts int64[B], union newly ids or None on
+        overflow, updated blocked mask, overflow flag)."""
+        from ..ops.pull_wave import pack_lane_matrix
+
+        B = len(seed_id_lists)
+        counts = np.zeros(B, dtype=np.int64)
+        union_parts: list = []
+        any_overflow = False
+        chunk_size = 32 * max_words
+        for c0 in range(0, B, chunk_size):
+            chunk = seed_id_lists[c0 : c0 + chunk_size]
+            mat, words = pack_lane_matrix(
+                chunk, pad_id=self.n_global, n_valid=self.n_nodes, base_index=c0
+            )
+            burst = self._gated_lanes.get((cap, words))
+            if burst is None:
+                burst = _build_gated_lane_burst(
+                    self.mesh, cap, self.n_global, self.n_nodes, words
+                )
+                self._gated_lanes[(cap, words)] = burst
+            blocked, lane_counts, count, ids, overflow = burst(
+                jnp.asarray(mat), self.in_src, self.edge_epoch, self.node_epoch,
+                self.is_real, blocked,
+            )
+            lane_counts, count, ids, overflow = jax.device_get(
+                (lane_counts, count, ids, overflow)
+            )
+            counts[c0 : c0 + len(chunk)] = lane_counts[: len(chunk)].astype(np.int64)
+            if overflow:
+                any_overflow = True
+            else:
+                union_parts.append(ids[: int(count)])
+        union_ids = (
+            None
+            if any_overflow
+            else (
+                np.concatenate(union_parts)
+                if union_parts
+                else np.empty(0, np.int32)
+            )
+        )
+        return counts, union_ids, blocked, any_overflow
+
+    def put_blocked(self, mask: Optional[np.ndarray] = None):
+        """The gated-lane blocked mask in ITS layout (bool[n_global],
+        GRAPH_AXIS-sharded) from a host mask over [0, n_nodes) — one place
+        owns the layout contract (mirror sync + initial state)."""
+        padded = np.zeros(self.n_global, dtype=bool)
+        if mask is not None:
+            padded[: len(mask)] = np.asarray(mask[: self.n_global], dtype=bool)
+        return jax.device_put(padded, NamedSharding(self.mesh, P(GRAPH_AXIS)))
 
     def clear_invalid(self) -> None:
         # a cached device-zero array: no per-clear H2D transfer

@@ -466,3 +466,122 @@ async def test_sharded_bridge_chaos_interleaving(chaos_seed):
         assert injected[0] > 0, "chaos run never exercised the failure injection"
     finally:
         set_default_hub(old)
+
+
+# ------------------------------------------------------------ mesh lane bursts
+
+async def test_mesh_lane_burst_matches_single_chip_lanes():
+    """invalidate_cascade_batch_lanes_sharded ≡ the single-chip lane path:
+    same per-group counts and same applied state, from the same pre-state,
+    including pre-existing invalidations and a recompute in between."""
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        capture,
+        compute_method,
+        set_default_hub,
+    )
+    from stl_fusion_tpu.graph import TpuGraphBackend
+
+    async def build():
+        hub = FusionHub()
+        old = set_default_hub(hub)
+        backend = TpuGraphBackend(hub)
+
+        class Chain(ComputeService):
+            def __init__(self, hub=None):
+                super().__init__(hub)
+                self.data = {i: i for i in range(16)}
+
+            @compute_method
+            async def base(self, i: int) -> int:
+                return self.data[i]
+
+            @compute_method
+            async def mid(self, i: int) -> int:
+                return await self.base(i) + await self.base((i + 1) % 16)
+
+            @compute_method
+            async def top(self, i: int) -> int:
+                return await self.mid(i) + 1
+
+        svc = Chain(hub)
+        for i in range(16):
+            await svc.top(i)
+        bases = [await capture(lambda i=i: svc.base(i)) for i in range(16)]
+        # a pre-existing invalidation the lanes must treat as blocked
+        bases[3].invalidate()
+        return hub, old, backend, svc, bases
+
+    hub_m, old, backend_m, svc_m, bases_m = await build()
+    try:
+        groups = [[bases_m[0]], [bases_m[3], bases_m[5]], [], [bases_m[0], bases_m[7]]]
+        counts_m = backend_m.invalidate_cascade_batch_lanes_sharded(groups)
+        state_m = backend_m.graph._h_invalid[: backend_m.graph.n_nodes].copy()
+    finally:
+        set_default_hub(old)
+
+    hub_s, old, backend_s, svc_s, bases_s = await build()
+    try:
+        groups = [[bases_s[0]], [bases_s[3], bases_s[5]], [], [bases_s[0], bases_s[7]]]
+        counts_s = backend_s.invalidate_cascade_batch_lanes(groups)
+        state_s = backend_s.graph._h_invalid[: backend_s.graph.n_nodes].copy()
+    finally:
+        set_default_hub(old)
+
+    np.testing.assert_array_equal(counts_m, counts_s)
+    np.testing.assert_array_equal(state_m, state_s)
+
+
+async def test_mesh_lane_burst_resident_blocked_state():
+    """Consecutive mesh lane bursts ride the resident blocked mask (no full
+    sync), a host-led change forces exactly one re-sync, and idempotence
+    holds across the resident state."""
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        capture,
+        compute_method,
+        set_default_hub,
+    )
+    from stl_fusion_tpu.graph import TpuGraphBackend
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        backend = TpuGraphBackend(hub)
+
+        class Chain(ComputeService):
+            @compute_method
+            async def base(self, i: int) -> int:
+                return i
+
+            @compute_method
+            async def top(self, i: int) -> int:
+                return await self.base(i) + 1
+
+        svc = Chain(hub=hub)
+        tops = [await capture(lambda i=i: svc.top(i)) for i in range(8)]
+        bases = [await capture(lambda i=i: svc.base(i)) for i in range(8)]
+
+        assert backend.invalidate_cascade_batch_lanes_sharded([[bases[0]]]).tolist() == [2]
+        entry = backend._packed_mirror
+        assert "invalid_version" in entry
+        v = entry["invalid_version"]
+        # second burst: resident state, no rebuild, version advances in step
+        assert backend.invalidate_cascade_batch_lanes_sharded([[bases[1]]]).tolist() == [2]
+        assert backend._packed_mirror is entry
+        assert entry["invalid_version"] != v
+        # idempotence: blocked seeds produce empty lanes
+        assert backend.invalidate_cascade_batch_lanes_sharded([[bases[0]]]).tolist() == [0]
+        assert tops[0].is_invalidated or backend._pending[backend.id_for(tops[0])]
+
+        # host-led mark → resync; burst on ANOTHER seed still exact
+        backend.graph.mark_invalid(
+            np.asarray([backend.id_for(bases[2])], dtype=np.int32)
+        )
+        assert backend.invalidate_cascade_batch_lanes_sharded([[bases[3]]]).tolist() == [2]
+        # the host-led mark is honored as blocked
+        assert backend.invalidate_cascade_batch_lanes_sharded([[bases[2]]]).tolist() == [0]
+    finally:
+        set_default_hub(old)
