@@ -311,6 +311,13 @@ class HubCheckpoint:
                 result.skipped += 1
             else:
                 result.computeds.append(c)
+        # tables restore BEFORE the edge-version invalidation loop: the
+        # restored nodes carry the scalar→table mark_row_stale hook (they are
+        # created through ComputeMethodFunction.create_computed), so any
+        # provably-stale invalidation below must find the warm rows already
+        # materialized and mark them stale — not land on a cold table and
+        # then get overwritten by a later warm import
+        result.tables = HubCheckpoint._restore_tables(hub, services, snap)
         for di, ui, used_version in snap.get("edges", ()):
             dep, used = restored[di], restored[ui]
             if dep is None or used is None:
@@ -322,7 +329,6 @@ class HubCheckpoint:
                 # dependent's warm value was produced against a version that
                 # no longer exists — it is provably stale
                 dep.invalidate(immediately=True)
-        result.tables = HubCheckpoint._restore_tables(hub, services, snap)
         return result
 
     @staticmethod
@@ -338,11 +344,18 @@ class HubCheckpoint:
                         entry["s"], entry["m"])
             return None
         args = tuple(decode(entry["a"]))
-        input = ComputeMethodInput(method_def, service, args)
+        function = method_def.get_function(service)
+        input = ComputeMethodInput(method_def, service, args, function)
         existing = hub.registry.get(input)
         if existing is not None and existing.is_consistent:
             return existing  # live state wins over the snapshot
-        computed = Computed(input, LTag(entry["v"]), method_def.options)
+        # route through the function's create_computed — NOT a bare
+        # Computed() — so restored nodes carry the same lifecycle hooks a
+        # freshly computed node gets (in particular the table-backed
+        # scalar→table mark_row_stale hook; a bare node would let post-
+        # restore invalidations recompute the scalar while read_batch/
+        # read_keys kept serving the stale warm row forever)
+        computed = function.create_computed(input, LTag(entry["v"]))
         computed.try_set_output(Result.ok(decode(entry["o"])))
         hub.registry.register(computed)
         computed.renew_timeouts(True)  # arm keep-alive so warm entries survive

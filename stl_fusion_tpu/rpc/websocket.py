@@ -86,6 +86,7 @@ class _WsAdapter:
         def __init__(self, ws):
             self._ws = ws
             self._pending: "collections.deque" = collections.deque()
+            self._inflight: list = []  # current batch's futures, popped from _pending
             self._wake = asyncio.Event()
             self._space = asyncio.Event()
             self._space.set()
@@ -110,7 +111,8 @@ class _WsAdapter:
                     await self._wake.wait()
                     self._wake.clear()
                     while self._pending:
-                        parts, futs, size = [], [], 0
+                        parts, size = [], 0
+                        futs = self._inflight
                         while self._pending and (not parts or size < _WsAdapter.PACK_BYTES):
                             data, fut = self._pending.popleft()
                             parts.append(struct.pack("<I", len(data)))
@@ -126,8 +128,12 @@ class _WsAdapter:
                         for fut in futs:
                             if not fut.done():
                                 fut.set_result(None)
+                        futs.clear()
             except asyncio.CancelledError:
-                self._fail(ConnectionError("transport closed"), [])
+                # cancellation mid-send (adapter.close()): the current batch
+                # was already popped from _pending — fail those futures too,
+                # or every send() awaiting this batch hangs forever
+                self._fail(ConnectionError("transport closed"), self._inflight)
                 raise
 
         def _fail(self, error: BaseException, futs: list) -> None:

@@ -412,3 +412,56 @@ async def test_table_restore_refuses_diverged_key_layout(tmp_path):
         np.testing.assert_allclose(vals, [1.0, 2.0, 3.0])
     finally:
         set_default_hub(old)
+
+
+async def test_restored_scalar_node_marks_table_row_stale(tmp_path):
+    """Advisor r3 (high): a RESTORED table-backed scalar node must carry the
+    same mark_row_stale hook a freshly computed node gets — invalidating it
+    after restore (op-log replay, dependency cascade) must reach the warm
+    MemoTable row, or read_batch serves the stale value indefinitely."""
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        TableBacking,
+        compute_method,
+        memo_table_of,
+        set_default_hub,
+    )
+
+    class Users(ComputeService):
+        def __init__(self, hub=None):
+            super().__init__(hub)
+            self.db = {i: float(i) for i in range(8)}
+
+        def load(self, ids):
+            return np.array([self.db[int(i)] for i in ids], dtype=np.float32)
+
+        @compute_method(table=TableBacking(rows=8, batch="load"))
+        async def balance(self, uid: int) -> float:
+            return self.db[uid]
+
+    hub_a = FusionHub()
+    old = set_default_hub(hub_a)
+    try:
+        a = Users(hub_a)
+        hub_a.add_service(a, "users")
+        assert await a.balance(2) == 2.0          # scalar node in the snapshot
+        memo_table_of(a.balance).read_batch(np.arange(8))  # warm table
+        path = str(tmp_path / "snap.bin")
+        HubCheckpoint.save(hub_a, path)
+
+        hub_b = FusionHub()
+        set_default_hub(hub_b)
+        b = Users(hub_b)
+        hub_b.add_service(b, "users")
+        result = HubCheckpoint.restore(hub_b, path)
+        assert result.tables == 1 and result.count >= 1
+
+        b.db[2] = 222.0
+        with invalidating():
+            await b.balance(2)                    # invalidates the RESTORED node
+        assert await b.balance(2) == 222.0        # scalar recomputes
+        # the warm row must have been marked stale by the restored node's hook
+        assert float(np.asarray(memo_table_of(b.balance).read_batch([2]))[0]) == 222.0
+    finally:
+        set_default_hub(old)

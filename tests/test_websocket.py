@@ -249,6 +249,37 @@ async def test_ws_writer_bounded_backpressure_and_failure():
     writer2._task.cancel()
 
 
+async def test_ws_writer_cancel_mid_send_fails_inflight_batch():
+    """Advisor r3 (medium): cancelling the flusher (adapter.close()) while a
+    packed frame is in flight must FAIL that batch's futures — they were
+    already popped from _pending, and leaving them unresolved hangs every
+    coroutine awaiting writer.send() for the batch forever."""
+    from stl_fusion_tpu.rpc.message import RpcMessage
+    from stl_fusion_tpu.rpc.websocket import _WsAdapter
+
+    in_send = asyncio.Event()
+
+    class StuckWs:
+        async def send(self, data):
+            in_send.set()
+            await asyncio.Event().wait()  # never completes
+
+    writer = _WsAdapter._Writer(StuckWs())
+    tasks = [
+        asyncio.ensure_future(writer.send(RpcMessage(0, i, "s", "m", b"x")))
+        for i in range(4)
+    ]
+    await asyncio.wait_for(in_send.wait(), 5.0)  # batch popped, send in flight
+    writer._task.cancel()
+    results = await asyncio.wait_for(
+        asyncio.gather(*tasks, return_exceptions=True), 5.0
+    )
+    assert all(isinstance(r, ConnectionError) for r in results)
+    # and later senders fail fast instead of queueing into a dead writer
+    with pytest.raises(ConnectionError):
+        await writer.send(RpcMessage(0, 9, "s", "m", b"x"))
+
+
 async def test_ws_invalidation_flood_bounded_and_delivered():
     """A $sys-c-style flood (3×1000 pushes) against a slowly-draining peer:
     memory stays bounded (pending ≤ MAX_PENDING throughout) and every
