@@ -1,5 +1,5 @@
 """Host↔device graph backend: DeviceGraph container + live hub mirror."""
-from .backend import TpuGraphBackend
+from .backend import RowBlock, TpuGraphBackend
 from .device_graph import DeviceGraph
 
-__all__ = ["TpuGraphBackend", "DeviceGraph"]
+__all__ = ["TpuGraphBackend", "RowBlock", "DeviceGraph"]

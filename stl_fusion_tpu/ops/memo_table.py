@@ -68,6 +68,10 @@ class MemoTable:
         self._valid_dev = jnp.zeros(self.n_rows, dtype=jnp.bool_)
         self._packed_cache: Optional[tuple] = None  # (version, packed bits)
         self.on_invalidate: List[Callable[[np.ndarray], None]] = []
+        #: fired with the refreshed ids after a vectorized recompute — the
+        #: columnar analogue of a recompute's consistency restoration (the
+        #: graph backend subscribes to clear device invalid bits in bulk)
+        self.on_refresh: List[Callable[[np.ndarray], None]] = []
         #: optional key codec (set by TableBacking wiring): arbitrary
         #: hashable keys ⇄ dense rows — see read_keys/invalidate_keys
         self.key_codec = None
@@ -187,21 +191,39 @@ class MemoTable:
         self._stale_count -= int(np.count_nonzero(self._stale_host[ids_np]))
         self._stale_host[ids_np] = False
         self._bump()
+        for handler in self.on_refresh:
+            handler(ids_np)
 
     def invalidate(self, ids: Ids) -> None:
         """Mark rows stale; notifies subscribers (the cascade entry point).
         Ids are deduped: on_invalidate handlers see each row once."""
+        ids_np = self._mark_stale(ids)
+        if ids_np is not None:
+            for handler in self.on_invalidate:
+                handler(ids_np)
+
+    def _mark_stale_from_wave(self, ids: Ids) -> None:
+        """Device-wave application path (graph backend): mark rows stale
+        WITHOUT firing ``on_invalidate`` — the wave already owns the cascade
+        and the scalar-twin application (two-tier, graph/backend.py), so the
+        table→scalar hook firing here would re-walk the whole wave in
+        per-row Python. ``changed`` still advances: reactive consumers see
+        the version bump either way."""
+        self._mark_stale(ids)
+
+    def _mark_stale(self, ids: Ids) -> Optional[np.ndarray]:
+        """Shared staleness bookkeeping; returns the deduped ids (None when
+        empty) so :meth:`invalidate` can notify with exactly what changed."""
         ids_np = np.unique(np.asarray(ids, dtype=np.int32))
         if ids_np.size == 0:
-            return
+            return None
         self._stale_count += int(np.count_nonzero(~self._stale_host[ids_np]))
         self._stale_host[ids_np] = True
         self._valid_dev = self._jit_cache["set_mask"](
             self._valid_dev, self._jnp.asarray(ids_np), False
         )
         self._bump()
-        for handler in self.on_invalidate:
-            handler(ids_np)
+        return ids_np
 
     def invalidate_all(self) -> None:
         self._stale_host[:] = True

@@ -47,8 +47,11 @@ __all__ = [
     "topo_graph_arrays",
     "topo_init_state",
     "build_topo_wave32",
-    "topo_mirror_burst_step",
-    "topo_mirror_burst_lanes_step",
+    "topo_mirror_gate_step",
+    "topo_mirror_finish_step",
+    "topo_mirror_gate_lanes_step",
+    "topo_mirror_finish_lanes_step",
+    "run_topo_sweep_passes",
     "topo_seeds_to_bits",
 ]
 
@@ -174,7 +177,10 @@ def topo_seeds_to_bits(graph: TopoGraph, seed_ids_per_wave, words: int = 1) -> n
     return bits
 
 
-def _topo_sweep_impl(level_starts, garrays: TopoGraphArrays, seed_bits, state: TopoState):
+def _topo_sweep_impl(
+    level_starts, garrays: TopoGraphArrays, seed_bits, state: TopoState,
+    start_level: int = 1,
+):
     import jax.numpy as jnp
     from jax import lax
 
@@ -200,8 +206,12 @@ def _topo_sweep_impl(level_starts, garrays: TopoGraphArrays, seed_bits, state: T
     invalid_before = invalid
     invalid = (invalid | seed_bits).at[n_tot].set(0)
 
-    # one pass, levels ascending: every gather reads only finalized rows
-    for l in range(1, len(level_starts) - 1):
+    # one pass, levels ascending: every gather reads only finalized rows.
+    # start_level=1 skips level 0 (no in-edges at build time by definition);
+    # multi-pass sweeps over PATCHED mirrors start at 0 — a patched edge
+    # into a level-0 row (any edge into level 0 is a level violation) fires
+    # from the previous pass's finalized state
+    for l in range(start_level, len(level_starts) - 1):
         a, b = level_starts[l], level_starts[l + 1]
         if a == b:
             continue
@@ -232,33 +242,27 @@ def _topo_sweep_impl(level_starts, garrays: TopoGraphArrays, seed_bits, state: T
 
 
 @functools.lru_cache(maxsize=8)
-def topo_mirror_burst_step(level_starts: Tuple[int, ...], cap: int, n_tot: int):
-    """Jitted LIVE-burst program over a topo mirror (graph/device_graph.py
-    ``build_topo_mirror``): project the dense live invalid state into topo
-    order (device gather — no host upload), run ONE gated fire sweep from
-    the burst's seeds (dense-BFS semantics: pre-existing invalid nodes
-    neither re-fire nor count), compact the newly-invalidated ORIGINAL ids
-    to ``cap``, and scatter them back into the dense invalid array — all in
-    one dispatch with an O(cap) readback. ``perm_clipped[j]`` is the
-    original id of topo row ``j`` (clipped into the dense array for virtual
-    rows, which ``is_real`` masks out)."""
+def topo_mirror_gate_step(n_tot: int):
+    """Jitted burst PROLOGUE over a topo mirror: project the dense live
+    invalid state into topo order (device gather — no host upload) and gate
+    the seeds with dense-BFS semantics — an already-invalid node neither
+    re-fires, counts, nor conducts (ops/wave.py::wave_step rule; a plain
+    closure sweep over ``invalid | seeds`` would also propagate PRE-EXISTING
+    invalidity, diverging from the dense path). The gate is expressed
+    THROUGH the sweep's own epoch machinery so _topo_sweep_impl is reused
+    verbatim: a blocked row gets epoch -3, so none of its in-edges (captured
+    at epoch 0) version-match — it can never fire; its bit starts 0 and is
+    never seeded, so nothing propagates THROUGH it either.
+
+    Split from the sweep and the epilogue (:func:`topo_mirror_finish_step`)
+    so the PASS COUNT of a patched mirror is a host loop over the jitted
+    sweep — violations accumulating on a patched mirror never recompile
+    anything (r4; the monolithic burst program re-traced per pass count)."""
     import jax
     import jax.numpy as jnp
 
     @jax.jit
-    def burst(garrays: TopoGraphArrays, node_epoch0, perm_clipped, g_invalid, seed_new_ids):
-        is_real = garrays.is_real
-        # FIRE-lane sweep gated by the pre-existing invalid state — the
-        # exact dense-BFS rule (ops/wave.py::wave_step): an already-invalid
-        # node neither re-fires its dependents nor counts as newly. A plain
-        # closure sweep over (invalid | seeds) would also propagate
-        # PRE-EXISTING invalidity (e.g. a host-led mark_invalid whose
-        # cascade the host already applied), diverging from the dense path.
-        # The gate is expressed THROUGH the sweep's own epoch machinery so
-        # _topo_sweep_impl is reused verbatim: a blocked (already-invalid)
-        # row gets epoch -3, so none of its in-edges (captured at epoch 0)
-        # version-match — it can never fire; and its bit starts 0 and is
-        # never seeded, so nothing propagates THROUGH it either.
+    def gate(is_real, node_epoch0, perm_clipped, g_invalid, seed_new_ids):
         blocked = (
             jnp.where(is_real, g_invalid[perm_clipped], False)
             .astype(jnp.int32)
@@ -266,17 +270,31 @@ def topo_mirror_burst_step(level_starts: Tuple[int, ...], cap: int, n_tot: int):
             .set(0)
         )
         node_epoch = jnp.where(blocked.astype(bool), -3, node_epoch0)
+        # union seeds CONDUCT even when already invalid (see ops/wave.py
+        # run_waves_union: an uncascaded columnar mark's declared dependents
+        # exist only on device); blocked rows still can't RECEIVE (epoch -3)
+        # and pre-invalid seeds are excluded from newly by the finish step
         seed_bits = (
             jnp.zeros(n_tot + 1, jnp.int32).at[seed_new_ids].set(1).at[n_tot].set(0)
-            & ~blocked
         )
-        state2, count = _topo_sweep_impl(
-            level_starts,
-            garrays,
-            seed_bits,
-            TopoState(node_epoch, jnp.zeros(n_tot + 1, dtype=jnp.int32)),
-        )
-        newly = state2.invalid_bits.astype(bool) & is_real
+        return node_epoch, seed_bits
+
+    return gate
+
+
+@functools.lru_cache(maxsize=8)
+def topo_mirror_finish_step(cap: int, n_tot: int):
+    """Jitted burst EPILOGUE: count the newly-invalidated real rows from the
+    final sweep bits, compact their ORIGINAL ids to ``cap`` (O(cap)
+    readback), and scatter them back into the dense invalid array."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def finish(is_real, perm_clipped, g_invalid, final_bits):
+        # ~pre-invalid: a conducting already-invalid seed is not NEWLY
+        newly = final_bits.astype(bool) & is_real & ~g_invalid[perm_clipped]
+        count = newly.sum(dtype=jnp.int32)
         pos = jnp.cumsum(newly.astype(jnp.int32)) - 1
         scatter_pos = jnp.where(newly & (pos < cap), pos, cap)  # OOB → dropped
         ids = (
@@ -284,38 +302,46 @@ def topo_mirror_burst_step(level_starts: Tuple[int, ...], cap: int, n_tot: int):
             .at[scatter_pos]
             .set(perm_clipped, mode="drop")
         )
-        # dense-state writeback: newly bits land on their ORIGINAL slots
         oob = g_invalid.shape[0]
         g_invalid2 = g_invalid.at[jnp.where(newly, perm_clipped, oob)].set(
             True, mode="drop"
         )
         return g_invalid2, count, ids, count > cap
 
-    return burst
+    return finish
+
+
+def run_topo_sweep_passes(level_starts, garrays, seed_bits, node_epoch, passes: int):
+    """HOST loop over jitted sweep passes, chaining device state — the
+    multi-pass execution of a patched mirror (level-violating edges need
+    one extra pass each; see _try_patch_mirror). The sweep program is keyed
+    only on (level_starts, start_level): any pass count reuses it, so
+    violations accumulating between bursts never recompile. Works for both
+    the 1-D union bits and the [n_tot+1, W] lane words."""
+    import jax.numpy as jnp
+
+    start = 0 if passes > 1 else 1  # patched mirrors may target level 0
+    step = topo_sweep_step(level_starts, start)
+    state = TopoState(node_epoch, jnp.zeros_like(seed_bits))
+    sb = seed_bits
+    for _ in range(passes):
+        state, _ = step(garrays, sb, state)
+        sb = jnp.zeros_like(seed_bits)  # only the first pass seeds
+    return state
 
 
 @functools.lru_cache(maxsize=8)
-def topo_mirror_burst_lanes_step(level_starts: Tuple[int, ...], cap: int, n_tot: int, words: int):
-    """Jitted LANE-PACKED live-burst program: ``32*words`` INDEPENDENT
-    command groups cascade in ONE sweep over the topo mirror.
-
-    The single-lane burst (:func:`topo_mirror_burst_step`) unions a whole
-    burst into one wave — correct, but it leaves 31/32 bits of every fetched
-    row idle while the random row fetch (the kernel's bound) costs a full
-    HBM transaction regardless. Here each group gets its own bit lane:
-    group g seeds word ``g//32`` bit ``g%32``, the W-word sweep computes all
-    closures in the same table pass, and per-lane popcounts come back with
-    the compacted UNION ids in one readback. Semantics per lane = a dense
-    BFS from the graph's CURRENT invalid state (the same gate as the
-    single-lane burst: pre-existing invalid rows neither fire, count, nor
-    conduct) — groups are snapshot-independent, exactly like the static
-    bench's packed waves, and the union is what gets applied.
-
-    ``seed_new_ids`` is int32[32*words, S] of NEW (topo-order) ids, padded
-    with ``n_tot``; ids must be UNIQUE within a lane (seed bits accumulate
-    by scatter-add — the caller dedups, which it does anyway to define a
-    group). Returns (g_invalid2, per-lane counts int32[32*words],
-    union count, compacted union original-ids, overflow)."""
+def topo_mirror_gate_lanes_step(n_tot: int, words: int):
+    """Lane-packed gate: ``32*words`` INDEPENDENT command groups, group g
+    seeding word ``g//32`` bit ``g%32``. Each lane gets dense-BFS semantics
+    from the graph's CURRENT invalid state (same gate as the union burst);
+    groups are snapshot-independent, exactly like the static bench's packed
+    waves. ``seed_new_ids`` is int32[32*words, S] of NEW (topo-order) ids,
+    padded with ``n_tot``; ids must be UNIQUE within a lane (seed bits
+    accumulate by scatter-add — the caller dedups, which it does anyway to
+    define a group). The device-side seed scatter keeps the upload O(total
+    seeds), never the O(n·W) bit matrix (16 MB/burst at 1M nodes through
+    the relay)."""
     import jax
     import jax.numpy as jnp
 
@@ -323,8 +349,7 @@ def topo_mirror_burst_lanes_step(level_starts: Tuple[int, ...], cap: int, n_tot:
     L = 32 * W
 
     @jax.jit
-    def burst(garrays: TopoGraphArrays, node_epoch0, perm_clipped, g_invalid, seed_new_ids):
-        is_real = garrays.is_real
+    def gate(is_real, node_epoch0, perm_clipped, g_invalid, seed_new_ids):
         blocked = (
             jnp.where(is_real, g_invalid[perm_clipped], False)
             .astype(jnp.int32)
@@ -332,8 +357,6 @@ def topo_mirror_burst_lanes_step(level_starts: Tuple[int, ...], cap: int, n_tot:
             .set(0)
         )
         node_epoch = jnp.where(blocked.astype(bool), -3, node_epoch0)
-        # device-side seed scatter: upload is O(total seeds), never the
-        # O(n·W) bit matrix (16 MB/burst at 1M nodes through the relay)
         lanes = jnp.arange(L, dtype=jnp.int32)
         word_of = lanes // 32
         bit_of = jnp.left_shift(jnp.int32(1), lanes % 32)  # lane 31 wraps negative: same bit pattern
@@ -347,14 +370,32 @@ def topo_mirror_burst_lanes_step(level_starts: Tuple[int, ...], cap: int, n_tot:
             .at[n_tot]
             .set(0)
         )
-        seed_bits = jnp.where(blocked[:, None].astype(bool), 0, seed_bits)
-        state2, _word_counts = _topo_sweep_impl(
-            level_starts,
-            garrays,
-            seed_bits,
-            TopoState(node_epoch, jnp.zeros((n_tot + 1, W), dtype=jnp.int32)),
+        # seeds CONDUCT even when already invalid (same rule as the union
+        # gate / ops/wave.py run_waves_union); blocked rows still can't
+        # receive, and the finish step excludes pre-invalid rows from counts
+        return node_epoch, seed_bits
+
+    return gate
+
+
+@functools.lru_cache(maxsize=8)
+def topo_mirror_finish_lanes_step(cap: int, n_tot: int, words: int):
+    """Lane-packed epilogue: per-lane closure popcounts + the compacted
+    UNION original-ids in one readback, dense-state writeback on device.
+    Returns (g_invalid2, lane_counts int32[32*words], union count, ids,
+    overflow)."""
+    import jax
+    import jax.numpy as jnp
+
+    W = words
+
+    @jax.jit
+    def finish(is_real, perm_clipped, g_invalid, final_bits):
+        # ~pre-invalid: a conducting already-invalid seed is not NEWLY in
+        # any lane (same rule as the union finish)
+        newly_bits = jnp.where(
+            is_real[:, None] & ~g_invalid[perm_clipped][:, None], final_bits, 0
         )
-        newly_bits = jnp.where(is_real[:, None], state2.invalid_bits, 0)
         # per-lane closure sizes: 32·W length-n reductions, fused by XLA —
         # never a [n, 32] unpacked intermediate
         lane_counts = jnp.stack(
@@ -379,18 +420,22 @@ def topo_mirror_burst_lanes_step(level_starts: Tuple[int, ...], cap: int, n_tot:
         )
         return g_invalid2, lane_counts, union_count, ids, union_count > cap
 
-    return burst
+    return finish
 
 
 @functools.lru_cache(maxsize=8)
-def topo_sweep_step(level_starts: Tuple[int, ...]):
+def topo_sweep_step(level_starts: Tuple[int, ...], start_level: int = 1):
     """Jitted sweep for one level layout: ``step(garrays, seed_bits, state)``.
 
     Level boundaries are compile-time (they shape the program); the graph
-    arrays stay runtime args so content updates never recompile."""
+    arrays stay runtime args so content updates never recompile.
+    ``start_level=0`` includes level 0 — needed only by multi-pass sweeps
+    over patched mirrors (an edge into a level-0 row)."""
     import jax
 
-    return jax.jit(functools.partial(_topo_sweep_impl, level_starts))
+    return jax.jit(
+        functools.partial(_topo_sweep_impl, level_starts, start_level=start_level)
+    )
 
 
 def build_topo_wave32(graph: TopoGraph, words: int = 1):

@@ -188,13 +188,21 @@ def run_waves_union(
     (O(edges × depth × W), which at 1M nodes × 64 waves ran long enough to
     get the TPU worker killed mid-program) collapses to one expansion,
     O(edges × depth) total. Returns (g, newly count, union newly mask).
+
+    Seeds CONDUCT even when already invalid (r4): a host-led columnar mark
+    (``table.invalidate`` → icasc journal entry) sets a row's invalid bit
+    without the host having walked its DEVICE-ONLY declared dependents, so
+    the expansion from such a seed must still fire them. Already-invalid
+    NON-seed nodes keep blocking propagation — they were either cascaded
+    when they were invalidated, or they are seeds of this same batch.
+    Pre-invalid seeds don't count as newly (mask diff vs inv_before).
     """
     inv_before = g.invalid
     frontier = seeds_to_frontier(g.n_cap, seed_ids.reshape(-1))
-    fresh = frontier & ~g.invalid
-    g = g._replace(invalid=g.invalid | fresh)
-    g, count = _expand_to_fixpoint(fresh, g)
-    return g, count, g.invalid & ~inv_before
+    g = g._replace(invalid=g.invalid | frontier)
+    g, _ = _expand_to_fixpoint(frontier, g)
+    newly = g.invalid & ~inv_before
+    return g, newly.sum(dtype=jnp.int32), newly
 
 
 @functools.partial(jax.jit, donate_argnums=(1,))

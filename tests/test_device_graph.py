@@ -476,10 +476,12 @@ def test_topo_mirror_burst_matches_dense_union():
     assert mirrored.mirror_bursts == 3 and dense.mirror_bursts == 0
 
 
-def test_topo_mirror_fingerprint_staleness_and_rebuild():
-    """Epoch bumps / new edges change the live-edge fingerprint: bursts
-    fall back to the dense path (still correct), and a rebuild restores the
-    mirror route."""
+def test_topo_mirror_patches_bump_and_breaks_on_untracked_delta():
+    """r4: an epoch bump no longer drops bursts to the dense path — the
+    delta PATCHES the mirror in place (tests/test_mirror_patch.py covers
+    the patch matrix). A delta the log cannot express (here: simulated by
+    severing the log) falls back to the dense path and is remembered
+    (missed_at), and a rebuild restores the mirror route."""
     rng = np.random.default_rng(23)
     n = 200
     edges = random_dag(rng, n, avg_deg=2.5)
@@ -491,14 +493,14 @@ def test_topo_mirror_fingerprint_staleness_and_rebuild():
     g.build_topo_mirror(k=4, cap=512)
     fp0 = g._topo_mirror["fp"]
 
-    # a recompute: epoch bump kills that node's in-edges → fp changes
+    # a recompute: epoch bump kills that node's in-edges → fp changes,
+    # but the delta log patches the mirror and the burst stays on it
     victim = int(arr[:, 1][len(arr) // 2])
     g.bump_epochs([victim])
     _, _, fp1 = g._live_edge_fingerprint()
     assert fp1 != fp0
 
     seeds = rng.choice(n, size=4, replace=False).tolist()
-    # burst still works (dense fallback), equals an explicit dense run
     twin = DeviceGraph(node_capacity=n, edge_capacity=len(edges) * 4)
     twin.add_nodes(n)
     twin.add_edges(arr[:, 0], arr[:, 1])
@@ -507,9 +509,21 @@ def test_topo_mirror_fingerprint_staleness_and_rebuild():
     c_dense, ids_dense = twin.run_waves_union([seeds], mirror="off")
     assert c_auto == c_dense
     np.testing.assert_array_equal(np.sort(ids_auto), np.sort(ids_dense))
-    assert g.mirror_bursts == 0  # stale mirror: dense fallback served it
+    assert g.mirror_bursts == 1 and g.mirror_patches == 1  # patched, served
+
+    # now an untracked structural change (broken delta log): dense fallback
+    victim2 = int(arr[:, 1][len(arr) // 3])
+    g.bump_epochs([victim2])
+    twin.bump_epochs([victim2])
+    g._mirror_deltas = None  # sever the log (an unpatchable delta does this)
+    seeds2 = rng.choice(n, size=4, replace=False).tolist()
+    c2_auto, ids2_auto = g.run_waves_union([seeds2])
+    c2_dense, ids2_dense = twin.run_waves_union([seeds2], mirror="off")
+    assert c2_auto == c2_dense
+    np.testing.assert_array_equal(np.sort(ids2_auto), np.sort(ids2_dense))
+    assert g.mirror_bursts == 1  # dense fallback served this one
     # ...and the failed validation is remembered: another burst on the same
-    # (unchanged) topology must not re-hash (missed_at == struct_version)
+    # (unchanged) topology must not re-validate (missed_at == struct_version)
     assert g._topo_mirror["missed_at"] == g._struct_version
 
     # rebuild picks up the new topology; mirror route is correct again
@@ -517,9 +531,10 @@ def test_topo_mirror_fingerprint_staleness_and_rebuild():
     twin.clear_invalid()
     info = g.build_topo_mirror(k=4, cap=512)
     assert info["fp"] != fp0
-    c_m, ids_m = g.run_waves_union([seeds])
-    c_d, ids_d = twin.run_waves_union([seeds], mirror="off")
-    assert c_m == c_d and g.mirror_bursts == 1
+    seeds3 = rng.choice(n, size=4, replace=False).tolist()
+    c_m, ids_m = g.run_waves_union([seeds3])
+    c_d, ids_d = twin.run_waves_union([seeds3], mirror="off")
+    assert c_m == c_d and g.mirror_bursts == 2
     np.testing.assert_array_equal(np.sort(ids_m), np.sort(ids_d))
 
 
