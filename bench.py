@@ -39,6 +39,22 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
+def _setup_jax_cache() -> None:
+    """Persistent XLA compilation cache (repo-local): the 10M-node topo
+    program costs ~100 s to compile cold; subsequent bench runs in this
+    workspace reuse the cached executables (measured ~7x faster process
+    start on the relay). Cold-start numbers are still REPORTED — they are
+    one-time per workspace, not per run."""
+    import jax
+
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        print(f"# compilation cache unavailable: {e}", file=sys.stderr)
+
+
 def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
     """Primary path: bit-packed 32-wave kernel. Default is the hybrid
     dense/sparse-level kernel (ops/hybrid_wave.py) — dense pull for wide
@@ -73,7 +89,13 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
     t0 = time.time()
     src, dst = power_law_dag(n_nodes, avg_degree=avg_deg, seed=7)
     if kernel == "topo":
-        graph = build_topo_graph(src, dst, n_nodes, k=4)
+        # quantize=False: level-size quantization exists so the LIVE
+        # mirror's compiled sweep survives rebuilds; a static bench graph
+        # never patches, and the ~10% pad rows cost real sweep time
+        graph = build_topo_graph(
+            src, dst, n_nodes, k=4,
+            quantize=os.environ.get("FUSION_BENCH_QUANTIZE", "0") == "1",
+        )
     elif kernel == "hybrid":
         graph = build_hybrid_graph(src, dst, n_nodes, k_in=4, k_out=8)
         tail_cap = int(os.environ.get("FUSION_BENCH_TAIL_CAP", 32768))
@@ -423,18 +445,19 @@ def run_live_section():
     """Embedded LIVE-path measurement (VERDICT r2 #1: BENCH must record the
     system, not just the kernels): perf/live_path.py as a subprocess — its
     own TPU memory lifetime — building a FUSION_BENCH_LIVE_NODES graph
-    through the real hub and driving the lane-packed burst
-    (invalidate_cascade_batch_lanes) with dense-equivalence asserts. The
-    subprocess skips its lone-wave and static-export sections (RTT-bound /
-    duplicated by this script's own run). FUSION_BENCH_LIVE_NODES=0 skips."""
+    through the columnar bulk-ingest path and driving churn-interleaved
+    lane bursts with incremental mirror maintenance, live lone-wave
+    latency, and dense-equivalence asserts on the churned topology.
+    FUSION_BENCH_LIVE_NODES=0 skips."""
     import subprocess
 
-    live_nodes = int(os.environ.get("FUSION_BENCH_LIVE_NODES", 1_000_000))
+    # default = the BASELINE stress scale (10M nodes, VERDICT r3 #4); the
+    # live subprocess builds it through the columnar bulk-ingest path in
+    # tens of seconds, so the full-scale run is affordable every round
+    live_nodes = int(os.environ.get("FUSION_BENCH_LIVE_NODES", 10_000_000))
     if live_nodes <= 0:
         return None
-    env = dict(
-        os.environ, LIVE_NODES=str(live_nodes), LIVE_LAT_WAVES="0", LIVE_STATIC="0"
-    )
+    env = dict(os.environ, LIVE_NODES=str(live_nodes))
     script = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "perf", "live_path.py"
     )
@@ -455,6 +478,8 @@ def run_live_section():
 
 def main() -> None:
     import jax
+
+    _setup_jax_cache()
 
     n_nodes = int(os.environ.get("FUSION_BENCH_NODES", 10_000_000))
     avg_deg = float(os.environ.get("FUSION_BENCH_DEG", 3))

@@ -3,29 +3,38 @@
 
 The static north-star bench (bench.py) runs the wave kernels over statically
 packed synthetic graphs; THIS benchmark builds the graph through the real
-system — every node is a live ``Computed`` produced by a ``@compute_method``
-call, every edge captured by the ambient dependency-capture context, every
-device structure populated through ``TpuGraphBackend``'s event journal — and
-then drives seed invalidations through ``invalidate_cascade`` /
-``invalidate_cascade_batch`` (VERDICT r1 #2).
+system and drives it UNDER CHURN (VERDICT r3 #1/#2/#3):
 
-What it reports (one JSON line):
-- ``build_nodes_per_s``    — live graph construction rate through the hub
-  (CPython compute + capture + journal)
-- ``live_inv_per_s``       — device invalidations/s over a burst of seed
-  waves driven through the live path (batched dispatch, O(wave) readbacks,
-  two-tier host application)
-- ``live_wave_ms_p50/p99`` — per-dispatch lone-wave latency through
-  ``invalidate_cascade`` (RTT-inclusive: this is what a caller actually
-  waits in THIS environment; the relay RTT floor is reported alongside)
-- ``static_export_inv_per_s`` — the SAME live-built graph exported to the
-  packed topo kernel (ops/topo_wave) and run at static-bench settings: the
-  mirror carries full fidelity to the flagship path, so the gap between
-  this and ``live_inv_per_s`` is the host command loop + relay, not the
-  graph.
+- **Columnar build** — the graph is registered through the framework's bulk
+  ingest path: a table-backed ``@compute_method`` service binds its dense
+  key space as a row block (``bind_table_rows``), declares the dependency
+  DAG in bulk numpy (``declare_row_edges``), and warms every row through
+  its own batch loader (``read_batch``). This is the production shape for
+  dense key spaces (the reference's analogue is the DbEntityResolver bulk
+  path); the r3 per-node scalar loop (~7 K nodes/s of pure CPython) remains
+  as a separately-reported micro-metric for continuity.
+- **Churn-interleaved lane bursts** — THE headline. Each round interleaves
+  real churn (recompute of all stale rows through the loader, new declared
+  edges, scalar recomputes of adopted rows — the bump+recapture shape) with
+  a 512-group lane-packed burst (``cascade_rows_lanes``). The topo mirror
+  absorbs the churn by INCREMENTAL PATCHING (level-preserving splices,
+  multi-pass sweeps for level-violating edges) with an ASYNC re-level
+  running in the background — bursts stay on the mirror lane path while the
+  structure evolves. ``mirror_patches`` / ``mirror_rebuilds`` /
+  ``mirror_patch_ms`` account for it.
+- **Live lone-wave latency** — ``live_wave_ms_p50/p99`` measured on the
+  REAL hub path (``cascade_rows_batch`` with one seed: flush → mirror gate/
+  sweep/finish → O(wave) readback → two-tier apply), reported raw
+  (RTT-inclusive: what a caller waits HERE) and RTT-subtracted (median
+  relay floor of an equivalently-shaped readback), with bootstrap CIs.
+- **Cold-start budget** — build_s / mirror_build_s / warm-up compile times
+  are first-class outputs; the persistent XLA compilation cache
+  (``.jax_cache/``) makes them one-time per workspace.
 
-Env: LIVE_NODES (default 1_000_000), LIVE_DEG (3), LIVE_WAVES (64),
-LIVE_LAT_WAVES (32).
+Env: LIVE_NODES (default 1_000_000), LIVE_DEG (3), LIVE_ROUNDS (6),
+LIVE_LANE_GROUPS (512), LIVE_LANE_SEEDS (8),
+LIVE_SCALAR_NODES (20000; 0 skips), LIVE_LAT_WAVES (32; 0 skips),
+LIVE_EDGE_CHURN (2/round), LIVE_SCALAR_CHURN (4/round).
 """
 import asyncio
 import json
@@ -33,33 +42,70 @@ import os
 import sys
 import time
 
-
-def note(msg: str) -> None:
-    print(f"# {msg}", file=sys.stderr, flush=True)
-
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+
+def note(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+def _setup_jax_cache() -> None:
+    import jax
+
+    cache = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # noqa: BLE001 — older jax: cache is an optimization
+        note(f"compilation cache unavailable: {e}")
+
+
 from stl_fusion_tpu.core import (  # noqa: E402
     ComputeService,
     FusionHub,
-    capture,
+    TableBacking,
     compute_method,
+    invalidating,
+    memo_table_of,
     set_default_hub,
 )
 from stl_fusion_tpu.graph import TpuGraphBackend  # noqa: E402
 from stl_fusion_tpu.graph.synthetic import power_law_dag  # noqa: E402
 
 
-class DagService(ComputeService):
-    """Synthetic dependency DAG as a real compute service: ``node(i)`` sums
-    its dependencies — each await captures a live edge."""
+def make_dag_service(n: int):
+    class DagTable(ComputeService):
+        """The benchmark DAG as a table-backed compute service: row i's
+        value derives from a base array (the 'database'); the dependency
+        topology is declared in bulk. The loader is the real columnar
+        compute path every warm/refresh rides."""
 
-    def __init__(self, dep_starts: np.ndarray, dep_src: np.ndarray, hub=None):
+        def __init__(self, hub=None):
+            super().__init__(hub)
+            self.base = np.arange(n, dtype=np.float32)
+
+        def load(self, ids):
+            return self.base[np.asarray(ids, dtype=np.int64)]
+
+        @compute_method(table=TableBacking(rows=n, batch="load"))
+        async def node(self, i: int) -> float:
+            return float(self.base[i])
+
+    return DagTable
+
+
+class ScalarDag(ComputeService):
+    """r3-continuity micro-service: per-node scalar build through the full
+    async compute pipeline (registry probe, lock, capture, journal)."""
+
+    def __init__(self, starts, src, hub=None):
         super().__init__(hub)
-        self._starts = dep_starts
-        self._src = dep_src
+        self._starts = starts
+        self._src = src
 
     @compute_method
     async def node(self, i: int) -> int:
@@ -70,212 +116,335 @@ class DagService(ComputeService):
         return acc
 
 
+def bootstrap_ci(samples: np.ndarray, q: float, n_boot: int = 1000, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    stats = [
+        float(np.percentile(rng.choice(samples, size=len(samples)), q))
+        for _ in range(n_boot)
+    ]
+    return [round(float(np.percentile(stats, 2.5)), 4), round(float(np.percentile(stats, 97.5)), 4)]
+
+
 async def main() -> None:
+    _setup_jax_cache()
     n = int(os.environ.get("LIVE_NODES", 1_000_000))
     deg = float(os.environ.get("LIVE_DEG", 3))
-    n_waves = int(os.environ.get("LIVE_WAVES", 64))
+    rounds = int(os.environ.get("LIVE_ROUNDS", 6))
+    n_groups = int(os.environ.get("LIVE_LANE_GROUPS", 512))
+    seeds_per_group = int(os.environ.get("LIVE_LANE_SEEDS", 8))
+    scalar_nodes = int(os.environ.get("LIVE_SCALAR_NODES", 20_000))
     lat_waves = int(os.environ.get("LIVE_LAT_WAVES", 32))
+    edge_churn = int(os.environ.get("LIVE_EDGE_CHURN", 2))
+    scalar_churn = int(os.environ.get("LIVE_SCALAR_CHURN", 4))
     rng = np.random.default_rng(123)
 
+    note(f"generating {n}-node power-law DAG...")
     src, dst = power_law_dag(n, avg_degree=deg, seed=7)
-    order = np.argsort(dst, kind="stable")
-    src_s, dst_s = src[order], dst[order]
-    starts = np.zeros(n + 1, dtype=np.int64)
-    np.add.at(starts[1:], dst_s, 1)
-    starts = np.cumsum(starts)
 
     hub = FusionHub()
     old = set_default_hub(hub)
     try:
-        backend = TpuGraphBackend(hub, node_capacity=n + 1, edge_capacity=len(src) + 1)
-        svc = DagService(starts, src_s, hub)
+        backend = TpuGraphBackend(
+            hub, node_capacity=n + 64, edge_capacity=len(src) + 65536
+        )
+        Dag = make_dag_service(n)
+        svc = Dag(hub)
+        hub.add_service(svc, "dag")
+        table = memo_table_of(svc.node)
 
-        # -------- build the live graph (bottom-up: deps always cached)
-        note(f"building {n}-node live graph through the hub...")
+        # -------- columnar build: the framework's bulk ingest path
+        note(f"building the {n}-node live graph (columnar bulk ingest)...")
+        chunk = min(n, 1_000_000)
         t0 = time.perf_counter()
-        for i in range(n):
-            await svc.node(i)
-        build_s = time.perf_counter() - t0
-        note(f"built in {build_s:.1f}s; flushing journal to device...")
+        block = backend.bind_table_rows(table)
+        backend.declare_row_edges(block, src, block, dst)
+        for c0 in range(0, n, chunk):
+            table.read_batch(np.arange(c0, min(c0 + chunk, n)))
         backend.flush()
-        note("flushed")
-        assert backend.node_count == n, (backend.node_count, n)
+        build_s = time.perf_counter() - t0
+        assert backend.node_count == n and table.stale_count() == 0
+        note(f"built in {build_s:.1f}s ({n/build_s:,.0f} nodes/s incl one-time compiles)")
 
-        # relay RTT floor of this environment (single readback)
+        # -------- scalar micro-build (r3 continuity: the per-node path)
+        scalar_rate = None
+        if scalar_nodes > 0:
+            note(f"scalar micro-build ({scalar_nodes} nodes)...")
+            s_src, s_dst = power_law_dag(scalar_nodes, avg_degree=deg, seed=11)
+            order = np.argsort(s_dst, kind="stable")
+            s_src, s_dst = s_src[order], s_dst[order]
+            starts = np.zeros(scalar_nodes + 1, dtype=np.int64)
+            np.add.at(starts[1:], s_dst, 1)
+            starts = np.cumsum(starts)
+            ssvc = ScalarDag(starts, s_src, hub)
+            hub.add_service(ssvc, "scalar_dag")
+            t0 = time.perf_counter()
+            for i in range(scalar_nodes):
+                await ssvc.node(i)
+            scalar_rate = scalar_nodes / (time.perf_counter() - t0)
+            note(f"scalar path: {scalar_rate:,.0f} nodes/s")
+
+        # -------- relay floors: a single readback, and the live lone-wave
+        # DISPATCH SHAPE (three dependent jitted calls + one readback —
+        # exactly what cascade_rows_batch's gate/sweep/finish chain pays
+        # through the relay). Subtracting the chain floor isolates the
+        # actual device+host work of a lone wave from tunnel latency.
+        import jax
         import jax.numpy as jnp
 
         x = jnp.zeros(8)
-        float((x + 1).sum())
-        t0 = time.perf_counter()
-        for _ in range(3):
+
+        @jax.jit
+        def _t1(v):
+            return v + 1
+
+        float(_t1(_t1(_t1(x))).sum())
+        rtt_samples, chain_samples = [], []
+        for _ in range(24):
+            t0 = time.perf_counter()
             float((x + 1).sum())
-        rtt_ms = (time.perf_counter() - t0) / 3 * 1e3
+            rtt_samples.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            float(_t1(_t1(_t1(x))).sum())
+            chain_samples.append((time.perf_counter() - t0) * 1e3)
+        rtt_ms = float(np.median(rtt_samples))
+        chain_floor_ms = float(np.median(chain_samples))
 
-        # -------- lone-wave latency through invalidate_cascade (shallow
-        # seeds: the shape of a typical edit), RTT-inclusive by design.
-        # LIVE_LAT_WAVES=0 skips (bench.py's embedded live section does —
-        # the RTT-bound numbers don't change and each wave is a dispatch)
-        lat_arr = None
-        if lat_waves > 1:
-            shallow = [n - 1 - int(i) for i in rng.choice(n // 100, size=lat_waves, replace=False)]
-            computeds = [await capture(lambda i=i: svc.node(i)) for i in shallow]
-            note("compiling the collect kernel (first invalidate_cascade)...")
-            backend.invalidate_cascade(computeds[0])  # compile the collect kernel
-            note("collect kernel compiled; timing lone waves...")
-            lat = []
-            for c in computeds[1:]:
-                t0 = time.perf_counter()
-                backend.invalidate_cascade(c)
-                lat.append((time.perf_counter() - t0) * 1e3)
-            lat_arr = np.asarray(lat)
-
-        # -------- burst throughput: deep seeds (hubs) through the batch API
-        deep_ids = rng.choice(n // 10, size=n_waves, replace=False).tolist()
-        deep = [await capture(lambda i=i: svc.node(i)) for i in deep_ids]
-        # warm the chained program with no-op waves of the same padded
-        # shape (a -1 seed row invalidates nothing) — compile time is not
-        # a per-burst cost
-        note("compiling the union burst program...")
-        backend.graph.run_waves_union([[-1]] * n_waves, mirror="off")
-        note("burst program compiled; running the timed burst...")
-        backend.graph.clear_invalid()  # bursts start from a consistent graph
+        # -------- topo mirror build + program warm-up (cold-start budget)
+        note("building the topo mirror...")
         t0 = time.perf_counter()
-        total = backend.invalidate_cascade_batch(deep)
-        burst_s = time.perf_counter() - t0
-
-        # -------- the same burst over the cached topo mirror (depth-free)
-        note("building the topo mirror of the live graph...")
-        t0 = time.perf_counter()
-        # default cap: waves larger than it take the mask-diff readback
-        # (1 byte/node) instead of a full id-buffer transfer (4 bytes/slot),
-        # which through the relay is the cheaper path for huge bursts
-        info = backend.build_topo_mirror()
+        info = backend.graph.build_topo_mirror()
         mirror_build_s = time.perf_counter() - t0
-        note(f"mirror built ({info['levels']} levels); compiling the burst program...")
-        # warm with the REAL seed shape (the program is specialized on the
-        # padded seed width), then reset state for the timed run
-        backend.graph.clear_invalid()
-        backend.invalidate_cascade_batch(deep)
-        note("mirror program compiled; running the timed mirror burst...")
-        backend.graph.clear_invalid()
-        t0 = time.perf_counter()
-        total_m = backend.invalidate_cascade_batch(deep)
-        mirror_burst_s = time.perf_counter() - t0
-        assert total_m == total, (total_m, total)  # mirror ≡ dense at scale
-
-        # -------- lane-packed burst: THE live headline (VERDICT r2 #1).
-        # Each group = the computeds one command's completion invalidates;
-        # every group cascades INDEPENDENTLY in its own bit lane, 32 groups
-        # per packed word, one mirror sweep per dispatch — the live path at
-        # the static kernel's lane occupancy instead of one union lane.
-        # 512 groups = W=16 words/row — the same knee the static bench
-        # found: doubling 256→512 cost only 0.44→0.46 s of burst time
-        # (374.7 M vs 213 M inv/s measured at 1 M nodes)
-        n_groups = int(os.environ.get("LIVE_LANE_GROUPS", 512))
-        seeds_per_group = int(os.environ.get("LIVE_LANE_SEEDS", 8))
+        note(f"mirror built ({info['levels']} levels) in {mirror_build_s:.1f}s; warming programs...")
         group_ids = [
             rng.choice(n // 10, size=seeds_per_group, replace=False).tolist()
             for _ in range(n_groups)
         ]
-        group_computeds = [
-            [await capture(lambda i=i: svc.node(i)) for i in ids] for ids in group_ids
-        ]
-        note(f"compiling the lane burst ({n_groups} groups x {seeds_per_group} seeds)...")
-        backend.graph.clear_invalid()
-        backend.invalidate_cascade_batch_lanes(group_computeds)  # compile
-        note("lane program compiled; running the timed lane burst...")
-        backend.graph.clear_invalid()
         t0 = time.perf_counter()
-        lane_counts = backend.invalidate_cascade_batch_lanes(group_computeds)
-        lanes_s = time.perf_counter() - t0
-        lanes_total = int(lane_counts.sum())
-        lanes_union_mask = backend.graph.invalid_mask().copy()
+        backend.cascade_rows_lanes(block, group_ids)  # lane program compile
+        lane_warm_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        backend.cascade_rows_batch(block, [n - 1])  # union program compile
+        union_warm_s = time.perf_counter() - t0
+        stale = np.nonzero(table._stale_host)[0]
+        if stale.size:
+            table.read_batch(stale)
+        backend.flush()
+        note(f"programs warm (lane {lane_warm_s:.1f}s, union {union_warm_s:.1f}s)")
 
-        # mirror ≡ dense, lane semantics: (a) the applied union equals ONE
-        # dense union BFS of all groups' seeds; (b) sampled per-group counts
-        # equal an independent dense run of just that group
-        note("asserting lane ≡ dense equivalence...")
-        backend.graph.clear_invalid()
-        dense_union_count, _ = backend.graph.run_waves_union(
-            [[backend._id_by_input[c.input] for g in group_computeds for c in g]],
-            mirror="off",
-        )
-        dense_union_mask = backend.graph.invalid_mask()
-        assert (dense_union_mask == lanes_union_mask).all(), "lane union != dense union"
-        assert dense_union_count == int(lanes_union_mask.sum())
-        for gi in (0, n_groups // 2, n_groups - 1):
-            backend.graph.clear_invalid()
-            c_dense, _ = backend.graph.run_waves_union(
-                [[backend._id_by_input[c.input] for c in group_computeds[gi]]],
-                mirror="off",
-            )
-            assert c_dense == int(lane_counts[gi]), (gi, c_dense, int(lane_counts[gi]))
-        note("lane ≡ dense: OK")
+        # -------- live lone-wave latency (VERDICT r3 #3): the REAL hub path
+        lat_raw = lat_sub = None
+        if lat_waves > 0:
+            note("timing live lone waves...")
+            shallow = rng.choice(n // 100, size=lat_waves, replace=False)
+            shallow = (n - 1 - shallow).tolist()  # tail rows: shallow closures
+            lat = []
+            for row in shallow:
+                t0 = time.perf_counter()
+                backend.cascade_rows_batch(block, [row])
+                lat.append((time.perf_counter() - t0) * 1e3)
+            lat_raw = np.asarray(lat)
+            lat_sub = np.maximum(lat_raw - chain_floor_ms, 0.0)
+            stale = np.nonzero(table._stale_host)[0]
+            if stale.size:
+                table.read_batch(stale)
+            backend.flush()
 
-        # -------- the same live-built graph on the flagship static kernel
-        # (LIVE_STATIC=0 skips — it shares kernels with bench.py's own run)
-        static_total, static_s = 0, 0.0
-        m = backend.graph.n_edges
-        if os.environ.get("LIVE_STATIC", "1") != "0":
-            from stl_fusion_tpu.ops.topo_wave import (
-                build_topo_graph,
-                build_topo_wave32,
-                topo_seeds_to_bits,
-            )
-
-            dg = backend.graph
-            topo = build_topo_graph(dg._h_edge_src[:m], dg._h_edge_dst[:m], n, k=4)
-            words = 4
-            state0, wave32 = build_topo_wave32(topo, words=words)
-            seed_lists = [
-                rng.choice(n, size=max(n // 100, 1), replace=False) for _ in range(32 * words)
-            ]
-            bits = jnp.asarray(topo_seeds_to_bits(topo, seed_lists, words=words))
-            note("compiling the static topo export...")
-            # the JITTED step (graph arrays as runtime args) — the raw
-            # ``wave32.impl`` executes EAGERLY, which through the axon relay
-            # means one round trip per level slice: minutes at 100K nodes and a
-            # worker OOM at 1M (each eager op materializes a fresh intermediate)
-            st, counts = wave32(bits, state0)  # compile
-            int(np.asarray(counts, dtype=np.int64).sum())
-            note("static export compiled; timing...")
+        # -------- churn-interleaved lane bursts: THE live headline
+        note(f"churn/burst loop: {rounds} rounds x {n_groups} groups x {seeds_per_group} seeds...")
+        gdev = backend.graph
+        total_inv = 0
+        burst_s = 0.0
+        churn_rows_total = 0
+        churn_s = 0.0
+        scalar_rows = rng.choice(n // 2, size=max(scalar_churn, 1) * rounds, replace=False)
+        loop_t0 = time.perf_counter()
+        for rnd in range(rounds):
+            # structural churn: new dependencies (some violate the frozen
+            # level order -> multi-pass patches), plus scalar recomputes of
+            # adopted rows (bump + declared-edge recapture). Their cascades
+            # land at the flush below.
+            v = rng.integers(1, n, size=edge_churn)
+            u = (rng.random(edge_churn) * v).astype(np.int64)
+            backend.declare_row_edges(block, u, block, v)
+            for i in range(scalar_churn):
+                row = int(scalar_rows[rnd * scalar_churn + i])
+                with invalidating():
+                    await svc.node(row)
+                await svc.node(row)
+            backend.flush()  # scalar marks cascade (one union wave)
+            # recompute side of churn: every stale row — the previous
+            # burst's closure AND the scalar churn's cascades — refreshes
+            # through the loader, restoring consistency before the burst
+            stale = np.nonzero(table._stale_host)[0]
             t0 = time.perf_counter()
-            st, counts = wave32(bits, state0)
-            static_total = int(np.asarray(counts, dtype=np.int64).sum())
-            static_s = time.perf_counter() - t0
+            if stale.size:
+                table.read_batch(stale)
+            backend.flush()
+            churn_s += time.perf_counter() - t0
+            churn_rows_total += int(stale.size)
+            # the burst: 512 command groups cascade in packed lanes, WITH
+            # the above churn applied since the last burst (patched mirror,
+            # multi-pass when level-violating deps accumulated)
+            t0 = time.perf_counter()
+            counts = backend.cascade_rows_lanes(block, group_ids)
+            bt = time.perf_counter() - t0
+            burst_s += bt
+            total_inv += int(counts.sum())
+            m = gdev._topo_mirror
+            note(
+                f"round {rnd}: churn {stale.size} rows, burst {bt:.2f}s "
+                f"({int(counts.sum())/max(bt,1e-9)/1e6:.0f}M inv/s, "
+                f"passes={m.get('passes', 1) if m else '?'}), "
+                f"patches={gdev.mirror_patches} rebuilds={gdev.mirror_rebuilds}"
+            )
+            # maintenance AFTER the burst: install a finished background
+            # re-level and warm its programs with an UNTIMED burst — a new
+            # level layout means a new sweep program, and that compile
+            # belongs to loop_s (sustained), never to the burst lane rate.
+            # (The patch path also self-starts a rebuild past 3 violations.)
+            if gdev.poll_topo_mirror_rebuild():
+                backend.cascade_rows_lanes(block, group_ids)
+                warm_stale = np.nonzero(table._stale_host)[0]
+                if warm_stale.size:
+                    table.read_batch(warm_stale)
+                backend.flush()
+            m = gdev._topo_mirror
+            if (
+                m is not None
+                and m.get("n_viol", 0) >= 1
+                and gdev._async_rebuild is None
+            ):
+                gdev.start_topo_mirror_rebuild()
+        loop_s = time.perf_counter() - loop_t0
+        bursts_on_mirror = gdev.mirror_bursts
+        note(
+            f"loop done: {total_inv:,} inv, burst {burst_s:.2f}s, loop {loop_s:.2f}s, "
+            f"patches={gdev.mirror_patches} rebuilds={gdev.mirror_rebuilds} "
+            f"bursts_on_mirror={bursts_on_mirror}"
+        )
+
+        # -------- lane ≡ oracle equivalence ON THE CHURNED TOPOLOGY.
+        # ≤2M nodes: the device dense-BFS path (the in-system oracle).
+        # Larger: a HOST CSR BFS over the live edge set — an INDEPENDENT
+        # implementation (the 10M dense while-loop program runs long enough
+        # to trip the TPU worker's watchdog through the relay).
+        note("asserting lane ≡ oracle equivalence on the churned graph...")
+        stale = np.nonzero(table._stale_host)[0]
+        if stale.size:
+            table.read_batch(stale)
+        backend.flush()
+        gdev.clear_invalid()
+        probe = group_ids[:: max(n_groups // 3, 1)][:3]
+        lane_counts = backend.cascade_rows_lanes(block, probe)
+        if n <= 2_000_000:
+            for gi, g in enumerate(probe):
+                gdev.clear_invalid()
+                c_dense, _ = gdev.run_waves_union(
+                    [[block.base + int(r) for r in g]], mirror="off"
+                )
+                assert c_dense == int(lane_counts[gi]), (
+                    gi, c_dense, int(lane_counts[gi])
+                )
+            note("lane ≡ dense: OK")
+        else:
+            nn = gdev.n_nodes
+            m_e = gdev.n_edges
+            live_e = (
+                gdev._h_node_epoch[gdev._h_edge_dst[:m_e]]
+                == gdev._h_edge_dst_epoch[:m_e]
+            )
+            ls_, ld_ = gdev._h_edge_src[:m_e][live_e], gdev._h_edge_dst[:m_e][live_e]
+            order = np.argsort(ls_, kind="stable")
+            ls_s, ld_s = ls_[order].astype(np.int64), ld_[order].astype(np.int64)
+            starts = np.zeros(nn + 1, dtype=np.int64)
+            np.add.at(starts[1:], ls_s[ls_s < nn], 1)
+            starts = np.cumsum(starts)
+            for gi, g in enumerate(probe):
+                seen = np.zeros(nn, dtype=bool)
+                frontier = block.base + np.asarray(g, dtype=np.int64)
+                seen[frontier] = True
+                while frontier.size:
+                    nxt = []
+                    for u_ in frontier:
+                        s0, s1 = starts[u_], starts[u_ + 1]
+                        nxt.append(ld_s[s0:s1])
+                    cand = np.concatenate(nxt) if nxt else np.empty(0, np.int64)
+                    cand = cand[~seen[cand]]
+                    cand = np.unique(cand)
+                    seen[cand] = True
+                    frontier = cand
+                want = int(seen.sum())
+                assert want == int(lane_counts[gi]), (gi, want, int(lane_counts[gi]))
+            note("lane ≡ host-BFS oracle: OK")
+        gdev.clear_invalid()
 
         result = {
             "metric": "live_path",
             "nodes": n,
-            "edges": int(m),
+            "edges": int(backend.edge_count),
             "build_s": round(build_s, 2),
             "build_nodes_per_s": round(n / build_s, 1),
+            "build_path": "columnar bulk ingest (bind_table_rows + declare_row_edges + read_batch warm)",
+            "build_scalar_nodes_per_s": round(scalar_rate, 1) if scalar_rate else None,
             "relay_rtt_ms": round(rtt_ms, 1),
+            # live lone-wave latency through cascade_rows_batch (flush ->
+            # mirror gate/sweep/finish -> O(wave) readback -> 2-tier apply)
             "live_wave_ms_p50": (
-                round(float(np.percentile(lat_arr, 50)), 2) if lat_arr is not None else None
+                round(float(np.percentile(lat_raw, 50)), 2) if lat_raw is not None else None
             ),
             "live_wave_ms_p99": (
-                round(float(np.percentile(lat_arr, 99)), 2) if lat_arr is not None else None
+                round(float(np.percentile(lat_raw, 99)), 2) if lat_raw is not None else None
             ),
-            "live_burst_waves": n_waves,
-            "live_burst_invalidations": int(total),
-            # THE live headline: lane-packed burst through the real hub
-            # (invalidate_cascade_batch_lanes), counts summed per group —
-            # the same accounting as the static bench's packed waves
-            "live_inv_per_s": round(lanes_total / lanes_s, 1),
+            "live_wave_ms_p50_rtt_subtracted": (
+                round(float(np.percentile(lat_sub, 50)), 2) if lat_sub is not None else None
+            ),
+            "live_wave_ms_p99_rtt_subtracted": (
+                round(float(np.percentile(lat_sub, 99)), 2) if lat_sub is not None else None
+            ),
+            "live_wave_ms_p50_ci": (
+                bootstrap_ci(lat_raw, 50) if lat_raw is not None else None
+            ),
+            "live_wave_ms_p99_ci": (
+                bootstrap_ci(lat_raw, 99) if lat_raw is not None else None
+            ),
+            "live_wave_ms_method": (
+                "each sample = one cascade_rows_batch([single tail row]) on the "
+                "live hub (RTT-inclusive); rtt_subtracted = sample - median relay "
+                "floor of the SAME dispatch shape (three dependent jitted calls "
+                "+ one readback — the gate/sweep/finish chain); CI = 95% "
+                "bootstrap (1000 resamples) on the raw samples"
+            ),
+            "relay_chain_floor_ms": round(chain_floor_ms, 1),
+            # THE live headline: lane-packed bursts WITH churn interleaved
+            "live_inv_per_s": round(total_inv / burst_s, 1) if burst_s else None,
+            "live_sustained_inv_per_s": round(total_inv / loop_s, 1) if loop_s else None,
+            "live_rounds": rounds,
             "live_lanes_groups": n_groups,
             "live_lanes_seeds_per_group": seeds_per_group,
-            "live_lanes_total_inv": lanes_total,
-            "live_lanes_union_inv": int(lanes_union_mask.sum()),
-            "live_lanes_s": round(lanes_s, 4),
-            "live_union_dense_inv_per_s": round(total / burst_s, 1),
-            "live_mirror_inv_per_s": round(total_m / mirror_burst_s, 1),
-            "mirror_build_s": round(mirror_build_s, 2),
-            "mirror_levels": info["levels"],
-            "static_export_inv_per_s": (
-                round(static_total / static_s, 1) if static_s else None
+            "live_lanes_total_inv": total_inv,
+            "live_burst_s": round(burst_s, 3),
+            "live_loop_s": round(loop_s, 3),
+            "churn_rows_recomputed": churn_rows_total,
+            "churn_recompute_rows_per_s": (
+                round(churn_rows_total / churn_s, 1) if churn_s else None
             ),
-            "static_export_waves": 128 if static_s else 0,
+            "churn_edges_declared": edge_churn * rounds,
+            "churn_scalar_recomputes": scalar_churn * rounds,
+            "mirror_patches": gdev.mirror_patches,
+            "mirror_rebuilds": gdev.mirror_rebuilds,
+            "mirror_patch_ms": round(gdev.mirror_patch_s * 1e3, 1),
+            "bursts_on_mirror": bursts_on_mirror,
+            "mirror_passes_final": (
+                gdev._topo_mirror.get("passes", 1) if gdev._topo_mirror else None
+            ),
+            # cold-start budget (VERDICT r3 #8) — one-time per workspace
+            # thanks to the persistent compilation cache
+            "cold_start": {
+                "build_s": round(build_s, 2),
+                "mirror_build_s": round(mirror_build_s, 2),
+                "lane_program_warm_s": round(lane_warm_s, 2),
+                "union_program_warm_s": round(union_warm_s, 2),
+            },
         }
         print(json.dumps(result))
     finally:

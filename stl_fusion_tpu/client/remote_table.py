@@ -21,9 +21,15 @@ row; Client/Internal/RpcComputeSystemCalls.cs:13-26 for the push pattern):
   resubscribes — fences dropped while the link was down can't strand stale
   rows.
 
-Codec-keyed tables stay in-process for now: remote access is by dense row
-ids (the benchmarked shape); key interning across the wire would make the
-server's codec authoritative and is left to the RPC service layer above.
+Codec-keyed tables work remotely too (VERDICT r3 #4): ``read_keys`` carries
+string/composite keys over the wire with the SERVER's codec authoritative —
+the server interns unknown keys (``$tables.read_keys``), the client learns
+the key→row assignments from responses and thereafter reads by row id
+(local gathers until a fence lands). The reference's RPC carries arbitrary
+argument lists for every call (Configuration/RpcByteArgumentSerializer.cs:
+8-60); this is that capability at table granularity. A reconnect clears the
+learned key map along with the row cache — a restarted server may intern
+keys onto different rows, and only its codec is truth.
 """
 from __future__ import annotations
 
@@ -47,6 +53,11 @@ log = logging.getLogger("stl_fusion_tpu")
 __all__ = ["RemoteTableHost", "RemoteTable", "TABLE_RPC_SERVICE"]
 
 TABLE_RPC_SERVICE = "$tables"
+
+
+def _deep_tuple(v):
+    """Wire decode turns tuples into lists; keys must be hashable."""
+    return tuple(_deep_tuple(x) for x in v) if isinstance(v, list) else v
 
 
 def _table_system(rpc_hub: "RpcHub") -> dict:
@@ -168,6 +179,16 @@ class _TableRpcService:
         values = np.asarray(table.read_batch(np.asarray(ids, dtype=np.int32)))
         return {"values": values, "version": table.version}
 
+    async def read_keys(self, name: str, keys):
+        """Keyed read with the SERVER's codec authoritative: unknown keys
+        intern here (exactly like an in-process ``read_keys``); the response
+        carries the assigned row ids so the client can fence-track them."""
+        table = self._host._require(name)
+        keys = [_deep_tuple(k) for k in keys]  # wire lists → hashable
+        rows = table.encode_keys(keys)  # allocates: server is truth
+        values = np.asarray(table.read_batch(rows))
+        return {"rows": rows, "values": values, "version": table.version}
+
     async def table_info(self, name: str):
         table = self._host._require(name)
         return {
@@ -200,6 +221,9 @@ class RemoteTable:
         self._row_fence_stamp: Optional[np.ndarray] = None
         self._fence_counter = 0
         self._lock = asyncio.Lock()
+        #: learned server key→row assignments (server codec authoritative;
+        #: cleared on reconnect — a restarted server may re-intern)
+        self._row_by_key: Dict = {}
         self._subscribed = False
         self._connects_seen = 0
         self._reconnect_task: Optional[asyncio.Task] = None
@@ -223,6 +247,60 @@ class RemoteTable:
                 if stale.size:
                     await self._fetch(np.unique(stale))
         return self._values[ids_np]
+
+    async def read_keys(self, keys) -> np.ndarray:
+        """Keyed reads over the wire (string / composite keys): unknown keys
+        resolve remotely in ONE batch (the server interns them — its codec
+        is authoritative), known keys read like ``read_batch`` — a local
+        gather unless a fence marked their rows stale."""
+        await self._ensure_ready()
+        norm = [_deep_tuple(k) for k in keys]
+        rows = np.empty(len(keys), dtype=np.int64)
+        # a reconnect mid-fetch clears the learned map (the server may have
+        # re-interned), vaporizing keys outside the in-flight batch — retry
+        # resolution instead of crashing (bounded: repeated drops give up)
+        for _attempt in range(3):
+            unknown = [j for j, k in enumerate(norm) if k not in self._row_by_key]
+            if not unknown:
+                break
+            async with self._fetch_lock:
+                still = [j for j in unknown if norm[j] not in self._row_by_key]
+                if still:
+                    # dedup while preserving one representative per key
+                    uniq = list({norm[j]: None for j in still})
+                    await self._fetch_keys(uniq)
+        else:
+            missing = [norm[j] for j in range(len(norm)) if norm[j] not in self._row_by_key]
+            if missing:
+                raise ConnectionError(
+                    f"keyed resolution kept getting invalidated by reconnects: {missing[:3]}"
+                )
+        for j, k in enumerate(norm):
+            rows[j] = self._row_by_key[k]
+        ids_np = rows.astype(np.int32)
+        if not self._valid[ids_np].all():
+            async with self._fetch_lock:
+                stale = ids_np[~self._valid[ids_np]]
+                if stale.size:
+                    await self._fetch(np.unique(stale))
+        return self._values[ids_np]
+
+    async def _fetch_keys(self, keys) -> None:
+        fence_floor = self._fence_counter
+        resp = await self.rpc_hub.call(
+            TABLE_RPC_SERVICE, "read_keys", (self.name, list(keys)),
+            peer_ref=self.peer_ref,
+        )
+        self.remote_reads += 1
+        rows = np.asarray(resp["rows"], dtype=np.int32)
+        self._values[rows] = resp["values"]
+        for k, r in zip(keys, rows):
+            self._row_by_key[_deep_tuple(k)] = int(r)
+        self.server_version = max(self.server_version, resp["version"])
+        # same in-flight-fence rule as _fetch: a fence stamped after this
+        # read began wins — the row keeps the value but stays stale
+        unfenced = self._row_fence_stamp[rows] <= fence_floor
+        self._valid[rows[unfenced]] = True
 
     async def _ensure_ready(self) -> None:
         if self._subscribed:
@@ -293,6 +371,9 @@ class RemoteTable:
             was_connected = ev.value.is_connected
             if was_connected:
                 self._apply_fence(self.server_version, None)
+                # a restarted server may intern keys onto different rows;
+                # its codec is the only truth — relearn from scratch
+                self._row_by_key.clear()
                 try:
                     await peer.send(_subscribe_message(self.name))
                 except Exception:  # noqa: BLE001 — next flip retries

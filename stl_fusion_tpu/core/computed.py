@@ -61,6 +61,7 @@ class Computed(Generic[T]):
         "_lock",
         "_backend_nid",
         "_ka_renewed_until",
+        "_ka_skip",
         "__weakref__",
     )
 
@@ -78,6 +79,7 @@ class Computed(Generic[T]):
         self._lock = threading.Lock()
         self._backend_nid: Optional[int] = None  # device-mirror node id
         self._ka_renewed_until = 0.0  # keep-alive renewal throttle window
+        self._ka_skip = 0  # hit-count renewal amortizer (see renew_timeouts)
 
     # ------------------------------------------------------------------ state
     def _pending_probe(self) -> bool:
@@ -361,14 +363,22 @@ class Computed(Generic[T]):
     def renew_timeouts(self, is_new: bool) -> None:
         """Refresh keep-alive on access (reference Computed.cs:248-262).
 
-        Throttled: the timer wheel already snaps deadlines to a duration/64
-        grid, so renewals inside one grid cell cannot move the deadline —
-        skipping them here (one monotonic() compare) keeps the memoized-hit
-        fast path out of the timer plumbing. Worst case the deadline lags
-        one grid cell (~1.6% of the duration), same slack the wheel's
-        quantization already allows."""
+        Doubly amortized: (a) a hit-count skip — only every 16th access
+        even LOOKS at the clock (≈ the reference's StochasticCounter-gated
+        renewal, Computed.cs:248-262 + StochasticCounter.cs), so the
+        memoized-hit fast path usually costs one int compare; (b) the timer
+        wheel already snaps deadlines to a duration/64 grid, so renewals
+        inside one grid cell cannot move the deadline. Worst case the
+        deadline lags 16 accesses + one grid cell — the same slack class
+        the reference's probabilistic renewal accepts."""
         if self._state == ConsistencyState.INVALIDATED:
             return
+        if not is_new:
+            k = self._ka_skip
+            if k > 0:
+                self._ka_skip = k - 1
+                return
+            self._ka_skip = 15
         d = self.options.min_cache_duration
         if d > 0:
             timeouts = self._hub().timeouts

@@ -67,8 +67,9 @@ class FunctionBase:
 
         # LOCK
         async with self.hub.registry.input_locks.lock(input):
-            # RETRY-READ
-            existing = self.hub.registry.get(input)
+            # RETRY-READ (peek: the same logical access as the READ above —
+            # monitors must not count it twice)
+            existing = self.hub.registry.peek(input)
             hit = self._try_use_existing_from_lock(existing, context, used_by)
             if hit is not None:
                 return hit

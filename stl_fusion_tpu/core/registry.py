@@ -34,6 +34,11 @@ class ComputedRegistry:
         self.on_register: List[Callable[["Computed"], None]] = []
         self.on_unregister: List[Callable[["Computed"], None]] = []
         self.on_access: List[Callable[["ComputedInput"], None]] = []
+        #: amortized count of memoized-hit FAST-path reads (the per-service
+        #: hot cache bypasses ``get``/``on_access`` entirely; it bumps this
+        #: by 16 on every 16th hit — the renewal cadence — so monitors keep
+        #: a truthful access total without putting a hook on the hot path)
+        self.fast_hits = 0
 
     def __len__(self) -> int:
         return len(self._map)
@@ -44,6 +49,19 @@ class ComputedRegistry:
         for h in self.on_access:
             h(input)
         return computed
+
+    def peek(self, input: "ComputedInput") -> Optional["Computed"]:
+        """``get`` without the on_access hooks — internal bookkeeping probes
+        (the hot-cache population after a miss, the under-lock RETRY-READ,
+        the wrapper's pre-invoke check) must not multi-count one logical
+        access in monitors."""
+        ref = self._map.get(input)
+        return ref() if ref is not None else None
+
+    def count_access(self, input: "ComputedInput") -> None:
+        """Fire the on_access hooks for an access served from a peek."""
+        for h in self.on_access:
+            h(input)
 
     def register(self, computed: "Computed") -> None:
         """Intern ``computed``; a displaced live entry is invalidated

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import functools
 import inspect
+import weakref
 from typing import Any, Callable, Optional
 
 from .context import OPT_INVALIDATE_BIT, CallOptions, ComputeContext, get_current
@@ -276,6 +277,19 @@ class ComputeMethodDef:
         return tuple(bound.arguments.values())[1:]  # drop self
 
 
+def _make_hot_evictor(hot: dict, key):
+    """Weakref finalizer dropping a hot-cache entry when its node is
+    collected — without it, high-cardinality keyspaces would leak one
+    (args-tuple → dead weakref) entry per key forever. Guarded by identity:
+    a displaced-and-repopulated key must not lose its LIVE entry."""
+
+    def evict(ref):
+        if hot.get(key) is ref:
+            del hot[key]
+
+    return evict
+
+
 def hub_of(service: Any) -> FusionHub:
     hub = getattr(service, "_fusion_hub", None)
     return hub if hub is not None else default_hub()
@@ -318,27 +332,67 @@ def compute_method(
             transient_error_invalidation_delay=transient_error_invalidation_delay,
         )
         method_def = ComputeMethodDef(func, options, table)
+        # per-service HOT cache attribute: args → weakref(consistent node).
+        # Weak entries keep the registry's lifecycle authoritative (pruner /
+        # keep-alive expiry still collect nodes; a dead or inconsistent
+        # entry just falls through to the full path and is re-populated).
+        hot_attr = f"_fusion_hot_{func.__qualname__.replace('.', '_')}"
 
         @functools.wraps(func)
         async def wrapper(self, *args, **kwargs):
+            context = ComputeContext.current()
+            copts = context.call_options
+            if copts == 0 and not kwargs:
+                # memoized-hit FAST path (the reference's 50M-ops/sec READ,
+                # Function.cs:56): default call mode + consistent node →
+                # attach the edge and return with no input construction, no
+                # registry probe, no awaits (≈1 dict hit + 1 weakref deref)
+                hot = self.__dict__.get(hot_attr)
+                if hot is not None:
+                    ref = hot.get(args)
+                    if ref is not None:
+                        existing = ref()
+                        if existing is not None and existing.is_consistent:
+                            used_by = get_current()
+                            if used_by is not None:
+                                used_by.add_used(existing)
+                            if existing._ka_skip == 0:
+                                # every 16th hit (the renewal cadence):
+                                # amortized access accounting for monitors
+                                existing.input.function.hub.registry.fast_hits += 16
+                            existing.renew_timeouts(False)
+                            return existing._output.value
+                        if existing is None:
+                            hot.pop(args, None)  # collected (evictor may race)
             function = method_def.get_function(self)
             input = ComputeMethodInput(
                 method_def, self, method_def.bind_args(self, args, kwargs), function
             )
-            context = ComputeContext.current()
-            copts = context.call_options
             if copts == 0:
-                # memoized-hit fast path (the reference's 50M-ops/sec READ,
-                # Function.cs:56): default call mode + consistent node →
-                # attach the edge and return without further awaits
-                existing = function.hub.registry.get(input)
-                if existing is not None and existing.is_consistent:
+                registry = function.hub.registry
+                # peek, not get: on a miss, invoke's own READ is the ONE
+                # counted access — a get here would make every miss count
+                # twice and read as a phantom hit in monitors
+                existing = registry.peek(input)
+                if existing is None or not existing.is_consistent:
+                    value = await function.invoke_and_strip(input, get_current(), context)
+                    existing = registry.peek(input)
+                    if existing is None or not existing.is_consistent:
+                        return value
+                else:
+                    registry.count_access(input)  # a served warm hit
                     used_by = get_current()
                     if used_by is not None:
                         used_by.add_used(existing)
                     existing.renew_timeouts(False)
-                    return existing.output.value
-                return await function.invoke_and_strip(input, get_current(), context)
+                    value = existing.output.value
+                hot = self.__dict__.get(hot_attr)
+                if hot is None:
+                    hot = self.__dict__[hot_attr] = {}
+                key = input.args
+                ref = weakref.ref(existing, _make_hot_evictor(hot, key))
+                hot[key] = ref
+                return value
             # the ambient computing node is the dependency-capture root —
             # except inside an invalidation replay, where no edges form.
             # scalar → table coherence lives on the node itself (see

@@ -23,14 +23,22 @@ class FusionMonitor:
     def __init__(self, hub: "FusionHub", report_period: float = 60.0):
         self.hub = hub
         self.report_period = report_period
-        self.accesses = 0
+        self._slow_accesses = 0
         self.registrations = 0
         self.invalidations = 0
+        # the hot-cache fast path counts amortized on the registry (every
+        # 16th hit — see core/service.py) instead of firing a hook per hit
+        self._fast_hits0 = getattr(hub.registry, "fast_hits", 0)
         self._started_at = time.monotonic()
         self._last_report = self._started_at
         hub.registry.on_access.append(self._on_access)
         hub.registry.on_register.append(self._on_register)
         hub.invalidated_hooks.append(self._on_invalidated)
+
+    @property
+    def accesses(self) -> int:
+        fast = getattr(self.hub.registry, "fast_hits", 0) - self._fast_hits0
+        return self._slow_accesses + fast
 
     # computes (misses) register; everything else that probed was a hit
     @property
@@ -39,10 +47,11 @@ class FusionMonitor:
 
     @property
     def hit_ratio(self) -> float:
-        return self.hits / self.accesses if self.accesses else 0.0
+        accesses = self.accesses
+        return self.hits / accesses if accesses else 0.0
 
     def _on_access(self, _input) -> None:
-        self.accesses += 1
+        self._slow_accesses += 1
         now = time.monotonic()
         if now - self._last_report >= self.report_period:
             self._last_report = now

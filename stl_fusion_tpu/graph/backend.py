@@ -274,6 +274,28 @@ class TpuGraphBackend:
             journal, self._journal = self._journal, []
         if not journal:
             return
+        icasc_parts: List[np.ndarray] = []
+
+        def run_icasc() -> None:
+            # Union expansion for the accumulated table marks (seeds
+            # conduct even while already invalid — ops/wave.py). The seeds
+            # themselves are NOT re-applied: each table marked its own rows
+            # stale and probed their scalar twins at mark time
+            # (MemoTable.invalidate → on_invalidate hooks), and a seed
+            # refreshed after its mark must not be re-staled — the union
+            # re-marks every seed, so refreshed ones are restored after.
+            # _apply_newly never journals (quiet table marks +
+            # invalidate_local under _applying_ids): no flush re-entry.
+            nids = np.unique(np.concatenate(icasc_parts))
+            icasc_parts.clear()
+            was_clear = nids[~self.graph._h_invalid[nids]]
+            total, newly_ids = self.graph.run_waves_union([nids.tolist()])
+            newly_ids = newly_ids[~np.isin(newly_ids, nids)]
+            if was_clear.size:
+                self.graph.clear_invalid_ids(was_clear)
+            self._apply_newly(newly_ids)
+            self.device_invalidations += total
+
         i, n = 0, len(journal)
         while i < n:
             kind = journal[i][0]
@@ -281,6 +303,19 @@ class TpuGraphBackend:
             while j < n and journal[j][0] == kind:
                 j += 1
             batch = [payload for _, payload in journal[i:j]]
+            if kind in ("cpack", "bump") and icasc_parts:
+                # a refresh/recompute of an ALREADY-ACCUMULATED mark must
+                # not be clobbered by (or clobber) the deferred expansion:
+                # expand NOW, in journal order, before clearing those bits.
+                # Non-intersecting batches (the common case) keep deferring
+                # — one union per flush.
+                touched = (
+                    np.concatenate(batch) if kind == "cpack"
+                    else np.asarray(batch, dtype=np.int32)
+                )
+                acc = np.concatenate(icasc_parts)
+                if np.isin(touched, acc).any():
+                    run_icasc()
             if kind == "bump":
                 self.graph.bump_epochs(np.asarray(batch, dtype=np.int32))
             elif kind == "edge":
@@ -294,28 +329,25 @@ class TpuGraphBackend:
                     np.concatenate([p[1] for p in batch]),
                 )
             elif kind == "icasc":
-                # host-led table invalidations CASCADE: the marked rows'
-                # declared dependents live only in the device graph, so the
-                # closure expands here (union wave; seeds conduct even if
-                # already invalid — ops/wave.py) and applies two-tier like
-                # any other wave. _apply_newly never journals (quiet table
-                # marks + invalidate_local under _applying_ids), so this
-                # cannot re-enter flush.
+                # host-led table invalidations CASCADE — but interleaved
+                # scalar churn would split them into many batches, and a
+                # union wave per batch is the one per-flush device cost
+                # that matters. All icasc marks of this flush mark their
+                # bits NOW (order vs bumps/refreshes preserved) and expand
+                # in ONE union wave at the END: expansion against the
+                # final structural state is safe — an edge only dies when
+                # its dependent recomputed, and a recomputed dependent is
+                # fresh by construction.
                 nids = np.concatenate(batch)
-                total, newly_ids = self.graph.run_waves_union([nids.tolist()])
-                # the seeds themselves are NOT re-applied: the table marked
-                # its own rows stale and probed their scalar twins at mark
-                # time (MemoTable.invalidate → on_invalidate hooks); a row
-                # refreshed between mark and flush must not be re-staled.
-                # Only the closure beyond the seeds is wave-applied.
-                newly_ids = newly_ids[~np.isin(newly_ids, nids)]
-                self._apply_newly(newly_ids)
-                self.device_invalidations += total
+                self.graph.mark_invalid(nids)
+                icasc_parts.append(nids)
             elif kind == "cpack":  # bulk refreshes: consistent again, no bump
                 self.graph.clear_invalid_ids(np.concatenate(batch))
             else:  # invalid
                 self.graph.mark_invalid(np.asarray(batch, dtype=np.int32))
             i = j
+        if icasc_parts:
+            run_icasc()
 
     # ------------------------------------------------------------------ columnar ingest
     def bind_table_rows(self, table, n_rows: Optional[int] = None) -> RowBlock:
@@ -690,8 +722,6 @@ class TpuGraphBackend:
         touched the invalid state since the last burst; only then does the
         bridge pay a full O(n) re-sync. Validated on the virtual CPU mesh
         (tests + dryrun)."""
-        sharded = self.sharded_mirror(mesh=mesh)
-        entry = self._sharded_mirror
         seeds: List[int] = []
         fallback = 0
         for c in computeds:
@@ -703,6 +733,18 @@ class TpuGraphBackend:
                 seeds.append(nid)
         if not seeds:
             return fallback
+        return self._union_sharded_nids(seeds, mesh) + fallback
+
+    def cascade_rows_batch_sharded(self, block: RowBlock, rows, mesh=None) -> int:
+        """:meth:`cascade_rows_batch` ON THE MESH: table rows seed a union
+        wave expanded over the device mesh (frontier all-gather over ICI),
+        applied back to the live hub and tables like the single-chip path."""
+        nids = block.base + self._check_rows(block, rows)
+        return self._union_sharded_nids(nids.tolist(), mesh)
+
+    def _union_sharded_nids(self, seeds: List[int], mesh=None) -> int:
+        sharded = self.sharded_mirror(mesh=mesh)
+        entry = self._sharded_mirror
         dg = self.graph
         if entry.get("invalid_version") != dg.invalid_version:
             # host-led change since the last burst (or first burst on this
@@ -731,7 +773,7 @@ class TpuGraphBackend:
         self._apply_newly(newly_ids)
         self.waves_run += 1
         self.device_invalidations += count
-        return count + fallback
+        return count
 
     def packed_mirror(self, mesh=None) -> dict:
         """Fingerprint-cached packed mesh mirror of the LIVE edge set — the
@@ -785,10 +827,6 @@ class TpuGraphBackend:
         entry reads out-of-sync until the dense apply completes).
         Returns per-group newly counts (missing computeds fall back to
         immediate host invalidation, counting 1)."""
-        import jax
-
-        entry = self.packed_mirror(mesh=mesh)
-        pg = entry["graph"]
         seed_lists: List[List[int]] = []
         fallback = np.zeros(len(groups), dtype=np.int64)
         for gi, group in enumerate(groups):
@@ -801,6 +839,21 @@ class TpuGraphBackend:
                 else:
                     ids.append(nid)
             seed_lists.append(ids)
+        return self._lanes_sharded_nids(seed_lists, mesh) + fallback
+
+    def cascade_rows_lanes_sharded(self, block: RowBlock, row_groups, mesh=None) -> np.ndarray:
+        """:meth:`cascade_rows_lanes` ON THE MESH: each row group cascades
+        independently in its own bit lane over the device mesh (packed
+        frontier words, one all-gather per level), union applied back to
+        the hub and tables like the single-chip path."""
+        seed_lists = [
+            (block.base + self._check_rows(block, g)).tolist() for g in row_groups
+        ]
+        return self._lanes_sharded_nids(seed_lists, mesh)
+
+    def _lanes_sharded_nids(self, seed_lists: List[List[int]], mesh=None) -> np.ndarray:
+        entry = self.packed_mirror(mesh=mesh)
+        pg = entry["graph"]
         dg = self.graph
         if entry.get("invalid_version") != dg.invalid_version:
             mask = dg.invalid_mask()
@@ -817,9 +870,9 @@ class TpuGraphBackend:
         dg.mark_invalid(union_ids)
         entry["invalid_version"] = dg.invalid_version
         self._apply_newly(union_ids)
-        self.waves_run += len(groups)
+        self.waves_run += len(seed_lists)
         self.device_invalidations += int(counts.sum())
-        return counts + fallback
+        return counts
 
     def computed_for(self, node_id: int):
         """The live Computed for a backend node id (None if collected)."""

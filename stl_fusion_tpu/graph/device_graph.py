@@ -150,13 +150,39 @@ class DeviceGraph:
             self._grow_edges(self.n_edges + k)
         if dst_epoch is None:
             dst_epoch = self._h_node_epoch[dst]
-        dst_epoch = np.asarray(dst_epoch, dtype=np.int32)
-        sl = slice(self.n_edges, self.n_edges + k)
+        dst_epoch = np.broadcast_to(
+            np.asarray(dst_epoch, dtype=np.int32), dst.shape
+        )
+        start = self.n_edges
+        sl = slice(start, start + k)
         self._h_edge_src[sl] = src
         self._h_edge_dst[sl] = dst
         self._h_edge_dst_epoch[sl] = dst_epoch
         self.n_edges += k
-        self._dirty = True
+        if self._g is not None and not self._dirty:
+            # incremental device append: an edge batch lands in the padded
+            # slots by scatter instead of dirtying the mirror — a full
+            # dense-array re-upload (~130 MB at 1M nodes through the relay)
+            # inside the next burst is exactly the cost live churn can't pay
+            jnp = self._jnp
+            idx = np.arange(start, start + k, dtype=np.int32)
+            pad = self._pad_ids_pow2(idx)  # repeats idx[0]: same values rewrite
+            if len(pad) != k:
+                src = np.concatenate([src, np.full(len(pad) - k, src[0], np.int32)])
+                dst = np.concatenate([dst, np.full(len(pad) - k, dst[0], np.int32)])
+                dst_epoch = np.concatenate(
+                    [dst_epoch, np.full(len(pad) - k, dst_epoch[0], np.int32)]
+                )
+            idx_j = jnp.asarray(pad)
+            self._g = self._g._replace(
+                edge_src=self._g.edge_src.at[idx_j].set(jnp.asarray(src)),
+                edge_dst=self._g.edge_dst.at[idx_j].set(jnp.asarray(dst)),
+                edge_dst_epoch=self._g.edge_dst_epoch.at[idx_j].set(
+                    jnp.asarray(dst_epoch)
+                ),
+            )
+        else:
+            self._dirty = True
         self._struct_version += 1
         if (
             self._topo_mirror is not None and self._mirror_deltas is not None
@@ -191,6 +217,19 @@ class DeviceGraph:
         else:
             self._dirty = True
 
+    @staticmethod
+    def _pad_ids_pow2(node_ids: np.ndarray) -> np.ndarray:
+        """Pow2-pad an id batch by REPEATING the first id (idempotent for
+        set-style scatters) so the device scatter's shape quantizes: live
+        batches vary per call, and through the relay every fresh shape is
+        a fresh executable (~seconds)."""
+        width = _round_up_pow2(len(node_ids))
+        if width == len(node_ids):
+            return node_ids
+        out = np.full(width, node_ids[0], dtype=np.int32)
+        out[: len(node_ids)] = node_ids
+        return out
+
     def mark_invalid(self, node_ids: np.ndarray) -> None:
         """Externally-observed invalidations (host-led waves) → mirror state."""
         node_ids = np.asarray(node_ids, dtype=np.int32)
@@ -199,7 +238,7 @@ class DeviceGraph:
         self._h_invalid[node_ids] = True
         self.invalid_version += 1
         if self._g is not None and not self._dirty:
-            ids = self._jnp.asarray(node_ids)
+            ids = self._jnp.asarray(self._pad_ids_pow2(node_ids))
             self._g = self._g._replace(invalid=self._g.invalid.at[ids].set(True))
 
     def clear_invalid_ids(self, node_ids: np.ndarray) -> None:
@@ -213,7 +252,7 @@ class DeviceGraph:
         self._h_invalid[node_ids] = False
         self.invalid_version += 1
         if self._g is not None and not self._dirty:
-            ids = self._jnp.asarray(node_ids)
+            ids = self._jnp.asarray(self._pad_ids_pow2(node_ids))
             self._g = self._g._replace(invalid=self._g.invalid.at[ids].set(False))
 
     def _grow_nodes(self, need: int) -> None:
@@ -362,7 +401,9 @@ class DeviceGraph:
         jnp = self._jnp
         g = self.device_arrays()
         flat = [int(i) for s in seed_id_lists for i in s]
-        width = _round_up_pow2(max(len(flat), 1))
+        # width floor 256: small cascades (lone waves, scalar-churn icasc
+        # batches) share ONE compiled program instead of one per pow2 width
+        width = max(256, _round_up_pow2(max(len(flat), 1)))
         ids = np.full(width, -1, dtype=np.int32)
         ids[: len(flat)] = np.asarray(flat, dtype=np.int32)
         self._g, count, newly = run_waves_union(jnp.asarray(ids), g)
@@ -493,9 +534,15 @@ class DeviceGraph:
                     lv = int(np.searchsorted(ls, rv, side="right")) - 1
                     if lu >= lv:
                         # frozen level order violated: patch anyway, pay
-                        # one extra sweep pass (exact — monotone OR)
+                        # one extra sweep pass (exact — monotone OR). Past
+                        # 3 violations, self-maintain: kick off the ASYNC
+                        # re-level (which dissolves them) and keep serving
+                        # with extra passes as the bridge; only past the
+                        # hard cap (8) is the sweep cost no longer worth it
                         n_viol += 1
-                        if n_viol > 3:
+                        if n_viol > 3 and self._async_rebuild is None:
+                            self.start_topo_mirror_rebuild(k=m["k"], cap=m["cap"])
+                        if n_viol > 8:
                             return _break_patched()
                         viol_by_row.setdefault(rv, set()).add(ru)
                     h[rv, int(free[0])] = ru
@@ -718,21 +765,32 @@ class DeviceGraph:
             [int(i) for s in seed_id_lists for i in s], dtype=np.int64
         )
         new_ids = m["inv_perm"][flat] if len(flat) else np.empty(0, np.int64)
-        width = _round_up_pow2(max(len(new_ids), 1))
+        width = max(256, _round_up_pow2(max(len(new_ids), 1)))  # shared program
         ids = np.full(width, n_tot, dtype=np.int32)  # pad = null row
         ids[: len(new_ids)] = new_ids.astype(np.int32)
         g = self.device_arrays()
         garrays = m["garrays"]
-        node_epoch, seed_bits = topo_mirror_gate_step(n_tot)(
-            garrays.is_real, m["node_epoch0"], m["perm_clipped"], g.invalid,
-            jnp.asarray(ids),
-        )
-        state = run_topo_sweep_passes(
-            m["level_starts"], garrays, seed_bits, node_epoch, m.get("passes", 1)
-        )
-        g_invalid2, count, out_ids, overflow = topo_mirror_finish_step(
-            m["cap"], n_tot
-        )(garrays.is_real, m["perm_clipped"], g.invalid, state.invalid_bits)
+        passes = m.get("passes", 1)
+        if passes == 1:
+            # steady state: ONE dispatch + one readback (through a relay,
+            # every dispatch costs ~a round trip — the split pipeline is
+            # for multi-pass patched mirrors only)
+            from ..ops.topo_wave import topo_mirror_fused_union_step
+
+            g_invalid2, count, out_ids, overflow = topo_mirror_fused_union_step(
+                m["level_starts"], m["cap"], n_tot
+            )(garrays, m["node_epoch0"], m["perm_clipped"], g.invalid, jnp.asarray(ids))
+        else:
+            node_epoch, seed_bits = topo_mirror_gate_step(n_tot)(
+                garrays.is_real, m["node_epoch0"], m["perm_clipped"], g.invalid,
+                jnp.asarray(ids),
+            )
+            state = run_topo_sweep_passes(
+                m["level_starts"], garrays, seed_bits, node_epoch, passes
+            )
+            g_invalid2, count, out_ids, overflow = topo_mirror_finish_step(
+                m["cap"], n_tot
+            )(garrays.is_real, m["perm_clipped"], g.invalid, state.invalid_bits)
         count, out_ids, overflow = jax.device_get((count, out_ids, overflow))
         self._g = g._replace(invalid=g_invalid2)
         self.mirror_bursts += 1
@@ -777,20 +835,30 @@ class DeviceGraph:
             )
             g = self.device_arrays()
             garrays = m["garrays"]
-            node_epoch, seed_bits = topo_mirror_gate_lanes_step(n_tot, words)(
-                garrays.is_real, m["node_epoch0"], m["perm_clipped"], g.invalid,
-                jnp.asarray(mat),
-            )
-            state = run_topo_sweep_passes(
-                m["level_starts"], garrays, seed_bits, node_epoch,
-                m.get("passes", 1),
-            )
-            g_invalid2, lane_counts, union_count, ids, overflow = (
-                topo_mirror_finish_lanes_step(m["cap"], n_tot, words)(
-                    garrays.is_real, m["perm_clipped"], g.invalid,
-                    state.invalid_bits,
+            passes = m.get("passes", 1)
+            if passes == 1:
+                from ..ops.topo_wave import topo_mirror_fused_lanes_step
+
+                g_invalid2, lane_counts, union_count, ids, overflow = (
+                    topo_mirror_fused_lanes_step(
+                        m["level_starts"], m["cap"], n_tot, words
+                    )(garrays, m["node_epoch0"], m["perm_clipped"], g.invalid,
+                      jnp.asarray(mat))
                 )
-            )
+            else:
+                node_epoch, seed_bits = topo_mirror_gate_lanes_step(n_tot, words)(
+                    garrays.is_real, m["node_epoch0"], m["perm_clipped"], g.invalid,
+                    jnp.asarray(mat),
+                )
+                state = run_topo_sweep_passes(
+                    m["level_starts"], garrays, seed_bits, node_epoch, passes
+                )
+                g_invalid2, lane_counts, union_count, ids, overflow = (
+                    topo_mirror_finish_lanes_step(m["cap"], n_tot, words)(
+                        garrays.is_real, m["perm_clipped"], g.invalid,
+                        state.invalid_bits,
+                    )
+                )
             lane_counts, union_count, ids, overflow = jax.device_get(
                 (lane_counts, union_count, ids, overflow)
             )

@@ -91,6 +91,7 @@ class OperationLogReader(WorkerBase):
         start_from_end: bool = True,
         batch_size: int = 1024,
         start_position: Optional[int] = None,
+        mesh=None,
     ):
         super().__init__("oplog-reader")
         self.log_store = log_store
@@ -98,6 +99,10 @@ class OperationLogReader(WorkerBase):
         self.notifier = notifier
         self.poll_period = poll_period
         self.batch_size = batch_size
+        #: optional jax.sharding.Mesh: external-operation lane replay runs
+        #: on the DEVICE MESH (invalidate_cascade_batch_lanes_sharded) — N
+        #: external commands cost one packed mesh sweep over ICI
+        self.mesh = mesh
         # explicit position (checkpoint resume) > tail-from-end > full replay
         if start_position is not None:
             self.watermark = start_position
@@ -170,7 +175,12 @@ class OperationLogReader(WorkerBase):
                 # what was collected, or those operations' invalidations
                 # would be lost forever (replay never revisits them)
                 if groups and any(groups):
-                    backend.invalidate_cascade_batch_lanes(groups)
+                    if self.mesh is not None:
+                        backend.invalidate_cascade_batch_lanes_sharded(
+                            groups, mesh=self.mesh
+                        )
+                    else:
+                        backend.invalidate_cascade_batch_lanes(groups)
 
 
 def attach_operation_log(
@@ -179,10 +189,12 @@ def attach_operation_log(
     notifier=None,
     start_reader: bool = True,
     start_position: Optional[int] = None,
+    mesh=None,
 ) -> OperationLogReader:
     """Wire a commander's operations pipeline to a durable log:
     - local completions append to the log (+ notify),
-    - a reader replays external completions from other hosts.
+    - a reader replays external completions from other hosts
+      (``mesh=`` routes the lane replay over the device mesh).
     """
     commander.attach_operations_pipeline()
     operations = commander.operations
@@ -200,7 +212,9 @@ def attach_operation_log(
             notifier.notify()
 
     operations.commit_listeners.append(persist)
-    reader = OperationLogReader(log_store, operations, notifier, start_position=start_position)
+    reader = OperationLogReader(
+        log_store, operations, notifier, start_position=start_position, mesh=mesh
+    )
     if start_reader:
         reader.start()
     return reader

@@ -41,6 +41,23 @@ __all__ = ["MemoTable"]
 Ids = Union[Sequence[int], np.ndarray]
 
 
+def _pad_repeat_pow2(ids_np: np.ndarray) -> np.ndarray:
+    """Pow2-pad an id batch by repeating the first id — shape-quantizes the
+    jitted kernels so varying batch sizes don't each compile a fresh device
+    executable (set-style scatters are duplicate-safe)."""
+    n = len(ids_np)
+    if n == 0:
+        return ids_np  # empty gathers/scatters stay empty (no [0] to repeat)
+    width = 1
+    while width < n:
+        width <<= 1
+    if width == n:
+        return ids_np
+    out = np.full(width, ids_np[0], dtype=np.int32)
+    out[:n] = ids_np
+    return out
+
+
 class MemoTable:
     def __init__(
         self,
@@ -66,6 +83,10 @@ class MemoTable:
         self._stale_host = np.ones(self.n_rows, dtype=bool)
         self._stale_count = self.n_rows  # exact count, O(batch) to maintain
         self._valid_dev = jnp.zeros(self.n_rows, dtype=jnp.bool_)
+        # True = the device mask lags _stale_host (wave application defers
+        # the scatter — a 10M-row wave would upload 40 MB of ids through
+        # the relay per burst); valid_mask/valid_bits materialize lazily
+        self._valid_dev_dirty = False
         self._packed_cache: Optional[tuple] = None  # (version, packed bits)
         self.on_invalidate: List[Callable[[np.ndarray], None]] = []
         #: fired with the refreshed ids after a vectorized recompute — the
@@ -109,7 +130,10 @@ class MemoTable:
         stale = self._stale_host[ids_np]
         if stale.any():
             self.refresh(np.unique(ids_np[stale]))
-        return self._jit_cache["gather"](self._values, self._jnp.asarray(ids_np))
+        k = len(ids_np)
+        padded = _pad_repeat_pow2(ids_np)
+        out = self._jit_cache["gather"](self._values, self._jnp.asarray(padded))
+        return out if len(padded) == k else out[:k]
 
     def encode_keys(self, keys, allocate: bool = True) -> np.ndarray:
         """Dense row ids for arbitrary keys via the attached codec (a key is
@@ -167,14 +191,18 @@ class MemoTable:
 
     @property
     def valid_mask(self):
-        """Per-row device validity mask (bool[n_rows])."""
+        """Per-row device validity mask (bool[n_rows]); materialized from
+        the host-authoritative staleness if a wave application deferred it."""
+        if self._valid_dev_dirty:
+            self._valid_dev = self._jnp.asarray(~self._stale_host)
+            self._valid_dev_dirty = False
         return self._valid_dev
 
     def valid_bits(self):
         """Packed per-row validity (uint32 lanes) for on-device bit-kernel
         consumers; packed on demand and cached per table version."""
         if self._packed_cache is None or self._packed_cache[0] != self.version:
-            self._packed_cache = (self.version, self._jit_cache["pack"](self._valid_dev))
+            self._packed_cache = (self.version, self._jit_cache["pack"](self.valid_mask))
         return self._packed_cache[1]
 
     # ------------------------------------------------------------------ writes
@@ -185,9 +213,20 @@ class MemoTable:
         if ids_np.size == 0:
             return
         rows = self.compute_fn(ids_np)
-        jids = self._jnp.asarray(ids_np)
+        # pow2-pad by repeating the first row (duplicate scatter of the SAME
+        # value is deterministic): refresh batch sizes vary per call, and a
+        # fresh shape is a fresh device executable (~seconds via the relay)
+        padded = _pad_repeat_pow2(ids_np)
+        if len(padded) != len(ids_np):
+            rows = np.asarray(rows)
+            pad_rows = np.broadcast_to(
+                rows[:1], (len(padded) - len(ids_np), *rows.shape[1:])
+            )
+            rows = np.concatenate([rows, pad_rows])
+        jids = self._jnp.asarray(padded)
         self._values = self._jit_cache["scatter"](self._values, jids, self._jnp.asarray(rows))
-        self._valid_dev = self._jit_cache["set_mask"](self._valid_dev, jids, True)
+        if not self._valid_dev_dirty:  # else: lazy materialization covers it
+            self._valid_dev = self._jit_cache["set_mask"](self._valid_dev, jids, True)
         self._stale_count -= int(np.count_nonzero(self._stale_host[ids_np]))
         self._stale_host[ids_np] = False
         self._bump()
@@ -207,9 +246,16 @@ class MemoTable:
         WITHOUT firing ``on_invalidate`` — the wave already owns the cascade
         and the scalar-twin application (two-tier, graph/backend.py), so the
         table→scalar hook firing here would re-walk the whole wave in
-        per-row Python. ``changed`` still advances: reactive consumers see
-        the version bump either way."""
-        self._mark_stale(ids)
+        per-row Python. The device mask update is DEFERRED (dirty flag;
+        wave ids are already unique, and a 10M-row id scatter would upload
+        40 MB through the relay per burst). ``changed`` still advances."""
+        ids_np = np.asarray(ids, dtype=np.int32)
+        if ids_np.size == 0:
+            return
+        self._stale_count += int(np.count_nonzero(~self._stale_host[ids_np]))
+        self._stale_host[ids_np] = True
+        self._valid_dev_dirty = True
+        self._bump()
 
     def _mark_stale(self, ids: Ids) -> Optional[np.ndarray]:
         """Shared staleness bookkeeping; returns the deduped ids (None when
@@ -219,9 +265,10 @@ class MemoTable:
             return None
         self._stale_count += int(np.count_nonzero(~self._stale_host[ids_np]))
         self._stale_host[ids_np] = True
-        self._valid_dev = self._jit_cache["set_mask"](
-            self._valid_dev, self._jnp.asarray(ids_np), False
-        )
+        if not self._valid_dev_dirty:
+            self._valid_dev = self._jit_cache["set_mask"](
+                self._valid_dev, self._jnp.asarray(_pad_repeat_pow2(ids_np)), False
+            )
         self._bump()
         return ids_np
 
@@ -229,6 +276,7 @@ class MemoTable:
         self._stale_host[:] = True
         self._stale_count = self.n_rows
         self._valid_dev = self._jnp.zeros_like(self._valid_dev)
+        self._valid_dev_dirty = False
         self._bump()
         if self.on_invalidate:
             all_ids = np.arange(self.n_rows, dtype=np.int32)
@@ -268,6 +316,7 @@ class MemoTable:
         self._stale_host = ~valid
         self._stale_count = int((~valid).sum())
         self._valid_dev = self._jnp.asarray(valid)
+        self._valid_dev_dirty = False
         self._packed_cache = None
         self.version = int(state["version"])
         self._bump()

@@ -49,6 +49,8 @@ __all__ = [
     "build_topo_wave32",
     "topo_mirror_gate_step",
     "topo_mirror_finish_step",
+    "topo_mirror_fused_union_step",
+    "topo_mirror_fused_lanes_step",
     "topo_mirror_gate_lanes_step",
     "topo_mirror_finish_lanes_step",
     "run_topo_sweep_passes",
@@ -90,36 +92,76 @@ def _levels_numpy(in_src: np.ndarray, n: int, k: int) -> np.ndarray:
     raise ValueError("level relaxation failed to converge (cycle?)")
 
 
+def _quantize_level(s: int) -> int:
+    """Pad a level's row count up to a coarse size bucket (≤12.5% overhead
+    past 128 rows, minimum grid 16). Level sizes — and therefore the
+    ``level_starts`` tuple the sweep program is keyed on — become STABLE
+    under small structural drift: a mirror rebuild after churn usually
+    produces the SAME tuple and reuses the already-compiled sweep (in-
+    process lru + persistent cache) instead of paying a full XLA compile
+    (~3 min at 1M nodes) inside the serving path."""
+    if s <= 0:
+        return 0
+    if s <= 16:
+        return 16
+    grid = max(16, 1 << (int(s - 1).bit_length() - 3))
+    return -(-s // grid) * grid
+
+
 def build_topo_graph(
-    src: np.ndarray, dst: np.ndarray, n_nodes: int, k: int = 4, use_native: bool = True
+    src: np.ndarray, dst: np.ndarray, n_nodes: int, k: int = 4, use_native: bool = True,
+    quantize: bool = True,
 ) -> TopoGraph:
     """In-ELL (build_ell on reversed edges, bounding in-degree at k with
-    virtual OR-collectors) renumbered into topological level order."""
+    virtual OR-collectors) renumbered into topological level order, each
+    level padded to a quantized size (null rows: no in-edges, not real) so
+    the compiled sweep survives rebuilds — see :func:`_quantize_level`."""
     ell: EllGraph = build_ell(dst, src, n_nodes, k=k, use_native=use_native)
-    n_tot = ell.n_tot
+    n_tot_o = ell.n_tot
     level = None
     if use_native:
         from ..native import native_topo_levels
 
-        level = native_topo_levels(ell.ell_dst, n_tot, k)
+        level = native_topo_levels(ell.ell_dst, n_tot_o, k)
     if level is None:
-        level = _levels_numpy(ell.ell_dst, n_tot, k)
+        level = _levels_numpy(ell.ell_dst, n_tot_o, k)
 
-    order = np.argsort(level, kind="stable")  # new id -> old id, levels ascending
-    perm = np.concatenate([order, [n_tot]]).astype(np.int64)
-    inv_perm = np.empty(n_tot + 1, dtype=np.int64)
-    inv_perm[perm] = np.arange(n_tot + 1)
+    order = np.argsort(level, kind="stable")  # levels ascending over old ids
+    sizes = np.bincount(level, minlength=int(level.max()) + 1 if n_tot_o else 1)
+    padded = [(_quantize_level(int(s)) if quantize else int(s)) for s in sizes]
+    n_tot = int(sum(padded))  # padded row-space size; null row at index n_tot
+    if quantize:
+        # quantize the TOTAL too (≤ ~3% tail of pure null rows): programs
+        # keyed on n_tot (gate/finish/lane epilogues) survive rebuilds whose
+        # level structure drifted — the expensive 512-lane popcount epilogue
+        # would otherwise recompile on every re-level
+        grain = max(256, (1 << (n_tot.bit_length() - 1)) // 32)
+        n_tot = -(-n_tot // grain) * grain
 
-    # remap rows into new order and entries into new ids (pad row n_tot is
-    # a fixed point of both maps)
+    # perm: new row -> old augmented id; pad rows map to the OLD null row
+    # (their in-rows read as all-pad, epoch -1 — they can never fire)
+    perm = np.full(n_tot + 1, n_tot_o, dtype=np.int64)
+    starts = [0]
+    pos = oi = 0
+    for s, ps in zip(sizes, padded):
+        s, ps = int(s), int(ps)
+        perm[pos : pos + s] = order[oi : oi + s]
+        oi += s
+        pos += ps
+        starts.append(pos)
+    inv_perm = np.full(n_tot_o + 1, n_tot, dtype=np.int64)
+    real = perm[:n_tot] != n_tot_o
+    inv_perm[perm[:n_tot][real]] = np.nonzero(real)[0]
+    inv_perm[n_tot_o] = n_tot
+
+    # remap rows into new order and entries into new ids (the old pad row
+    # maps to the new null row n_tot, so pad entries stay pads)
     in_src = inv_perm[ell.ell_dst[perm]].astype(np.int32)
     edge_epoch = ell.ell_epoch[perm]
-    is_real = ell.is_real[perm]
+    is_real = ell.is_real[perm] & (perm != n_tot_o)
 
-    counts = np.bincount(level, minlength=int(level.max()) + 1 if n_tot else 1)
-    starts = tuple(int(x) for x in np.concatenate([[0], np.cumsum(counts)]))
     return TopoGraph(
-        in_src, edge_epoch, is_real, starts, perm, inv_perm, n_nodes, n_tot, k
+        in_src, edge_epoch, is_real, tuple(starts), perm, inv_perm, n_nodes, n_tot, k
     )
 
 
@@ -315,19 +357,158 @@ def run_topo_sweep_passes(level_starts, garrays, seed_bits, node_epoch, passes: 
     """HOST loop over jitted sweep passes, chaining device state — the
     multi-pass execution of a patched mirror (level-violating edges need
     one extra pass each; see _try_patch_mirror). The sweep program is keyed
-    only on (level_starts, start_level): any pass count reuses it, so
-    violations accumulating between bursts never recompile. Works for both
-    the 1-D union bits and the [n_tot+1, W] lane words."""
+    only on level_starts: ANY pass count reuses it (start_level is pinned
+    to 0 — level 0 is sources-only, so the extra slice is near-free, and a
+    passes 1→2 transition must not re-key the program mid-serving: the
+    compile would land inside a timed burst)."""
     import jax.numpy as jnp
 
-    start = 0 if passes > 1 else 1  # patched mirrors may target level 0
-    step = topo_sweep_step(level_starts, start)
+    step = topo_sweep_step(level_starts, 0)
     state = TopoState(node_epoch, jnp.zeros_like(seed_bits))
     sb = seed_bits
     for _ in range(passes):
         state, _ = step(garrays, sb, state)
         sb = jnp.zeros_like(seed_bits)  # only the first pass seeds
     return state
+
+
+def _lane_counts_blocked(newly_bits, W: int, block: int = 1 << 15):
+    """Per-lane popcounts of [rows, W] packed bits in ONE pass over HBM.
+
+    The obvious ``stack([((bits[:, w] >> b) & 1).sum() ...])`` emits 32·W
+    separate strided reductions which XLA does NOT fuse at scale — at 10M
+    rows × W=16 that re-reads the 700 MB bit array hundreds of times
+    (~30 s/burst measured). Here a fori_loop unpacks one [block, W, 32]
+    tile at a time and accumulates [W, 32] partials: total traffic = one
+    read of the bits + a 64 MB transient."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rows = newly_bits.shape[0]
+    nb = -(-rows // block)
+    padded = jnp.pad(newly_bits, ((0, nb * block - rows), (0, 0)))
+    shifts = jnp.arange(32, dtype=jnp.int32)[None, None, :]
+
+    def body(i, acc):
+        blk = lax.dynamic_slice(padded, (i * block, 0), (block, W))
+        bits = (blk[:, :, None] >> shifts) & 1
+        return acc + bits.sum(axis=0, dtype=jnp.int32)
+
+    acc = lax.fori_loop(0, nb, body, jnp.zeros((W, 32), jnp.int32))
+    return acc.reshape(W * 32)  # lane l = word l//32, bit l%32 — stack order
+
+
+@functools.lru_cache(maxsize=8)
+def topo_mirror_fused_union_step(level_starts: Tuple[int, ...], cap: int, n_tot: int):
+    """ONE-dispatch union burst (gate + single-pass sweep + finish fused):
+    the steady-state path when the mirror carries no level violations.
+
+    Through a remote-relay environment every dispatch costs ~a round trip
+    un-pipelined, so the split gate/sweep/finish pipeline (which exists so
+    MULTI-pass sweeps never recompile) pays 3-4 RTTs per lone wave. The
+    fused program pays one dispatch + one readback. Compiled per level
+    layout like the sweep itself — the mirror's warm-up covers it; patched
+    mirrors with violations (passes > 1) fall back to the split pipeline."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def burst(garrays: TopoGraphArrays, node_epoch0, perm_clipped, g_invalid, seed_new_ids):
+        is_real = garrays.is_real
+        blocked = (
+            jnp.where(is_real, g_invalid[perm_clipped], False)
+            .astype(jnp.int32)
+            .at[n_tot]
+            .set(0)
+        )
+        node_epoch = jnp.where(blocked.astype(bool), -3, node_epoch0)
+        seed_bits = (
+            jnp.zeros(n_tot + 1, jnp.int32).at[seed_new_ids].set(1).at[n_tot].set(0)
+        )
+        state, _ = _topo_sweep_impl(
+            level_starts, garrays, seed_bits,
+            TopoState(node_epoch, jnp.zeros(n_tot + 1, dtype=jnp.int32)), 0,
+        )
+        newly = state.invalid_bits.astype(bool) & is_real & ~g_invalid[perm_clipped]
+        count = newly.sum(dtype=jnp.int32)
+        pos = jnp.cumsum(newly.astype(jnp.int32)) - 1
+        scatter_pos = jnp.where(newly & (pos < cap), pos, cap)
+        ids = (
+            jnp.full(cap, -1, dtype=jnp.int32)
+            .at[scatter_pos]
+            .set(perm_clipped, mode="drop")
+        )
+        oob = g_invalid.shape[0]
+        g_invalid2 = g_invalid.at[jnp.where(newly, perm_clipped, oob)].set(
+            True, mode="drop"
+        )
+        return g_invalid2, count, ids, count > cap
+
+    return burst
+
+
+@functools.lru_cache(maxsize=8)
+def topo_mirror_fused_lanes_step(
+    level_starts: Tuple[int, ...], cap: int, n_tot: int, words: int
+):
+    """ONE-dispatch lane burst (gate + single-pass sweep + finish fused) —
+    see :func:`topo_mirror_fused_union_step` for why: the split pipeline
+    exists for multi-pass patched mirrors; at passes == 1 the fused program
+    saves 2-3 relay round trips per burst."""
+    import jax
+    import jax.numpy as jnp
+
+    W = words
+    L = 32 * W
+
+    @jax.jit
+    def burst(garrays: TopoGraphArrays, node_epoch0, perm_clipped, g_invalid, seed_new_ids):
+        is_real = garrays.is_real
+        blocked = (
+            jnp.where(is_real, g_invalid[perm_clipped], False)
+            .astype(jnp.int32)
+            .at[n_tot]
+            .set(0)
+        )
+        node_epoch = jnp.where(blocked.astype(bool), -3, node_epoch0)
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        word_of = lanes // 32
+        bit_of = jnp.left_shift(jnp.int32(1), lanes % 32)
+        flat = seed_new_ids * W + word_of[:, None]
+        vals = jnp.broadcast_to(bit_of[:, None], seed_new_ids.shape)
+        seed_bits = (
+            jnp.zeros((n_tot + 1) * W, jnp.int32)
+            .at[flat.ravel()]
+            .add(vals.ravel())
+            .reshape(n_tot + 1, W)
+            .at[n_tot]
+            .set(0)
+        )
+        state, _ = _topo_sweep_impl(
+            level_starts, garrays, seed_bits,
+            TopoState(node_epoch, jnp.zeros((n_tot + 1, W), dtype=jnp.int32)), 0,
+        )
+        newly_bits = jnp.where(
+            is_real[:, None] & ~g_invalid[perm_clipped][:, None],
+            state.invalid_bits, 0,
+        )
+        lane_counts = _lane_counts_blocked(newly_bits, W)
+        union = (newly_bits != 0).any(axis=1)
+        union_count = union.sum(dtype=jnp.int32)
+        pos = jnp.cumsum(union.astype(jnp.int32)) - 1
+        scatter_pos = jnp.where(union & (pos < cap), pos, cap)
+        ids = (
+            jnp.full(cap, -1, dtype=jnp.int32)
+            .at[scatter_pos]
+            .set(perm_clipped, mode="drop")
+        )
+        oob = g_invalid.shape[0]
+        g_invalid2 = g_invalid.at[jnp.where(union, perm_clipped, oob)].set(
+            True, mode="drop"
+        )
+        return g_invalid2, lane_counts, union_count, ids, union_count > cap
+
+    return burst
 
 
 @functools.lru_cache(maxsize=8)
@@ -396,15 +577,7 @@ def topo_mirror_finish_lanes_step(cap: int, n_tot: int, words: int):
         newly_bits = jnp.where(
             is_real[:, None] & ~g_invalid[perm_clipped][:, None], final_bits, 0
         )
-        # per-lane closure sizes: 32·W length-n reductions, fused by XLA —
-        # never a [n, 32] unpacked intermediate
-        lane_counts = jnp.stack(
-            [
-                ((newly_bits[:, w] >> b) & 1).sum(dtype=jnp.int32)
-                for w in range(W)
-                for b in range(32)
-            ]
-        )
+        lane_counts = _lane_counts_blocked(newly_bits, W)  # one-pass popcounts
         union = (newly_bits != 0).any(axis=1)
         union_count = union.sum(dtype=jnp.int32)
         pos = jnp.cumsum(union.astype(jnp.int32)) - 1

@@ -129,9 +129,11 @@ def _build_gated_lane_burst(mesh, cap: int, n_global: int, n_nodes: int, words: 
             .add(vals.ravel(), mode="drop")
             .reshape(n_global, W)
         )
-        seeds = lax.with_sharding_constraint(
-            jnp.where(blocked[:, None], 0, seeds), word_sh
-        )
+        # seeds CONDUCT even when already blocked (r4, the union rule —
+        # ops/wave.py::run_waves_union): a blocked row still can't RECEIVE
+        # (epoch -3), and the newly mask below excludes pre-blocked rows
+        # from counts, union, and writeback
+        seeds = lax.with_sharding_constraint(seeds, word_sh)
         node_epoch = lax.with_sharding_constraint(
             jnp.where(blocked, -3, node_epoch0), node_sh
         )
@@ -139,7 +141,7 @@ def _build_gated_lane_burst(mesh, cap: int, n_global: int, n_nodes: int, words: 
             seeds, in_src, edge_epoch, node_epoch, is_real,
             lax.with_sharding_constraint(jnp.zeros_like(seeds), word_sh),
         )
-        newly = jnp.where(is_real[:, None], inv, 0)
+        newly = jnp.where(is_real[:, None] & ~blocked[:, None], inv, 0)
         lane_counts = jnp.stack(
             [
                 ((newly[:, w] >> b) & 1).sum(dtype=jnp.int32)

@@ -117,10 +117,14 @@ def build_sharded_wave(mesh: Mesh, n_global: int, exchange: str = "packed"):
         check_vma=False,  # pallas interpret-mode lowering can't track vma
     )
     def _wave(seeds_l, esrc_l, edst_l, eepoch_l, nepoch_l, inv_l):
+        # seeds CONDUCT even when already invalid (r4, same rule as the
+        # single-chip union — ops/wave.py::run_waves_union: an uncascaded
+        # columnar mark's declared dependents live only in the graph);
+        # pre-invalid seeds don't count, invalid NON-seeds still block
         fresh = seeds_l & ~inv_l
-        inv_l = inv_l | fresh
+        inv_l = inv_l | seeds_l
         count0 = lax.psum(fresh.sum(dtype=jnp.int32), GRAPH_AXIS)
-        go0 = lax.psum(fresh.any().astype(jnp.int32), GRAPH_AXIS) > 0
+        go0 = lax.psum(seeds_l.any().astype(jnp.int32), GRAPH_AXIS) > 0
 
         def cond(carry):
             _f, _inv, _count, go = carry
@@ -136,7 +140,7 @@ def build_sharded_wave(mesh: Mesh, n_global: int, exchange: str = "packed"):
             newly = lax.psum(nxt_l.sum(dtype=jnp.int32), GRAPH_AXIS)
             return nxt_l, inv_l, count + newly, newly > 0
 
-        _f, inv_l, count, _go = lax.while_loop(cond, body, (fresh, inv_l, count0, go0))
+        _f, inv_l, count, _go = lax.while_loop(cond, body, (seeds_l, inv_l, count0, go0))
         return inv_l, nepoch_l, count
 
     @jax.jit

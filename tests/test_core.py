@@ -361,3 +361,44 @@ async def test_invalidation_delay_debounces(fresh_hub):
     node2 = await capture(lambda: svc.get())
     assert node2.invalidate(immediately=True) is True
     assert node2.is_invalidated
+
+
+async def test_hot_path_coherence_after_invalidate_and_collect():
+    """r4 memoized-hit fast path (per-service weakref hot cache): the hot
+    entry must never serve a stale value — invalidation, displacement, and
+    collection all fall through to the full path."""
+    import gc
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        class Svc(ComputeService):
+            def __init__(self, hub=None):
+                super().__init__(hub)
+                self.calls = 0
+                self.val = 1
+
+            @compute_method
+            async def get(self, k: int) -> int:
+                self.calls += 1
+                return self.val
+
+        svc = Svc(hub)
+        assert await svc.get(5) == 1
+        assert await svc.get(5) == 1 and svc.calls == 1  # hot hit
+        # invalidation: the hot entry's node reads inconsistent -> recompute
+        svc.val = 2
+        with invalidating():
+            await svc.get(5)
+        assert await svc.get(5) == 2 and svc.calls == 2
+        assert await svc.get(5) == 2 and svc.calls == 2  # hot again
+        # keyword-call coherence: kwargs route through the full path but
+        # share the same normalized cache slot
+        assert await svc.get(k=5) == 2 and svc.calls == 2
+        # collection: drop every strong ref, gc, fast path repopulates
+        node = await capture(lambda: svc.get(5))
+        del node
+        gc.collect()
+        assert await svc.get(5) == 2  # no crash; recompute or hit both fine
+    finally:
+        set_default_hub(old)

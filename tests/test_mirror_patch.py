@@ -106,13 +106,16 @@ def test_level_violating_edge_patches_with_extra_pass():
     assert count2 == 63
 
 
-def test_violation_chain_needs_passes_and_caps_at_three():
+def test_violation_chain_needs_passes_and_self_maintains():
     """A dependency path through V violating edges needs 1+V passes; past
-    3 violations the log breaks (rebuild is cheaper than 5+ passes)."""
-    # four parallel chains of 16; cross edges wire them tail -> head
-    g = DeviceGraph(node_capacity=64, edge_capacity=512)
-    g.add_nodes(64)
-    for c in range(4):
+    3 violations the mirror SELF-MAINTAINS (auto-starts the async
+    re-level, keeps serving with extra passes as the bridge); past the
+    hard cap of 8 the log breaks to the dense path."""
+    # four parallel chains of 16 (+ a DISCONNECTED fifth, 64..79, for the
+    # hard-cap leg); cross edges wire the four tail -> head
+    g = DeviceGraph(node_capacity=128, edge_capacity=512)
+    g.add_nodes(80)
+    for c in range(5):
         b = 16 * c
         g.add_edges(np.arange(b, b + 15), np.arange(b + 1, b + 16))
     g.build_topo_mirror()
@@ -129,12 +132,30 @@ def test_violation_chain_needs_passes_and_caps_at_three():
     c2, _ = g.run_waves_union([[0]])
     assert g._topo_mirror["passes"] == 4 and g.mirror_bursts == 2
     assert c2 == 16 + 15 + 15 + 15  # ...now 49..63 reachable via 47->49
-    # fourth breaks to the dense path (already-reached target: same count)
+    assert g._async_rebuild is None  # 3 violations: no maintenance yet
+    # fourth STILL patches (passes=5) and auto-starts the async re-level
+    # (15 -> 34: violating but acyclic — 34 is already downstream of 15)
     g.clear_invalid()
-    g.add_edges(np.array([47]), np.array([18]))
+    g.add_edges(np.array([15]), np.array([34]))
     c3, _ = g.run_waves_union([[0]])
-    assert g.mirror_bursts == 2  # dense served it
-    assert c3 == 16 + 15 + 15 + 15
+    assert g.mirror_bursts == 3 and g._topo_mirror["passes"] == 5
+    assert c3 == 16 + 15 + 15 + 15  # 34 was already reached
+    assert g._async_rebuild is not None, "self-maintenance did not start"
+    g._async_rebuild["thread"].join(30)
+    assert g.poll_topo_mirror_rebuild()
+    assert g._topo_mirror.get("passes", 1) == 1  # violations dissolved
+    g.clear_invalid()
+    c4, _ = g.run_waves_union([[0]])
+    assert c4 == c3 and g.mirror_bursts == 4
+    # hard cap: 9 violating edges into the disconnected fifth chain
+    # (63 -> 64..72: acyclic, and level(63) >= level(64+i) in ANY order
+    # that keeps the fifth chain at its own levels) break the log
+    g.clear_invalid()
+    for i in range(9):
+        g.add_edges(np.array([63]), np.array([64 + i]))
+    c5, _ = g.run_waves_union([[0]])
+    assert g.mirror_bursts == 4  # dense served it (log broke past 8)
+    assert c5 == c3 + 16  # the fifth chain is reachable now
 
 
 def test_in_degree_overflow_breaks():

@@ -394,3 +394,113 @@ async def test_command_to_remote_refetch_full_stack():
             await server.stop()
     finally:
         set_default_hub(old)
+
+
+def make_keyed_table(rows=32):
+    from stl_fusion_tpu.core.service import InternKeyCodec
+
+    db = {"alice": 1.0, "bob": 2.0, "carol": 3.0, ("acme", 7): 40.0, ("acme", 8): 41.0}
+    loads_count = [0]
+    codec = InternKeyCodec(rows)
+
+    def compute(ids):
+        loads_count[0] += len(ids)
+        out = []
+        for i in ids:
+            args = codec.decode(int(i))
+            key = args[0] if len(args) == 1 else args
+            out.append(db[key])
+        return np.array(out, dtype=np.float32)
+
+    table = MemoTable(rows, compute)
+    table.key_codec = codec
+    return table, db, loads_count
+
+
+async def test_remote_read_keys_server_codec_authoritative():
+    """VERDICT r3 #4: string AND composite keys resolve remotely — the
+    server interns unknown keys, the client learns the rows and reads
+    locally thereafter."""
+    server, client = await rpc_pair()
+    table, db, loads_count = make_keyed_table()
+    # server-side reads intern some keys FIRST: the client must adopt the
+    # server's layout, not invent its own
+    table.read_keys(["bob"])
+    RemoteTableHost(server).expose("users", table)
+    remote = RemoteTable(client, "default", "users")
+    try:
+        vals = await remote.read_keys(["alice", "bob", ("acme", 7)])
+        np.testing.assert_allclose(vals, [1.0, 2.0, 40.0])
+        assert remote.remote_reads == 1  # one RPC resolved all three
+        # layout matches the server codec (bob interned first → row 0)
+        assert remote._row_by_key["bob"] == 0
+        assert table.key_codec.peek(("alice",)) == remote._row_by_key["alice"]
+        # repeat keyed reads are LOCAL
+        reads_before = remote.remote_reads
+        vals = await remote.read_keys([("acme", 7), "alice"])
+        np.testing.assert_allclose(vals, [40.0, 1.0])
+        assert remote.remote_reads == reads_before
+    finally:
+        remote.dispose()
+        await client.stop()
+        await server.stop()
+
+
+async def test_remote_keyed_fence_refetches_only_fenced_key():
+    server, client = await rpc_pair()
+    table, db, loads_count = make_keyed_table()
+    RemoteTableHost(server).expose("users", table)
+    remote = RemoteTable(client, "default", "users")
+    try:
+        await remote.read_keys(["alice", "carol"])
+        db["alice"] = 11.0
+        table.invalidate_keys(["alice"])  # server-side keyed invalidation
+
+        async def fenced():
+            while remote.fences_seen == 0:
+                await asyncio.sleep(0.005)
+
+        await asyncio.wait_for(fenced(), 5.0)
+        reads_before = remote.remote_reads
+        vals = await remote.read_keys(["alice", "carol"])
+        np.testing.assert_allclose(vals, [11.0, 3.0])
+        assert remote.remote_reads == reads_before + 1  # one row refetched
+        assert await remote.read_keys(["carol"]) == [3.0]
+        assert remote.remote_reads == reads_before + 1  # carol stayed local
+    finally:
+        remote.dispose()
+        await client.stop()
+        await server.stop()
+
+
+async def test_remote_keyed_reconnect_relearns_layout():
+    """A reconnect clears the learned key→row map (a restarted server may
+    re-intern differently) and the next keyed read resolves fresh."""
+    server, client = await rpc_pair()
+    table, db, loads_count = make_keyed_table()
+    RemoteTableHost(server).expose("users", table)
+    remote = RemoteTable(client, "default", "users")
+    try:
+        await remote.read_keys(["alice"])
+        assert remote._row_by_key
+        peer = client.client_peer("default")
+
+        # sever the link; mutate while disconnected (fence push lost)
+        await peer.disconnect(ConnectionError("simulated drop"))
+        db["alice"] = 111.0
+        table.invalidate_keys(["alice"])
+
+        await peer.when_connected()
+
+        async def relearn_cleared():
+            while remote._row_by_key:
+                await asyncio.sleep(0.005)
+
+        await asyncio.wait_for(relearn_cleared(), 10.0)
+        vals = await asyncio.wait_for(remote.read_keys(["alice"]), 10.0)
+        np.testing.assert_allclose(vals, [111.0])
+        assert remote._row_by_key  # relearned
+    finally:
+        remote.dispose()
+        await client.stop()
+        await server.stop()

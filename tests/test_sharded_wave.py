@@ -325,9 +325,12 @@ async def test_sharded_bridge_resident_state_skips_full_sync():
         )
         assert backend.invalidate_cascade_batch_sharded([bases[4]]) == 3
         assert len(sync_calls) == 2
-        # the host-led mark was honored: base(3) reads as already invalid,
-        # and (dense rule) an already-invalid seed does NOT re-expand —
-        # run_wave's `fresh = seeds & ~invalid` gate
+        # the host-led mark was honored: base(3) reads as already invalid
+        # and doesn't COUNT — but (r4 conduct-all union rule) a marked seed
+        # still fires its dependents (a columnar mark's declared dependents
+        # exist only in the graph): top(3)+agg re-invalidate (safe
+        # over-invalidation, 2 newly), then the expansion is idempotent
+        assert backend.invalidate_cascade_batch_sharded([bases[3]]) == 2
         assert backend.invalidate_cascade_batch_sharded([bases[3]]) == 0
         assert len(sync_calls) == 2
     finally:
@@ -581,7 +584,10 @@ async def test_mesh_lane_burst_resident_blocked_state():
             np.asarray([backend.id_for(bases[2])], dtype=np.int32)
         )
         assert backend.invalidate_cascade_batch_lanes_sharded([[bases[3]]]).tolist() == [2]
-        # the host-led mark is honored as blocked
+        # the host-led mark is honored: the marked seed doesn't count, but
+        # (r4 conduct-all) it still fires its dependent chain — top(2)
+        # re-invalidates (safe over-invalidation), then idempotence holds
+        assert backend.invalidate_cascade_batch_lanes_sharded([[bases[2]]]).tolist() == [1]
         assert backend.invalidate_cascade_batch_lanes_sharded([[bases[2]]]).tolist() == [0]
     finally:
         set_default_hub(old)
