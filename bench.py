@@ -462,17 +462,16 @@ def run_live_section():
         os.path.dirname(os.path.abspath(__file__)), "perf", "live_path.py"
     )
     try:
+        # stdout captured (the JSON line); stderr INHERITED so the
+        # subprocess's progress notes land in the driver log even on success
         proc = subprocess.run(
-            [sys.executable, script], env=env, capture_output=True, text=True,
+            [sys.executable, script], env=env, stdout=subprocess.PIPE, text=True,
             timeout=3600,
         )
     except subprocess.TimeoutExpired:
         return {"error": "live path timed out"}
     if proc.returncode != 0:
-        return {
-            "error": f"live path failed rc={proc.returncode}",
-            "stderr_tail": proc.stderr[-2000:],
-        }
+        return {"error": f"live path failed rc={proc.returncode} (stderr inherited above)"}
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
