@@ -237,9 +237,21 @@ class DeviceGraph:
             return
         self._h_invalid[node_ids] = True
         self.invalid_version += 1
-        if self._g is not None and not self._dirty:
-            ids = self._jnp.asarray(self._pad_ids_pow2(node_ids))
-            self._g = self._g._replace(invalid=self._g.invalid.at[ids].set(True))
+        self._device_invalid_update(node_ids, True)
+
+    def _device_invalid_update(self, node_ids: np.ndarray, value: bool) -> None:
+        """Apply a host-side invalid-state change to the device copy. Small
+        batches scatter by (pow2-padded) ids; batches whose id payload
+        exceeds the full bool mask (ids are 4 B/entry, the mask 1 B/node)
+        upload the host-authoritative mask instead — a 10M-row refresh costs
+        11 MB, not 40 MB, through the relay."""
+        if self._g is None or self._dirty:
+            return
+        if node_ids.size * 4 > self.n_cap + 1:
+            self._g = self._g._replace(invalid=self._jnp.asarray(self._h_invalid))
+            return
+        ids = self._jnp.asarray(self._pad_ids_pow2(node_ids))
+        self._g = self._g._replace(invalid=self._g.invalid.at[ids].set(value))
 
     def clear_invalid_ids(self, node_ids: np.ndarray) -> None:
         """Refreshed rows are consistent again WITHOUT an epoch bump — the
@@ -251,9 +263,7 @@ class DeviceGraph:
             return
         self._h_invalid[node_ids] = False
         self.invalid_version += 1
-        if self._g is not None and not self._dirty:
-            ids = self._jnp.asarray(self._pad_ids_pow2(node_ids))
-            self._g = self._g._replace(invalid=self._g.invalid.at[ids].set(False))
+        self._device_invalid_update(node_ids, False)
 
     def _grow_nodes(self, need: int) -> None:
         new_cap = _round_up_pow2(need)
