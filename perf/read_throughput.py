@@ -124,6 +124,28 @@ class PlainUserService:
         self.dal.update_email(uid, email)
 
 
+def make_mutator(service, stop, read_first: bool = False):
+    """The shared 10 ms read-modify-write mutator every mode churns with
+    (one definition: the fence cadence PERF keys off must not diverge)."""
+
+    async def mutator():
+        rnd = random.Random(1)
+        count = 0
+        while not stop.is_set():
+            uid = rnd.randrange(USER_COUNT)
+            if read_first:
+                user = await service.get(uid)
+                assert user is not None
+            count += 1
+            await service.update_email(uid, f"{count}@counter.org")
+            try:
+                await asyncio.wait_for(stop.wait(), 0.01)
+            except asyncio.TimeoutError:
+                pass
+
+    return mutator
+
+
 async def run_scalar_hot(service, readers: int, iterations: int):
     """Harness-minimal scalar loop: PRECOMPUTED uid sequence (no per-op
     randrange — ~0.6 µs/op of pure-python harness in the parity loop above
@@ -132,18 +154,7 @@ async def run_scalar_hot(service, readers: int, iterations: int):
     reference's loop shape for comparability."""
     stop = asyncio.Event()
     ids = [(i * 7919) % USER_COUNT for i in range(min(iterations, 100_000))]
-
-    async def mutator():
-        rnd = random.Random(1)
-        count = 0
-        while not stop.is_set():
-            uid = rnd.randrange(USER_COUNT)
-            count += 1
-            await service.update_email(uid, f"{count}@counter.org")
-            try:
-                await asyncio.wait_for(stop.wait(), 0.01)
-            except asyncio.TimeoutError:
-                pass
+    mutator = make_mutator(service, stop)
 
     async def reader(count: int) -> int:
         ok = 0
@@ -174,20 +185,7 @@ async def run_scalar(service, readers: int, iterations: int, mutate: bool,
     while writes land on the server service)."""
     mut_svc = mutator_service or service
     stop = asyncio.Event()
-
-    async def mutator():
-        rnd = random.Random(1)
-        count = 0
-        while not stop.is_set():
-            uid = rnd.randrange(USER_COUNT)
-            user = await mut_svc.get(uid)
-            assert user is not None
-            count += 1
-            await mut_svc.update_email(uid, f"{count}@counter.org")
-            try:
-                await asyncio.wait_for(stop.wait(), 0.01)
-            except asyncio.TimeoutError:
-                pass
+    mutator = make_mutator(mut_svc, stop, read_first=True)
 
     async def reader(n: int, count: int) -> int:
         rnd = random.Random(n)

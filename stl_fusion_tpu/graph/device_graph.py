@@ -373,6 +373,10 @@ class DeviceGraph:
         if count or overflow:
             self.invalid_version += 1
         if overflow:
+            # the pack runs as its own dispatch (one extra RTT) — folding it
+            # into the wave/finish kernels' batched transfer would save it,
+            # at the cost of re-keying every compiled burst program; at
+            # ~0.1 s against a multi-second overflow round it stays separate
             packed = np.asarray(_pack_mask_kernel()(self._g.invalid))
             dev_mask = np.unpackbits(
                 packed.view(np.uint8), count=len(self._h_invalid), bitorder="little"
@@ -917,9 +921,14 @@ class DeviceGraph:
         return int(count)
 
     def _sync_invalid_back(self) -> None:
-        """After a device wave, the device invalid lane is newer — pull it."""
+        """After a device wave, the device invalid lane is newer — pull it
+        BIT-PACKED (1 bit/node through the per-byte-charged relay, same as
+        the overflow readback path)."""
         self.invalid_version += 1
-        self._h_invalid = np.array(self._g.invalid)  # writable copy
+        packed = np.asarray(_pack_mask_kernel()(self._g.invalid))
+        self._h_invalid = np.unpackbits(
+            packed.view(np.uint8), count=self.n_cap + 1, bitorder="little"
+        ).astype(bool)
 
     # ------------------------------------------------------------------ readback
     def invalid_mask(self) -> np.ndarray:
