@@ -224,7 +224,7 @@ async def main() -> None:
             lat_sub = np.maximum(lat_raw - chain_floor_ms, 0.0)
             stale = np.nonzero(table._stale_host)[0]
             if stale.size:
-                table.read_batch(stale)
+                table.refresh(stale)
             backend.flush()
 
         # -------- lane program warm (after latency: the big lane program
@@ -237,7 +237,7 @@ async def main() -> None:
         backend.cascade_rows_lanes(block, group_ids)  # fused lane program
         stale = np.nonzero(table._stale_host)[0]
         if stale.size:
-            table.read_batch(stale)
+            table.refresh(stale)
         backend.flush()
         # ALSO warm the split (multi-pass) pipeline variants: the first
         # level-violating churn edge flips passes to 2 and the split
@@ -250,7 +250,7 @@ async def main() -> None:
         m["passes"] = 1
         stale = np.nonzero(table._stale_host)[0]
         if stale.size:
-            table.read_batch(stale)
+            table.refresh(stale)
         backend.flush()
         lane_warm_s = time.perf_counter() - t0
         note(f"lane programs warm, fused + split ({lane_warm_s:.1f}s)")
@@ -284,7 +284,9 @@ async def main() -> None:
             stale = np.nonzero(table._stale_host)[0]
             t0 = time.perf_counter()
             if stale.size:
-                table.read_batch(stale)
+                table.refresh(stale)  # the recompute API: loader + scatter,
+                # no result gather (read_batch's per-size result slice would
+                # compile once per distinct stale count through the relay)
             backend.flush()
             churn_s += time.perf_counter() - t0
             churn_rows_total += int(stale.size)
@@ -312,7 +314,7 @@ async def main() -> None:
                 backend.cascade_rows_lanes(block, group_ids)
                 warm_stale = np.nonzero(table._stale_host)[0]
                 if warm_stale.size:
-                    table.read_batch(warm_stale)
+                    table.refresh(warm_stale)
                 backend.flush()
             m = gdev._topo_mirror
             if (
@@ -337,7 +339,7 @@ async def main() -> None:
         note("asserting lane ≡ oracle equivalence on the churned graph...")
         stale = np.nonzero(table._stale_host)[0]
         if stale.size:
-            table.read_batch(stale)
+            table.refresh(stale)
         backend.flush()
         gdev.clear_invalid()
         probe = group_ids[:: max(n_groups // 3, 1)][:3]
