@@ -35,7 +35,7 @@ import numpy as np
 
 from ..core.computed import Computed
 from ..core.hub import FusionHub
-from ..core.inputs import ComputeMethodInput
+from ..core.inputs import ComputeMethodInput, KwArgsTail
 from ..graph.device_graph import DeviceGraph
 from ..utils.ltag import LTag
 from ..utils.result import Result
@@ -344,6 +344,15 @@ class HubCheckpoint:
                         entry["s"], entry["m"])
             return None
         args = tuple(decode(entry["a"]))
+        if not (args and type(args[-1]) is KwArgsTail):  # already canonical
+            try:
+                # snapshots from before a key-normalization change store
+                # args under the OLD canonical form (e.g. a defaulted
+                # call's short tuple); re-normalizing keeps restored nodes
+                # reachable by post-restore reads instead of orphaning them
+                args = method_def.bind_args(service, args, {})
+            except Exception:  # noqa: BLE001 — legacy key: keep raw
+                pass
         function = method_def.get_function(service)
         input = ComputeMethodInput(method_def, service, args, function)
         existing = hub.registry.get(input)

@@ -14,7 +14,30 @@ if TYPE_CHECKING:
     from .computed import Computed
     from .function import FunctionBase
 
-__all__ = ["ComputedInput", "ComputeMethodInput"]
+__all__ = ["ComputedInput", "ComputeMethodInput", "KwArgsTail"]
+
+
+class KwArgsTail:
+    """Canonical keyword-argument tail of a cache key. Methods whose
+    signature cannot be replayed positionally (keyword-only params, ``*``/
+    ``**`` catch-alls) normalize to ``(*positional, KwArgsTail(sorted
+    kwargs))`` — hashable, order-canonical, and replayable by
+    :meth:`ComputeMethodInput.invoke_original` (a flat positional tuple
+    would TypeError on replay; r4 review)."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items: Tuple):
+        self.items = tuple(items)
+
+    def __eq__(self, other: object) -> bool:
+        return type(other) is KwArgsTail and self.items == other.items
+
+    def __hash__(self) -> int:
+        return hash(self.items)
+
+    def __repr__(self) -> str:
+        return f"**{dict(self.items)!r}"
 
 
 class ComputedInput:
@@ -56,8 +79,15 @@ class ComputeMethodInput(ComputedInput):
 
     async def invoke_original(self):
         """Call the user's method body (≈ InvokeOriginalFunction,
-        ComputeMethodInput.cs:32-45)."""
-        return await self.method_def.original(self.service, *self.args)
+        ComputeMethodInput.cs:32-45). A :class:`KwArgsTail` key tail —
+        produced by bind_args for signatures that cannot be replayed
+        positionally — is expanded back into keyword arguments."""
+        args = self.args
+        if args and type(args[-1]) is KwArgsTail:
+            return await self.method_def.original(
+                self.service, *args[:-1], **dict(args[-1].items)
+            )
+        return await self.method_def.original(self.service, *args)
 
     def __eq__(self, other: object) -> bool:
         return (
@@ -73,3 +103,24 @@ class ComputeMethodInput(ComputedInput):
     def __repr__(self) -> str:
         name = getattr(self.method_def, "name", "?")
         return f"{type(self.service).__name__}.{name}{self.args!r}"
+
+
+def _register_kwargs_tail_wire() -> None:
+    """KwArgsTail keys appear inside checkpointed node args (checkpoint/
+    stores ``input.args`` verbatim), so they must round-trip the wire."""
+    from ..utils.serialization import register_wire_type
+
+    def _retuple(v):
+        # wire decode turns tuples into lists; key values must re-tuple
+        # DEEPLY or the restored key is unhashable (r4 review)
+        return tuple(_retuple(x) for x in v) if isinstance(v, list) else v
+
+    register_wire_type(
+        KwArgsTail,
+        "KwArgsTail",
+        to_dict=lambda v: {"i": [list(item) for item in v.items]},
+        from_dict=lambda d: KwArgsTail((k, _retuple(val)) for k, val in d["i"]),
+    )
+
+
+_register_kwargs_tail_wire()

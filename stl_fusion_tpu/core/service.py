@@ -24,7 +24,7 @@ from typing import Any, Callable, Optional
 from .context import OPT_INVALIDATE_BIT, CallOptions, ComputeContext, get_current
 from .function import ComputeMethodFunction
 from .hub import FusionHub, default_hub
-from .inputs import ComputeMethodInput
+from .inputs import ComputeMethodInput, KwArgsTail
 from .options import ComputedOptions
 
 __all__ = [
@@ -138,18 +138,21 @@ class TableBacking:
     def covers(self, args: tuple) -> bool:
         """Could these call args EVER map to a table row? (A cheap shape
         check at node-creation time; the row itself resolves lazily at
-        invalidation time through the table's codec, which may intern the
-        key only after the node was created.)"""
+        invalidation time through ``row_for_args``, which is the authority
+        — including for normalized keys carrying a defaults tail.)"""
         if self.keys:
             return True
-        return len(args) == 1 and isinstance(args[0], int)
+        return len(args) >= 1 and isinstance(args[0], int)
 
 
 class ComputeMethodDef:
     """Per-method metadata + per-(hub) function cache
     (≈ ComputeMethodDef, Interception/ComputeMethodDef.cs)."""
 
-    __slots__ = ("original", "name", "options", "signature", "table", "_functions")
+    __slots__ = (
+        "original", "name", "options", "signature", "table", "_functions",
+        "_pos_defaults", "_n_required", "_hashable_defaults",
+    )
 
     def __init__(self, original: Callable, options: ComputedOptions,
                  table: Optional[TableBacking] = None):
@@ -159,6 +162,32 @@ class ComputeMethodDef:
         self.signature = inspect.signature(original)
         self.table = table
         self._functions: dict = {}
+        # defaults tail for kwargs-free normalization (bind_args): only for
+        # plain positional-or-keyword signatures. *args/**kwargs/keyword-
+        # only methods normalize through signature.bind into a positional
+        # prefix + KwArgsTail key (replayable — a flat positional tuple
+        # would TypeError at invoke_original; r4 review).
+        params = list(self.signature.parameters.values())[1:]  # drop self
+        simple = all(
+            p.kind is inspect.Parameter.POSITIONAL_OR_KEYWORD for p in params
+        )
+        self._pos_defaults = tuple(p.default for p in params) if simple else None
+        # syntax guarantees defaults are a contiguous tail, so "the tail
+        # from len(args) has no empty default" ⇔ len(args) ≥ required count
+        self._n_required = sum(
+            1 for p in params if p.default is inspect.Parameter.empty
+        )
+        # an UNHASHABLE default (b=[]) can never ride a cache key: keep the
+        # old raw-args identity for such methods instead of crashing every
+        # defaulted call at input-hash time (r4 review)
+        try:
+            hash(tuple(
+                p.default for p in params
+                if p.default is not inspect.Parameter.empty
+            ))
+            self._hashable_defaults = True
+        except TypeError:
+            self._hashable_defaults = False
 
     def get_function(self, service: Any) -> ComputeMethodFunction:
         hub = hub_of(service)
@@ -244,16 +273,42 @@ class ComputeMethodDef:
             return None
         codec = table.key_codec
         if codec is None:
-            return args[0] if len(args) == 1 and isinstance(args[0], int) else None
+            if len(args) == 1 and isinstance(args[0], int):
+                return args[0]
+            # normalized key of a defaulted method: (row, *defaults tail)
+            # still maps to its row — dropping it here would sever scalar→
+            # table invalidation coherence for every defaulted table method
+            # (r4 review)
+            d = self._pos_defaults
+            if (
+                d is not None
+                and len(d) > 1
+                and len(args) == len(d)
+                and isinstance(args[0], int)
+                and args[1:] == d[1:]
+            ):
+                return args[0]
+            return None
         return codec.peek(tuple(args))
 
     def args_for_row(self, row: int, table) -> Optional[tuple]:
         """Canonical call args for a row of ``table`` (the reverse map used
-        by table→scalar invalidation)."""
+        by table→scalar invalidation). Must return the NORMALIZED key —
+        scalar nodes of a defaulted method register under
+        ``(row, *defaults)``, so the short ``(row,)`` would miss them in
+        the registry (r4 review)."""
         if self.table is None or table is None:
             return None
         codec = table.key_codec
         if codec is None:
+            d = self._pos_defaults
+            if (
+                d is not None
+                and len(d) > 1
+                and self._n_required <= 1  # everything past the row defaults
+                and self._hashable_defaults
+            ):
+                return (int(row),) + d[1:]
             return (int(row),)
         return codec.decode(int(row))
 
@@ -268,13 +323,40 @@ class ComputeMethodDef:
         return store.get((id(hub_of(service)), self.name))
 
     def bind_args(self, service: Any, args: tuple, kwargs: dict) -> tuple:
-        """Normalize (args, kwargs) → canonical positional tuple so
-        ``get(x=1)`` and ``get(1)`` share one cache slot."""
-        if not kwargs:
+        """Normalize (args, kwargs) → one canonical cache key per logical
+        call, so ``get(x=1)``, ``get(1)`` and ``get(1, b=default)`` share
+        one slot (each shape keying its own node would let invalidation of
+        one leave the others stale — r4 review). Plain positional-or-
+        keyword signatures key a pure positional tuple (kwargs-free calls
+        append the precomputed defaults tail — no ``signature.bind`` on the
+        hot path); signatures with keyword-only or ``*``/``**`` params key
+        ``(*positional, KwArgsTail)``, which invoke_original can replay.
+        Calls omitting a REQUIRED argument pass through raw and fail at
+        invocation, like any call."""
+        d = self._pos_defaults
+        if not kwargs and d is not None:
+            if (
+                len(args) >= len(d)
+                or len(args) < self._n_required
+                or not self._hashable_defaults
+            ):
+                return args
+            return args + d[len(args):]
+        try:
+            bound = self.signature.bind(service, *args, **kwargs)
+        except TypeError:
+            # mis-shaped call: keep raw identity; invocation raises the
+            # same TypeError the direct call would
+            if kwargs:
+                return args + (KwArgsTail(sorted(kwargs.items())),)
             return args
-        bound = self.signature.bind(service, *args, **kwargs)
-        bound.apply_defaults()
-        return tuple(bound.arguments.values())[1:]  # drop self
+        if self._hashable_defaults:
+            bound.apply_defaults()  # unhashable defaults must never key
+        if d is not None:
+            return tuple(bound.arguments.values())[1:]  # drop self
+        pos = bound.args[1:]  # drop self
+        kw = bound.kwargs
+        return pos + ((KwArgsTail(sorted(kw.items())),) if kw else ())
 
 
 def _make_hot_evictor(hot: dict, key):
@@ -392,6 +474,17 @@ def compute_method(
                 key = input.args
                 ref = weakref.ref(existing, _make_hot_evictor(hot, key))
                 hot[key] = ref
+                if not kwargs and args != key:
+                    # the fast path probes by the RAW positional tuple; a
+                    # call omitting defaulted params normalizes to a longer
+                    # key (ADVICE r4) — alias the raw tuple to the same node
+                    # so such calls fast-path too. SOUND only kwargs-free:
+                    # the normalized key is then a pure function of the raw
+                    # tuple. Kwargs calls never alias (get(1, b=3) raw-keys
+                    # as (1,), which must stay free for the real get(1)) and
+                    # are excluded from the fast path by design — they pay
+                    # the slow path's registry probe, the documented cost.
+                    hot[args] = weakref.ref(existing, _make_hot_evictor(hot, args))
                 return value
             # the ambient computing node is the dependency-capture root —
             # except inside an invalidation replay, where no edges form.

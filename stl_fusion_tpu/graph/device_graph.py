@@ -206,12 +206,20 @@ class DeviceGraph:
             self._topo_mirror is not None and self._mirror_deltas is not None
         ) or self._rebuild_deltas is not None:
             # only LIVE-at-append edges exist for the mirror; dead-on-arrival
-            # edges (checkpoint loads with stale epochs) are invisible to it
-            live = np.broadcast_to(dst_epoch, dst.shape) == self._h_node_epoch[dst]
+            # edges (checkpoint loads with stale epochs) are invisible to it.
+            # Slice to the REAL batch [:k]: the incremental device-append
+            # branch above pow2-pads src/dst in place, and recording the pad
+            # repeats would inflate the delta log ~2x toward its break
+            # thresholds (duplicates are patch-time no-ops, but the log
+            # budget is what keeps churn on the patch path).
+            src_r, dst_r = src[:k], dst[:k]
+            # dst_epoch is already broadcast to dst.shape above (and the pad
+            # branch concatenates matching shapes), so a plain slice works
+            live = dst_epoch[:k] == self._h_node_epoch[dst_r]
             if live.all():
-                self._record_mirror_delta("add", (src.copy(), dst.copy()))
+                self._record_mirror_delta("add", (src_r.copy(), dst_r.copy()))
             elif live.any():
-                self._record_mirror_delta("add", (src[live].copy(), dst[live].copy()))
+                self._record_mirror_delta("add", (src_r[live].copy(), dst_r[live].copy()))
 
     def bump_epochs(self, node_ids: np.ndarray) -> None:
         """Nodes recomputed: new epoch ⇒ their stale in-edges go dead, and

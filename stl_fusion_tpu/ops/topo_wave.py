@@ -130,11 +130,13 @@ def build_topo_graph(
     sizes = np.bincount(level, minlength=int(level.max()) + 1 if n_tot_o else 1)
     padded = [(_quantize_level(int(s)) if quantize else int(s)) for s in sizes]
     n_tot = int(sum(padded))  # padded row-space size; null row at index n_tot
-    if quantize:
+    if quantize and n_tot:
         # quantize the TOTAL too (≤ ~3% tail of pure null rows): programs
         # keyed on n_tot (gate/finish/lane epilogues) survive rebuilds whose
         # level structure drifted — the expensive 512-lane popcount epilogue
-        # would otherwise recompile on every re-level
+        # would otherwise recompile on every re-level. (n_tot == 0 — an
+        # empty backend mirror — would shift by -1 here; the trivial graph
+        # needs no padding at all.)
         grain = max(256, (1 << (n_tot.bit_length() - 1)) // 32)
         n_tot = -(-n_tot // grain) * grain
 

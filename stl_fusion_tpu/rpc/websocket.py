@@ -135,6 +135,12 @@ class _WsAdapter:
                 # or every send() awaiting this batch hangs forever
                 self._fail(ConnectionError("transport closed"), self._inflight)
                 raise
+            except Exception as e:  # noqa: BLE001 — anything else that kills
+                # the loop (ws.send errors are handled inline above; this
+                # covers any other failure) must fail the popped batch too,
+                # or senders awaiting it hang forever on a dead task
+                self._fail(e, self._inflight)
+                raise
 
         def _fail(self, error: BaseException, futs: list) -> None:
             self._error = error

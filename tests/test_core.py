@@ -68,6 +68,97 @@ async def test_kwargs_normalize_to_same_key():
     assert svc.compute_count == 1
 
 
+async def test_defaulted_call_shapes_share_one_node():
+    """All call shapes of a defaulted method — omitted, positional,
+    keyword — must key ONE node (r4 review: asymmetric normalization gave
+    each shape its own node, so invalidating via one shape left the others
+    serving stale values forever)."""
+
+    class Defaulted(ComputeService):
+        def __init__(self, hub=None):
+            super().__init__(hub)
+            self.calls = 0
+
+        @compute_method
+        async def get(self, a: str, b: int = 3) -> int:
+            self.calls += 1
+            return len(a) + b
+
+    svc = Defaulted()
+    assert await svc.get("x") == 4
+    assert await svc.get("x", 3) == 4
+    assert await svc.get("x", b=3) == 4
+    assert await svc.get(a="x") == 4
+    assert svc.calls == 1  # one node serves every shape
+    # invalidating via one shape invalidates THE node other shapes read
+    with invalidating():
+        await svc.get("x", 3)
+    assert await svc.get("x") == 4
+    assert svc.calls == 2
+    # the raw-args alias keeps the omitted-default shape on the fast path
+    for _ in range(3):
+        await svc.get("x")
+    assert svc.calls == 2
+
+
+async def test_keyword_only_methods_replay_and_share_nodes():
+    """Keyword-only params can't be replayed positionally: the key carries
+    a KwArgsTail instead (r4 review — flat tuples raised TypeError at
+    invoke), and all call shapes still share one node."""
+
+    class KwOnly(ComputeService):
+        def __init__(self, hub=None):
+            super().__init__(hub)
+            self.calls = 0
+
+        @compute_method
+        async def get(self, a: str, *, b: int = 3) -> int:
+            self.calls += 1
+            return len(a) + b
+
+    svc = KwOnly()
+    assert await svc.get("x", b=3) == 4  # must not TypeError
+    assert await svc.get("x") == 4
+    assert await svc.get(a="x", b=3) == 4
+    assert svc.calls == 1
+    assert await svc.get("x", b=5) == 6  # different kwargs: its own node
+    assert svc.calls == 2
+    with invalidating():
+        await svc.get("x")
+    assert await svc.get("x", b=3) == 4
+    assert svc.calls == 3
+
+
+async def test_unhashable_default_keeps_raw_identity():
+    """A mutable default (b=[]) can never ride a cache key: such methods
+    keep raw-args identity instead of crashing at input-hash time."""
+
+    class Mutable(ComputeService):
+        def __init__(self, hub=None):
+            super().__init__(hub)
+            self.calls = 0
+
+        @compute_method
+        async def get(self, a: str, extra: list = []) -> int:  # noqa: B006
+            self.calls += 1
+            return len(a) + len(extra)
+
+    svc = Mutable()
+    assert await svc.get("x") == 1
+    assert await svc.get("x") == 1
+    assert svc.calls == 1
+
+
+async def test_kwargs_tail_wire_roundtrip_stays_hashable():
+    from stl_fusion_tpu.core.inputs import KwArgsTail
+    from stl_fusion_tpu.utils.serialization import decode, encode
+
+    tail = KwArgsTail((("ids", (1, (2, 3))), ("name", "x")))
+    back = decode(encode(tail))
+    assert back == tail
+    hash(back)  # deep re-tupled: must be hashable for restored keys
+
+
 async def test_invalidation_recomputes():
     svc = CounterService()
     assert await svc.get("a") == 0

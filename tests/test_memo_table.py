@@ -556,3 +556,43 @@ async def test_single_arg_tuple_valued_keys():
         assert float(np.asarray(table.read_keys([(3, 0)]))[0]) == 7.0
     finally:
         set_default_hub(old)
+
+
+async def test_defaulted_table_method_keeps_row_coherence():
+    """r4 review: a table-backed method with a defaulted extra param
+    normalizes its key to (row, *defaults) — row mapping and scalar→table
+    invalidation coherence must survive the longer key."""
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        TableBacking,
+        compute_method,
+        invalidating,
+        memo_table_of,
+    )
+
+    class Scaled(ComputeService):
+        def __init__(self, hub=None):
+            super().__init__(hub)
+            self.data = {i: float(i * 2) for i in range(16)}
+
+        def load(self, ids):
+            return np.asarray([self.data[int(i)] for i in ids], dtype=np.float32)
+
+        @compute_method(table=TableBacking(rows=16, batch="load"))
+        async def val(self, i: int, scale: float = 1.0) -> float:
+            return self.data[i] * scale
+
+    svc = Scaled()
+    table = memo_table_of(svc.val)
+    table.read_batch([5, 6])
+    svc.data[5] = 99.0
+    with invalidating():
+        await svc.val(5)  # normalized key (5, 1.0) must still map to row 5
+    out = np.asarray(table.read_batch([5, 6]))
+    np.testing.assert_allclose(out, [99.0, 12.0])
+    # reverse direction: table.invalidate must reach the LIVE scalar node
+    # registered under the normalized (row, *defaults) key
+    assert await svc.val(7) == 14.0
+    svc.data[7] = 50.0
+    table.invalidate([7])
+    assert await svc.val(7) == 50.0  # stale node was invalidated, recomputed

@@ -342,3 +342,17 @@ def test_bump_recapture_retires_and_recounts_violations():
         assert g._topo_mirror["n_viol"] == 1, cycle
         assert g._topo_mirror["passes"] == 2, cycle
     assert g.mirror_rebuilds == 1 and g.mirror_bursts == 7
+
+
+def test_add_edges_delta_records_unpadded_batch():
+    """ADVICE r4: the incremental device-append branch pow2-pads src/dst in
+    place; the mirror delta must record the REAL batch, not the padded one
+    (pad repeats inflate the log toward its break thresholds)."""
+    g = chain_graph()
+    g.device_arrays()  # materialize: the padded incremental append path runs
+    assert g._mirror_deltas == []
+    g.add_edges(np.array([1, 2, 3]), np.array([10, 20, 30]))  # pads to 4
+    assert len(g._mirror_deltas) == 1
+    kind, (src, dst) = g._mirror_deltas[0]
+    assert kind == "add"
+    assert src.tolist() == [1, 2, 3] and dst.tolist() == [10, 20, 30]

@@ -198,3 +198,19 @@ def test_multiword_packing_matches_oracle():
         assert got == expected, f"wave {i}: {len(got)} vs {len(expected)}"
         total += len(expected)
     assert count == total
+
+
+def test_empty_graph_builds_trivially():
+    """ADVICE r4: n_tot == 0 hit a negative shift in the total-quantization;
+    an empty backend (build_topo_mirror before any nodes) must get the
+    trivial graph, not a ValueError."""
+    g = build_topo_graph(np.empty(0, np.int32), np.empty(0, np.int32), 0)
+    assert g.n_tot == 0 and g.n_real == 0
+    assert g.level_starts == (0,) or g.level_starts == (0, 0)
+
+    from stl_fusion_tpu.graph.device_graph import DeviceGraph
+
+    dg = DeviceGraph()
+    dg.build_topo_mirror()  # no nodes yet: must not raise
+    counts, ids = dg.run_waves_lanes([[]])
+    assert counts.tolist() == [0] and ids.size == 0
