@@ -404,6 +404,16 @@ async def main() -> None:
             scalar_rate = scalar_nodes / (time.perf_counter() - t0)
             note(f"scalar path: {scalar_rate:,.0f} nodes/s")
 
+        # measurement-method prose lives in stderr notes, NEVER in the
+        # result JSON: the driver captures a bounded stdout tail, and r4's
+        # embedded method strings pushed the headline fields out of the
+        # window (VERDICT r4 weak #3 — "the canonical record is unparseable")
+        note(
+            "live_wave_ms method: each sample = one cascade_rows_batch([single "
+            "tail row]) on the live hub (RTT-inclusive); rtt_subtracted = "
+            "sample - median relay floor of the same dispatch shape; "
+            "CI = 95% bootstrap (1000 resamples) on the raw samples"
+        )
         result = {
             "metric": "live_path",
             "nodes": n,
@@ -432,13 +442,6 @@ async def main() -> None:
             ),
             "live_wave_ms_p99_ci": (
                 bootstrap_ci(lat_raw, 99) if lat_raw is not None else None
-            ),
-            "live_wave_ms_method": (
-                "each sample = one cascade_rows_batch([single tail row]) on the "
-                "live hub (RTT-inclusive); rtt_subtracted = sample - median relay "
-                "floor of the SAME dispatch shape (three dependent jitted calls "
-                "+ one readback — the gate/sweep/finish chain); CI = 95% "
-                "bootstrap (1000 resamples) on the raw samples"
             ),
             "relay_chain_floor_ms": round(chain_floor_ms, 1),
             # THE live headline: lane-packed bursts WITH churn interleaved
