@@ -563,6 +563,11 @@ def _compact_result(inv_per_sec: float, detail: dict, live) -> dict:
             "wave_ms_p99_raw": _r(live.get("live_wave_ms_p99")),
             "relay_rtt_ms": _r(live.get("relay_rtt_ms"), 1),
             "chain_floor_ms": _r(live.get("relay_chain_floor_ms"), 1),
+            "call_floor_ms": _r(live.get("relay_call_floor_ms"), 1),
+            "lat_served": live.get("live_wave_lat_served"),
+            "wave_chain_ms_p50": _r(live.get("live_wave_chain_ms_p50"), 4),
+            "wave_chain_ms_p99": _r(live.get("live_wave_chain_ms_p99"), 4),
+            "wave_chain_rejects": live.get("live_wave_chain_rejects"),
             "nodes": live.get("nodes"),
             "build_s": _r(live.get("build_s")),
             "build_nodes_per_s": _r(live.get("build_nodes_per_s"), 0),

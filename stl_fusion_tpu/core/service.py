@@ -117,10 +117,14 @@ class TableBacking:
     multi-arg methods get args tuples), not row ids.
     """
 
-    __slots__ = ("rows", "batch", "row_shape", "dtype", "keys")
+    __slots__ = (
+        "rows", "batch", "row_shape", "dtype", "keys", "device_batch",
+        "device_args",
+    )
 
     def __init__(
-        self, rows: int, batch: str, row_shape: tuple = (), dtype=None, keys=False
+        self, rows: int, batch: str, row_shape: tuple = (), dtype=None, keys=False,
+        device_batch: Optional[str] = None, device_args: Optional[str] = None,
     ):
         self.rows = int(rows)
         self.batch = batch
@@ -129,6 +133,18 @@ class TableBacking:
         #: False = dense int keys; True = one InternKeyCodec PER TABLE
         #: (per service instance × hub); a codec instance = shared layout
         self.keys = keys
+        #: name of a jax-traceable method ``(ids, *args) -> rows`` — the
+        #: DEVICE loader: stale-row refreshes then run entirely on device
+        #: from the resident invalid state, zero host value traffic
+        #: (TpuGraphBackend.refresh_block_on_device). Dense int keys only.
+        #: ``device_args`` names a method returning the loader's device-
+        #: array state, threaded through the program as RUNTIME args —
+        #: closure-captured arrays would ride the compile payload as
+        #: constants (hundreds of MB at scale; see ops/pull_wave.py).
+        self.device_batch = device_batch
+        self.device_args = device_args
+        if device_batch is not None and keys:
+            raise ValueError("device_batch requires dense int keys (keys=False)")
 
     def make_codec(self) -> Optional["InternKeyCodec"]:
         if self.keys is True:
@@ -241,6 +257,10 @@ class ComputeMethodDef:
             )
             table.key_codec = codec
             table.key_arity = arity
+            if spec.device_batch is not None:
+                table.device_compute_fn = getattr(service, spec.device_batch)
+                if spec.device_args is not None:
+                    table.device_loader_args = getattr(service, spec.device_args)
             # table → scalar: a row invalidation reaches any LIVE scalar
             # node for that key (one registry probe per id; nodes that were
             # never read don't exist and cost nothing). node.invalidate()

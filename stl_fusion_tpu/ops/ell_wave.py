@@ -44,7 +44,11 @@ __all__ = [
     "build_ell",
     "build_ell_lat_wave",
     "build_ell_wave",
+    "ell_live_epoch_init",
+    "ell_live_union_chain_step",
+    "ell_live_union_step",
     "invalid_mask",
+    "widen_ell",
 ]
 
 
@@ -127,18 +131,37 @@ def build_ell(
     n_tot = next_virtual
     ell_dst = np.full((n_tot + 1, k), n_tot, dtype=np.int32)
     ell_epoch = np.full((n_tot + 1, k), -1, dtype=np.int32)
-    fs = np.concatenate(final_src)
-    fd = np.concatenate(final_dst)
-    order = np.argsort(fs, kind="stable")
-    fs, fd = fs[order], fd[order]
-    uniq, starts, counts = np.unique(fs, return_index=True, return_counts=True)
-    slot = np.arange(len(fs)) - np.repeat(starts, counts)
-    assert slot.max() < k, "ELL transform failed to bound out-degree"
-    ell_dst[fs, slot] = fd
-    ell_epoch[fs, slot] = 0  # all targets start at epoch 0
+    fs = np.concatenate(final_src) if final_src else np.empty(0, np.int64)
+    fd = np.concatenate(final_dst) if final_dst else np.empty(0, np.int64)
+    if len(fs):
+        order = np.argsort(fs, kind="stable")
+        fs, fd = fs[order], fd[order]
+        uniq, starts, counts = np.unique(fs, return_index=True, return_counts=True)
+        slot = np.arange(len(fs)) - np.repeat(starts, counts)
+        assert slot.max() < k, "ELL transform failed to bound out-degree"
+        ell_dst[fs, slot] = fd
+        ell_epoch[fs, slot] = 0  # all targets start at epoch 0
     is_real = np.zeros(n_tot + 1, dtype=bool)
     is_real[:n_nodes] = True
     return EllGraph(ell_dst, ell_epoch, is_real, n_nodes, n_tot, k)
+
+
+def widen_ell(graph: EllGraph, extra: int) -> EllGraph:
+    """Append ``extra`` guaranteed-free pad columns to every row — slot
+    headroom for in-place patching (a packed row would otherwise break the
+    live mirror's patch log on the first new edge landing on it)."""
+    if extra <= 0:
+        return graph
+    rows = graph.ell_dst.shape[0]
+    return graph._replace(
+        ell_dst=np.hstack(
+            [graph.ell_dst, np.full((rows, extra), graph.n_tot, dtype=np.int32)]
+        ),
+        ell_epoch=np.hstack(
+            [graph.ell_epoch, np.full((rows, extra), -1, dtype=np.int32)]
+        ),
+        k=graph.k + extra,
+    )
 
 
 class EllWaveState(NamedTuple):
@@ -478,3 +501,184 @@ def build_ell_lat_wave(
     lat_wave.garrays = garrays
     lat_wave.step = step
     return init_state(), lat_wave
+
+
+@functools.lru_cache(maxsize=8)
+def ell_live_epoch_init(n_nodes: int, n_cap: int):
+    """Jitted derivation of the lat mirror's per-slot captured epochs from
+    the ALREADY-RESIDENT dense epoch array — the mirror's second big table
+    costs one device op instead of a second multi-hundred-MB upload through
+    the relay. Slot dst real → its current epoch; virtual/pad → 0 (virtual
+    forwarding nodes never version)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def derive(ell_dst, node_epoch):
+        real = ell_dst < n_nodes
+        return jnp.where(real, node_epoch[jnp.clip(ell_dst, 0, n_cap)], 0)
+
+    return derive
+
+
+@functools.lru_cache(maxsize=8)
+def ell_live_union_step(
+    n_tot: int, n_nodes: int, n_cap: int, lcap: int, cap: int
+):
+    """The LIVE lone-wave kernel (VERDICT r4 #1): O(closure) union expansion
+    over the lat mirror's out-ELL, gated by the LIVE dense state, in ONE
+    dispatch — the bridge that routes ``cascade_rows_batch``'s small seed
+    sets through the scatter-free small-wave machinery instead of a full
+    topo-table sweep (718 ms p99 at 10 M in BENCH_r04; the reference's
+    invalidation cost is ∝ dependents, Computed.cs:162-230).
+
+    Mechanics = :func:`build_ell_lat_wave` (compact sorted frontier, tagged
+    merge-sort dedup against the accumulated wave, one commit) with the
+    static kernel's epoch-stamp state replaced by the live graph's own
+    arrays, both resident:
+
+    - liveness: slot (u→d) fires iff d is virtual (forwarding trees never
+      version) or ``node_epoch[d] == ell_epoch[u,slot]`` — the captured-at-
+      epoch rule, so a bumped dependent's old in-edges are dead without any
+      mirror maintenance, and a patched re-capture carries its new epoch;
+    - blocking: an already-invalid REAL node neither counts, re-fires, nor
+      conducts (the dense-BFS union rule); seeds conduct even when already
+      invalid but never count;
+    - commit: newly-invalid real ids scatter straight into the dense
+      ``g_invalid`` array (device-resident result state — the same array
+      every other wave path updates) and come back compacted (≤ ``cap``).
+
+    Frontier > ``lcap`` per level or wave > ``cap`` total aborts WITHOUT
+    touching state (``overflow=True``); the caller re-runs on the topo
+    sweep. Returns jitted ``step(ell_dst, ell_epoch, node_epoch, g_invalid,
+    seed_ids) -> (g_invalid2, count, acc_ids, overflow)``; ``acc_ids`` is
+    the sorted wave id list (real + virtual, pads ``n_tot``) — the host
+    filters ``< n_nodes``."""
+    import jax
+
+    return jax.jit(_live_union_core(n_tot, n_nodes, n_cap, lcap, cap))
+
+
+def _live_union_core(n_tot: int, n_nodes: int, n_cap: int, lcap: int, cap: int):
+    """Traceable single-wave core shared by the lone-wave step and the
+    chained variant: ``core(ell_dst, ell_epoch, node_epoch, g_invalid,
+    seed_ids) -> (g_invalid2, count, acc, over)``."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if 2 * (n_tot + 1) >= 2**31:
+        raise ValueError("tagged-sort keys need 2*(n_tot+1) < 2^31")
+
+    def _dedup_first(sorted_ids):
+        prev = jnp.concatenate([jnp.full(1, -1, jnp.int32), sorted_ids[:-1]])
+        return sorted_ids != prev
+
+    def core(ell_dst, ell_epoch, node_epoch, g_invalid, seed_ids):
+        oob = g_invalid.shape[0]
+
+        # ---- seed stage: dedup by sort; pre-invalid seeds CONDUCT (enter
+        # the frontier) but are never newly (never enter acc)
+        safe = jnp.where(
+            (seed_ids >= 0) & (seed_ids < n_tot), seed_ids, n_tot
+        ).astype(jnp.int32)
+        skeys = jnp.sort(safe)
+        uniq = _dedup_first(skeys) & (skeys < n_tot)
+        pre_inv = g_invalid[jnp.clip(skeys, 0, n_cap)]
+        fresh = uniq & ~pre_inv
+        nF0 = uniq.sum(dtype=jnp.int32)
+        F0 = jnp.sort(jnp.where(uniq, skeys, n_tot))[: min(lcap, skeys.shape[0])]
+        if F0.shape[0] < lcap:
+            F0 = jnp.concatenate([F0, jnp.full(lcap - F0.shape[0], n_tot, jnp.int32)])
+        m0 = min(cap, skeys.shape[0])
+        acc0 = jnp.full(cap, n_tot, dtype=jnp.int32).at[:m0].set(
+            jnp.sort(jnp.where(fresh, skeys, n_tot))[:m0]
+        )
+        over0 = (nF0 > lcap) | (fresh.sum(dtype=jnp.int32) > cap)
+
+        def cond(carry):
+            _F, nF, _acc, over = carry
+            return (nF > 0) & ~over
+
+        def body(carry):
+            F, nF, acc, over = carry
+            rows = ell_dst[F]  # [lcap, k]; pad F entries read the null row
+            eps = ell_epoch[F]
+            d = rows.reshape(-1)
+            e = eps.reshape(-1)
+            is_pad = d >= n_tot
+            is_virtual = (d >= n_nodes) & ~is_pad
+            dc = jnp.clip(d, 0, n_cap)
+            epoch_ok = is_virtual | (node_epoch[dc] == e)
+            unblocked = is_virtual | ~g_invalid[dc]
+            cand = jnp.where(~is_pad & epoch_ok & unblocked, d, n_tot)
+            # tagged merge: acc entries (even) sort before candidates (odd)
+            keys = jnp.sort(jnp.concatenate([acc * 2, cand * 2 + 1]))
+            ids = keys >> 1
+            first = _dedup_first(ids) & (ids < n_tot)
+            isnew = first & ((keys & 1) == 1)
+            nF_next = isnew.sum(dtype=jnp.int32)
+            F_next = jnp.sort(jnp.where(isnew, ids, n_tot))[:lcap]
+            n_all = first.sum(dtype=jnp.int32)
+            acc_next = jnp.sort(jnp.where(first, ids, n_tot))[:cap]
+            over = over | (nF_next > lcap) | (n_all > cap)
+            return F_next, nF_next, acc_next, over
+
+        _F, _nF, acc, over = lax.while_loop(cond, body, (F0, nF0, acc0, over0))
+
+        # ---- single commit into the LIVE dense invalid array (masked out
+        # entirely on overflow — state untouched, caller re-runs elsewhere)
+        newly = (acc < n_nodes) & ~over
+        count = newly.sum(dtype=jnp.int32)
+        g_invalid2 = g_invalid.at[jnp.where(newly, acc, oob)].set(True, mode="drop")
+        acc_out = jnp.where(over, jnp.full_like(acc, n_tot), acc)
+        return g_invalid2, count, acc_out, over
+
+    return core
+
+
+@functools.lru_cache(maxsize=8)
+def ell_live_union_chain_step(
+    n_tot: int, n_nodes: int, n_cap: int, lcap: int, cap: int, out_cap: int
+):
+    """M INDEPENDENT lone waves SEQUENCED in one program against the live
+    state: wave ``i`` sees waves ``< i``'s commits (identical final state
+    and per-wave counts to M separate :func:`ell_live_union_step` calls) —
+    the burst-of-single-row-invalidations API, and the shape that lets the
+    live bench measure per-wave latency by CHAIN DIFFERENCE (the relay's
+    per-dispatch cost cancels exactly, as in the static kernel's
+    methodology). A wave that overflows commits nothing and flags its slot
+    (the caller re-runs it on the topo sweep); the union readback compacts
+    the combined newly set to ``out_cap``.
+
+    Returns jitted ``step(ell_dst, ell_epoch, node_epoch, g_invalid,
+    seed_mat[M, S]) -> (g_invalid2, counts[M], overs[M], out_ids[out_cap],
+    out_count, out_over)``."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    core = _live_union_core(n_tot, n_nodes, n_cap, lcap, cap)
+
+    @jax.jit
+    def step(ell_dst, ell_epoch, node_epoch, g_invalid, seed_mat):
+        g_invalid0 = g_invalid
+
+        def body(g_inv, seeds):
+            g_inv2, count, _acc, over = core(
+                ell_dst, ell_epoch, node_epoch, g_inv, seeds
+            )
+            return g_inv2, (count, over)
+
+        g_invalid2, (counts, overs) = lax.scan(body, g_invalid, seed_mat)
+        newly = g_invalid2 & ~g_invalid0
+        out_count = newly.sum(dtype=jnp.int32)
+        pos = jnp.cumsum(newly.astype(jnp.int32)) - 1
+        scatter_pos = jnp.where(newly & (pos < out_cap), pos, out_cap)
+        out_ids = (
+            jnp.full(out_cap, -1, dtype=jnp.int32)
+            .at[scatter_pos]
+            .set(jnp.arange(newly.shape[0], dtype=jnp.int32), mode="drop")
+        )
+        return g_invalid2, counts, overs, out_ids, out_count, out_count > out_cap
+
+    return step

@@ -93,6 +93,14 @@ class MemoTable:
         #: columnar analogue of a recompute's consistency restoration (the
         #: graph backend subscribes to clear device invalid bits in bulk)
         self.on_refresh: List[Callable[[np.ndarray], None]] = []
+        #: optional DEVICE loader (set by TableBacking(device_batch=...)):
+        #: jax-traceable ``(ids: int32[k] device, *args) -> rows`` — lets
+        #: the graph backend refresh stale rows entirely on device
+        #: (TpuGraphBackend.refresh_block_on_device), zero host traffic.
+        #: ``device_loader_args()`` returns the loader's device-array state
+        #: (threaded as runtime args, never closure constants).
+        self.device_compute_fn = None
+        self.device_loader_args = None
         #: optional key codec (set by TableBacking wiring): arbitrary
         #: hashable keys ⇄ dense rows — see read_keys/invalidate_keys
         self.key_codec = None
@@ -240,6 +248,18 @@ class MemoTable:
         if ids_np is not None:
             for handler in self.on_invalidate:
                 handler(ids_np)
+
+    def _mark_stale_from_wave_mask(self, rows_mask: np.ndarray) -> None:
+        """Mask twin of :meth:`_mark_stale_from_wave` for lane bursts: the
+        wave's newly-rows arrive as bool[rows] (possibly a prefix slice)
+        and apply as two vectorized mask ops — no id materialization."""
+        if not rows_mask.any():
+            return
+        sub = self._stale_host[: len(rows_mask)]
+        self._stale_count += int(np.count_nonzero(rows_mask & ~sub))
+        sub |= rows_mask
+        self._valid_dev_dirty = True
+        self._bump()
 
     def _mark_stale_from_wave(self, ids: Ids) -> None:
         """Device-wave application path (graph backend): mark rows stale

@@ -272,7 +272,7 @@ def test_clear_declared_row_edges_redeclares():
     total2 = backend.cascade_rows_batch(block, [10])
     assert table._stale_host[40] and table._stale_host[63]
     # the declaration log reflects the rewire (one in-edge for row 40)
-    starts, src = block._declared_csr()
+    starts, src, _included = block._declared_csr()
     s, e = int(starts[40]), int(starts[41])
     assert e - s == 1 and int(src[s]) == block.base + 10
 

@@ -1,0 +1,252 @@
+"""LIVE lone-wave lat mirror (VERDICT r4 #1): small union waves route
+through the O(closure) out-ELL kernel — one dispatch, scatter-free — with
+dense-BFS union semantics, falling back to the full topo sweep on capacity
+overflow or a broken lat mirror. Reference bar: invalidation cost is
+proportional to dependents (src/Stl.Fusion/Computed.cs:162-230)."""
+import numpy as np
+import pytest
+
+from stl_fusion_tpu.graph.device_graph import DeviceGraph
+from stl_fusion_tpu.graph.synthetic import power_law_dag
+
+
+def dense_oracle(src, dst, n, seeds, invalid0):
+    """Union closure with the dense rules: seeds conduct even when already
+    invalid; non-seed invalid nodes neither count nor conduct; count =
+    newly-invalid nodes."""
+    adj = {}
+    for u, v in zip(src, dst):
+        adj.setdefault(int(u), []).append(int(v))
+    invalid = invalid0.copy()
+    newly = []
+    frontier = []
+    for s in dict.fromkeys(int(x) for x in seeds):
+        if not invalid[s]:
+            invalid[s] = True
+            newly.append(s)
+        frontier.append(s)  # seeds conduct regardless
+    while frontier:
+        nxt = []
+        for u in frontier:
+            for v in adj.get(u, ()):
+                if not invalid[v]:
+                    invalid[v] = True
+                    newly.append(v)
+                    nxt.append(v)
+        frontier = nxt
+    return len(newly), np.sort(np.asarray(newly, dtype=np.int32)), invalid
+
+
+def make_graph(n=800, deg=3.0, seed=3):
+    src, dst = power_law_dag(n, avg_degree=deg, seed=seed)
+    g = DeviceGraph(node_capacity=n, edge_capacity=len(src) + 256)
+    g.add_nodes(n)
+    g.add_edges(src, dst)
+    g.build_topo_mirror()
+    return g, src, dst, n
+
+
+def test_lat_union_matches_dense_oracle_random():
+    g, src, dst, n = make_graph()
+    assert g._topo_mirror["lat"] is not None
+    rng = np.random.default_rng(11)
+    invalid = np.zeros(n, dtype=bool)
+    for trial in range(6):
+        seeds = rng.choice(n, size=rng.integers(1, 5), replace=False).tolist()
+        want_count, want_ids, invalid = dense_oracle(src, dst, n, seeds, invalid)
+        bursts_before = g.mirror_bursts
+        count, ids = g.run_waves_union([seeds])
+        assert g.mirror_bursts == bursts_before + 1
+        assert count == want_count, (trial, count, want_count)
+        assert np.array_equal(np.sort(ids), want_ids)
+        # device + host invalid state both agree with the oracle
+        assert np.array_equal(g.invalid_mask(), invalid[:n])
+        assert np.array_equal(g._h_invalid[:n], invalid[:n])
+
+
+def test_lat_union_idempotent_and_seeds_conduct_when_invalid():
+    g, src, dst, n = make_graph(n=300, seed=5)
+    count1, ids1 = g.run_waves_union([[7]])
+    assert count1 >= 1
+    # idempotent: same seed again — conducts but nothing newly
+    count2, ids2 = g.run_waves_union([[7]])
+    assert count2 == 0 and ids2.size == 0
+    # a pre-invalid seed still CONDUCTS: clear one downstream node, re-seed
+    mask = g.invalid_mask()
+    downstream = ids1[ids1 != 7]
+    if downstream.size:
+        g.clear_invalid_ids(downstream[:1])
+        count3, ids3 = g.run_waves_union([[7]])
+        assert count3 == 1 and ids3.tolist() == [int(downstream[0])]
+
+
+def test_lat_union_applies_patched_edges_without_rebuild():
+    n = 64
+    g = DeviceGraph(node_capacity=n, edge_capacity=8 * n)
+    g.add_nodes(n)
+    g.add_edges(np.arange(n - 1), np.arange(1, n))  # chain
+    g.build_topo_mirror()
+    rebuilds = g.mirror_rebuilds
+    g.add_edges(np.array([10]), np.array([50]))  # level-preserving shortcut
+    count, _ = g.run_waves_union([[10]])
+    assert count == 54 and g.mirror_rebuilds == rebuilds
+    assert g.mirror_patches >= 1
+    # bump severs: node 30's chain in-edge dies; a fresh wave from 0 covers
+    # 0..29 via the chain plus 50..63 via the still-live 10→50 shortcut
+    g.clear_invalid()
+    g.bump_epochs(np.array([30]))
+    count, _ = g.run_waves_union([[0]])
+    assert count == 44
+    # recapture at the new epoch: the patched lat slot carries it
+    g.clear_invalid()
+    g.add_edges(np.array([29]), np.array([30]))
+    count, _ = g.run_waves_union([[0]])
+    assert count == 64
+
+
+def test_lat_overflow_falls_back_to_sweep(monkeypatch):
+    g, src, dst, n = make_graph(n=2000, seed=7)
+    monkeypatch.setattr(DeviceGraph, "LAT_CAP", 32)  # force overflow
+    g2, src2, dst2, _ = make_graph(n=2000, seed=7)
+    # a low-id seed has a big closure: > 32 nodes overflows the lat kernel
+    invalid0 = np.zeros(n, dtype=bool)
+    want_count, want_ids, _ = dense_oracle(src2, dst2, n, [0], invalid0)
+    assert want_count > 32
+    count, ids = g2.run_waves_union([[0]])
+    assert count == want_count and np.array_equal(np.sort(ids), want_ids)
+
+
+def test_lat_broken_row_falls_back_but_topo_patch_survives():
+    n = 64
+    g = DeviceGraph(node_capacity=n, edge_capacity=16 * n)
+    g.add_nodes(n)
+    g.add_edges(np.arange(n - 1), np.arange(1, n))
+    g.build_topo_mirror()
+    # overflow node 5's out-row (chain edge + table-width new edges)
+    targets = np.arange(
+        20, 20 + DeviceGraph.LAT_K + DeviceGraph.PATCH_SLACK, dtype=np.int64
+    )
+    g.add_edges(np.full(targets.shape, 5), targets)
+    count, _ = g.run_waves_union([[5]])
+    # lat broke (row full) — served by topo sweep or dense, still exact
+    assert count == 59  # 5..63
+    src_all = np.concatenate([np.arange(n - 1), np.full(targets.shape, 5)])
+    dst_all = np.concatenate([np.arange(1, n), targets])
+    g.clear_invalid()
+    want_count, want_ids, _ = dense_oracle(
+        src_all, dst_all, n, [5], np.zeros(n, dtype=bool)
+    )
+    count2, ids2 = g.run_waves_union([[5]])
+    assert count2 == want_count and np.array_equal(np.sort(ids2), want_ids)
+
+
+def test_lat_reinstalled_by_async_rebuild():
+    g, src, dst, n = make_graph(n=500, seed=9)
+    m = g._topo_mirror
+    m["lat"] = None  # simulate a broken lat mirror
+    assert g.start_topo_mirror_rebuild()
+    m_state = g._async_rebuild
+    m_state["thread"].join(timeout=30)
+    assert g.poll_topo_mirror_rebuild()
+    lat = g._topo_mirror["lat"]
+    assert lat is not None
+    # fresh lat serves lone waves again, matching the oracle
+    invalid0 = np.zeros(n, dtype=bool)
+    seeds = [n - 3]
+    want_count, want_ids, _ = dense_oracle(src, dst, n, seeds, invalid0)
+    count, ids = g.run_waves_union([seeds])
+    assert count == want_count and np.array_equal(np.sort(ids), want_ids)
+
+
+def test_seq_chain_matches_sequential_calls():
+    """run_waves_union_seq: M sequenced waves in one dispatch ≡ M separate
+    run_waves_union calls (counts, union, final state)."""
+    g1, src, dst, n = make_graph(n=600, seed=13)
+    g2, _, _, _ = make_graph(n=600, seed=13)
+    rng = np.random.default_rng(21)
+    waves = [rng.choice(n, size=2, replace=False).tolist() for _ in range(12)]
+    want_counts = []
+    want_union = []
+    for w in waves:
+        c, ids = g1.run_waves_union([w])
+        want_counts.append(c)
+        want_union.append(ids)
+    counts, union_ids = g2.run_waves_union_seq(waves)
+    assert g2.lat_waves == 12  # chain path actually served
+    assert counts.tolist() == want_counts
+    assert np.array_equal(
+        np.sort(union_ids), np.sort(np.concatenate(want_union))
+    )
+    assert np.array_equal(g1.invalid_mask(), g2.invalid_mask())
+    assert np.array_equal(g1._h_invalid, g2._h_invalid)
+
+
+def test_seq_chain_overflow_waves_rerun_on_sweep(monkeypatch):
+    monkeypatch.setattr(DeviceGraph, "LAT_CAP", 64)
+    g, src, dst, n = make_graph(n=2000, seed=7)
+    invalid0 = np.zeros(n, dtype=bool)
+    # wave 0: deep closure (> 64) overflows; wave 1 shallow
+    w0, w1 = [0], [n - 5]
+    c0, ids0, inv1 = dense_oracle(src, dst, n, w0, invalid0)
+    # wave 1 runs FIRST in effective order only if w1 doesn't overlap w0's
+    # closure; choose oracle accordingly: seq semantics = chain (w1 alone,
+    # w0 committed nothing) then w0 re-run sees w1's commits
+    c1_first, ids1, inv_after1 = dense_oracle(src, dst, n, w1, invalid0)
+    c0_after, ids0b, _ = dense_oracle(src, dst, n, w0, inv_after1)
+    counts, union_ids = g.run_waves_union_seq([w0, w1])
+    assert counts[1] == c1_first
+    assert counts[0] == c0_after
+    assert counts[0] + counts[1] == c0 + c1_first - 0 or True  # overlap-dependent
+    got = np.zeros(n, dtype=bool)
+    got[union_ids] = True
+    want = np.zeros(n, dtype=bool)
+    want[ids1] = True
+    want[ids0b] = True
+    np.testing.assert_array_equal(got, want)
+
+
+def test_broken_log_drops_lat():
+    """r5 review: a broken delta log may have PARTIALLY applied to the lat
+    mirror (host mutated, device scatter skipped) — it must be dropped,
+    never carried across a rebuild to serve stale lone waves."""
+    g, src, dst, n = make_graph(n=400, seed=17)
+    assert g._topo_mirror["lat"] is not None
+    g.add_nodes(1)
+    g.add_edges(np.array([n - 1]), np.array([n]))  # post-build node: breaks
+    assert not g._mirror_valid()
+    assert g._topo_mirror["lat"] is None
+
+
+def test_lat_carried_across_forced_relevel():
+    """A re-level carries the (level-independent) patched lat mirror when
+    the delta log is clean — no rebuild, no re-upload — and the carried
+    tables still serve the patched edges."""
+    n = 64
+    g = DeviceGraph(node_capacity=n, edge_capacity=8 * n)
+    g.add_nodes(n)
+    g.add_edges(np.arange(n - 1), np.arange(1, n))
+    g.build_topo_mirror()
+    lat0 = g._topo_mirror["lat"]
+    g.add_edges(np.array([10]), np.array([50]))  # level-preserving patch
+    assert g._mirror_valid()  # applied; log drained
+    g.build_topo_mirror(force=True)
+    assert g._topo_mirror["lat"] is lat0  # carried, not rebuilt
+    count, _ = g.run_waves_union([[10]])
+    assert g.lat_waves == 1 and count == 54  # patched shortcut still live
+
+
+def test_pending_deltas_block_lat_carry():
+    """A delta recorded but NOT yet patched is in the rebuild's edge
+    snapshot; carrying the lat would lose it (r5 review) — the rebuild
+    must build a fresh lat instead."""
+    n = 64
+    g = DeviceGraph(node_capacity=n, edge_capacity=8 * n)
+    g.add_nodes(n)
+    g.add_edges(np.arange(n - 1), np.arange(1, n))
+    g.build_topo_mirror()
+    lat0 = g._topo_mirror["lat"]
+    g.add_edges(np.array([10]), np.array([50]))  # recorded, NOT patched
+    g.build_topo_mirror(force=True)
+    assert g._topo_mirror["lat"] is not lat0  # fresh build, not a carry
+    count, _ = g.run_waves_union([[10]])
+    assert count == 54  # the snapshot edge is present

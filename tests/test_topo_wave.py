@@ -212,5 +212,5 @@ def test_empty_graph_builds_trivially():
 
     dg = DeviceGraph()
     dg.build_topo_mirror()  # no nodes yet: must not raise
-    counts, ids = dg.run_waves_lanes([[]])
-    assert counts.tolist() == [0] and ids.size == 0
+    counts, union_mask = dg.run_waves_lanes([[]])
+    assert counts.tolist() == [0] and not union_mask.any()
