@@ -48,6 +48,9 @@ def _setup_jax_cache() -> None:
     import jax
 
     cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    os.environ.setdefault(
+        "FUSION_MIRROR_CACHE", os.path.join(os.path.dirname(cache), ".fusion_mirror_cache")
+    )
     try:
         jax.config.update("jax_compilation_cache_dir", cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -618,6 +618,60 @@ class TpuGraphBackend:
                 h(ids_np)
         return n_cleared
 
+    def warm_block_on_device(self, block: RowBlock) -> int:
+        """Load EVERY row of a bound table through its DEVICE loader in one
+        dispatch — the cold-start warm. The host-loader alternative
+        (chunked ``read_batch``) computes on host and ships all values
+        through the relay (~40 MB at 10M rows). Graph invalid state is
+        untouched (a fresh table has nothing invalid to clear)."""
+        table = block.table
+        fn = table.device_compute_fn
+        if fn is None:
+            raise TypeError(
+                "table has no device loader — declare "
+                "TableBacking(device_batch=...) or warm via read_batch()"
+            )
+        if block.n_rows != table.n_rows:
+            raise ValueError("warm_block_on_device requires a FULL table bind")
+        if self.graph._h_invalid[block.base : block.end()].any():
+            # outstanding graph invalid marks: warming would zero table
+            # staleness while the dense/device invalid bits stayed set,
+            # silently pre-blocking those rows in later bursts (r5 review)
+            raise RuntimeError(
+                "block has outstanding invalid marks — use "
+                "refresh_block_on_device() (warm is for cold tables)"
+            )
+        loader_args = (
+            tuple(table.device_loader_args())
+            if table.device_loader_args is not None
+            else ()
+        )
+        prog = block._dev_refresh.get("warm")
+        if prog is None:
+            import jax
+            import jax.numpy as jnp
+
+            n_rows = block.n_rows
+
+            @jax.jit
+            def prog(*largs):
+                ids = jnp.arange(n_rows, dtype=jnp.int32)
+                return fn(ids, *largs), jnp.ones(n_rows, dtype=jnp.bool_)
+
+            block._dev_refresh["warm"] = prog
+        table._values, table._valid_dev = prog(*loader_args)
+        table._valid_dev_dirty = False
+        n_stale = table._stale_count
+        table._stale_host[:] = False
+        table._stale_count = 0
+        table._bump()
+        extern = [h for h in table.on_refresh if not getattr(h, "_backend_hook", False)]
+        if extern:
+            all_ids = np.arange(block.n_rows, dtype=np.int32)
+            for h in extern:
+                h(all_ids)
+        return n_stale
+
     def cascade_rows_batch_seq(self, block: RowBlock, row_batches) -> np.ndarray:
         """M :meth:`cascade_rows_batch` calls in ONE device dispatch, each
         batch cascading against the state the previous batches left
@@ -944,15 +998,17 @@ class TpuGraphBackend:
         return count
 
     def packed_mirror(self, mesh=None) -> dict:
-        """Fingerprint-cached packed mesh mirror of the LIVE edge set — the
-        multi-chip lane-burst bridge (PackedShardedGraph over the currently
-        live, epoch-matched edges + a device-resident blocked mask mirroring
-        the invalid state). Rebuilt when the live-edge fingerprint changes;
-        the blocked mask re-syncs from the dense state only after host-led
-        invalid-state changes (same invalid_version protocol as the union
-        bridge)."""
-        import jax
-
+        """Packed mesh mirror of the LIVE edge set — the multi-chip
+        lane-burst bridge (PackedShardedGraph over the currently live,
+        epoch-matched edges + a device-resident blocked mask mirroring the
+        invalid state). Structural churn PATCHES the mesh tables in place
+        from the graph's ordered delta stream (VERDICT r4 #4 — the r4
+        mirror rebuilt on ANY bump/append): bumps scatter the mesh's
+        rebased epochs (the pull kernel has no level order, so no
+        violations exist), adds splice into slack slots; only slot
+        overflow, unknown nodes, or a broken log rebuild. The blocked mask
+        re-syncs from the dense state only after host-led invalid-state
+        changes (same invalid_version protocol as the union bridge)."""
         from ..parallel.packed_wave import PackedShardedGraph
         from .device_graph import check_structure_cache
 
@@ -966,21 +1022,73 @@ class TpuGraphBackend:
                 cached_ref is None if mesh is None
                 else cached_ref is not None and cached_ref() is mesh
             )
-            if same_mesh and check_structure_cache(
-                cached, sv, lambda: dg._live_edge_fingerprint()[2]
-            ):
-                return cached
+            if same_mesh:
+                if cached["validated_at"] == sv:
+                    return cached
+                aux = cached["aux_log"]
+                if not aux["broken"] and self._try_patch_packed(cached, aux):
+                    cached["validated_at"] = sv
+                    return cached
+                if cached["fp"] is not None and check_structure_cache(
+                    cached, sv, lambda: dg._live_edge_fingerprint()[2]
+                ):
+                    return cached
+        if cached is not None:
+            dg.drop_aux_delta_log(cached["aux_log"])
         src, dst, fp = dg._live_edge_fingerprint()
-        pg = PackedShardedGraph(src, dst, dg.n_nodes, mesh=mesh)
+        pg = PackedShardedGraph(
+            src, dst, dg.n_nodes, mesh=mesh, slack=dg.PATCH_SLACK
+        )
         self._packed_mirror = {
             "fp": fp,
             "validated_at": sv,
             "mesh_ref": weakref.ref(mesh) if mesh is not None else None,
             "graph": pg,
             "blocked": pg.put_blocked(),
+            # epochs on the mesh are REBASED to 0 at build; deltas carry
+            # absolute epochs and translate through this base
+            "epoch_base": dg._h_node_epoch[: dg.n_nodes].copy(),
+            "aux_log": dg.register_aux_delta_log(),
             # absent invalid_version ⇒ next burst full-syncs from dense
         }
         return self._packed_mirror
+
+    def _try_patch_packed(self, entry: dict, aux: dict) -> bool:
+        """Replay the recorded structural deltas onto the mesh mirror in
+        order. Returns False (and breaks the log) on anything the in-place
+        path can't absorb — the caller rebuilds."""
+        deltas = aux["deltas"]
+        if not deltas:
+            return True
+        pg = entry["graph"]
+        base = entry["epoch_base"]
+        n = pg.n_nodes
+        for kind, payload in deltas:
+            if kind == "bump":
+                ids = np.asarray(payload, dtype=np.int64)
+                ids = ids[ids < n]
+                if ids.size:
+                    # the first in-place mutation invalidates the BUILD
+                    # fingerprint forever: a later failed replay must never
+                    # let the fp path revalidate half-patched tables (r5
+                    # review — the dense graph's cumulative no-op churn can
+                    # restore the build fp while the mesh sits mid-replay)
+                    entry["fp"] = None
+                    pg.patch_bumps(ids)
+            else:
+                u, v, ep = payload
+                u64 = np.asarray(u, dtype=np.int64)
+                v64 = np.asarray(v, dtype=np.int64)
+                if u64.size and (int(u64.max()) >= n or int(v64.max()) >= n):
+                    aux["broken"] = True  # nodes born after the build
+                    return False
+                ep_rel = np.asarray(ep, dtype=np.int64) - base[v64]
+                entry["fp"] = None
+                if not pg.patch_adds(u64, v64, ep_rel):
+                    aux["broken"] = True  # slot overflow
+                    return False
+        aux["deltas"] = []
+        return True
 
     def invalidate_cascade_batch_lanes_sharded(
         self, groups: Sequence[Sequence["Computed"]], mesh=None

@@ -36,6 +36,24 @@ from .mesh import GRAPH_AXIS, graph_mesh
 __all__ = ["PackedShardedGraph", "build_packed_sharded_wave"]
 
 
+@functools.lru_cache(maxsize=1)
+def _patch_scatter_add():
+    @jax.jit
+    def f(arr, ids):
+        return arr.at[ids].add(1, mode="drop")  # pads index OOB → dropped
+
+    return f
+
+
+@functools.lru_cache(maxsize=1)
+def _patch_row_scatter():
+    @jax.jit
+    def f(t1, t2, rows, v1, v2):
+        return t1.at[rows].set(v1), t2.at[rows].set(v2)
+
+    return f
+
+
 def build_packed_sharded_wave(mesh: Mesh):
     """Compile the packed sharded kernel for a mesh.
 
@@ -177,20 +195,28 @@ class PackedShardedGraph:
         mesh: Optional[Mesh] = None,
         k: int = 8,
         words: int = 1,
+        slack: int = 0,
     ):
         # build_pull_graph = build_ell on reversed edges, which routes
         # through the native packer itself — one packer path to maintain
+        from ..ops.ell_wave import widen_ell
         from ..ops.pull_wave import build_pull_graph
 
         self.mesh = mesh or graph_mesh()
         n_dev = self.mesh.devices.size
 
         ell = build_pull_graph(edges_src, edges_dst, n_nodes, k=k)
+        if slack:
+            # guaranteed-free in-slots per row: the LIVE mesh mirror
+            # patches structural churn in place (VERDICT r4 #4), and a
+            # packed row would break the patch on its first new in-edge
+            ell = widen_ell(ell, slack)
         in_src, n_tot = ell.ell_dst, ell.n_tot
         self.n_nodes = n_nodes
         self.n_tot = n_tot
-        self.k = k
+        self.k = ell.k
         self.words = words
+        self.patches = 0  # in-place structural patches absorbed
         # pad rows to the mesh grid; pads are inert (epoch -1 slots)
         self.n_local = max(-(-(n_tot + 1) // n_dev), 1)
         self.n_global = self.n_local * n_dev
@@ -205,6 +231,7 @@ class PackedShardedGraph:
                 f"use ShardedDeviceGraph (one wave per pass) at this scale"
             )
 
+        k = self.k
         rows = np.full((self.n_global, k), n_tot, dtype=np.int32)
         rows[: n_tot + 1] = in_src
         edge_epoch = np.full((self.n_global, k), -1, dtype=np.int32)
@@ -220,6 +247,12 @@ class PackedShardedGraph:
         self.edge_epoch = jax.device_put(edge_epoch, sh2)
         self.node_epoch = jax.device_put(node_epoch, sh)
         self.is_real = jax.device_put(is_real, sh)
+        # host patch-truth copies (REAL copies — the device_put above may
+        # alias the numpy buffers zero-copy on the CPU backend, and these
+        # mutate in place during patching)
+        self.h_in_src = rows.copy()
+        self.h_edge_epoch = edge_epoch.copy()
+        self.h_node_epoch = node_epoch.copy()
         self._word_sharding = sh2
         self._zero_words = jax.device_put(
             np.zeros((self.n_global, words), dtype=np.int32), sh2
@@ -228,6 +261,67 @@ class PackedShardedGraph:
         self._wave = build_packed_sharded_wave(self.mesh)
         self._chain = None  # compiled lazily per batch shape
         self._gated_lanes: dict = {}  # (cap, words) → jitted gated burst
+
+    # ------------------------------------------------------------------ patching
+    def patch_bumps(self, node_ids: np.ndarray) -> None:
+        """Recomputed nodes (RELATIVE epoch convention: the mesh mirror
+        rebases epochs to 0 at build; the owner translates): +1 kills all
+        live in-edges of those rows — the mesh pull kernel has NO level
+        order, so a bump is just an epoch scatter, never a re-level."""
+        ids = np.unique(np.asarray(node_ids, dtype=np.int64))
+        if ids.size == 0:
+            return
+        self.h_node_epoch[ids] += 1
+        width = max(256, 1 << int(len(ids) - 1).bit_length())
+        padded = np.full(width, self.n_global, dtype=np.int64)  # OOB → drop
+        padded[: len(ids)] = ids
+        self.node_epoch = _patch_scatter_add()(
+            self.node_epoch, jnp.asarray(padded)
+        )
+        self.patches += 1
+
+    def patch_adds(
+        self, u64: np.ndarray, v64: np.ndarray, ep_rel: np.ndarray
+    ) -> bool:
+        """Splice new in-edges (u → v at RELATIVE captured epoch) into free
+        row slots, vectorized like the single-chip mirror's patcher. The
+        mesh kernel iterates BFS to fixpoint, so there are no level
+        violations — only slot overflow (returns False: caller rebuilds).
+        """
+        if u64.size == 0:
+            return True
+        hd, he = self.h_in_src, self.h_edge_epoch
+        pad = self.n_tot
+        dup = ((hd[v64] == u64[:, None]) & (he[v64] == ep_rel[:, None])).any(axis=1)
+        u, v, e = u64[~dup], v64[~dup], ep_rel[~dup]
+        if u.size == 0:
+            return True
+        order = np.lexsort((e, u, v))
+        u, v, e = u[order], v[order], e[order]
+        first = np.ones(len(u), dtype=bool)
+        first[1:] = (v[1:] != v[:-1]) | (u[1:] != u[:-1]) | (e[1:] != e[:-1])
+        u, v, e = u[first], v[first], e[first]
+        idx = np.arange(len(v))
+        grp_start = np.ones(len(v), dtype=bool)
+        grp_start[1:] = v[1:] != v[:-1]
+        rank = idx - np.maximum.accumulate(np.where(grp_start, idx, 0))
+        free_cum = (hd[v] == pad).cumsum(axis=1)
+        need = rank + 1
+        if (free_cum[:, -1] < need).any():
+            return False  # in-row overflow: cheaper to rebuild
+        slot = (free_cum == need[:, None]).argmax(axis=1)
+        hd[v, slot] = u
+        he[v, slot] = e
+        rows = np.unique(v)
+        width = max(256, 1 << int(len(rows) - 1).bit_length())
+        q = np.full(width, self.n_global - 1, dtype=np.int64)
+        q[: len(rows)] = rows  # pad rows rewrite their own current contents
+        self.in_src, self.edge_epoch = _patch_row_scatter()(
+            self.in_src, self.edge_epoch, jnp.asarray(q),
+            jnp.asarray(hd[q]), jnp.asarray(he[q]),
+        )
+        self.patches += 1
+        return True
 
     # ------------------------------------------------------------------ waves
     def seeds_to_bits(self, seed_ids_per_wave: Sequence[Sequence[int]]) -> np.ndarray:

@@ -384,3 +384,63 @@ def test_hot_cache_evicts_collected_entries():
             set_default_hub(old)
 
     asyncio.run(run())
+
+
+async def test_device_loader_warm_and_refresh():
+    """r5: TableBacking(device_batch=...) — cold-start warm and stale-row
+    recompute run entirely on device (loader state as runtime args), with
+    host bookkeeping matching the host-path semantics."""
+    import jax.numpy as jnp
+
+    from stl_fusion_tpu.core import TableBacking, compute_method, memo_table_of
+
+    n = 64
+
+    class DevSvc(ComputeService):
+        def __init__(self, hub=None):
+            super().__init__(hub)
+            self.base = np.arange(n, dtype=np.float32)
+            self._dev = jnp.asarray(self.base)
+
+        def load(self, ids):
+            return self.base[np.asarray(ids, dtype=np.int64)]
+
+        def load_dev(self, ids, base_dev):
+            return base_dev[ids] * 2.0
+
+        def dev_args(self):
+            return (self._dev,)
+
+        @compute_method(
+            table=TableBacking(
+                rows=n, batch="load", device_batch="load_dev", device_args="dev_args"
+            )
+        )
+        async def val(self, i: int) -> float:
+            return float(self.base[i])
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        backend = TpuGraphBackend(hub, node_capacity=n, edge_capacity=8 * n)
+        svc = DevSvc(hub)
+        hub.add_service(svc)
+        table = memo_table_of(svc.val)
+        block = backend.bind_table_rows(table)
+        backend.declare_row_edges(block, np.arange(n - 1), block, np.arange(1, n))
+        assert backend.warm_block_on_device(block) == n
+        assert table.stale_count() == 0
+        np.testing.assert_allclose(np.asarray(table.values), svc.base * 2.0)
+        # cascade marks rows stale; the device refresh recomputes them
+        svc._dev = jnp.asarray(svc.base + 100.0)
+        total = backend.cascade_rows_batch(block, [50])
+        assert total == 14 and table.stale_count() == 14
+        assert backend.refresh_block_on_device(block) == 14
+        assert table.stale_count() == 0
+        vals = np.asarray(table.values)
+        np.testing.assert_allclose(vals[:50], svc.base[:50] * 2.0)  # untouched
+        np.testing.assert_allclose(vals[50:], (svc.base[50:] + 100.0) * 2.0)
+        assert not backend.graph.invalid_mask().any()  # device state cleared
+        assert not backend.graph._h_invalid.any()
+    finally:
+        set_default_hub(old)

@@ -362,3 +362,41 @@ def test_add_edges_delta_records_unpadded_batch():
     assert kind == "add"
     assert src.tolist() == [1, 2, 3] and dst.tolist() == [10, 20, 30]
     assert eps.tolist() == [0, 0, 0]  # captured epochs ride the delta
+
+
+def test_mirror_disk_cache_roundtrip(tmp_path, monkeypatch):
+    """r5: the fingerprint-keyed mirror disk cache (restart warmth) — a
+    second DeviceGraph over the same live edge set loads the built tables
+    and serves oracle-identical waves, and stays patchable."""
+    import time
+
+    monkeypatch.setenv("FUSION_MIRROR_CACHE", str(tmp_path))
+    n = 96
+    src = np.arange(n - 1)
+    dst = np.arange(1, n)
+
+    def fresh():
+        g = DeviceGraph(node_capacity=n, edge_capacity=8 * n)
+        g.add_nodes(n)
+        g.add_edges(src, dst)
+        return g
+
+    g1 = fresh()
+    g1.build_topo_mirror()
+    deadline = time.time() + 20
+    while not list(tmp_path.glob("*.npz")) and time.time() < deadline:
+        time.sleep(0.1)
+    assert list(tmp_path.glob("*.npz")), "background cache save did not land"
+
+    g2 = fresh()
+    g2.build_topo_mirror()
+    assert g2._topo_mirror["lat"] is not None
+    c1, _ = g1.run_waves_union([[10]])
+    c2, _ = g2.run_waves_union([[10]])
+    assert c1 == c2 == n - 10
+    # a cache-loaded mirror still patches in place
+    g2.add_edges(np.array([5]), np.array([60]))
+    g2.clear_invalid()
+    c3, _ = g2.run_waves_union([[5]])
+    assert g2.mirror_patches == 1 and g2.mirror_rebuilds == 1
+    assert c3 == n - 5
