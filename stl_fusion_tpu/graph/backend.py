@@ -589,8 +589,11 @@ class TpuGraphBackend:
                 return values2, valid2, inv2
 
             block._dev_refresh[update_valid] = prog
+        # valid_mask (not the raw array) applies any deferred small
+        # updates first; the update_valid=False variant ignores validity
+        valid_in = table.valid_mask if update_valid else table._valid_dev
         values2, valid2, inv2 = prog(
-            table._values, table._valid_dev, g.invalid, *loader_args
+            table._values, valid_in, g.invalid, *loader_args
         )
         table._values = values2
         if update_valid:
@@ -661,6 +664,8 @@ class TpuGraphBackend:
             block._dev_refresh["warm"] = prog
         table._values, table._valid_dev = prog(*loader_args)
         table._valid_dev_dirty = False
+        table._valid_pending.clear()
+        table._valid_pending_n = 0
         n_stale = table._stale_count
         table._stale_host[:] = False
         table._stale_count = 0

@@ -67,6 +67,39 @@ def _pack_mask_host(mask: np.ndarray) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=1)
+def _fused_bump():
+    """One jitted op for an epoch bump (+1 on unique ids, invalid cleared):
+    pads repeat the first id, so add lanes past ``n_live`` are masked to 0
+    (the invalid clear is idempotent and needs no mask)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def bump(node_epoch, invalid, ids, n_live):
+        live = jnp.arange(ids.shape[0], dtype=jnp.int32) < n_live
+        return (
+            node_epoch.at[ids].add(jnp.where(live, 1, 0)),
+            invalid.at[ids].set(False),
+        )
+
+    return bump
+
+
+@functools.lru_cache(maxsize=1)
+def _fused_triple_scatter():
+    """One jitted scatter updating the three edge arrays of an incremental
+    append (src, dst, epoch): one relay dispatch instead of three eager
+    ones (~100 ms each through the tunnel, paid per scalar-churn flush)."""
+    import jax
+
+    @jax.jit
+    def scat(t1, t2, t3, rows, v1, v2, v3):
+        return t1.at[rows].set(v1), t2.at[rows].set(v2), t3.at[rows].set(v3)
+
+    return scat
+
+
+@functools.lru_cache(maxsize=1)
 def _fused_pair_scatter():
     """One jitted row scatter updating BOTH of a mirror's paired tables
     (ids + epochs): half the programs (and relay compiles) of two eager
@@ -258,13 +291,13 @@ class DeviceGraph:
                 dst_epoch = np.concatenate(
                     [dst_epoch, np.full(len(pad) - k, dst_epoch[0], np.int32)]
                 )
-            idx_j = jnp.asarray(pad)
+            es, ed, ee = _fused_triple_scatter()(
+                self._g.edge_src, self._g.edge_dst, self._g.edge_dst_epoch,
+                jnp.asarray(pad), jnp.asarray(src), jnp.asarray(dst),
+                jnp.asarray(np.asarray(dst_epoch)),
+            )
             self._g = self._g._replace(
-                edge_src=self._g.edge_src.at[idx_j].set(jnp.asarray(src)),
-                edge_dst=self._g.edge_dst.at[idx_j].set(jnp.asarray(dst)),
-                edge_dst_epoch=self._g.edge_dst_epoch.at[idx_j].set(
-                    jnp.asarray(dst_epoch)
-                ),
+                edge_src=es, edge_dst=ed, edge_dst_epoch=ee
             )
         else:
             self._dirty = True
@@ -302,8 +335,14 @@ class DeviceGraph:
 
     def bump_epochs(self, node_ids: np.ndarray) -> None:
         """Nodes recomputed: new epoch ⇒ their stale in-edges go dead, and
-        their invalid flag clears (a recomputed node is consistent again)."""
-        node_ids = np.asarray(node_ids, dtype=np.int32)
+        their invalid flag clears (a recomputed node is consistent again).
+        Ids are UNIQUE-ified first: the host fancy ``+=`` applies once per
+        unique id (numpy buffering) while a device ``.at[].add`` would
+        accumulate per occurrence — a duplicated batch would silently
+        diverge the two epoch copies."""
+        node_ids = np.unique(np.asarray(node_ids, dtype=np.int32))
+        if node_ids.size == 0:
+            return
         self._h_node_epoch[node_ids] += 1
         self._h_invalid[node_ids] = False
         self._struct_version += 1
@@ -316,11 +355,14 @@ class DeviceGraph:
             self._record_mirror_delta("bump", node_ids.copy())
         if self._g is not None and not self._dirty:
             jnp = self._jnp
-            ids = jnp.asarray(node_ids)
-            self._g = self._g._replace(
-                node_epoch=self._g.node_epoch.at[ids].add(1),
-                invalid=self._g.invalid.at[ids].set(False),
+            ids = jnp.asarray(self._pad_ids_pow2(node_ids))
+            # pads repeat the first id: the epoch bump must NOT double-
+            # apply, so the fused op masks pad lanes via a length scalar
+            ne, inv = _fused_bump()(
+                self._g.node_epoch, self._g.invalid, ids,
+                jnp.asarray(len(node_ids), dtype=jnp.int32),
             )
+            self._g = self._g._replace(node_epoch=ne, invalid=inv)
         else:
             self._dirty = True
 

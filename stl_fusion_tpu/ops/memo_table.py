@@ -87,6 +87,12 @@ class MemoTable:
         # the scatter — a 10M-row wave would upload 40 MB of ids through
         # the relay per burst); valid_mask/valid_bits materialize lazily
         self._valid_dev_dirty = False
+        # small invalidate/refresh batches defer their device-mask scatter
+        # here (applied in order at materialization): through a relay every
+        # eager scatter is a ~100 ms dispatch, and a scalar write loop paid
+        # one per op (r5 — the live bench's dominant non-burst phase)
+        self._valid_pending: List[np.ndarray] = []
+        self._valid_pending_n = 0
         self._packed_cache: Optional[tuple] = None  # (version, packed bits)
         self.on_invalidate: List[Callable[[np.ndarray], None]] = []
         #: fired with the refreshed ids after a vectorized recompute — the
@@ -197,13 +203,47 @@ class MemoTable:
         """The raw device value table (rows for stale ids may be outdated)."""
         return self._values
 
+    MAX_VALID_PENDING = 4096  # total deferred ids before a full rebuild wins
+
+    def _defer_valid(self, ids_np: np.ndarray, value: bool) -> None:
+        """Queue a small device-mask update instead of dispatching it
+        eagerly; past the budget the full lazy materialization is cheaper.
+        The queue stores only the TOUCHED ids — at flush time the
+        authoritative host staleness supplies each id's final value, so
+        any number of deferred batches coalesce into ONE scatter."""
+        if self._valid_dev_dirty:
+            return  # full materialization already pending
+        if self._valid_pending_n + len(ids_np) > self.MAX_VALID_PENDING:
+            self._valid_dev_dirty = True
+            self._valid_pending.clear()
+            self._valid_pending_n = 0
+        else:
+            self._valid_pending.append(ids_np)
+            self._valid_pending_n += len(ids_np)
+
     @property
     def valid_mask(self):
         """Per-row device validity mask (bool[n_rows]); materialized from
-        the host-authoritative staleness if a wave application deferred it."""
+        the host-authoritative staleness if a wave application deferred it.
+        Deferred small updates flush as ONE value-scatter: the final value
+        of every touched id is just ``~stale_host[id]`` (host truth), so
+        per-batch replay — and its one relay dispatch per batch — is
+        unnecessary."""
         if self._valid_dev_dirty:
             self._valid_dev = self._jnp.asarray(~self._stale_host)
             self._valid_dev_dirty = False
+            self._valid_pending.clear()
+            self._valid_pending_n = 0
+        elif self._valid_pending:
+            ids = np.unique(np.concatenate(self._valid_pending))
+            padded = _pad_repeat_pow2(ids)
+            self._valid_dev = self._jit_cache["set_mask_vals"](
+                self._valid_dev,
+                self._jnp.asarray(padded),
+                self._jnp.asarray(~self._stale_host[padded]),
+            )
+            self._valid_pending.clear()
+            self._valid_pending_n = 0
         return self._valid_dev
 
     def valid_bits(self):
@@ -233,8 +273,7 @@ class MemoTable:
             rows = np.concatenate([rows, pad_rows])
         jids = self._jnp.asarray(padded)
         self._values = self._jit_cache["scatter"](self._values, jids, self._jnp.asarray(rows))
-        if not self._valid_dev_dirty:  # else: lazy materialization covers it
-            self._valid_dev = self._jit_cache["set_mask"](self._valid_dev, jids, True)
+        self._defer_valid(ids_np, True)  # dirty: lazy materialization covers it
         self._stale_count -= int(np.count_nonzero(self._stale_host[ids_np]))
         self._stale_host[ids_np] = False
         self._bump()
@@ -285,10 +324,7 @@ class MemoTable:
             return None
         self._stale_count += int(np.count_nonzero(~self._stale_host[ids_np]))
         self._stale_host[ids_np] = True
-        if not self._valid_dev_dirty:
-            self._valid_dev = self._jit_cache["set_mask"](
-                self._valid_dev, self._jnp.asarray(_pad_repeat_pow2(ids_np)), False
-            )
+        self._defer_valid(ids_np, False)
         self._bump()
         return ids_np
 
@@ -297,6 +333,8 @@ class MemoTable:
         self._stale_count = self.n_rows
         self._valid_dev = self._jnp.zeros_like(self._valid_dev)
         self._valid_dev_dirty = False
+        self._valid_pending.clear()
+        self._valid_pending_n = 0
         self._bump()
         if self.on_invalidate:
             all_ids = np.arange(self.n_rows, dtype=np.int32)
@@ -337,6 +375,8 @@ class MemoTable:
         self._stale_count = int((~valid).sum())
         self._valid_dev = self._jnp.asarray(valid)
         self._valid_dev_dirty = False
+        self._valid_pending.clear()
+        self._valid_pending_n = 0
         self._packed_cache = None
         self.version = int(state["version"])
         self._bump()
@@ -371,10 +411,17 @@ def _kernels():
         return mask.at[ids].set(on)
 
     @jax.jit
+    def set_mask_vals(mask, ids, vals):
+        return mask.at[ids].set(vals)
+
+    @jax.jit
     def pack(mask):
         n = mask.shape[0]
         pad = (-n) % 32
         m = jnp.pad(mask, (0, pad)).reshape(-1, 32).astype(jnp.uint32)
         return (m << jnp.arange(32, dtype=jnp.uint32)).sum(axis=1, dtype=jnp.uint32)
 
-    return {"gather": gather, "scatter": scatter, "set_mask": set_mask, "pack": pack}
+    return {
+        "gather": gather, "scatter": scatter, "set_mask": set_mask,
+        "set_mask_vals": set_mask_vals, "pack": pack,
+    }
