@@ -99,35 +99,19 @@ def _fused_triple_scatter():
     return scat
 
 
-@functools.lru_cache(maxsize=1)
 def _fused_pair_scatter():
-    """One jitted row scatter updating BOTH of a mirror's paired tables
-    (ids + epochs): half the programs (and relay compiles) of two eager
-    scatters, cached per (table shapes × width bucket) by jit itself."""
-    import jax
+    """Shared paired-table row scatter (ops/bitops)."""
+    from ..ops.bitops import fused_pair_scatter
 
-    @jax.jit
-    def scat(t1, t2, rows, v1, v2):
-        return t1.at[rows].set(v1), t2.at[rows].set(v2)
-
-    return scat
+    return fused_pair_scatter()
 
 
-@functools.lru_cache(maxsize=1)
 def _pack_mask_kernel():
-    """bool[n] → uint32[ceil(n/32)] little-endian bit pack, jitted once:
-    overflow readbacks ship 1 bit/node through the relay instead of 1 byte."""
-    import jax
-    import jax.numpy as jnp
+    """Jitted bool→uint32 bit pack (overflow readbacks ship 1 bit/node
+    through the relay); one shared definition in ops/bitops."""
+    from ..ops.bitops import pack_bool_bits_jit
 
-    @jax.jit
-    def pack(mask):
-        n = mask.shape[0]
-        pad = (-n) % 32
-        m = jnp.pad(mask, (0, pad)).reshape(-1, 32).astype(jnp.uint32)
-        return (m << jnp.arange(32, dtype=jnp.uint32)).sum(axis=1, dtype=jnp.uint32)
-
-    return pack
+    return pack_bool_bits_jit()
 
 
 def check_structure_cache(entry: dict, struct_version: int, fp_fn) -> bool:

@@ -1,0 +1,47 @@
+"""Shared bit-pack primitives for the relay-thin transfer paths.
+
+One definition of the little-endian bool→uint32 pack that burst epilogues,
+overflow readbacks, and table validity bits all use (three modules had
+drifted their own copies of it); the host-side twin lives in
+graph/device_graph.py::_pack_mask_host next to its unpack kernel.
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["fused_pair_scatter", "pack_bool_bits", "pack_bool_bits_jit"]
+
+
+def pack_bool_bits(mask):
+    """bool[n] → uint32[ceil(n/32)] little-endian pack (traceable — use
+    inside larger jitted programs; ships 1 bit/node through the per-byte-
+    charged relay instead of 1 byte)."""
+    import jax.numpy as jnp
+
+    n = mask.shape[0]
+    pad = (-n) % 32
+    m = jnp.pad(mask, (0, pad)).reshape(-1, 32).astype(jnp.uint32)
+    return (m << jnp.arange(32, dtype=jnp.uint32)).sum(axis=1, dtype=jnp.uint32)
+
+
+@functools.lru_cache(maxsize=1)
+def pack_bool_bits_jit():
+    """Standalone jitted pack for eager callers."""
+    import jax
+
+    return jax.jit(pack_bool_bits)
+
+
+@functools.lru_cache(maxsize=1)
+def fused_pair_scatter():
+    """One jitted row scatter updating a mirror's paired tables (ids +
+    epochs): half the programs (and relay compiles) of two eager scatters,
+    cached per (table shapes × width bucket) by jit itself. Shared by the
+    single-chip topo/lat mirrors and the packed mesh mirror."""
+    import jax
+
+    @jax.jit
+    def scat(t1, t2, rows, v1, v2):
+        return t1.at[rows].set(v1), t2.at[rows].set(v2)
+
+    return scat

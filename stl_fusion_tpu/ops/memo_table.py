@@ -414,12 +414,9 @@ def _kernels():
     def set_mask_vals(mask, ids, vals):
         return mask.at[ids].set(vals)
 
-    @jax.jit
-    def pack(mask):
-        n = mask.shape[0]
-        pad = (-n) % 32
-        m = jnp.pad(mask, (0, pad)).reshape(-1, 32).astype(jnp.uint32)
-        return (m << jnp.arange(32, dtype=jnp.uint32)).sum(axis=1, dtype=jnp.uint32)
+    from .bitops import pack_bool_bits_jit
+
+    pack = pack_bool_bits_jit()  # shared wrapper: one trace cache repo-wide
 
     return {
         "gather": gather, "scatter": scatter, "set_mask": set_mask,

@@ -45,15 +45,6 @@ def _patch_scatter_add():
     return f
 
 
-@functools.lru_cache(maxsize=1)
-def _patch_row_scatter():
-    @jax.jit
-    def f(t1, t2, rows, v1, v2):
-        return t1.at[rows].set(v1), t2.at[rows].set(v2)
-
-    return f
-
-
 def build_packed_sharded_wave(mesh: Mesh):
     """Compile the packed sharded kernel for a mesh.
 
@@ -316,7 +307,9 @@ class PackedShardedGraph:
         width = max(256, 1 << int(len(rows) - 1).bit_length())
         q = np.full(width, self.n_global - 1, dtype=np.int64)
         q[: len(rows)] = rows  # pad rows rewrite their own current contents
-        self.in_src, self.edge_epoch = _patch_row_scatter()(
+        from ..ops.bitops import fused_pair_scatter
+
+        self.in_src, self.edge_epoch = fused_pair_scatter()(
             self.in_src, self.edge_epoch, jnp.asarray(q),
             jnp.asarray(hd[q]), jnp.asarray(he[q]),
         )
