@@ -300,13 +300,14 @@ async def main() -> None:
         # difference. Per-wave work is identical to M separate calls.
         chain_p50 = chain_p99 = None
         chain_rejects = None
-        if lat_waves > 0:
+        if lat_waves > 0 and n // 100 // (8 + 64) - 1 >= 2:
             note("timing chained lone waves (chain-difference)...")
             m_short, m_long = 8, 64
             n_chain = 16  # p99 of a small sample ≈ its max; 16 samples +
             # the symmetric trim keep one relay hiccup from owning the tail
-            # (scaled down on small graphs so the disjoint-seed pool fits)
-            n_chain = max(4, min(n_chain, n // 100 // (m_short + m_long) - 1))
+            # (scaled down on small graphs so the disjoint-seed pool fits;
+            # graphs too small for even 2 chained samples skip the section)
+            n_chain = min(n_chain, n // 100 // (m_short + m_long) - 1)
             need = (n_chain + 1) * (m_short + m_long)
             pool = rng.choice(n // 100, size=need, replace=False)
             pool = (n - 1 - pool).reshape(n_chain + 1, m_short + m_long)

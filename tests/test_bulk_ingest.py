@@ -444,3 +444,31 @@ async def test_device_loader_warm_and_refresh():
         assert not backend.graph._h_invalid.any()
     finally:
         set_default_hub(old)
+
+
+async def test_cascade_rows_batch_seq_matches_sequential_hub_level():
+    """cascade_rows_batch_seq through the BACKEND: sequential semantics,
+    table rows stale, per-batch counts — identical to M separate calls."""
+    n = 200
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        backend = TpuGraphBackend(hub, node_capacity=n, edge_capacity=8 * n)
+        svc = ChainN(hub, n)
+        hub.add_service(svc)
+        table = memo_table_of(svc.val)
+        block = backend.bind_table_rows(table)
+        backend.declare_row_edges(
+            block, np.arange(n - 1), block, np.arange(1, n)
+        )
+        table.read_batch(np.arange(n))
+        backend.flush()
+        backend.graph.build_topo_mirror()
+        counts = backend.cascade_rows_batch_seq(block, [[150], [100], [150]])
+        # chain semantics: [150] stales 150..199 (50); [100] stales
+        # 100..149 (50 — rows ≥150 already stale); [150] again: 0 newly
+        assert counts.tolist() == [50, 50, 0]
+        assert table.stale_count() == 100
+        assert bool(table._stale_host[100]) and not bool(table._stale_host[99])
+    finally:
+        set_default_hub(old)
