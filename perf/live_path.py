@@ -300,9 +300,9 @@ async def main() -> None:
         # difference. Per-wave work is identical to M separate calls.
         chain_p50 = chain_p99 = None
         chain_rejects = None
-        if lat_waves > 0 and n // 100 // (8 + 64) - 1 >= 2:
+        m_short, m_long = 8, 64
+        if lat_waves > 0 and n // 100 // (m_short + m_long) - 1 >= 2:
             note("timing chained lone waves (chain-difference)...")
-            m_short, m_long = 8, 64
             n_chain = 16  # p99 of a small sample ≈ its max; 16 samples +
             # the symmetric trim keep one relay hiccup from owning the tail
             # (scaled down on small graphs so the disjoint-seed pool fits;
@@ -357,14 +357,17 @@ async def main() -> None:
         if table.stale_count():
             backend.refresh_block_on_device(block)
         backend.flush()
-        # ALSO warm the split (multi-pass) pipeline variants: the first
-        # level-violating churn edge flips passes to 2 and the split
-        # programs would otherwise compile inside a timed burst
+        # ALSO warm every multi-pass variant a churned run can route to:
+        # fused-2 and fused-3 (one program per pass count ≤ FUSED_PASS_MAX)
+        # and the split gate/sweep/finish pipeline (passes > 3, the
+        # violation-pileup bridge while a re-level runs) — any of these
+        # compiling inside a timed burst would depress that round's rate
         gdev = backend.graph
         m = gdev._topo_mirror
-        m["passes"] = 2
-        backend.cascade_rows_lanes(block, group_ids)
-        backend.cascade_rows_batch(block, [n - 1])
+        for warm_passes in (2, 3, 4):
+            m["passes"] = warm_passes
+            backend.cascade_rows_lanes(block, group_ids)
+            backend.cascade_rows_batch(block, [n - 1])
         m["passes"] = 1
         if table.stale_count():
             backend.refresh_block_on_device(block)
