@@ -15,7 +15,10 @@ A ground-up rebuild of the capabilities of Stl.Fusion (reference:
 - invalidation-aware RPC with per-call invalidation subscriptions
   (``rpc`` + ``client``), multi-host invalidation via a durable operation
   log (``oplog``), and intra-pod frontier exchange over XLA collectives
-  (``parallel``).
+  (``parallel``);
+- chaos-hardened failure handling (``resilience``): deterministic fault
+  injection, per-peer circuit breakers, and a device-wave watchdog with a
+  split-host-loop fallback — see RESILIENCE.md.
 
 See SURVEY.md for the reference structural map this build follows.
 """

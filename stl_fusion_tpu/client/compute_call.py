@@ -61,9 +61,25 @@ class RpcOutboundComputeCall(RpcOutboundCall):
 
     def set_result(self, value: Any, message: RpcMessage) -> None:
         v = message.header(VERSION_HEADER)
-        self.result_version = LTag.parse(v) if v else None
+        version = LTag.parse(v) if v else None
+        if self.future is not None and self.future.done():
+            # a REDELIVERED result (reconnect re-send): the original answer
+            # was already consumed. A version that moved on means the server
+            # recomputed while the link was down — and the invalidation for
+            # OUR version died with the old link (sent into a buffer the
+            # link took down with it). Without this check the bound computed
+            # stays consistent-but-stale FOREVER (≈ the reference's
+            # version-mismatch handling, RpcOutboundComputeCall.cs:71-109).
+            if (
+                version is not None
+                and self.result_version is not None
+                and version != self.result_version
+            ):
+                self.set_invalidated()
+            return
+        self.result_version = version
         # compute calls STAY registered — the invalidation push arrives later
-        if self.future is not None and not self.future.done():
+        if self.future is not None:
             self.future.set_result(value)
 
     def set_error(self, error: BaseException) -> None:

@@ -20,20 +20,46 @@ __all__ = ["FusionMonitor"]
 
 
 class FusionMonitor:
-    def __init__(self, hub: "FusionHub", report_period: float = 60.0):
+    def __init__(self, hub: "FusionHub", report_period: float = 60.0, resilience=None):
         self.hub = hub
         self.report_period = report_period
         self._slow_accesses = 0
         self.registrations = 0
         self.invalidations = 0
+        #: ResilienceEvents ledger exported by report(); defaults to the
+        #: process-wide registry so breaker transitions, watchdog fallbacks
+        #: and oplog quarantines show up with zero wiring
+        if resilience is None:
+            from ..resilience.events import global_events
+
+            resilience = global_events()
+        self.resilience = resilience
         # the hot-cache fast path counts amortized on the registry (every
         # 16th hit — see core/service.py) instead of firing a hook per hit
         self._fast_hits0 = getattr(hub.registry, "fast_hits", 0)
         self._started_at = time.monotonic()
         self._last_report = self._started_at
+        self._disposed = False
         hub.registry.on_access.append(self._on_access)
         hub.registry.on_register.append(self._on_register)
         hub.invalidated_hooks.append(self._on_invalidated)
+
+    def dispose(self) -> None:
+        """Detach all three hub hooks (idempotent). Without this every
+        constructed monitor kept counting — and kept ITSELF alive through
+        the hub's hook lists — forever."""
+        if self._disposed:
+            return
+        self._disposed = True
+        for hooks, fn in (
+            (self.hub.registry.on_access, self._on_access),
+            (self.hub.registry.on_register, self._on_register),
+            (self.hub.invalidated_hooks, self._on_invalidated),
+        ):
+            try:
+                hooks.remove(fn)
+            except ValueError:
+                pass
 
     @property
     def accesses(self) -> int:
@@ -72,4 +98,7 @@ class FusionMonitor:
             "hit_ratio": round(self.hit_ratio, 4),
             "registry_size": len(self.hub.registry),
             "accesses_per_sec": round(self.accesses / elapsed, 1) if elapsed else 0.0,
+            # degradation ledger: breaker transitions, watchdog fallbacks,
+            # chaos injections, oplog quarantines — one dict of counters
+            "resilience": self.resilience.snapshot(),
         }

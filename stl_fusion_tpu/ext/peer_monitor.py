@@ -26,6 +26,11 @@ class RpcPeerState:
     #: the peer gave up (unrecoverable connect error): no reconnect is
     #: coming, so UIs should render a hard failure, not a retry banner
     is_terminated: bool = False
+    #: circuit-breaker state ("closed"/"open"/"half-open") when a
+    #: resilience.PeerCircuitBreaker is installed on the peer, else None —
+    #: "open" means the peer is QUARANTINED (dials parked), which UIs should
+    #: render differently from an ordinary reconnect countdown
+    breaker: Optional[str] = None
 
 
 class RpcPeerStateMonitor(WorkerBase):
@@ -40,6 +45,7 @@ class RpcPeerStateMonitor(WorkerBase):
         ev = self.peer.connection_state
         while True:
             s = ev.value
+            breaker = getattr(self.peer, "breaker", None)
             self.state.set(
                 RpcPeerState(
                     is_connected=s.is_connected,
@@ -50,6 +56,28 @@ class RpcPeerStateMonitor(WorkerBase):
                         None if s.is_terminated else getattr(self.peer, "reconnects_at", None)
                     ),
                     is_terminated=s.is_terminated,
+                    breaker=breaker.state if breaker is not None else None,
                 )
             )
-            ev = await ev.when_next()
+            if breaker is None:
+                ev = await ev.when_next()
+                continue
+            # a breaker transitions WITHOUT a connection event too (open →
+            # half-open in the dial gate, half-open → closed on probe-stable
+            # timeout) — wake on whichever chain moves first so a recovered
+            # peer is never rendered as quarantined until its next disconnect
+            conn_next = asyncio.ensure_future(ev.when_next())
+            brk_next = asyncio.ensure_future(breaker.changes.latest().when_next())
+            try:
+                done, _pending = await asyncio.wait(
+                    {conn_next, brk_next}, return_when=asyncio.FIRST_COMPLETED
+                )
+            finally:
+                # asyncio.wait never cancels its children — without this, a
+                # monitor stopped while parked here leaks both waiter tasks
+                # ("Task was destroyed but it is pending!" at loop close)
+                for p in (conn_next, brk_next):
+                    if not p.done():
+                        p.cancel()
+            if conn_next in done:
+                ev = conn_next.result()

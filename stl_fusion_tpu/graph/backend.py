@@ -166,6 +166,10 @@ class TpuGraphBackend:
         self._block_by_table: Dict[int, RowBlock] = {}
         self._sharded_mirror: Optional[dict] = None  # see sharded_mirror
         self._packed_mirror: Optional[dict] = None  # see packed_mirror
+        #: optional resilience.WaveWatchdog: when attached, union/lane burst
+        #: dispatches route through it (deadline + fault containment with a
+        #: split-host-loop fallback); None = direct dispatch, zero overhead
+        self.watchdog = None
         self.waves_run = 0
         self.device_invalidations = 0
         hub.registry.on_register.append(self._on_register)
@@ -277,6 +281,28 @@ class TpuGraphBackend:
                 self._journal.append(("invalid", nid))
                 self._pending[nid] = False  # host led; nothing left to materialize
 
+    def attach_watchdog(self, watchdog):
+        """Route wave dispatches through a resilience.WaveWatchdog: a fused
+        burst that raises or blows its deadline degrades to the split host
+        loop; the first fused wave after recovery is oracle-verified."""
+        self.watchdog = watchdog
+        return watchdog
+
+    def _wave_union(self, seed_lists):
+        if self.watchdog is not None:
+            return self.watchdog.run_union(self.graph, seed_lists)
+        return self.graph.run_waves_union(seed_lists)
+
+    def _wave_lanes(self, seed_lists):
+        if self.watchdog is not None:
+            return self.watchdog.run_lanes(self.graph, seed_lists)
+        return self.graph.run_waves_lanes(seed_lists)
+
+    def _wave_union_seq(self, seed_lists):
+        if self.watchdog is not None:
+            return self.watchdog.run_seq(self.graph, seed_lists)
+        return self.graph.run_waves_union_seq(seed_lists)
+
     def mark_watched(self, computed: "Computed") -> None:
         """An invalidation observer attached: device waves must apply this
         node EAGERLY (hub routes ``Computed.on_invalidated`` here)."""
@@ -319,7 +345,7 @@ class TpuGraphBackend:
             nids = np.unique(np.concatenate(icasc_parts))
             icasc_parts.clear()
             was_clear = nids[~self.graph._h_invalid[nids]]
-            total, newly_ids = self.graph.run_waves_union([nids.tolist()])
+            total, newly_ids = self._wave_union([nids.tolist()])
             newly_ids = newly_ids[~np.isin(newly_ids, nids)]
             if was_clear.size:
                 self.graph.clear_invalid_ids(was_clear)
@@ -524,7 +550,7 @@ class TpuGraphBackend:
         # — per-level full-edge gathers over the pow2-padded edge arrays
         # lose to one depth-free mirror sweep. The mirror union is the
         # lone-wave path too.
-        total, newly_ids = self.graph.run_waves_union([nids.tolist()])
+        total, newly_ids = self._wave_union([nids.tolist()])
         self._apply_newly(newly_ids)
         self.waves_run += 1
         self.device_invalidations += total
@@ -690,7 +716,7 @@ class TpuGraphBackend:
             (block.base + self._check_rows(block, rows)).tolist()
             for rows in row_batches
         ]
-        counts, union_ids = self.graph.run_waves_union_seq(seed_lists)
+        counts, union_ids = self._wave_union_seq(seed_lists)
         self._apply_newly(union_ids)
         self.waves_run += len(seed_lists)
         self.device_invalidations += int(counts.sum())
@@ -705,7 +731,7 @@ class TpuGraphBackend:
         seed_lists = [
             (block.base + self._check_rows(block, g)).tolist() for g in row_groups
         ]
-        counts, union_ids = self.graph.run_waves_lanes(seed_lists)
+        counts, union_ids = self._wave_lanes(seed_lists)
         self._apply_newly(union_ids)
         self.waves_run += len(seed_lists)
         self.device_invalidations += int(counts.sum())
@@ -750,7 +776,7 @@ class TpuGraphBackend:
                 seeds.append([nid])
         if not seeds:
             return fallback
-        total, newly_ids = self.graph.run_waves_union(seeds)
+        total, newly_ids = self._wave_union(seeds)
         self._apply_newly(newly_ids)
         self.waves_run += len(seeds)
         self.device_invalidations += total
@@ -784,7 +810,7 @@ class TpuGraphBackend:
                 else:
                     ids.append(nid)
             seed_lists.append(ids)
-        counts, union_ids = self.graph.run_waves_lanes(seed_lists)
+        counts, union_ids = self._wave_lanes(seed_lists)
         self._apply_newly(union_ids)
         self.waves_run += len(groups)
         self.device_invalidations += int(counts.sum())

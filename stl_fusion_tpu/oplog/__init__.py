@@ -1,6 +1,12 @@
 """Durable operation log + multi-host invalidation (SURVEY.md §2.6)."""
 from .entity_resolver import EntityResolver
-from .log import InMemoryOperationLog, OperationLog, OperationRecord, SqliteOperationLog
+from .log import (
+    CorruptRecord,
+    InMemoryOperationLog,
+    OperationLog,
+    OperationRecord,
+    SqliteOperationLog,
+)
 from .trimmer import OperationLogTrimmer
 from .scope import (
     ScopedSqliteDb,
@@ -12,10 +18,12 @@ from .reader import (
     FileChangeNotifier,
     LocalChangeNotifier,
     OperationLogReader,
+    QuarantinedRange,
     attach_operation_log,
 )
 
 __all__ = [
+    "CorruptRecord",
     "EntityResolver",
     "InMemoryOperationLog",
     "OperationLog",
@@ -25,6 +33,7 @@ __all__ = [
     "LocalChangeNotifier",
     "OperationLogReader",
     "OperationLogTrimmer",
+    "QuarantinedRange",
     "attach_operation_log",
     "ScopedSqliteDb",
     "SqliteOperationScope",

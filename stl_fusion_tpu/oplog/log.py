@@ -24,6 +24,7 @@ from typing import Any, List, Optional
 from ..utils.serialization import decode, encode
 
 __all__ = [
+    "CorruptRecord",
     "OperationRecord",
     "OperationLog",
     "SqliteOperationLog",
@@ -78,6 +79,21 @@ class OperationRecord:
     index: int = 0  # log position (store-assigned)
 
 
+@dataclass(frozen=True)
+class CorruptRecord:
+    """A log row that exists but cannot be decoded (truncated/garbled
+    payload — a torn write, a partial disk, a bad migration). Stores
+    surface these instead of RAISING from ``read_after``: one poisoned row
+    must not halt every reader forever (reader.py quarantines it and
+    resumes at the next good watermark). ``commit_time`` is kept when the
+    column itself survived — the trimmer uses it to never trim past a
+    quarantined range."""
+
+    index: int
+    commit_time: Optional[float]
+    error: str
+
+
 class OperationLog:
     """Abstract durable operation log."""
 
@@ -85,7 +101,10 @@ class OperationLog:
         raise NotImplementedError
 
     def read_after(self, index: int, limit: int = 1024) -> List[OperationRecord]:
-        """Records with position > index, oldest first."""
+        """Records with position > index, oldest first. A row that exists
+        but cannot be decoded comes back as a :class:`CorruptRecord` in
+        position — never an exception (reader.py quarantines and
+        resumes)."""
         raise NotImplementedError
 
     def last_index(self) -> int:
@@ -157,17 +176,24 @@ class SqliteOperationLog(OperationLog):
                 " FROM operations WHERE idx > ? ORDER BY idx LIMIT ?",
                 (index, limit),
             ).fetchall()
-        return [
-            OperationRecord(
-                id=r[1],
-                agent_id=r[2],
-                commit_time=r[3],
-                command=decode(json.loads(r[4])),
-                items=tuple(decode(json.loads(r[5]))),
-                index=r[0],
-            )
-            for r in rows
-        ]
+        out: List[OperationRecord] = []
+        for r in rows:
+            try:
+                out.append(
+                    OperationRecord(
+                        id=r[1],
+                        agent_id=r[2],
+                        commit_time=r[3],
+                        command=decode(json.loads(r[4])),
+                        items=tuple(decode(json.loads(r[5]))),
+                        index=r[0],
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 — torn/garbled row: surface,
+                # don't raise (one poisoned row must not halt every reader)
+                commit_time = r[3] if isinstance(r[3], (int, float)) else None
+                out.append(CorruptRecord(index=r[0], commit_time=commit_time, error=repr(e)))
+        return out
 
     def last_index(self) -> int:
         with self._lock:

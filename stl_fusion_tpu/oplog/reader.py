@@ -19,21 +19,49 @@ import asyncio
 import logging
 import os
 import time
-from typing import TYPE_CHECKING, Callable, List, Optional
+from typing import TYPE_CHECKING, Callable, List, Optional, Tuple
 
 import contextlib
 
+from dataclasses import dataclass
+
 from ..operations.operation import Operation
 from ..operations.pipeline import batch_cascade_scope
+from ..resilience.events import ResilienceEvents, global_events
 from ..utils.async_chain import WorkerBase
-from .log import OperationLog, OperationRecord
+from .log import CorruptRecord, OperationLog, OperationRecord
 
 if TYPE_CHECKING:
     from ..operations.pipeline import OperationsHost
 
 log = logging.getLogger("stl_fusion_tpu")
 
-__all__ = ["OperationLogReader", "LocalChangeNotifier", "FileChangeNotifier", "attach_operation_log"]
+__all__ = [
+    "OperationLogReader",
+    "LocalChangeNotifier",
+    "FileChangeNotifier",
+    "QuarantinedRange",
+    "attach_operation_log",
+]
+
+
+@dataclass(frozen=True)
+class QuarantinedRange:
+    """A log index range the reader skipped instead of halting on: a
+    corrupt/truncated row, or a gap in the index sequence (rows that
+    vanished mid-log — a torn write or external deletion). ``commit_floor``
+    is the newest commit time known to be ≤ the range. ``clamps_trimmer``
+    marks ranges with something left to PROTECT: a corrupt row is evidence
+    a repaired cold boot can still replay, so the trimmer refuses to trim
+    past it; a gap's rows are already gone (and commit-time/idx ordering
+    skew can make a routine trim look like a mid-batch gap), so gaps are
+    recorded as telemetry but never block GC."""
+
+    first_index: int
+    last_index: int
+    commit_floor: Optional[float]
+    reason: str
+    clamps_trimmer: bool = True
 
 
 class LocalChangeNotifier:
@@ -58,24 +86,32 @@ class FileChangeNotifier:
     def __init__(self, path: str):
         self.path = path
         self._local = LocalChangeNotifier()
-        self._last_mtime = 0.0
+        self._last_token: Tuple[float, int] = (0.0, -1)
 
     def subscribe(self) -> asyncio.Event:
         return self._local.subscribe()
 
     def notify(self) -> None:
+        # the appended byte makes the file SIZE a shared monotonic token:
+        # two notifies inside one clock tick (coarse-granularity filesystems
+        # tick ~10ms here), or from two processes with skewed clocks, would
+        # collide on mtime alone and silently drop a cross-process wakeup.
+        # Growth is one byte per commit notification — negligible next to
+        # the operation log it accompanies (and truncating the file is safe:
+        # a size DECREASE also changes the token).
         with open(self.path, "a") as f:
-            f.write("")
+            f.write(".")
         os.utime(self.path, None)
         self._local.notify()
 
     def poll(self) -> bool:
         try:
-            m = os.path.getmtime(self.path)
+            st = os.stat(self.path)
         except OSError:
             return False
-        if m > self._last_mtime:
-            self._last_mtime = m
+        token = (st.st_mtime, st.st_size)
+        if token != self._last_token:
+            self._last_token = token
             self._local.notify()
             return True
         return False
@@ -92,6 +128,7 @@ class OperationLogReader(WorkerBase):
         batch_size: int = 1024,
         start_position: Optional[int] = None,
         mesh=None,
+        events: Optional[ResilienceEvents] = None,
     ):
         super().__init__("oplog-reader")
         self.log_store = log_store
@@ -99,6 +136,13 @@ class OperationLogReader(WorkerBase):
         self.notifier = notifier
         self.poll_period = poll_period
         self.batch_size = batch_size
+        self.events = events if events is not None else global_events()
+        #: ranges skipped instead of halting on (corrupt rows, index gaps);
+        #: the trimmer's quarantine guard reads quarantine_floor() off this
+        self.quarantined: List[QuarantinedRange] = []
+        self.corrupt_seen = 0
+        self.gaps_seen = 0
+        self._last_commit_time: Optional[float] = None
         #: optional jax.sharding.Mesh: external-operation lane replay runs
         #: on the DEVICE MESH (invalidate_cascade_batch_lanes_sharded) — N
         #: external commands cost one packed mesh sweep over ICI
@@ -153,10 +197,37 @@ class OperationLogReader(WorkerBase):
                 if backend is not None
                 else contextlib.nullcontext()
             )
+            # a gap is only trustworthy INSIDE one read batch (the store
+            # returned rows on both sides of a hole in ONE query): rows
+            # missing ACROSS batches — or before the first record — may have
+            # been legitimately trimmed while this reader lagged, and a
+            # false gap would clamp the trimmer at its commit floor forever
+            prev_index: Optional[int] = None
             try:
                 with scope:
                     for rec in records:
+                        if prev_index is not None and rec.index > prev_index + 1:
+                            self.gaps_seen += 1
+                            self._quarantine(
+                                prev_index + 1, rec.index - 1,
+                                self._last_commit_time,
+                                "index gap", "oplog_gap",
+                                clamps_trimmer=False,
+                            )
+                        prev_index = rec.index
                         self.watermark = max(self.watermark, rec.index)
+                        if isinstance(rec, CorruptRecord):
+                            # torn/garbled row: quarantine + RESUME at the
+                            # next good watermark instead of halting the
+                            # whole invalidation fan-out on one bad write
+                            self._quarantine(
+                                rec.index, rec.index,
+                                rec.commit_time or self._last_commit_time,
+                                f"corrupt: {rec.error}", "oplog_corrupt",
+                            )
+                            self.corrupt_seen += 1
+                            continue
+                        self._last_commit_time = rec.commit_time
                         if rec.agent_id == self.operations.agent.id:
                             continue  # our own operation: already completed locally
                         self.external_seen += 1
@@ -181,6 +252,40 @@ class OperationLogReader(WorkerBase):
                         )
                     else:
                         backend.invalidate_cascade_batch_lanes(groups)
+
+    # ------------------------------------------------------------------ quarantine
+    def _quarantine(
+        self,
+        first: int,
+        last: int,
+        commit_floor: Optional[float],
+        reason: str,
+        kind: str,
+        clamps_trimmer: bool = True,
+    ) -> None:
+        rng = QuarantinedRange(first, last, commit_floor, reason, clamps_trimmer)
+        self.quarantined.append(rng)
+        self.events.record(kind, f"[{first}, {last}] {reason}")
+        log.warning("oplog reader quarantined [%d, %d]: %s", first, last, reason)
+
+    def quarantine_floor(self) -> Optional[float]:
+        """Oldest commit time the trimmer must PRESERVE: the minimum commit
+        floor across trimmer-clamping quarantined ranges (None when nothing
+        clamps; 0.0 — trim nothing — when a clamping range couldn't be
+        dated). Gap ranges never clamp: their rows are already gone, and a
+        false gap (trim vs commit-time/idx skew) must not disable GC."""
+        floors = [r.commit_floor for r in self.quarantined if r.clamps_trimmer]
+        if not floors:
+            return None
+        return 0.0 if any(f is None for f in floors) else min(floors)
+
+    def clear_quarantine(self) -> int:
+        """Operator reset after inspecting (or repairing) quarantined rows:
+        forget the ranges so the trimmer resumes normal GC. Returns the
+        number of ranges dropped."""
+        n = len(self.quarantined)
+        self.quarantined.clear()
+        return n
 
 
 def attach_operation_log(

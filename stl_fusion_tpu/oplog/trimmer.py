@@ -28,19 +28,33 @@ class OperationLogTrimmer(WorkerBase):
         max_age: float = 600.0,
         check_period: float = 60.0,
         clock: Optional[MomentClock] = None,
+        quarantine_guard=None,
     ):
         super().__init__(name="oplog-trimmer")
         self.log_store = log_store
         self.max_age = max_age
         self.check_period = check_period
         self.clock = clock
+        #: an OperationLogReader (or anything with ``quarantine_floor() ->
+        #: Optional[float]``): the trimmer never trims past a quarantined
+        #: range — the evidence of a torn/corrupt row must outlive the GC
+        #: so operators can inspect it and cold-boot readers can replay a
+        #: repaired row
+        self.quarantine_guard = quarantine_guard
         self.trimmed_total = 0
+        self.clamped_trims = 0
 
     def _now(self) -> float:
         return self.clock.now() if self.clock is not None else time.time()
 
     def trim_once(self) -> int:
-        removed = self.log_store.trim_before(self._now() - self.max_age)
+        cutoff = self._now() - self.max_age
+        if self.quarantine_guard is not None:
+            floor = self.quarantine_guard.quarantine_floor()
+            if floor is not None and floor < cutoff:
+                cutoff = floor
+                self.clamped_trims += 1
+        removed = self.log_store.trim_before(cutoff)
         self.trimmed_total += removed
         if removed:
             log.debug("oplog trimmer removed %d records", removed)

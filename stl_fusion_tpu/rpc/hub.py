@@ -79,6 +79,11 @@ class RpcHub:
         #: messages without re-running the chain).
         self.inbound_middlewares: List[Callable] = []
         self.outbound_middlewares: List[Callable] = []
+        #: dial gates: each is ``async (peer) -> None``, awaited before every
+        #: client dial. A gate that parks is a quarantine — the peer circuit
+        #: breaker (resilience/breaker.py) holds flapping peers here so
+        #: reconnect re-send storms can't amplify
+        self.connect_gates: List[Callable[[RpcClientPeer], Awaitable[None]]] = []
         #: local service fallback for routing proxies
         self.local_services: Dict[str, Any] = {}
 
@@ -110,6 +115,8 @@ class RpcHub:
             raise RpcConfigurationError(
                 f"hub {self.name!r} has no client connector configured"
             )
+        for gate in self.connect_gates:
+            await gate(peer)
         return await self.client_connector(peer)
 
     def client(self, service_name: str, peer_ref: Optional[str] = None) -> "RpcClientProxy":

@@ -30,10 +30,21 @@ log = logging.getLogger("stl_fusion_tpu")
 
 __all__ = [
     "call_logging_middleware",
+    "chaos_middleware",
     "default_session_replacer_middleware",
     "bind_peer_session",
     "peer_session",
 ]
+
+
+def chaos_middleware(policy, events=None) -> Callable:
+    """Fault-injection stage (resilience/chaos.py): drop / duplicate /
+    delay sampled per message from a seeded policy — the production-shaped
+    chaos injection point (append to ``inbound_middlewares`` /
+    ``outbound_middlewares`` like any other stage)."""
+    from ..resilience.chaos import chaos_middleware as _impl
+
+    return _impl(policy, events)
 
 
 def call_logging_middleware(logger=None, level: int = logging.DEBUG) -> Callable:

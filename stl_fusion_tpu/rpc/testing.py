@@ -54,6 +54,7 @@ class RpcTestTransportBase:
         self.connect_count: Dict[str, int] = {}
         self._blocked = False
         self._fail_next_after: Optional[int] = None
+        self._chaos = None
         client_hub.client_connector = self._connect
 
     def _server_for(self, peer_ref: str) -> RpcHub:
@@ -64,6 +65,11 @@ class RpcTestTransportBase:
             raise ConnectionError("test transport is blocked")
         server_hub = self._server_for(peer.ref)
         client_end, server_end = create_twisted_pair()
+        if self._chaos is not None:
+            from ..resilience.chaos import wrap_chaos_pair
+
+            client_end = wrap_chaos_pair(client_end, self._chaos)
+            server_end = wrap_chaos_pair(server_end, self._chaos)
         server_hub.server_peer(f"client:{peer.ref}").connect(server_end)
         self.connect_count[peer.ref] = self.connect_count.get(peer.ref, 0) + 1
         if self._fail_next_after is not None:
@@ -85,6 +91,13 @@ class RpcTestTransportBase:
         """The NEXT connection's writer dies after ``sends`` sends (reader
         keeps hanging) — kills the link mid-re-send-batch."""
         self._fail_next_after = sends
+
+    def set_chaos(self, policy) -> None:
+        """Apply a ``resilience.ChaosPolicy`` to every connection made from
+        now on (both directions): per-message drop/duplicate/delay/reorder
+        on the twisted channels. ``None`` disables for future connections
+        (existing links keep their wrappers until they die)."""
+        self._chaos = policy
 
     async def wait_connected(self, peer_ref: str = "default", timeout: float = 5.0) -> None:
         peer = self.client_hub.client_peer(peer_ref)

@@ -141,12 +141,28 @@ class WorkerBase:
 
     async def stop(self) -> None:
         self._stop_requested = True
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+        task = self._task
+        if task is None:
+            return
+        # cancel-until-dead: on py ≤ 3.11, asyncio.wait_for SWALLOWS a
+        # cancellation when its inner future completes in the same event-loop
+        # step (the bpo-42130 family) — a worker parked in wait_for whose
+        # wake-up fired exactly at stop() time absorbs the cancel and runs
+        # forever, deadlocking the stop() awaiter (observed as a rare hang of
+        # the op-log reader restart under chaos). Re-cancel until the task is
+        # actually done; asyncio.wait never raises, and _run_guarded consumes
+        # the task's own CancelledError, so nothing leaks. _task stays set
+        # until the task is REALLY dead — is_running/when_stopped/start must
+        # not observe "stopped" while on_run still executes.
+        grace = 0.2
+        while not task.done():
+            task.cancel()
+            await asyncio.wait([task], timeout=grace)
+            # first re-cancel covers the swallow; after that, escalate the
+            # grace so a worker legitimately mid-async-cleanup isn't hammered
+            # with a fresh CancelledError every 200 ms
+            grace = 1.0
+        if self._task is task:
             self._task = None
 
     async def when_stopped(self) -> None:

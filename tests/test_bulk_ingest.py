@@ -332,8 +332,8 @@ def test_monitor_counts_no_phantom_hits_on_misses(fresh_hub=None):
     async def run():
         hub = FusionHub()
         old = set_default_hub(hub)
+        monitor = FusionMonitor(hub)
         try:
-            monitor = FusionMonitor(hub)
 
             class S(ComputeService):
                 @compute_method
@@ -346,6 +346,7 @@ def test_monitor_counts_no_phantom_hits_on_misses(fresh_hub=None):
             assert monitor.registrations == 50
             assert monitor.hit_ratio < 0.1, monitor.report()
         finally:
+            monitor.dispose()
             set_default_hub(old)
 
     asyncio.run(run())

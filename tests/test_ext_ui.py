@@ -238,20 +238,28 @@ async def test_ui_action_failure_tracker_collects_errors(fresh_hub):
 
 async def test_fusion_monitor_hit_ratio(fresh_hub):
     monitor = FusionMonitor(fresh_hub)
+    try:
 
-    class S(ComputeService):
-        @compute_method
-        async def get(self, k: str) -> str:
-            return k
+        class S(ComputeService):
+            @compute_method
+            async def get(self, k: str) -> str:
+                return k
 
-    svc = S(fresh_hub)
-    await svc.get("a")
-    for _ in range(9):
+        svc = S(fresh_hub)
         await svc.get("a")
-    report = monitor.report()
-    assert report["computes"] >= 1
-    assert report["accesses"] >= 10
-    assert report["hit_ratio"] > 0.5
+        for _ in range(9):
+            await svc.get("a")
+        report = monitor.report()
+        assert report["computes"] >= 1
+        assert report["accesses"] >= 10
+        assert report["hit_ratio"] > 0.5
+    finally:
+        monitor.dispose()
+    # dispose() detached all three hub hooks — further activity is invisible
+    hooks = len(fresh_hub.registry.on_register)
+    await svc.get("b")
+    assert monitor.registrations == report["computes"]
+    assert len(fresh_hub.registry.on_register) == hooks
 
 
 # ------------------------------------------------------------------ durable variants

@@ -276,6 +276,46 @@ async def test_invalidate_only_restart_answer_retries():
         await _stop(client_rpc, server_rpc)
 
 
+async def test_invalidation_delivery_under_chaos_dup_reorder_disconnect():
+    """$sys-c.invalidate delivery across an injected disconnect/reconnect
+    WITH duplicated and reordered frames (resilience.ChaosPolicy on the
+    twisted channels): duplicates must dedup (inbound call registry +
+    done-future guards), reordered invalidate-before-result frames must
+    resolve through the ResultMissedError retry, and the subscription must
+    survive the reconnect — every increment still reaches the client."""
+    from stl_fusion_tpu.resilience import ChaosPolicy
+
+    svc, client, transport, client_rpc, server_rpc, _cf = make_stack()
+    policy = ChaosPolicy(
+        seed=42, duplicate=0.5, reorder_window=4, reorder_flush_s=0.005
+    )
+    transport.set_chaos(policy)
+    try:
+        assert await client.get("a") == 0
+        node = await capture(lambda: client.get("a"))
+
+        await transport.disconnect()  # injected mid-subscription disconnect
+        await transport.wait_connected()
+
+        # the re-sent compute call re-captured server-side: the push still
+        # arrives, through duplicated + shuffled frames
+        await svc.increment("a")
+        await asyncio.wait_for(node.when_invalidated(), 5.0)
+        assert await client.get("a") == 1
+
+        # several more rounds: each invalidation delivered exactly-once in
+        # effect (a duplicate or reordered frame must never stick a stale
+        # value or double-invalidate a fresh one)
+        for expect in (2, 3, 4):
+            node = await capture(lambda: client.get("a"))
+            await svc.increment("a")
+            await asyncio.wait_for(node.when_invalidated(), 5.0)
+            assert await client.get("a") == expect
+        assert policy.duplicated > 0  # the chaos actually exercised the path
+    finally:
+        await _stop(client_rpc, server_rpc)
+
+
 async def test_fusion_client_chaos_no_lost_invalidation():
     """Randomized chaos over the compute client: server-side increments,
     disconnects, and half-open flaky connections interleave with client

@@ -18,6 +18,7 @@ from stl_fusion_tpu.oplog import (
     FileChangeNotifier,
     InMemoryOperationLog,
     LocalChangeNotifier,
+    OperationLogTrimmer,
     ScopedSqliteDb,
     SqliteOperationLog,
     attach_db_operation_scope,
@@ -565,6 +566,131 @@ async def test_multihost_chaos_convergence(tmp_path):
         finally:
             for r in readers.values():
                 await r.stop()
+
+
+# ------------------------------------------------ torn-log quarantine
+
+async def _write_ops(tmp_path, keys):
+    """Host A commits one SetValue per key into a fresh sqlite log."""
+    path = str(tmp_path / "ops.sqlite")
+    log_store = SqliteOperationLog(path)
+    hub_a, svc_a, reader_a = make_host(log_store, LocalChangeNotifier())
+    await reader_a.stop()  # writer only
+    for i, k in enumerate(keys):
+        await hub_a.commander.call(SetValue(k, i + 1))
+    return path, log_store
+
+
+def _cold_boot_reader(log_store):
+    hub_b = FusionHub()
+    svc_b = ValueService(hub_b)
+    hub_b.commander.add_service(svc_b)
+    hub_b.commander.attach_operations_pipeline()
+    from stl_fusion_tpu.oplog import OperationLogReader
+
+    reader_b = OperationLogReader(
+        log_store, hub_b.commander.operations, start_from_end=False
+    )
+    return hub_b, svc_b, reader_b
+
+
+async def test_reader_quarantines_corrupt_entry_and_resumes(tmp_path):
+    """A truncated committed entry (torn write) must not halt the reader:
+    it quarantines the row, REPLAYS everything else, and resumes at the
+    next good watermark — and the trimmer never trims past the range."""
+    import sqlite3
+
+    DB.clear()
+    keys = ["c1", "c2", "c3"]
+    path, log_store = await _write_ops(tmp_path, keys)
+    # truncate the MIDDLE committed entry's payload (a torn write)
+    conn = sqlite3.connect(path)
+    conn.execute("UPDATE operations SET command_json = substr(command_json, 1, 4) WHERE idx = 2")
+    conn.commit()
+    conn.close()
+
+    hub_b, svc_b, reader_b = _cold_boot_reader(log_store)
+    try:
+        nodes = {k: await capture(lambda k=k: svc_b.get(k)) for k in keys}
+        handled = await reader_b.read_new()
+        assert handled == 2  # ops 1 and 3 replayed; 2 quarantined, not fatal
+        assert reader_b.corrupt_seen == 1
+        assert reader_b.watermark == 3  # resumed past the poisoned row
+        assert len(reader_b.quarantined) == 1
+        rng = reader_b.quarantined[0]
+        assert (rng.first_index, rng.last_index) == (2, 2)
+        assert nodes["c1"].is_invalidated and nodes["c3"].is_invalidated
+        # the quarantined op's invalidation is LOST for this host (the
+        # documented degradation) — but the reader lives to deliver c3's
+        assert await svc_b.get("c1") == 1 and await svc_b.get("c3") == 3
+
+        # the trimmer clamps to the quarantine floor: records BELOW the
+        # quarantined range GC normally, the quarantined row and everything
+        # after it survive (the evidence + a future repair outlive GC)
+        trimmer = OperationLogTrimmer(log_store, max_age=0.0, quarantine_guard=reader_b)
+        assert trimmer.trim_once() <= 1  # at most the pre-quarantine record
+        assert trimmer.clamped_trims == 1
+        remaining = [r.index for r in log_store.read_after(0)]
+        assert remaining[0] == 2 or remaining == [1, 2, 3]  # corrupt row survives
+        assert 2 in remaining and 3 in remaining
+        # without the guard the same cutoff WOULD have emptied the log
+        assert OperationLogTrimmer(log_store, max_age=0.0).trim_once() == len(remaining)
+        assert log_store.read_after(0) == []
+    finally:
+        await reader_b.stop()
+        log_store.close()
+
+
+async def test_reader_detects_index_gap_and_resumes(tmp_path):
+    """Rows that VANISHED mid-sequence (external deletion, torn compaction)
+    are detected as an index gap, quarantined, and skipped — the reader
+    keeps replaying instead of silently mis-synchronizing."""
+    import sqlite3
+
+    DB.clear()
+    keys = ["g1", "g2", "g3", "g4"]
+    path, log_store = await _write_ops(tmp_path, keys)
+    conn = sqlite3.connect(path)
+    conn.execute("DELETE FROM operations WHERE idx = 2")
+    conn.commit()
+    conn.close()
+
+    hub_b, svc_b, reader_b = _cold_boot_reader(log_store)
+    try:
+        handled = await reader_b.read_new()
+        assert handled == 3  # 1, 3, 4
+        assert reader_b.gaps_seen == 1
+        rng = reader_b.quarantined[0]
+        assert (rng.first_index, rng.last_index) == (2, 2)
+        assert rng.commit_floor is not None  # dated by the last good record
+        assert reader_b.watermark == 4
+
+        # a gap records telemetry but does NOT clamp GC: its rows are
+        # already gone (and a routine trim can masquerade as a gap under
+        # commit-time/idx ordering skew — clamping would disable GC forever)
+        assert not rng.clamps_trimmer and reader_b.quarantine_floor() is None
+        trimmer = OperationLogTrimmer(log_store, max_age=0.0, quarantine_guard=reader_b)
+        assert trimmer.trim_once() == 3
+        assert trimmer.clamped_trims == 0
+    finally:
+        await reader_b.stop()
+        log_store.close()
+
+
+async def test_trimmer_resumes_normal_gc_without_quarantine(tmp_path):
+    """Guard wired but nothing quarantined ⇒ the trimmer GCs normally."""
+    DB.clear()
+    path, log_store = await _write_ops(tmp_path, ["n1", "n2"])
+    hub_b, svc_b, reader_b = _cold_boot_reader(log_store)
+    try:
+        await reader_b.read_new()
+        assert reader_b.quarantined == [] and reader_b.quarantine_floor() is None
+        trimmer = OperationLogTrimmer(log_store, max_age=0.0, quarantine_guard=reader_b)
+        assert trimmer.trim_once() == 2
+        assert trimmer.clamped_trims == 0
+    finally:
+        await reader_b.stop()
+        log_store.close()
 
 
 # ------------------------------------------------------ lane-packed batch replay
