@@ -27,7 +27,11 @@ p50/p99 fields then report None rather than a fake distribution),
 FUSION_BENCH_LATENCY_SAMPLES (96), FUSION_BENCH_LAT_LCAP/LAT_CAP (512/4096
 latency-kernel capacities), FUSION_BENCH_SHARDED=1 → mesh-sharded dense
 wave over all devices (bit-packed 32*WORDS-waves-per-pass kernel by
-default; FUSION_BENCH_SHARDED_PACKED=0 → one-wave-at-a-time chaining).
+default; FUSION_BENCH_SHARDED_PACKED=0 → one-wave-at-a-time chaining),
+FUSION_BENCH_FANOUT_CLIENTS (default 100; 0 skips) → the distributed
+fan-out section (perf/fanout_path.py: that many in-memory RPC clients
+subscribed across the live table while bursts run; FANOUT_* env knobs
+pass through).
 """
 import json
 import os
@@ -480,6 +484,37 @@ def run_live_section():
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def run_fanout_section():
+    """Embedded distributed fan-out measurement (ISSUE 2: the 10M burst and
+    the RPC layer, exercised together): perf/fanout_path.py as a subprocess
+    — FUSION_BENCH_FANOUT_CLIENTS in-memory clients subscribed across the
+    live table while lane bursts run, recording clients-fenced/s, keys per
+    batch frame, coalesce ratio, and the client-observed staleness window,
+    plus the per-key-vs-coalesced A/B. FUSION_BENCH_FANOUT_CLIENTS=0 skips."""
+    import subprocess
+
+    clients = int(os.environ.get("FUSION_BENCH_FANOUT_CLIENTS", 100))
+    if clients <= 0:
+        return None
+    env = dict(os.environ, FANOUT_CLIENTS=str(clients))
+    env.setdefault(
+        "FANOUT_NODES", os.environ.get("FUSION_BENCH_LIVE_NODES", str(10_000_000))
+    )
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "perf", "fanout_path.py"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], env=env, stdout=subprocess.PIPE, text=True,
+            timeout=3600,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "fanout path timed out"}
+    if proc.returncode != 0:
+        return {"error": f"fanout path failed rc={proc.returncode} (stderr inherited above)"}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def main() -> None:
     import jax
 
@@ -508,6 +543,9 @@ def main() -> None:
     live = run_live_section()
     if live is not None:
         detail["live"] = live
+    fanout = run_fanout_section()
+    if fanout is not None:
+        detail["fanout"] = fanout
     result = {
         "metric": "cascading_invalidations_per_sec",
         "value": round(inv_per_sec, 1),
@@ -520,14 +558,18 @@ def main() -> None:
     # every headline field — r4's full record overflowed the window and the
     # canonical capture lost its own headline (VERDICT r4 weak #3/#2).
     print("# full record: " + json.dumps(result), file=sys.stderr, flush=True)
-    print(json.dumps(_compact_result(inv_per_sec, detail, live), separators=(",", ":")))
+    print(
+        json.dumps(
+            _compact_result(inv_per_sec, detail, live, fanout), separators=(",", ":")
+        )
+    )
 
 
 def _r(v, nd=2):
     return None if v is None else round(float(v), nd)
 
 
-def _compact_result(inv_per_sec: float, detail: dict, live) -> dict:
+def _compact_result(inv_per_sec: float, detail: dict, live, fanout=None) -> dict:
     """The single stdout line: every headline metric, nothing that scales
     with run verbosity, target well under the driver's tail window."""
     out = {
@@ -589,6 +631,25 @@ def _compact_result(inv_per_sec: float, detail: dict, live) -> dict:
         }
         if out["live"]["phases"] is None:
             del out["live"]["phases"]
+    if fanout is not None and "error" in fanout:
+        out["fanout"] = {"error": fanout["error"]}
+    elif fanout is not None:
+        out["fanout"] = {
+            "clients": fanout.get("clients"),
+            "subs": fanout.get("subscriptions"),
+            "nodes": fanout.get("nodes"),
+            "speedup": fanout.get("coalesced_vs_perkey_speedup"),
+            "fenced_per_s": _r(fanout.get("coalesced_clients_fenced_per_s"), 1),
+            "fenced_per_s_perkey": _r(fanout.get("perkey_clients_fenced_per_s"), 1),
+            "keys_per_frame": fanout.get("coalesced_keys_per_frame"),
+            "coalesce_ratio": fanout.get("coalesced_coalesce_ratio"),
+            "staleness_ms_p50": fanout.get("coalesced_staleness_ms_p50"),
+            "staleness_ms_p99": fanout.get("coalesced_staleness_ms_p99"),
+            "delivery_ms_p50": fanout.get("coalesced_delivery_ms_p50"),
+            "delivery_ms_p99": fanout.get("coalesced_delivery_ms_p99"),
+            "lone_ms_p50": fanout.get("coalesced_lone_ms_p50"),
+            "lone_ms_p50_perkey": fanout.get("perkey_lone_ms_p50"),
+        }
     return out
 
 

@@ -246,7 +246,11 @@ async def main() -> None:
         t0 = time.perf_counter()
         info = backend.graph.build_topo_mirror()
         mirror_build_s = time.perf_counter() - t0
-        note(f"mirror built ({info['levels']} levels) in {mirror_build_s:.1f}s; warming programs...")
+        mirror_cache_hit = backend.graph.mirror_cache_hits > 0
+        note(
+            f"mirror built ({info['levels']} levels) in {mirror_build_s:.1f}s "
+            f"(disk cache {'HIT' if mirror_cache_hit else 'miss'}); warming programs..."
+        )
         t0 = time.perf_counter()
         backend.cascade_rows_batch(block, [n - 1])  # lat-mirror union compile
         gdev = backend.graph
@@ -691,6 +695,10 @@ async def main() -> None:
             "cold_start": {
                 "build_s": round(build_s, 2),
                 "mirror_build_s": round(mirror_build_s, 2),
+                # the restart-warmth contract (VERDICT r5 missing #2): a
+                # same-workspace restart must load the built mirror tables
+                # from FUSION_MIRROR_CACHE instead of re-deriving them
+                "mirror_cache_hit": mirror_cache_hit,
                 "lane_program_warm_s": round(lane_warm_s, 2),
                 "union_program_warm_s": round(union_warm_s, 2),
                 "refresh_program_warm_s": round(refresh_warm_s, 2),

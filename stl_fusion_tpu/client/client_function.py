@@ -87,10 +87,22 @@ class ClientComputed(Computed):
             self._bind_to_call(call)
 
     def _bind_to_call(self, call: RpcOutboundComputeCall) -> None:
-        def on_invalidated(_fut):
-            self.invalidate(immediately=True)
-
-        call.when_invalidated.add_done_callback(on_invalidated)
+        # sync callback, not a when_invalidated done_callback: the node
+        # invalidates IN the dispatch that applied the $sys-c frame (a
+        # done_callback defers by one loop hop per subscription — at
+        # fan-out scale those hops dominated the staleness window). An
+        # ALREADY-invalidated call (race with the result) keeps the
+        # deferred path: binding happens before the node's output is set,
+        # and an inline invalidate there would invert the output/invalidate
+        # order the retry logic expects.
+        if call.when_invalidated.done():
+            call.when_invalidated.add_done_callback(
+                lambda _f: self.invalidate(immediately=True)
+            )
+        else:
+            call.invalidated_callbacks.append(
+                lambda: self.invalidate(immediately=True)
+            )
         self.on_invalidated(lambda _c: call.unregister())
 
     # -- cache synchronization gate ---------------------------------------

@@ -58,6 +58,11 @@ class RpcOutboundComputeCall(RpcOutboundCall):
         super().__init__(peer, service, method, args, no_wait)
         self.result_version: Optional[LTag] = None
         self.when_invalidated: asyncio.Future = asyncio.get_event_loop().create_future()
+        #: sync callbacks run INSIDE set_invalidated — the bound
+        #: ClientComputed invalidates in the same dispatch that applied the
+        #: frame instead of one call_soon hop later; at fan-out scale those
+        #: hops were a measurable share of the staleness window
+        self.invalidated_callbacks: list = []
 
     def set_result(self, value: Any, message: RpcMessage) -> None:
         v = message.header(VERSION_HEADER)
@@ -102,6 +107,9 @@ class RpcOutboundComputeCall(RpcOutboundCall):
             )
         if not self.when_invalidated.done():
             self.when_invalidated.set_result(None)
+            callbacks, self.invalidated_callbacks = self.invalidated_callbacks, []
+            for cb in callbacks:
+                cb()
         self.peer.outbound_calls.pop(self.call_id, None)
 
     def unregister(self) -> None:
@@ -112,6 +120,10 @@ class RpcInboundComputeCall(RpcInboundCall):
     def __init__(self, peer, message):
         super().__init__(peer, message)
         self.computed = None
+        self._fanout_nid = None  # registered in the hub's ComputeFanoutIndex
+        #: set by the fanout index when a wave drain already shipped this
+        #: subscription's invalidation — the watch task must not re-send
+        self._invalidation_pushed = False
 
     async def _run(self) -> None:
         try:
@@ -145,8 +157,29 @@ class RpcInboundComputeCall(RpcInboundCall):
                 pass
             self.peer.inbound_calls.pop(self.call_id, None)
             return
-        # stay registered; push $sys-c.invalidate when the computed dies
-        asyncio.get_event_loop().create_task(self._watch_invalidation(computed))
+        # stay registered; push $sys-c when the computed dies. The push is
+        # armed as a SYNC on_invalidated handler, not a parked watch task:
+        # under coalescing the push is a dict insert into the peer outbox
+        # (flushed as one $sys-c.invalidate_batch per tick), so a burst
+        # fencing 10k subscriptions costs 10k inserts + N frames — not 10k
+        # task wakeups + 10k awaited sends. Graph-resident computeds ALSO
+        # index into the hub's fanout index (rpc/fanout.py) so a device
+        # burst's newly-mask drains them during wave application; the
+        # handler then just cleans up (``_invalidation_pushed``).
+        # (index registration honors the wire-compat flag: a hub serving
+        # per-key frames must not let the mask drain ship batch frames)
+        fanout = getattr(self.peer.hub, "compute_fanout", None)
+        nid = getattr(computed, "_backend_nid", None)
+        if (
+            fanout is not None
+            and nid is not None
+            and getattr(self.peer.hub, "coalesce_invalidations", True)
+        ):
+            self._fanout_nid = nid
+            fanout.register(
+                nid, self.peer, self.call_id, computed.version.format(), call=self
+            )
+        computed.on_invalidated(self._on_computed_invalidated)
 
     def restart(self) -> None:
         """Re-delivery after reconnect: if our computed already died, the
@@ -173,18 +206,63 @@ class RpcInboundComputeCall(RpcInboundCall):
             )
         return computed
 
-    async def _watch_invalidation(self, computed) -> None:
-        try:
-            await computed.when_invalidated()
-            await self._send_invalidation()
-        except asyncio.CancelledError:
-            pass
-        finally:
-            self.peer.inbound_calls.pop(self.call_id, None)
+    def _on_computed_invalidated(self, computed) -> None:
+        """Sync invalidation handler: unindex, unregister, push. Runs inside
+        the invalidation (host-led cascade or the wave's eager apply)."""
+        if self._fanout_nid is not None:
+            fanout = getattr(self.peer.hub, "compute_fanout", None)
+            if fanout is not None:
+                fanout.unregister(self._fanout_nid, self.peer, self.call_id)
+            self._fanout_nid = None
+        self.peer.inbound_calls.pop(self.call_id, None)
+        if self._invalidation_pushed:
+            return  # the wave drain already batched this subscription
+        if getattr(self.peer.hub, "coalesce_invalidations", True):
+            self._invalidation_pushed = True
+            version = computed.version.format() if computed is not None else None
+            try:
+                self.peer.outbox.post_invalidation(self.call_id, version)
+            except RuntimeError:  # no running loop: no live link to push to
+                pass
+        else:
+            # per-key wire shape: the send awaits the channel — needs a task
+            def _spawn():
+                asyncio.get_event_loop().create_task(self._send_invalidation())
+
+            try:
+                _spawn()
+            except RuntimeError:
+                # invalidation applied from an off-loop thread: marshal the
+                # spawn onto the peer's home loop (parity with the old
+                # watch task's threadsafe wakeup)
+                home = self.peer.outbox._home_loop
+                if home is not None and not home.is_closed():
+                    try:
+                        home.call_soon_threadsafe(_spawn)
+                    except RuntimeError:
+                        pass  # loop closed: peer is gone
 
     async def _send_invalidation(self, max_attempts: int = 100) -> None:
-        """Deliver $sys-c.invalidate, riding out reconnects: the subscription
-        must not be lost just because the link was down when it fired."""
+        """Deliver this subscription's invalidation.
+
+        Default path: POST into the peer's outbox coalescer — synchronous,
+        no awaited channel write per subscription; the outbox flushes one
+        ``$sys-c.invalidate_batch`` frame per drain tick (version-deduped)
+        and itself rides out reconnects (pending entries survive a link
+        flap). ``hub.coalesce_invalidations = False`` selects the original
+        one-frame-per-key wire shape below, kept for wire compat and as the
+        fan-out A/B baseline.
+
+        Callers: the per-key send task the invalidation handler spawns, and
+        ``restart()`` (a re-sent call means the client's state is unknown —
+        re-push unconditionally; ``_invalidation_pushed`` never gates here,
+        duplicate delivery is a client-side no-op)."""
+        if getattr(self.peer.hub, "coalesce_invalidations", True):
+            version = (
+                self.computed.version.format() if self.computed is not None else None
+            )
+            self.peer.outbox.post_invalidation(self.call_id, version)
+            return
         message = RpcMessage(
             call_type_id=CALL_TYPE_COMPUTE,
             call_id=self.call_id,
@@ -221,5 +299,20 @@ def install_compute_call_type(rpc_hub: "RpcHub") -> None:
             call = peer.outbound_calls.get(call_id)
             if isinstance(call, RpcOutboundComputeCall):
                 call.set_invalidated()
+        elif message.method == "invalidate_batch":
+            # one frame, many subscriptions: [[call_id, version|None], ...].
+            # Application is per-entry identical to a per-key invalidate —
+            # invalidation is monotone, so the entry's version never gates
+            # it (an entry for a version the client never saw still means
+            # "your value is stale"; the PR-1 version-mismatch rule in
+            # set_result covers the redelivered-result interaction, and a
+            # dup/reordered batch finds the call already unregistered and
+            # no-ops). The version rides for dedup at the sender and
+            # diagnostics here.
+            (entries,) = loads(message.argument_data)
+            for entry in entries:
+                call = peer.outbound_calls.get(entry[0])
+                if isinstance(call, RpcOutboundComputeCall):
+                    call.set_invalidated()
 
     rpc_hub.compute_system_handler = handle_compute_system

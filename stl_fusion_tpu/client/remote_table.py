@@ -120,6 +120,25 @@ class RemoteTableHost:
             self._push_fence(name, table.version, np.asarray(ids, dtype=np.int32))
 
         table.on_invalidate.append(on_invalidate)
+
+        def on_wave_invalidate(ids: np.ndarray) -> None:
+            # device bursts mark rows stale through the wave path, which
+            # keeps on_invalidate silent (the wave owns the cascade) — the
+            # backend fires this hook instead, so burst-fenced rows reach
+            # remote subscribers too (pre-coalescer they never did).
+            # Deferred via call_soon: the hook runs INSIDE wave application
+            # (backend contract: hooks must be cheap), and serializing a
+            # wave-sized id payload there would stall the burst.
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return  # no loop → no live link; reconnect covers it
+            loop.call_soon(
+                self._push_fence, name, table.version,
+                np.asarray(ids, dtype=np.int32),
+            )
+
+        table.on_wave_invalidate.append(on_wave_invalidate)
         return self
 
     def _require(self, name: str) -> "MemoTable":
@@ -139,6 +158,13 @@ class RemoteTableHost:
     def _push_fence(self, name: str, version: int, ids: np.ndarray) -> None:
         subs = self._subs.get(name, {})
         if not subs:
+            return
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            # wave applied outside any event loop (sync bench/test paths):
+            # there is no live connection to push to from here — subscribers
+            # recover via the reconnect invalidate-all contract
             return
         message = RpcMessage(
             call_type_id=0,

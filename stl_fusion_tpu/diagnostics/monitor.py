@@ -34,6 +34,10 @@ class FusionMonitor:
 
             resilience = global_events()
         self.resilience = resilience
+        #: RPC hubs whose fan-out/coalescer counters report() exports
+        #: (attach_rpc_hub); weakly referenced so a monitor never pins a
+        #: stopped hub's peer machinery
+        self._rpc_hubs: list = []
         # the hot-cache fast path counts amortized on the registry (every
         # 16th hit — see core/service.py) instead of firing a hook per hit
         self._fast_hits0 = getattr(hub.registry, "fast_hits", 0)
@@ -60,6 +64,35 @@ class FusionMonitor:
                 hooks.remove(fn)
             except ValueError:
                 pass
+
+    def attach_rpc_hub(self, rpc_hub) -> "FusionMonitor":
+        """Export an RPC hub's invalidation fan-out counters (per-peer
+        outbox coalescing, batch frames, fanout-index drains) in
+        :meth:`report` under ``"fanout"``."""
+        import weakref
+
+        self._rpc_hubs.append(weakref.ref(rpc_hub))
+        return self
+
+    def _fanout_report(self):
+        totals = None
+        for ref in self._rpc_hubs:
+            hub = ref()
+            if hub is None:
+                continue
+            stats = hub.fanout_stats()
+            if totals is None:
+                totals = stats
+            else:
+                for k, v in stats.items():
+                    if isinstance(v, dict):  # nested fanout_index counters
+                        sub = totals.setdefault(k, {})
+                        for kk, vv in v.items():
+                            if isinstance(vv, (int, float)):
+                                sub[kk] = sub.get(kk, 0) + vv
+                    elif isinstance(v, (int, float)):
+                        totals[k] = totals.get(k, 0) + v
+        return totals
 
     @property
     def accesses(self) -> int:
@@ -91,7 +124,10 @@ class FusionMonitor:
 
     def report(self) -> dict:
         elapsed = time.monotonic() - self._started_at
+        fanout = self._fanout_report()
+        extra = {"fanout": fanout} if fanout is not None else {}
         return {
+            **extra,
             "accesses": self.accesses,
             "computes": self.registrations,
             "invalidations": self.invalidations,

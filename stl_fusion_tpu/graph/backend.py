@@ -172,6 +172,14 @@ class TpuGraphBackend:
         self.watchdog = None
         self.waves_run = 0
         self.device_invalidations = 0
+        #: fired on every wave application with the newly-invalid set AS
+        #: THE DEVICE SHIPPED IT — an id array (small waves) or a bool mask
+        #: over node ids (lane bursts, 1 bit/node). The RPC fan-out index
+        #: (rpc/fanout.py) drains subscribed keys straight from here into
+        #: per-peer invalidation batches — no per-subscription watch-task
+        #: wakeup on the burst path. Hooks must be cheap and non-reentrant
+        #: (they run inside wave application).
+        self.newly_hooks: List = []
         hub.registry.on_register.append(self._on_register)
         hub.edge_added_hooks.append(self._on_edge_added)
         hub.invalidated_hooks.append(self._on_invalidated)
@@ -330,6 +338,7 @@ class TpuGraphBackend:
             journal, self._journal = self._journal, []
         if not journal:
             return
+        journal = self._coalesce_bump_epack_pairs(journal)
         icasc_parts: List[np.ndarray] = []
 
         def run_icasc() -> None:
@@ -358,6 +367,8 @@ class TpuGraphBackend:
             j = i
             while j < n and journal[j][0] == kind:
                 j += 1
+            # (after _coalesce_bump_epack_pairs, an N-recompute storm's
+            # alternating pairs arrive here as two long same-kind runs)
             batch = [payload for _, payload in journal[i:j]]
             if kind in ("cpack", "bump") and icasc_parts:
                 # a refresh/recompute of an ALREADY-ACCUMULATED mark must
@@ -404,6 +415,60 @@ class TpuGraphBackend:
             i = j
         if icasc_parts:
             run_icasc()
+
+    @staticmethod
+    def _coalesce_bump_epack_pairs(journal: List[Tuple[str, object]]) -> List[Tuple[str, object]]:
+        """Rewrite maximal alternating ``bump x, epack(→x), bump y,
+        epack(→y), ...`` runs (pairwise-distinct nids) into a bump run
+        followed by an epack run, so the batcher below replays them as ONE
+        epoch scatter + ONE edge append instead of 2N device dispatches.
+
+        This is the re-subscription/scalar-churn storm shape: every scalar
+        recompute of a row node journals exactly this pair
+        (``_on_register``), and at N recomputes per flush the per-op replay
+        dominated the live loop (~0.5 s/op at 10M — the r5 'scalar churn'
+        phase). Reordering is sound because the entries commute: an epack's
+        edges carry their DEPENDENT's current epoch, which only that
+        dependent's own bump (already ahead of it) changes — a later bump
+        of a DIFFERENT nid cannot affect them. A repeated nid ends the run
+        (its second bump must observe the first pair applied in order)."""
+        n = len(journal)
+        if n < 4:
+            return journal
+        out: List[Tuple[str, object]] = []
+        i = 0
+        while i < n:
+            if (
+                i + 3 < n
+                and journal[i][0] == "bump"
+                and journal[i + 1][0] == "epack"
+            ):
+                bumps: List[Tuple[str, object]] = []
+                epacks: List[Tuple[str, object]] = []
+                seen = set()
+                j = i
+                while (
+                    j + 1 < n
+                    and journal[j][0] == "bump"
+                    and journal[j + 1][0] == "epack"
+                    and journal[j][1] not in seen
+                ):
+                    nid = journal[j][1]
+                    _srcs, dsts = journal[j + 1][1]
+                    if len(dsts) == 0 or not (dsts == nid).all():
+                        break  # not the re-declare shape: keep strict order
+                    seen.add(nid)
+                    bumps.append(journal[j])
+                    epacks.append(journal[j + 1])
+                    j += 2
+                if len(bumps) > 1:
+                    out.extend(bumps)
+                    out.extend(epacks)
+                    i = j
+                    continue
+            out.append(journal[i])
+            i += 1
+        return out
 
     # ------------------------------------------------------------------ columnar ingest
     def bind_table_rows(self, table, n_rows: Optional[int] = None) -> RowBlock:
@@ -848,9 +913,14 @@ class TpuGraphBackend:
                 for bi in np.unique(idx[in_block]):
                     blk = self._row_blocks[int(bi)]
                     sel = in_block & (idx == bi)
-                    blk.table._mark_stale_from_wave(newly_ids[sel] - blk.base)
+                    local = newly_ids[sel] - blk.base
+                    blk.table._mark_stale_from_wave(local)
+                    for h in blk.table.on_wave_invalidate:
+                        h(np.asarray(local, dtype=np.int32))
         watched = newly_ids[self._watched[newly_ids]]
         self._pending[newly_ids] = True
+        for hook in self.newly_hooks:
+            hook(newly_ids)
         self._eager_invalidate(watched)
 
     def _apply_newly_mask(self, newly: np.ndarray) -> None:
@@ -862,8 +932,14 @@ class TpuGraphBackend:
             sub = newly[blk.base : min(blk.end(), n)]
             if sub.any():
                 blk.table._mark_stale_from_wave_mask(sub)
+                if blk.table.on_wave_invalidate:
+                    local = np.nonzero(sub)[0].astype(np.int32)
+                    for h in blk.table.on_wave_invalidate:
+                        h(local)
         self._pending[:n] |= newly
         watched = np.nonzero(newly & self._watched[:n])[0]
+        for hook in self.newly_hooks:
+            hook(newly)
         self._eager_invalidate(watched)
 
     def _eager_invalidate(self, watched_ids) -> None:

@@ -95,6 +95,13 @@ class MemoTable:
         self._valid_pending_n = 0
         self._packed_cache: Optional[tuple] = None  # (version, packed bits)
         self.on_invalidate: List[Callable[[np.ndarray], None]] = []
+        #: fired BY THE GRAPH BACKEND with the local row ids a DEVICE WAVE
+        #: marked stale (``_mark_stale_from_wave*`` itself stays silent —
+        #: the wave owns the cascade; these hooks are for EXTERNAL
+        #: observers such as the RPC fence push, which would otherwise
+        #: never learn of burst-driven staleness). Only fired when
+        #: non-empty, so unobserved tables pay nothing per wave.
+        self.on_wave_invalidate: List[Callable[[np.ndarray], None]] = []
         #: fired with the refreshed ids after a vectorized recompute — the
         #: columnar analogue of a recompute's consistency restoration (the
         #: graph backend subscribes to clear device invalid bits in bulk)

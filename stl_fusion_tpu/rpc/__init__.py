@@ -1,6 +1,8 @@
 """RPC layer — distributed communication backend (SURVEY.md §2.4)."""
 from .calls import RpcCallTypeRegistry, RpcInboundCall, RpcOutboundCall
+from .fanout import ComputeFanoutIndex, install_compute_fanout
 from .hub import RpcClientProxy, RpcHub, consistent_hash_router
+from .outbox import PeerOutbox
 from .message import COMPUTE_SYSTEM_SERVICE, SYSTEM_SERVICE, VERSION_HEADER, RpcMessage
 from .peer import ConnectionState, RpcClientPeer, RpcPeer, RpcServerPeer
 from .registry import RpcMethodDef, RpcServiceDef, RpcServiceRegistry, rpc_no_wait
@@ -14,6 +16,9 @@ from .middleware import (
 from .testing import RpcMultiServerTestTransport, RpcTestTransport
 
 __all__ = [
+    "ComputeFanoutIndex",
+    "PeerOutbox",
+    "install_compute_fanout",
     "RpcCallTypeRegistry",
     "RpcInboundCall",
     "RpcOutboundCall",

@@ -1,0 +1,179 @@
+"""ComputeFanoutIndex — newly-mask → subscribed-key extraction.
+
+The missing half of the coalesced fan-out (ISSUE 2 tentpole): the burst
+path already ships its newly-invalid set as a device-packed 1-bit/node
+mask (graph/backend.py ``_apply_newly_mask``); this index maps backend
+node ids to live ``$sys-c`` subscriptions so a wave's mask drains STRAIGHT
+into per-peer pending invalidation sets (``PeerOutbox.post_invalidation``)
+— one vectorized intersection per wave, no per-subscription watch-task
+wakeup on the burst path.
+
+The per-computed watch task (``RpcInboundComputeCall._watch_invalidation``)
+stays as the correctness backstop: host-led invalidations cascade through
+the host graph, not through a device wave, so only the watch task sees
+them. Both paths post into the same per-peer pending map, which dedups —
+a subscription fenced by the mask AND its watch task ships once per flush.
+
+Install with :func:`install_compute_fanout` on the SERVER rpc hub whose
+fusion hub has a :class:`~stl_fusion_tpu.graph.TpuGraphBackend` attached.
+"""
+from __future__ import annotations
+
+import logging
+import weakref
+from typing import TYPE_CHECKING, Dict, Optional, Tuple
+
+import numpy as np
+
+if TYPE_CHECKING:
+    from ..graph.backend import TpuGraphBackend
+    from .hub import RpcHub
+    from .peer import RpcPeer
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["ComputeFanoutIndex", "install_compute_fanout"]
+
+
+class ComputeFanoutIndex:
+    def __init__(self, rpc_hub: "RpcHub", backend: "TpuGraphBackend"):
+        self.rpc_hub = rpc_hub
+        self.backend = backend
+        #: nid → {(id(peer), call_id): (weakref(peer), version,
+        #: weakref(inbound call) | None)} — weak so a dead peer/call never
+        #: pins its connection machinery through the index
+        self._by_nid: Dict[
+            int, Dict[Tuple[int, int], Tuple[object, Optional[str], Optional[object]]]
+        ] = {}
+        self._nid_arr: Optional[np.ndarray] = None  # cache of _by_nid keys
+        backend.newly_hooks.append(self._on_newly)
+        self.subscriptions = 0  # live entries
+        self.registered_total = 0
+        self.drained_total = 0  # subscriptions fenced via the mask path
+        self.waves_seen = 0
+        self._disposed = False
+
+    def dispose(self) -> None:
+        """Detach from the backend's wave hooks and the hub (idempotent) —
+        the same contract FusionMonitor.dispose() has: without it every
+        constructed index keeps itself (and its hub) alive through the
+        backend's hook list forever."""
+        if self._disposed:
+            return
+        self._disposed = True
+        try:
+            self.backend.newly_hooks.remove(self._on_newly)
+        except ValueError:
+            pass
+        if self.rpc_hub.compute_fanout is self:
+            self.rpc_hub.compute_fanout = None
+        self._by_nid.clear()
+        self._nid_arr = None
+        self.subscriptions = 0
+
+    # ------------------------------------------------------------------ registry
+    def register(
+        self,
+        nid: int,
+        peer: "RpcPeer",
+        call_id: int,
+        version: Optional[str],
+        call=None,
+    ) -> None:
+        """Index one live subscription. ``call`` (the inbound compute call)
+        lets the drain stamp ``_invalidation_pushed`` so the per-computed
+        watch task doesn't send the same invalidation a second time."""
+        subs = self._by_nid.get(nid)
+        if subs is None:
+            subs = self._by_nid[nid] = {}
+            self._nid_arr = None
+        subs[(id(peer), call_id)] = (
+            weakref.ref(peer),
+            version,
+            weakref.ref(call) if call is not None else None,
+        )
+        self.subscriptions += 1
+        self.registered_total += 1
+
+    def unregister(self, nid: int, peer: "RpcPeer", call_id: int) -> None:
+        subs = self._by_nid.get(nid)
+        if subs is None:
+            return
+        if subs.pop((id(peer), call_id), None) is not None:
+            self.subscriptions -= 1
+        if not subs:
+            del self._by_nid[nid]
+            self._nid_arr = None
+
+    # ------------------------------------------------------------------ drain
+    def _subscribed_nids(self) -> np.ndarray:
+        if self._nid_arr is None:
+            self._nid_arr = np.fromiter(
+                self._by_nid.keys(), dtype=np.int64, count=len(self._by_nid)
+            )
+        return self._nid_arr
+
+    def _on_newly(self, newly) -> None:
+        """Wave-application hook: intersect the newly-invalid set with the
+        subscribed nids (vectorized) and post each hit's (call_id, version)
+        into its peer's outbox pending map (the outbox marshals posts from
+        off-loop callers onto its home loop). Runs inside wave application
+        — O(subscribed) + one mask gather, never O(wave)."""
+        if not self._by_nid:
+            return
+        if not getattr(self.rpc_hub, "coalesce_invalidations", True):
+            # wire-compat mode flipped ON after registrations were made:
+            # leave delivery to the per-key invalidation handlers (the
+            # pushed-flag is never set, so nothing is lost)
+            return
+        self.waves_seen += 1
+        nids = self._subscribed_nids()
+        if isinstance(newly, np.ndarray) and newly.dtype == np.bool_:
+            n = len(newly)
+            in_range = nids[nids < n]
+            hits = in_range[newly[in_range]]
+        else:
+            newly_ids = np.asarray(newly)
+            if newly_ids.size == 0:
+                return
+            hits = nids[np.isin(nids, newly_ids)]
+        for nid in hits.tolist():
+            subs = self._by_nid.pop(nid, None)
+            if subs is None:
+                continue
+            self._nid_arr = None
+            self.subscriptions -= len(subs)
+            self.drained_total += len(subs)
+            for (_pid, call_id), (peer_ref, version, call_ref) in subs.items():
+                peer = peer_ref()
+                if peer is None:
+                    continue
+                if call_ref is not None:
+                    call = call_ref()
+                    if call is not None:
+                        # the watch-task backstop will still wake (the
+                        # computed invalidates host-side too) but must not
+                        # ship this subscription a second time
+                        call._invalidation_pushed = True
+                peer.outbox.post_invalidation(call_id, version)
+
+    def stats(self) -> dict:
+        return {
+            "subscriptions": self.subscriptions,
+            "registered_total": self.registered_total,
+            "drained_total": self.drained_total,
+            "waves_seen": self.waves_seen,
+        }
+
+
+def install_compute_fanout(rpc_hub: "RpcHub", backend: "TpuGraphBackend") -> ComputeFanoutIndex:
+    """Wire the burst newly-mask to the hub's ``$sys-c`` subscriptions.
+    Idempotent per (hub, backend) pairing; returns the index."""
+    existing = rpc_hub.compute_fanout
+    if existing is not None:
+        if existing.backend is backend:
+            return existing
+        raise ValueError("this hub already has a fanout index on another backend")
+    index = ComputeFanoutIndex(rpc_hub, backend)
+    rpc_hub.compute_fanout = index
+    return index

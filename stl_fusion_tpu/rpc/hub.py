@@ -67,6 +67,16 @@ class RpcHub:
         )
         #: $sys-c dispatch hook, installed by the fusion client layer
         self.compute_system_handler: Optional[Callable[[RpcPeer, RpcMessage], None]] = None
+        #: True (default): server-side invalidation pushes coalesce through
+        #: each peer's outbox into one ``$sys-c.invalidate_batch`` frame per
+        #: drain tick (version-deduped). False: the original one-frame-per-
+        #: key ``$sys-c.invalidate`` path — kept for wire compat with old
+        #: clients and as the A/B baseline (perf/fanout_path.py). Clients
+        #: always understand BOTH frame kinds regardless of this flag.
+        self.coalesce_invalidations: bool = True
+        #: optional ComputeFanoutIndex (rpc/fanout.py): lets a device
+        #: wave's newly-mask drain straight into per-peer batches
+        self.compute_fanout: Optional[Any] = None
         #: $sys-t dispatch hook (per-table row fences + subscriptions),
         #: installed by client/remote_table.py on both ends
         self.table_system_handler: Optional[Callable[[RpcPeer, RpcMessage], None]] = None
@@ -150,6 +160,31 @@ class RpcHub:
     async def stop(self) -> None:
         for peer in list(self.peers.values()):
             await peer.stop()
+
+    # ------------------------------------------------------------------ diagnostics
+    def fanout_stats(self) -> dict:
+        """Aggregate outbox/coalescer counters over every peer (plus the
+        fanout index's, when installed) — exported through
+        ``FusionMonitor.report()`` so the fan-out path is observable."""
+        totals = {
+            "messages_sent": 0,
+            "invalidations_posted": 0,
+            "invalidations_coalesced": 0,
+            "batch_frames_sent": 0,
+            "batch_keys_sent": 0,
+            "pending_dropped": 0,
+            "queued": 0,
+            "pending_invalidations": 0,
+        }
+        for peer in self.peers.values():
+            ob = peer._outbox
+            if ob is None:
+                continue
+            for k, v in ob.stats().items():
+                totals[k] += v
+        if self.compute_fanout is not None:
+            totals["fanout_index"] = self.compute_fanout.stats()
+        return totals
 
 
 class RpcClientProxy:

@@ -26,6 +26,7 @@ from .message import COMPUTE_SYSTEM_SERVICE, SYSTEM_SERVICE, TABLE_SYSTEM_SERVIC
 
 if TYPE_CHECKING:
     from .hub import RpcHub
+    from .outbox import PeerOutbox
 
 log = logging.getLogger("stl_fusion_tpu")
 
@@ -91,6 +92,7 @@ class RpcPeer(WorkerBase):
         self._call_id_counter = itertools.count(1)
         self._conn: Optional[ChannelPair] = None
         self._resend_failures = 0  # consecutive connect-then-die-on-resend
+        self._outbox: Optional["PeerOutbox"] = None
 
     # ------------------------------------------------------------------ id/state
     def allocate_call_id(self) -> int:
@@ -188,9 +190,47 @@ class RpcPeer(WorkerBase):
         e._transport_death = True  # see _send_raw
         return e
 
+    @property
+    def outbox(self) -> "PeerOutbox":
+        """The per-peer outbound drain queue + invalidation coalescer
+        (created lazily — a peer that never sends never pays for it)."""
+        if self._outbox is None:
+            from .outbox import PeerOutbox
+
+            self._outbox = PeerOutbox(self)
+        return self._outbox
+
     async def send(self, message: RpcMessage) -> None:
+        """Deliver one message, in per-peer FIFO order.
+
+        Routed through the outbox drain queue: concurrent senders no longer
+        interleave on the raw channel (order is the queue's, surviving
+        whatever order the loop wakes tasks in), and a sender behind a slow
+        frame is parked in the queue instead of on the channel. The error
+        contract is unchanged — this resolves when the message hit the
+        channel and raises what the channel write raised. The no-backlog
+        fast path below keeps a lone send at its pre-outbox cost (one
+        awaited channel write, no queue hop)."""
         if self._conn is None:
             raise self._not_connected(self.ref)
+        outbox = self._outbox
+        if outbox is None or outbox.can_bypass():
+            ob = outbox if outbox is not None else self.outbox
+            ob._in_flight = True
+            try:
+                await self._send_now(message)
+                ob.messages_sent += 1
+            finally:
+                ob._in_flight = False
+                if ob._fifo or ob._pending_inval:
+                    ob._kick()
+            return
+        await outbox.send(message)
+
+    async def _send_now(self, message: RpcMessage) -> None:
+        """The raw delivery step (middlewares + channel write) — only the
+        outbox drain and its bypass fast path may call this; everything
+        else goes through :meth:`send` so FIFO order holds."""
         mws = self.hub.outbound_middlewares
         if mws:
             await _run_middlewares(mws, self, message, self._send_raw)
@@ -335,6 +375,8 @@ class RpcPeer(WorkerBase):
 
     async def stop(self) -> None:
         await self.disconnect()
+        if self._outbox is not None:
+            self._outbox.stop()
         await super().stop()
 
 

@@ -42,6 +42,44 @@ class _FlakyPair:
     def close(self, error: Optional[BaseException] = None) -> None:
         self._pair.close(error)
 
+
+class _CodecChannel:
+    """One direction of a codec-faithful link: send serializes the whole
+    message (envelope included), receive deserializes."""
+
+    def __init__(self, inner, encode: bool):
+        self._inner = inner
+        self._encode = encode
+
+    async def send(self, message) -> None:
+        from ..utils.serialization import dumps
+
+        await self._inner.send(dumps(message) if self._encode else message)
+
+    async def receive(self):
+        from ..utils.serialization import loads
+
+        item = await self._inner.receive()
+        return loads(item) if not self._encode else item
+
+    def close(self, error: Optional[BaseException] = None) -> None:
+        self._inner.close(error)
+
+
+class _CodecPair:
+    """Codec-faithful endpoint wrapper: every frame pays full envelope
+    serialization both ways, like a real socket transport (the raw twisted
+    channels pass Python objects, which understates per-frame cost — a
+    fan-out benchmark over them would flatter the per-key baseline)."""
+
+    def __init__(self, pair: ChannelPair):
+        self._pair = pair
+        self.writer = _CodecChannel(pair.writer, encode=True)
+        self.reader = _CodecChannel(pair.reader, encode=False)
+
+    def close(self, error: Optional[BaseException] = None) -> None:
+        self._pair.close(error)
+
 __all__ = ["RpcTestTransportBase", "RpcTestTransport", "RpcMultiServerTestTransport"]
 
 
@@ -49,12 +87,16 @@ class RpcTestTransportBase:
     """Channel-pair transport plumbing shared by the single- and
     multi-server variants; subclasses pick the server hub per peer ref."""
 
-    def __init__(self, client_hub: RpcHub):
+    def __init__(self, client_hub: RpcHub, wire_codec: bool = False):
         self.client_hub = client_hub
         self.connect_count: Dict[str, int] = {}
         self._blocked = False
         self._fail_next_after: Optional[int] = None
         self._chaos = None
+        #: True → every frame is dumps()ed on send and loads()ed on receive
+        #: (both directions, both ends) — the serialization cost a real
+        #: socket transport pays per frame
+        self.wire_codec = wire_codec
         client_hub.client_connector = self._connect
 
     def _server_for(self, peer_ref: str) -> RpcHub:
@@ -65,6 +107,9 @@ class RpcTestTransportBase:
             raise ConnectionError("test transport is blocked")
         server_hub = self._server_for(peer.ref)
         client_end, server_end = create_twisted_pair()
+        if self.wire_codec:
+            client_end = _CodecPair(client_end)
+            server_end = _CodecPair(server_end)
         if self._chaos is not None:
             from ..resilience.chaos import wrap_chaos_pair
 
@@ -107,8 +152,8 @@ class RpcTestTransportBase:
 class RpcTestTransport(RpcTestTransportBase):
     """Wires a client hub to a server hub through channel pairs."""
 
-    def __init__(self, client_hub: RpcHub, server_hub: RpcHub):
-        super().__init__(client_hub)
+    def __init__(self, client_hub: RpcHub, server_hub: RpcHub, wire_codec: bool = False):
+        super().__init__(client_hub, wire_codec=wire_codec)
         self.server_hub = server_hub
 
     def _server_for(self, peer_ref: str) -> RpcHub:
@@ -120,8 +165,8 @@ class RpcMultiServerTestTransport(RpcTestTransportBase):
     the in-memory analogue of the MultiServerRpc sample's server pool
     (samples/MultiServerRpc/Program.cs:58-76): peer ref = pool member."""
 
-    def __init__(self, client_hub: RpcHub, servers: Dict[str, RpcHub]):
-        super().__init__(client_hub)
+    def __init__(self, client_hub: RpcHub, servers: Dict[str, RpcHub], wire_codec: bool = False):
+        super().__init__(client_hub, wire_codec=wire_codec)
         self.servers = dict(servers)
 
     def _server_for(self, peer_ref: str) -> RpcHub:
