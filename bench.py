@@ -628,9 +628,13 @@ def _compact_result(inv_per_sec: float, detail: dict, live, fanout=None) -> dict
             # per-phase loop breakdown (live_path emits it from r5 on —
             # the burst/sustained gap itemization, VERDICT r4 #6)
             "phases": live.get("loop_phases"),
+            # wave-profiler summary (ISSUE 3): the system's own per-wave
+            # device/apply/flush accounting + whether telemetry ran
+            "telemetry": live.get("telemetry"),
         }
-        if out["live"]["phases"] is None:
-            del out["live"]["phases"]
+        for opt in ("phases", "telemetry"):
+            if out["live"][opt] is None:
+                del out["live"][opt]
     if fanout is not None and "error" in fanout:
         out["fanout"] = {"error": fanout["error"]}
     elif fanout is not None:
@@ -649,6 +653,9 @@ def _compact_result(inv_per_sec: float, detail: dict, live, fanout=None) -> dict
             "delivery_ms_p99": fanout.get("coalesced_delivery_ms_p99"),
             "lone_ms_p50": fanout.get("coalesced_lone_ms_p50"),
             "lone_ms_p50_perkey": fanout.get("perkey_lone_ms_p50"),
+            # the system's own per-mode delivery slice (ISSUE 3), beside
+            # the harness percentiles — they must agree to bucket width
+            "system_delivery_ms": fanout.get("coalesced_system_delivery_ms"),
         }
     return out
 

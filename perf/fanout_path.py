@@ -200,6 +200,17 @@ async def run_mode(
     server_rpc.compute_fanout = fanout_index if coalesced else None
     # counter snapshot (outboxes accumulate across modes)
     snap = server_rpc.fanout_stats()
+    # per-mode slice of the SYSTEM's delivery histogram: the global
+    # histogram accumulates across modes and the lone-latency probes, so a
+    # whole-run snapshot would blend per-key and coalesced samples — the
+    # checkpoint diff isolates exactly this mode's distribution
+    from stl_fusion_tpu.diagnostics import global_metrics
+
+    delivery_hist = global_metrics().histogram(
+        "fusion_e2e_delivery_ms",
+        help="server wave apply -> client invalidation apply",
+    )
+    delivery_cp = delivery_hist.checkpoint()
 
     total_subs = sum(len(c.keys) for c in clients)
     observer = Observer()
@@ -260,6 +271,10 @@ async def run_mode(
     fenced = total_subs * rounds
     frames = delta["batch_frames_sent"]
     return {
+        # the system's own delivery numbers for THIS mode (ISSUE 3): must
+        # agree with the harness-measured delivery_ms_p50/p99 below to
+        # bucket resolution — the in-system histogram owns the number now
+        "system_delivery_ms": delivery_hist.since(delivery_cp),
         "clients_fenced_total": fenced,
         "clients_fenced_per_s": round(fenced / fanout_s, 1) if fanout_s else None,
         "fanout_s": round(fanout_s, 4),

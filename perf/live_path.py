@@ -35,7 +35,10 @@ Env: LIVE_NODES (default 1_000_000), LIVE_DEG (3), LIVE_ROUNDS (6),
 LIVE_LANE_GROUPS (512), LIVE_LANE_SEEDS (8),
 LIVE_SCALAR_NODES (20000; 0 skips), LIVE_LAT_WAVES (32; 0 skips),
 LIVE_EDGE_CHURN (2000/round — level-aware realistic churn, see
-make_churn_edges), LIVE_SCALAR_CHURN (4/round).
+make_churn_edges), LIVE_SCALAR_CHURN (4/round),
+LIVE_TELEMETRY (1; 0 disables the wave profiler — the A/B knob for the
+<3% observability-overhead budget; the result's ``telemetry`` section
+records which mode ran so BENCH_*.json tracks it).
 """
 import asyncio
 import json
@@ -161,6 +164,7 @@ async def main() -> None:
     lat_waves = int(os.environ.get("LIVE_LAT_WAVES", 32))
     edge_churn = int(os.environ.get("LIVE_EDGE_CHURN", 2000))
     scalar_churn = int(os.environ.get("LIVE_SCALAR_CHURN", 4))
+    telemetry_on = os.environ.get("LIVE_TELEMETRY", "1") != "0"
     rng = np.random.default_rng(123)
 
     note(f"generating {n}-node power-law DAG...")
@@ -177,6 +181,7 @@ async def main() -> None:
             # dense re-upload inside a timed round
             edge_capacity=len(src) + max(65536, 4 * edge_churn * rounds),
         )
+        backend.profiler.enabled = telemetry_on
         Dag = make_dag_service(n)
         svc = Dag(hub)
         hub.add_service(svc, "dag")
@@ -690,6 +695,12 @@ async def main() -> None:
             "mirror_passes_final": (
                 gdev._topo_mirror.get("passes", 1) if gdev._topo_mirror else None
             ),
+            # wave-profiler summary (ISSUE 3): the system's own account of
+            # where wave time went — device vs host-apply vs journal flush —
+            # recorded into BENCH_*.json so observability overhead is
+            # tracked release over release (LIVE_TELEMETRY=0 is the
+            # disabled baseline for the <3% budget A/B)
+            "telemetry": backend.profiler.summary(),
             # cold-start budget (VERDICT r3 #8) — one-time per workspace
             # thanks to the persistent compilation cache
             "cold_start": {

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Telemetry smoke (ISSUE 3 CI step): boot a small server+client pair,
+drive one burst through the full stack (device wave → fanout index → outbox
+batch frame → wire-codec channel → client apply), then scrape the HTTP
+gateway's ``/metrics`` and assert
+
+- the Prometheus exposition PARSES (every sample line is ``name value``),
+- the end-to-end delivery histogram (``fusion_e2e_delivery_ms``) is
+  NON-EMPTY — i.e. the system measured its own fan-out latency, no harness
+  stopwatch involved,
+- ``/trace`` serves JSON with the monitor report (waves + delivery).
+
+Prints ONE JSON summary line on stdout; exits non-zero on any failed check.
+
+Env: TELEMETRY_NODES (default 512), TELEMETRY_CLIENTS (4),
+TELEMETRY_KEYS (4 per client).
+"""
+import asyncio
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stl_fusion_tpu.client import compute_client, install_compute_call_type  # noqa: E402
+from stl_fusion_tpu.core import (  # noqa: E402
+    ComputeService,
+    FusionHub,
+    TableBacking,
+    capture,
+    compute_method,
+    memo_table_of,
+    set_default_hub,
+)
+from stl_fusion_tpu.diagnostics import FusionMonitor, global_metrics  # noqa: E402
+from stl_fusion_tpu.graph import TpuGraphBackend  # noqa: E402
+from stl_fusion_tpu.rpc import RpcHub, RpcTestTransport, install_compute_fanout  # noqa: E402
+from stl_fusion_tpu.rpc.http_gateway import FusionHttpServer  # noqa: E402
+
+
+def note(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+async def http_get(host, port, path):
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(
+        f"GET {path} HTTP/1.1\r\nHost: {host}\r\nConnection: close\r\n\r\n".encode()
+    )
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return head.split(b"\r\n", 1)[0].decode(), body
+
+
+def parse_exposition(text: str) -> dict:
+    """Every non-comment line must be ``name value`` with a float value —
+    the 'exposition parses' acceptance check."""
+    samples = {}
+    for line in text.strip().splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        samples[name] = float(value)
+    return samples
+
+
+async def main() -> int:
+    n = int(os.environ.get("TELEMETRY_NODES", 512))
+    n_clients = int(os.environ.get("TELEMETRY_CLIENTS", 4))
+    keys_per_client = int(os.environ.get("TELEMETRY_KEYS", 4))
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        backend = TpuGraphBackend(hub, node_capacity=n + 8, edge_capacity=4 * n)
+
+        class Tbl(ComputeService):
+            def __init__(self, h=None):
+                super().__init__(h)
+                self.base = np.arange(n, dtype=np.float32)
+
+            def load(self, ids):
+                return self.base[np.asarray(ids, dtype=np.int64)]
+
+            @compute_method(table=TableBacking(rows=n, batch="load"))
+            async def node(self, i: int) -> float:
+                return float(self.base[i])
+
+        svc = Tbl(hub)
+        hub.add_service(svc, "tbl")
+        table = memo_table_of(svc.node)
+        block = backend.bind_table_rows(table)
+        src = np.arange(0, n - 1, dtype=np.int64)
+        dst = np.arange(1, n, dtype=np.int64)  # one long chain
+        backend.declare_row_edges(block, src, block, dst)
+        table.read_batch(np.arange(n))
+        backend.flush()
+
+        server_rpc = RpcHub("server")
+        install_compute_call_type(server_rpc)
+        server_rpc.add_service("tbl", svc)
+        install_compute_fanout(server_rpc, backend)
+        monitor = FusionMonitor(hub).attach_rpc_hub(server_rpc)
+        monitor.start_reporter(period=30.0)
+
+        gateway = FusionHttpServer(server_rpc)
+        gateway.monitor = monitor
+        await gateway.start()
+        note(f"gateway at {gateway.url}")
+
+        # clients subscribe over codec-faithful channels
+        nodes = []
+        client_rpcs = []
+        for i in range(n_clients):
+            crpc = RpcHub(f"client-{i}")
+            install_compute_call_type(crpc)
+            RpcTestTransport(crpc, server_rpc, wire_codec=True)
+            proxy = compute_client("tbl", crpc, FusionHub(), peer_ref=f"c{i}")
+            for k in range(keys_per_client):
+                key = n - 1 - (i * keys_per_client + k)
+                nodes.append(await capture(lambda key=key: proxy.node(int(key))))
+            client_rpcs.append(crpc)
+        note(f"{len(nodes)} subscriptions live; bursting from row 0...")
+
+        backend.cascade_rows_batch(block, [0])  # the chain fences every key
+        await asyncio.wait_for(
+            asyncio.gather(*(nd.when_invalidated() for nd in nodes)), 30.0
+        )
+        await asyncio.sleep(0.05)  # let outbox drains settle
+
+        status, body = await http_get(gateway.host, gateway.port, "/metrics")
+        assert status.endswith("200 OK"), status
+        samples = parse_exposition(body.decode())
+        delivery_count = samples.get("fusion_e2e_delivery_ms_count", 0)
+        assert delivery_count >= len(nodes), (
+            f"e2e delivery histogram has {delivery_count} samples, "
+            f"expected >= {len(nodes)} — the system did not measure its own fan-out"
+        )
+        assert samples.get("fusion_batch_frames_sent_total", 0) >= 1
+        assert samples.get("fusion_waves_run_total", 0) >= 1
+
+        status, body = await http_get(gateway.host, gateway.port, "/trace")
+        assert status.endswith("200 OK"), status
+        trace = json.loads(body)
+        report = trace["report"]
+        assert report["delivery"]["count"] >= len(nodes)
+        assert report["waves"]["waves_recorded"] >= 1
+        cause = report["waves"]["recent"][-1]["cause"]
+        assert nodes[0].invalidation_cause == cause, (
+            nodes[0].invalidation_cause, cause,
+        )
+
+        print(json.dumps({
+            "metric": "telemetry_smoke",
+            "ok": True,
+            "subscriptions": len(nodes),
+            "delivery_count": int(delivery_count),
+            "delivery_p50_ms": report["delivery"]["p50"],
+            "delivery_p99_ms": report["delivery"]["p99"],
+            "waves_recorded": report["waves"]["waves_recorded"],
+            "exposition_samples": len(samples),
+            "cause": cause,
+        }))
+        monitor.dispose()
+        await gateway.stop()
+        for crpc in client_rpcs:
+            await crpc.stop()
+        await server_rpc.stop()
+        return 0
+    finally:
+        set_default_hub(old)
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

@@ -105,6 +105,16 @@ class ClientComputed(Computed):
             )
         self.on_invalidated(lambda _c: call.unregister())
 
+    @property
+    def invalidation_cause(self):
+        """Cause id of the server-side wave/span that invalidated this node
+        (carried in the ``$sys-c`` frame, ISSUE 3) — the client end of the
+        cross-peer trace link; None while consistent or for cache-only
+        nodes. Falls back to the locally-stamped cause (a client-side graph
+        backend's wave) when no call delivered one."""
+        call_cause = self.call.invalidation_cause if self.call is not None else None
+        return call_cause or self._invalidation_cause
+
     # -- cache synchronization gate ---------------------------------------
     @property
     def is_synchronized(self) -> bool:

@@ -18,9 +18,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import TYPE_CHECKING, Any, Optional
 
 from ..core.context import try_capture
+from ..diagnostics.metrics import global_metrics
 from ..utils.ltag import LTag
 from ..utils.serialization import dumps, loads
 from ..rpc.calls import RpcInboundCall, RpcOutboundCall
@@ -51,12 +53,35 @@ class ResultMissedError(Exception):
     invalidate-only). Retriable: the client just re-issues the call."""
 
 
+#: cached delivery histogram: set_invalidated runs once per applied key in
+#: a fan-out burst, and a registry get-or-create there (name sanitize +
+#: lock) would tax the exact path PR 2 optimized. Cached once; a test that
+#: clears the global registry mid-run keeps recording into the detached
+#: instance (nothing in-repo does that).
+_delivery_hist = None
+
+
+def _record_delivery(delta_ms: float) -> None:
+    global _delivery_hist
+    h = _delivery_hist
+    if h is None:
+        h = _delivery_hist = global_metrics().histogram(
+            "fusion_e2e_delivery_ms",
+            help="server wave apply -> client invalidation apply",
+        )
+    h.record(delta_ms)
+
+
 class RpcOutboundComputeCall(RpcOutboundCall):
     call_type_id = CALL_TYPE_COMPUTE
 
     def __init__(self, peer, service, method, args, no_wait=False):
         super().__init__(peer, service, method, args, no_wait)
         self.result_version: Optional[LTag] = None
+        #: cause id of the server-side wave/span whose invalidation fenced
+        #: this call (ISSUE 3 trace propagation); None until invalidated or
+        #: when the server predates cause stamping
+        self.invalidation_cause: Optional[str] = None
         self.when_invalidated: asyncio.Future = asyncio.get_event_loop().create_future()
         #: sync callbacks run INSIDE set_invalidated — the bound
         #: ClientComputed invalidates in the same dispatch that applied the
@@ -91,7 +116,7 @@ class RpcOutboundComputeCall(RpcOutboundCall):
         super().set_error(error)
         self.set_invalidated()  # an errored call can't deliver invalidations
 
-    def set_invalidated(self) -> None:
+    def set_invalidated(self, cause: Optional[str] = None, origin_ts: Optional[float] = None) -> None:
         """Single-connection delivery is ordered (result, then invalidate —
         the reference leans on that, RpcOutboundComputeCall.cs:71-83), but
         two of our paths deliver an invalidate while the result future is
@@ -100,7 +125,26 @@ class RpcOutboundComputeCall(RpcOutboundCall):
         with invalidate-ONLY when its computed is already stale. No result
         can be counted on after that, so a pending future fails with the
         retriable ``ResultMissedError`` (the client's already-invalidated
-        retry loop handles it) instead of parking the caller forever."""
+        retry loop handles it) instead of parking the caller forever.
+
+        ``cause``/``origin_ts`` arrive from the ``$sys-c`` frame: the cause
+        links this fence to its originating server wave; the origin
+        timestamp yields the end-to-end delivery sample recorded into the
+        process histogram (``fusion_e2e_delivery_ms``). The timestamp is a
+        ``perf_counter`` value — the histogram is only TRUSTWORTHY when
+        both ends share the clock (in-process / same-host stacks, the
+        bench/test/CI shape). Across hosts perf_counter epochs are
+        unrelated: the range guard below rejects the samples that land
+        outside [0, 1h) but CANNOT detect epochs that happen to differ by
+        less — a cross-host deployment must treat this histogram as
+        unreliable until a wall-clock variant ships (OBSERVABILITY.md
+        lists it as an open item)."""
+        if cause is not None:
+            self.invalidation_cause = cause
+        if origin_ts is not None:
+            delta_ms = (time.perf_counter() - origin_ts) * 1e3
+            if 0.0 <= delta_ms < 3.6e6:  # range guard, NOT skew detection
+                _record_delivery(delta_ms)
         if self.future is not None and not self.future.done():
             self.future.set_exception(
                 ResultMissedError(f"invalidation overtook the result of call {self.call_id}")
@@ -221,7 +265,12 @@ class RpcInboundComputeCall(RpcInboundCall):
             self._invalidation_pushed = True
             version = computed.version.format() if computed is not None else None
             try:
-                self.peer.outbox.post_invalidation(self.call_id, version)
+                self.peer.outbox.post_invalidation(
+                    self.call_id,
+                    version,
+                    cause=getattr(computed, "_invalidation_cause", None),
+                    origin_ts=time.perf_counter(),
+                )
             except RuntimeError:  # no running loop: no live link to push to
                 pass
         else:
@@ -257,18 +306,25 @@ class RpcInboundComputeCall(RpcInboundCall):
         ``restart()`` (a re-sent call means the client's state is unknown —
         re-push unconditionally; ``_invalidation_pushed`` never gates here,
         duplicate delivery is a client-side no-op)."""
+        cause = getattr(self.computed, "_invalidation_cause", None)
         if getattr(self.peer.hub, "coalesce_invalidations", True):
             version = (
                 self.computed.version.format() if self.computed is not None else None
             )
-            self.peer.outbox.post_invalidation(self.call_id, version)
+            self.peer.outbox.post_invalidation(
+                self.call_id, version, cause=cause, origin_ts=time.perf_counter()
+            )
             return
+        headers = [("@t0", repr(time.perf_counter()))]
+        if cause is not None:
+            headers.append(("@cause", cause))
         message = RpcMessage(
             call_type_id=CALL_TYPE_COMPUTE,
             call_id=self.call_id,
             service=COMPUTE_SYSTEM_SERVICE,
             method="invalidate",
             argument_data=dumps([self.call_id]),
+            headers=tuple(headers),
         )
         for _ in range(max_attempts):
             try:
@@ -298,7 +354,11 @@ def install_compute_call_type(rpc_hub: "RpcHub") -> None:
             (call_id,) = loads(message.argument_data)
             call = peer.outbound_calls.get(call_id)
             if isinstance(call, RpcOutboundComputeCall):
-                call.set_invalidated()
+                t0 = message.header("@t0")
+                call.set_invalidated(
+                    cause=message.header("@cause"),
+                    origin_ts=float(t0) if t0 else None,
+                )
         elif message.method == "invalidate_batch":
             # one frame, many subscriptions: [[call_id, version|None], ...].
             # Application is per-entry identical to a per-key invalidate —
@@ -313,6 +373,11 @@ def install_compute_call_type(rpc_hub: "RpcHub") -> None:
             for entry in entries:
                 call = peer.outbound_calls.get(entry[0])
                 if isinstance(call, RpcOutboundComputeCall):
-                    call.set_invalidated()
+                    # wire compat: pre-ISSUE-3 senders ship [cid, ver];
+                    # current senders [cid, ver, cause, origin_ts]
+                    call.set_invalidated(
+                        cause=entry[2] if len(entry) > 2 else None,
+                        origin_ts=entry[3] if len(entry) > 3 else None,
+                    )
 
     rpc_hub.compute_system_handler = handle_compute_system

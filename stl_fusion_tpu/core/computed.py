@@ -60,6 +60,7 @@ class Computed(Generic[T]):
         "_delayed_invalidation_pending",
         "_lock",
         "_backend_nid",
+        "_invalidation_cause",
         "_ka_renewed_until",
         "_ka_skip",
         "__weakref__",
@@ -78,6 +79,10 @@ class Computed(Generic[T]):
         self._delayed_invalidation_pending = False
         self._lock = threading.Lock()
         self._backend_nid: Optional[int] = None  # device-mirror node id
+        #: cause id of the wave/mutation that invalidated this node (ISSUE 3
+        #: trace propagation) — stamped by the backend's eager apply; None
+        #: for plain host-led invalidations outside any wave
+        self._invalidation_cause: Optional[str] = None
         self._ka_renewed_until = 0.0  # keep-alive renewal throttle window
         self._ka_skip = 0  # hit-count renewal amortizer (see renew_timeouts)
 

@@ -2,11 +2,20 @@
 reports, activity-style tracing spans, and explicit graph-invariant sweeps
 (the build's race-detection story)."""
 from .invariants import InvariantReport, InvariantViolation, validate_hub, validate_mirror
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    WaveProfiler,
+    global_metrics,
+)
 from .monitor import FusionMonitor
 from .tracing import (
     ActivitySource,
     Span,
     add_listener,
+    clear_recent,
     current_span,
     get_activity_source,
     recent_spans,
@@ -22,8 +31,15 @@ __all__ = [
     "ActivitySource",
     "Span",
     "add_listener",
+    "clear_recent",
     "current_span",
     "get_activity_source",
     "recent_spans",
     "remove_listener",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "WaveProfiler",
+    "global_metrics",
 ]

@@ -20,9 +20,23 @@ __all__ = ["FusionMonitor"]
 
 
 class FusionMonitor:
-    def __init__(self, hub: "FusionHub", report_period: float = 60.0, resilience=None):
+    def __init__(
+        self,
+        hub: "FusionHub",
+        report_period: float = 60.0,
+        resilience=None,
+        metrics=None,
+    ):
         self.hub = hub
         self.report_period = report_period
+        #: MetricsRegistry the report pulls shared telemetry from (the
+        #: end-to-end delivery histogram the client apply path records);
+        #: defaults to the process-wide registry
+        if metrics is None:
+            from .metrics import global_metrics
+
+            metrics = global_metrics()
+        self.metrics = metrics
         self._slow_accesses = 0
         self.registrations = 0
         self.invalidations = 0
@@ -44,17 +58,46 @@ class FusionMonitor:
         self._started_at = time.monotonic()
         self._last_report = self._started_at
         self._disposed = False
+        self._reporter_task = None
         hub.registry.on_access.append(self._on_access)
         hub.registry.on_register.append(self._on_register)
         hub.invalidated_hooks.append(self._on_invalidated)
 
+    def start_reporter(self, period: float = None):
+        """Emit the periodic report from a BACKGROUND task instead of
+        piggybacking on ``_on_access``: an idle-but-subscribed process
+        (a server holding live ``$sys-c`` subscriptions with no local
+        reads) never fires ``_on_access``, so it never reported at all.
+        Requires a running event loop; idempotent while running; stopped
+        for good by :meth:`dispose`."""
+        import asyncio
+
+        if self._disposed:
+            raise RuntimeError("monitor is disposed")
+        if self._reporter_task is not None and not self._reporter_task.done():
+            return self._reporter_task
+        if period is not None:
+            self.report_period = period
+
+        async def _report_loop():
+            while True:
+                await asyncio.sleep(self.report_period)
+                self._last_report = time.monotonic()
+                log.info("fusion stats: %s", self.report())
+
+        self._reporter_task = asyncio.get_event_loop().create_task(_report_loop())
+        return self._reporter_task
+
     def dispose(self) -> None:
-        """Detach all three hub hooks (idempotent). Without this every
-        constructed monitor kept counting — and kept ITSELF alive through
-        the hub's hook lists — forever."""
+        """Detach all three hub hooks and stop the background reporter
+        (idempotent). Without this every constructed monitor kept counting
+        — and kept ITSELF alive through the hub's hook lists — forever."""
         if self._disposed:
             return
         self._disposed = True
+        if self._reporter_task is not None:
+            self._reporter_task.cancel()
+            self._reporter_task = None
         for hooks, fn in (
             (self.hub.registry.on_access, self._on_access),
             (self.hub.registry.on_register, self._on_register),
@@ -126,6 +169,18 @@ class FusionMonitor:
         elapsed = time.monotonic() - self._started_at
         fanout = self._fanout_report()
         extra = {"fanout": fanout} if fanout is not None else {}
+        # per-wave timelines: the hub's graph backend carries the profiler
+        backend = getattr(self.hub, "graph_backend", None)
+        profiler = getattr(backend, "profiler", None)
+        if profiler is not None:
+            extra["waves"] = profiler.report()
+        # end-to-end delivery: wave applied server-side -> client apply,
+        # measured INSIDE the system (the $sys-c origin timestamp), not by
+        # a harness. find(), not histogram(): reporting must never mint an
+        # empty metric.
+        delivery = self.metrics.find("fusion_e2e_delivery_ms")
+        if delivery is not None:
+            extra["delivery"] = delivery.snapshot()
         return {
             **extra,
             "accesses": self.accesses,

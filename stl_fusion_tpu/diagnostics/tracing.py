@@ -22,7 +22,15 @@ from typing import Any, Callable, Deque, Dict, List, Optional
 
 log = logging.getLogger("stl_fusion_tpu.tracing")
 
-__all__ = ["Span", "ActivitySource", "get_activity_source", "add_listener", "remove_listener", "recent_spans"]
+__all__ = [
+    "Span",
+    "ActivitySource",
+    "get_activity_source",
+    "add_listener",
+    "remove_listener",
+    "recent_spans",
+    "clear_recent",
+]
 
 _span_ids = itertools.count(1)
 _current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
@@ -55,6 +63,22 @@ class Span:
     def set_tag(self, key: str, value: Any) -> "Span":
         self.tags[key] = value
         return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-safe span view (the ``/trace`` gateway route ships these)."""
+        return {
+            "source": self.source,
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "duration_ms": (
+                round(self.duration * 1e3, 4) if self.duration is not None else None
+            ),
+            "error_type": self.error_type,
+            "error_message": self.error_message,
+            "tags": {k: repr(v) if not isinstance(v, (int, float, str, bool, type(None))) else v
+                     for k, v in self.tags.items()},
+        }
 
     def __enter__(self) -> "Span":
         self.span_id = next(_span_ids)
@@ -112,3 +136,12 @@ def recent_spans(source: Optional[str] = None, name: Optional[str] = None) -> Li
         for s in _recent
         if (source is None or s.source == source) and (name is None or s.name == name)
     ]
+
+
+def clear_recent() -> None:
+    """Drop the recorded span buffer. The buffer (and the listener list)
+    are module-level state that would otherwise LEAK across tests — a span
+    recorded by one test shows up in the next test's ``recent_spans()``.
+    ``tests/conftest.py`` calls this per test (and snapshots/restores the
+    listener list) so span assertions are hermetic."""
+    _recent.clear()

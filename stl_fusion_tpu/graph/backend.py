@@ -35,13 +35,18 @@ the same version-match rule the reference applies per-edge
 """
 from __future__ import annotations
 
+import itertools
 import logging
+import os
 import threading
+import time
 import weakref
 from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..diagnostics.metrics import WaveProfiler, global_metrics
+from ..diagnostics.tracing import current_span
 from .device_graph import DeviceGraph
 
 if TYPE_CHECKING:
@@ -52,6 +57,10 @@ if TYPE_CHECKING:
 log = logging.getLogger("stl_fusion_tpu")
 
 __all__ = ["TpuGraphBackend", "RowBlock"]
+
+#: process-unique cause-id prefix: two hosts minting "wave#1" must not
+#: collide when their frames meet in one client's telemetry
+_CAUSE_PREFIX = f"{os.getpid():x}"
 
 
 class RowBlock:
@@ -180,10 +189,62 @@ class TpuGraphBackend:
         #: wakeup on the burst path. Hooks must be cheap and non-reentrant
         #: (they run inside wave application).
         self.newly_hooks: List = []
+        #: per-wave timeline recorder (ISSUE 3): every wave dispatch records
+        #: seeds / newly / device-vs-host ms / journal depth / cause id into
+        #: a ring buffer surfaced by FusionMonitor.report()["waves"] and the
+        #: bench telemetry section. ``profiler.enabled = False`` reduces the
+        #: instrumentation to attribute checks.
+        self.profiler = WaveProfiler()
+        #: cause id of the wave currently being applied (stamped into
+        #: $sys-c frames by the fan-out index) + the host timestamp the
+        #: apply started at — the origin end of the end-to-end delivery
+        #: histogram
+        self.last_cause_id: Optional[str] = None
+        self.last_wave_applied_ts: Optional[float] = None
+        self._cause_seq = itertools.count(1)
         hub.registry.on_register.append(self._on_register)
         hub.edge_added_hooks.append(self._on_edge_added)
         hub.invalidated_hooks.append(self._on_invalidated)
         hub.attach_graph_backend(self)
+        global_metrics().register_collector(self, TpuGraphBackend._collect_metrics)
+
+    def _collect_metrics(self) -> dict:
+        """Pull-time gauges for /metrics (weak-registered — a dead backend
+        drops out of the scrape on its own)."""
+        return {
+            "fusion_graph_nodes": self.graph.n_nodes,
+            "fusion_graph_edges": self.graph.n_edges,
+            "fusion_graph_journal_depth": len(self._journal),
+            "fusion_waves_run_total": self.waves_run,
+            "fusion_device_invalidations_total": self.device_invalidations,
+        }
+
+    def _begin_wave(self) -> str:
+        """Mint this wave's cause id: the active tracing span when one is
+        open (a command/mutation running under CommandTracer — the wave
+        then links back to its originating span, SURVEY §5.1's activity
+        propagation), else a process-unique sequence id. The id rides the
+        fan-out into ``$sys-c`` frame entries so a client fence can name
+        the server-side wave that caused it."""
+        span = current_span()
+        if span is not None:
+            cause = f"{_CAUSE_PREFIX}/{span.source}:{span.name}#{span.span_id}"
+        else:
+            cause = f"{_CAUSE_PREFIX}/wave#{next(self._cause_seq)}"
+        self.last_cause_id = cause
+        return cause
+
+    def _profile_wave(self, kind, seeds, cause, t0, t1, newly, groups=None) -> None:
+        if self.profiler.enabled:
+            self.profiler.record_wave(
+                kind,
+                seeds=seeds,
+                newly=newly,
+                device_ms=(t1 - t0) * 1e3,
+                apply_ms=(time.perf_counter() - t1) * 1e3,
+                cause=cause,
+                groups=groups,
+            )
 
     # ------------------------------------------------------------------ event feed
     def _on_register(self, computed: "Computed") -> None:
@@ -338,10 +399,16 @@ class TpuGraphBackend:
             journal, self._journal = self._journal, []
         if not journal:
             return
+        t_flush0 = time.perf_counter()
+        journal_pre = len(journal)
         journal = self._coalesce_bump_epack_pairs(journal)
+        journal_post = len(journal)
         icasc_parts: List[np.ndarray] = []
+        icasc_s = 0.0  # embedded wave time: reported on the wave records,
+        # subtracted from flush_ms so the two never double-count
 
         def run_icasc() -> None:
+            nonlocal icasc_s
             # Union expansion for the accumulated table marks (seeds
             # conduct even while already invalid — ops/wave.py). The seeds
             # themselves are NOT re-applied: each table marked its own rows
@@ -353,13 +420,18 @@ class TpuGraphBackend:
             # invalidate_local under _applying_ids): no flush re-entry.
             nids = np.unique(np.concatenate(icasc_parts))
             icasc_parts.clear()
+            cause = self._begin_wave()
+            t0 = time.perf_counter()
             was_clear = nids[~self.graph._h_invalid[nids]]
             total, newly_ids = self._wave_union([nids.tolist()])
             newly_ids = newly_ids[~np.isin(newly_ids, nids)]
             if was_clear.size:
                 self.graph.clear_invalid_ids(was_clear)
+            t1 = time.perf_counter()
             self._apply_newly(newly_ids)
             self.device_invalidations += total
+            self._profile_wave("icasc", len(nids), cause, t0, t1, len(newly_ids))
+            icasc_s += time.perf_counter() - t0
 
         i, n = 0, len(journal)
         while i < n:
@@ -415,6 +487,12 @@ class TpuGraphBackend:
             i = j
         if icasc_parts:
             run_icasc()
+        if self.profiler.enabled:
+            self.profiler.note_flush(
+                journal_pre,
+                journal_post,
+                (time.perf_counter() - t_flush0 - icasc_s) * 1e3,
+            )
 
     @staticmethod
     def _coalesce_bump_epack_pairs(journal: List[Tuple[str, object]]) -> List[Tuple[str, object]]:
@@ -615,10 +693,14 @@ class TpuGraphBackend:
         # — per-level full-edge gathers over the pow2-padded edge arrays
         # lose to one depth-free mirror sweep. The mirror union is the
         # lone-wave path too.
+        cause = self._begin_wave()
+        t0 = time.perf_counter()
         total, newly_ids = self._wave_union([nids.tolist()])
+        t1 = time.perf_counter()
         self._apply_newly(newly_ids)
         self.waves_run += 1
         self.device_invalidations += total
+        self._profile_wave("union", len(nids), cause, t0, t1, len(newly_ids))
         return total
 
     def refresh_block_on_device(self, block: RowBlock) -> int:
@@ -781,10 +863,17 @@ class TpuGraphBackend:
             (block.base + self._check_rows(block, rows)).tolist()
             for rows in row_batches
         ]
+        cause = self._begin_wave()
+        t0 = time.perf_counter()
         counts, union_ids = self._wave_union_seq(seed_lists)
+        t1 = time.perf_counter()
         self._apply_newly(union_ids)
         self.waves_run += len(seed_lists)
         self.device_invalidations += int(counts.sum())
+        self._profile_wave(
+            "seq", sum(len(s) for s in seed_lists), cause, t0, t1,
+            int(counts.sum()), groups=len(seed_lists),
+        )
         return counts
 
     def cascade_rows_lanes(self, block: RowBlock, row_groups) -> np.ndarray:
@@ -796,10 +885,17 @@ class TpuGraphBackend:
         seed_lists = [
             (block.base + self._check_rows(block, g)).tolist() for g in row_groups
         ]
+        cause = self._begin_wave()
+        t0 = time.perf_counter()
         counts, union_ids = self._wave_lanes(seed_lists)
+        t1 = time.perf_counter()
         self._apply_newly(union_ids)
         self.waves_run += len(seed_lists)
         self.device_invalidations += int(counts.sum())
+        self._profile_wave(
+            "lanes", sum(len(s) for s in seed_lists), cause, t0, t1,
+            int(counts.sum()), groups=len(seed_lists),
+        )
         return counts
 
     # ------------------------------------------------------------------ offload
@@ -815,10 +911,14 @@ class TpuGraphBackend:
         if nid is None:
             computed.invalidate(immediately=True)
             return 1
+        cause = self._begin_wave()
+        t0 = time.perf_counter()
         count, newly_ids = self.graph.run_wave_collect([nid], cap=collect_cap)
+        t1 = time.perf_counter()
         self._apply_newly(newly_ids)
         self.waves_run += 1
         self.device_invalidations += count
+        self._profile_wave("collect", 1, cause, t0, t1, len(newly_ids))
         return count
 
     def invalidate_cascade_batch(self, computeds: Sequence["Computed"]) -> int:
@@ -841,10 +941,14 @@ class TpuGraphBackend:
                 seeds.append([nid])
         if not seeds:
             return fallback
+        cause = self._begin_wave()
+        t0 = time.perf_counter()
         total, newly_ids = self._wave_union(seeds)
+        t1 = time.perf_counter()
         self._apply_newly(newly_ids)
         self.waves_run += len(seeds)
         self.device_invalidations += total
+        self._profile_wave("union", len(seeds), cause, t0, t1, len(newly_ids))
         return total + fallback
 
     def invalidate_cascade_batch_lanes(
@@ -875,10 +979,17 @@ class TpuGraphBackend:
                 else:
                     ids.append(nid)
             seed_lists.append(ids)
+        cause = self._begin_wave()
+        t0 = time.perf_counter()
         counts, union_ids = self._wave_lanes(seed_lists)
+        t1 = time.perf_counter()
         self._apply_newly(union_ids)
         self.waves_run += len(groups)
         self.device_invalidations += int(counts.sum())
+        self._profile_wave(
+            "lanes", sum(len(s) for s in seed_lists), cause, t0, t1,
+            int(counts.sum()), groups=len(groups),
+        )
         return counts + fallback
 
     def build_topo_mirror(self, k: int = 4, cap: int = 65536) -> dict:
@@ -897,6 +1008,7 @@ class TpuGraphBackend:
         BOOL MASK over node ids (lane bursts: millions of rows travel as
         1 bit/node and apply as vectorized mask ops — materializing ids
         was ~a third of r4's per-burst cost at 10M)."""
+        self.last_wave_applied_ts = time.perf_counter()
         if isinstance(newly, np.ndarray) and newly.dtype == np.bool_:
             return self._apply_newly_mask(newly)
         newly_ids = newly
@@ -950,6 +1062,10 @@ class TpuGraphBackend:
             c = self.computed_for(node_id)
             if c is None:
                 continue
+            # cause propagation: the sync invalidation handlers this fires
+            # (RpcInboundComputeCall._on_computed_invalidated) read the
+            # stamp to tag their $sys-c push with the originating wave
+            c._invalidation_cause = self.last_cause_id
             self._applying_ids.add(node_id)
             try:
                 c.invalidate_local()
@@ -1091,6 +1207,8 @@ class TpuGraphBackend:
         # permanently ahead and a retry of the same seeds would find
         # nothing newly-invalid (a silently dropped cascade)
         entry.pop("invalid_version", None)
+        cause = self._begin_wave()
+        t0 = time.perf_counter()
         count, newly_ids, overflow = sharded.run_wave_collect(seeds)
         if overflow:
             # wave larger than the collect buffer: one mask-diff readback
@@ -1099,9 +1217,11 @@ class TpuGraphBackend:
             newly_ids = np.nonzero(newly)[0].astype(np.int32)
         dg.mark_invalid(newly_ids)  # dense device + host mirror catch up
         entry["invalid_version"] = dg.invalid_version  # in sync again
+        t1 = time.perf_counter()
         self._apply_newly(newly_ids)
         self.waves_run += 1
         self.device_invalidations += count
+        self._profile_wave("sharded_union", len(seeds), cause, t0, t1, len(newly_ids))
         return count
 
     def packed_mirror(self, mesh=None) -> dict:
@@ -1243,6 +1363,8 @@ class TpuGraphBackend:
             dg._h_invalid[: dg.n_nodes] = mask
             entry["blocked"] = pg.put_blocked(mask)
         entry.pop("invalid_version", None)  # out-of-sync until apply completes
+        cause = self._begin_wave()
+        t0 = time.perf_counter()
         counts, union_ids, blocked2, overflow = pg.run_gated_lanes(
             seed_lists, entry["blocked"]
         )
@@ -1252,9 +1374,14 @@ class TpuGraphBackend:
             union_ids = np.nonzero(newly)[0].astype(np.int32)
         dg.mark_invalid(union_ids)
         entry["invalid_version"] = dg.invalid_version
+        t1 = time.perf_counter()
         self._apply_newly(union_ids)
         self.waves_run += len(seed_lists)
         self.device_invalidations += int(counts.sum())
+        self._profile_wave(
+            "sharded_lanes", sum(len(s) for s in seed_lists), cause, t0, t1,
+            int(counts.sum()), groups=len(seed_lists),
+        )
         return counts
 
     def computed_for(self, node_id: int):

@@ -153,6 +153,26 @@ class OperationLogReader(WorkerBase):
         else:
             self.watermark = log_store.last_index() if start_from_end else 0
         self.external_seen = 0
+        # reader-lag gauge for /metrics (ISSUE 3): how far this reader's
+        # watermark trails the writer's last index — THE cross-host
+        # staleness number. Weak-registered; a dead reader drops out.
+        from ..diagnostics.metrics import global_metrics
+
+        global_metrics().register_collector(self, OperationLogReader._collect_metrics)
+        # non-additive: the WORST reader's lag, never the sum over readers
+        global_metrics().set_aggregation("fusion_oplog_reader_lag", "max")
+
+    def _collect_metrics(self) -> dict:
+        try:
+            lag = max(self.log_store.last_index() - self.watermark, 0)
+        except Exception:  # noqa: BLE001 — a failing store must not kill a scrape
+            lag = -1
+        return {
+            "fusion_oplog_reader_lag": lag,
+            "fusion_oplog_external_seen_total": self.external_seen,
+            "fusion_oplog_corrupt_seen_total": self.corrupt_seen,
+            "fusion_oplog_gaps_seen_total": self.gaps_seen,
+        }
 
     async def on_run(self) -> None:
         wake = self.notifier.subscribe() if self.notifier is not None else None

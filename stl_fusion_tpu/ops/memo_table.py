@@ -123,8 +123,22 @@ class MemoTable:
         self.key_arity: Optional[int] = None
         self.changed: AsyncEvent = AsyncEvent(0)
         self._jit_cache = _kernels()  # shared: tables reuse one compile cache
+        # /metrics exposure (ISSUE 3): stale backlog + version, summed over
+        # live tables at scrape time — weak-registered, a collected table
+        # drops out on its own; read_batch/invalidate never pay a registry hop
+        from ..diagnostics.metrics import global_metrics
+
+        global_metrics().register_collector(self, MemoTable._collect_metrics)
         if eager:
             self.refresh(np.arange(self.n_rows))
+
+    def _collect_metrics(self) -> dict:
+        return {
+            "fusion_memo_tables": 1,
+            "fusion_memo_rows": self.n_rows,
+            "fusion_memo_stale_rows": self._stale_count,
+            "fusion_memo_versions_total": self.version,
+        }
 
     # ------------------------------------------------------------------ reads
     def read_batch(self, ids: Ids):

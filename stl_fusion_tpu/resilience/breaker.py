@@ -97,8 +97,23 @@ class PeerCircuitBreaker(WorkerBase):
         self._gate = gate
         self.peer.hub.connect_gates.append(gate)
         self.peer.breaker = self  # type: ignore[attr-defined]
+        # breaker-state gauges for /metrics (ISSUE 3): weak-registered, so a
+        # disposed/collected breaker drops out of the scrape on its own
+        from ..diagnostics.metrics import global_metrics
+
+        global_metrics().register_collector(self, PeerCircuitBreaker._collect_metrics)
         self.start()
         return self
+
+    def _collect_metrics(self) -> dict:
+        return {
+            "fusion_breakers": 1,
+            "fusion_breakers_open": 1 if self.state == BreakerState.OPEN else 0,
+            "fusion_breakers_half_open": 1 if self.state == BreakerState.HALF_OPEN else 0,
+            "fusion_breaker_opens_total": self.opens,
+            "fusion_breaker_closes_total": self.closes,
+            "fusion_breaker_quarantined_dials_total": self.quarantined_dials,
+        }
 
     async def dispose(self) -> None:
         if self._gate is not None:
@@ -109,6 +124,9 @@ class PeerCircuitBreaker(WorkerBase):
             self._gate = None
         if getattr(self.peer, "breaker", None) is self:
             self.peer.breaker = None  # type: ignore[attr-defined]
+        from ..diagnostics.metrics import global_metrics
+
+        global_metrics().unregister_collector(self)
         await self.stop()
 
     # ------------------------------------------------------------------ scoring

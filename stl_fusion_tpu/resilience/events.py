@@ -57,7 +57,22 @@ class ResilienceEvents:
 #: the process-wide default ledger: components that aren't handed an explicit
 #: registry record here, so FusionMonitor.report() sees them with no wiring
 _GLOBAL = ResilienceEvents()
+_METRICS_REGISTERED = False
 
 
 def global_events() -> ResilienceEvents:
+    # lazily expose the ledger's counters through the process metrics
+    # registry (/metrics route, ISSUE 3): one collector, registered the
+    # first time anything touches the ledger
+    global _METRICS_REGISTERED
+    if not _METRICS_REGISTERED:
+        _METRICS_REGISTERED = True
+        from ..diagnostics.metrics import global_metrics
+
+        global_metrics().register_collector(
+            _GLOBAL,
+            lambda ev: {
+                f"fusion_resilience_{k}_total": v for k, v in ev.counters.items()
+            },
+        )
     return _GLOBAL

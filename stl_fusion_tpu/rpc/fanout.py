@@ -127,6 +127,11 @@ class ComputeFanoutIndex:
             # pushed-flag is never set, so nothing is lost)
             return
         self.waves_seen += 1
+        # the wave's identity + apply timestamp: stamped into every posted
+        # entry so the client fence links back to this wave and the e2e
+        # delivery histogram measures from the apply moment (ISSUE 3)
+        cause = getattr(self.backend, "last_cause_id", None)
+        origin_ts = getattr(self.backend, "last_wave_applied_ts", None)
         nids = self._subscribed_nids()
         if isinstance(newly, np.ndarray) and newly.dtype == np.bool_:
             n = len(newly)
@@ -155,7 +160,9 @@ class ComputeFanoutIndex:
                         # computed invalidates host-side too) but must not
                         # ship this subscription a second time
                         call._invalidation_pushed = True
-                peer.outbox.post_invalidation(call_id, version)
+                peer.outbox.post_invalidation(
+                    call_id, version, cause=cause, origin_ts=origin_ts
+                )
 
     def stats(self) -> dict:
         return {

@@ -133,6 +133,19 @@ class FusionHttpServer:
         #: path → (content_type, body): static pages served next to the
         #: JSON API (the sample-UI host path, ≈ MapBlazorHub + index.html)
         self.static_routes: dict = {}
+        #: observability routes (ISSUE 3): GET /metrics — Prometheus text
+        #: exposition of the process registry; GET /trace — recent tracing
+        #: spans (+ the attached monitor's report, waves and delivery
+        #: histogram included, when :attr:`monitor` is set). Served ONLY to
+        #: peers :meth:`_is_trusted_proxy` accepts (default: loopback — the
+        #: sidecar scraper shape; with :attr:`proxy_shared_secret` set the
+        #: scraper must send it in ``x-auth-request-secret``): span tags
+        #: carry command arguments and the report names internals, so a
+        #: direct remote client gets 404, never the dump. Flip off to drop
+        #: the routes entirely.
+        self.serve_observability: bool = True
+        #: optional diagnostics.FusionMonitor whose report() /trace embeds
+        self.monitor = None
         self._server: Optional[asyncio.AbstractServer] = None
 
     def _is_trusted_proxy(self, headers: dict) -> bool:
@@ -185,7 +198,45 @@ class FusionHttpServer:
             body = await reader.readexactly(content_length) if content_length else b""
             peer = writer.get_extra_info("peername")
             headers["_ip"] = peer[0] if peer else ""
-            static = self.static_routes.get(urllib.parse.urlsplit(target).path)
+            path = urllib.parse.urlsplit(target).path
+            observability = (
+                self.serve_observability
+                and method == "GET"
+                and path in ("/metrics", "/trace")
+                # same trust gate as principal headers: loopback (or the
+                # shared scraper secret) only — a direct remote client must
+                # not read spans/reports off a port it happens to reach
+                and self._is_trusted_proxy(headers)
+            )
+            if observability and path == "/metrics":
+                from ..diagnostics.metrics import global_metrics
+
+                raw = global_metrics().render_prometheus().encode()
+                writer.write(
+                    "HTTP/1.1 200 OK\r\n"
+                    "Content-Type: text/plain; version=0.0.4; charset=utf-8\r\n"
+                    f"Content-Length: {len(raw)}\r\nConnection: close\r\n\r\n".encode()
+                    + raw
+                )
+                await writer.drain()
+                return
+            if observability and path == "/trace":
+                from ..diagnostics.tracing import recent_spans
+
+                payload: dict = {
+                    "spans": [s.to_dict() for s in recent_spans()[-256:]],
+                }
+                if self.monitor is not None:
+                    payload["report"] = self.monitor.report()
+                raw = json.dumps(payload, default=repr).encode()
+                writer.write(
+                    "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                    f"Content-Length: {len(raw)}\r\nConnection: close\r\n\r\n".encode()
+                    + raw
+                )
+                await writer.drain()
+                return
+            static = self.static_routes.get(path)
             if static is not None and method == "GET":
                 ctype, content = static
                 raw = content.encode() if isinstance(content, str) else content

@@ -12,8 +12,10 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Sequence
 
+from ..diagnostics.metrics import global_metrics
 from ..utils.async_utils import ChannelPair
 from .calls import RpcCallTypeRegistry, RpcOutboundCall
 from .message import RpcMessage
@@ -96,6 +98,44 @@ class RpcHub:
         self.connect_gates: List[Callable[[RpcClientPeer], Awaitable[None]]] = []
         #: local service fallback for routing proxies
         self.local_services: Dict[str, Any] = {}
+        # /metrics exposure: weak-registered pull-time collector — counters
+        # stay plain attributes on the hot paths; the registry sums across
+        # every live hub only when someone actually scrapes (ISSUE 3)
+        global_metrics().register_collector(self, RpcHub._collect_metrics)
+        # non-additive: the worst pending age across hubs, never the sum
+        global_metrics().set_aggregation("fusion_outbox_pending_age_ms", "max")
+
+    def _collect_metrics(self) -> dict:
+        s = self.fanout_stats()
+        out = {
+            "fusion_outbox_queued": s["queued"],
+            "fusion_outbox_pending_invalidations": s["pending_invalidations"],
+            "fusion_outbox_messages_sent_total": s["messages_sent"],
+            "fusion_invalidations_posted_total": s["invalidations_posted"],
+            "fusion_invalidations_coalesced_total": s["invalidations_coalesced"],
+            "fusion_batch_frames_sent_total": s["batch_frames_sent"],
+            "fusion_batch_keys_sent_total": s["batch_keys_sent"],
+            "fusion_outbox_pending_dropped_total": s["pending_dropped"],
+            "fusion_rpc_peers": len(self.peers),
+        }
+        fi = s.get("fanout_index")
+        if fi is not None:
+            out["fusion_fanout_subscriptions"] = fi["subscriptions"]
+            out["fusion_fanout_drained_total"] = fi["drained_total"]
+            out["fusion_fanout_waves_seen_total"] = fi["waves_seen"]
+        # flush-tick lag gauge: how long the OLDEST pending invalidation has
+        # sat coalescing (0 when nothing is pending). The shipped-frame lag
+        # distribution is the fusion_outbox_flush_lag_ms histogram.
+        oldest = None
+        for peer in self.peers.values():
+            ob = peer._outbox
+            if ob is not None and ob._pending_since is not None:
+                if oldest is None or ob._pending_since < oldest:
+                    oldest = ob._pending_since
+        out["fusion_outbox_pending_age_ms"] = (
+            (time.perf_counter() - oldest) * 1e3 if oldest is not None else 0.0
+        )
+        return out
 
     # ------------------------------------------------------------------ server side
     def add_service(self, name: str, implementation: Any):

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from collections import deque
 from typing import TYPE_CHECKING, Deque, Dict, Optional, Tuple
 
@@ -70,9 +71,15 @@ class PeerOutbox:
         except RuntimeError:
             self._home_loop = None
         self._fifo: Deque[Tuple[RpcMessage, Optional[asyncio.Future]]] = deque()
-        #: call_id → version string (or None); insertion-order flush,
-        #: last-posted version wins — the latest by causality
-        self._pending_inval: Dict[int, Optional[str]] = {}
+        #: call_id → (version | None, cause id | None, origin ts | None);
+        #: insertion-order flush, last-posted entry wins — the latest by
+        #: causality. cause/origin ride into the batch frame entries so a
+        #: client fence can name its originating server wave and measure
+        #: true end-to-end delivery (ISSUE 3).
+        self._pending_inval: Dict[int, Tuple[Optional[str], Optional[str], Optional[float]]] = {}
+        #: perf_counter of the oldest un-flushed post — the flush-tick lag
+        #: gauge/histogram source (how long invalidations sat coalescing)
+        self._pending_since: Optional[float] = None
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         #: True while the drain task (or a bypassing direct send) is mid-
@@ -105,18 +112,31 @@ class PeerOutbox:
         self._kick()
         await future
 
-    def post_invalidation(self, call_id: int, version: Optional[str]) -> None:
+    def post_invalidation(
+        self,
+        call_id: int,
+        version: Optional[str],
+        cause: Optional[str] = None,
+        origin_ts: Optional[float] = None,
+    ) -> None:
         """Coalesce one subscription invalidation into the next batch frame.
         Synchronous — the caller never awaits a channel. Posting the same
         call twice between flushes ships once, at the latest version.
-        Safe from off-loop callers (the kick marshals to the home loop)."""
+        Safe from off-loop callers (the kick marshals to the home loop).
+
+        ``cause`` is the originating wave/span id and ``origin_ts`` the
+        server-side wave-apply timestamp (``time.perf_counter()``): both
+        ride the frame entry to the client, which links its fence back to
+        the server wave and records the end-to-end delivery histogram."""
         if self._stopped:
             self.pending_dropped += 1
             return
         self.invalidations_posted += 1
         if call_id in self._pending_inval:
             self.invalidations_coalesced += 1
-        self._pending_inval[call_id] = version
+        elif not self._pending_inval:
+            self._pending_since = time.perf_counter()
+        self._pending_inval[call_id] = (version, cause, origin_ts)
         self._kick()
 
     def _kick(self) -> None:
@@ -192,6 +212,7 @@ class PeerOutbox:
         if state.is_terminated:
             self.pending_dropped += len(self._pending_inval)
             self._pending_inval.clear()
+            self._pending_since = None
             return
         if not peer.is_connected:
             # park until the link returns; pending survives the reconnect.
@@ -205,33 +226,46 @@ class PeerOutbox:
             except asyncio.TimeoutError:
                 self.pending_dropped += len(self._pending_inval)
                 self._pending_inval.clear()
+                self._pending_since = None
                 return
             if not peer.is_connected:
                 return  # terminated; next tick drops
         batch, self._pending_inval = self._pending_inval, {}
+        pending_since, self._pending_since = self._pending_since, None
         message = RpcMessage(
             call_type_id=CALL_TYPE_COMPUTE,
             call_id=0,
             service=COMPUTE_SYSTEM_SERVICE,
             method="invalidate_batch",
-            argument_data=dumps([[[cid, ver] for cid, ver in batch.items()]]),
+            # entry = [call_id, version, cause, origin_ts]; clients also
+            # accept the pre-ISSUE-3 2-element shape (wire compat)
+            argument_data=dumps(
+                [[[cid, ver, cause, ts] for cid, (ver, cause, ts) in batch.items()]]
+            ),
         )
         self._in_flight = True
         try:
             await peer._send_now(message)
         except asyncio.CancelledError:
-            self._merge_back(batch)
+            self._merge_back(batch, pending_since)
             raise
         except Exception:  # noqa: BLE001 — link died mid-flush: the batch
             # stays pending and the next tick parks on the reconnect above
-            self._merge_back(batch)
+            self._merge_back(batch, pending_since)
         else:
             self.batch_frames_sent += 1
             self.batch_keys_sent += len(batch)
+            if pending_since is not None:
+                from ..diagnostics.metrics import global_metrics
+
+                global_metrics().histogram(
+                    "fusion_outbox_flush_lag_ms",
+                    help="oldest pending invalidation -> batch frame on the wire",
+                ).record((time.perf_counter() - pending_since) * 1e3)
         finally:
             self._in_flight = False
 
-    def _merge_back(self, batch: Dict[int, Optional[str]]) -> None:
+    def _merge_back(self, batch: Dict[int, Tuple], pending_since: Optional[float] = None) -> None:
         """Re-pend a failed batch WITHOUT clobbering newer posts: anything
         posted since the flush snapshot is newer than the snapshot entry.
         A batch whose flush was cancelled by stop() is dropped — re-pending
@@ -240,8 +274,14 @@ class PeerOutbox:
         if self._stopped:
             self.pending_dropped += len(batch)
             return
-        for call_id, version in batch.items():
-            self._pending_inval.setdefault(call_id, version)
+        for call_id, entry in batch.items():
+            self._pending_inval.setdefault(call_id, entry)
+        # the snapshot's entries are back: the lag clock resumes from the
+        # ORIGINAL oldest post, not from the failed flush
+        if pending_since is not None and (
+            self._pending_since is None or pending_since < self._pending_since
+        ):
+            self._pending_since = pending_since
         self._wake.set()
 
     # ------------------------------------------------------------------ lifecycle
@@ -256,6 +296,7 @@ class PeerOutbox:
                 future.set_exception(err)
         self.pending_dropped += len(self._pending_inval)
         self._pending_inval.clear()
+        self._pending_since = None  # the age gauge must not report a ghost
 
     def stats(self) -> dict:
         return {

@@ -21,7 +21,25 @@ import asyncio  # noqa: E402
 import inspect  # noqa: E402
 import sys  # noqa: E402
 
+import pytest  # noqa: E402
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _isolate_span_state():
+    """Tracing keeps module-level state (the recent-span ring + listener
+    list) that would otherwise LEAK across tests: a span recorded by one
+    test shows up in the next test's ``recent_spans()``, and a listener a
+    test forgot to remove fires forever. Clear the ring and snapshot/
+    restore the listeners around every test (ISSUE 3 satellite)."""
+    from stl_fusion_tpu.diagnostics import tracing
+
+    tracing.clear_recent()
+    listeners_before = list(tracing._listeners)
+    yield
+    tracing._listeners[:] = listeners_before
+    tracing.clear_recent()
 
 
 def pytest_pyfunc_call(pyfuncitem):

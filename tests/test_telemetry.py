@@ -1,0 +1,444 @@
+"""End-to-end invalidation telemetry tests (ISSUE 3).
+
+Covers the metrics registry (counters/gauges/log-scale histograms,
+collectors, Prometheus exposition), the wave profiler ring buffer and its
+``FusionMonitor.report()["waves"]`` surface, cross-peer cause-id/origin-ts
+propagation through ``$sys-c`` frames over a codec-faithful transport (the
+acceptance scenario), span parenting across asyncio task boundaries, the
+monitor's background reporter, and the gateway ``/metrics``/``/trace``
+routes.
+"""
+import asyncio
+import gc
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from stl_fusion_tpu.client import compute_client, install_compute_call_type
+from stl_fusion_tpu.core import (
+    ComputeService,
+    FusionHub,
+    TableBacking,
+    capture,
+    compute_method,
+    memo_table_of,
+    set_default_hub,
+)
+from stl_fusion_tpu.diagnostics import FusionMonitor, global_metrics
+from stl_fusion_tpu.diagnostics.metrics import Histogram, MetricsRegistry
+from stl_fusion_tpu.diagnostics.tracing import clear_recent, get_activity_source, recent_spans
+from stl_fusion_tpu.graph import TpuGraphBackend
+from stl_fusion_tpu.rpc import RpcHub, RpcTestTransport, install_compute_fanout
+
+
+# ---------------------------------------------------------------- registry
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_get_or_create(self):
+        r = MetricsRegistry()
+        c = r.counter("reads_total")
+        c.inc()
+        c.inc(2)
+        assert r.counter("reads_total") is c
+        assert r.snapshot()["reads_total"] == 3
+        g = r.gauge("depth")
+        g.set(7)
+        assert r.snapshot()["depth"] == 7
+
+    def test_kind_conflict_raises(self):
+        r = MetricsRegistry()
+        r.counter("x")
+        with pytest.raises(TypeError):
+            r.gauge("x")
+
+    def test_find_never_creates(self):
+        r = MetricsRegistry()
+        assert r.find("ghost") is None
+        assert "ghost" not in r.snapshot()
+
+    def test_histogram_percentiles_and_bounds(self):
+        h = Histogram("lat_ms")
+        for v in [1.0] * 98 + [500.0, 900.0]:
+            h.record(v)
+        assert h.count == 100
+        assert h.percentile(50) <= 2.0
+        assert h.percentile(99) >= 250.0
+        h.record(-5.0)  # clamped, never thrown
+        assert h.min == 0.0
+        snap = h.snapshot()
+        assert snap["count"] == 101 and snap["p50"] is not None
+
+    def test_max_aggregation_for_non_additive_gauges(self):
+        r = MetricsRegistry()
+
+        class Owner:
+            pass
+
+        a, b = Owner(), Owner()
+        r.register_collector(a, lambda o: {"fusion_age_ms": 5.0})
+        r.register_collector(b, lambda o: {"fusion_age_ms": 3.0})
+        r.set_aggregation("fusion_age_ms", "max")
+        assert r.snapshot()["fusion_age_ms"] == 5.0
+        with pytest.raises(ValueError):
+            r.set_aggregation("fusion_age_ms", "median")
+
+    def test_histogram_checkpoint_since_isolates_a_phase(self):
+        h = Histogram("lat_ms")
+        for _ in range(50):
+            h.record(1000.0)  # phase A: slow
+        cp = h.checkpoint()
+        for _ in range(50):
+            h.record(1.0)  # phase B: fast
+        phase_b = h.since(cp)
+        assert phase_b["count"] == 50
+        assert phase_b["p99"] <= 4.0  # unpolluted by phase A's 1s samples
+        assert h.percentile(50) >= 1.0  # whole-run view unchanged
+
+    def test_collectors_sum_and_weakref_prune(self):
+        r = MetricsRegistry()
+
+        class Owner:
+            pass
+
+        a, b = Owner(), Owner()
+        r.register_collector(a, lambda o: {"fusion_things": 2})
+        r.register_collector(b, lambda o: {"fusion_things": 3})
+        assert r.snapshot()["fusion_things"] == 5
+        del b
+        gc.collect()
+        assert r.snapshot()["fusion_things"] == 2
+
+    def test_prometheus_exposition_parses(self):
+        r = MetricsRegistry()
+        r.counter("fusion_reads_total", help="reads").inc(4)
+        r.histogram("fusion_lat_ms").record(3.0)
+        text = r.render_prometheus()
+        seen = {}
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            name, value = line.rsplit(" ", 1)
+            float(value)  # every sample line must parse
+            seen[name] = float(value)
+        assert seen["fusion_reads_total"] == 4
+        assert seen["fusion_lat_ms_count"] == 1
+        # histogram buckets are cumulative and end at +Inf == count
+        assert seen['fusion_lat_ms_bucket{le="+Inf"}'] == 1
+
+
+# ---------------------------------------------------------------- profiler
+
+
+def _make_table_stack(n=32):
+    hub = FusionHub()
+    backend = TpuGraphBackend(hub, node_capacity=n + 8, edge_capacity=256)
+
+    class Tbl(ComputeService):
+        def __init__(self, h=None):
+            super().__init__(h)
+            self.base = np.arange(n, dtype=np.float32)
+
+        def load(self, ids):
+            return self.base[np.asarray(ids, dtype=np.int64)]
+
+        @compute_method(table=TableBacking(rows=n, batch="load"))
+        async def node(self, i: int) -> float:
+            return float(self.base[i])
+
+    svc = Tbl(hub)
+    hub.add_service(svc, "tbl")
+    table = memo_table_of(svc.node)
+    block = backend.bind_table_rows(table)
+    src = np.arange(0, n - 1, dtype=np.int64)
+    dst = np.arange(1, n, dtype=np.int64)  # chain 0 -> 1 -> ... -> n-1
+    backend.declare_row_edges(block, src, block, dst)
+    table.read_batch(np.arange(n))
+    backend.flush()
+    return hub, backend, svc, table, block
+
+
+class TestWaveProfiler:
+    async def test_wave_records_timeline_fields(self):
+        hub, backend, svc, table, block = _make_table_stack()
+        old = set_default_hub(hub)
+        try:
+            backend.cascade_rows_batch(block, [0])
+            recs = backend.profiler.recent()
+            assert recs, "cascade must record a wave"
+            rec = recs[-1]
+            assert rec["kind"] == "union"
+            assert rec["seeds"] == 1
+            assert rec["newly"] >= 1
+            assert rec["device_ms"] >= 0 and rec["apply_ms"] >= 0
+            assert rec["cause"] and rec["cause"] == backend.last_cause_id
+            # the flush that preceded the wave contributed journal depths
+            flushed = [r for r in recs if "journal_pre" in r]
+            assert flushed and flushed[0]["journal_pre"] >= flushed[0]["journal_post"] - 1
+            s = backend.profiler.summary()
+            assert s["waves_recorded"] == len(recs) and s["device_ms_p50"] is not None
+        finally:
+            set_default_hub(old)
+
+    async def test_lanes_record_groups_and_disable_gate(self):
+        hub, backend, svc, table, block = _make_table_stack()
+        old = set_default_hub(hub)
+        try:
+            backend.cascade_rows_lanes(block, [[0], [5]])
+            rec = backend.profiler.recent()[-1]
+            assert rec["kind"] == "lanes" and rec["groups"] == 2
+            before = backend.profiler.waves_recorded
+            backend.profiler.enabled = False
+            backend.graph.clear_invalid()
+            table.read_batch(np.arange(32))
+            backend.cascade_rows_batch(block, [0])
+            assert backend.profiler.waves_recorded == before
+        finally:
+            set_default_hub(old)
+
+    async def test_monitor_reports_waves(self):
+        hub, backend, svc, table, block = _make_table_stack()
+        old = set_default_hub(hub)
+        monitor = FusionMonitor(hub)
+        try:
+            backend.cascade_rows_batch(block, [0])
+            report = monitor.report()
+            assert report["waves"]["waves_recorded"] >= 1
+            assert report["waves"]["recent"][-1]["kind"] == "union"
+        finally:
+            monitor.dispose()
+            set_default_hub(old)
+
+    async def test_span_cause_links_wave_to_command_span(self):
+        hub, backend, svc, table, block = _make_table_stack()
+        old = set_default_hub(hub)
+        try:
+            src = get_activity_source("test.cmd")
+            with src.span("mutate") as span:
+                backend.cascade_rows_batch(block, [0])
+            cause = backend.profiler.recent()[-1]["cause"]
+            assert f"test.cmd:mutate#{span.span_id}" in cause
+        finally:
+            set_default_hub(old)
+
+
+# ------------------------------------------------------- cause round trip
+
+
+def _make_rpc_stack(n=32, wire_codec=True, coalesce=True):
+    hub, backend, svc, table, block = _make_table_stack(n)
+    server_rpc = RpcHub("server")
+    server_rpc.coalesce_invalidations = coalesce
+    install_compute_call_type(server_rpc)
+    server_rpc.add_service("tbl", svc)
+    index = install_compute_fanout(server_rpc, backend)
+    client_fusion = FusionHub()
+    client_rpc = RpcHub("client")
+    install_compute_call_type(client_rpc)
+    RpcTestTransport(client_rpc, server_rpc, wire_codec=wire_codec)
+    client = compute_client("tbl", client_rpc, client_fusion)
+    return hub, backend, block, svc, server_rpc, client_rpc, client, index
+
+
+class TestCauseRoundTrip:
+    async def test_cause_and_delivery_over_wire_codec_batch_frames(self):
+        """THE acceptance scenario: a client-side invalidation apply carries
+        the originating server cause id, asserted over a codec-faithful
+        channel, and the monitor exposes a non-empty end-to-end delivery
+        histogram."""
+        n = 32
+        hub, backend, block, svc, srpc, crpc, client, index = _make_rpc_stack(n)
+        old = set_default_hub(hub)
+        monitor = FusionMonitor(hub)
+        delivery_before = (
+            global_metrics().find("fusion_e2e_delivery_ms").count
+            if global_metrics().find("fusion_e2e_delivery_ms")
+            else 0
+        )
+        try:
+            node = await capture(lambda: client.node(n - 1))
+            assert index.subscriptions == 1
+            backend.cascade_rows_batch(block, [0])  # chain fences row n-1
+            await asyncio.wait_for(node.when_invalidated(), 5.0)
+            server_cause = backend.last_cause_id
+            assert server_cause is not None
+            assert node.call.invalidation_cause == server_cause
+            assert node.invalidation_cause == server_cause
+            report = monitor.report()
+            assert report["delivery"]["count"] > delivery_before
+            assert report["delivery"]["p50"] is not None
+        finally:
+            monitor.dispose()
+            await crpc.stop()
+            await srpc.stop()
+            set_default_hub(old)
+
+    async def test_cause_rides_perkey_frames_too(self):
+        """Wire-compat mode (one $sys-c.invalidate per key) carries the
+        cause/origin in frame HEADERS — old clients ignore them, ours
+        links the fence all the same."""
+        n = 32
+        hub, backend, block, svc, srpc, crpc, client, index = _make_rpc_stack(
+            n, coalesce=False
+        )
+        old = set_default_hub(hub)
+        try:
+            node = await capture(lambda: client.node(n - 1))
+            backend.cascade_rows_batch(block, [0])
+            await asyncio.wait_for(node.when_invalidated(), 5.0)
+            assert node.invalidation_cause == backend.last_cause_id
+        finally:
+            await crpc.stop()
+            await srpc.stop()
+            set_default_hub(old)
+
+    async def test_old_wire_shape_batch_entries_still_apply(self):
+        """A 2-element batch entry (pre-cause sender) must still invalidate
+        — cause/origin are additive, never required."""
+        from stl_fusion_tpu.client.compute_call import RpcOutboundComputeCall
+
+        class FakePeer:
+            def __init__(self):
+                self.outbound_calls = {}
+
+            def allocate_call_id(self):
+                return 1
+
+        peer = FakePeer()
+        call = RpcOutboundComputeCall(peer, "svc", "m", ())
+        peer.outbound_calls[1] = call
+        from stl_fusion_tpu.rpc.hub import RpcHub as _Hub
+
+        hub = _Hub("compat")
+        install_compute_call_type(hub)
+        from stl_fusion_tpu.rpc.message import (
+            CALL_TYPE_COMPUTE,
+            COMPUTE_SYSTEM_SERVICE,
+            RpcMessage,
+        )
+        from stl_fusion_tpu.utils.serialization import dumps
+
+        msg = RpcMessage(
+            CALL_TYPE_COMPUTE, 0, COMPUTE_SYSTEM_SERVICE, "invalidate_batch",
+            dumps([[[1, "@1"]]]),
+        )
+        hub.compute_system_handler(peer, msg)
+        assert call.when_invalidated.done()
+        assert call.invalidation_cause is None
+
+
+# ------------------------------------------------------------- span state
+
+
+class TestSpanState:
+    async def test_span_parenting_crosses_task_boundaries(self):
+        """contextvar inheritance: a span opened in a task created INSIDE an
+        active span parents to it — the trace tree survives asyncio fan-out
+        (the reference's Activity.Current flows the same way)."""
+        src = get_activity_source("test.tasks")
+        inner_ids = []
+
+        async def child():
+            with src.span("child") as sp:
+                await asyncio.sleep(0)
+                inner_ids.append((sp.span_id, sp.parent_id))
+
+        with src.span("parent") as parent:
+            t1 = asyncio.get_event_loop().create_task(child())
+            t2 = asyncio.get_event_loop().create_task(child())
+            await asyncio.gather(t1, t2)
+        (id1, p1), (id2, p2) = inner_ids
+        assert p1 == parent.span_id and p2 == parent.span_id
+        assert id1 != id2
+        # and the tasks' spans never clobbered each other's context
+        assert parent.parent_id is None
+
+    def test_clear_recent_isolates(self):
+        src = get_activity_source("test.clear")
+        with src.span("a"):
+            pass
+        assert recent_spans(source="test.clear")
+        clear_recent()
+        assert not recent_spans(source="test.clear")
+
+
+# ---------------------------------------------------------------- monitor
+
+
+class TestMonitorReporter:
+    async def test_background_reporter_fires_while_idle(self, caplog):
+        """An idle-but-subscribed process must still report: no _on_access
+        ever fires here, yet the report lands on schedule."""
+        hub = FusionHub()
+        monitor = FusionMonitor(hub, report_period=0.02)
+        try:
+            with caplog.at_level(logging.INFO, logger="stl_fusion_tpu"):
+                task = monitor.start_reporter()
+                assert monitor.start_reporter() is task  # idempotent
+                await asyncio.sleep(0.08)
+            assert any("fusion stats" in r.message for r in caplog.records)
+        finally:
+            monitor.dispose()
+        assert monitor._reporter_task is None
+        await asyncio.sleep(0)
+        assert task.cancelled()
+        with pytest.raises(RuntimeError):
+            monitor.start_reporter()
+
+
+# ---------------------------------------------------------------- gateway
+
+
+class TestGatewayObservability:
+    async def _get(self, host, port, path):
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(
+            f"GET {path} HTTP/1.1\r\nHost: {host}\r\nConnection: close\r\n\r\n".encode()
+        )
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        return head.split(b"\r\n", 1)[0].decode(), body
+
+    async def test_metrics_and_trace_routes(self):
+        from stl_fusion_tpu.rpc.http_gateway import FusionHttpServer
+
+        hub = FusionHub()
+        monitor = FusionMonitor(hub)
+        rpc = RpcHub("gw")
+        server = FusionHttpServer(rpc)
+        server.monitor = monitor
+        await server.start()
+        try:
+            global_metrics().counter("fusion_gw_probe_total").inc()
+            with get_activity_source("test.gw").span("probe"):
+                pass
+            status, body = await self._get(server.host, server.port, "/metrics")
+            assert status.endswith("200 OK")
+            text = body.decode()
+            assert "fusion_gw_probe_total 1" in text
+            for line in text.strip().splitlines():  # exposition must parse
+                if not line.startswith("#"):
+                    float(line.rsplit(" ", 1)[1])
+            status, body = await self._get(server.host, server.port, "/trace")
+            assert status.endswith("200 OK")
+            payload = json.loads(body)
+            assert any(s["name"] == "probe" for s in payload["spans"])
+            assert "hit_ratio" in payload["report"]
+
+            # an untrusted peer (loopback removed from the allowlist) must
+            # get 404, never the span/report dump
+            server.trusted_proxies = frozenset()
+            status, _ = await self._get(server.host, server.port, "/trace")
+            assert status.endswith("404 Not Found")
+            server.trusted_proxies = frozenset({"127.0.0.1", "::1"})
+
+            server.serve_observability = False
+            status, _ = await self._get(server.host, server.port, "/metrics")
+            assert status.endswith("404 Not Found")
+        finally:
+            monitor.dispose()
+            await server.stop()
