@@ -631,8 +631,11 @@ def _compact_result(inv_per_sec: float, detail: dict, live, fanout=None) -> dict
             # wave-profiler summary (ISSUE 3): the system's own per-wave
             # device/apply/flush accounting + whether telemetry ran
             "telemetry": live.get("telemetry"),
+            # flight-recorder mode + event accounting (ISSUE 4): tracks
+            # the causal-journal overhead A/B (LIVE_RECORDER) per release
+            "recorder": live.get("recorder"),
         }
-        for opt in ("phases", "telemetry"):
+        for opt in ("phases", "telemetry", "recorder"):
             if out["live"][opt] is None:
                 del out["live"][opt]
     if fanout is not None and "error" in fanout:

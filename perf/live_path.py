@@ -38,7 +38,10 @@ LIVE_EDGE_CHURN (2000/round — level-aware realistic churn, see
 make_churn_edges), LIVE_SCALAR_CHURN (4/round),
 LIVE_TELEMETRY (1; 0 disables the wave profiler — the A/B knob for the
 <3% observability-overhead budget; the result's ``telemetry`` section
-records which mode ran so BENCH_*.json tracks it).
+records which mode ran so BENCH_*.json tracks it),
+LIVE_RECORDER (1; 0 disables the causal flight recorder — the ISSUE 4
+A/B under the same <3% budget discipline; the result's ``recorder``
+section records the mode + event counts for BENCH_*.json).
 """
 import asyncio
 import json
@@ -165,6 +168,7 @@ async def main() -> None:
     edge_churn = int(os.environ.get("LIVE_EDGE_CHURN", 2000))
     scalar_churn = int(os.environ.get("LIVE_SCALAR_CHURN", 4))
     telemetry_on = os.environ.get("LIVE_TELEMETRY", "1") != "0"
+    recorder_on = os.environ.get("LIVE_RECORDER", "1") != "0"
     rng = np.random.default_rng(123)
 
     note(f"generating {n}-node power-law DAG...")
@@ -182,6 +186,9 @@ async def main() -> None:
             edge_capacity=len(src) + max(65536, 4 * edge_churn * rounds),
         )
         backend.profiler.enabled = telemetry_on
+        from stl_fusion_tpu.diagnostics.flight_recorder import RECORDER
+
+        RECORDER.enabled = recorder_on
         Dag = make_dag_service(n)
         svc = Dag(hub)
         hub.add_service(svc, "dag")
@@ -701,6 +708,10 @@ async def main() -> None:
             # tracked release over release (LIVE_TELEMETRY=0 is the
             # disabled baseline for the <3% budget A/B)
             "telemetry": backend.profiler.summary(),
+            # flight-recorder mode + event accounting (ISSUE 4): the
+            # LIVE_RECORDER=0 run is the disabled baseline for the same
+            # <3% budget A/B as LIVE_TELEMETRY
+            "recorder": RECORDER.summary(),
             # cold-start budget (VERDICT r3 #8) — one-time per workspace
             # thanks to the persistent compilation cache
             "cold_start": {

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Telemetry smoke (ISSUE 3 CI step): boot a small server+client pair,
+"""Telemetry smoke (ISSUE 3 + 4 CI step): boot a small server+client pair,
 drive one burst through the full stack (device wave → fanout index → outbox
 batch frame → wire-codec channel → client apply), then scrape the HTTP
 gateway's ``/metrics`` and assert
@@ -8,7 +8,10 @@ gateway's ``/metrics`` and assert
 - the end-to-end delivery histogram (``fusion_e2e_delivery_ms``) is
   NON-EMPTY — i.e. the system measured its own fan-out latency, no harness
   stopwatch involved,
-- ``/trace`` serves JSON with the monitor report (waves + delivery).
+- ``/trace`` serves JSON with the monitor report (waves + delivery +
+  recorder), and ``?section=`` bounds the payload to one section,
+- ``/explain?key=`` assembles a causal chain that NAMES the burst wave's
+  cause id (the ISSUE 4 acceptance: the "why" answer works over HTTP).
 
 Prints ONE JSON summary line on stdout; exits non-zero on any failed check.
 
@@ -19,6 +22,7 @@ import asyncio
 import json
 import os
 import sys
+import urllib.parse
 
 import numpy as np
 
@@ -149,10 +153,43 @@ async def main() -> int:
         report = trace["report"]
         assert report["delivery"]["count"] >= len(nodes)
         assert report["waves"]["waves_recorded"] >= 1
+        assert report["recorder"]["events_recorded"] >= 1
         cause = report["waves"]["recent"][-1]["cause"]
         assert nodes[0].invalidation_cause == cause, (
             nodes[0].invalidation_cause, cause,
         )
+
+        # section bound: a scraper can fetch ONE report section
+        status, body = await http_get(gateway.host, gateway.port, "/trace?section=waves")
+        assert status.endswith("200 OK"), status
+        sec = json.loads(body)
+        assert set(sec) == {"report"} and set(sec["report"]) == {"waves"}
+
+        # /explain?key=: the causal chain names the burst wave's cause id
+        # (ISSUE 4 acceptance, over plain HTTP)
+        from stl_fusion_tpu.diagnostics import RECORDER
+
+        # the SERVER-side key of the fenced tail row (clients share this
+        # process's recorder, so a bare fragment match could land on the
+        # client-side key — fence events are journaled server-side)
+        keys = [
+            e["key"]
+            for e in RECORDER.recent(kind="client_fenced")
+            if f".node({n - 1},)" in (e["key"] or "")
+        ]
+        assert keys, "flight recorder holds no fence event for the tail row"
+        status, body = await http_get(
+            gateway.host, gateway.port, "/explain?key=" + urllib.parse.quote(keys[-1])
+        )
+        assert status.endswith("200 OK"), status
+        explain_payload = json.loads(body)
+        assert explain_payload["invalidation"]["cause"] == cause, (
+            explain_payload["invalidation"], cause,
+        )
+        assert any(cause in line for line in explain_payload["chain"]), (
+            explain_payload["chain"]
+        )
+        assert explain_payload["invalidation"]["clients_fenced"] >= 1
 
         print(json.dumps({
             "metric": "telemetry_smoke",
@@ -164,6 +201,8 @@ async def main() -> int:
             "waves_recorded": report["waves"]["waves_recorded"],
             "exposition_samples": len(samples),
             "cause": cause,
+            "explain_chain": explain_payload["chain"],
+            "recorder_events": report["recorder"]["events_recorded"],
         }))
         monitor.dispose()
         await gateway.stop()

@@ -54,9 +54,7 @@ __all__ = [
 _FORMAT_VERSION = 1
 
 
-def _deep_tuple(v: Any) -> Any:
-    """Wire decode turns tuples into lists; codec keys must be hashable."""
-    return tuple(_deep_tuple(x) for x in v) if isinstance(v, list) else v
+from ..utils.serialization import deep_tuple as _deep_tuple
 
 
 # ---------------------------------------------------------------- device graph

@@ -22,6 +22,7 @@ import time
 from typing import TYPE_CHECKING, Any, Optional
 
 from ..core.context import try_capture
+from ..diagnostics.flight_recorder import RECORDER, call_key
 from ..diagnostics.metrics import global_metrics
 from ..utils.ltag import LTag
 from ..utils.serialization import dumps, loads
@@ -141,6 +142,16 @@ class RpcOutboundComputeCall(RpcOutboundCall):
         lists it as an open item)."""
         if cause is not None:
             self.invalidation_cause = cause
+        if RECORDER.enabled:
+            # the client end of the causal chain: explain() on this process
+            # reads these to say WHO fenced the key (and the cause joins
+            # back to the server's wave/span over the $sys-d hop)
+            RECORDER.note(
+                "fenced",
+                key=call_key(self.service, self.method, self.args),
+                cause=cause,
+                detail=f"call#{self.call_id} peer={getattr(self.peer, 'ref', '?')}",
+            )
         if origin_ts is not None:
             delta_ms = (time.perf_counter() - origin_ts) * 1e3
             if 0.0 <= delta_ms < 3.6e6:  # range guard, NOT skew detection
@@ -261,6 +272,7 @@ class RpcInboundComputeCall(RpcInboundCall):
         self.peer.inbound_calls.pop(self.call_id, None)
         if self._invalidation_pushed:
             return  # the wave drain already batched this subscription
+        pushed = False
         if getattr(self.peer.hub, "coalesce_invalidations", True):
             self._invalidation_pushed = True
             version = computed.version.format() if computed is not None else None
@@ -273,6 +285,8 @@ class RpcInboundComputeCall(RpcInboundCall):
                 )
             except RuntimeError:  # no running loop: no live link to push to
                 pass
+            else:
+                pushed = True
         else:
             # per-key wire shape: the send awaits the channel — needs a task
             def _spawn():
@@ -280,6 +294,7 @@ class RpcInboundComputeCall(RpcInboundCall):
 
             try:
                 _spawn()
+                pushed = True
             except RuntimeError:
                 # invalidation applied from an off-loop thread: marshal the
                 # spawn onto the peer's home loop (parity with the old
@@ -288,8 +303,21 @@ class RpcInboundComputeCall(RpcInboundCall):
                 if home is not None and not home.is_closed():
                     try:
                         home.call_soon_threadsafe(_spawn)
+                        pushed = True
                     except RuntimeError:
                         pass  # loop closed: peer is gone
+        if pushed and RECORDER.enabled:
+            # server side of the fence, journaled AFTER the push was
+            # actually enqueued — a swallowed no-loop failure must not read
+            # as "client was notified" in explain() (the mask-drain path
+            # notes its own in rpc/fanout.py)
+            RECORDER.note(
+                "client_fenced",
+                key=repr(computed.input) if computed is not None else None,
+                cause=getattr(computed, "_invalidation_cause", None),
+                count=1,
+                detail=f"call#{self.call_id} peer={self.peer.ref}",
+            )
 
     async def _send_invalidation(self, max_attempts: int = 100) -> None:
         """Deliver this subscription's invalidation.

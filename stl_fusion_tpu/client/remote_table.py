@@ -55,9 +55,7 @@ __all__ = ["RemoteTableHost", "RemoteTable", "TABLE_RPC_SERVICE"]
 TABLE_RPC_SERVICE = "$tables"
 
 
-def _deep_tuple(v):
-    """Wire decode turns tuples into lists; keys must be hashable."""
-    return tuple(_deep_tuple(x) for x in v) if isinstance(v, list) else v
+from ..utils.serialization import deep_tuple as _deep_tuple
 
 
 def _table_system(rpc_hub: "RpcHub") -> dict:

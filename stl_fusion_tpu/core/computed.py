@@ -29,6 +29,8 @@ import logging
 import threading
 from typing import TYPE_CHECKING, Any, AsyncIterator, Callable, Generic, List, Optional, Set, Tuple, TypeVar
 
+from ..diagnostics.flight_recorder import RECORDER
+from ..diagnostics.tracing import current_cause_id
 from ..utils.ltag import LTag
 from ..utils.result import Result
 from .consistency import ConsistencyState
@@ -41,9 +43,16 @@ if TYPE_CHECKING:
 T = TypeVar("T")
 log = logging.getLogger("stl_fusion_tpu")
 
-__all__ = ["Computed"]
+__all__ = ["Computed", "LAZY_WAVE_DETAIL"]
 
 _INF = float("inf")
+
+#: flight-journal detail stamped when a PENDING device-wave invalidation
+#: (the unwatched lazy tier) is materialized on host — the wave's identity
+#: is not recorded per-node (only the bit), but the MECHANISM is known and
+#: explain() must not mislabel it "host-led" (diagnostics/explain.py keys
+#: on this string)
+LAZY_WAVE_DETAIL = "lazy device-wave invalidation materialized (wave identity not recorded per-node)"
 
 
 class Computed(Generic[T]):
@@ -144,6 +153,8 @@ class Computed(Generic[T]):
             self._output = output
             self._state = int(ConsistencyState.CONSISTENT)
             invalidate_now = self._invalidate_on_set_output
+        if RECORDER.enabled:
+            RECORDER.note("computed", key=repr(self.input))
         if invalidate_now:
             self.invalidate(immediately=True)
         else:
@@ -179,7 +190,7 @@ class Computed(Generic[T]):
             # a device wave already computed this node's transitive closure
             # (version-matched dependents included) — materialize locally,
             # no host cascade needed
-            return self.invalidate_local()
+            return self.invalidate_local(_detail=LAZY_WAVE_DETAIL)
         delay = self.options.invalidation_delay
         if not immediately and delay > 0:
             with self._lock:
@@ -190,6 +201,10 @@ class Computed(Generic[T]):
             return True
 
         transitioned = False
+        # host-led cascades stamp their cause from the open tracing span
+        # (the SAME id format device waves mint at _begin_wave), so an
+        # explain() chain works even when no device mirror is attached
+        host_cause = current_cause_id()
         stack: List["Computed"] = [self]
         while stack:
             node = stack.pop()
@@ -210,6 +225,12 @@ class Computed(Generic[T]):
                 node._used_by.clear()
             if node is self:
                 transitioned = True
+            if host_cause is not None:
+                node._invalidation_cause = host_cause
+            if RECORDER.enabled:
+                RECORDER.note(
+                    "invalidated", key=repr(node.input), cause=node._invalidation_cause
+                )
             hub = node._hub()
             hub.timeouts.cancel(node)
             if handlers:
@@ -229,10 +250,13 @@ class Computed(Generic[T]):
             hub.on_invalidated(node)
         return transitioned
 
-    def invalidate_local(self) -> bool:
+    def invalidate_local(self, _detail: Optional[str] = None) -> bool:
         """Single-node invalidation WITHOUT cascading — used when a device
         wave already computed the full transitive closure and the host just
-        applies it (stl_fusion_tpu.graph.TpuGraphBackend)."""
+        applies it (stl_fusion_tpu.graph.TpuGraphBackend). ``_detail`` rides
+        into the flight-journal event: lazy materializations pass
+        :data:`LAZY_WAVE_DETAIL` so explain() can say "device wave,
+        materialized lazily" instead of mislabeling them host-led."""
         with self._lock:
             state = self._state
             if state == ConsistencyState.INVALIDATED:
@@ -246,6 +270,16 @@ class Computed(Generic[T]):
             used = list(self._used)
             self._used.clear()
             self._used_by.clear()
+        if RECORDER.enabled:
+            # cause was stamped by the backend's eager apply (device waves)
+            # when one exists; the wave seq auto-stamps from the recorder's
+            # current_wave context during wave application
+            RECORDER.note(
+                "invalidated",
+                key=repr(self.input),
+                cause=self._invalidation_cause,
+                detail=_detail,
+            )
         hub = self._hub()
         hub.timeouts.cancel(self)
         if handlers:
@@ -264,7 +298,7 @@ class Computed(Generic[T]):
         if self._state == ConsistencyState.CONSISTENT and self._pending_probe():
             # materialize the pending device invalidation so the handler
             # observes (and fires on) the real state
-            self.invalidate_local()
+            self.invalidate_local(_detail=LAZY_WAVE_DETAIL)
         fire_now = False
         with self._lock:
             if self._state == ConsistencyState.INVALIDATED:

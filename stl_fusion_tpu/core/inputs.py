@@ -108,18 +108,15 @@ class ComputeMethodInput(ComputedInput):
 def _register_kwargs_tail_wire() -> None:
     """KwArgsTail keys appear inside checkpointed node args (checkpoint/
     stores ``input.args`` verbatim), so they must round-trip the wire."""
-    from ..utils.serialization import register_wire_type
-
-    def _retuple(v):
-        # wire decode turns tuples into lists; key values must re-tuple
-        # DEEPLY or the restored key is unhashable (r4 review)
-        return tuple(_retuple(x) for x in v) if isinstance(v, list) else v
+    from ..utils.serialization import deep_tuple, register_wire_type
 
     register_wire_type(
         KwArgsTail,
         "KwArgsTail",
         to_dict=lambda v: {"i": [list(item) for item in v.items]},
-        from_dict=lambda d: KwArgsTail((k, _retuple(val)) for k, val in d["i"]),
+        # key values must re-tuple DEEPLY or the restored key is
+        # unhashable (r4 review)
+        from_dict=lambda d: KwArgsTail((k, deep_tuple(val)) for k, val in d["i"]),
     )
 
 

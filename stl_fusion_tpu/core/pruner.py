@@ -47,4 +47,15 @@ class ComputedGraphPruner(WorkerBase):
                 await asyncio.sleep(0)
         removed += 0 if live else self.hub.registry.prune()
         self.pruned_edges_total += removed
+        if removed:
+            from ..diagnostics.flight_recorder import RECORDER
+
+            if RECORDER.enabled:
+                # one event per sweep, not per edge — the flight journal
+                # answers "did pruning run, how much did it drop"
+                RECORDER.note(
+                    "pruned",
+                    key="registry",
+                    detail=f"{removed} stale used_by edges over {len(live)} nodes",
+                )
         return removed

@@ -1,6 +1,22 @@
 """Diagnostics (SURVEY.md §5.1-5.2): registry monitoring + hit-ratio
-reports, activity-style tracing spans, and explicit graph-invariant sweeps
-(the build's race-detection story)."""
+reports, activity-style tracing spans, explicit graph-invariant sweeps
+(the build's race-detection story), the causal flight recorder +
+``explain()`` introspection, and the online consistency auditor
+(ISSUE 4).
+
+NOTE: ``core.computed`` imports this package at module scope (the flight-
+recorder hot-path hooks), so nothing here may import ``core``/``rpc`` at
+module scope — ``explain``/``auditor`` keep those imports function-local.
+"""
+from .auditor import ConsistencyAuditor
+from .explain import (
+    explain,
+    explain_client,
+    explain_remote,
+    explain_with_fallback,
+    install_explain,
+)
+from .flight_recorder import RECORDER, FlightRecorder, global_recorder
 from .invariants import InvariantReport, InvariantViolation, validate_hub, validate_mirror
 from .metrics import (
     Counter,
@@ -16,14 +32,25 @@ from .tracing import (
     Span,
     add_listener,
     clear_recent,
+    current_cause_id,
     current_span,
     get_activity_source,
     recent_spans,
     remove_listener,
+    span_cause_id,
 )
 
 __all__ = [
     "FusionMonitor",
+    "ConsistencyAuditor",
+    "FlightRecorder",
+    "RECORDER",
+    "global_recorder",
+    "explain",
+    "explain_client",
+    "explain_remote",
+    "explain_with_fallback",
+    "install_explain",
     "InvariantReport",
     "InvariantViolation",
     "validate_hub",
@@ -32,10 +59,12 @@ __all__ = [
     "Span",
     "add_listener",
     "clear_recent",
+    "current_cause_id",
     "current_span",
     "get_activity_source",
     "recent_spans",
     "remove_listener",
+    "span_cause_id",
     "Counter",
     "Gauge",
     "Histogram",

@@ -53,6 +53,7 @@ __all__ = [
     "MetricsRegistry",
     "WaveProfiler",
     "global_metrics",
+    "next_wave_seq",
 ]
 
 
@@ -377,6 +378,14 @@ def global_metrics() -> MetricsRegistry:
 _wave_seq = itertools.count(1)
 
 
+def next_wave_seq() -> int:
+    """Mint the next process-wide wave sequence number. The backend mints
+    it at ``_begin_wave`` (so the flight recorder can stamp events DURING
+    wave application with the wave they belong to) and hands it back to
+    :meth:`WaveProfiler.record_wave` — one numbering for both rings."""
+    return next(_wave_seq)
+
+
 class WaveProfiler:
     """Per-wave timeline ring buffer for a TpuGraphBackend.
 
@@ -430,11 +439,12 @@ class WaveProfiler:
         apply_ms: float,
         cause: Optional[str] = None,
         groups: Optional[int] = None,
+        seq: Optional[int] = None,
     ) -> None:
         if not self.enabled:
             return
         rec = {
-            "seq": next(_wave_seq),
+            "seq": seq if seq is not None else next(_wave_seq),
             "kind": kind,
             "at": time.time(),
             "seeds": int(seeds),

@@ -59,6 +59,10 @@ class FusionMonitor:
         self._last_report = self._started_at
         self._disposed = False
         self._reporter_task = None
+        #: ConsistencyAuditor started by start_auditor(); its last_report
+        #: surfaces as report()["audit"], and dispose() stops it
+        self.auditor = None
+        self._auditor_kwargs: dict = {}
         hub.registry.on_access.append(self._on_access)
         hub.registry.on_register.append(self._on_register)
         hub.invalidated_hooks.append(self._on_invalidated)
@@ -88,6 +92,61 @@ class FusionMonitor:
         self._reporter_task = asyncio.get_event_loop().create_task(_report_loop())
         return self._reporter_task
 
+    def start_auditor(self, period: Optional[float] = None, **kwargs):
+        """Start the online consistency auditor beside the reporter: sampled
+        ``validate_hub``/``validate_mirror`` sweeps + the canary staleness
+        sentinel, exporting ``fusion_invariant_violations`` /
+        ``fusion_canary_staleness_ms`` and tripping a resilience-ledger
+        event on violation (ISSUE 4). Idempotent while running — a repeat
+        call with the same settings is a no-op returning the live task,
+        and a new ``period`` retimes the running loop; CHANGED constructor
+        settings raise instead of being silently dropped (a caller asking
+        for ``sample=1.0`` must not keep auditing 25%). Stopped by
+        :meth:`dispose`. Extra kwargs reach the
+        :class:`~stl_fusion_tpu.diagnostics.auditor.ConsistencyAuditor`
+        constructor (``sample=``, ``canary=``, ``backend=``, ...)."""
+        if self._disposed:
+            raise RuntimeError("monitor is disposed")
+        if self.auditor is None:
+            from .auditor import ConsistencyAuditor
+
+            # defaults, not fixed arguments: the docstring promises kwargs
+            # passthrough, so an explicit metrics=/events= must override
+            # the monitor's own instead of raising a duplicate-kwarg error
+            kwargs.setdefault("metrics", self.metrics)
+            kwargs.setdefault("events", self.resilience)
+            self._auditor_kwargs = dict(kwargs)
+            self.auditor = ConsistencyAuditor(
+                self.hub,
+                period=period if period is not None else 30.0,
+                **kwargs,
+            )
+        elif any(self._auditor_setting_differs(k, v) for k, v in kwargs.items()):
+            raise RuntimeError(
+                "auditor already constructed with different settings — "
+                "adjust monitor.auditor directly, or dispose() and "
+                "recreate the monitor"
+            )
+        return self.auditor.start(period=period)
+
+    #: start_auditor kwarg → live ConsistencyAuditor attribute, for the
+    #: changed-settings guard (a repeat call passing the value already in
+    #: effect — even a constructor default — must stay a no-op)
+    _AUDITOR_ATTRS = {
+        "sample": "sample",
+        "canary": "canary_enabled",
+        "backend": "backend",
+        "recorder": "recorder",
+    }
+
+    def _auditor_setting_differs(self, key: str, value) -> bool:
+        if key in self._auditor_kwargs:
+            return self._auditor_kwargs[key] != value
+        attr = self._AUDITOR_ATTRS.get(key)
+        if attr is not None:
+            return getattr(self.auditor, attr) != value
+        return True  # unrecorded setting (e.g. seed): conservative
+
     def dispose(self) -> None:
         """Detach all three hub hooks and stop the background reporter
         (idempotent). Without this every constructed monitor kept counting
@@ -98,6 +157,9 @@ class FusionMonitor:
         if self._reporter_task is not None:
             self._reporter_task.cancel()
             self._reporter_task = None
+        if self.auditor is not None:
+            self.auditor.dispose()
+            self.auditor = None
         for hooks, fn in (
             (self.hub.registry.on_access, self._on_access),
             (self.hub.registry.on_register, self._on_register),
@@ -181,6 +243,14 @@ class FusionMonitor:
         delivery = self.metrics.find("fusion_e2e_delivery_ms")
         if delivery is not None:
             extra["delivery"] = delivery.snapshot()
+        # causal flight journal: per-kind lifecycle counters + ring depth
+        # (the events themselves serve via explain()/GET /explain)
+        from .flight_recorder import RECORDER
+
+        extra["recorder"] = RECORDER.summary()
+        # online auditor: the latest sweep's verdict, when one is running
+        if self.auditor is not None and self.auditor.last_report is not None:
+            extra["audit"] = self.auditor.last_report
         return {
             **extra,
             "accesses": self.accesses,

@@ -15,6 +15,7 @@ from __future__ import annotations
 import contextvars
 import itertools
 import logging
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -30,7 +31,18 @@ __all__ = [
     "remove_listener",
     "recent_spans",
     "clear_recent",
+    "span_cause_id",
+    "current_cause_id",
+    "find_span_by_cause",
 ]
+
+#: process-unique cause-id prefix (shared with graph/backend.py wave ids):
+#: two hosts minting "wave#1" must not collide when their frames meet in
+#: one client's telemetry. pid ALONE is not unique across hosts — two
+#: containers both running as pid 1 would mint byte-identical ids — so a
+#: random suffix minted once at import disambiguates them (4 bytes: a
+#: 2-byte suffix birthday-collides past ~300 same-pid containers)
+CAUSE_PREFIX = f"{os.getpid():x}-{int.from_bytes(os.urandom(4), 'big'):08x}"
 
 _span_ids = itertools.count(1)
 _current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
@@ -121,6 +133,45 @@ def current_span() -> Optional[Span]:
     return _current_span.get()
 
 
+def span_cause_id(span: Span) -> str:
+    """The canonical cause-id form of a span — the SAME format
+    ``TpuGraphBackend._begin_wave`` stamps into ``$sys-c`` frames, so a
+    host-led invalidation under an open span joins the trace machinery
+    exactly like a device wave does."""
+    return f"{CAUSE_PREFIX}/{span.source}:{span.name}#{span.span_id}"
+
+
+def current_cause_id() -> Optional[str]:
+    """Cause id of the currently open span, or None outside any span."""
+    span = _current_span.get()
+    return span_cause_id(span) if span is not None else None
+
+
+def find_span_by_cause(cause: str) -> Optional[Span]:
+    """Resolve a span-shaped cause id back to its recorded span (None for
+    wave-shaped causes, foreign-process causes, or evicted spans)."""
+    prefix, sep, rest = cause.partition("/")
+    if not sep or prefix != CAUSE_PREFIX or "#" not in rest:
+        return None
+    name_part, _, id_part = rest.rpartition("#")
+    if ":" not in name_part:
+        # wave-shaped rest ("wave#N"): span-shaped causes are always
+        # "<source>:<name>#<id>" — without the colon this would parse N as
+        # a span id and resolve to an unrelated span
+        return None
+    try:
+        span_id = int(id_part)
+    except ValueError:
+        return None
+    # snapshot (one C-level copy) before iterating: a worker thread closing
+    # a span appends to _recent, and a bare Python-level iteration racing
+    # that append raises "deque mutated during iteration" mid-explain()
+    for s in reversed(list(_recent)):
+        if s.span_id == span_id:
+            return s
+    return None
+
+
 def add_listener(listener: Callable[[Span], None]) -> None:
     _listeners.append(listener)
 
@@ -133,7 +184,7 @@ def remove_listener(listener: Callable[[Span], None]) -> None:
 def recent_spans(source: Optional[str] = None, name: Optional[str] = None) -> List[Span]:
     return [
         s
-        for s in _recent
+        for s in list(_recent)  # snapshot: appends from other threads race
         if (source is None or s.source == source) and (name is None or s.name == name)
     ]
 

@@ -35,9 +35,7 @@ the same version-match rule the reference applies per-edge
 """
 from __future__ import annotations
 
-import itertools
 import logging
-import os
 import threading
 import time
 import weakref
@@ -45,8 +43,9 @@ from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..diagnostics.metrics import WaveProfiler, global_metrics
-from ..diagnostics.tracing import current_span
+from ..diagnostics.flight_recorder import RECORDER
+from ..diagnostics.metrics import WaveProfiler, global_metrics, next_wave_seq
+from ..diagnostics.tracing import CAUSE_PREFIX, current_span, span_cause_id
 from .device_graph import DeviceGraph
 
 if TYPE_CHECKING:
@@ -59,8 +58,10 @@ log = logging.getLogger("stl_fusion_tpu")
 __all__ = ["TpuGraphBackend", "RowBlock"]
 
 #: process-unique cause-id prefix: two hosts minting "wave#1" must not
-#: collide when their frames meet in one client's telemetry
-_CAUSE_PREFIX = f"{os.getpid():x}"
+#: collide when their frames meet in one client's telemetry. SHARED with
+#: tracing (span_cause_id / find_span_by_cause key on byte-identical
+#: prefixes) — never mint a diverging local copy.
+_CAUSE_PREFIX = CAUSE_PREFIX
 
 
 class RowBlock:
@@ -201,7 +202,9 @@ class TpuGraphBackend:
         #: histogram
         self.last_cause_id: Optional[str] = None
         self.last_wave_applied_ts: Optional[float] = None
-        self._cause_seq = itertools.count(1)
+        #: seq of the last wave begun — minted at _begin_wave so recorder
+        #: events during application join the profiler record they belong to
+        self.last_wave_seq: Optional[int] = None
         hub.registry.on_register.append(self._on_register)
         hub.edge_added_hooks.append(self._on_edge_added)
         hub.invalidated_hooks.append(self._on_invalidated)
@@ -225,16 +228,28 @@ class TpuGraphBackend:
         then links back to its originating span, SURVEY §5.1's activity
         propagation), else a process-unique sequence id. The id rides the
         fan-out into ``$sys-c`` frame entries so a client fence can name
-        the server-side wave that caused it."""
+        the server-side wave that caused it. Also mints the wave SEQ here
+        (not at record time) and publishes it to the flight recorder, so
+        lifecycle events recorded DURING this wave's application carry the
+        wave they belong to (ISSUE 4). Wave-shaped causes carry the SAME
+        seq as the profiler/journal records — one numbering, so an
+        operator grepping for "wave#7" lands on wave 7's record.
+
+        Returns ``(cause, seq)``: call sites hold BOTH and pass the seq to
+        :meth:`_profile_wave` — a nested wave (an invalidation handler
+        triggering another cascade mid-apply) overwrites ``last_wave_seq``,
+        and recording the outer wave from the attribute would stamp it
+        with the inner wave's number."""
+        self.last_wave_seq = next_wave_seq()
         span = current_span()
         if span is not None:
-            cause = f"{_CAUSE_PREFIX}/{span.source}:{span.name}#{span.span_id}"
+            cause = span_cause_id(span)
         else:
-            cause = f"{_CAUSE_PREFIX}/wave#{next(self._cause_seq)}"
+            cause = f"{_CAUSE_PREFIX}/wave#{self.last_wave_seq}"
         self.last_cause_id = cause
-        return cause
+        return cause, self.last_wave_seq
 
-    def _profile_wave(self, kind, seeds, cause, t0, t1, newly, groups=None) -> None:
+    def _profile_wave(self, kind, seeds, cause, t0, t1, newly, seq, groups=None) -> None:
         if self.profiler.enabled:
             self.profiler.record_wave(
                 kind,
@@ -244,6 +259,14 @@ class TpuGraphBackend:
                 apply_ms=(time.perf_counter() - t1) * 1e3,
                 cause=cause,
                 groups=groups,
+                seq=seq,
+            )
+        if RECORDER.enabled:
+            RECORDER.note(
+                "wave",
+                cause=cause,
+                wave=seq,
+                detail=f"{kind}: seeds={seeds} newly={newly}",
             )
 
     # ------------------------------------------------------------------ event feed
@@ -297,10 +320,17 @@ class TpuGraphBackend:
                     old = old_ref() if old_ref is not None else None
             self._computed_by_id[nid] = weakref.ref(computed)
             computed._backend_nid = nid
+        if RECORDER.enabled:
+            RECORDER.note("registered", key=repr(input), detail=f"nid={nid}")
         if old is not None:
+            from ..core.computed import LAZY_WAVE_DETAIL
+
             self._applying_ids.add(nid)
             try:
-                old.invalidate_local()
+                # the displaced node's pending device invalidation
+                # materializes as it is superseded — journal it as the
+                # device-wave mechanism it is, not as host-led
+                old.invalidate_local(_detail=LAZY_WAVE_DETAIL)
             finally:
                 self._applying_ids.discard(nid)
 
@@ -420,7 +450,7 @@ class TpuGraphBackend:
             # invalidate_local under _applying_ids): no flush re-entry.
             nids = np.unique(np.concatenate(icasc_parts))
             icasc_parts.clear()
-            cause = self._begin_wave()
+            cause, wave_seq = self._begin_wave()
             t0 = time.perf_counter()
             was_clear = nids[~self.graph._h_invalid[nids]]
             total, newly_ids = self._wave_union([nids.tolist()])
@@ -430,7 +460,7 @@ class TpuGraphBackend:
             t1 = time.perf_counter()
             self._apply_newly(newly_ids)
             self.device_invalidations += total
-            self._profile_wave("icasc", len(nids), cause, t0, t1, len(newly_ids))
+            self._profile_wave("icasc", len(nids), cause, t0, t1, len(newly_ids), wave_seq)
             icasc_s += time.perf_counter() - t0
 
         i, n = 0, len(journal)
@@ -693,14 +723,14 @@ class TpuGraphBackend:
         # — per-level full-edge gathers over the pow2-padded edge arrays
         # lose to one depth-free mirror sweep. The mirror union is the
         # lone-wave path too.
-        cause = self._begin_wave()
+        cause, wave_seq = self._begin_wave()
         t0 = time.perf_counter()
         total, newly_ids = self._wave_union([nids.tolist()])
         t1 = time.perf_counter()
         self._apply_newly(newly_ids)
         self.waves_run += 1
         self.device_invalidations += total
-        self._profile_wave("union", len(nids), cause, t0, t1, len(newly_ids))
+        self._profile_wave("union", len(nids), cause, t0, t1, len(newly_ids), wave_seq)
         return total
 
     def refresh_block_on_device(self, block: RowBlock) -> int:
@@ -863,7 +893,7 @@ class TpuGraphBackend:
             (block.base + self._check_rows(block, rows)).tolist()
             for rows in row_batches
         ]
-        cause = self._begin_wave()
+        cause, wave_seq = self._begin_wave()
         t0 = time.perf_counter()
         counts, union_ids = self._wave_union_seq(seed_lists)
         t1 = time.perf_counter()
@@ -872,7 +902,7 @@ class TpuGraphBackend:
         self.device_invalidations += int(counts.sum())
         self._profile_wave(
             "seq", sum(len(s) for s in seed_lists), cause, t0, t1,
-            int(counts.sum()), groups=len(seed_lists),
+            int(counts.sum()), wave_seq, groups=len(seed_lists),
         )
         return counts
 
@@ -885,7 +915,7 @@ class TpuGraphBackend:
         seed_lists = [
             (block.base + self._check_rows(block, g)).tolist() for g in row_groups
         ]
-        cause = self._begin_wave()
+        cause, wave_seq = self._begin_wave()
         t0 = time.perf_counter()
         counts, union_ids = self._wave_lanes(seed_lists)
         t1 = time.perf_counter()
@@ -894,7 +924,7 @@ class TpuGraphBackend:
         self.device_invalidations += int(counts.sum())
         self._profile_wave(
             "lanes", sum(len(s) for s in seed_lists), cause, t0, t1,
-            int(counts.sum()), groups=len(seed_lists),
+            int(counts.sum()), wave_seq, groups=len(seed_lists),
         )
         return counts
 
@@ -911,14 +941,14 @@ class TpuGraphBackend:
         if nid is None:
             computed.invalidate(immediately=True)
             return 1
-        cause = self._begin_wave()
+        cause, wave_seq = self._begin_wave()
         t0 = time.perf_counter()
         count, newly_ids = self.graph.run_wave_collect([nid], cap=collect_cap)
         t1 = time.perf_counter()
         self._apply_newly(newly_ids)
         self.waves_run += 1
         self.device_invalidations += count
-        self._profile_wave("collect", 1, cause, t0, t1, len(newly_ids))
+        self._profile_wave("collect", 1, cause, t0, t1, len(newly_ids), wave_seq)
         return count
 
     def invalidate_cascade_batch(self, computeds: Sequence["Computed"]) -> int:
@@ -941,14 +971,14 @@ class TpuGraphBackend:
                 seeds.append([nid])
         if not seeds:
             return fallback
-        cause = self._begin_wave()
+        cause, wave_seq = self._begin_wave()
         t0 = time.perf_counter()
         total, newly_ids = self._wave_union(seeds)
         t1 = time.perf_counter()
         self._apply_newly(newly_ids)
         self.waves_run += len(seeds)
         self.device_invalidations += total
-        self._profile_wave("union", len(seeds), cause, t0, t1, len(newly_ids))
+        self._profile_wave("union", len(seeds), cause, t0, t1, len(newly_ids), wave_seq)
         return total + fallback
 
     def invalidate_cascade_batch_lanes(
@@ -979,7 +1009,7 @@ class TpuGraphBackend:
                 else:
                     ids.append(nid)
             seed_lists.append(ids)
-        cause = self._begin_wave()
+        cause, wave_seq = self._begin_wave()
         t0 = time.perf_counter()
         counts, union_ids = self._wave_lanes(seed_lists)
         t1 = time.perf_counter()
@@ -988,7 +1018,7 @@ class TpuGraphBackend:
         self.device_invalidations += int(counts.sum())
         self._profile_wave(
             "lanes", sum(len(s) for s in seed_lists), cause, t0, t1,
-            int(counts.sum()), groups=len(groups),
+            int(counts.sum()), wave_seq, groups=len(groups),
         )
         return counts + fallback
 
@@ -1009,9 +1039,21 @@ class TpuGraphBackend:
         1 bit/node and apply as vectorized mask ops — materializing ids
         was ~a third of r4's per-burst cost at 10M)."""
         self.last_wave_applied_ts = time.perf_counter()
-        if isinstance(newly, np.ndarray) and newly.dtype == np.bool_:
-            return self._apply_newly_mask(newly)
-        newly_ids = newly
+        # recorder events emitted DURING application (eager invalidations,
+        # fanout fence posts) auto-stamp this wave; the finally RESTORES
+        # the prior stamp (not None) so a nested wave triggered by an
+        # invalidation handler doesn't strip the outer wave's remaining
+        # events — and a throwing handler never leaks the stamp
+        prev_wave = RECORDER.current_wave
+        RECORDER.current_wave = self.last_wave_seq
+        try:
+            if isinstance(newly, np.ndarray) and newly.dtype == np.bool_:
+                return self._apply_newly_mask(newly)
+            self._apply_newly_ids(newly)
+        finally:
+            RECORDER.current_wave = prev_wave
+
+    def _apply_newly_ids(self, newly_ids) -> None:
         if len(newly_ids) == 0:
             return
         if self._block_bases.size:
@@ -1207,7 +1249,7 @@ class TpuGraphBackend:
         # permanently ahead and a retry of the same seeds would find
         # nothing newly-invalid (a silently dropped cascade)
         entry.pop("invalid_version", None)
-        cause = self._begin_wave()
+        cause, wave_seq = self._begin_wave()
         t0 = time.perf_counter()
         count, newly_ids, overflow = sharded.run_wave_collect(seeds)
         if overflow:
@@ -1221,7 +1263,7 @@ class TpuGraphBackend:
         self._apply_newly(newly_ids)
         self.waves_run += 1
         self.device_invalidations += count
-        self._profile_wave("sharded_union", len(seeds), cause, t0, t1, len(newly_ids))
+        self._profile_wave("sharded_union", len(seeds), cause, t0, t1, len(newly_ids), wave_seq)
         return count
 
     def packed_mirror(self, mesh=None) -> dict:
@@ -1363,7 +1405,7 @@ class TpuGraphBackend:
             dg._h_invalid[: dg.n_nodes] = mask
             entry["blocked"] = pg.put_blocked(mask)
         entry.pop("invalid_version", None)  # out-of-sync until apply completes
-        cause = self._begin_wave()
+        cause, wave_seq = self._begin_wave()
         t0 = time.perf_counter()
         counts, union_ids, blocked2, overflow = pg.run_gated_lanes(
             seed_lists, entry["blocked"]
@@ -1380,7 +1422,7 @@ class TpuGraphBackend:
         self.device_invalidations += int(counts.sum())
         self._profile_wave(
             "sharded_lanes", sum(len(s) for s in seed_lists), cause, t0, t1,
-            int(counts.sum()), groups=len(seed_lists),
+            int(counts.sum()), wave_seq, groups=len(seed_lists),
         )
         return counts
 

@@ -25,6 +25,8 @@ import contextlib
 
 from dataclasses import dataclass
 
+from ..diagnostics.flight_recorder import RECORDER
+from ..diagnostics.tracing import get_activity_source
 from ..operations.operation import Operation
 from ..operations.pipeline import batch_cascade_scope
 from ..resilience.events import ResilienceEvents, global_events
@@ -258,7 +260,32 @@ class OperationLogReader(WorkerBase):
                             commit_time=rec.commit_time,
                             items=list(rec.items),
                         )
-                        await self.operations.notify_completed(operation, is_local=False)
+                        if RECORDER.enabled:
+                            # the flight-journal join point for cross-host
+                            # causality: explain() resolves "via oplog entry
+                            # E on host H" from these
+                            RECORDER.note(
+                                "oplog_replayed",
+                                key=f"oplog:{rec.agent_id}",
+                                oplog=rec.index,
+                                detail=type(rec.command).__name__,
+                            )
+                        # replay under a span: host-led invalidations this
+                        # completion cascades stamp a cause id naming the
+                        # originating oplog record (computed.py stamps from
+                        # the open span); recorder events auto-carry the
+                        # index via current_oplog
+                        prev_oplog = RECORDER.current_oplog
+                        RECORDER.current_oplog = rec.index
+                        try:
+                            with get_activity_source("oplog").span(
+                                "replay", index=rec.index, agent=rec.agent_id
+                            ):
+                                await self.operations.notify_completed(
+                                    operation, is_local=False
+                                )
+                        finally:
+                            RECORDER.current_oplog = prev_oplog
                         handled += 1
             finally:
                 # the watermark has already advanced past collected records —
@@ -266,12 +293,17 @@ class OperationLogReader(WorkerBase):
                 # what was collected, or those operations' invalidations
                 # would be lost forever (replay never revisits them)
                 if groups and any(groups):
-                    if self.mesh is not None:
-                        backend.invalidate_cascade_batch_lanes_sharded(
-                            groups, mesh=self.mesh
-                        )
-                    else:
-                        backend.invalidate_cascade_batch_lanes(groups)
+                    # the burst covers every record of this batch; the span
+                    # names the range so lane-wave causes resolve to it
+                    with get_activity_source("oplog").span(
+                        "batch", upto=self.watermark, groups=len(groups)
+                    ):
+                        if self.mesh is not None:
+                            backend.invalidate_cascade_batch_lanes_sharded(
+                                groups, mesh=self.mesh
+                            )
+                        else:
+                            backend.invalidate_cascade_batch_lanes(groups)
 
     # ------------------------------------------------------------------ quarantine
     def _quarantine(

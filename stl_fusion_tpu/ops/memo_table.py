@@ -34,6 +34,7 @@ from typing import Callable, List, Optional, Sequence, Union
 
 import numpy as np
 
+from ..diagnostics.flight_recorder import RECORDER
 from ..utils.async_utils import AsyncEvent
 
 __all__ = ["MemoTable"]
@@ -298,6 +299,12 @@ class MemoTable:
         self._stale_count -= int(np.count_nonzero(self._stale_host[ids_np]))
         self._stale_host[ids_np] = False
         self._bump()
+        if RECORDER.enabled:
+            RECORDER.note(
+                "table_refreshed",
+                key=f"table:{id(self):x}",
+                detail=f"{len(ids_np)} rows",
+            )
         for handler in self.on_refresh:
             handler(ids_np)
 
@@ -306,6 +313,15 @@ class MemoTable:
         Ids are deduped: on_invalidate handlers see each row once."""
         ids_np = self._mark_stale(ids)
         if ids_np is not None:
+            if RECORDER.enabled:
+                # one event per CALL (never per row): host-led bulk marks
+                # show up in the flight journal; wave-driven staleness is
+                # already journaled by the backend's wave event
+                RECORDER.note(
+                    "table_invalidated",
+                    key=f"table:{id(self):x}",
+                    detail=f"{len(ids_np)} rows",
+                )
             for handler in self.on_invalidate:
                 handler(ids_np)
 

@@ -25,6 +25,8 @@ from typing import TYPE_CHECKING, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..diagnostics.flight_recorder import RECORDER
+
 if TYPE_CHECKING:
     from ..graph.backend import TpuGraphBackend
     from .hub import RpcHub
@@ -149,6 +151,7 @@ class ComputeFanoutIndex:
             self._nid_arr = None
             self.subscriptions -= len(subs)
             self.drained_total += len(subs)
+            posted = 0
             for (_pid, call_id), (peer_ref, version, call_ref) in subs.items():
                 peer = peer_ref()
                 if peer is None:
@@ -162,6 +165,19 @@ class ComputeFanoutIndex:
                         call._invalidation_pushed = True
                 peer.outbox.post_invalidation(
                     call_id, version, cause=cause, origin_ts=origin_ts
+                )
+                posted += 1
+            if posted and RECORDER.enabled:
+                # one event per fenced KEY (never per subscription), with
+                # the count of fences actually POSTED — dead peers skipped
+                # above must not inflate explain()'s "fenced N clients"
+                c = self.backend.computed_for(nid)
+                RECORDER.note(
+                    "client_fenced",
+                    key=repr(c.input) if c is not None else f"nid:{nid}",
+                    cause=cause,
+                    count=posted,
+                    detail=f"{posted} subscription(s) via mask drain",
                 )
 
     def stats(self) -> dict:

@@ -133,10 +133,14 @@ class FusionHttpServer:
         #: path → (content_type, body): static pages served next to the
         #: JSON API (the sample-UI host path, ≈ MapBlazorHub + index.html)
         self.static_routes: dict = {}
-        #: observability routes (ISSUE 3): GET /metrics — Prometheus text
-        #: exposition of the process registry; GET /trace — recent tracing
-        #: spans (+ the attached monitor's report, waves and delivery
-        #: histogram included, when :attr:`monitor` is set). Served ONLY to
+        #: observability routes (ISSUE 3 + 4): GET /metrics — Prometheus
+        #: text exposition of the process registry; GET /trace — recent
+        #: tracing spans (+ the attached monitor's report, waves and
+        #: delivery histogram included, when :attr:`monitor` is set;
+        #: ``?section=waves|fanout|delivery|recorder|audit`` bounds the
+        #: payload to one report section); GET /explain?key= — the causal
+        #: chain for a key (flight recorder + wave profiler + span join,
+        #: diagnostics/explain.py). Served ONLY to
         #: peers :meth:`_is_trusted_proxy` accepts (default: loopback — the
         #: sidecar scraper shape; with :attr:`proxy_shared_secret` set the
         #: scraper must send it in ``x-auth-request-secret``): span tags
@@ -175,6 +179,18 @@ class FusionHttpServer:
             await self._server.wait_closed()
             self._server = None
 
+    @staticmethod
+    async def _write_json(writer: asyncio.StreamWriter, status: str, payload) -> None:
+        """One JSON response, non-JSON-able leaves repr'd (the observability
+        routes ship diagnostic dicts, where a lossy repr beats a 500)."""
+        raw = json.dumps(payload, default=repr).encode()
+        writer.write(
+            f"HTTP/1.1 {status}\r\nContent-Type: application/json\r\n"
+            f"Content-Length: {len(raw)}\r\nConnection: close\r\n\r\n".encode()
+            + raw
+        )
+        await writer.drain()
+
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         try:
             request_line = (await reader.readline()).decode("latin1").strip()
@@ -198,11 +214,12 @@ class FusionHttpServer:
             body = await reader.readexactly(content_length) if content_length else b""
             peer = writer.get_extra_info("peername")
             headers["_ip"] = peer[0] if peer else ""
-            path = urllib.parse.urlsplit(target).path
+            parsed_target = urllib.parse.urlsplit(target)
+            path = parsed_target.path
             observability = (
                 self.serve_observability
                 and method == "GET"
-                and path in ("/metrics", "/trace")
+                and path in ("/metrics", "/trace", "/explain")
                 # same trust gate as principal headers: loopback (or the
                 # shared scraper secret) only — a direct remote client must
                 # not read spans/reports off a port it happens to reach
@@ -223,18 +240,75 @@ class FusionHttpServer:
             if observability and path == "/trace":
                 from ..diagnostics.tracing import recent_spans
 
-                payload: dict = {
-                    "spans": [s.to_dict() for s in recent_spans()[-256:]],
-                }
-                if self.monitor is not None:
-                    payload["report"] = self.monitor.report()
-                raw = json.dumps(payload, default=repr).encode()
-                writer.write(
-                    "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
-                    f"Content-Length: {len(raw)}\r\nConnection: close\r\n\r\n".encode()
-                    + raw
-                )
-                await writer.drain()
+                query = urllib.parse.parse_qs(parsed_target.query)
+                section = query.get("section", [None])[0]
+                if section:
+                    # payload bound (ISSUE 4 satellite): a scraper fetches
+                    # ONE report section (waves|fanout|delivery|recorder|
+                    # audit|...) instead of the whole embedded report + spans
+                    if self.monitor is None:
+                        # every section would 400 as "unknown" here — name
+                        # the REAL problem (no monitor wired) instead
+                        await self._write_json(
+                            writer,
+                            "503 Service Unavailable",
+                            {
+                                "error": {
+                                    "type": "NoMonitor",
+                                    "message": "no FusionMonitor attached to this gateway",
+                                }
+                            },
+                        )
+                        return
+                    report = self.monitor.report()
+                    if section not in report:
+                        # a typo'd section served as {"<typo>": null} reads
+                        # as "no data recorded" — reject loudly instead
+                        await self._write_json(
+                            writer,
+                            "400 Bad Request",
+                            {
+                                "error": {
+                                    "type": "BadRequest",
+                                    "message": (
+                                        f"unknown or empty section {section!r}; "
+                                        f"available: {sorted(report)}"
+                                    ),
+                                }
+                            },
+                        )
+                        return
+                    payload: dict = {"report": {section: report.get(section)}}
+                else:
+                    payload = {
+                        "spans": [s.to_dict() for s in recent_spans()[-256:]],
+                    }
+                    if self.monitor is not None:
+                        payload["report"] = self.monitor.report()
+                await self._write_json(writer, "200 OK", payload)
+                return
+            if observability and path == "/explain":
+                from ..diagnostics.explain import explain_with_fallback
+
+                query = urllib.parse.parse_qs(parsed_target.query)
+                key = query.get("key", [None])[0]
+                if not key:
+                    await self._write_json(
+                        writer,
+                        "400 Bad Request",
+                        {"error": {"type": "BadRequest", "message": "key= required"}},
+                    )
+                    return
+                try:
+                    hub = self.monitor.hub if self.monitor is not None else None
+                    status_line, payload = "200 OK", explain_with_fallback(key, hub=hub)
+                except Exception as e:  # noqa: BLE001 — the incident-diagnosis
+                    # endpoint must answer with the failure, never with a
+                    # dropped connection ($sys-d's _serve_explain contract)
+                    log.exception("explain(%r) failed", key)
+                    status_line = "500 Internal Server Error"
+                    payload = {"error": {"type": type(e).__name__, "message": str(e)}}
+                await self._write_json(writer, status_line, payload)
                 return
             static = self.static_routes.get(path)
             if static is not None and method == "GET":

@@ -82,6 +82,11 @@ class RpcHub:
         #: $sys-t dispatch hook (per-table row fences + subscriptions),
         #: installed by client/remote_table.py on both ends
         self.table_system_handler: Optional[Callable[[RpcPeer, RpcMessage], None]] = None
+        #: $sys-d dispatch hook (cross-peer explain/introspection), installed
+        #: by diagnostics.explain.install_explain on both ends; may be an
+        #: ASYNC callable (the server side awaits a registry peek + a reply
+        #: send) — the peer dispatch awaits coroutine results
+        self.diag_system_handler: Optional[Callable[[RpcPeer, RpcMessage], Any]] = None
         #: composable middleware chains (≈ RpcInboundMiddleware /
         #: RpcOutboundMiddleware, Stl.Rpc/Infrastructure/): each entry is
         #: ``async (peer, message, nxt)`` where ``await nxt(message)``

@@ -13,11 +13,19 @@ from typing import Optional, Tuple
 
 from ..utils.serialization import wire_type
 
-__all__ = ["RpcMessage", "SYSTEM_SERVICE", "COMPUTE_SYSTEM_SERVICE", "TABLE_SYSTEM_SERVICE", "VERSION_HEADER"]
+__all__ = [
+    "RpcMessage",
+    "SYSTEM_SERVICE",
+    "COMPUTE_SYSTEM_SERVICE",
+    "TABLE_SYSTEM_SERVICE",
+    "DIAG_SYSTEM_SERVICE",
+    "VERSION_HEADER",
+]
 
 SYSTEM_SERVICE = "$sys"
 COMPUTE_SYSTEM_SERVICE = "$sys-c"
 TABLE_SYSTEM_SERVICE = "$sys-t"  # per-TABLE row fences (remote_table.py)
+DIAG_SYSTEM_SERVICE = "$sys-d"  # cross-peer introspection (diagnostics/explain.py)
 VERSION_HEADER = "@version"
 
 CALL_TYPE_PLAIN = 0

@@ -22,7 +22,13 @@ from ..utils.async_utils import AsyncEvent, Channel, ChannelClosedError, Channel
 from ..utils.collections import RecentlySeenMap
 from ..utils.errors import ExceptionInfo
 from ..utils.serialization import dumps, loads
-from .message import COMPUTE_SYSTEM_SERVICE, SYSTEM_SERVICE, TABLE_SYSTEM_SERVICE, RpcMessage
+from .message import (
+    COMPUTE_SYSTEM_SERVICE,
+    DIAG_SYSTEM_SERVICE,
+    SYSTEM_SERVICE,
+    TABLE_SYSTEM_SERVICE,
+    RpcMessage,
+)
 
 if TYPE_CHECKING:
     from .hub import RpcHub
@@ -93,6 +99,10 @@ class RpcPeer(WorkerBase):
         self._conn: Optional[ChannelPair] = None
         self._resend_failures = 0  # consecutive connect-then-die-on-resend
         self._outbox: Optional["PeerOutbox"] = None
+        # strong refs to in-flight $sys-d handler tasks: the event loop
+        # holds tasks only weakly, and a collected task silently never
+        # sends its explain reply
+        self._diag_tasks: set = set()
 
     # ------------------------------------------------------------------ id/state
     def allocate_call_id(self) -> int:
@@ -326,8 +336,33 @@ class RpcPeer(WorkerBase):
             handler = self.hub.table_system_handler
             if handler is not None:
                 handler(self, message)
+        elif message.service == DIAG_SYSTEM_SERVICE:
+            handler = self.hub.diag_system_handler
+            if handler is not None:
+                result = handler(self, message)
+                if asyncio.iscoroutine(result):
+                    # spawned, never awaited inline: diagnostics traffic
+                    # must not head-of-line-block the receive pump — a slow
+                    # explain resolution would otherwise delay the $sys-c
+                    # invalidation frames queued behind it on this link. A
+                    # hub with no handler silently drops the frame
+                    # (introspection is additive, never load-bearing).
+                    task = asyncio.get_event_loop().create_task(result)
+                    self._diag_tasks.add(task)
+                    task.add_done_callback(self._on_diag_done)
         else:
             self._process_inbound(message)
+
+    def _on_diag_done(self, task: "asyncio.Task") -> None:
+        self._diag_tasks.discard(task)
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            # swallowed deliberately: a failed explain reply (dead link,
+            # serialization hiccup) times out at the asker; it must never
+            # surface as an unhandled-task error on the serving loop
+            log.debug("diagnostics handler failed: %s", exc)
 
     def _process_system(self, message: RpcMessage) -> None:
         """$sys: ok / error / cancel / not-found (RpcSystemCalls.cs:6-71)."""
@@ -377,6 +412,11 @@ class RpcPeer(WorkerBase):
         await self.disconnect()
         if self._outbox is not None:
             self._outbox.stop()
+        for task in list(self._diag_tasks):
+            # in-flight explain replies die with the peer — left pending
+            # they surface as "Task was destroyed but it is pending!" at
+            # loop close (the asker's timeout covers the lost reply)
+            task.cancel()
         await super().stop()
 
 

@@ -24,7 +24,25 @@ from .ltag import LTag
 
 T = TypeVar("T")
 
-__all__ = ["wire_type", "register_wire_type", "encode", "decode", "dumps", "loads", "WireSerializer"]
+__all__ = [
+    "wire_type",
+    "register_wire_type",
+    "encode",
+    "decode",
+    "dumps",
+    "loads",
+    "deep_tuple",
+    "WireSerializer",
+]
+
+
+def deep_tuple(v):
+    """Wire decode turns tuples into lists (JSON has no tuple); values used
+    as cache/codec keys or replayed method args must re-tuple DEEPLY to be
+    hashable again. THE shared helper — remote-table keys, checkpoint codec
+    keys, KwArgsTail restore and explain-request args all decode through
+    this one definition."""
+    return tuple(deep_tuple(x) for x in v) if isinstance(v, list) else v
 
 _BY_NAME: Dict[str, Tuple[Type, Callable[[Any], dict], Callable[[dict], Any]]] = {}
 _BY_TYPE: Dict[Type, str] = {}

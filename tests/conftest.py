@@ -28,18 +28,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 @pytest.fixture(autouse=True)
 def _isolate_span_state():
-    """Tracing keeps module-level state (the recent-span ring + listener
-    list) that would otherwise LEAK across tests: a span recorded by one
-    test shows up in the next test's ``recent_spans()``, and a listener a
-    test forgot to remove fires forever. Clear the ring and snapshot/
-    restore the listeners around every test (ISSUE 3 satellite)."""
+    """Tracing and the flight recorder keep module-level state (the
+    recent-span ring + listener list, the lifecycle-event ring) that would
+    otherwise LEAK across tests: a span recorded by one test shows up in
+    the next test's ``recent_spans()``, a listener a test forgot to remove
+    fires forever, and one test's invalidation events pollute the next
+    test's ``explain()``. Clear both rings and snapshot/restore the
+    listeners + recorder gate around every test (ISSUE 3/4 satellites)."""
     from stl_fusion_tpu.diagnostics import tracing
+    from stl_fusion_tpu.diagnostics.flight_recorder import RECORDER
 
     tracing.clear_recent()
+    RECORDER.clear()
     listeners_before = list(tracing._listeners)
+    recorder_enabled_before = RECORDER.enabled
     yield
     tracing._listeners[:] = listeners_before
     tracing.clear_recent()
+    RECORDER.enabled = recorder_enabled_before
+    RECORDER.clear()
 
 
 def pytest_pyfunc_call(pyfuncitem):

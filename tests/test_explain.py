@@ -1,0 +1,493 @@
+"""Causal flight recorder + explain() + online auditor tests (ISSUE 4).
+
+Covers the bounded-memory flight journal under an event storm, local
+causal-chain assembly (device waves and host-led span-stamped cascades),
+THE acceptance scenario — a client's ``explain`` naming the originating
+server wave's cause id end to end over ``RpcTestTransport(wire_codec=True)``
+via the ``$sys-d`` hop — the auditor's detection of an injected
+I2 edge-symmetry violation (exported as a metric + resilience event), and
+the gateway's ``/explain?key=`` route + ``/trace?section=`` payload bound.
+"""
+import asyncio
+import json
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from stl_fusion_tpu.client import compute_client, install_compute_call_type
+from stl_fusion_tpu.core import (
+    ComputeService,
+    FusionHub,
+    TableBacking,
+    capture,
+    compute_method,
+    invalidating,
+    memo_table_of,
+    set_default_hub,
+)
+from stl_fusion_tpu.diagnostics import (
+    ConsistencyAuditor,
+    FusionMonitor,
+    RECORDER,
+    explain,
+    explain_client,
+    explain_remote,
+    get_activity_source,
+    global_metrics,
+    install_explain,
+)
+from stl_fusion_tpu.diagnostics.flight_recorder import FlightRecorder
+from stl_fusion_tpu.graph import TpuGraphBackend
+from stl_fusion_tpu.resilience import ResilienceEvents
+from stl_fusion_tpu.rpc import RpcHub, RpcTestTransport, install_compute_fanout
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _make_table_stack(n=32):
+    hub = FusionHub()
+    backend = TpuGraphBackend(hub, node_capacity=n + 8, edge_capacity=256)
+
+    class Tbl(ComputeService):
+        def __init__(self, h=None):
+            super().__init__(h)
+            self.base = np.arange(n, dtype=np.float32)
+
+        def load(self, ids):
+            return self.base[np.asarray(ids, dtype=np.int64)]
+
+        @compute_method(table=TableBacking(rows=n, batch="load"))
+        async def node(self, i: int) -> float:
+            return float(self.base[i])
+
+    svc = Tbl(hub)
+    hub.add_service(svc, "tbl")
+    table = memo_table_of(svc.node)
+    block = backend.bind_table_rows(table)
+    backend.declare_row_edges(
+        block, np.arange(0, n - 1, dtype=np.int64), block, np.arange(1, n, dtype=np.int64)
+    )
+    table.read_batch(np.arange(n))
+    backend.flush()
+    return hub, backend, svc, table, block
+
+
+def _make_rpc_stack(n=32):
+    hub, backend, svc, table, block = _make_table_stack(n)
+    server_rpc = RpcHub("server")
+    install_compute_call_type(server_rpc)
+    server_rpc.add_service("tbl", svc)
+    install_compute_fanout(server_rpc, backend)
+    install_explain(server_rpc, fusion_hub=hub)
+    client_fusion = FusionHub()
+    client_rpc = RpcHub("client")
+    install_compute_call_type(client_rpc)
+    install_explain(client_rpc)
+    RpcTestTransport(client_rpc, server_rpc, wire_codec=True)
+    client = compute_client("tbl", client_rpc, client_fusion)
+    return hub, backend, block, svc, server_rpc, client_rpc, client
+
+
+class Warehouse(ComputeService):
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self.stock = {}
+
+    @compute_method
+    async def item(self, key: str) -> int:
+        return self.stock.get(key, 0)
+
+    @compute_method
+    async def pair_sum(self, a: str, b: str) -> int:
+        return (await self.item(a)) + (await self.item(b))
+
+    async def put(self, key: str, n: int):
+        self.stock[key] = n
+        with invalidating():
+            await self.item(key)
+
+
+# ------------------------------------------------------------------ recorder
+
+
+class TestFlightRecorder:
+    def test_bounded_memory_under_100k_event_storm(self):
+        """The 100k-storm contract: the ring holds ``capacity`` events, the
+        per-kind counters stay exact, and context stamps survive."""
+        rec = FlightRecorder(capacity=4096)
+        for i in range(100_000):
+            rec.note("invalidated", key=f"k{i}", cause=f"c{i % 7}")
+        assert len(rec._ring) == 4096
+        assert rec.events_recorded == 100_000
+        assert rec.counts["invalidated"] == 100_000
+        # the ring kept the NEWEST events
+        assert rec.recent(1)[0]["key"] == "k99999"
+        summary = rec.summary()
+        assert summary["depth"] == 4096 and summary["events_recorded"] == 100_000
+
+    def test_disabled_recorder_records_nothing(self):
+        rec = FlightRecorder()
+        rec.enabled = False
+        rec.note("computed", key="x")
+        assert rec.events_recorded == 0 and not rec._ring
+
+    def test_context_stamps_auto_apply(self):
+        rec = FlightRecorder()
+        rec.current_wave = 17
+        rec.current_oplog = 4
+        rec.note("invalidated", key="x", cause="c")
+        ev = rec.recent(1)[0]
+        assert ev["wave"] == 17 and ev["oplog"] == 4
+
+    async def test_lifecycle_events_feed_the_journal(self):
+        hub = FusionHub()
+        svc = hub.add_service(Warehouse(hub))
+        node = await capture(lambda: svc.item("a"))
+        await svc.put("a", 5)
+        kinds = [e["kind"] for e in RECORDER.for_key(repr(node.input))]
+        assert "computed" in kinds and "invalidated" in kinds
+
+
+# ------------------------------------------------------------------ explain
+
+
+class TestExplainLocal:
+    async def test_wave_invalidation_names_cause_and_wave(self):
+        hub, backend, svc, table, block = _make_table_stack()
+        old = set_default_hub(hub)
+        try:
+            tail = await capture(lambda: svc.node(31))
+            tail.on_invalidated(lambda _c: None)  # watched: the wave applies eagerly
+            backend.cascade_rows_batch(block, [0])  # chain fences row 31
+            assert tail.is_invalidated
+            report = explain(tail, hub=hub)
+            inv = report["invalidation"]
+            assert inv["cause"] == backend.last_cause_id
+            assert inv["wave"] is not None
+            assert inv["wave"]["seq"] == backend.last_wave_seq
+            assert any("invalidated by wave" in line for line in report["chain"])
+            assert any(backend.last_cause_id in line for line in report["chain"])
+        finally:
+            set_default_hub(old)
+
+    async def test_host_led_invalidation_names_command_span(self):
+        """Host-led cascades (no device wave) stamp their cause from the
+        open tracing span — explain() resolves the originating span."""
+        hub = FusionHub()
+        svc = hub.add_service(Warehouse(hub))
+        pair = await capture(lambda: svc.pair_sum("a", "b"))
+        with get_activity_source("test.cmd").span("restock") as span:
+            await svc.put("a", 9)
+        report = explain(repr(pair.input), hub=hub)
+        inv = report["invalidation"]
+        assert inv["cause"] is not None and f"#{span.span_id}" in inv["cause"]
+        assert inv["span"] is not None and inv["span"]["name"] == "restock"
+        assert any("test.cmd:restock" in line for line in report["chain"])
+
+    async def test_materialized_lazy_wave_is_not_labeled_host_led(self):
+        """An UNWATCHED node fenced by a device wave sits in the lazy tier
+        (pending bit); once materialized (here via on_invalidated), its
+        journal event must still attribute the DEVICE-WAVE mechanism —
+        never read as 'host-led'."""
+        hub, backend, svc, table, block = _make_table_stack()
+        old = set_default_hub(hub)
+        try:
+            tail = await capture(lambda: svc.node(31))  # unwatched
+            backend.cascade_rows_batch(block, [0])
+            # pre-materialization: the honest lazy-tier answer
+            report = explain(tail, hub=hub)
+            assert report["invalidation"].get("pending") is True
+            # materialize (attaching an observer does it)
+            tail.on_invalidated(lambda _c: None)
+            report = explain(tail, hub=hub)
+            assert "device wave" in report["chain"][0]
+            assert "host-led" not in report["chain"][0]
+        finally:
+            set_default_hub(old)
+
+    def test_wave_shaped_cause_never_resolves_to_a_span(self):
+        """Regression: a wave cause "pid/wave#3" must not resolve to the
+        unrelated span whose span_id happens to be 3 — span-shaped causes
+        always carry a "<source>:<name>" segment."""
+        from stl_fusion_tpu.diagnostics.tracing import (
+            CAUSE_PREFIX,
+            find_span_by_cause,
+            span_cause_id,
+        )
+
+        with get_activity_source("test.fsc").span("victim") as span:
+            pass
+        assert find_span_by_cause(f"{CAUSE_PREFIX}/wave#{span.span_id}") is None
+        assert find_span_by_cause(span_cause_id(span)) is span
+        assert find_span_by_cause(f"deadbeef/other:host#{span.span_id}") is None
+
+    async def test_consistent_key_explains_as_clean(self):
+        hub = FusionHub()
+        svc = hub.add_service(Warehouse(hub))
+        node = await capture(lambda: svc.item("a"))
+        report = explain(repr(node.input), hub=hub)
+        assert report["state"] == "CONSISTENT"
+        assert report["invalidation"] is None
+        assert "no recorded invalidation" in report["chain"][0]
+
+
+class TestExplainRemote:
+    async def test_client_explain_names_server_wave_cause_end_to_end(self):
+        """THE acceptance scenario: explain(key) on a CLIENT names the
+        originating server wave's cause id, over the wire codec, via the
+        $sys-d hop."""
+        n = 32
+        hub, backend, block, svc, srpc, crpc, client = _make_rpc_stack(n)
+        old = set_default_hub(hub)
+        try:
+            node = await capture(lambda: client.node(n - 1))
+            backend.cascade_rows_batch(block, [0])
+            await asyncio.wait_for(node.when_invalidated(), 5.0)
+            server_cause = backend.last_cause_id
+            assert server_cause is not None
+
+            story = await explain_client(node, timeout=5.0)
+            remote = story["remote"]
+            assert remote["invalidation"]["cause"] == server_cause
+            assert remote["invalidation"]["wave"]["seq"] == backend.last_wave_seq
+            assert remote["invalidation"]["clients_fenced"] >= 1
+            assert any(server_cause in line for line in remote["chain"])
+            # the local half links the same cause to the fence event
+            local = story["local"]
+            assert local["invalidation"]["cause"] == server_cause
+        finally:
+            await crpc.stop()
+            await srpc.stop()
+            set_default_hub(old)
+
+    async def test_explain_remote_unknown_key_degrades_gracefully(self):
+        hub, backend, block, svc, srpc, crpc, client = _make_rpc_stack()
+        try:
+            await client.node(3)  # connect
+            peer = crpc.client_peer("default")
+            report = await explain_remote(peer, "tbl", "node", (999,), timeout=5.0)
+            assert "chain" in report  # a no-history chain, never an error/hang
+            assert "no recorded invalidation" in report["chain"][0]
+        finally:
+            await crpc.stop()
+            await srpc.stop()
+
+    async def test_sys_d_never_executes_non_compute_methods(self):
+        """Regression: the server-side registry peek must only touch
+        @compute_method wrappers — a plain RPC method (a mutation) would
+        EXECUTE as a side effect of an introspection request."""
+        hub, backend, block, svc, srpc, crpc, client = _make_rpc_stack()
+        try:
+            svc.mutations = 0
+
+            # register a service exposing a REAL async mutation method
+            class Mut:
+                def __init__(self, s):
+                    self._s = s
+
+                async def bump(self, n: int) -> int:
+                    self._s.mutations += n
+                    return self._s.mutations
+
+            srpc.add_service("mut", Mut(svc))
+            await client.node(3)  # connect
+            peer = crpc.client_peer("default")
+            report = await explain_remote(peer, "mut", "bump", (5,), timeout=5.0)
+            assert svc.mutations == 0, "introspection executed a mutation!"
+            assert "error" in report  # refused, not journal-scanned
+
+            # ...and an unknown service must not degrade into a journal
+            # scan either (it would leak keys the peer cannot invoke)
+            report = await explain_remote(peer, "ghost", "canary", (), timeout=5.0)
+            assert "error" in report and "events" not in report
+        finally:
+            await crpc.stop()
+            await srpc.stop()
+
+    async def test_sys_d_refuses_free_form_journal_scans(self):
+        """The $sys-d endpoint answers ANY connected peer, so bare-string
+        requests (an arbitrary fragment scan over the process journal,
+        other tenants' keys included) must be refused — that lookup shape
+        is served only by the trust-gated HTTP route."""
+        import asyncio as _a
+
+        from stl_fusion_tpu.rpc.message import DIAG_SYSTEM_SERVICE, RpcMessage
+        from stl_fusion_tpu.utils.serialization import dumps
+
+        hub, backend, block, svc, srpc, crpc, client = _make_rpc_stack()
+        try:
+            node = await capture(lambda: client.node(3))
+            peer = crpc.client_peer("default")
+            pending = crpc._explain_pending
+            call_id = peer.allocate_call_id()
+            fut = _a.get_event_loop().create_future()
+            pending[(id(peer), call_id)] = fut
+            await peer.send(
+                RpcMessage(0, call_id, DIAG_SYSTEM_SERVICE, "explain", dumps(["node("]))
+            )
+            report = await _a.wait_for(fut, 5.0)
+            assert "error" in report and "chain" not in report
+        finally:
+            await crpc.stop()
+            await srpc.stop()
+
+
+# ------------------------------------------------------------------ auditor
+
+
+class TestAuditor:
+    async def test_clean_audit_reports_no_violations(self):
+        hub, backend, svc, table, block = _make_table_stack()
+        old = set_default_hub(hub)
+        events = ResilienceEvents()
+        auditor = ConsistencyAuditor(
+            hub, backend=backend, sample=1.0, events=events, seed=1
+        )
+        try:
+            backend.cascade_rows_batch(block, [0])
+            table.read_batch(np.arange(32))
+            report = await auditor.audit_once()
+            assert report["violations"] == []
+            assert report["canary_ok"] is True
+            assert report["canary_staleness_ms"] is not None
+            assert events.count("invariant_violation") == 0
+            hist = global_metrics().find("fusion_canary_staleness_ms")
+            assert hist is not None and hist.count >= 1
+        finally:
+            auditor.dispose()
+            set_default_hub(old)
+
+    async def test_auditor_flags_injected_i2_violation(self):
+        """The detection contract: corrupt edge symmetry (drop a used_by
+        back-edge) → the auditor finds it, counts it, exports the metric
+        and trips the resilience ledger."""
+        hub = FusionHub()
+        svc = hub.add_service(Warehouse(hub))
+        await svc.pair_sum("a", "b")
+        node = await capture(lambda: svc.pair_sum("a", "b"))
+        used = node.used[0]
+        with used._lock:
+            used._used_by.clear()  # the I2 injection
+        events = ResilienceEvents()
+        auditor = ConsistencyAuditor(hub, sample=1.0, canary=False, events=events)
+        try:
+            report = await auditor.audit_once()
+            assert any("I2" in v for v in report["violations"])
+            assert auditor.violations_total >= 1
+            assert events.count("invariant_violation") == 1
+            assert global_metrics().snapshot()["fusion_invariant_violations"] >= 1
+            assert RECORDER.counts.get("invariant_violation", 0) >= 1
+        finally:
+            auditor.dispose()
+
+    async def test_canary_detects_stuck_invalidation(self):
+        """A canary that reads back stale is ITSELF a violation — the
+        sentinel for 'invalidation stopped propagating'."""
+        hub = FusionHub()
+        auditor = ConsistencyAuditor(hub, sample=1.0, events=ResilienceEvents())
+        try:
+            await auditor.audit_once()
+
+            # sabotage: the canary read serves a value that never advances
+            # past the invalidation — the "invalidation stopped
+            # propagating" shape the sentinel exists to catch
+            class Stuck:
+                value = 0
+
+                async def canary(self):
+                    return -1  # perpetually stale
+
+            auditor._canary_svc = Stuck()
+            report = await auditor.audit_once()
+            assert report["canary_ok"] is False
+            assert any("canary" in v for v in report["violations"])
+        finally:
+            auditor.dispose()
+
+    async def test_monitor_start_auditor_and_report_sections(self):
+        hub, backend, svc, table, block = _make_table_stack()
+        old = set_default_hub(hub)
+        monitor = FusionMonitor(hub)
+        try:
+            backend.cascade_rows_batch(block, [0])
+            task = monitor.start_auditor(period=0.02, sample=1.0, seed=2)
+            assert monitor.start_auditor(period=0.02) is task  # idempotent
+            deadline = asyncio.get_event_loop().time() + 5.0
+            while monitor.auditor.last_report is None:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.02)
+            report = monitor.report()
+            assert report["audit"]["sweeps"] >= 1
+            assert report["recorder"]["events_recorded"] >= 1
+            assert report["recorder"]["counts"].get("wave", 0) >= 1
+        finally:
+            monitor.dispose()
+            set_default_hub(old)
+        assert monitor.auditor is None
+        with pytest.raises(RuntimeError):
+            monitor.start_auditor()
+
+
+# ------------------------------------------------------------------ gateway
+
+
+class TestGatewayExplain:
+    async def _get(self, host, port, path):
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(
+            f"GET {path} HTTP/1.1\r\nHost: {host}\r\nConnection: close\r\n\r\n".encode()
+        )
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        return head.split(b"\r\n", 1)[0].decode(), body
+
+    async def test_explain_route_and_trace_sections(self):
+        from stl_fusion_tpu.rpc.http_gateway import FusionHttpServer
+
+        hub, backend, svc, table, block = _make_table_stack()
+        old = set_default_hub(hub)
+        monitor = FusionMonitor(hub)
+        rpc = RpcHub("gw")
+        server = FusionHttpServer(rpc)
+        server.monitor = monitor
+        await server.start()
+        try:
+            tail = await capture(lambda: svc.node(31))
+            tail.on_invalidated(lambda _c: None)  # watched: eager apply
+            backend.cascade_rows_batch(block, [0])
+            assert tail.is_invalidated
+            key = urllib.parse.quote(repr(tail.input))
+            status, body = await self._get(server.host, server.port, f"/explain?key={key}")
+            assert status.endswith("200 OK")
+            payload = json.loads(body)
+            assert payload["invalidation"]["cause"] == backend.last_cause_id
+
+            status, _ = await self._get(server.host, server.port, "/explain")
+            assert status.endswith("400 Bad Request")
+
+            # payload bound: one section, no span dump
+            status, body = await self._get(server.host, server.port, "/trace?section=waves")
+            assert status.endswith("200 OK")
+            payload = json.loads(body)
+            assert set(payload) == {"report"}
+            assert set(payload["report"]) == {"waves"}
+            assert payload["report"]["waves"]["waves_recorded"] >= 1
+
+            status, body = await self._get(
+                server.host, server.port, "/trace?section=recorder"
+            )
+            payload = json.loads(body)
+            assert payload["report"]["recorder"]["events_recorded"] >= 1
+
+            # the trust gate covers /explain exactly like /metrics //trace
+            server.trusted_proxies = frozenset()
+            status, _ = await self._get(server.host, server.port, f"/explain?key={key}")
+            assert status.endswith("404 Not Found")
+        finally:
+            monitor.dispose()
+            await server.stop()
+            set_default_hub(old)

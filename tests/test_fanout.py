@@ -22,7 +22,7 @@ from stl_fusion_tpu.core import (
     invalidating,
     set_default_hub,
 )
-from stl_fusion_tpu.diagnostics import FusionMonitor
+from stl_fusion_tpu.diagnostics import FusionMonitor, validate_hub
 from stl_fusion_tpu.graph import TpuGraphBackend
 from stl_fusion_tpu.rpc import RpcHub, RpcTestTransport, install_compute_fanout
 from stl_fusion_tpu.rpc.message import COMPUTE_SYSTEM_SERVICE
@@ -181,6 +181,12 @@ async def test_batch_delivery_chaos_dup_reorder_converges(coalesce):
         assert policy.duplicated > 0
         if coalesce:
             assert srpc.fanout_stats()["batch_frames_sent"] >= 1
+        # correctness sweep after the chaos (ISSUE 4 satellite: the race-
+        # detection story must RUN in the suites, not just exist): the
+        # hammered server graph — and the client mirror of it — still
+        # satisfies I1-I5
+        validate_hub(svc._fusion_hub).require()
+        validate_hub(_cf).require()
     finally:
         await _stop(crpc, srpc)
 
@@ -220,6 +226,9 @@ async def test_dropped_batch_frame_converges_after_reconnect():
                         f"a batched invalidation was lost"
                     )
                     await asyncio.sleep(0.05)
+            # structural invariants held through drops + reconnects
+            validate_hub(svc._fusion_hub).require()
+            validate_hub(_cf).require()
         finally:
             await _stop(crpc, srpc)
 

@@ -563,6 +563,13 @@ async def test_multihost_chaos_convergence(tmp_path):
                             f"{await svcs[host].get(k)}, DB has {want}"
                         )
                         await asyncio.sleep(0.05)
+
+            # correctness sweep (ISSUE 4 satellite): reader crash/restart
+            # churn must leave BOTH hosts' graphs structurally sound
+            from stl_fusion_tpu.diagnostics import validate_hub
+
+            validate_hub(hub_a).require()
+            validate_hub(hub_b).require()
         finally:
             for r in readers.values():
                 await r.stop()

@@ -431,6 +431,15 @@ async def test_chaos_scenario_partition_storm_end_to_end():
                 )
                 await asyncio.sleep(0.05)
 
+        # correctness sweeps (ISSUE 4 satellite: these had NO callers in
+        # the chaos suites — the race-detection story existed but never
+        # ran where races actually happen): the stormed server graph
+        # satisfies I1-I5 and the device CSR mirror matches host truth
+        from stl_fusion_tpu.diagnostics import validate_hub, validate_mirror
+
+        validate_hub(server_fusion).require()
+        validate_mirror(backend).require()
+
         assert unhandled == [], unhandled
     finally:
         loop.set_exception_handler(None)
