@@ -31,7 +31,10 @@ default; FUSION_BENCH_SHARDED_PACKED=0 → one-wave-at-a-time chaining),
 FUSION_BENCH_FANOUT_CLIENTS (default 100; 0 skips) → the distributed
 fan-out section (perf/fanout_path.py: that many in-memory RPC clients
 subscribed across the live table while bursts run; FANOUT_* env knobs
-pass through).
+pass through), FUSION_BENCH_CLUSTER_SERVERS (default 3; 0 skips) → the
+cluster control-plane section (perf/cluster_path.py: routed N-server
+throughput vs single-server + rebalance convergence after a member kill;
+CLUSTER_* env knobs pass through).
 """
 import json
 import os
@@ -515,6 +518,32 @@ def run_fanout_section():
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def run_cluster_section():
+    """Embedded cluster control-plane measurement (ISSUE 5):
+    perf/cluster_path.py as a subprocess — routed N-server throughput vs
+    single-server, rebalance convergence after a member kill, and the
+    /metrics epoch-bump assertion. FUSION_BENCH_CLUSTER_SERVERS=0 skips."""
+    import subprocess
+
+    servers = int(os.environ.get("FUSION_BENCH_CLUSTER_SERVERS", 3))
+    if servers <= 0:
+        return None
+    env = dict(os.environ, CLUSTER_SERVERS=str(servers), JAX_PLATFORMS="cpu")
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "perf", "cluster_path.py"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], env=env, stdout=subprocess.PIPE, text=True,
+            timeout=600,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "cluster path timed out"}
+    if proc.returncode != 0:
+        return {"error": f"cluster path failed rc={proc.returncode} (stderr inherited above)"}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def main() -> None:
     import jax
 
@@ -546,6 +575,9 @@ def main() -> None:
     fanout = run_fanout_section()
     if fanout is not None:
         detail["fanout"] = fanout
+    cluster = run_cluster_section()
+    if cluster is not None:
+        detail["cluster"] = cluster
     result = {
         "metric": "cascading_invalidations_per_sec",
         "value": round(inv_per_sec, 1),
@@ -560,7 +592,8 @@ def main() -> None:
     print("# full record: " + json.dumps(result), file=sys.stderr, flush=True)
     print(
         json.dumps(
-            _compact_result(inv_per_sec, detail, live, fanout), separators=(",", ":")
+            _compact_result(inv_per_sec, detail, live, fanout, cluster),
+            separators=(",", ":"),
         )
     )
 
@@ -569,7 +602,7 @@ def _r(v, nd=2):
     return None if v is None else round(float(v), nd)
 
 
-def _compact_result(inv_per_sec: float, detail: dict, live, fanout=None) -> dict:
+def _compact_result(inv_per_sec: float, detail: dict, live, fanout=None, cluster=None) -> dict:
     """The single stdout line: every headline metric, nothing that scales
     with run verbosity, target well under the driver's tail window."""
     out = {
@@ -659,6 +692,20 @@ def _compact_result(inv_per_sec: float, detail: dict, live, fanout=None) -> dict
             # the system's own per-mode delivery slice (ISSUE 3), beside
             # the harness percentiles — they must agree to bucket width
             "system_delivery_ms": fanout.get("coalesced_system_delivery_ms"),
+        }
+    if cluster is not None and "error" in cluster:
+        out["cluster"] = {"error": cluster["error"]}
+    elif cluster is not None:
+        out["cluster"] = {
+            "servers": cluster.get("servers"),
+            "routed_reads_per_s": _r(cluster.get("routed_reads_per_s"), 1),
+            "single_reads_per_s": _r(cluster.get("single_reads_per_s"), 1),
+            "routed_vs_single": cluster.get("routed_vs_single"),
+            "reassign_ms": cluster.get("reassign_ms"),
+            "converged_ms": cluster.get("converged_ms"),
+            "resharded_keys": cluster.get("resharded_keys"),
+            "failure_timeout_s": cluster.get("failure_timeout_s"),
+            "epoch_final": cluster.get("epoch_final"),
         }
     return out
 

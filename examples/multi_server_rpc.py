@@ -1,17 +1,30 @@
 #!/usr/bin/env python
 """MultiServerRpc — port of the reference sample
-(samples/MultiServerRpc/Program.cs, Service.cs): TWO chat servers, each with
-its own state, and one client whose call router consistent-hashes every call
-— compute reads AND posted commands — to the server that owns the chat id
-(Program.cs:58-76). Observers watch two chats that land on different
-servers; each server only ever sees its own chat's traffic, and invalidation
-pushes arrive from the right server's socket.
+(samples/MultiServerRpc/Program.cs, Service.cs), grown onto the ISSUE-5
+cluster control plane: TWO chat servers, each with its own state, and one
+client routing every call — compute reads AND posted commands — through an
+epoch-versioned ShardMap (key → virtual shard → rendezvous owner) instead
+of the reference's static consistent hash (Program.cs:58-76). Observers
+watch two chats that land on different servers; each server only ever sees
+its own chat's traffic, and invalidation pushes arrive from the right
+server's socket.
+
+Then the part the reference never had — FAILOVER: server1 is killed.
+Commands addressed to its chats fail FAST with ShardMovedError (no
+split-brain write ever lands on a non-owner), the membership control plane
+detects the death and mints a new shard-map epoch, the client's rebalancer
+fences every moved key's cached computed (cause ``reshard:<epoch>``), and
+the observers converge on the surviving owner's answers — no unhandled
+exceptions anywhere.
+
+Transport: real websockets when the ``websockets`` package is installed;
+otherwise the in-memory multi-server transport (same protocol, same
+frames) so the sample runs in minimal environments.
 
 Run: python examples/multi_server_rpc.py
 """
 import asyncio
 import dataclasses
-import hashlib
 import os
 import sys
 
@@ -22,19 +35,33 @@ from stl_fusion_tpu.client import (
     add_fusion_service,
     install_compute_call_type,
 )
+from stl_fusion_tpu.cluster import (
+    ClusterMember,
+    ClusterRebalancer,
+    ShardMapRouter,
+    ShardMovedError,
+    install_cluster_client,
+    install_cluster_guard,
+)
 from stl_fusion_tpu.commands import (
-    COMMANDER_SERVICE,
     bridge_commands,
     command_handler,
     expose_commander,
 )
 from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method, is_invalidating
 from stl_fusion_tpu.rpc import RpcHub
-from stl_fusion_tpu.rpc.websocket import RpcWebSocketServer, websocket_multi_connector
 from stl_fusion_tpu.utils.serialization import wire_type
+
+try:
+    import websockets  # noqa: F401
+
+    HAVE_WEBSOCKETS = True
+except ImportError:
+    HAVE_WEBSOCKETS = False
 
 SERVER_COUNT = 2
 SERVER_REFS = [f"server{i}" for i in range(SERVER_COUNT)]
+N_SHARDS = 64
 
 
 @wire_type
@@ -42,6 +69,11 @@ SERVER_REFS = [f"server{i}" for i in range(SERVER_COUNT)]
 class ChatPost:
     chat_id: str
     message: str
+
+    def shard_key(self) -> str:
+        """Commands route by the chat they mutate — the ShardMapRouter
+        reads this instead of the whole envelope's repr."""
+        return self.chat_id
 
 
 class Chat(ComputeService):
@@ -73,21 +105,6 @@ class Chat(ComputeService):
         self._chats[command.chat_id] = posts
 
 
-def stable_hash(key: str) -> int:
-    # the reference uses Djb2 because string.GetHashCode changes run to run
-    # (Program.cs:64-66); any run-stable hash has the same property
-    return int.from_bytes(hashlib.sha1(key.encode()).digest()[:4], "little")
-
-
-def chat_router(service: str, method: str, args: tuple):
-    """Route chat reads by arg0 and bridged posts by command.chat_id."""
-    if service == "chat":
-        return SERVER_REFS[stable_hash(args[0]) % SERVER_COUNT]
-    if service == COMMANDER_SERVICE and isinstance(args[0], ChatPost):
-        return SERVER_REFS[stable_hash(args[0].chat_id) % SERVER_COUNT]
-    return "default"
-
-
 async def run_server(ref: str):
     fusion = FusionHub()
     fusion.commander.attach_operations_pipeline()
@@ -97,33 +114,74 @@ async def run_server(ref: str):
     install_compute_call_type(rpc)
     rpc.add_service("chat", chat)
     expose_commander(rpc, fusion.commander)
-    server = await RpcWebSocketServer(rpc).start()
-    return chat, server
+    server = None
+    if HAVE_WEBSOCKETS:
+        from stl_fusion_tpu.rpc.websocket import RpcWebSocketServer
+
+        server = await RpcWebSocketServer(rpc).start()
+    return chat, rpc, server
 
 
 async def main():
-    chats, servers = [], []
+    chats, rpcs, servers = {}, {}, {}
     for ref in SERVER_REFS:
-        chat, server = await run_server(ref)
-        chats.append(chat)
-        servers.append(server)
+        chat, rpc, server = await run_server(ref)
+        chats[ref], rpcs[ref], servers[ref] = chat, rpc, server
 
+    # ---- control plane: heartbeat membership + owner guard on every server
+    members = {}
+    mesh = {}
+    for ref in SERVER_REFS:
+        if HAVE_WEBSOCKETS:
+            from stl_fusion_tpu.rpc.websocket import websocket_multi_connector
+
+            rpcs[ref].client_connector = websocket_multi_connector(
+                {r: servers[r].url for r in SERVER_REFS if r != ref}
+            )
+        else:
+            from stl_fusion_tpu.rpc import RpcMultiServerTestTransport
+
+            mesh[ref] = RpcMultiServerTestTransport(
+                rpcs[ref], {r: rpcs[r] for r in SERVER_REFS if r != ref},
+                client_name=ref,
+            )
+        member = ClusterMember(
+            rpcs[ref], ref, seeds=SERVER_REFS, n_shards=N_SHARDS,
+            heartbeat_interval=0.1, failure_timeout=1.0,
+        ).install()
+        install_cluster_guard(rpcs[ref], member)
+        members[ref] = member
+
+    # ---- client: shard-map routing + live resharding
     client_rpc = RpcHub("client")
     install_compute_call_type(client_rpc)
-    client_rpc.call_router = chat_router
-    client_rpc.client_connector = websocket_multi_connector(
-        {ref: server.url for ref, server in zip(SERVER_REFS, servers)}
-    )
+    if HAVE_WEBSOCKETS:
+        from stl_fusion_tpu.rpc.websocket import websocket_multi_connector
+
+        client_rpc.client_connector = websocket_multi_connector(
+            {ref: servers[ref].url for ref in SERVER_REFS}
+        )
+    else:
+        from stl_fusion_tpu.rpc import RpcMultiServerTestTransport
+
+        client_transport = RpcMultiServerTestTransport(
+            client_rpc, dict(rpcs), client_name="client"
+        )
+    router = ShardMapRouter(client_rpc, members=SERVER_REFS, n_shards=N_SHARDS)
+    client_rpc.call_router = router
+    install_cluster_client(client_rpc, router)
     client_fusion = FusionHub()
+    rebalancer = ClusterRebalancer(client_rpc, router)
     chat_client = add_fusion_service(RpcServiceMode.ROUTER, "chat", client_rpc, client_fusion)
+    rebalancer.attach_proxy(chat_client)
     bridge_commands(client_fusion.commander, client_rpc, [ChatPost], peer_ref=None)
 
-    # find two chat ids that land on different servers
+    # find two chat ids that land on different servers (per the shard map)
     by_ref: dict = {}
     i = 0
     while len(by_ref) < SERVER_COUNT:
         chat_id = f"chat{i}"
-        by_ref.setdefault(chat_router("chat", "get", (chat_id,)), chat_id)
+        by_ref.setdefault(router("chat", "get_recent_messages", (chat_id,)), chat_id)
         i += 1
     chat_a, chat_b = by_ref["server0"], by_ref["server1"]
     print(f"chat {chat_a!r} → server0, chat {chat_b!r} → server1")
@@ -151,15 +209,90 @@ async def main():
 
     await asyncio.wait_for(asyncio.gather(*observers), 10.0)
     assert counts[chat_a][-1] == 5 and counts[chat_b][-1] == 2, counts
-    assert chats[0].seen_commands == 1 and chats[1].seen_commands == 1, (
-        chats[0].seen_commands,
-        chats[1].seen_commands,
+    assert chats["server0"].seen_commands == 1 and chats["server1"].seen_commands == 1, (
+        chats["server0"].seen_commands,
+        chats["server1"].seen_commands,
     )
     print("multi-server OK: reads and commands sharded by chat id, pushes from the owning server")
 
+    # ================= FAILOVER PHASE: kill server1 =================
+    loop = asyncio.get_event_loop()
+    unhandled = []
+    loop.set_exception_handler(lambda l, ctx: unhandled.append(ctx))
+
+    epoch_before = max(m.shard_map.epoch for m in members.values())
+    print(f"killing server1 (epoch {epoch_before})...")
+    await members["server1"].dispose()
+    if servers["server1"] is not None:
+        await servers["server1"].stop()
+    else:
+        for t in mesh.values():
+            t.servers.pop("server1", None)
+        client_transport.servers.pop("server1", None)
+    await rpcs["server1"].stop()
+    await asyncio.sleep(0.3)  # let the client's dial fail → owner marked down
+
+    # commands to the dead shard fail FAST (ShardMovedError, never a hang,
+    # never a split-brain write onto the replica)
+    fail_fast = 0
+    landed = 0
+    deadline = loop.time() + 10.0
+    while fail_fast == 0 and "server1" in router.shard_map.members:
+        assert loop.time() < deadline, "command to dead owner neither failed nor rerouted"
+        try:
+            await asyncio.wait_for(
+                commander.call(ChatPost(chat_b, "into the void")), 2.0
+            )
+            # the new epoch applied between the membership check above and
+            # the route: the post landed on the NEW owner — guard-accepted,
+            # not split-brain — and its words count toward the totals below
+            landed += 1
+        except ShardMovedError as e:
+            fail_fast += 1
+            print(f"command to dead shard failed fast: {type(e).__name__}")
+        except (ConnectionError, asyncio.TimeoutError):
+            await asyncio.sleep(0.1)  # detection racing us; try again
+    assert fail_fast >= 1 or "server1" not in router.shard_map.members
+    if not fail_fast:
+        print(f"probe raced the reshard: {landed} post(s) landed on the new owner")
+
+    # membership detects the death → new epoch → the client's rebalancer
+    # fences every moved key and evicts the departed per-peer client
+    deadline = loop.time() + 10.0
+    while "server1" in router.shard_map.members:
+        assert loop.time() < deadline, router.snapshot()
+        await asyncio.sleep(0.05)
+    print(
+        f"resharded to epoch {router.shard_map.epoch}: members "
+        f"{list(router.shard_map.members)}, {rebalancer.resharded_keys} key(s) fenced"
+    )
+    assert "server1" not in chat_client._clients, "departed FusionClient must be evicted"
+
+    # observers converge on the surviving owner's answers: server0 saw none
+    # of chat_b's history — only any probe that raced the epoch apply
+    # ("into the void" = 3 words each) — then a post lands there
+    survivor_base = 3 * landed
+    survivor_count = await asyncio.wait_for(chat_client.get_word_count(chat_b), 10.0)
+    assert survivor_count == survivor_base, (survivor_count, landed)
+    node = await capture(lambda: chat_client.get_word_count(chat_b))
+    await commander.call(ChatPost(chat_b, "back online"))
+    await asyncio.wait_for(node.when_invalidated(), 10.0)
+    recovered = await asyncio.wait_for(chat_client.get_word_count(chat_b), 10.0)
+    assert recovered == survivor_base + 2, (recovered, landed)
+    assert chats["server0"].seen_commands >= 2  # it now owns chat_b's writes
+    assert unhandled == [], unhandled
+    loop.set_exception_handler(None)
+    print(f"failover OK: {chat_b!r} now served by server0, word count {recovered}")
+
+    for ref, m in members.items():
+        if ref != "server1":
+            await m.dispose()
     await client_rpc.stop()
-    for server in servers:
-        await server.stop()
+    for ref in SERVER_REFS:
+        if ref != "server1":
+            if servers[ref] is not None:
+                await servers[ref].stop()
+            await rpcs[ref].stop()
 
 
 if __name__ == "__main__":

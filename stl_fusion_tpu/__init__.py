@@ -18,7 +18,11 @@ A ground-up rebuild of the capabilities of Stl.Fusion (reference:
   (``parallel``);
 - chaos-hardened failure handling (``resilience``): deterministic fault
   injection, per-peer circuit breakers, and a device-wave watchdog with a
-  split-host-loop fallback — see RESILIENCE.md.
+  split-host-loop fallback — see RESILIENCE.md;
+- an elastic cluster control plane (``cluster``): heartbeat membership,
+  an epoch-versioned rendezvous shard map, epoch-stamped routing with
+  read failover, and live resharding that fences moved keys' client
+  caches — see CLUSTER.md.
 
 See SURVEY.md for the reference structural map this build follows.
 """

@@ -43,6 +43,21 @@ log = logging.getLogger("stl_fusion_tpu")
 __all__ = ["ClientComputed", "ClientComputeMethodFunction", "FusionClient", "compute_client"]
 
 
+# cluster/router.py FAILOVER_HEADER as a literal: client_function loads
+# before (and without) the cluster package
+_FAILOVER_HEADER = "@failover"
+
+
+def _is_shard_moved(e: BaseException) -> bool:
+    """Function-local cluster import: client_function loads before (and
+    without) the cluster package; the check must never create the cycle."""
+    try:
+        from ..cluster.shard_map import ShardMovedError
+    except ImportError:  # pragma: no cover — cluster ships with the package
+        return False
+    return isinstance(e, ShardMovedError)
+
+
 class ClientComputeMethodInput(ComputedInput):
     __slots__ = ("function_ref", "method", "args")
 
@@ -141,12 +156,19 @@ class ClientComputeMethodFunction(FunctionBase):
         peer_ref: Optional[str],
         cache: Optional[ClientComputedCache] = None,
         options: Optional[ComputedOptions] = None,
+        cluster_routed: bool = False,
     ):
         super().__init__(hub, options or ComputedOptions.CLIENT_DEFAULT)
         self.rpc_hub = rpc_hub
         self.service = service
         self.peer_ref = peer_ref
         self.cache = cache
+        #: True for the per-peer clients a RoutingComputeProxy caches: the
+        #: peer was chosen by the hub's shard router, so calls stamp the
+        #: router's @shard/@epoch headers even though peer_ref is fixed
+        #: (cluster/router.py headers_for). A user-pinned CLIENT-mode proxy
+        #: stays unstamped — pinning opts out of cluster routing.
+        self.cluster_routed = cluster_routed
 
     # ------------------------------------------------------------------ compute
     async def compute(self, input: ClientComputeMethodInput, existing: Optional[Computed]) -> Computed:
@@ -192,10 +214,23 @@ class ClientComputeMethodFunction(FunctionBase):
         tries = 0
         while True:
             tries += 1
-            peer_ref = self.peer_ref or self.rpc_hub.call_router(self.service, input.method, input.args)
+            router = self.rpc_hub.call_router
+            headers: tuple = ()
+            if self.peer_ref is None and hasattr(router, "route"):
+                # shard-map routing: the decision carries its @shard/@epoch
+                # stamp (and @failover when the owner is unreachable)
+                peer_ref, headers = router.route(self.service, input.method, input.args)
+            else:
+                peer_ref = self.peer_ref or router(self.service, input.method, input.args)
+                if self.cluster_routed and hasattr(router, "headers_for"):
+                    headers = router.headers_for(
+                        self.service, input.method, input.args, peer_ref=peer_ref
+                    )
             peer = self.rpc_hub.client_peer(peer_ref or "default")
             await peer.when_connected()
-            call = RpcOutboundComputeCall(peer, self.service, input.method, input.args)
+            call = RpcOutboundComputeCall(
+                peer, self.service, input.method, input.args, headers=headers
+            )
             try:
                 value = await call.invoke()
                 output = Result.ok(value)
@@ -203,11 +238,31 @@ class ClientComputeMethodFunction(FunctionBase):
                 raise
             except ResultMissedError as e:
                 # invalidation overtook the result (reconnect interleaving /
-                # invalidate-only restart answer): just re-issue the call
+                # invalidate-only restart answer): just re-issue the call —
+                # UNLESS the fence was a reshard: this peer no longer owns
+                # the key, so re-issuing here would loop against a non-owner
+                # (or park on a retired peer). Surface ShardMovedError so
+                # the routing layer re-routes against the new map.
+                cause = call.invalidation_cause
+                if cause is not None and cause.startswith("reshard:"):
+                    if self.peer_ref is None and tries <= 3:
+                        continue  # we route per call: next try uses the new map
+                    from ..cluster.shard_map import ShardMovedError
+
+                    raise ShardMovedError(f"call fenced by {cause}") from e
                 if tries <= 3:
                     continue
                 output = Result.err(e)
             except Exception as e:  # noqa: BLE001 — errors are memoized
+                if _is_shard_moved(e):
+                    # never memoize a routing rejection: apply the carried
+                    # map and either re-route (per-call routing) or hand the
+                    # error to whoever owns the routing decision
+                    if hasattr(router, "note_moved"):
+                        router.note_moved(e)
+                    if self.peer_ref is None and tries <= 3:
+                        continue
+                    raise
                 output = Result.err(e)
             version = call.result_version or self.hub.version_generator.next()
             computed = ClientComputed(input, LTag(version), self.options, call)
@@ -219,6 +274,16 @@ class ClientComputeMethodFunction(FunctionBase):
                 self.hub.registry.register(computed)
             if self.cache is not None and not output.has_error:
                 self.cache.set(input.cache_key(), dumps(value))
+            if not output.has_error and any(k == _FAILOVER_HEADER for k, _ in headers):
+                # a failover read is served by the REPLICA, whose $sys-c
+                # subscription never sees the owner's writes — and an owner
+                # that recovers without an epoch change fences nothing. So
+                # the computed expires on the router's clock: the re-read
+                # routes back to the recovered owner (or to the replica
+                # again while the outage lasts, bounded thrash).
+                ttl = getattr(router, "failover_ttl", 0.0)
+                if ttl and ttl > 0:
+                    self.hub.timeouts.schedule_invalidate(computed, ttl)
             return computed
 
 
@@ -236,9 +301,11 @@ class FusionClient:
         peer_ref: Optional[str] = "default",
         cache: Optional[ClientComputedCache] = None,
         options: Optional[ComputedOptions] = None,
+        cluster_routed: bool = False,
     ):
         self._function = ClientComputeMethodFunction(
-            fusion_hub or default_hub(), rpc_hub, service, peer_ref, cache, options
+            fusion_hub or default_hub(), rpc_hub, service, peer_ref, cache, options,
+            cluster_routed=cluster_routed,
         )
 
     def __getattr__(self, method: str):

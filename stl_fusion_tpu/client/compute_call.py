@@ -76,8 +76,8 @@ def _record_delivery(delta_ms: float) -> None:
 class RpcOutboundComputeCall(RpcOutboundCall):
     call_type_id = CALL_TYPE_COMPUTE
 
-    def __init__(self, peer, service, method, args, no_wait=False):
-        super().__init__(peer, service, method, args, no_wait)
+    def __init__(self, peer, service, method, args, no_wait=False, headers=()):
+        super().__init__(peer, service, method, args, no_wait, headers)
         self.result_version: Optional[LTag] = None
         #: cause id of the server-side wave/span whose invalidation fenced
         #: this call (ISSUE 3 trace propagation); None until invalidated or

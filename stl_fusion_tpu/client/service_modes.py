@@ -63,25 +63,52 @@ class RoutingComputeProxy:
         client = self._clients.get(peer_ref)
         if client is None:
             client = FusionClient(
-                self.service_name, self.rpc_hub, self.fusion_hub, peer_ref, self.cache
+                self.service_name, self.rpc_hub, self.fusion_hub, peer_ref, self.cache,
+                cluster_routed=True,
             )
             self._clients[peer_ref] = client
         return client
+
+    def evict_peer(self, peer_ref: str) -> Optional[FusionClient]:
+        """Drop (and return) the cached per-peer client. Pre-ISSUE-5 these
+        were cached FOREVER: a peer that left the pool kept a live
+        FusionClient (and its cache) routing into a dead socket. The
+        cluster rebalancer calls this for every departed member; callers
+        running a static pool can call it directly on membership edits."""
+        return self._clients.pop(peer_ref, None)
 
     def __getattr__(self, method: str):
         if method.startswith("_"):
             raise AttributeError(method)
 
         async def call(*args):
-            ref = self.rpc_hub.call_router(self.service_name, method, args)
-            if not ref:  # router says local (RpcClientInterceptor local fallback)
-                if self.local_service is None:
-                    raise LookupError(
-                        f"router returned local for {self.service_name}.{method} "
-                        f"but no local service is registered"
+            attempts = 0
+            while True:
+                attempts += 1
+                router = self.rpc_hub.call_router
+                ref = router(self.service_name, method, args)
+                if not ref:  # router says local (RpcClientInterceptor local fallback)
+                    if self.local_service is None:
+                        raise LookupError(
+                            f"router returned local for {self.service_name}.{method} "
+                            f"but no local service is registered"
+                        )
+                    return await getattr(self.local_service, method)(*args)
+                try:
+                    return await getattr(self.client_for(ref), method)(*args)
+                except Exception as e:  # noqa: BLE001 — reshard retry only
+                    # a shard-map rejection (the per-peer client already
+                    # applied the carried map) or a retired peer: THIS is
+                    # the layer that owns the routing decision, so re-route
+                    # once against the current map. Static routers keep the
+                    # historic raise-through behavior.
+                    from ..cluster.shard_map import ShardMovedError
+
+                    retriable = isinstance(e, ShardMovedError) or (
+                        isinstance(e, ConnectionError) and hasattr(router, "route")
                     )
-                return await getattr(self.local_service, method)(*args)
-            return await getattr(self.client_for(ref), method)(*args)
+                    if not retriable or attempts >= 2:
+                        raise
 
         call.__name__ = method
         call.__fusion_remote_proxy__ = self  # invalidation replay is the owner's job

@@ -1,0 +1,49 @@
+"""Cluster control plane (ISSUE 5): membership, epoch-versioned shard map,
+live resharding with cache fencing.
+
+Turns the static consistent-hash router into an elastic, failure-aware
+mesh, reusing the existing substrates instead of duplicating them — the
+``$sys-m`` frames ride :class:`~stl_fusion_tpu.rpc.outbox.PeerOutbox`,
+failure detection feeds from :class:`~stl_fusion_tpu.resilience.breaker.
+PeerCircuitBreaker`, fencing drives the ordinary ``set_invalidated``
+client path, and every decision journals into the flight recorder /
+metrics registry. CLUSTER.md is the runbook.
+
+- :mod:`.shard_map` — pure, wire-serializable ``ShardMap``: V virtual
+  shards → members by rendezvous hashing; ``diff()`` names exactly what
+  moved between epochs. ``ShardMovedError`` is the protocol's rejection.
+- :mod:`.membership` — ``ClusterMember``: heartbeat membership on
+  ``$sys-m`` with a deterministic lowest-id coordinator (single-coordinator
+  control plane; no consensus claimed — see CLUSTER.md).
+- :mod:`.router` — ``ShardMapRouter`` (installable as ``RpcHub.call_router``
+  and into ``RoutingComputeProxy``), the server-side
+  ``install_cluster_guard`` fence, and the ``install_cluster_client`` glue.
+- :mod:`.rebalancer` — ``ClusterRebalancer``: fences moved keys with a
+  ``reshard:<epoch>`` cause and retires departed peers (clients, breakers,
+  peer workers).
+"""
+from .membership import ClusterMember
+from .rebalancer import ClusterRebalancer
+from .router import (
+    EPOCH_HEADER,
+    FAILOVER_HEADER,
+    SHARD_HEADER,
+    ShardMapRouter,
+    install_cluster_client,
+    install_cluster_guard,
+)
+from .shard_map import DEFAULT_SHARDS, ShardMap, ShardMovedError
+
+__all__ = [
+    "ClusterMember",
+    "ClusterRebalancer",
+    "DEFAULT_SHARDS",
+    "EPOCH_HEADER",
+    "FAILOVER_HEADER",
+    "SHARD_HEADER",
+    "ShardMap",
+    "ShardMapRouter",
+    "ShardMovedError",
+    "install_cluster_client",
+    "install_cluster_guard",
+]

@@ -1,0 +1,411 @@
+"""ClusterMember — heartbeat membership on the ``$sys-m`` system service.
+
+A deliberately small control plane, same pattern as ``$sys-c``/``$sys-d``:
+one dispatch hook on the hub, tiny frames, no new transport. The design is
+a SINGLE-COORDINATOR membership — the lowest member id coordinates, which
+is deterministic and needs no consensus round; CLUSTER.md documents exactly
+what that does NOT guarantee (a partitioned coordinator pair can mint
+divergent epochs; epochs + the owner guard bound the damage to rejected
+calls, never to silently-split writes... for reads — commands fail fast).
+
+Protocol (all frames ride ``$sys-m``, fire-and-forget through the peer's
+existing :class:`~stl_fusion_tpu.rpc.outbox.PeerOutbox`):
+
+- ``heartbeat [member_id, epoch]`` — member → coordinator, every
+  ``heartbeat_interval``. The coordinator ALWAYS answers with ``map`` on
+  the same link: the reply is simultaneously the member's liveness signal
+  for the coordinator and its epoch sync (a stale member catches up one
+  heartbeat after any change). An unknown sender is a JOIN → new epoch.
+- ``suspect [member_id, reason]`` — anyone → coordinator: failure evidence
+  (the breaker-open fast path). The coordinator removes the member → new
+  epoch.
+- ``leave [member_id]`` — graceful departure → new epoch.
+- ``map [shard_map]`` — the epoch broadcast. Applied iff newer; every
+  member that APPLIES a map forwards it to all its connected peers, so
+  downstream clients learn within one hop of whichever member they dial.
+- ``sync [epoch]`` — anyone → member: reply ``map`` if ours is newer
+  (client bootstrap).
+
+Failure detection feeds from BOTH sources the issue names: missed
+heartbeats (coordinator-side ``failure_timeout``) and open
+:class:`~stl_fusion_tpu.resilience.PeerCircuitBreaker`s — the coordinator
+checks each member peer's breaker every tick, and non-coordinators send
+``suspect`` when THEIR breaker to a member opens. Breaker evidence only
+exists where a breaker is INSTALLED: on an OUTBOUND ``client_peer(m)``
+link to the member with ``PeerCircuitBreaker(peer).install()`` (the
+routed-mesh deployment, where members dial each other to forward calls).
+A hub that only hears a member's inbound heartbeats has no breaker to
+consult, and the fast path silently contributes nothing there — the
+heartbeat timeout is the universal backstop either way. Coordinator death
+is covered by takeover: when the coordinator has been silent past
+``failure_timeout``, the lowest surviving member mints the next epoch
+without it.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..diagnostics.flight_recorder import RECORDER
+from ..diagnostics.metrics import global_metrics
+from ..resilience.events import ResilienceEvents, global_events
+from ..rpc.message import MEMBER_SYSTEM_SERVICE, RpcMessage
+from ..utils.async_chain import WorkerBase
+from ..utils.serialization import dumps, loads
+from .shard_map import DEFAULT_SHARDS, ShardMap
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["ClusterMember"]
+
+
+class ClusterMember(WorkerBase):
+    def __init__(
+        self,
+        rpc_hub,
+        member_id: str,
+        seeds: Sequence[str],
+        n_shards: int = DEFAULT_SHARDS,
+        heartbeat_interval: float = 0.5,
+        failure_timeout: float = 2.0,
+        events: Optional[ResilienceEvents] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        super().__init__(f"cluster:{member_id}")
+        self.rpc_hub = rpc_hub
+        #: this member's id IS the peer ref others dial it by
+        self.member_id = member_id
+        self.heartbeat_interval = heartbeat_interval
+        self.failure_timeout = failure_timeout
+        self.events = events if events is not None else global_events()
+        self._clock = clock
+        #: epoch 0 = bootstrap view (seeds); the coordinator mints epoch 1
+        #: on its first tick, so any coordinator map overrides any seed view
+        self.shard_map = ShardMap.initial(list(seeds) + [member_id], n_shards=n_shards)
+        now = clock()
+        self._last_heard: Dict[str, float] = {m: now for m in self.shard_map.members}
+        self._coord_heard = now
+        #: callbacks ``(old_map, new_map)`` on every applied/minted epoch
+        self.on_map_change: List[Callable[[ShardMap, ShardMap], None]] = []
+        # -- counters (collector-exported; report()["cluster"]) -----------
+        self.epochs_minted = 0
+        self.joins_seen = 0
+        self.failures_seen = 0
+        self.takeovers = 0
+        self.heartbeats_sent = 0
+        self.heartbeats_seen = 0
+        self.stale_rejections = 0  # bumped by the guard (cluster/router.py)
+        self._suspected: set = set()  # dedup suspicion sends per incident
+        #: member -> when we FIRST courted it as takeover successor; a
+        #: candidate that never answers for a full failure window is
+        #: treated as dead too (double-failure takeover, _member_tick)
+        self._court_started: Dict[str, float] = {}
+        global_metrics().register_collector(self, ClusterMember._collect_metrics)
+        global_metrics().set_aggregation("fusion_shard_map_epoch", "max")
+        # member count is a non-additive gauge: N co-hosted members must
+        # scrape as N members, not N² (set_aggregation docstring rule)
+        global_metrics().set_aggregation("fusion_cluster_members", "max")
+
+    # ------------------------------------------------------------------ wiring
+    def install(self) -> "ClusterMember":
+        """Attach the ``$sys-m`` dispatch hook and start the tick loop."""
+        self.rpc_hub.member_system_handler = self._handle
+        self.start()
+        return self
+
+    async def dispose(self) -> None:
+        if self.rpc_hub.member_system_handler is self._handle:
+            self.rpc_hub.member_system_handler = None
+        global_metrics().unregister_collector(self)
+        await self.stop()
+
+    def _collect_metrics(self) -> dict:
+        return {
+            "fusion_shard_map_epoch": self.shard_map.epoch,
+            "fusion_cluster_members": len(self.shard_map.members),
+            "fusion_cluster_is_coordinator": 1 if self.is_coordinator else 0,
+            "fusion_cluster_epochs_minted_total": self.epochs_minted,
+            "fusion_cluster_joins_total": self.joins_seen,
+            "fusion_cluster_failures_total": self.failures_seen,
+            "fusion_cluster_stale_rejections_total": self.stale_rejections,
+        }
+
+    # ------------------------------------------------------------------ state
+    @property
+    def coordinator(self) -> Optional[str]:
+        return self.shard_map.coordinator
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.coordinator == self.member_id
+
+    def snapshot(self) -> dict:
+        return {
+            "member_id": self.member_id,
+            "epoch": self.shard_map.epoch,
+            "members": list(self.shard_map.members),
+            "coordinator": self.coordinator,
+            "is_coordinator": self.is_coordinator,
+            "n_shards": self.shard_map.n_shards,
+            "epochs_minted": self.epochs_minted,
+            "joins_seen": self.joins_seen,
+            "failures_seen": self.failures_seen,
+            "takeovers": self.takeovers,
+            "stale_rejections": self.stale_rejections,
+        }
+
+    # ------------------------------------------------------------------ frames
+    @staticmethod
+    def _frame(method: str, args: list) -> RpcMessage:
+        return RpcMessage(0, 0, MEMBER_SYSTEM_SERVICE, method, dumps(args))
+
+    async def _try_send(self, peer, method: str, args: list) -> bool:
+        """Fire-and-forget control frame: membership is periodic, so a miss
+        (link down, mid-dial) is covered by the next tick — never by
+        parking the tick loop on ``when_connected``."""
+        try:
+            await peer.send(self._frame(method, args))
+            return True
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — the next tick retries
+            return False
+
+    def _handle(self, peer, message: RpcMessage):
+        """``$sys-m`` dispatch (may return a coroutine — the peer pump
+        spawns it so replies never block the receive loop)."""
+        method = message.method
+        args = loads(message.argument_data)
+        ref = getattr(peer, "ref", None)
+        if ref is not None:
+            # ANY $sys-m frame proves the sender lives: a courted takeover
+            # candidate that answers stops its court-silence clock
+            self._court_started.pop(ref, None)
+        if method == "heartbeat":
+            member_id, epoch = args[0], int(args[1])
+            return self._on_heartbeat(peer, member_id, epoch)
+        if method == "map":
+            wire = args[0]
+            smap = wire if isinstance(wire, ShardMap) else ShardMap.from_wire(wire)
+            if peer.ref == self.coordinator or smap.coordinator == self.coordinator:
+                self._coord_heard = self._clock()
+            return self._apply_map(smap)
+        if method == "suspect":
+            member_id = args[0]
+            reason = args[1] if len(args) > 1 else "suspected"
+            if self.is_coordinator:
+                return self._remove_members({member_id}, f"suspected: {reason}")
+            return None
+        if method == "leave":
+            if self.is_coordinator:
+                return self._remove_members({args[0]}, "graceful leave")
+            return None
+        if method == "sync":
+            their_epoch = int(args[0])
+            if self.shard_map.epoch > their_epoch:
+                return self._try_send(peer, "map", [self.shard_map.to_wire()])
+            return None
+        return None
+
+    async def _on_heartbeat(self, peer, member_id: str, epoch: int) -> None:
+        self.heartbeats_seen += 1
+        self._last_heard[member_id] = self._clock()
+        self._suspected.discard(member_id)
+        if self.is_coordinator and member_id not in self.shard_map.members:
+            self.joins_seen += 1
+            self.events.record("cluster_join", member_id)
+            self._mint(
+                list(self.shard_map.members) + [member_id], f"join: {member_id}"
+            )
+        # the reply is liveness + sync in one tiny frame; non-coordinators
+        # answer too (a joiner seeded with only THIS member still learns
+        # the real map, and through it the real coordinator)
+        await self._try_send(peer, "map", [self.shard_map.to_wire()])
+
+    # ------------------------------------------------------------------ epochs
+    def _mint(self, members: Sequence[str], why: str) -> None:
+        """Coordinator-side: mint the next epoch and broadcast it."""
+        old = self.shard_map
+        new = old.with_members(members)
+        self.epochs_minted += 1
+        log.debug("cluster %s: epoch %d -> %d (%s)", self.member_id, old.epoch, new.epoch, why)
+        self._adopt(old, new, why)
+
+    def _apply_map(self, new: ShardMap) -> None:
+        old = self.shard_map
+        if new.epoch <= old.epoch:
+            return
+        self._adopt(old, new, "applied from broadcast")
+
+    def _adopt(self, old: ShardMap, new: ShardMap, why: str) -> None:
+        self.shard_map = new
+        if new.coordinator != old.coordinator:
+            # the takeover clock restarts for a NEW coordinator: a bystander
+            # adopting a takeover map mid-timeout would otherwise keep the
+            # DEAD coordinator's last-heard stamp, decide the LIVE successor
+            # is silent too, and mint an epoch ejecting it
+            self._coord_heard = self._clock()
+            self._court_started.clear()  # succession settled; fresh slate
+        for m in new.members:
+            self._last_heard.setdefault(m, self._clock())
+        if RECORDER.enabled:
+            moved = ShardMap.diff(old, new)
+            RECORDER.note(
+                "resharded",
+                key=None,
+                cause=f"reshard:{new.epoch}",
+                count=len(moved),
+                detail=(
+                    f"epoch {old.epoch}->{new.epoch} on {self.member_id}: "
+                    f"{len(moved)} shard(s) moved ({why})"
+                ),
+            )
+        for cb in list(self.on_map_change):
+            try:
+                cb(old, new)
+            except Exception:  # noqa: BLE001
+                log.exception("cluster %s: map-change callback failed", self.member_id)
+        # forward to every connected peer (members we dialed, members and
+        # clients that dialed us) — one hop of gossip makes the broadcast
+        # reach clients of every member, not just the coordinator's
+        self._broadcast(new)
+
+    def _broadcast(self, smap: ShardMap) -> None:
+        wire = smap.to_wire()
+        for peer in list(self.rpc_hub.peers.values()):
+            if peer.is_connected:
+                task = asyncio.get_event_loop().create_task(
+                    self._try_send(peer, "map", [wire])
+                )
+                # tracked like $sys-d replies: silent, bounded, cancellable
+                peer._diag_tasks.add(task)
+                task.add_done_callback(peer._diag_tasks.discard)
+
+    def _remove_members(self, gone: set, why: str) -> None:
+        gone = {m for m in gone if m in self.shard_map.members and m != self.member_id}
+        if not gone:
+            return
+        self.failures_seen += len(gone)
+        self.events.record("cluster_member_removed", f"{sorted(gone)}: {why}")
+        self._mint([m for m in self.shard_map.members if m not in gone], why)
+
+    # ------------------------------------------------------------------ tick
+    async def on_run(self) -> None:
+        while True:
+            await asyncio.sleep(self.heartbeat_interval)
+            try:
+                if self.is_coordinator:
+                    await self._coordinator_tick()
+                else:
+                    await self._member_tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the tick loop must survive
+                log.exception("cluster %s: tick failed", self.member_id)
+
+    async def _coordinator_tick(self) -> None:
+        now = self._clock()
+        self._last_heard[self.member_id] = now
+        if self.shard_map.epoch == 0:
+            # first tick of a fresh cluster: promote the seed view to a
+            # real epoch so joiners' bootstrap maps are strictly older
+            self._mint(self.shard_map.members, "bootstrap")
+            return
+        dead = set()
+        for m in self.shard_map.members:
+            if m == self.member_id:
+                continue
+            if self._last_heard.get(m, now) + self.failure_timeout < now:
+                dead.add(m)
+                self.events.record("cluster_heartbeat_timeout", m)
+                continue
+            peer = self.rpc_hub.peers.get(m)
+            breaker = getattr(peer, "breaker", None) if peer is not None else None
+            if breaker is not None and breaker.state == "open":
+                # the breaker's evidence is fresher than the heartbeat
+                # timeout — fail the member over NOW
+                dead.add(m)
+                self.events.record("cluster_breaker_evidence", m)
+        if dead:
+            self._remove_members(dead, "failure detection")
+
+    async def _member_tick(self) -> None:
+        coord = self.coordinator
+        now = self._clock()
+        if coord is not None and coord != self.member_id:
+            peer = self.rpc_hub.client_peer(coord)
+            if await self._try_send(
+                peer, "heartbeat", [self.member_id, self.shard_map.epoch]
+            ):
+                self.heartbeats_sent += 1
+            # coordinator takeover: silent past the failure timeout, and we
+            # are the lowest VIABLE survivor → mint the next epoch without
+            # it (deterministic; a live-but-partitioned coordinator will
+            # keep minting too — the documented no-consensus caveat). A
+            # survivor we courted for a full failure window without ONE
+            # answering frame counts as dead too: when the coordinator and
+            # the lowest survivor die together (one rack), succession must
+            # cascade to the next member, not leave the cluster headless.
+            if self._coord_heard + self.failure_timeout < now:
+                viable = [
+                    m
+                    for m in self.shard_map.members
+                    if m != coord
+                    and (
+                        m == self.member_id
+                        or self._court_started.get(m, now) + self.failure_timeout >= now
+                    )
+                ]
+                if viable and min(viable) == self.member_id:
+                    dropped = set(self.shard_map.members) - set(viable)
+                    self.takeovers += 1
+                    self.failures_seen += len(dropped)
+                    self.events.record(
+                        "cluster_takeover",
+                        f"{self.member_id} replaces {coord} "
+                        f"(silent: {sorted(dropped)})",
+                    )
+                    self._coord_heard = now
+                    self._mint(viable, f"takeover from silent {coord}")
+                elif viable:
+                    # not the successor: court the would-be coordinator so
+                    # we learn its takeover epoch (we only ever dial the
+                    # coordinator, and ours is dead — without this hop a
+                    # bystander member never hears the new map), and start
+                    # its court-silence clock
+                    candidate = min(viable)
+                    self._court_started.setdefault(candidate, now)
+                    if await self._try_send(
+                        self.rpc_hub.client_peer(candidate),
+                        "heartbeat",
+                        [self.member_id, self.shard_map.epoch],
+                    ):
+                        self.heartbeats_sent += 1
+        # suspicion fast path: OUR breaker to a fellow member opened —
+        # tell the coordinator instead of waiting out its heartbeat window
+        for m in self.shard_map.members:
+            if m == self.member_id or m == coord:
+                continue
+            peer = self.rpc_hub.peers.get(m)
+            breaker = getattr(peer, "breaker", None) if peer is not None else None
+            if breaker is None or breaker.state != "open":
+                # incident over (breaker closed / peer rebuilt): re-arm so
+                # the member's NEXT failure takes the fast path again —
+                # suspicion dedup is per incident, not per member forever
+                self._suspected.discard(m)
+                continue
+            if m in self._suspected or coord is None:
+                continue
+            self._suspected.add(m)
+            await self._try_send(
+                self.rpc_hub.client_peer(coord), "suspect", [m, "breaker open"]
+            )
+
+    async def leave(self) -> None:
+        """Graceful departure: tell the coordinator, then dispose."""
+        coord = self.coordinator
+        if coord is not None and coord != self.member_id:
+            await self._try_send(self.rpc_hub.client_peer(coord), "leave", [self.member_id])
+        await self.dispose()

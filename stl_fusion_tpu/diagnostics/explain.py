@@ -184,6 +184,40 @@ def explain(
             out["chain"] = [f"{key_str}: no recorded invalidation (state: {state})"]
         return out
 
+    # reshard cause family (ISSUE 5): the key was fenced because its shard
+    # moved to a new owner — no wave, no span, no oplog entry; the story is
+    # the epoch change. The rebalancer journals a per-key "resharded" event
+    # whose detail names the owner move, so the chain can say exactly
+    # where the key's subscription went.
+    if cause is not None and cause.startswith("reshard:"):
+        epoch_s = cause.partition(":")[2]
+        # match the journal event to THIS invalidation's epoch: after
+        # consecutive reshards the key's newest "resharded" event can
+        # describe a later epoch's owner move, not the one that fenced it
+        moved_ev = next(
+            (
+                e
+                for e in reversed(events)
+                if e["kind"] == "resharded" and e.get("cause") == cause
+            ),
+            None,
+        )
+        detail = (moved_ev or {}).get("detail") or ""
+        line = f"{key_str}: invalidated by reshard to epoch {epoch_s}"
+        if "owner " in detail:
+            line += f" ({detail[detail.index('owner '):].replace('->', '→')})"
+        out["invalidation"] = {
+            "cause": cause,
+            "reshard_epoch": int(epoch_s) if epoch_s.isdigit() else epoch_s,
+            "detail": detail or None,
+        }
+        out["chain"] = [
+            line,
+            f"caused by {cause}",
+            "the fenced client re-subscribes on the new owner at its next read",
+        ]
+        return out
+
     # wave record: an exact seq match wins outright (several waves can
     # share one span-shaped cause — e.g. two cascades under one command
     # span — and a cause-first scan would grab the NEWEST of them, not the

@@ -58,7 +58,15 @@ __all__ = [
 
 
 def _sanitize(name: str) -> str:
-    """Prometheus metric-name charset: [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    """Prometheus metric-name charset: [a-zA-Z_:][a-zA-Z0-9_:]*.
+
+    A ``{label="value"}`` suffix is preserved verbatim (the cluster router
+    exports per-peer series like ``fusion_routed_calls_total{peer="m0"}``);
+    only the metric-name prefix is sanitized. Suffix values come from
+    in-repo collectors, never from wire input."""
+    if "{" in name and name.endswith("}"):
+        base, _, labels = name.partition("{")
+        return _sanitize(base) + "{" + labels
     out = []
     for i, ch in enumerate(name):
         ok = ch.isascii() and (ch.isalpha() or ch == "_" or ch == ":" or (ch.isdigit() and i > 0))
@@ -349,10 +357,18 @@ class MetricsRegistry:
                 v = m.value
                 lines.append(f"{m.name} {v}")
         collected = self._collect()
+        typed = {m.name for m in metrics}
         for k in sorted(collected):
-            if any(m.name == k for m in metrics):
-                continue
-            lines.append(f"# TYPE {k} gauge")
+            # labeled samples (fusion_routed_calls_total{peer="m0"}) belong
+            # to their base family: ONE valid "# TYPE <base> gauge" line,
+            # never a TYPE line with a brace-suffixed name (which breaks
+            # the whole scrape — the exposition name charset is strict)
+            base = k.partition("{")[0]
+            if k == base and base in typed:
+                continue  # registered metrics win over collector shadows
+            if base not in typed:
+                lines.append(f"# TYPE {base} gauge")
+                typed.add(base)
             lines.append(f"{k} {collected[k]}")
         return "\n".join(lines) + "\n"
 

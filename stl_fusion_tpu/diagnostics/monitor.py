@@ -52,6 +52,9 @@ class FusionMonitor:
         #: (attach_rpc_hub); weakly referenced so a monitor never pins a
         #: stopped hub's peer machinery
         self._rpc_hubs: list = []
+        #: cluster control-plane parts (attach_cluster): member / router /
+        #: rebalancer snapshots merged into report()["cluster"]
+        self._cluster_parts: list = []
         # the hot-cache fast path counts amortized on the registry (every
         # 16th hit — see core/service.py) instead of firing a hook per hit
         self._fast_hits0 = getattr(hub.registry, "fast_hits", 0)
@@ -179,6 +182,30 @@ class FusionMonitor:
         self._rpc_hubs.append(weakref.ref(rpc_hub))
         return self
 
+    def attach_cluster(self, *parts) -> "FusionMonitor":
+        """Export cluster control-plane state in :meth:`report` under
+        ``"cluster"``: any mix of ``ClusterMember``, ``ShardMapRouter``
+        and ``ClusterRebalancer`` (anything with ``snapshot()``), merged
+        into one dict. Weakly referenced, like the RPC hubs."""
+        import weakref
+
+        for part in parts:
+            self._cluster_parts.append(weakref.ref(part))
+        return self
+
+    def _cluster_report(self):
+        merged = None
+        for ref in self._cluster_parts:
+            part = ref()
+            if part is None:
+                continue
+            snap = part.snapshot()
+            if merged is None:
+                merged = dict(snap)
+            else:
+                merged.update(snap)
+        return merged
+
     def _fanout_report(self):
         totals = None
         for ref in self._rpc_hubs:
@@ -231,6 +258,9 @@ class FusionMonitor:
         elapsed = time.monotonic() - self._started_at
         fanout = self._fanout_report()
         extra = {"fanout": fanout} if fanout is not None else {}
+        cluster = self._cluster_report()
+        if cluster is not None:
+            extra["cluster"] = cluster
         # per-wave timelines: the hub's graph backend carries the profiler
         backend = getattr(self.hub, "graph_backend", None)
         profiler = getattr(backend, "profiler", None)

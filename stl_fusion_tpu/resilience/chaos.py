@@ -286,6 +286,16 @@ def reorder_burst(seed: int = 23) -> ChaosPolicy:
     return ChaosPolicy(seed=seed, duplicate=0.05, reorder_window=4)
 
 
+@_scenario("member_churn")
+def member_churn(seed: int = 41) -> ChaosPolicy:
+    """Lossy, duplicating, reordering links with NO scheduled events — the
+    message-level weather for the cluster acceptance scenario
+    (tests/test_cluster.py): the kill/join sequence is orchestrated by the
+    test (real member death, not a link flap), while every control-plane
+    and data frame rides this policy."""
+    return ChaosPolicy(seed=seed, drop=0.03, duplicate=0.02, reorder_window=4)
+
+
 @_scenario("partition_storm")
 def partition_storm(seed: int = 31) -> ChaosPolicy:
     """Three quick peer kills (the flap ramp that opens a breaker), then a

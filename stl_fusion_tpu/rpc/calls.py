@@ -39,12 +39,26 @@ class RpcOutboundCall:
 
     call_type_id = CALL_TYPE_PLAIN
 
-    def __init__(self, peer: "RpcPeer", service: str, method: str, args: tuple, no_wait: bool = False):
+    def __init__(
+        self,
+        peer: "RpcPeer",
+        service: str,
+        method: str,
+        args: tuple,
+        no_wait: bool = False,
+        headers: tuple = (),
+    ):
         self.peer = peer
         self.service = service
         self.method = method
         self.args = args
         self.no_wait = no_wait
+        #: extra wire headers stamped on the call message (the cluster
+        #: router's ``@shard``/``@epoch``/``@failover`` stamps ride here);
+        #: fixed at call creation, so a reconnect re-send replays the SAME
+        #: stamp — a re-sent call with a stale epoch is rejected with the
+        #: current map, which is exactly the sync the client needs
+        self.headers = headers
         self.call_id = peer.allocate_call_id()
         self.future: Optional[asyncio.Future] = None if no_wait else asyncio.get_event_loop().create_future()
 
@@ -56,6 +70,7 @@ class RpcOutboundCall:
             service=self.service,
             method=self.method,
             argument_data=dumps(list(self.args)),
+            headers=self.headers,
         )
 
     # -- lifecycle ---------------------------------------------------------

@@ -150,6 +150,12 @@ class FusionHttpServer:
         self.serve_observability: bool = True
         #: optional diagnostics.FusionMonitor whose report() /trace embeds
         self.monitor = None
+        #: cluster control-plane parts served by GET /shards (ISSUE 5):
+        #: any mix of ClusterMember / ShardMapRouter / ClusterRebalancer
+        #: (anything with ``snapshot()``), merged — same trust gate as the
+        #: other observability routes (topology + per-peer traffic are
+        #: operator data, not public data)
+        self.cluster: tuple = ()
         self._server: Optional[asyncio.AbstractServer] = None
 
     def _is_trusted_proxy(self, headers: dict) -> bool:
@@ -219,7 +225,7 @@ class FusionHttpServer:
             observability = (
                 self.serve_observability
                 and method == "GET"
-                and path in ("/metrics", "/trace", "/explain")
+                and path in ("/metrics", "/trace", "/explain", "/shards")
                 # same trust gate as principal headers: loopback (or the
                 # shared scraper secret) only — a direct remote client must
                 # not read spans/reports off a port it happens to reach
@@ -309,6 +315,27 @@ class FusionHttpServer:
                     status_line = "500 Internal Server Error"
                     payload = {"error": {"type": type(e).__name__, "message": str(e)}}
                 await self._write_json(writer, status_line, payload)
+                return
+            if observability and path == "/shards":
+                merged: dict = {}
+                for part in self.cluster:
+                    try:
+                        merged.update(part.snapshot())
+                    except Exception as e:  # noqa: BLE001 — one bad part, not a 500
+                        merged.setdefault("errors", []).append(repr(e))
+                if not merged:
+                    await self._write_json(
+                        writer,
+                        "503 Service Unavailable",
+                        {
+                            "error": {
+                                "type": "NoCluster",
+                                "message": "no cluster parts attached to this gateway",
+                            }
+                        },
+                    )
+                    return
+                await self._write_json(writer, "200 OK", merged)
                 return
             static = self.static_routes.get(path)
             if static is not None and method == "GET":

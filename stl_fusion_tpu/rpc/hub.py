@@ -10,7 +10,6 @@ service modes, FusionBuilder.cs:222-320).
 """
 from __future__ import annotations
 
-import hashlib
 import logging
 import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Sequence
@@ -87,6 +86,11 @@ class RpcHub:
         #: ASYNC callable (the server side awaits a registry peek + a reply
         #: send) — the peer dispatch awaits coroutine results
         self.diag_system_handler: Optional[Callable[[RpcPeer, RpcMessage], Any]] = None
+        #: $sys-m dispatch hook (cluster membership: heartbeats, suspicions,
+        #: shard-map pushes), installed by cluster.membership.ClusterMember
+        #: on members and cluster.router.install_cluster_client on clients;
+        #: may be async (map replies) — dispatched like $sys-d
+        self.member_system_handler: Optional[Callable[[RpcPeer, RpcMessage], Any]] = None
         #: composable middleware chains (≈ RpcInboundMiddleware /
         #: RpcOutboundMiddleware, Stl.Rpc/Infrastructure/): each entry is
         #: ``async (peer, message, nxt)`` where ``await nxt(message)``
@@ -189,18 +193,48 @@ class RpcHub:
         call_type_id: int = 0,
         no_wait: bool = False,
     ) -> Any:
-        ref = peer_ref if peer_ref is not None else self.call_router(service, method, args)
-        if ref is None:
-            # router says local (≈ RpcClientInterceptor local fallback)
-            local = self.local_services.get(service)
-            if local is None:
-                raise LookupError(f"no local implementation for {service!r}")
-            return await getattr(local, method)(*args)
-        peer = self.client_peer(ref)
-        await peer.when_connected()
-        outbound_cls = self.call_types.outbound(call_type_id)
-        call = outbound_cls(peer, service, method, args, no_wait=no_wait)
-        return await call.invoke()
+        attempts = 0
+        while True:
+            attempts += 1
+            router = self.call_router
+            headers: tuple = ()
+            if peer_ref is not None:
+                # an explicit pin opts OUT of cluster routing — no shard
+                # stamp, so the guard never second-guesses the caller
+                ref = peer_ref
+            elif hasattr(router, "route"):
+                # shard-map router: the routing decision carries its own
+                # @shard/@epoch stamp (cluster/router.py); a command whose
+                # owner is down fails fast RIGHT HERE (never retried below)
+                ref, headers = router.route(service, method, args)
+            else:
+                ref = router(service, method, args)
+            if ref is None:
+                # router says local (≈ RpcClientInterceptor local fallback)
+                local = self.local_services.get(service)
+                if local is None:
+                    raise LookupError(f"no local implementation for {service!r}")
+                return await getattr(local, method)(*args)
+            peer = self.client_peer(ref)
+            await peer.when_connected()
+            outbound_cls = self.call_types.outbound(call_type_id)
+            call = outbound_cls(peer, service, method, args, no_wait=no_wait, headers=headers)
+            try:
+                return await call.invoke()
+            except Exception as e:  # noqa: BLE001 — only ShardMovedError is special
+                from ..cluster.shard_map import ShardMovedError
+
+                if (
+                    not isinstance(e, ShardMovedError)
+                    or peer_ref is not None
+                    or attempts >= 2
+                ):
+                    raise
+                # the rejection carries the server's current map: apply it
+                # and retry ONCE against the new owner (bounded — a second
+                # rejection surfaces to the caller)
+                if hasattr(router, "note_moved"):
+                    router.note_moved(e)
 
     async def stop(self) -> None:
         for peer in list(self.peers.values()):
@@ -258,11 +292,24 @@ def consistent_hash_router(
     peer_refs: Sequence[str], key_arg: int = 0
 ) -> RpcCallRouter:
     """Shard calls over a peer pool by hashing an argument — the reference's
-    MultiServerRpc routing pattern (Program.cs:58-76)."""
+    MultiServerRpc routing pattern (Program.cs:58-76).
+
+    Since ISSUE 5 this is a thin shim over the cluster's
+    :class:`~stl_fusion_tpu.cluster.shard_map.ShardMap` with a STATIC
+    member list: same public name and signature, but routing goes
+    key → virtual shard → rendezvous owner instead of sha1-mod-N, so
+    removing one member from the pool moves only that member's shards
+    (~V/N keys) rather than remapping ~(N-1)/N of everything. Routes stay
+    sha1-stable across process restarts (never the salted builtin
+    ``hash()``). For an ELASTIC pool — membership, epochs, failover,
+    fencing — install a ``cluster.ShardMapRouter`` instead."""
+    from ..cluster.shard_map import ShardMap
+
+    shard_map = ShardMap.initial(peer_refs)
 
     def route(service: str, method: str, args: tuple) -> str:
         key = repr(args[key_arg]) if len(args) > key_arg else service
-        h = int.from_bytes(hashlib.sha1(key.encode()).digest()[:8], "big")
-        return peer_refs[h % len(peer_refs)]
+        return shard_map.owner_of(key)
 
+    route.shard_map = shard_map  # introspectable by tests/diagnostics
     return route

@@ -19,6 +19,7 @@ __all__ = [
     "COMPUTE_SYSTEM_SERVICE",
     "TABLE_SYSTEM_SERVICE",
     "DIAG_SYSTEM_SERVICE",
+    "MEMBER_SYSTEM_SERVICE",
     "VERSION_HEADER",
 ]
 
@@ -26,6 +27,7 @@ SYSTEM_SERVICE = "$sys"
 COMPUTE_SYSTEM_SERVICE = "$sys-c"
 TABLE_SYSTEM_SERVICE = "$sys-t"  # per-TABLE row fences (remote_table.py)
 DIAG_SYSTEM_SERVICE = "$sys-d"  # cross-peer introspection (diagnostics/explain.py)
+MEMBER_SYSTEM_SERVICE = "$sys-m"  # cluster membership + shard-map frames (cluster/)
 VERSION_HEADER = "@version"
 
 CALL_TYPE_PLAIN = 0

@@ -25,6 +25,7 @@ from ..utils.serialization import dumps, loads
 from .message import (
     COMPUTE_SYSTEM_SERVICE,
     DIAG_SYSTEM_SERVICE,
+    MEMBER_SYSTEM_SERVICE,
     SYSTEM_SERVICE,
     TABLE_SYSTEM_SERVICE,
     RpcMessage,
@@ -347,6 +348,19 @@ class RpcPeer(WorkerBase):
                     # invalidation frames queued behind it on this link. A
                     # hub with no handler silently drops the frame
                     # (introspection is additive, never load-bearing).
+                    task = asyncio.get_event_loop().create_task(result)
+                    self._diag_tasks.add(task)
+                    task.add_done_callback(self._on_diag_done)
+        elif message.service == MEMBER_SYSTEM_SERVICE:
+            handler = self.hub.member_system_handler
+            if handler is not None:
+                result = handler(self, message)
+                if asyncio.iscoroutine(result):
+                    # same discipline as $sys-d: membership bookkeeping may
+                    # need to SEND (a map reply to a heartbeat), and that
+                    # awaited send must not head-of-line-block this link's
+                    # receive pump. A hub with no handler drops the frame —
+                    # a cluster-unaware peer ignores the control plane.
                     task = asyncio.get_event_loop().create_task(result)
                     self._diag_tasks.add(task)
                     task.add_done_callback(self._on_diag_done)

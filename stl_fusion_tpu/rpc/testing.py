@@ -87,7 +87,8 @@ class RpcTestTransportBase:
     """Channel-pair transport plumbing shared by the single- and
     multi-server variants; subclasses pick the server hub per peer ref."""
 
-    def __init__(self, client_hub: RpcHub, wire_codec: bool = False):
+    def __init__(self, client_hub: RpcHub, wire_codec: bool = False,
+                 client_name: Optional[str] = None):
         self.client_hub = client_hub
         self.connect_count: Dict[str, int] = {}
         self._blocked = False
@@ -97,10 +98,22 @@ class RpcTestTransportBase:
         #: (both directions, both ends) — the serialization cost a real
         #: socket transport pays per frame
         self.wire_codec = wire_codec
+        #: distinguishes this client hub in the SERVER-side peer ref. The
+        #: historic ref shape ``client:{target_ref}`` collides when several
+        #: client hubs dial the same server (each .connect() displaces the
+        #: previous link) — a cluster mesh (N members + M clients all
+        #: dialing each other, cluster/) needs one server peer PER dialer.
+        self.client_name = client_name
         client_hub.client_connector = self._connect
 
     def _server_for(self, peer_ref: str) -> RpcHub:
         raise NotImplementedError
+
+    def server_peer_ref(self, target_ref: str) -> str:
+        """The ref the target server hub knows this client hub's link by."""
+        if self.client_name is not None:
+            return f"client:{self.client_name}@{target_ref}"
+        return f"client:{target_ref}"
 
     async def _connect(self, peer: RpcClientPeer) -> ChannelPair:
         if self._blocked:
@@ -115,7 +128,7 @@ class RpcTestTransportBase:
 
             client_end = wrap_chaos_pair(client_end, self._chaos)
             server_end = wrap_chaos_pair(server_end, self._chaos)
-        server_hub.server_peer(f"client:{peer.ref}").connect(server_end)
+        server_hub.server_peer(self.server_peer_ref(peer.ref)).connect(server_end)
         self.connect_count[peer.ref] = self.connect_count.get(peer.ref, 0) + 1
         if self._fail_next_after is not None:
             fail_after, self._fail_next_after = self._fail_next_after, None
@@ -152,8 +165,9 @@ class RpcTestTransportBase:
 class RpcTestTransport(RpcTestTransportBase):
     """Wires a client hub to a server hub through channel pairs."""
 
-    def __init__(self, client_hub: RpcHub, server_hub: RpcHub, wire_codec: bool = False):
-        super().__init__(client_hub, wire_codec=wire_codec)
+    def __init__(self, client_hub: RpcHub, server_hub: RpcHub, wire_codec: bool = False,
+                 client_name: Optional[str] = None):
+        super().__init__(client_hub, wire_codec=wire_codec, client_name=client_name)
         self.server_hub = server_hub
 
     def _server_for(self, peer_ref: str) -> RpcHub:
@@ -165,8 +179,9 @@ class RpcMultiServerTestTransport(RpcTestTransportBase):
     the in-memory analogue of the MultiServerRpc sample's server pool
     (samples/MultiServerRpc/Program.cs:58-76): peer ref = pool member."""
 
-    def __init__(self, client_hub: RpcHub, servers: Dict[str, RpcHub], wire_codec: bool = False):
-        super().__init__(client_hub, wire_codec=wire_codec)
+    def __init__(self, client_hub: RpcHub, servers: Dict[str, RpcHub], wire_codec: bool = False,
+                 client_name: Optional[str] = None):
+        super().__init__(client_hub, wire_codec=wire_codec, client_name=client_name)
         self.servers = dict(servers)
 
     def _server_for(self, peer_ref: str) -> RpcHub:

@@ -1,0 +1,1023 @@
+"""Cluster control plane tests (ISSUE 5): rendezvous shard-map properties
+(stability, minimal movement), heartbeat membership (bootstrap, join,
+failure, breaker evidence, coordinator takeover), epoch-stamped routing
+(stale-client apply-and-retry, read failover, command fail-fast), the
+rebalancer's cache fencing + departed-peer retirement (the
+RoutingComputeProxy._clients leak regression), explain()'s reshard cause
+family, and THE acceptance scenario — a 3-member cluster under the seeded
+``member_churn`` chaos policy surviving one kill and one join with zero
+oracle-divergent stale reads and zero unhandled exceptions."""
+import asyncio
+import hashlib
+import time
+
+import pytest
+
+from stl_fusion_tpu.client import (
+    RpcServiceMode,
+    add_fusion_service,
+    install_compute_call_type,
+)
+from stl_fusion_tpu.cluster import (
+    ClusterMember,
+    ClusterRebalancer,
+    ShardMap,
+    ShardMapRouter,
+    ShardMovedError,
+    install_cluster_client,
+    install_cluster_guard,
+)
+from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method, invalidating
+from stl_fusion_tpu.resilience import SCENARIOS, BreakerState, PeerCircuitBreaker
+from stl_fusion_tpu.rpc import RpcHub, RpcMultiServerTestTransport
+from stl_fusion_tpu.utils.errors import ExceptionInfo
+from stl_fusion_tpu.utils.serialization import dumps, loads
+
+
+# ------------------------------------------------------------------ shard map
+
+def test_shard_map_is_deterministic_and_order_insensitive():
+    a = ShardMap.initial(["m0", "m1", "m2"], n_shards=128, epoch=1)
+    b = ShardMap.initial(["m2", "m0", "m1"], n_shards=128, epoch=1)
+    assert a.assignment == b.assignment
+    assert a.members == ("m0", "m1", "m2")
+    assert a.coordinator == "m0"
+    # sha1-anchored, never the salted builtin hash(): recompute the
+    # rendezvous winner for shard 7 from first principles
+    def score(member, shard):
+        return int.from_bytes(hashlib.sha1(f"{member}|{shard}".encode()).digest()[:8], "big")
+
+    expected = max(a.members, key=lambda m: (score(m, 7), m))
+    assert a.owner_of_shard(7) == expected
+    # key → shard is pure sha1 too
+    digest = int.from_bytes(hashlib.sha1(b"some-key").digest()[:8], "big")
+    assert a.shard_of("some-key") == digest % 128
+
+
+def test_shard_map_minimal_movement():
+    """Removing a member moves EXACTLY its shards (≈V/N); adding one moves
+    ≈V/(N+1). The modulo router this replaces moved ~(N-1)/V·V."""
+    for n in (2, 3, 5):
+        members = [f"m{i}" for i in range(n)]
+        old = ShardMap.initial(members, n_shards=256, epoch=1)
+        removed = members[-1]
+        new = old.with_members(members[:-1])
+        moved = set(ShardMap.diff(old, new))
+        owned = {s for s in range(256) if old.owner_of_shard(s) == removed}
+        assert moved == owned  # nothing ELSE moves — the rendezvous property
+        assert len(moved) <= 2 * 256 // n  # ≤ 2/N of the shards
+        # unmoved shards keep their exact owner
+        for s in range(256):
+            if s not in moved:
+                assert new.owner_of_shard(s) == old.owner_of_shard(s)
+        grown = old.with_members(members + ["extra"])
+        gained = set(ShardMap.diff(old, grown))
+        assert 0 < len(gained) <= 2 * 256 // (n + 1)
+        assert all(grown.owner_of_shard(s) == "extra" for s in gained)
+
+
+def test_shard_map_epochs_diff_and_wire():
+    m1 = ShardMap.initial(["a", "b"], n_shards=32, epoch=1)
+    m2 = m1.with_members(["a", "b", "c"])
+    assert m2.epoch == 2
+    assert ShardMap.diff(m1, m1) == ()
+    rt = loads(dumps(m2))
+    assert rt == m2 and rt.assignment == m2.assignment
+    # replica = second in rendezvous order, never the owner
+    for s in range(32):
+        owners = m2.owners_for_shard(s, 2)
+        assert owners[0] == m2.owner_of_shard(s)
+        assert owners[1] != owners[0]
+        assert m2.replica_of_shard(s) == owners[1]
+
+
+def test_shard_moved_error_carries_map_through_exception_info():
+    smap = ShardMap.initial(["a", "b"], n_shards=16, epoch=3)
+    err = ShardMovedError("shard 5 moved", shard_map=smap)
+    rebuilt = ExceptionInfo.capture(err).to_exception()
+    assert isinstance(rebuilt, ShardMovedError)
+    assert rebuilt.shard_map == smap
+    bare = ExceptionInfo.capture(ShardMovedError("no map attached")).to_exception()
+    assert isinstance(bare, ShardMovedError) and bare.shard_map is None
+
+
+# ------------------------------------------------------------------ harness
+
+class Kv(ComputeService):
+    """Keyed service over a SHARED backing store (the common-database
+    deployment shape): any member can serve any key's current value, so
+    ownership is about subscriptions + invalidation, and the single-server
+    oracle is just the store itself."""
+
+    def __init__(self, hub, name, store):
+        super().__init__(hub)
+        self.name = name
+        self.store = store
+        self.calls = 0
+
+    @compute_method
+    async def get(self, key: str):
+        self.calls += 1
+        return [self.name, self.store.get(key, 0)]
+
+    async def put(self, key: str, value: int):
+        self.store[key] = value
+        with invalidating():
+            await self.get(key)
+
+
+class Cluster:
+    """N in-memory members + one routed client, fully meshed."""
+
+    def __init__(self, refs, n_shards=64, heartbeat=0.05, timeout=0.4):
+        self.refs = list(refs)
+        self.n_shards = n_shards
+        self.heartbeat = heartbeat
+        self.timeout = timeout
+        self.store = {}
+        self.hubs = {}
+        self.services = {}
+        self.fusions = {}
+        self.members = {}
+        self.mesh = {}
+        self.killed = set()
+        for ref in refs:
+            self._build_server(ref)
+        for ref in refs:
+            self._wire_server(ref, seeds=self.refs)
+        self.client_rpc = RpcHub("client")
+        install_compute_call_type(self.client_rpc)
+        self.transport = RpcMultiServerTestTransport(
+            self.client_rpc, dict(self.hubs), client_name="c0"
+        )
+        self.router = ShardMapRouter(self.client_rpc, members=self.refs, n_shards=n_shards)
+        self.client_rpc.call_router = self.router
+        install_cluster_client(self.client_rpc, self.router)
+        self.client_fusion = FusionHub()
+        self.rebalancer = ClusterRebalancer(self.client_rpc, self.router)
+        self.proxy = add_fusion_service(
+            RpcServiceMode.ROUTER, "kv", self.client_rpc, self.client_fusion
+        )
+        self.rebalancer.attach_proxy(self.proxy)
+
+    def _build_server(self, ref):
+        fusion = FusionHub()
+        rpc = RpcHub(ref)
+        install_compute_call_type(rpc)
+        svc = Kv(fusion, ref, self.store)
+        rpc.add_service("kv", svc)
+        self.hubs[ref] = rpc
+        self.services[ref] = svc
+        self.fusions[ref] = fusion
+
+    def _wire_server(self, ref, seeds):
+        others = {r: h for r, h in self.hubs.items() if r != ref}
+        self.mesh[ref] = RpcMultiServerTestTransport(self.hubs[ref], others, client_name=ref)
+        member = ClusterMember(
+            self.hubs[ref], ref, seeds=seeds, n_shards=self.n_shards,
+            heartbeat_interval=self.heartbeat, failure_timeout=self.timeout,
+        ).install()
+        install_cluster_guard(self.hubs[ref], member)
+        self.members[ref] = member
+
+    async def kill(self, ref):
+        """Real member death: unreachable from everyone, process gone."""
+        self.killed.add(ref)
+        for t in list(self.mesh.values()) + [self.transport]:
+            t.servers.pop(ref, None)
+        await self.members[ref].dispose()
+        await self.hubs[ref].stop()
+
+    async def join(self, ref, via=None):
+        self._build_server(ref)
+        for r, t in self.mesh.items():
+            if r != ref and r not in self.killed:
+                t.servers[ref] = self.hubs[ref]
+        self.transport.servers[ref] = self.hubs[ref]
+        seeds = [ref] + [via or min(r for r in self.refs if r not in self.killed)]
+        self._wire_server(ref, seeds=seeds)
+        self.refs.append(ref)
+        return self.members[ref]
+
+    def live_members(self):
+        return [r for r in self.refs if r not in self.killed]
+
+    async def wait_epoch(self, predicate, timeout=8.0, what="epoch condition"):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while not predicate():
+            assert asyncio.get_event_loop().time() < deadline, (
+                f"{what} not reached: client={self.router.snapshot()}, "
+                f"members={ {r: m.snapshot() for r, m in self.members.items() if r not in self.killed} }"
+            )
+            await asyncio.sleep(0.02)
+
+    async def stop(self):
+        for r, m in list(self.members.items()):
+            if r not in self.killed:
+                await m.dispose()
+        await self.client_rpc.stop()
+        for r, h in self.hubs.items():
+            if r not in self.killed:
+                await h.stop()
+
+
+# ------------------------------------------------------------------ membership
+
+async def test_bootstrap_kill_and_join_end_to_end():
+    c = Cluster(["m0", "m1", "m2"])
+    try:
+        # bootstrap: the coordinator promotes the seed view to epoch 1
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        assert c.members["m0"].is_coordinator
+        keys = [f"k{i}" for i in range(12)]
+        nodes = {}
+        for k in keys:
+            assert (await c.proxy.get(k))[1] == 0
+            nodes[k] = await capture(lambda k=k: c.proxy.get(k))
+        assert len(c.router.routed_calls) >= 2, c.router.routed_calls
+
+        # a write on the owner pushes $sys-c to the routed client
+        k0 = keys[0]
+        owner = c.router("kv", "get", (k0,))
+        await c.services[owner].put(k0, 42)
+        await asyncio.wait_for(nodes[k0].when_invalidated(), 5)
+        assert (await c.proxy.get(k0))[1] == 42
+
+        # ---- kill a non-coordinator: failure detection -> epoch 2,
+        # moved keys fenced, departed client evicted + peer retired
+        await c.kill("m2")
+        await c.wait_epoch(
+            lambda: "m2" not in c.router.shard_map.members, what="kill epoch at client"
+        )
+        assert c.router.shard_map.epoch >= 2
+        assert c.rebalancer.resharded_keys > 0
+        assert "m2" not in c.proxy._clients  # the _clients leak fix
+        assert "m2" not in c.client_rpc.peers  # peer retired outright
+        for k in keys:
+            v = await asyncio.wait_for(c.proxy.get(k), 5)
+            assert v[1] == c.store.get(k, 0), (k, v)
+            assert v[0] != "m2"
+
+        # ---- join m3: heartbeat announce -> epoch 3, traffic reaches it
+        epoch_before = c.router.shard_map.epoch
+        await c.join("m3")
+        await c.wait_epoch(
+            lambda: "m3" in c.router.shard_map.members, what="join epoch at client"
+        )
+        assert c.router.shard_map.epoch > epoch_before
+        for k in keys:
+            v = await asyncio.wait_for(c.proxy.get(k), 5)
+            assert v[1] == c.store.get(k, 0), (k, v)
+        assert c.router.routed_calls.get("m3", 0) > 0
+    finally:
+        await c.stop()
+
+
+async def test_stale_client_rejected_applies_map_and_retries_once():
+    """A client whose bootstrap map predates the cluster's (wrong member
+    set entirely) is corrected by ONE ShardMovedError round trip."""
+    c = Cluster(["m0", "m1"], n_shards=32)
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        # sabotage the client's view: it believes m0 owns EVERYTHING
+        c.router.shard_map = ShardMap.initial(["m0"], n_shards=32)
+        # find a key the real map assigns to m1
+        real = c.members["m0"].shard_map
+        key = next(
+            f"x{i}"
+            for i in range(1000)
+            if real.owner_of(c.router.key_for("kv", "get", (f"x{i}",))) == "m1"
+        )
+        # route stamps epoch 0 toward m0; m0's guard rejects with its map;
+        # the client applies it and the retry lands on m1 — transparently
+        v = await asyncio.wait_for(c.proxy.get(key), 5)
+        assert v[0] == "m1", v
+        assert c.router.moved_rejections_seen >= 1
+        assert c.router.shard_map.epoch == real.epoch
+        assert c.members["m0"].stale_rejections >= 1
+    finally:
+        await c.stop()
+
+
+async def test_read_failover_and_command_fail_fast():
+    c = Cluster(["m0", "m1"], n_shards=32)
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        smap = c.router.shard_map
+        key = "fk"
+        shard = c.router.shard_for("kv", "get", (key,))
+        owner, replica = smap.owners_for_shard(shard, 2)
+        # prime both links
+        assert (await c.proxy.get(key))[0] == owner
+        # the owner goes into dial backoff (down, but not yet failed out of
+        # the map): reads fail over to the replica within the same epoch
+        peer = c.client_rpc.client_peer(owner)
+        peer.reconnects_at = time.monotonic() + 30.0
+        ref, headers = c.router.route("kv", "get", (key,))
+        assert ref == replica
+        assert ("@failover", "1") in headers
+        v = await asyncio.wait_for(c.proxy.get(f"{key}-fresh-{shard}"), 5)
+        failover_served = c.router.failover_reads
+        assert failover_served >= 1
+        # commands NEVER fail over — split-brain protection fails fast
+        with pytest.raises(ShardMovedError):
+            c.router.route("$commander", "call", (_FakeCommand(key),))
+        peer.reconnects_at = None
+    finally:
+        await c.stop()
+
+
+class _FakeCommand:
+    def __init__(self, key):
+        self._key = key
+
+    def shard_key(self):
+        return self._key
+
+    def __repr__(self):
+        return f"_FakeCommand({self._key})"
+
+
+async def test_failover_read_expires_and_rehomes_on_owner_recovery():
+    """A failover-served computed must not outlive the outage. The
+    replica's ``$sys-c`` subscription cannot see the owner's writes, and an
+    owner that recovers WITHIN the failure timeout mints no epoch — so
+    nothing fences the cached value. It expires on ``router.failover_ttl``
+    instead, and the re-read routes back to the recovered owner."""
+    c = Cluster(["m0", "m1"], n_shards=32)
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        c.router.failover_ttl = 0.15
+        smap = c.router.shard_map
+        key = "fh"
+        shard = c.router.shard_for("kv", "get", (key,))
+        owner, replica = smap.owners_for_shard(shard, 2)
+        # prime the OWNER link with another key it owns (a fresh peer's
+        # dial worker would clear the backoff stamp we set below)
+        warm = next(
+            f"w{i}" for i in range(1000)
+            if smap.owner_of(c.router.key_for("kv", "get", (f"w{i}",))) == owner
+        )
+        assert (await c.proxy.get(warm))[0] == owner
+
+        # transient owner blip: dial backoff, shorter than failure_timeout
+        peer = c.client_rpc.client_peer(owner)
+        peer.reconnects_at = time.monotonic() + 30.0
+        v = await asyncio.wait_for(c.proxy.get(key), 5)
+        assert v[0] == replica  # served under @failover
+
+        # owner recovers (no epoch change, no reshard fence) and takes a
+        # write — the replica-bound subscription can never deliver it
+        peer.reconnects_at = None
+        await c.services[owner].put(key, 7)
+        deadline = asyncio.get_event_loop().time() + 5
+        while True:
+            v = await asyncio.wait_for(c.proxy.get(key), 5)
+            if v[0] == owner and v[1] == 7:
+                break  # TTL expired the failover node; read re-homed
+            assert asyncio.get_event_loop().time() < deadline, v
+            await asyncio.sleep(0.05)
+    finally:
+        await c.stop()
+
+
+async def test_breaker_open_is_failure_evidence():
+    """An open PeerCircuitBreaker fails the member over immediately —
+    BEFORE its heartbeat timeout elapses."""
+    c = Cluster(["m0", "m1", "m2"], heartbeat=0.05, timeout=30.0)  # timeout huge on purpose
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+
+        # the coordinator's OWN breaker to m2 reports open
+        class OpenBreaker:
+            state = BreakerState.OPEN
+
+            async def dispose(self):
+                pass
+
+        coord_hub = c.hubs["m0"]
+        peer = coord_hub.client_peer("m2")
+        peer.breaker = OpenBreaker()
+        await c.wait_epoch(
+            lambda: "m2" not in c.members["m0"].shard_map.members,
+            timeout=5.0,
+            what="breaker-evidence removal",
+        )
+        assert c.members["m0"].shard_map.epoch >= 2
+    finally:
+        await c.stop()
+
+
+async def test_coordinator_takeover_after_silence():
+    c = Cluster(["m0", "m1", "m2"], heartbeat=0.05, timeout=0.35)
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        await c.kill("m0")  # the coordinator itself
+        # m1 (lowest survivor) takes over; m2 learns the takeover epoch
+        await c.wait_epoch(
+            lambda: (
+                "m0" not in c.members["m1"].shard_map.members
+                and "m0" not in c.members["m2"].shard_map.members
+            ),
+            timeout=10.0,
+            what="takeover epoch on both survivors",
+        )
+        assert c.members["m1"].is_coordinator
+        assert c.members["m1"].takeovers == 1
+        assert c.members["m2"].shard_map.coordinator == "m1"
+    finally:
+        await c.stop()
+
+
+async def test_adopting_takeover_map_restarts_coordinator_clock():
+    """A bystander that ADOPTS a takeover map mid-timeout must grant the
+    new coordinator a fresh failure window — not keep the dead
+    coordinator's last-heard stamp, decide the successor is silent too,
+    and mint an epoch ejecting the live new coordinator."""
+    clock = [0.0]
+    rpc = RpcHub("m2")
+    member = ClusterMember(
+        rpc, "m2", seeds=["m0", "m1", "m2"], n_shards=16,
+        heartbeat_interval=0.05, failure_timeout=0.4, clock=lambda: clock[0],
+    )  # never .install()ed: ticks run manually, deterministically
+    try:
+        member._apply_map(ShardMap.initial(["m0", "m1", "m2"], n_shards=16, epoch=1))
+        assert member.coordinator == "m0"
+        clock[0] = 1.0  # m0 silent for far longer than failure_timeout
+
+        # m1's takeover broadcast reaches m2 BEFORE m2's own timeout tick
+        class _Peer:
+            ref = "m1"
+
+        takeover = ShardMap(epoch=2, members=("m1", "m2"), n_shards=16)
+        member._handle(_Peer(), member._frame("map", [takeover.to_wire()]))
+        assert member.coordinator == "m1"
+
+        # m2's post-timeout tick: m1 just announced itself — no hijack
+        await member._member_tick()
+        assert member.takeovers == 0
+        assert member.coordinator == "m1"
+        assert "m1" in member.shard_map.members
+        assert member.shard_map.epoch == 2  # nothing minted
+    finally:
+        await member.dispose()
+        await rpc.stop()
+
+
+async def test_takeover_cascades_past_a_dead_successor():
+    """Coordinator AND lowest survivor die together (one rack): the next
+    member must not court the dead successor forever — after a full
+    unanswered court window it treats the candidate as dead too and takes
+    over itself, so the cluster is never permanently headless."""
+    clock = [0.0]
+    rpc = RpcHub("m2")
+    member = ClusterMember(
+        rpc, "m2", seeds=["m0", "m1", "m2"], n_shards=16,
+        heartbeat_interval=0.05, failure_timeout=0.4, clock=lambda: clock[0],
+    )
+    sent = []
+
+    async def record(peer, method, args):
+        sent.append((getattr(peer, "ref", None), method, list(args)))
+        return True
+
+    member._try_send = record
+    try:
+        member._apply_map(ShardMap.initial(["m0", "m1", "m2"], n_shards=16, epoch=1))
+        clock[0] = 1.0  # m0 (coordinator) silent far past failure_timeout
+        await member._member_tick()  # not the successor: courts m1
+        assert member.takeovers == 0
+        assert ("m1", "heartbeat", ["m2", 1]) in sent
+        clock[0] = 1.2  # m1's court window still open
+        await member._member_tick()
+        assert member.takeovers == 0
+
+        # m1 answered NOTHING for a full failure window → m2 takes over,
+        # minting an epoch without EITHER dead member
+        clock[0] = 1.7
+        await member._member_tick()
+        assert member.takeovers == 1
+        assert member.is_coordinator
+        assert member.shard_map.members == ("m2",)
+        assert member.shard_map.epoch == 2
+    finally:
+        await member.dispose()
+        await rpc.stop()
+
+
+async def test_courted_successor_answer_resets_court_clock():
+    """A live successor that answers the courting (any ``$sys-m`` frame)
+    must never be cascaded past — its court-silence clock resets."""
+    clock = [0.0]
+    rpc = RpcHub("m2")
+    member = ClusterMember(
+        rpc, "m2", seeds=["m0", "m1", "m2"], n_shards=16,
+        heartbeat_interval=0.05, failure_timeout=0.4, clock=lambda: clock[0],
+    )
+
+    async def swallow(peer, method, args):
+        return True
+
+    member._try_send = swallow
+    try:
+        member._apply_map(ShardMap.initial(["m0", "m1", "m2"], n_shards=16, epoch=1))
+        clock[0] = 1.0
+        await member._member_tick()  # courts m1 (court clock starts at 1.0)
+
+        class _Peer:
+            ref = "m1"
+
+        clock[0] = 1.3  # m1 proves it lives (a gossiped map replay suffices)
+        member._handle(_Peer(), member._frame("map", [member.shard_map.to_wire()]))
+        clock[0] = 1.8  # past 1.0+0.4: WITHOUT the reset m1 would be ejected
+        await member._member_tick()
+        assert member.takeovers == 0  # still courting the live successor
+        assert "m1" in member.shard_map.members
+    finally:
+        await member.dispose()
+        await rpc.stop()
+
+
+async def test_suspicion_rearms_after_breaker_closes():
+    """The breaker-open suspect fast path dedups per INCIDENT: once our
+    breaker to a member closes again, its next failure must produce a new
+    ``suspect`` frame — not be swallowed by a forever-stale _suspected."""
+    rpc = RpcHub("m1")
+    member = ClusterMember(
+        rpc, "m1", seeds=["m0", "m1", "m2"], n_shards=16,
+        heartbeat_interval=0.05, failure_timeout=30.0,
+    )
+    sent = []
+
+    async def record(peer, method, args):
+        sent.append((method, list(args)))
+        return True
+
+    member._try_send = record
+
+    class _Breaker:
+        state = "open"
+
+    class _Peer:
+        breaker = _Breaker()
+
+    try:
+        rpc.peers["m2"] = _Peer()
+        await member._member_tick()
+        assert ("suspect", ["m2", "breaker open"]) in sent
+        sent.clear()
+        await member._member_tick()  # same incident: deduped
+        assert not any(m == "suspect" for m, _ in sent)
+
+        _Peer.breaker.state = "closed"
+        await member._member_tick()  # incident over: suspicion re-arms
+        _Peer.breaker.state = "open"
+        sent.clear()
+        await member._member_tick()  # second incident: fast path again
+        assert ("suspect", ["m2", "breaker open"]) in sent
+    finally:
+        rpc.peers.pop("m2", None)  # the stub has no peer lifecycle
+        await member.dispose()
+        await rpc.stop()
+
+
+# ------------------------------------------------------------------ fencing
+
+async def test_reshard_fences_moved_keys_and_explain_names_it():
+    c = Cluster(["m0", "m1", "m2"])
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        keys = [f"k{i}" for i in range(16)]
+        nodes = {k: None for k in keys}
+        for k in keys:
+            await c.proxy.get(k)
+            nodes[k] = await capture(lambda k=k: c.proxy.get(k))
+        old_map = c.router.shard_map
+        await c.kill("m2")
+        await c.wait_epoch(
+            lambda: "m2" not in c.router.shard_map.members, what="kill epoch at client"
+        )
+        new_map = c.router.shard_map
+        moved = set(ShardMap.diff(old_map, new_map))
+        cause = f"reshard:{new_map.epoch}"
+        fenced = unfenced = 0
+        for k in keys:
+            node = nodes[k]
+            shard = c.router.shard_for("kv", "get", (k,))
+            if shard in moved:
+                fenced += 1
+                assert node.is_invalidated, k
+                assert node.invalidation_cause == cause, (k, node.invalidation_cause)
+            else:
+                unfenced += 1
+                assert not node.is_invalidated, k  # untouched subscription stays live
+        assert fenced > 0 and unfenced > 0, (fenced, unfenced)
+
+        # explain() tells the reshard story end to end
+        from stl_fusion_tpu.diagnostics import explain
+
+        fenced_key = next(
+            k for k in keys if c.router.shard_for("kv", "get", (k,)) in moved
+        )
+        report = explain(nodes[fenced_key], hub=c.client_fusion)
+        assert report["invalidation"]["cause"] == cause, report
+        assert report["invalidation"]["reshard_epoch"] == new_map.epoch
+        chain = " | ".join(report["chain"])
+        assert f"invalidated by reshard to epoch {new_map.epoch}" in chain, chain
+        assert "owner m2 →" in chain, chain  # names the owner move
+    finally:
+        await c.stop()
+
+
+async def test_explain_reshard_over_sys_d_wire():
+    """The reshard cause family works end to end over $sys-d: the client's
+    local explain names the fence + owner move, and the NEW owner answers
+    an explain_remote for the same call shape over the wire."""
+    from stl_fusion_tpu.diagnostics import explain, explain_remote, install_explain
+
+    c = Cluster(["m0", "m1"])
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        install_explain(c.client_rpc, c.client_fusion)
+        for ref in ("m0", "m1"):
+            install_explain(c.hubs[ref], c.fusions[ref])
+        key = "wk"
+        await c.proxy.get(key)
+        node = await capture(lambda: c.proxy.get(key))
+        old_owner = c.router.shard_map.owner_of(c.router.key_for("kv", "get", (key,)))
+        # force a reshard that moves EVERYTHING off the old owner
+        survivor = "m1" if old_owner == "m0" else "m0"
+        c.router.apply_map(c.router.shard_map.with_members([survivor]))
+        assert node.is_invalidated
+        cause = node.invalidation_cause
+        assert cause is not None and cause.startswith("reshard:")
+        local = explain(node, hub=c.client_fusion)
+        local_chain = " | ".join(local["chain"])
+        assert "invalidated by reshard to epoch" in local_chain, local
+        assert f"owner {old_owner} →" in local_chain, local
+        # re-read: the fenced key re-subscribes on the survivor...
+        v = await asyncio.wait_for(c.proxy.get(key), 5)
+        assert v[0] == survivor
+        # ...and the new owner explains the key over the $sys-d wire path
+        remote = await asyncio.wait_for(
+            explain_remote(c.client_rpc.client_peer(survivor), "kv", "get", (key,)), 5
+        )
+        assert "error" not in remote, remote
+        assert remote["key"].endswith(f".get('{key}',)"), remote
+    finally:
+        await c.stop()
+
+
+async def test_evicted_client_regression_direct_map_change():
+    """The ISSUE-5 satellite regression in isolation: a map change that
+    drops a member evicts + retires its cached FusionClient even with NO
+    membership machinery running (a static pool edited by hand)."""
+    c = Cluster(["m0", "m1"])
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        keys = [f"e{i}" for i in range(8)]
+        for k in keys:
+            await c.proxy.get(k)
+        assert set(c.proxy._clients) == {"m0", "m1"}
+        # first contact synced the client off its epoch-0 bootstrap view
+        # (the guard rejects stale epochs outright; apply-and-retry is the
+        # sync) — so the locally-minted epoch below is newer than the
+        # servers' and the guard honors the newer stamp
+        assert c.router.shard_map.epoch >= 1
+        target_epoch = c.router.shard_map.epoch + 1
+        c.router.apply_map(c.router.shard_map.with_members(["m0"]))
+        assert "m1" not in c.proxy._clients, "departed peer's FusionClient must be evicted"
+        assert "m1" not in c.client_rpc.peers, "departed peer must be retired from the hub"
+        assert c.rebalancer.peers_retired == 1
+        # the epoch the client minted locally is NEWER than the servers' —
+        # the guard honors the newer stamp, so reads keep working on m0
+        for k in keys:
+            v = await asyncio.wait_for(c.proxy.get(k), 5)
+            assert v[0] == "m0", v
+        assert c.router.shard_map.epoch == target_epoch
+    finally:
+        await c.stop()
+
+
+async def test_reshard_does_not_fence_non_cluster_pinned_peers():
+    """Review fix: a pinned CLIENT-mode service sharing the routed hub is
+    not governed by the shard map — its keys hashing into a moved shard is
+    coincidence, not ownership, so epoch changes must leave its
+    subscriptions alone (pre-fix the rebalancer fenced them)."""
+    c = Cluster(["m0", "m1", "m2"])
+    standalone_rpc = RpcHub("standalone")
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        # routed reads first: the pinned service carries no epoch stamps,
+        # so these are what connect the client hub to the members — enough
+        # keys that it dials SURVIVORS too (a client connected only to the
+        # victim has nobody left to gossip it the post-kill map)
+        for i in range(12):
+            await c.proxy.get(f"warm{i}")
+        await c.wait_epoch(
+            lambda: c.router.shard_map.epoch >= 1
+            and {"m0", "m1"} <= set(c.client_rpc.peers),
+            what="client map sync + survivor links",
+        )
+        install_compute_call_type(standalone_rpc)
+        standalone_fusion = FusionHub()
+        standalone_rpc.add_service("pinned", Kv(standalone_fusion, "standalone", {}))
+        c.transport.servers["standalone"] = standalone_rpc
+        pinned = add_fusion_service(
+            RpcServiceMode.CLIENT, "pinned", c.client_rpc, c.client_fusion,
+            peer_ref="standalone",
+        )
+        # pick keys whose shards are OWNED by m2, so killing m2 is
+        # guaranteed to move every one of them (deterministic, no
+        # hash-luck flake on whether the moved set touches our keys)
+        keys, i = [], 0
+        while len(keys) < 4:
+            k = f"p{i}"
+            i += 1
+            shard = c.router.shard_for("pinned", "get", (k,))
+            if c.router.shard_map.owner_of_shard(shard) == "m2":
+                keys.append(k)
+        nodes = {}
+        for k in keys:
+            await pinned.get(k)
+            nodes[k] = await capture(lambda k=k: pinned.get(k))
+        await c.kill("m2")
+        await c.wait_epoch(
+            lambda: "m2" not in c.router.shard_map.members, what="kill epoch at client"
+        )
+        assert c.rebalancer.rebalances >= 1  # the fence pass DID run
+        for k in keys:
+            assert not nodes[k].is_invalidated, (
+                f"pinned key {k} fenced by a cluster epoch change it has "
+                f"nothing to do with"
+            )
+    finally:
+        await standalone_rpc.stop()
+        await c.stop()
+
+
+async def test_explain_reshard_matches_fencing_epoch_after_consecutive_moves():
+    """Review fix: explain() must decorate the chain with the owner move of
+    the epoch that FENCED the node, not whatever per-key "resharded" event
+    is newest — after consecutive reshards of the same shard those differ."""
+    from stl_fusion_tpu.diagnostics import explain
+
+    c = Cluster(["m0", "m1"])
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        key = "ck"
+        await c.proxy.get(key)
+        node1 = await capture(lambda: c.proxy.get(key))
+        old_owner = c.router.shard_map.owner_of(c.router.key_for("kv", "get", (key,)))
+        survivor = "m1" if old_owner == "m0" else "m0"
+        # reshard 1: everything moves to the survivor — node1 is fenced
+        c.router.apply_map(c.router.shard_map.with_members([survivor]))
+        assert node1.is_invalidated
+        cause1 = node1.invalidation_cause
+        epoch1 = int(cause1.partition(":")[2])
+        # re-read: the key re-subscribes on the survivor (a NEW call)...
+        await asyncio.wait_for(c.proxy.get(key), 5)
+        await capture(lambda: c.proxy.get(key))
+        # ...then reshard 2 moves the same key BACK, journaling a newer
+        # per-key "resharded" event under a later epoch's cause
+        c.router.apply_map(
+            c.router.shard_map.with_members([survivor, old_owner])
+        )
+        report = explain(node1, hub=c.client_fusion)
+        assert report["invalidation"]["cause"] == cause1, report
+        chain = " | ".join(report["chain"])
+        assert f"invalidated by reshard to epoch {epoch1}" in chain, chain
+        # epoch1's move was old_owner → survivor; pre-fix the chain showed
+        # epoch2's survivor → old_owner detail against epoch1's headline
+        assert f"owner {old_owner} → {survivor}" in chain, chain
+    finally:
+        await c.stop()
+
+
+# ------------------------------------------------------------------ THE acceptance scenario
+
+async def test_chaos_member_churn_kill_and_join_oracle_consistent():
+    """Acceptance (ISSUE 5): 3-member cluster under the seeded
+    ``member_churn`` ChaosPolicy (drop/dup/reorder on every link) survives
+    one member kill and one member join — reads fail over, every moved key
+    is fenced (zero oracle-divergent stale reads), breakers to surviving
+    members end closed with the routed path re-engaged, zero unhandled
+    exceptions."""
+    loop = asyncio.get_event_loop()
+    unhandled = []
+    loop.set_exception_handler(lambda l, ctx: unhandled.append(ctx))
+
+    c = Cluster(["m0", "m1", "m2"], heartbeat=0.05, timeout=0.5)
+    policy = SCENARIOS["member_churn"]()
+    assert policy.drop > 0 and policy.duplicate > 0 and policy.reorder_window >= 2
+    c.transport.set_chaos(policy)
+    breakers = {}
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        # keys chosen per-owner off the deterministic epoch-1 map: the kill
+        # below MUST move some subscribed keys (m2's) and leave others
+        boot_map = c.members["m0"].shard_map
+        keys = []
+        for ref in ("m0", "m1", "m2"):
+            found = [
+                f"k{i}" for i in range(200)
+                if boot_map.owner_of(c.router.key_for("kv", "get", (f"k{i}",))) == ref
+            ][:4]
+            assert len(found) == 4, (ref, found)
+            keys.extend(found)
+        nodes = {}
+        for k in keys:
+            await asyncio.wait_for(c.proxy.get(k), 10)
+            nodes[k] = await capture(lambda k=k: c.proxy.get(k))
+        for ref in ("m0", "m1"):
+            peer = c.client_rpc.client_peer(ref)
+            breakers[ref] = PeerCircuitBreaker(
+                peer, flap_threshold=50, flap_window=0.5, cooldown=0.2,
+                probe_stable=0.1,
+            ).install()
+
+        # traffic + churn: writes through the owners while chaos drops and
+        # reorders frames on the client links; re-reads keep the fenced
+        # keys' subscriptions live on their current owner
+        async def churn(rounds, base=0):
+            for i in range(rounds):
+                k = keys[i % len(keys)]
+                owner = c.router.shard_map.owner_of(c.router.key_for("kv", "get", (k,)))
+                svc = c.services.get(owner)
+                if svc is not None and owner not in c.killed:
+                    await svc.put(k, base + i + 1)
+                    await asyncio.wait_for(c.proxy.get(k), 10)
+                await asyncio.sleep(0.01)
+
+        await churn(30)
+        # fresh subscriptions on EVERY key right before the kill — the
+        # fence set must be non-empty by construction
+        for k in keys:
+            await asyncio.wait_for(c.proxy.get(k), 10)
+        kill_at = loop.time()
+        await c.kill("m2")
+        await c.wait_epoch(
+            lambda: "m2" not in c.router.shard_map.members,
+            timeout=10.0,
+            what="kill epoch at client under chaos",
+        )
+        reassigned_s = loop.time() - kill_at
+        await churn(30, base=100)
+        await c.join("m3")
+        await c.wait_epoch(
+            lambda: "m3" in c.router.shard_map.members,
+            timeout=10.0,
+            what="join epoch at client under chaos",
+        )
+        await churn(30, base=200)
+
+        # chaos off for new links; drop the chaotic ones so recovery is clean
+        c.transport.set_chaos(None)
+        for ref in c.live_members():
+            await c.transport.disconnect(ref)
+
+        # oracle: every key's client-observed value equals the single-server
+        # oracle (the shared store) — a missed fence would pin a stale value
+        # here forever
+        for k in keys:
+            want = c.store.get(k, 0)
+            deadline = loop.time() + 10.0
+            while True:
+                got = await asyncio.wait_for(c.proxy.get(k), 10)
+                if got[1] == want and got[0] != "m2":
+                    break
+                assert loop.time() < deadline, (
+                    f"stale read survived the reshard: {k}={got}, oracle={want}"
+                )
+                await asyncio.sleep(0.05)
+
+        # reads failed over / rerouted during the window, and the kill was
+        # reassigned within a small multiple of the failure timeout
+        assert reassigned_s < 5.0, reassigned_s
+        assert c.rebalancer.resharded_keys > 0
+        assert c.rebalancer.rebalances >= 2  # kill + join (± chaos-driven extras)
+
+        # breakers to SURVIVING members end closed; routed path re-engaged
+        for ref, breaker in breakers.items():
+            deadline = loop.time() + 10.0
+            while breaker.state != BreakerState.CLOSED:
+                assert loop.time() < deadline, breaker.snapshot()
+                await asyncio.sleep(0.05)
+        assert (await asyncio.wait_for(c.proxy.get(keys[0]), 10))[1] == c.store.get(keys[0], 0)
+
+        # m3 serves real traffic after the join
+        assert c.router.routed_calls.get("m3", 0) > 0
+
+        assert unhandled == [], unhandled
+    finally:
+        loop.set_exception_handler(None)
+        for breaker in breakers.values():
+            await breaker.dispose()
+        await c.stop()
+
+
+# ------------------------------------------------------------------ observability
+
+async def test_monitor_and_gateway_expose_cluster():
+    from stl_fusion_tpu.diagnostics import FusionMonitor
+    from stl_fusion_tpu.rpc.http_gateway import FusionHttpServer
+
+    c = Cluster(["m0", "m1"])
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        await c.proxy.get("obs-key")
+        monitor = FusionMonitor(c.client_fusion).attach_cluster(
+            c.router, c.rebalancer
+        )
+        try:
+            report = monitor.report()["cluster"]
+            assert report["members"] == ["m0", "m1"]
+            assert report["epoch"] >= 1
+            assert report["coordinator"] == "m0"
+            assert sum(report["routed_calls"].values()) >= 1
+            assert "resharded_keys" in report  # rebalancer snapshot merged in
+        finally:
+            monitor.dispose()
+
+        gateway = FusionHttpServer(c.hubs["m0"])
+        gateway.cluster = (c.members["m0"],)
+        await gateway.start()
+        try:
+
+            async def get(path):
+                reader, writer = await asyncio.open_connection(gateway.host, gateway.port)
+                writer.write(
+                    f"GET {path} HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n".encode()
+                )
+                await writer.drain()
+                raw = await reader.read()
+                writer.close()
+                head, _, body = raw.partition(b"\r\n\r\n")
+                return head.split(b"\r\n", 1)[0].decode(), body
+
+            import json
+
+            status, body = await get("/shards")
+            assert status.endswith("200 OK"), status
+            shards = json.loads(body)
+            assert shards["member_id"] == "m0" and shards["epoch"] >= 1
+            assert shards["is_coordinator"] is True
+
+            # per-peer labeled series make the exposition (and it parses)
+            status, body = await get("/metrics")
+            assert status.endswith("200 OK"), status
+            samples = {}
+            for line in body.decode().strip().splitlines():
+                if line and not line.startswith("#"):
+                    name, value = line.rsplit(" ", 1)
+                    samples[name] = float(value)
+            assert samples.get("fusion_shard_map_epoch", 0) >= 1
+            assert any(name.startswith('fusion_routed_calls_total{peer="') for name in samples)
+
+            # the route vanishes with observability off — same as /metrics
+            gateway.serve_observability = False
+            status, _ = await get("/shards")
+            assert status.endswith("404 Not Found"), status
+        finally:
+            await gateway.stop()
+    finally:
+        await c.stop()

@@ -76,3 +76,13 @@ def test_multi_server_rpc_sample():
     assert "server0: got ChatPost" in stdout
     assert "server1: got ChatPost" in stdout
     assert "multi-server OK" in stdout
+    # ISSUE 5 failover phase: commands to the dead shard fail fast — or, in
+    # the race the example explicitly tolerates, the probe lands on the NEW
+    # owner because the reshard epoch applied mid-flight — then the cluster
+    # reshards and observers converge on the surviving owner
+    assert (
+        "command to dead shard failed fast: ShardMovedError" in stdout
+        or "probe raced the reshard" in stdout
+    )
+    assert "resharded to epoch" in stdout
+    assert "failover OK" in stdout

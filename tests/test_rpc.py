@@ -597,3 +597,53 @@ async def test_outbound_middleware_rejecting_result_errors_client():
         assert transport.connect_count["default"] == 1
     finally:
         await _shutdown(client_hub, server_hub)
+
+
+def test_consistent_hash_router_stable_across_process_restarts():
+    """The router's routes must be a pure function of (pool, key) — sha1,
+    never the salted builtin hash(): a FRESH interpreter (different
+    PYTHONHASHSEED) must compute byte-identical routes (ISSUE 5 satellite;
+    a restart that remapped keys would orphan every subscription)."""
+    import os
+    import subprocess
+    import sys
+
+    pool = ["alpha", "beta", "gamma"]
+    keys = [f"key{i}" for i in range(32)]
+    router = consistent_hash_router(pool)
+    here = [router("svc", "m", (k,)) for k in keys]
+
+    script = (
+        "from stl_fusion_tpu.rpc import consistent_hash_router;"
+        f"r = consistent_hash_router({pool!r});"
+        f"print(','.join(r('svc','m',(k,)) for k in {keys!r}))"
+    )
+    env = dict(os.environ, PYTHONHASHSEED="12345", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip().split(",") == here
+
+
+def test_consistent_hash_router_minimal_movement_on_member_removal():
+    """The ShardMap-backed shim moves ≤ 2/N of keys when one member leaves
+    (rendezvous minimal movement) — the modulo router it replaced remapped
+    ~(N-1)/N. Removal moves EXACTLY the departed member's keys."""
+    pool = [f"srv{i}" for i in range(4)]
+    keys = [f"key{i}" for i in range(2000)]
+    full = consistent_hash_router(pool)
+    smaller = consistent_hash_router(pool[:-1])
+    removed = pool[-1]
+    moved = stayed = 0
+    for k in keys:
+        before = full("svc", "m", (k,))
+        after = smaller("svc", "m", (k,))
+        if before != after:
+            moved += 1
+            assert before == removed, (k, before, after)  # only its keys move
+        else:
+            stayed += 1
+    assert 0 < moved <= 2 * len(keys) // len(pool), (moved, stayed)

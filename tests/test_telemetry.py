@@ -128,6 +128,34 @@ class TestMetricsRegistry:
         # histogram buckets are cumulative and end at +Inf == count
         assert seen['fusion_lat_ms_bucket{le="+Inf"}'] == 1
 
+    def test_prometheus_labeled_collector_samples_share_one_type_line(self):
+        """Per-peer collector series (fusion_routed_calls_total{peer="m0"})
+        must render under ONE valid '# TYPE <base> gauge' line — a TYPE
+        line with a brace-suffixed name violates the exposition name
+        charset and makes Prometheus reject the ENTIRE scrape."""
+        r = MetricsRegistry()
+
+        class Owner:
+            pass
+
+        owner = Owner()
+        r.register_collector(
+            owner,
+            lambda o: {
+                "fusion_routed_calls_total": 7,
+                'fusion_routed_calls_total{peer="m0"}': 4,
+                'fusion_routed_calls_total{peer="m1"}': 3,
+            },
+        )
+        text = r.render_prometheus()
+        type_lines = [l for l in text.splitlines() if l.startswith("# TYPE")]
+        assert all("{" not in l for l in type_lines), type_lines
+        assert type_lines.count("# TYPE fusion_routed_calls_total gauge") == 1
+        assert 'fusion_routed_calls_total{peer="m0"} 4' in text
+        assert 'fusion_routed_calls_total{peer="m1"} 3' in text
+        # the un-labeled family total renders too, before its labeled series
+        assert "\nfusion_routed_calls_total 7" in "\n" + text
+
 
 # ---------------------------------------------------------------- profiler
 
