@@ -133,8 +133,11 @@ async def main():
     total = await carts1.total("apple", "apple", "banana")
     print(f"host1 total: {total} ({products1.db_reads} DB reads)")
     # local commits append synchronously, so the log's end IS this host's
-    # up-to-date position (the reader's own watermark only tracks replay)
-    HubCheckpoint.save(hub1, ckpt_path, oplog_position=log_store.last_index())
+    # up-to-date position (the reader's own watermark only tracks replay);
+    # passing the log lets the snapshot carry a trim-safety floor
+    HubCheckpoint.save(
+        hub1, ckpt_path, oplog_position=log_store.last_index(), log_store=log_store
+    )
     await reader1.stop()
     del hub1, products1, carts1
     print("host1 checkpointed and died")

@@ -20,7 +20,14 @@ SURVEY §5.4 maps both onto the TPU build as: **checkpoint = snapshot of
   invalidates exactly the entries that went stale while the host was down.
 - :class:`CheckpointManager` — numbered snapshots in a directory with
   ``latest()`` lookup, the orbax-style save/restore loop without the
-  training-framework dependency surface.
+  training-framework dependency surface. Since ISSUE 6 the manager is the
+  durability layer proper: snapshots are checksummed + fsynced (see
+  checkpoint/durable.py for the envelope), ``restore_latest`` falls back
+  PAST a corrupt/torn latest snapshot to the newest valid one (quarantine-
+  logging what it skipped), ``save_durable`` captures the epoch-consistent
+  ``(shard-map epoch, oplog watermark)`` state the cluster warm-rejoin
+  path (cluster/rejoin.py) restores, and ``snapshot_floor()`` feeds the
+  oplog trimmer's clamp so a replay tail is never trimmed away.
 """
 from __future__ import annotations
 
@@ -39,13 +46,26 @@ from ..core.inputs import ComputeMethodInput, KwArgsTail
 from ..graph.device_graph import DeviceGraph
 from ..utils.ltag import LTag
 from ..utils.result import Result
-from ..utils.serialization import dumps, encode, decode, loads
+from ..utils.serialization import encode, decode
+from .durable import (
+    CorruptSnapshotError,
+    DurableHubState,
+    atomic_write,
+    read_snapshot_file,
+    read_snapshot_header,
+    write_snapshot_file,
+)
 
 log = logging.getLogger("stl_fusion_tpu")
+
+# distinguishes "caller did not choose a floor" from an explicit None
+_FLOOR_UNSET = object()
 
 __all__ = [
     "save_graph",
     "load_graph",
+    "CorruptSnapshotError",
+    "DurableHubState",
     "HubCheckpoint",
     "RestoreResult",
     "CheckpointManager",
@@ -59,18 +79,25 @@ from ..utils.serialization import deep_tuple as _deep_tuple
 
 # ---------------------------------------------------------------- device graph
 def save_graph(graph: DeviceGraph, path: str) -> None:
-    """Snapshot a DeviceGraph's authoritative host arrays (live prefixes only)."""
-    np.savez_compressed(
-        path,
-        format=np.int32(_FORMAT_VERSION),
-        n_nodes=np.int64(graph.n_nodes),
-        n_edges=np.int64(graph.n_edges),
-        edge_src=graph._h_edge_src[: graph.n_edges],
-        edge_dst=graph._h_edge_dst[: graph.n_edges],
-        edge_dst_epoch=graph._h_edge_dst_epoch[: graph.n_edges],
-        node_epoch=graph._h_node_epoch[: graph.n_nodes],
-        invalid=graph._h_invalid[: graph.n_nodes],
-    )
+    """Snapshot a DeviceGraph's authoritative host arrays (live prefixes
+    only). Written through :func:`durable.atomic_write` so a crash
+    mid-save never leaves a truncated npz where the last good snapshot
+    stood."""
+
+    def _write(f):
+        np.savez_compressed(
+            f,
+            format=np.int32(_FORMAT_VERSION),
+            n_nodes=np.int64(graph.n_nodes),
+            n_edges=np.int64(graph.n_edges),
+            edge_src=graph._h_edge_src[: graph.n_edges],
+            edge_dst=graph._h_edge_dst[: graph.n_edges],
+            edge_dst_epoch=graph._h_edge_dst_epoch[: graph.n_edges],
+            node_epoch=graph._h_node_epoch[: graph.n_nodes],
+            invalid=graph._h_invalid[: graph.n_nodes],
+        )
+
+    atomic_write(path, _write)
 
 
 def load_graph(path: str) -> DeviceGraph:
@@ -128,21 +155,46 @@ class RestoreResult:
     tables: int = 0  # MemoTables restored warm (columnar twin state)
     oplog_position: int = 0
     saved_at: float = 0.0
+    # -- durable-state extras (ISSUE 6; zero/None for legacy snapshots) --
+    epoch: int = 0  # shard-map epoch the snapshot was taken under
+    snapshot_map: Optional[dict] = None  # wire-form ShardMap at snapshot time
+    commit_floor: Optional[float] = None  # oldest trim-safe commit time
+    subscriptions: int = 0  # live fan-out subscriptions at snapshot time
 
     @property
     def count(self) -> int:
         return len(self.computeds)
+
+    @property
+    def watermark(self) -> int:
+        """Alias for ``oplog_position`` in durable-state terms."""
+        return self.oplog_position
 
 
 class HubCheckpoint:
     """Snapshot/restore of a hub's warm computed state (SURVEY §5.4)."""
 
     @staticmethod
-    def snapshot(hub: FusionHub, oplog_position: int = 0) -> dict:
+    def snapshot(
+        hub: FusionHub,
+        oplog_position: int = 0,
+        *,
+        commit_floor: Any = _FLOOR_UNSET,
+        log_store: Any = None,
+    ) -> dict:
         """Capture every live CONSISTENT compute-method node whose arguments
         and value serialize. Error outputs and mid-compute nodes are skipped
         (they recompute cold — same rule as the reference's client cache,
-        which only persists successful results)."""
+        which only persists successful results).
+
+        ``commit_floor``/``log_store`` control the trim-safety floor stamped
+        in the snapshot header — see ``_capture_floor`` for the rules. The
+        default (neither given) stamps NO floor, which makes
+        ``snapshot_floor()`` clamp every trim while the snapshot is
+        retained: safe, observable (``snapshot_clamped_trims``), but the
+        log grows. Deployments that trim should pass ``log_store`` (or use
+        ``CheckpointManager.save_durable``, which derives the floor from
+        the reader)."""
         nodes: List[dict] = []
         index_of: Dict[Any, int] = {}
         live = hub.registry.live_computeds()
@@ -187,11 +239,41 @@ class HubCheckpoint:
             "format": _FORMAT_VERSION,
             "saved_at": time.time(),
             "oplog_position": int(oplog_position),
+            "oplog": {
+                "watermark": int(oplog_position),
+                "commit_floor": HubCheckpoint._capture_floor(
+                    int(oplog_position), commit_floor, log_store
+                ),
+            },
             "nodes": nodes,
             "edges": edges,
             "tables": HubCheckpoint._snapshot_tables(hub),
             "skipped": skipped,
         }
+
+    @staticmethod
+    def _capture_floor(watermark: int, commit_floor: Any, log_store: Any):
+        """The trim-safety floor for a snapshot at ``watermark`` — the
+        commit time of the OLDEST oplog entry its replay tail needs.
+
+        An explicit ``commit_floor`` wins (the caller read it off a
+        reader). With a ``log_store`` the floor is derived from the log
+        itself: the first record ABOVE the watermark, or the capture
+        instant when the tail is empty (entries appended later commit at
+        or after now). With neither, None — a caller-supplied watermark
+        may LAG the log head, and a floor of now would let the trimmer
+        delete the lagging tail replay still needs, so no floor is the
+        only safe answer (``snapshot_floor()`` turns it into a
+        clamp-every-trim)."""
+        if commit_floor is not _FLOOR_UNSET:
+            return commit_floor
+        if log_store is not None:
+            try:
+                tail = log_store.read_after(watermark, limit=1)
+            except Exception:  # noqa: BLE001 — corrupt head row: no floor
+                return None
+            return tail[0].commit_time if tail else time.time()
+        return None
 
     @staticmethod
     def _snapshot_tables(hub: FusionHub) -> List[dict]:
@@ -266,12 +348,26 @@ class HubCheckpoint:
         return restored
 
     @staticmethod
-    def save(hub: FusionHub, path: str, oplog_position: int = 0) -> dict:
-        snap = HubCheckpoint.snapshot(hub, oplog_position)
-        tmp = f"{path}.tmp{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(dumps(snap))
-        os.replace(tmp, path)
+    def save(
+        hub: FusionHub,
+        path: str,
+        oplog_position: int = 0,
+        *,
+        commit_floor: Any = _FLOOR_UNSET,
+        log_store: Any = None,
+    ) -> dict:
+        """Snapshot + persist atomically: temp file, fsync, rename, payload
+        checksum in the header (checkpoint/durable.py). A crash at ANY
+        point leaves either the previous snapshot or a temp file the
+        restore path never looks at — never a truncated ``path``.
+
+        Pass ``log_store`` (or an explicit ``commit_floor``) so the
+        snapshot carries a trim-safety floor; without one it clamps every
+        trim while retained (see ``HubCheckpoint.snapshot``)."""
+        snap = HubCheckpoint.snapshot(
+            hub, oplog_position, commit_floor=commit_floor, log_store=log_store
+        )
+        write_snapshot_file(path, snap)
         return snap
 
     @staticmethod
@@ -290,16 +386,26 @@ class HubCheckpoint:
 
         ``services`` maps snapshot service names to live instances; defaults
         to the hub's service container keyed by type name.
+
+        Raises :class:`CorruptSnapshotError` for a torn/garbled file —
+        ``CheckpointManager.restore_latest`` catches it and falls back to
+        the next-newest snapshot.
         """
-        with open(path, "rb") as f:
-            snap = loads(f.read())
+        snap = read_snapshot_file(path)
         if snap.get("format") != _FORMAT_VERSION:
-            raise ValueError(f"unsupported checkpoint format {snap.get('format')!r}")
+            raise CorruptSnapshotError(
+                f"unsupported checkpoint format {snap.get('format')!r}"
+            )
         if services is None:
             services = _services_by_name(hub)
+        cluster = DurableHubState.cluster_of(snap)
         result = RestoreResult(
-            oplog_position=int(snap.get("oplog_position", 0)),
+            oplog_position=DurableHubState.watermark_of(snap),
             saved_at=float(snap.get("saved_at", 0.0)),
+            epoch=int(cluster.get("epoch", 0) or 0),
+            snapshot_map=cluster.get("shard_map"),
+            commit_floor=(snap.get("oplog") or {}).get("commit_floor"),
+            subscriptions=len(snap.get("subscriptions", ())),
         )
         restored: List[Optional[Computed]] = []
         for entry in snap["nodes"]:
@@ -371,13 +477,32 @@ class HubCheckpoint:
 
 # ---------------------------------------------------------------- manager
 class CheckpointManager:
-    """Numbered hub snapshots in a directory: ``fusion-ckpt-{n}.bin``."""
+    """Numbered hub snapshots in a directory: ``fusion-ckpt-{n}.bin``.
+
+    The durability contract (ISSUE 6): saves are atomic + checksummed
+    (checkpoint/durable.py), ``restore_latest`` falls back past corrupt or
+    torn snapshots to the newest VALID one (quarantining what it skipped
+    as ``*.corrupt`` so the evidence survives for operators but never
+    blocks the next restore), and ``snapshot_floor()`` hands the oplog
+    trimmer the oldest commit time any retained snapshot's replay tail
+    still needs — trimming past it would strand a warm rejoin."""
 
     _PATTERN = re.compile(r"fusion-ckpt-(\d+)\.bin$")
 
-    def __init__(self, directory: str, keep: int = 3):
+    def __init__(self, directory: str, keep: int = 3, events=None):
         self.directory = directory
         self.keep = keep
+        if events is None:
+            from ..resilience.events import global_events
+
+            events = global_events()
+        self.events = events
+        self.saves = 0
+        self.corrupt_skipped = 0
+        # headerless (legacy v1) files need a FULL read to tell restorable
+        # from garbage; the trimmer calls snapshot_floor() every GC cycle,
+        # so the verdict is cached per (path, mtime, size)
+        self._legacy_probe: Dict[str, Tuple[float, int, bool]] = {}
         os.makedirs(directory, exist_ok=True)
 
     def _steps(self) -> List[int]:
@@ -395,20 +520,156 @@ class CheckpointManager:
         steps = self._steps()
         return steps[-1] if steps else None
 
-    def save(self, hub: FusionHub, oplog_position: int = 0) -> int:
-        step = (self.latest_step() or 0) + 1
-        HubCheckpoint.save(hub, self.path_of(step), oplog_position)
+    def _rotate(self) -> None:
         for old in self._steps()[: -self.keep]:
             try:
                 os.remove(self.path_of(old))
             except OSError:
                 pass
+
+    def save(
+        self,
+        hub: FusionHub,
+        oplog_position: int = 0,
+        *,
+        commit_floor: Any = _FLOOR_UNSET,
+        log_store: Any = None,
+    ) -> int:
+        step = (self.latest_step() or 0) + 1
+        HubCheckpoint.save(
+            hub,
+            self.path_of(step),
+            oplog_position,
+            commit_floor=commit_floor,
+            log_store=log_store,
+        )
+        self.saves += 1
+        self._rotate()
         return step
+
+    def save_durable(
+        self,
+        hub: FusionHub,
+        *,
+        reader=None,
+        log_store=None,
+        member=None,
+        router=None,
+        rpc_hub=None,
+    ) -> int:
+        """Save the epoch-consistent durable snapshot: the hub body keyed
+        to ``(shard-map epoch, oplog watermark)`` plus live fan-out
+        subscriptions — what :func:`~stl_fusion_tpu.cluster.rejoin.
+        warm_rejoin` restores. Any cluster/oplog handle may be None (a
+        standalone hub snapshots with epoch 0)."""
+        snap = DurableHubState.snapshot(
+            hub,
+            reader=reader,
+            log_store=log_store,
+            member=member,
+            router=router,
+            rpc_hub=rpc_hub,
+        )
+        step = (self.latest_step() or 0) + 1
+        write_snapshot_file(self.path_of(step), snap)
+        self.saves += 1
+        self._rotate()
+        return step
+
+    def _quarantine(self, step: int, error: Exception) -> None:
+        """Skip-and-log a snapshot restore_latest could not trust: ledger
+        event + rename to ``*.corrupt`` (kept on disk as evidence, invisible
+        to ``_steps`` so it never blocks the fallback again)."""
+        self.corrupt_skipped += 1
+        path = self.path_of(step)
+        log.warning("checkpoint: snapshot %s unreadable (%s); falling back",
+                    path, error)
+        self.events.record("snapshot_corrupt", f"{os.path.basename(path)}: {error}")
+        try:
+            os.replace(path, f"{path}.corrupt")
+        except OSError:
+            pass
 
     def restore_latest(
         self, hub: FusionHub, services: Optional[Dict[str, Any]] = None
     ) -> Optional[RestoreResult]:
-        step = self.latest_step()
-        if step is None:
-            return None
-        return HubCheckpoint.restore(hub, self.path_of(step), services)
+        """Restore from the newest VALID snapshot: corrupt/torn files are
+        quarantined and the walk falls back to the next-newest. Returns
+        None when no restorable snapshot exists (cold boot)."""
+        for step in reversed(self._steps()):
+            try:
+                return HubCheckpoint.restore(hub, self.path_of(step), services)
+            except CorruptSnapshotError as e:
+                self._quarantine(step, e)
+            except FileNotFoundError:
+                continue  # rotated away between _steps() and open
+            except OSError as e:
+                # transient I/O error (EIO under load, NFS hiccup) on a
+                # possibly-VALID snapshot: fall back for this restore but
+                # leave the file in place — quarantining would permanently
+                # demote a good snapshot over a one-off read failure
+                log.warning("checkpoint: snapshot %s unreadable (%s); "
+                            "skipping without quarantine", self.path_of(step), e)
+                self.events.record(
+                    "snapshot_skipped",
+                    f"{os.path.basename(self.path_of(step))}: {e}",
+                )
+        return None
+
+    def snapshot_floor(self) -> Optional[float]:
+        """Oldest oplog commit time a retained snapshot still needs for
+        its replay tail — the trimmer's snapshot clamp (min over retained
+        READABLE headers: a snapshot the restore walk would quarantine
+        contributes nothing, so a corrupt file never pins GC forever).
+        None when no durable snapshot exists."""
+        floors = []
+        for step in self._steps():
+            path = self.path_of(step)
+            header = read_snapshot_header(path)
+            if header is None:
+                # no v2 header: either garbage (restore would quarantine
+                # it — contributes nothing) or a RESTORABLE legacy v1 file,
+                # which restore_latest happily loads; only the full read
+                # can tell them apart, and a restorable snapshot with no
+                # floor must clamp ALL trims or the trimmer eats the tail
+                # its warm rejoin needs. The full read is cached per
+                # (mtime, size): the trimmer polls this every GC cycle and
+                # legacy payloads can be large.
+                if self._probe_legacy(path):
+                    return 0.0
+                continue
+            floor = header.get("commit_floor")
+            if floor is None:
+                # v2 but FLOOR-LESS: a plain save() with no log_store/
+                # commit_floor (the snapshot's watermark may lag the head,
+                # so no floor is derivable). Replay needs are unbounded
+                # below — no trim is safe while it is retained. None would
+                # instead mean "no clamp" and lose the tail; deployments
+                # that trim should snapshot via save_durable or pass
+                # log_store= (see HubCheckpoint.snapshot).
+                return 0.0
+            floors.append(floor)
+        return min(floors) if floors else None
+
+    def _probe_legacy(self, path: str) -> bool:
+        """Whether a headerless snapshot file is RESTORABLE legacy v1 (it
+        must clamp trims) as opposed to garbage (it must not pin the log).
+        One full read per (mtime, size); a transient OSError is NOT cached
+        — it says nothing about the file."""
+        try:
+            st = os.stat(path)
+        except OSError:
+            return False
+        key = (st.st_mtime, st.st_size)
+        cached = self._legacy_probe.get(path)
+        if cached is not None and (cached[0], cached[1]) == key:
+            return cached[2]
+        try:
+            read_snapshot_file(path)
+            verdict = True
+        except CorruptSnapshotError:
+            verdict = False
+        except OSError:
+            return False
+        self._legacy_probe[path] = (key[0], key[1], verdict)
+        return verdict

@@ -21,9 +21,15 @@ metrics registry. CLUSTER.md is the runbook.
 - :mod:`.rebalancer` — ``ClusterRebalancer``: fences moved keys with a
   ``reshard:<epoch>`` cause and retires departed peers (clients, breakers,
   peer workers).
+- :mod:`.rejoin` — ``warm_rejoin`` (ISSUE 6): restart-from-snapshot —
+  restore the newest valid durable checkpoint, replay only the oplog tail
+  above its watermark, re-announce to membership, and fence exactly the
+  keys whose shard assignment changed between the snapshot epoch and the
+  current epoch. DURABILITY.md is the runbook.
 """
 from .membership import ClusterMember
 from .rebalancer import ClusterRebalancer
+from .rejoin import RejoinReport, fence_moved_keys, verify_restore, warm_rejoin
 from .router import (
     EPOCH_HEADER,
     FAILOVER_HEADER,
@@ -40,10 +46,14 @@ __all__ = [
     "DEFAULT_SHARDS",
     "EPOCH_HEADER",
     "FAILOVER_HEADER",
+    "RejoinReport",
     "SHARD_HEADER",
     "ShardMap",
     "ShardMapRouter",
     "ShardMovedError",
+    "fence_moved_keys",
     "install_cluster_client",
     "install_cluster_guard",
+    "verify_restore",
+    "warm_rejoin",
 ]

@@ -102,6 +102,10 @@ class ClusterMember(WorkerBase):
         #: candidate that never answers for a full failure window is
         #: treated as dead too (double-failure takeover, _member_tick)
         self._court_started: Dict[str, float] = {}
+        #: when the would-be bootstrap coordinator FIRST probed its seeds
+        #: for an existing cluster (ISSUE 6): a RESTARTING lowest-id member
+        #: must rejoin the live epoch, not hijack it with a parallel one
+        self._bootstrap_sync_started: Optional[float] = None
         global_metrics().register_collector(self, ClusterMember._collect_metrics)
         global_metrics().set_aggregation("fusion_shard_map_epoch", "max")
         # member count is a non-additive gauge: N co-hosted members must
@@ -213,7 +217,17 @@ class ClusterMember(WorkerBase):
         self.heartbeats_seen += 1
         self._last_heard[member_id] = self._clock()
         self._suspected.discard(member_id)
-        if self.is_coordinator and member_id not in self.shard_map.members:
+        # epoch 0 = unresolved bootstrap: a RESTARTED lowest-id member also
+        # believes it coordinates here, and minting a join epoch off the
+        # seed view would spawn a parallel epoch-1 lineage next to the live
+        # cluster — the same split-brain the coordinator-tick sync probe
+        # guards against. Joins wait until the probe resolves (adopting the
+        # live map, or minting the genuine bootstrap epoch).
+        if (
+            self.is_coordinator
+            and self.shard_map.epoch > 0
+            and member_id not in self.shard_map.members
+        ):
             self.joins_seen += 1
             self.events.record("cluster_join", member_id)
             self._mint(
@@ -309,8 +323,28 @@ class ClusterMember(WorkerBase):
         now = self._clock()
         self._last_heard[self.member_id] = now
         if self.shard_map.epoch == 0:
-            # first tick of a fresh cluster: promote the seed view to a
-            # real epoch so joiners' bootstrap maps are strictly older
+            # Before promoting the seed view to epoch 1, probe the seeds
+            # for a cluster that already exists: a RESTARTED lowest-id
+            # member also lands here believing it coordinates, and minting
+            # immediately would split-brain a live cluster that moved on
+            # without it. Any seed holding a real epoch answers the sync
+            # with its map; we adopt it and REJOIN through the normal
+            # heartbeat path (the once-again-lowest id gets the
+            # coordinator role handed back with the join epoch). The probe
+            # window is a few heartbeats — enough for several sync retries
+            # against a lossy link, NOT scaled to failure_timeout (a long
+            # failure window must not stall a genuine fresh bootstrap).
+            others = [m for m in self.shard_map.members if m != self.member_id]
+            window = min(self.failure_timeout, 3 * self.heartbeat_interval + 0.25)
+            if others:
+                if self._bootstrap_sync_started is None:
+                    self._bootstrap_sync_started = now
+                if now < self._bootstrap_sync_started + window:
+                    for m in others:
+                        await self._try_send(
+                            self.rpc_hub.client_peer(m), "sync", [0]
+                        )
+                    return
             self._mint(self.shard_map.members, "bootstrap")
             return
         dead = set()

@@ -1,0 +1,324 @@
+"""Warm rejoin — restart-from-snapshot for a cluster member (ISSUE 6).
+
+A member restart used to mean a cold boot: empty graph, 45-60 s of mirror
+rebuild + program warm-up, and every previously-served key recomputed from
+scratch. This module is the warm path:
+
+1. **restore** the newest valid durable snapshot
+   (:meth:`~stl_fusion_tpu.checkpoint.CheckpointManager.restore_latest` —
+   which already falls back past corrupt/torn files), re-registering every
+   warm computed + MemoTable at its original version;
+2. **replay** ONLY the oplog tail above the snapshot's watermark through
+   the quarantine-aware :class:`~stl_fusion_tpu.oplog.OperationLogReader`
+   — the replay runs under ``oplog:replay`` spans, so every invalidation
+   it cascades carries a cause ``explain()`` resolves to the rehydration;
+3. **re-announce** to membership (a plain :class:`ClusterMember` install —
+   the first heartbeat is the join);
+4. **fence** exactly the keys whose shard assignment changed between the
+   snapshot's epoch and the cluster's current epoch
+   (``ShardMap.diff(snapshot_map, current_map)``): a key that is STILL
+   assigned elsewhere when this member returns must not serve its warm
+   value, so it is invalidated (under a ``restore:fence`` span) rather
+   than trusted. Keys whose assignment is unchanged — including keys that
+   round-tripped through a survivor while this member was down — keep
+   their warm values: every mutation in this system rides the oplog, so
+   the step-2 tail replay already invalidated anything written elsewhere
+   in the interim. The fence is an ownership guard, not a substitute for
+   replay.
+
+Everything is observable: ``fusion_restore_*`` metrics, a flight-recorder
+``restored`` event, and :func:`verify_restore` runs one
+:class:`~stl_fusion_tpu.diagnostics.auditor.ConsistencyAuditor` sweep over
+the restored state (the acceptance gate: zero invariant violations).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..diagnostics.flight_recorder import RECORDER
+from ..diagnostics.metrics import global_metrics
+from ..diagnostics.tracing import get_activity_source
+from ..oplog.reader import OperationLogReader, attach_operation_log
+from .membership import ClusterMember
+from .shard_map import DEFAULT_SHARDS, ShardMap
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["RejoinReport", "fence_moved_keys", "verify_restore", "warm_rejoin"]
+
+
+@dataclass
+class RejoinReport:
+    """What the rejoin did — mutable because the epoch-diff fence runs
+    when the rejoined member LEARNS the current map (one heartbeat later),
+    not inside :func:`warm_rejoin` itself; ``fence_applied`` is set then."""
+
+    warm: bool = False
+    restored_nodes: int = 0
+    restored_tables: int = 0
+    restored_edges: int = 0
+    subscriptions_lost: int = 0  # live fan-out links at snapshot time (died with the process)
+    snapshot_epoch: int = 0
+    snapshot_watermark: int = 0
+    oplog_last_index: int = 0
+    replayed_entries: int = 0  # tail records scanned = watermark advance
+    replayed_external: int = 0  # external operations replayed as invalidations
+    current_epoch: int = 0  # set when the fence runs
+    fenced_keys: int = 0
+    restore_s: float = 0.0  # snapshot restore + tail replay, before announce
+    fence_applied: "asyncio.Event" = field(default_factory=asyncio.Event)
+
+    def snapshot(self) -> dict:
+        return {
+            "warm": self.warm,
+            "restored_nodes": self.restored_nodes,
+            "restored_tables": self.restored_tables,
+            "restored_edges": self.restored_edges,
+            "subscriptions_lost": self.subscriptions_lost,
+            "snapshot_epoch": self.snapshot_epoch,
+            "snapshot_watermark": self.snapshot_watermark,
+            "oplog_last_index": self.oplog_last_index,
+            "replayed_entries": self.replayed_entries,
+            "replayed_external": self.replayed_external,
+            "current_epoch": self.current_epoch,
+            "fenced_keys": self.fenced_keys,
+            "restore_s": round(self.restore_s, 4),
+        }
+
+
+def _routing_key(computed, key_arg: int, key_fn) -> Optional[str]:
+    """The same key → shard convention ``ShardMapRouter.key_for`` uses,
+    derived from a SERVER-side computed's input (None: not shard-governed,
+    e.g. an anonymous computed)."""
+    inp = getattr(computed, "input", None)
+    args = getattr(inp, "args", None)
+    if args is None:
+        return None
+    if key_fn is not None:
+        return key_fn(computed)
+    if len(args) > key_arg:
+        return repr(args[key_arg])
+    return None
+
+
+def fence_moved_keys(
+    computeds: Sequence,
+    old_map: ShardMap,
+    new_map: ShardMap,
+    *,
+    key_arg: int = 0,
+    key_fn: Optional[Callable] = None,
+) -> int:
+    """Invalidate every restored computed whose key's shard owner changed
+    between ``old_map`` (snapshot epoch) and ``new_map`` (current epoch).
+    Runs under a ``restore:fence`` span so the cascades carry a cause
+    ``explain()`` names. Returns the number fenced."""
+    moved = frozenset(ShardMap.diff(old_map, new_map))
+    if not moved:
+        return 0
+    fenced = 0
+    with get_activity_source("restore").span(
+        "fence", old_epoch=old_map.epoch, new_epoch=new_map.epoch, moved=len(moved)
+    ):
+        for c in computeds:
+            key = _routing_key(c, key_arg, key_fn)
+            if key is None:
+                continue
+            if new_map.shard_of(key) in moved and c.invalidate(immediately=True):
+                fenced += 1
+    return fenced
+
+
+async def verify_restore(hub, backend=None, sample: float = 1.0) -> dict:
+    """One full :class:`ConsistencyAuditor` sweep over the restored state
+    (structural invariants + mirror cross-check + canary probe). Returns
+    the audit report; the acceptance gate is ``violations == []``."""
+    from ..diagnostics.auditor import ConsistencyAuditor
+
+    auditor = ConsistencyAuditor(hub, backend=backend, sample=sample)
+    try:
+        return await auditor.audit_once()
+    finally:
+        auditor.dispose()
+
+
+async def warm_rejoin(
+    hub,
+    rpc_hub,
+    manager,
+    log_store,
+    *,
+    member_id: str,
+    seeds: Sequence[str],
+    notifier=None,
+    n_shards: int = DEFAULT_SHARDS,
+    heartbeat_interval: float = 0.5,
+    failure_timeout: float = 2.0,
+    services=None,
+    key_arg: int = 0,
+    key_fn: Optional[Callable] = None,
+    mesh=None,
+    announce: bool = True,
+    start_reader: bool = True,
+) -> Tuple[Optional[ClusterMember], OperationLogReader, RejoinReport]:
+    """Bring a restarted member back WARM: restore → replay tail →
+    re-announce → epoch-diff fence. Returns ``(member, reader, report)``;
+    ``member`` is None when ``announce=False`` (standalone warm boot).
+
+    With no restorable snapshot this degrades to the cold path (reader
+    tails from the end, nothing fenced) and ``report.warm`` is False —
+    callers never need a separate cold branch.
+    """
+    t0 = time.perf_counter()
+    metrics = global_metrics()
+    result = manager.restore_latest(hub, services)
+    report = RejoinReport(warm=result is not None)
+    snapshot_map: Optional[ShardMap] = None
+    if result is not None:
+        report.restored_nodes = result.count
+        report.restored_tables = result.tables
+        report.restored_edges = result.edges
+        report.subscriptions_lost = result.subscriptions
+        report.snapshot_epoch = result.epoch
+        report.snapshot_watermark = result.oplog_position
+        if result.snapshot_map:
+            try:
+                snapshot_map = ShardMap.from_wire(result.snapshot_map)
+            except (KeyError, ValueError, TypeError):
+                snapshot_map = None
+    # the reader resumes from the snapshot watermark (or tails from the
+    # end on a cold boot — nothing warm exists that replay could fix)
+    reader = attach_operation_log(
+        hub.commander,
+        log_store,
+        notifier,
+        start_reader=False,
+        start_position=report.snapshot_watermark if result is not None else None,
+        mesh=mesh,
+    )
+    if result is not None:
+        # drain the tail SYNCHRONOUSLY before serving/announcing: the
+        # member must not answer a read between "warm but stale" and
+        # "replayed" — that window is exactly the stale-read bug class
+        # this subsystem exists to remove
+        report.replayed_external = await reader.read_new()
+        report.replayed_entries = reader.watermark - report.snapshot_watermark
+    report.oplog_last_index = log_store.last_index()
+    report.restore_s = time.perf_counter() - t0
+    if start_reader:
+        reader.start()
+
+    member: Optional[ClusterMember] = None
+    if announce:
+        member = ClusterMember(
+            rpc_hub,
+            member_id,
+            seeds=seeds,
+            n_shards=n_shards,
+            heartbeat_interval=heartbeat_interval,
+            failure_timeout=failure_timeout,
+        ).install()
+
+    # ------------------------------------------------------------ fence
+    restored_refs: List = list(result.computeds) if result is not None else []
+
+    def _fence(current: ShardMap) -> None:
+        report.current_epoch = current.epoch
+        if snapshot_map is not None and restored_refs:
+            report.fenced_keys = fence_moved_keys(
+                restored_refs, snapshot_map, current, key_arg=key_arg, key_fn=key_fn
+            )
+            if report.fenced_keys:
+                metrics.counter(
+                    "fusion_restore_fenced_keys_total",
+                    help="restored keys invalidated by the rejoin epoch-diff fence",
+                ).inc(report.fenced_keys)
+        restored_refs.clear()  # drop the strong refs; live anchors own them now
+        report.fence_applied.set()
+
+    if member is not None and snapshot_map is not None:
+
+        def _on_map(old: ShardMap, new: ShardMap) -> None:
+            # fence against the JOIN epoch — the first at/above-snapshot map
+            # that CONTAINS this member. Earlier maps (minted while we were
+            # down) show every one of our shards as "moved away", and
+            # fencing against one would invalidate the entire warm state the
+            # restore just rebuilt; until we are in the map the guard
+            # rejects routed traffic anyway, so waiting is safe. The
+            # absent->present transition is the join itself regardless of
+            # epoch: after a FULL-cluster restart the surviving members
+            # re-mint epochs from 1, so a snapshot taken at epoch N may
+            # never see new.epoch >= N again — without this clause the
+            # fence would never fire and fence_applied awaiters would hang.
+            # old.epoch == 0 is the member's own pre-join seed view (which
+            # always lists itself, membership.py bootstrap): the first REAL
+            # map applied over it that contains us is our join map too
+            joined_now = member_id in new.members and (
+                member_id not in old.members or old.epoch == 0
+            )
+            if (
+                (new.epoch >= report.snapshot_epoch or joined_now)
+                and member_id in new.members
+                and not report.fence_applied.is_set()
+            ):
+                try:
+                    member.on_map_change.remove(_on_map)
+                except ValueError:
+                    pass
+                _fence(new)
+
+        member.on_map_change.append(_on_map)
+        if member.shard_map.epoch >= report.snapshot_epoch:
+            _on_map(member.shard_map, member.shard_map)
+    else:
+        # no membership (standalone) or no epoch info in the snapshot:
+        # there is nothing to diff against — the fence is a no-op, but the
+        # event still fires so callers can await it unconditionally
+        _fence(member.shard_map if member is not None else snapshot_map or ShardMap.initial([member_id], n_shards=n_shards))
+
+    # ------------------------------------------------------------ telemetry
+    metrics.counter(
+        "fusion_restores_total", help="warm/cold rejoin restores attempted"
+    ).inc()
+    metrics.gauge(
+        "fusion_restore_replayed_entries",
+        help="oplog tail records replayed by the last restore (last_index - snapshot watermark)",
+    ).set(report.replayed_entries)
+    metrics.gauge(
+        "fusion_restore_nodes", help="computeds restored warm by the last restore"
+    ).set(report.restored_nodes)
+    metrics.gauge(
+        "fusion_restore_tables", help="MemoTables restored warm by the last restore"
+    ).set(report.restored_tables)
+    metrics.gauge(
+        "fusion_restore_s", help="snapshot restore + tail replay wall time (s)"
+    ).set(report.restore_s)
+    if RECORDER.enabled:
+        RECORDER.note(
+            "restored",
+            key=None,
+            count=report.restored_nodes,
+            oplog=reader.watermark,
+            detail=(
+                f"{'warm' if report.warm else 'cold'} rejoin of {member_id}: "
+                f"{report.restored_nodes} node(s), {report.restored_tables} "
+                f"table(s), replayed {report.replayed_entries} oplog entr(ies) "
+                f"above watermark {report.snapshot_watermark} in "
+                f"{report.restore_s:.3f}s"
+            ),
+        )
+    log.info(
+        "cluster %s: %s rejoin restored %d nodes / %d tables, replayed %d "
+        "oplog entries in %.3fs",
+        member_id,
+        "warm" if report.warm else "cold",
+        report.restored_nodes,
+        report.restored_tables,
+        report.replayed_entries,
+        report.restore_s,
+    )
+    return member, reader, report

@@ -1,5 +1,12 @@
 """Host↔device graph backend: DeviceGraph container + live hub mirror."""
 from .backend import RowBlock, TpuGraphBackend
 from .device_graph import DeviceGraph
+from .program_cache import enable_program_cache, program_cache_stats
 
-__all__ = ["TpuGraphBackend", "RowBlock", "DeviceGraph"]
+__all__ = [
+    "TpuGraphBackend",
+    "RowBlock",
+    "DeviceGraph",
+    "enable_program_cache",
+    "program_cache_stats",
+]

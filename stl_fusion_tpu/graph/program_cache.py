@@ -1,0 +1,111 @@
+"""Persistent program cache — compiled lane/burst programs survive restarts.
+
+The cold-start budget's biggest line items are compiles, not data:
+BENCH_r05 recorded lane_program_warm 60.2 s and compile_s swinging 5-100 s
+run to run. XLA already ships a persistent compilation cache; this module
+is the ONE place the project configures it (bench.py, perf/live_path.py
+and any serving process call :func:`enable_program_cache` instead of
+hand-rolling ``jax.config`` calls), plus the restart-warmth telemetry:
+``stats()`` counts cached executables so the warm-rejoin path
+(cluster/rejoin.py, DURABILITY.md) can report whether a restart actually
+pre-warmed from disk or recompiled cold.
+
+The same call also anchors ``FUSION_MIRROR_CACHE`` (the topo-mirror disk
+cache, device_graph.py) next to the program cache by default, so "warm
+workspace" means ONE directory pair an operator can ship to a new box.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["enable_program_cache", "program_cache_stats"]
+
+#: env override for the cache root (matches FUSION_MIRROR_CACHE's shape)
+CACHE_ENV = "FUSION_PROGRAM_CACHE"
+
+
+def _default_root() -> str:
+    return os.environ.get(
+        CACHE_ENV,
+        os.path.join(os.path.expanduser("~"), ".cache", "stl_fusion_tpu"),
+    )
+
+
+def enable_program_cache(
+    root: Optional[str] = None,
+    *,
+    jax_dir: Optional[str] = None,
+    mirror_dir: Optional[str] = None,
+    min_compile_seconds: float = 1.0,
+    mirror_cache: bool = True,
+) -> dict:
+    """Point XLA's persistent compilation cache at ``<root>/jax`` (and,
+    by default, the topo-mirror disk cache at ``<root>/mirror`` unless
+    FUSION_MIRROR_CACHE is already set). ``jax_dir``/``mirror_dir``
+    override the exact directories (bench.py keeps its historic
+    repo-local ``.jax_cache``/``.fusion_mirror_cache`` so warm workspaces
+    stay warm). Idempotent; returns an info dict ``{root, jax_cache_dir,
+    mirror_cache_dir, enabled, error}`` — callers report it rather than
+    assuming the cache took (older jax builds and read-only filesystems
+    degrade to cold compiles, never to a crash)."""
+    root = root or _default_root()
+    jax_dir = jax_dir or os.path.join(root, "jax")
+    mirror_dir = mirror_dir or os.path.join(root, "mirror")
+    info = {
+        "root": root,
+        "jax_cache_dir": jax_dir,
+        "mirror_cache_dir": None,
+        "enabled": False,
+        "error": None,
+    }
+    if mirror_cache:
+        os.environ.setdefault("FUSION_MIRROR_CACHE", mirror_dir)
+        info["mirror_cache_dir"] = os.environ["FUSION_MIRROR_CACHE"]
+    try:
+        os.makedirs(jax_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", jax_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", float(min_compile_seconds)
+        )
+        info["enabled"] = True
+    except Exception as e:  # noqa: BLE001 — the cache is an optimization only
+        info["error"] = repr(e)
+        log.warning("program cache unavailable (%s); compiles stay cold", e)
+    try:
+        from ..diagnostics.metrics import global_metrics
+
+        global_metrics().gauge(
+            "fusion_program_cache_enabled",
+            help="1 when the persistent XLA compilation cache is active",
+        ).set(1 if info["enabled"] else 0)
+    except Exception:  # noqa: BLE001 — metrics must never block enabling
+        pass
+    return info
+
+
+def program_cache_stats(root: Optional[str] = None) -> dict:
+    """Count cached executables + bytes under the cache dir — the
+    restart-warmth signal (``entries > 0`` before first compile of a new
+    process means the restart pre-warms from disk)."""
+    root = root or _default_root()
+    # accept either a cache ROOT (<root>/jax holds the executables) or
+    # the exact jax cache dir (bench's repo-local .jax_cache layout)
+    sub = os.path.join(root, "jax")
+    jax_dir = sub if os.path.isdir(sub) else root
+    entries = 0
+    size = 0
+    if os.path.isdir(jax_dir):
+        for dirpath, _dirnames, filenames in os.walk(jax_dir):
+            for name in filenames:
+                entries += 1
+                try:
+                    size += os.path.getsize(os.path.join(dirpath, name))
+                except OSError:
+                    pass
+    return {"dir": jax_dir, "entries": entries, "bytes": size}

@@ -14,6 +14,7 @@ restarts.
 from __future__ import annotations
 
 import json
+import os
 import sqlite3
 import threading
 import time
@@ -152,10 +153,45 @@ class SqliteOperationLog(OperationLog):
     """Durable log in sqlite — the shared-DB pattern the reference's
     multi-host samples run on (two hosts, one database file)."""
 
-    def __init__(self, path: str):
+    def __init__(
+        self,
+        path: str,
+        busy_timeout_s: float = 30.0,
+        synchronous: Optional[str] = None,
+    ):
         self.path = path
         self._lock = threading.Lock()
-        self._conn = sqlite3.connect(path, check_same_thread=False, timeout=30.0)
+        self._conn = sqlite3.connect(
+            path, check_same_thread=False, timeout=busy_timeout_s
+        )
+        if synchronous is None:
+            synchronous = os.environ.get("FUSION_OPLOG_SYNC", "NORMAL")
+        if synchronous.upper() not in ("OFF", "NORMAL", "FULL", "EXTRA"):
+            raise ValueError(f"invalid synchronous level: {synchronous!r}")
+        # WAL lets a snapshotting READER (another process/instance tailing
+        # or checkpointing this log) run concurrently with an appending
+        # WRITER — under the default rollback journal the reader takes a
+        # shared lock that makes a loaded writer throw `database is
+        # locked`. busy_timeout is the in-engine wait (sqlite3's `timeout`
+        # arg only covers the connection-level retry loop; the pragma also
+        # guards statements issued after the connection was handed out).
+        # Both are best-effort: ":memory:" and some network filesystems
+        # refuse WAL, and the log still works in rollback mode there.
+        self.journal_mode = None
+        try:
+            self._conn.execute(f"PRAGMA busy_timeout={int(busy_timeout_s * 1000)}")
+            row = self._conn.execute("PRAGMA journal_mode=WAL").fetchone()
+            self.journal_mode = row[0] if row else None
+            # NORMAL removes the per-commit fsync stall that made append the
+            # fan-out bottleneck under load, but a power loss can drop acked
+            # rows from an unsynced WAL — which breaks the warm-rejoin
+            # contract that snapshot watermark + surviving tail covers every
+            # committed write. Deployments relying on exact-tail replay
+            # across power loss should run FULL (constructor arg or
+            # FUSION_OPLOG_SYNC=FULL); see DURABILITY.md "Trim safety".
+            self._conn.execute(f"PRAGMA synchronous={synchronous.upper()}")
+        except sqlite3.Error:  # pragma unsupported: keep default journaling
+            pass
         ensure_operations_schema(self._conn)
         self._conn.commit()
 

@@ -5,6 +5,16 @@ DbOperationLogTrimmer.cs: a periodic worker that drops operation records
 older than ``max_age`` so the durable log stays bounded. Readers keep
 commit-time watermarks (reader.py), so trimming behind every host's
 watermark is safe; ``max_age`` should exceed the reader's max commit age.
+
+Two guards clamp the cutoff, and the trim respects the MIN of both:
+
+- ``quarantine_guard`` (PR 1) — never trim past a quarantined corrupt row
+  (the evidence must outlive GC).
+- ``snapshot_guard`` (ISSUE 6) — never trim the replay tail above a
+  retained snapshot's watermark: a warm rejoin restores the snapshot and
+  replays exactly the entries above it; trimming them strands the member
+  with a permanently stale warm state. Anything exposing
+  ``snapshot_floor() -> Optional[float]`` fits (CheckpointManager does).
 """
 from __future__ import annotations
 
@@ -29,6 +39,7 @@ class OperationLogTrimmer(WorkerBase):
         check_period: float = 60.0,
         clock: Optional[MomentClock] = None,
         quarantine_guard=None,
+        snapshot_guard=None,
     ):
         super().__init__(name="oplog-trimmer")
         self.log_store = log_store
@@ -41,8 +52,13 @@ class OperationLogTrimmer(WorkerBase):
         #: so operators can inspect it and cold-boot readers can replay a
         #: repaired row
         self.quarantine_guard = quarantine_guard
+        #: a CheckpointManager (or anything with ``snapshot_floor() ->
+        #: Optional[float]``): the trimmer never trims the replay tail a
+        #: retained snapshot's warm rejoin still needs
+        self.snapshot_guard = snapshot_guard
         self.trimmed_total = 0
         self.clamped_trims = 0
+        self.snapshot_clamped_trims = 0
 
     def _now(self) -> float:
         return self.clock.now() if self.clock is not None else time.time()
@@ -54,6 +70,11 @@ class OperationLogTrimmer(WorkerBase):
             if floor is not None and floor < cutoff:
                 cutoff = floor
                 self.clamped_trims += 1
+        if self.snapshot_guard is not None:
+            floor = self.snapshot_guard.snapshot_floor()
+            if floor is not None and floor < cutoff:
+                cutoff = floor
+                self.snapshot_clamped_trims += 1
         removed = self.log_store.trim_before(cutoff)
         self.trimmed_total += removed
         if removed:

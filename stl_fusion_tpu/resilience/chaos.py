@@ -296,6 +296,18 @@ def member_churn(seed: int = 41) -> ChaosPolicy:
     return ChaosPolicy(seed=seed, drop=0.03, duplicate=0.02, reorder_window=4)
 
 
+@_scenario("rolling_restart")
+def rolling_restart(seed: int = 53) -> ChaosPolicy:
+    """Message-level weather for the rolling-upgrade acceptance scenario
+    (tests/test_cluster.py, ISSUE 6): each of the 3 members is killed and
+    warm-rejoined from its durable snapshot IN SEQUENCE while every frame
+    — heartbeats, map gossip, ``$sys-c`` pushes, rejoin traffic — rides a
+    lossy, duplicating, reordering link. Like ``member_churn``, the
+    kill/restart sequence itself is orchestrated by the test (real member
+    death + restore-from-snapshot, not a link flap)."""
+    return ChaosPolicy(seed=seed, drop=0.03, duplicate=0.02, reorder_window=4)
+
+
 @_scenario("partition_storm")
 def partition_storm(seed: int = 31) -> ChaosPolicy:
     """Three quick peer kills (the flap ramp that opens a breaker), then a

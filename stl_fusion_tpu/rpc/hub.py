@@ -10,6 +10,7 @@ service modes, FusionBuilder.cs:222-320).
 """
 from __future__ import annotations
 
+import itertools
 import logging
 import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Sequence
@@ -46,6 +47,10 @@ class RpcHub:
         self.service_registry = RpcServiceRegistry()
         self.call_types = RpcCallTypeRegistry()
         self.peers: Dict[str, RpcPeer] = {}
+        #: hub-lifetime outbound call id sequence, shared by every peer
+        #: (see RpcPeer._call_id_counter for why per-peer counters are a
+        #: stale-read bug after peer re-creation)
+        self._outbound_call_ids = itertools.count(1)
         #: transport factory for client peers: async (peer) -> ChannelPair
         self.client_connector: Optional[Callable[[RpcClientPeer], Awaitable[ChannelPair]]] = None
         self.call_router: RpcCallRouter = lambda service, method, args: "default"

@@ -13,7 +13,6 @@ story: calls survive connection loss without user code noticing.
 from __future__ import annotations
 
 import asyncio
-import itertools
 import logging
 from typing import TYPE_CHECKING, Any, Dict, Optional, Tuple
 
@@ -96,7 +95,14 @@ class RpcPeer(WorkerBase):
         self.outbound_calls: Dict[int, Any] = {}
         self.inbound_calls: Dict[int, Any] = {}
         self._completed_inbound = RecentlySeenMap(capacity=10_000, max_age=600.0)
-        self._call_id_counter = itertools.count(1)
+        # call ids come from the HUB, not this peer object: a peer that is
+        # torn down (breaker quarantine, retire) and later re-created for
+        # the same ref must NOT restart at 1 — the server keeps completed
+        # compute calls registered per client ref so $sys-c pushes survive
+        # reconnects, and a reused id makes _process_inbound restart() the
+        # OLD subscription, re-sending the old call's result to the new
+        # call (a silent cross-wired read that never heals)
+        self._call_id_counter = hub._outbound_call_ids
         self._conn: Optional[ChannelPair] = None
         self._resend_failures = 0  # consecutive connect-then-die-on-resend
         self._outbox: Optional["PeerOutbox"] = None

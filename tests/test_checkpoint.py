@@ -7,6 +7,7 @@ import dataclasses
 import os
 
 import numpy as np
+import pytest
 
 from stl_fusion_tpu.checkpoint import (
     CheckpointManager,
@@ -465,3 +466,232 @@ async def test_restored_scalar_node_marks_table_row_stale(tmp_path):
         assert float(np.asarray(memo_table_of(b.balance).read_batch([2]))[0]) == 222.0
     finally:
         set_default_hub(old)
+
+
+# ---------------------------------------------------------------- durability (ISSUE 6)
+
+def test_snapshot_envelope_checksum_header_and_legacy(tmp_path):
+    """The v2 envelope: header carries (checksum, watermark, commit_floor)
+    readable without the payload; a torn or bit-flipped file raises
+    CorruptSnapshotError instead of deserializing garbage; pre-envelope
+    files (bare serialized dict) still load as legacy v1."""
+    from stl_fusion_tpu.checkpoint.durable import (
+        CorruptSnapshotError,
+        read_snapshot_file,
+        read_snapshot_header,
+        write_snapshot_file,
+    )
+    from stl_fusion_tpu.utils.serialization import dumps
+
+    snap = {"format": 1, "nodes": [], "edges": [],
+            "oplog": {"watermark": 41, "commit_floor": 123.5}}
+    path = str(tmp_path / "snap.bin")
+    write_snapshot_file(path, snap)
+    assert not any(n.startswith("snap.bin.tmp") for n in os.listdir(tmp_path))
+
+    header = read_snapshot_header(path)
+    assert header["watermark"] == 41 and header["commit_floor"] == 123.5
+    assert read_snapshot_file(path)["oplog"]["watermark"] == 41
+
+    # torn write: drop the last bytes — checksum fails, never garbage
+    blob = open(path, "rb").read()
+    torn = str(tmp_path / "torn.bin")
+    with open(torn, "wb") as f:
+        f.write(blob[:-7])
+    with pytest.raises(CorruptSnapshotError):
+        read_snapshot_file(torn)
+
+    # bit flip inside the payload: same contract
+    flipped = bytearray(blob)
+    flipped[-3] ^= 0xFF
+    with open(str(tmp_path / "flip.bin"), "wb") as f:
+        f.write(bytes(flipped))
+    with pytest.raises(CorruptSnapshotError):
+        read_snapshot_file(str(tmp_path / "flip.bin"))
+
+    # legacy v1: bare serialized dict, no magic — still loads, no header
+    legacy = str(tmp_path / "legacy.bin")
+    with open(legacy, "wb") as f:
+        f.write(dumps({"format": 1, "nodes": [], "edges": [], "oplog_position": 9}))
+    assert read_snapshot_header(legacy) is None
+    assert read_snapshot_file(legacy)["oplog_position"] == 9
+
+
+async def test_manager_falls_back_past_corrupt_latest(tmp_path):
+    """The ISSUE 6 satellite regression: a crash mid-save (simulated by
+    truncating the newest snapshot) must not break restore_latest — it
+    quarantines the bad file as *.corrupt and restores the newest VALID
+    one instead of raising."""
+    PRICES.update({"apple": 2.0, "pear": 3.0})
+    hub = FusionHub()
+    svc = hub.add_service(CartService(hub))
+    await svc.total()
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=3)
+    s1 = mgr.save(hub, oplog_position=1)
+    s2 = mgr.save(hub, oplog_position=2)
+
+    # torn write of the latest + a stray crash-path temp file
+    latest = mgr.path_of(s2)
+    blob = open(latest, "rb").read()
+    with open(latest, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    open(os.path.join(mgr.directory, "fusion-ckpt-9.bin.tmp123"), "wb").close()
+
+    hub2 = FusionHub()
+    hub2.add_service(CartService(hub2))
+    result = mgr.restore_latest(hub2)
+    assert result is not None and result.count == 3
+    assert result.oplog_position == 1  # fell back to s1, not the torn s2
+    assert mgr.corrupt_skipped == 1
+    # the torn file is quarantined on disk, invisible to the next walk
+    assert os.path.exists(f"{latest}.corrupt")
+    assert mgr._steps() == [s1]
+    # and the quarantine is ledgered for operators
+    assert mgr.events.recent_of("snapshot_corrupt"), mgr.events.snapshot()
+
+
+async def test_save_durable_snapshot_floor_and_corrupt_header(tmp_path):
+    """save_durable captures the (epoch, watermark) pair; snapshot_floor()
+    is the MIN commit floor over retained readable headers, and a garbled
+    file contributes nothing (it must never pin the oplog forever)."""
+    import time as _time
+
+    DB.clear()
+    DB.update({"x": 1})
+    log_store = InMemoryOperationLog()
+    notifier = LocalChangeNotifier()
+    hub = FusionHub()
+    svc = hub.add_service(ValueService(hub))
+    hub.commander.add_service(svc)
+    reader = attach_operation_log(hub.commander, log_store, notifier, start_reader=False)
+    try:
+        await svc.get("x")
+        await hub.commander.call(CkptSet("x", 5))
+        await reader.read_new()
+        assert log_store.last_index() >= 1
+
+        mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=3)
+        t0 = _time.time()
+        step = mgr.save_durable(hub, reader=reader, log_store=log_store)
+        from stl_fusion_tpu.checkpoint.durable import read_snapshot_header
+
+        header = read_snapshot_header(mgr.path_of(step))
+        assert header["watermark"] == reader.watermark
+        floor = mgr.snapshot_floor()
+        assert floor is not None and floor <= _time.time() + 1
+        # a second, later snapshot cannot RAISE the floor past the first
+        mgr.save_durable(hub, reader=reader, log_store=log_store)
+        assert mgr.snapshot_floor() == floor or mgr.snapshot_floor() <= floor + (
+            _time.time() - t0 + 1
+        )
+        # garbled bytes where a snapshot should be: no floor contribution
+        with open(mgr.path_of(97), "wb") as f:
+            f.write(b"FUSNAP2 nonsense\n")
+        assert mgr.snapshot_floor() is not None
+        # plain save() with NO floor source stamps no floor — the caller's
+        # watermark may LAG the log head, and a floor of "now" would let
+        # the trimmer delete the lagging tail replay still needs, so
+        # clamp-every-trim is the only safe answer
+        mgr.keep = 10  # keep rotation out of the floor assertions
+        step2 = mgr.save(hub, oplog_position=reader.watermark)
+        h2 = read_snapshot_header(mgr.path_of(step2))
+        assert h2["commit_floor"] is None
+        assert mgr.snapshot_floor() == 0.0
+        os.remove(mgr.path_of(step2))
+        # given the log, save() derives the floor from the log itself:
+        # at the head the floor is the capture instant — trims may flow
+        step3 = mgr.save(
+            hub, oplog_position=log_store.last_index(), log_store=log_store
+        )
+        h3 = read_snapshot_header(mgr.path_of(step3))
+        assert h3["commit_floor"] is not None
+        assert mgr.snapshot_floor() > 0.0
+        # at a LAGGING watermark the floor is the commit time of the FIRST
+        # tail record (what replay actually needs preserved), not "now"
+        first = log_store.read_after(0, limit=1)[0]
+        step4 = mgr.save(hub, oplog_position=0, log_store=log_store)
+        h4 = read_snapshot_header(mgr.path_of(step4))
+        assert h4["commit_floor"] == first.commit_time
+        os.remove(mgr.path_of(step4))
+        # a v2 snapshot with NO floor (foreign/older writer) clamps all
+        # trims while retained — its replay needs are unbounded below
+        from stl_fusion_tpu.checkpoint.durable import write_snapshot_file
+        from stl_fusion_tpu.utils.serialization import dumps as _dumps
+
+        bare = {"format": 1, "oplog_position": 2, "nodes": [], "edges": [],
+                "tables": []}
+        write_snapshot_file(mgr.path_of(98), bare)
+        assert mgr.snapshot_floor() == 0.0
+        os.remove(mgr.path_of(98))
+        # a RESTORABLE legacy v1 file (headerless) clamps too:
+        # restore_latest loads it, so its tail must not be trimmed away
+        with open(mgr.path_of(99), "wb") as f:
+            f.write(_dumps(bare))
+        assert mgr.snapshot_floor() == 0.0
+        os.remove(mgr.path_of(99))
+        assert mgr.snapshot_floor() > 0.0  # backstops gone: real floors
+    finally:
+        await reader.stop()
+
+
+async def test_warm_restart_replays_exact_tail(tmp_path):
+    """THE acceptance arithmetic (ISSUE 6): the oplog tail replayed by a
+    warm restart is exactly ``last_index - snapshot_watermark`` entries —
+    nothing re-replayed from below the watermark, nothing skipped above."""
+    from stl_fusion_tpu.cluster import warm_rejoin
+
+    DB.clear()
+    DB.update({"x": 1, "y": 2})
+    log_store = InMemoryOperationLog()
+    notifier = LocalChangeNotifier()
+
+    # host A lives through the whole scenario
+    hub_a = FusionHub()
+    svc_a = hub_a.add_service(ValueService(hub_a))
+    hub_a.commander.add_service(svc_a)
+    reader_a = attach_operation_log(hub_a.commander, log_store, notifier)
+
+    # host B warms up, snapshots durably, then "dies"
+    hub_b = FusionHub()
+    svc_b = hub_b.add_service(ValueService(hub_b))
+    hub_b.commander.add_service(svc_b)
+    reader_b = attach_operation_log(hub_b.commander, log_store, notifier,
+                                    start_reader=False)
+    assert await svc_b.get("x") == 1 and await svc_b.get("y") == 2
+    await reader_b.read_new()
+    mgr = CheckpointManager(str(tmp_path / "ckpts"))
+    mgr.save_durable(hub_b, reader=reader_b, log_store=log_store)
+    watermark = reader_b.watermark
+    await reader_b.stop()
+    del hub_b, svc_b
+
+    # while B is down, A commits exactly 3 operations
+    for i in range(3):
+        await hub_a.commander.call(CkptSet("x", 100 + i))
+
+    # B restarts WARM (standalone: no membership to announce to)
+    hub_b2 = FusionHub()
+    svc_b2 = hub_b2.add_service(ValueService(hub_b2))
+    hub_b2.commander.add_service(svc_b2)
+    member, reader_b2, report = await warm_rejoin(
+        hub_b2, None, mgr, log_store,
+        member_id="b", seeds=["b"], notifier=notifier,
+        announce=False, start_reader=False,
+    )
+    try:
+        assert member is None and report.warm
+        assert report.snapshot_watermark == watermark
+        assert report.oplog_last_index == log_store.last_index()
+        # exactly the tail: last_index - snapshot_watermark, no more, no less
+        assert report.replayed_entries == log_store.last_index() - watermark
+        assert report.replayed_entries == 3
+        assert reader_b2.watermark == log_store.last_index()
+        # the replay invalidated the stale warm entry; y stayed warm
+        assert await svc_b2.get("x") == 102
+        assert await svc_b2.get("y") == 2
+        assert svc_b2.compute_calls == 1
+        assert report.restored_nodes == 2
+        await report.fence_applied.wait()  # fires even with no membership
+    finally:
+        await reader_b2.stop()
+        await reader_a.stop()

@@ -8,11 +8,13 @@ family, and THE acceptance scenario — a 3-member cluster under the seeded
 ``member_churn`` chaos policy surviving one kill and one join with zero
 oracle-divergent stale reads and zero unhandled exceptions."""
 import asyncio
+import dataclasses
 import hashlib
 import time
 
 import pytest
 
+from stl_fusion_tpu.checkpoint import CheckpointManager
 from stl_fusion_tpu.client import (
     RpcServiceMode,
     add_fusion_service,
@@ -26,12 +28,27 @@ from stl_fusion_tpu.cluster import (
     ShardMovedError,
     install_cluster_client,
     install_cluster_guard,
+    verify_restore,
+    warm_rejoin,
 )
-from stl_fusion_tpu.core import ComputeService, FusionHub, capture, compute_method, invalidating
+from stl_fusion_tpu.commands import command_handler
+from stl_fusion_tpu.core import (
+    ComputeService,
+    FusionHub,
+    capture,
+    compute_method,
+    invalidating,
+    is_invalidating,
+)
+from stl_fusion_tpu.oplog import (
+    InMemoryOperationLog,
+    LocalChangeNotifier,
+    attach_operation_log,
+)
 from stl_fusion_tpu.resilience import SCENARIOS, BreakerState, PeerCircuitBreaker
 from stl_fusion_tpu.rpc import RpcHub, RpcMultiServerTestTransport
 from stl_fusion_tpu.utils.errors import ExceptionInfo
-from stl_fusion_tpu.utils.serialization import dumps, loads
+from stl_fusion_tpu.utils.serialization import dumps, loads, wire_type
 
 
 # ------------------------------------------------------------------ shard map
@@ -103,6 +120,17 @@ def test_shard_moved_error_carries_map_through_exception_info():
 
 # ------------------------------------------------------------------ harness
 
+@wire_type("KvSet")
+@dataclasses.dataclass(frozen=True)
+class KvSet:
+    """Journaled write: commits through the commander so it lands in the
+    shared operation log and replays into every member's graph — the
+    durable write path the warm-rejoin tail replay (ISSUE 6) rides."""
+
+    key: str
+    value: int
+
+
 class Kv(ComputeService):
     """Keyed service over a SHARED backing store (the common-database
     deployment shape): any member can serve any key's current value, so
@@ -125,11 +153,23 @@ class Kv(ComputeService):
         with invalidating():
             await self.get(key)
 
+    @command_handler
+    async def set_value(self, command: KvSet):
+        if is_invalidating():
+            await self.get(command.key)
+            return
+        self.store[command.key] = command.value
+
 
 class Cluster:
-    """N in-memory members + one routed client, fully meshed."""
+    """N in-memory members + one routed client, fully meshed.
 
-    def __init__(self, refs, n_shards=64, heartbeat=0.05, timeout=0.4):
+    With ``oplog=True`` every member journals commander writes to ONE
+    shared operation log (the two-hosts-one-DB pattern) and tails it with
+    a reader — the substrate the ISSUE 6 warm-rejoin tests restart on.
+    """
+
+    def __init__(self, refs, n_shards=64, heartbeat=0.05, timeout=0.4, oplog=False):
         self.refs = list(refs)
         self.n_shards = n_shards
         self.heartbeat = heartbeat
@@ -141,6 +181,9 @@ class Cluster:
         self.members = {}
         self.mesh = {}
         self.killed = set()
+        self.log_store = InMemoryOperationLog() if oplog else None
+        self.notifier = LocalChangeNotifier() if oplog else None
+        self.readers = {}
         for ref in refs:
             self._build_server(ref)
         for ref in refs:
@@ -160,7 +203,7 @@ class Cluster:
         )
         self.rebalancer.attach_proxy(self.proxy)
 
-    def _build_server(self, ref):
+    def _build_server(self, ref, attach_reader=True):
         fusion = FusionHub()
         rpc = RpcHub(ref)
         install_compute_call_type(rpc)
@@ -169,6 +212,13 @@ class Cluster:
         self.hubs[ref] = rpc
         self.services[ref] = svc
         self.fusions[ref] = fusion
+        if self.log_store is not None:
+            fusion.add_service(svc, "kv")  # named for checkpoint restore
+            fusion.commander.add_service(svc)
+            if attach_reader:
+                self.readers[ref] = attach_operation_log(
+                    fusion.commander, self.log_store, self.notifier
+                )
 
     def _wire_server(self, ref, seeds):
         others = {r: h for r, h in self.hubs.items() if r != ref}
@@ -185,6 +235,9 @@ class Cluster:
         self.killed.add(ref)
         for t in list(self.mesh.values()) + [self.transport]:
             t.servers.pop(ref, None)
+        reader = self.readers.pop(ref, None)
+        if reader is not None:
+            await reader.stop()
         await self.members[ref].dispose()
         await self.hubs[ref].stop()
 
@@ -198,6 +251,64 @@ class Cluster:
         self._wire_server(ref, seeds=seeds)
         self.refs.append(ref)
         return self.members[ref]
+
+    def _reconnect(self, ref):
+        """Re-register a restarted member's hub with every live transport
+        and give it a fresh mesh link of its own."""
+        for r, t in self.mesh.items():
+            if r != ref and r not in self.killed:
+                t.servers[ref] = self.hubs[ref]
+        self.transport.servers[ref] = self.hubs[ref]
+        others = {
+            r: h for r, h in self.hubs.items() if r != ref and r not in self.killed
+        }
+        self.mesh[ref] = RpcMultiServerTestTransport(
+            self.hubs[ref], others, client_name=ref
+        )
+
+    async def rejoin_warm(self, ref, manager, **kwargs):
+        """Restart a killed member from its durable snapshot: fresh hubs
+        (the old process is gone), transports rewired, then the real
+        ``warm_rejoin`` path — restore, tail replay, re-announce, fence."""
+        assert self.log_store is not None, "warm rejoin needs the oplog substrate"
+        self.killed.discard(ref)
+        self._build_server(ref, attach_reader=False)  # warm_rejoin owns the reader
+        self._reconnect(ref)
+        seeds = [ref] + [r for r in self.refs if r != ref and r not in self.killed]
+        member, reader, report = await warm_rejoin(
+            self.fusions[ref],
+            self.hubs[ref],
+            manager,
+            self.log_store,
+            member_id=ref,
+            seeds=seeds,
+            notifier=self.notifier,
+            n_shards=self.n_shards,
+            heartbeat_interval=self.heartbeat,
+            failure_timeout=self.timeout,
+            **kwargs,
+        )
+        install_cluster_guard(self.hubs[ref], member)
+        self.members[ref] = member
+        self.readers[ref] = reader
+        return member, reader, report
+
+    async def put_cmd(self, ref, key, value):
+        """Journaled write through ``ref``'s commander: mutates the shared
+        store, appends to the oplog, and invalidates everywhere."""
+        await self.fusions[ref].commander.call(KvSet(key, value))
+
+    async def wait_oplog_synced(self, refs=None, timeout=8.0):
+        """Wait until every (live) member's reader watermark reaches the
+        log head — the deterministic anchor for exact-tail assertions."""
+        last = self.log_store.last_index()
+        refs = [r for r in (refs or self.live_members()) if r in self.readers]
+        deadline = asyncio.get_event_loop().time() + timeout
+        while any(self.readers[r].watermark < last for r in refs):
+            assert asyncio.get_event_loop().time() < deadline, {
+                r: self.readers[r].watermark for r in refs
+            }
+            await asyncio.sleep(0.02)
 
     def live_members(self):
         return [r for r in self.refs if r not in self.killed]
@@ -215,6 +326,8 @@ class Cluster:
         for r, m in list(self.members.items()):
             if r not in self.killed:
                 await m.dispose()
+        for r, reader in list(self.readers.items()):
+            await reader.stop()
         await self.client_rpc.stop()
         for r, h in self.hubs.items():
             if r not in self.killed:
@@ -477,6 +590,39 @@ async def test_adopting_takeover_map_restarts_coordinator_clock():
         assert member.coordinator == "m1"
         assert "m1" in member.shard_map.members
         assert member.shard_map.epoch == 2  # nothing minted
+    finally:
+        await member.dispose()
+        await rpc.stop()
+
+
+async def test_epoch0_heartbeat_join_does_not_mint_parallel_lineage():
+    """A RESTARTED lowest-id member still at its epoch-0 seed view must not
+    mint a join epoch off a heartbeat from a member it doesn't know — that
+    spawns a parallel epoch-1 lineage beside the live cluster (the same
+    split-brain the coordinator-tick bootstrap probe guards). Joins wait
+    until the probe resolves by adopting the live map."""
+    rpc = RpcHub("m0")
+    member = ClusterMember(
+        rpc, "m0", seeds=["m0", "m1"], n_shards=16,
+        heartbeat_interval=0.05, failure_timeout=0.4,
+    )  # never .install()ed: frames dispatched manually, deterministically
+    try:
+        assert member.shard_map.epoch == 0 and member.is_coordinator
+
+        class _Peer:
+            ref = "m3"
+
+            async def send(self, frame):
+                pass
+
+        # a live-cluster member heartbeats before any sync reply lands
+        await member._on_heartbeat(_Peer(), "m3", 5)
+        assert member.epochs_minted == 0
+        assert member.shard_map.epoch == 0  # no parallel lineage minted
+
+        # the probe resolves: the live map arrives; joins mint normally
+        member._apply_map(ShardMap(epoch=5, members=("m1", "m2", "m3"), n_shards=16))
+        assert member.shard_map.epoch == 5
     finally:
         await member.dispose()
         await rpc.stop()
@@ -949,6 +1095,298 @@ async def test_chaos_member_churn_kill_and_join_oracle_consistent():
         loop.set_exception_handler(None)
         for breaker in breakers.values():
             await breaker.dispose()
+        await c.stop()
+
+
+# ------------------------------------------------------------------ warm rejoin (ISSUE 6)
+
+async def test_warm_rejoin_replays_exact_tail_and_fences_moved_keys(tmp_path):
+    """A killed member restarts FROM ITS SNAPSHOT: the oplog tail replayed
+    is exactly ``last_index - snapshot_watermark`` entries, the epoch-diff
+    fence invalidates exactly the restored keys whose shard moved (to the
+    m3 that joined while the member was down) and trusts the rest warm, and
+    the ConsistencyAuditor finds zero invariant violations post-restore."""
+    c = Cluster(["m0", "m1", "m2"], oplog=True)
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        # deterministic key split off the PURE maps (assignment depends only
+        # on the member set): `stay` keys keep m2 as owner after m3 joins,
+        # `move` keys hand over to m3 — the fence must split them exactly
+        map3 = ShardMap.initial(["m0", "m1", "m2"], n_shards=c.n_shards)
+        map4 = ShardMap.initial(["m0", "m1", "m2", "m3"], n_shards=c.n_shards)
+        stay, move = [], []
+        i = 0
+        while len(stay) < 3 or len(move) < 2:
+            k = f"k{i}"
+            i += 1
+            rk = c.router.key_for("kv", "get", (k,))
+            if map3.owner_of(rk) != "m2":
+                continue
+            (move if map4.owner_of(rk) == "m3" else stay).append(k)
+        stay, move = stay[:3], move[:2]
+        keys = stay + move
+
+        for n, k in enumerate(keys):
+            await c.put_cmd("m2", k, n + 1)
+        for k in keys:  # warm server-side computeds ON m2 (the owner)
+            assert (await asyncio.wait_for(c.proxy.get(k), 5))[0] == "m2"
+        # dial the SURVIVORS too — a client connected only to the victim
+        # has nobody left to gossip it the post-kill map
+        for i in range(12):
+            await asyncio.wait_for(c.proxy.get(f"warm{i}"), 5)
+        await c.wait_epoch(
+            lambda: {"m0", "m1"} <= set(c.client_rpc.peers),
+            what="client survivor links",
+        )
+        await c.wait_oplog_synced()
+
+        mgr = CheckpointManager(str(tmp_path / "m2-ckpts"))
+        watermark = c.readers["m2"].watermark
+        snapshot_epoch = c.members["m2"].shard_map.epoch
+        mgr.save_durable(
+            c.fusions["m2"], reader=c.readers["m2"],
+            member=c.members["m2"], rpc_hub=c.hubs["m2"],
+        )
+        await c.kill("m2")
+        await c.wait_epoch(
+            lambda: "m2" not in c.router.shard_map.members, what="kill epoch"
+        )
+
+        # while m2 is down: m3 joins (moves `move`'s shards) and exactly 4
+        # journaled writes land — 2 on warm keys, 2 elsewhere
+        await c.join("m3")
+        await c.wait_epoch(
+            lambda: "m3" in c.router.shard_map.members, what="join epoch"
+        )
+        await c.put_cmd("m0", stay[0], 101)
+        await c.put_cmd("m0", move[0], 102)
+        await c.put_cmd("m0", "elsewhere-a", 103)
+        await c.put_cmd("m0", "elsewhere-b", 104)
+        last = c.log_store.last_index()
+        assert last - watermark == 4
+
+        t0 = time.perf_counter()
+        member, reader, report = await c.rejoin_warm("m2", mgr)
+        assert report.warm
+        assert report.snapshot_watermark == watermark
+        assert report.snapshot_epoch == snapshot_epoch
+        # THE acceptance arithmetic: exactly the tail, nothing else
+        assert report.replayed_entries == last - watermark == 4
+        assert report.oplog_last_index == last
+        assert reader.watermark == last
+        assert report.restored_nodes >= len(keys)
+
+        # the fence waits for the JOIN epoch (m2 back in the map), then
+        # invalidates exactly the restored keys whose owner changed
+        await asyncio.wait_for(report.fence_applied.wait(), 8)
+        assert "m2" in c.members["m2"].shard_map.members
+        assert report.current_epoch > snapshot_epoch
+        assert report.fenced_keys >= len(move)
+
+        await c.wait_epoch(
+            lambda: "m2" in c.router.shard_map.members, what="rejoin epoch at client"
+        )
+        for k in keys + ["elsewhere-a", "elsewhere-b"]:
+            want = c.store.get(k, 0)
+            deadline = asyncio.get_event_loop().time() + 10
+            while True:
+                got = await asyncio.wait_for(c.proxy.get(k), 5)
+                if got[1] == want:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, (k, got, want)
+                await asyncio.sleep(0.05)
+        restore_to_serving_s = time.perf_counter() - t0
+        assert restore_to_serving_s < 10.0, restore_to_serving_s
+
+        # `stay` keys that nobody wrote stayed WARM on m2 — the whole point
+        untouched = [k for k in stay[1:]]
+        for k in untouched:
+            v = await asyncio.wait_for(c.proxy.get(k), 5)
+            assert v[1] == c.store[k]
+
+        # zero invariant violations over the restored graph
+        audit = await verify_restore(c.fusions["m2"])
+        assert audit["violations"] == [], audit
+    finally:
+        await c.stop()
+
+
+async def test_fence_fires_after_full_cluster_restart_epoch_regression(tmp_path):
+    """A FULL-cluster restart re-mints epochs from 1, so a snapshot taken
+    at epoch N may never see a map with epoch >= N again. The fence must
+    fire on the member's own join transition regardless of epoch —
+    otherwise ``fence_applied`` awaiters hang forever and the fence's
+    strong refs pin every restored computed."""
+    c = Cluster(["m0", "m1", "m2"], oplog=True)
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        # drive the lineage's epoch up: two join/kill cycles mint 4 epochs
+        for extra in ("m3", "m4"):
+            await c.join(extra)
+            await c.wait_epoch(
+                lambda: extra in c.members["m0"].shard_map.members,
+                what=f"{extra} join epoch",
+            )
+            await c.kill(extra)
+            await c.wait_epoch(
+                lambda: extra not in c.members["m0"].shard_map.members,
+                what=f"{extra} kill epoch",
+            )
+        await c.put_cmd("m0", "alpha", 1)
+        await c.put_cmd("m0", "beta", 2)
+        await c.wait_oplog_synced()
+        await c.services["m2"].get("alpha")  # warm computeds to restore
+        await c.services["m2"].get("beta")
+        snapshot_epoch = c.members["m2"].shard_map.epoch
+        assert snapshot_epoch >= 5, snapshot_epoch
+        mgr = CheckpointManager(str(tmp_path / "m2-ckpts"))
+        mgr.save_durable(
+            c.fusions["m2"], reader=c.readers["m2"],
+            member=c.members["m2"], rpc_hub=c.hubs["m2"],
+        )
+
+        # FULL restart: every member dies; m0 + m1 come back COLD and
+        # bootstrap a NEW lineage whose epochs start over at 1
+        for ref in ("m2", "m1", "m0"):
+            await c.kill(ref)
+        for ref in ("m0", "m1"):
+            c.killed.discard(ref)
+            c._build_server(ref)
+        for ref in ("m0", "m1"):
+            for r, t in c.mesh.items():
+                if r != ref and r not in c.killed:
+                    t.servers[ref] = c.hubs[ref]
+            c.transport.servers[ref] = c.hubs[ref]
+        for ref in ("m0", "m1"):
+            c._wire_server(ref, seeds=["m0", "m1"])
+        await c.wait_epoch(
+            lambda: all(
+                c.members[r].shard_map.epoch >= 1
+                and {"m0", "m1"} <= set(c.members[r].shard_map.members)
+                for r in ("m0", "m1")
+            ),
+            what="new-lineage bootstrap",
+        )
+        assert c.members["m0"].shard_map.epoch < snapshot_epoch
+
+        member, reader, report = await c.rejoin_warm("m2", mgr)
+        assert report.warm
+        assert report.snapshot_epoch == snapshot_epoch
+        # the join transition fires the fence even though the fresh
+        # lineage's epoch never reaches the snapshot epoch
+        await asyncio.wait_for(report.fence_applied.wait(), 8)
+        assert report.current_epoch < report.snapshot_epoch
+        assert "m2" in c.members["m2"].shard_map.members
+    finally:
+        await c.stop()
+
+
+async def test_rolling_restart_chaos_acceptance(tmp_path):
+    """THE acceptance scenario (ISSUE 6): kill + warm-rejoin each of the 3
+    members IN SEQUENCE under the seeded ``rolling_restart`` ChaosPolicy
+    (drop/dup/reorder on the client links) — every restart restores from
+    its durable snapshot, replays exactly the oplog tail above its
+    watermark, returns to serving in seconds, and the cluster never serves
+    an oracle-divergent stale read; auditor: zero invariant violations."""
+    loop = asyncio.get_event_loop()
+    unhandled = []
+    loop.set_exception_handler(lambda l, ctx: unhandled.append(ctx))
+
+    c = Cluster(["m0", "m1", "m2"], oplog=True, heartbeat=0.05, timeout=0.5)
+    policy = SCENARIOS["rolling_restart"]()
+    assert policy.drop > 0 and policy.duplicate > 0 and policy.reorder_window >= 2
+    c.transport.set_chaos(policy)
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        boot_map = c.members["m0"].shard_map
+        keys = []
+        for ref in ("m0", "m1", "m2"):
+            found = [
+                f"k{i}" for i in range(200)
+                if boot_map.owner_of(c.router.key_for("kv", "get", (f"k{i}",))) == ref
+            ][:3]
+            assert len(found) == 3, (ref, found)
+            keys.extend(found)
+        for n, k in enumerate(keys):
+            await c.put_cmd("m0", k, n + 1)
+        for k in keys:
+            await asyncio.wait_for(c.proxy.get(k), 10)
+        await c.wait_oplog_synced()
+
+        rounds = []
+        for round_no, victim in enumerate(("m0", "m1", "m2")):
+            mgr = CheckpointManager(str(tmp_path / f"{victim}-ckpts"))
+            await c.wait_oplog_synced([victim])
+            watermark = c.readers[victim].watermark
+            mgr.save_durable(
+                c.fusions[victim], reader=c.readers[victim],
+                member=c.members[victim], rpc_hub=c.hubs[victim],
+            )
+            await c.kill(victim)
+            await c.wait_epoch(
+                lambda: victim not in c.router.shard_map.members,
+                timeout=10.0, what=f"kill epoch for {victim} under chaos",
+            )
+            # journaled writes while the member is down — the tail it must
+            # replay (some on its own keys, some elsewhere)
+            writer = next(r for r in c.live_members())
+            for n, k in enumerate(keys[:4]):
+                await c.put_cmd(writer, k, 1000 * (round_no + 1) + n)
+            await c.put_cmd(writer, f"extra-{round_no}", round_no)
+            expected_tail = c.log_store.last_index() - watermark
+            assert expected_tail == 5
+
+            t0 = time.perf_counter()
+            member, reader, report = await c.rejoin_warm(victim, mgr)
+            assert report.warm, f"{victim} came back cold"
+            assert report.replayed_entries == expected_tail, (victim, report.snapshot())
+            await c.wait_epoch(
+                lambda: victim in c.router.shard_map.members,
+                timeout=10.0, what=f"rejoin epoch for {victim} under chaos",
+            )
+            # oracle sweep under chaos: every key converges to the store's
+            # value — a missed fence or a short replay would pin staleness
+            for k in keys:
+                want = c.store.get(k, 0)
+                deadline = loop.time() + 10.0
+                while True:
+                    got = await asyncio.wait_for(c.proxy.get(k), 10)
+                    if got[1] == want:
+                        break
+                    assert loop.time() < deadline, (
+                        f"stale read after {victim} rejoin: {k}={got}, oracle={want}"
+                    )
+                    await asyncio.sleep(0.05)
+            restore_to_serving_s = time.perf_counter() - t0
+            assert restore_to_serving_s < 10.0, (victim, restore_to_serving_s)
+            audit = await verify_restore(c.fusions[victim])
+            assert audit["violations"] == [], (victim, audit)
+            rounds.append((victim, report.replayed_entries, restore_to_serving_s))
+
+        # all three members back, serving, on one map
+        assert set(c.router.shard_map.members) == {"m0", "m1", "m2"}
+        c.transport.set_chaos(None)
+        for k in keys:
+            want = c.store.get(k, 0)
+            deadline = loop.time() + 10.0
+            while True:
+                got = await asyncio.wait_for(c.proxy.get(k), 10)
+                if got[1] == want:
+                    break
+                assert loop.time() < deadline, (k, got, want)
+                await asyncio.sleep(0.05)
+        assert unhandled == [], unhandled
+    finally:
+        loop.set_exception_handler(None)
         await c.stop()
 
 

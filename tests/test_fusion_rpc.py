@@ -134,6 +134,33 @@ async def test_compute_call_survives_reconnect():
         await _stop(crpc, srpc)
 
 
+async def test_recreated_client_peer_never_reuses_call_ids():
+    """A client peer torn down (breaker quarantine, rebalancer retire) and
+    later RE-CREATED for the same ref must not restart call ids at 1: the
+    server keeps completed compute calls registered per client ref so
+    subscriptions survive reconnects, and a reused id makes the server
+    ``restart()`` the OLD call — re-sending the old key's result to the
+    new call, a silent cross-wired read that never heals."""
+    svc, client, transport, crpc, srpc, cf = make_stack()
+    try:
+        await svc.increment("a")  # a=1 so a cross-wired result is visible
+        assert await client.get("a") == 1  # stays registered server-side
+        # simulate the retire: the peer OBJECT dies; the server's per-ref
+        # state (including the registered get("a") call) survives
+        peer = crpc.peers.pop("default")
+        await peer.stop()
+        fresh = compute_client("counters", crpc, FusionHub())
+        # a reused id would restart() get("a") and deliver its value (1)
+        assert await fresh.get("b") == 0
+        ids = {c.message.call_id for p in srpc.peers.values()
+               for c in p.inbound_calls.values()}
+        assert len(ids) == len(
+            [c for p in srpc.peers.values() for c in p.inbound_calls.values()]
+        ), f"inbound call ids collided: {ids}"
+    finally:
+        await _stop(crpc, srpc)
+
+
 async def test_remote_error_memoized_and_raised():
     server_fusion = FusionHub()
     server_rpc = RpcHub("server")

@@ -875,3 +875,116 @@ async def test_reader_cancellation_mid_batch_applies_collected_groups():
     finally:
         await reader_b.stop()
         set_default_hub(old)
+
+
+# ------------------------------------------------------------ durability (ISSUE 6)
+
+def test_sqlite_wal_mode_and_concurrent_append_read(tmp_path):
+    """The WAL satellite regression: a snapshotting READER tailing the log
+    while an appending WRITER is loaded must never throw `database is
+    locked` — WAL + busy_timeout let both proceed. Two connections (two
+    SqliteOperationLog instances, the two-processes-one-file shape), one
+    thread hammering append, one hammering read_after."""
+    import threading
+
+    from stl_fusion_tpu.oplog import OperationRecord
+
+    path = str(tmp_path / "wal.sqlite")
+    writer_log = SqliteOperationLog(path)
+    reader_log = SqliteOperationLog(path)
+    assert writer_log.journal_mode == "wal", writer_log.journal_mode
+
+    n_ops = 200
+    errors = []
+    seen_max = [0]
+
+    def write():
+        try:
+            for i in range(n_ops):
+                writer_log.append(
+                    OperationRecord(f"op{i}", "writer", float(i + 1), None, ())
+                )
+        except Exception as e:  # noqa: BLE001 — the regression under test
+            errors.append(e)
+
+    def read():
+        try:
+            while seen_max[0] < n_ops and not errors:
+                rows = reader_log.read_after(0)
+                if rows:
+                    seen_max[0] = max(seen_max[0], rows[-1].index)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=write), threading.Thread(target=read)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    try:
+        assert errors == [], errors
+        assert seen_max[0] == n_ops
+        assert writer_log.last_index() == n_ops
+        assert len(reader_log.read_after(0)) == n_ops
+    finally:
+        writer_log.close()
+        reader_log.close()
+
+
+def test_trimmer_respects_min_of_quarantine_and_snapshot_floors(tmp_path):
+    """The trim cutoff is min(max_age cutoff, quarantine floor, snapshot
+    floor) — whichever guard is older wins, and each clamp is counted on
+    its own counter. The snapshot guard is a REAL CheckpointManager whose
+    retained snapshot header names the floor (the warm-rejoin replay tail
+    above it must survive GC)."""
+    from stl_fusion_tpu.checkpoint import CheckpointManager
+    from stl_fusion_tpu.checkpoint.durable import write_snapshot_file
+    from stl_fusion_tpu.oplog import OperationRecord
+
+    class QGuard:
+        def __init__(self, floor):
+            self._floor = floor
+
+        def quarantine_floor(self):
+            return self._floor
+
+    def fresh_log():
+        log_store = InMemoryOperationLog()
+        for i in range(6):  # commit times 0.0 .. 5.0
+            log_store.append(OperationRecord(f"t{i}", "agent", float(i), None, ()))
+        return log_store
+
+    # snapshot floor (2.0) is OLDER than the quarantine floor (4.0):
+    # the snapshot clamp wins — only records below 2.0 trim
+    mgr = CheckpointManager(str(tmp_path / "ckpts"))
+    write_snapshot_file(
+        mgr.path_of(1),
+        {"format": 1, "nodes": [], "edges": [],
+         "oplog": {"watermark": 2, "commit_floor": 2.0}},
+    )
+    assert mgr.snapshot_floor() == 2.0
+    log_store = fresh_log()
+    trimmer = OperationLogTrimmer(
+        log_store, max_age=0.0, quarantine_guard=QGuard(4.0), snapshot_guard=mgr
+    )
+    assert trimmer.trim_once() == 2  # t=0.0, 1.0 only
+    assert trimmer.clamped_trims == 1  # quarantine clamped now -> 4.0 first
+    assert trimmer.snapshot_clamped_trims == 1  # then snapshot -> 2.0
+    assert [r.index for r in log_store.read_after(0)] == [3, 4, 5, 6]
+
+    # quarantine floor (1.0) OLDER than snapshot floor (2.0): quarantine
+    # wins and the snapshot clamp never fires
+    log_store = fresh_log()
+    trimmer = OperationLogTrimmer(
+        log_store, max_age=0.0, quarantine_guard=QGuard(1.0), snapshot_guard=mgr
+    )
+    assert trimmer.trim_once() == 1  # t=0.0 only
+    assert trimmer.snapshot_clamped_trims == 0
+    assert [r.index for r in log_store.read_after(0)] == [2, 3, 4, 5, 6]
+
+    # no snapshots retained: the guard contributes nothing
+    empty_mgr = CheckpointManager(str(tmp_path / "empty"))
+    log_store = fresh_log()
+    trimmer = OperationLogTrimmer(log_store, max_age=0.0, snapshot_guard=empty_mgr)
+    assert trimmer.trim_once() == 6
+    assert trimmer.snapshot_clamped_trims == 0
