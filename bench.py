@@ -283,6 +283,16 @@ def run_single_chip(n_nodes, avg_deg, seeds_per_wave, n_waves, rng):
         # stands).
         positive = np.sort(raw[raw > 0])
         rejects = int((raw <= 0).sum())
+        if rejects:
+            # the negative-timing belt, observable beyond this record
+            # (ISSUE 7 satellite): the same counter the live profiler
+            # exports, so /metrics shows rejects wherever they happen
+            from stl_fusion_tpu.diagnostics.metrics import global_metrics
+
+            global_metrics().counter(
+                "fusion_wave_timing_rejects_total",
+                help="negative per-wave timing samples rejected as measurement artifacts",
+            ).inc(rejects)
         # gate on the PRE-trim measurement count: the trim is an estimator
         # choice, not lost data
         if len(positive) < max(8, n_samples // 2):
@@ -676,11 +686,22 @@ def _compact_result(inv_per_sec: float, detail: dict, live, fanout=None, cluster
             "total_inv": live.get("live_lanes_total_inv"),
             "burst_s": _r(live.get("live_burst_s"), 1),
             "loop_s": _r(live.get("live_loop_s"), 1),
+            # nonblocking fused execution (ISSUE 7): fused chain depth +
+            # dispatch count, eager fallbacks (must stay 0), and the
+            # overlap-occupancy of host work against device execution
+            "nonblocking": live.get("live_nonblocking"),
+            "fused_depth": live.get("live_fuse_depth"),
+            "fused_chain_dispatches": live.get("live_fused_chain_dispatches"),
+            "eager_fallback_rounds": live.get("live_eager_fallback_rounds"),
+            "overlap_occupancy": live.get("live_overlap_occupancy"),
             "churn_rows_per_s": _r(live.get("churn_recompute_rows_per_s"), 0),
             "churn_edges": live.get("churn_edges_declared"),
             "mirror_patches": live.get("mirror_patches"),
             "mirror_rebuilds": live.get("mirror_rebuilds"),
             "mirror_patch_ms": _r(live.get("mirror_patch_ms"), 1),
+            # host-vs-device halves of the patch bill (ISSUE 7 satellite)
+            "mirror_patch_host_ms": _r(live.get("mirror_patch_host_ms"), 1),
+            "mirror_patch_device_ms": _r(live.get("mirror_patch_device_ms"), 1),
             "cold_start": live.get("cold_start"),
             # per-phase loop breakdown (live_path emits it from r5 on —
             # the burst/sustained gap itemization, VERDICT r4 #6)

@@ -31,11 +31,23 @@ system and drives it UNDER CHURN (VERDICT r3 #1/#2/#3):
   are first-class outputs; the persistent XLA compilation cache
   (``.jax_cache/``) makes them one-time per workspace.
 
+- **Nonblocking fused execution** (ISSUE 7, default): the loop runs as
+  super-rounds of LIVE_FUSE_DEPTH logical rounds — each round's lane burst
+  AND its device refresh fuse into ONE loop-carried dispatch chain
+  (``cascade_rows_lanes_refresh_chain``), the next super-round's churn
+  prep (edge declarations + scalar recomputes, journal-only) runs WHILE
+  the chain executes on device, and the chain's host apply + fence drain
+  harvest afterwards. ``overlap_occupancy`` reports the fraction of chain
+  wall time covered by that host work; ``LIVE_NONBLOCKING=0`` restores
+  the per-round blocking loop (the A/B baseline).
+
 Env: LIVE_NODES (default 1_000_000), LIVE_DEG (3), LIVE_ROUNDS (6),
 LIVE_LANE_GROUPS (512), LIVE_LANE_SEEDS (8),
 LIVE_SCALAR_NODES (20000; 0 skips), LIVE_LAT_WAVES (32; 0 skips),
 LIVE_EDGE_CHURN (2000/round — level-aware realistic churn, see
 make_churn_edges), LIVE_SCALAR_CHURN (4/round),
+LIVE_NONBLOCKING (1; 0 = legacy blocking loop),
+LIVE_FUSE_DEPTH (3; logical rounds fused per dispatch chain),
 LIVE_TELEMETRY (1; 0 disables the wave profiler — the A/B knob for the
 <3% observability-overhead budget; the result's ``telemetry`` section
 records which mode ran so BENCH_*.json tracks it),
@@ -168,6 +180,8 @@ async def main() -> None:
     lat_waves = int(os.environ.get("LIVE_LAT_WAVES", 32))
     edge_churn = int(os.environ.get("LIVE_EDGE_CHURN", 2000))
     scalar_churn = int(os.environ.get("LIVE_SCALAR_CHURN", 4))
+    nonblocking = os.environ.get("LIVE_NONBLOCKING", "1") != "0"
+    fuse_depth = max(1, min(int(os.environ.get("LIVE_FUSE_DEPTH", 3)), rounds))
     telemetry_on = os.environ.get("LIVE_TELEMETRY", "1") != "0"
     recorder_on = os.environ.get("LIVE_RECORDER", "1") != "0"
     rng = np.random.default_rng(123)
@@ -361,6 +375,11 @@ async def main() -> None:
                 f"(t[{m_long} seq waves] - t[{m_short}]) / {m_long - m_short} "
                 f"via cascade_rows_batch_seq — relay dispatch cost cancels"
             )
+            if chain_rejects:
+                # the negative-timing belt is now observable system-side
+                # (ISSUE 7 satellite): rejects land in the metrics registry
+                # + FusionMonitor.report()["waves"], not just this record
+                backend.profiler.note_timing_rejects(chain_rejects, "wave_chain")
             if table.stale_count():
                 backend.refresh_block_on_device(block)
             backend.flush()
@@ -456,13 +475,20 @@ async def main() -> None:
         refresh_warm_s = time.perf_counter() - t0
         note(f"device-refresh program warm ({refresh_warm_s:.1f}s)")
 
-        # -------- churn-interleaved lane bursts: THE live headline
-        note(f"churn/burst loop: {rounds} rounds x {n_groups} groups x {seeds_per_group} seeds...")
+        # loop state + churn helpers live BEFORE the chain warm: the warm
+        # runs full untimed super-rounds through the SAME helpers, so the
+        # timed loop's program set (chain at the patched pass count, the
+        # super-round-sized journal scatters, the patch quad-scatter
+        # widths) is compiled before the clock starts
         gdev = backend.graph
         total_inv = 0
         burst_s = 0.0
         churn_rows_total = 0
         churn_s = 0.0
+        fused_chain_dispatches = 0
+        eager_rounds = 0  # super-rounds served by the blocking fallback
+        overlap_host_s = 0.0  # host churn prep inside a chain's flight window
+        chain_wall_s = 0.0  # dispatch -> harvest-complete wall time
         phases = {
             "declare_s": 0.0, "scalar_s": 0.0, "refresh_s": 0.0,
             "burst_s": 0.0, "maintain_s": 0.0,
@@ -471,63 +497,90 @@ async def main() -> None:
         # in-edges; rows with declared in-degree beyond the mirror row
         # width re-declare through collector trees, which the patcher
         # (correctly) absorbs by rebuild — the per-round churn shape picks
-        # representative low-in-degree rows so rebuilds stay the exception
+        # representative low-in-degree rows so rebuilds stay the exception.
+        # The pool covers the timed rounds PLUS the untimed warm
+        # super-rounds (distinct rows, same shape).
+        warm_rounds = 0
+        if nonblocking:
+            warm_rounds = fuse_depth + (rounds % fuse_depth)
         indeg = np.bincount(dst, minlength=n)
         low_indeg = np.nonzero(indeg[: n // 2] <= 4)[0]
         scalar_rows = rng.choice(
-            low_indeg, size=max(scalar_churn, 1) * rounds, replace=False
+            low_indeg,
+            size=max(scalar_churn, 1) * (rounds + warm_rounds),
+            replace=False,
         )
         churn_edges_actual = 0
-        loop_t0 = time.perf_counter()
-        for rnd in range(rounds):
-            # structural churn: new dependencies (some violate the frozen
-            # level order -> multi-pass patches), plus scalar recomputes of
-            # adopted rows (bump + declared-edge recapture). Their cascades
-            # land at the flush below.
+
+        async def prep_churn(k_rounds: int, round_base: int, timed: bool = True) -> None:
+            """Churn prep for the next k logical rounds: edge declarations
+            + scalar recomputes. JOURNAL-ONLY host work (no flush, no
+            device reads) — safe to run while a dispatched chain executes,
+            which is exactly where the nonblocking loop runs it.
+            ``timed=False`` (the warm super-rounds) keeps the declares out
+            of the recorded churn accounting."""
+            nonlocal churn_edges_actual
             t0 = time.perf_counter()
-            u, v = make_churn_edges(edge_churn)
-            churn_edges_actual += backend.declare_row_edges(block, u, block, v)
-            phases["declare_s"] += time.perf_counter() - t0
+            for _ in range(k_rounds):
+                u, v = make_churn_edges(edge_churn)
+                declared = backend.declare_row_edges(block, u, block, v)
+                if timed:
+                    churn_edges_actual += declared
+            if timed:
+                phases["declare_s"] += time.perf_counter() - t0
             t0 = time.perf_counter()
-            for i in range(scalar_churn):
-                row = int(scalar_rows[rnd * scalar_churn + i])
-                with invalidating():
+            for j in range(k_rounds):
+                for i in range(scalar_churn):
+                    row = int(scalar_rows[(round_base + j) * scalar_churn + i])
+                    with invalidating():
+                        await svc.node(row)
                     await svc.node(row)
-                await svc.node(row)
-            backend.flush()  # scalar marks cascade (one union wave)
-            phases["scalar_s"] += time.perf_counter() - t0
-            # recompute side of churn: every stale row — the previous
-            # burst's closure AND the scalar churn's cascades — recomputes
-            # ON DEVICE through the table's device loader (one dispatch,
-            # zero host value traffic), restoring consistency pre-burst
+            if timed:
+                phases["scalar_s"] += time.perf_counter() - t0
+
+        # -------- fused chain warm (ISSUE 7): ONE untimed warm super-round
+        # per chain depth, through the full cycle (churn prep → flush →
+        # refresh → chain dispatch+harvest). This compiles the loop's real
+        # program set: the burst→refresh chain at the pass count the
+        # patched mirror actually carries (the warm churn introduces the
+        # violating tail, so passes settles BEFORE timing), the
+        # super-round-sized journal replay scatters, and the patch
+        # scatters — all persisted in the program cache.
+        chain_warm_s = None
+        if nonblocking:
             t0 = time.perf_counter()
-            refreshed = backend.refresh_block_on_device(block)
-            _jax.device_get(table._values[:1])  # sync: honest phase split
-            dt = time.perf_counter() - t0
-            churn_s += dt
-            phases["refresh_s"] += dt
-            churn_rows_total += refreshed
-            # the burst: 512 command groups cascade in packed lanes, WITH
-            # the above churn applied since the last burst (patched mirror,
-            # multi-pass when level-violating deps accumulated)
-            t0 = time.perf_counter()
-            counts = backend.cascade_rows_lanes(block, group_ids)
-            bt = time.perf_counter() - t0
-            burst_s += bt
-            phases["burst_s"] += bt
-            total_inv += int(counts.sum())
-            m = gdev._topo_mirror
+            depths = [fuse_depth]
+            if rounds % fuse_depth:
+                depths.append(rounds % fuse_depth)
+            warm_base = rounds
+            for d in depths:
+                await prep_churn(d, warm_base, timed=False)
+                warm_base += d
+                backend.flush()
+                backend.refresh_block_on_device(block)
+                backend.cascade_rows_lanes_refresh_chain(
+                    block, [group_ids] * d
+                )
+            backend.flush()
+            chain_warm_s = time.perf_counter() - t0
             note(
-                f"round {rnd}: churn {refreshed} rows ({dt:.2f}s), burst {bt:.2f}s "
-                f"({int(counts.sum())/max(bt,1e-9)/1e6:.0f}M inv/s, "
-                f"passes={m.get('passes', 1) if m else '?'}), "
-                f"patches={gdev.mirror_patches} rebuilds={gdev.mirror_rebuilds}"
+                f"burst→refresh chain warm super-rounds, depths {depths} "
+                f"({chain_warm_s:.1f}s)"
             )
-            # maintenance AFTER the burst: install a finished background
-            # re-level and warm its programs with an UNTIMED burst — a new
-            # level layout means a new sweep program, and that compile
-            # belongs to loop_s (sustained), never to the burst lane rate.
-            # (The patch path also self-starts a rebuild past 3 violations.)
+
+        # -------- churn-interleaved lane bursts: THE live headline
+        note(
+            f"churn/burst loop ({'nonblocking' if nonblocking else 'legacy'}"
+            f"{', fuse_depth=' + str(fuse_depth) if nonblocking else ''}): "
+            f"{rounds} rounds x {n_groups} groups x {seeds_per_group} seeds..."
+        )
+
+        def maintain() -> None:
+            """Install a finished background re-level and warm its programs
+            with an UNTIMED burst — a new level layout means a new sweep
+            program, and that compile belongs to loop_s (sustained), never
+            to the burst lane rate. (The patch path also self-starts a
+            rebuild past 3 violations.)"""
             t0 = time.perf_counter()
             if gdev.poll_topo_mirror_rebuild():
                 backend.cascade_rows_lanes(block, group_ids)
@@ -545,12 +598,132 @@ async def main() -> None:
                 # policy spent ~70s/run on installs
                 gdev.start_topo_mirror_rebuild()
             phases["maintain_s"] += time.perf_counter() - t0
+
+        loop_t0 = time.perf_counter()
+        if nonblocking:
+            # ---- the ISSUE 7 loop: super-rounds of fuse_depth logical
+            # rounds; burst i → device refresh → burst i+1 run as ONE
+            # loop-carried chain dispatch, churn prep for the NEXT
+            # super-round overlaps the chain's device execution, and the
+            # harvest (host apply + fence drain) lands afterwards
+            pending = None
+            pending_k = 0
+            dispatch_done_ts = None
+            done_rounds = 0
+            while done_rounds < rounds or pending is not None:
+                k = min(fuse_depth, rounds - done_rounds)
+                if k > 0:
+                    # overlapped host work: this prep runs while the
+                    # previous chain (if any) executes on device
+                    await prep_churn(k, done_rounds)
+                if pending is not None:
+                    t0 = time.perf_counter()
+                    if dispatch_done_ts is not None:
+                        overlap_host_s += max(t0 - dispatch_done_ts, 0.0)
+                    per_burst = pending.harvest()
+                    dt = time.perf_counter() - t0
+                    burst_s += dt
+                    phases["burst_s"] += dt
+                    chain_wall_s += time.perf_counter() - pending.dispatched_at
+                    chain_inv = sum(int(c.sum()) for c in per_burst)
+                    total_inv += chain_inv
+                    churn_rows_total += pending.cleared_total
+                    m = gdev._topo_mirror
+                    note(
+                        f"super-round of {pending_k}: chain harvest {dt:.2f}s "
+                        f"({chain_inv:,} inv, passes="
+                        f"{m.get('passes', 1) if m else '?'}), "
+                        f"patches={gdev.mirror_patches} "
+                        f"rebuilds={gdev.mirror_rebuilds}"
+                    )
+                    pending = None
+                    maintain()
+                if k > 0:
+                    # flush the prep's journal (scalar marks cascade — one
+                    # union wave) and re-consistent those rows pre-burst
+                    t0 = time.perf_counter()
+                    backend.flush()
+                    phases["scalar_s"] += time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    refreshed = backend.refresh_block_on_device(block)
+                    _jax.device_get(table._values[:1])  # honest phase split
+                    dt = time.perf_counter() - t0
+                    churn_s += dt
+                    phases["refresh_s"] += dt
+                    churn_rows_total += refreshed
+                    t0 = time.perf_counter()
+                    try:
+                        pending = backend.cascade_rows_lanes_refresh_chain(
+                            block, [group_ids] * k, nonblocking=True
+                        )
+                        fused_chain_dispatches += 1
+                        pending_k = k
+                    except (RuntimeError, TypeError):
+                        # mirror not fusible right now (multi-pass pileup
+                        # mid-re-level): blocking fallback for this
+                        # super-round — counted, never silent
+                        eager_rounds += k
+                        for _ in range(k):
+                            counts = backend.cascade_rows_lanes(block, group_ids)
+                            total_inv += int(counts.sum())
+                            refreshed = backend.refresh_block_on_device(block)
+                            churn_rows_total += refreshed
+                    dt = time.perf_counter() - t0
+                    burst_s += dt
+                    phases["burst_s"] += dt
+                    dispatch_done_ts = time.perf_counter()
+                    done_rounds += k
+        else:
+            for rnd in range(rounds):
+                # structural churn: new dependencies (some violate the
+                # frozen level order -> multi-pass patches), plus scalar
+                # recomputes of adopted rows (bump + declared-edge
+                # recapture). Their cascades land at the flush below.
+                await prep_churn(1, rnd)
+                t0 = time.perf_counter()
+                backend.flush()  # scalar marks cascade (one union wave)
+                phases["scalar_s"] += time.perf_counter() - t0
+                # recompute side of churn: every stale row — the previous
+                # burst's closure AND the scalar churn's cascades —
+                # recomputes ON DEVICE through the table's device loader
+                # (one dispatch, zero host value traffic)
+                t0 = time.perf_counter()
+                refreshed = backend.refresh_block_on_device(block)
+                _jax.device_get(table._values[:1])  # sync: honest phase split
+                dt = time.perf_counter() - t0
+                churn_s += dt
+                phases["refresh_s"] += dt
+                churn_rows_total += refreshed
+                # the burst: 512 command groups cascade in packed lanes,
+                # WITH the above churn applied since the last burst
+                t0 = time.perf_counter()
+                counts = backend.cascade_rows_lanes(block, group_ids)
+                bt = time.perf_counter() - t0
+                burst_s += bt
+                phases["burst_s"] += bt
+                total_inv += int(counts.sum())
+                m = gdev._topo_mirror
+                note(
+                    f"round {rnd}: churn {refreshed} rows ({dt:.2f}s), burst {bt:.2f}s "
+                    f"({int(counts.sum())/max(bt,1e-9)/1e6:.0f}M inv/s, "
+                    f"passes={m.get('passes', 1) if m else '?'}), "
+                    f"patches={gdev.mirror_patches} rebuilds={gdev.mirror_rebuilds}"
+                )
+                maintain()
         loop_s = time.perf_counter() - loop_t0
         bursts_on_mirror = gdev.mirror_bursts
+        overlap_occupancy = (
+            round(overlap_host_s / chain_wall_s, 4) if chain_wall_s else None
+        )
         note(
             f"loop done: {total_inv:,} inv, burst {burst_s:.2f}s, loop {loop_s:.2f}s, "
             f"patches={gdev.mirror_patches} rebuilds={gdev.mirror_rebuilds} "
             f"bursts_on_mirror={bursts_on_mirror}"
+            + (
+                f", fused_chains={fused_chain_dispatches} "
+                f"overlap_occupancy={overlap_occupancy}"
+                if nonblocking else ""
+            )
         )
 
         # -------- lane ≡ oracle equivalence ON THE CHURNED TOPOLOGY.
@@ -715,6 +888,17 @@ async def main() -> None:
             # THE live headline: lane-packed bursts WITH churn interleaved
             "live_inv_per_s": round(total_inv / burst_s, 1) if burst_s else None,
             "live_sustained_inv_per_s": round(total_inv / loop_s, 1) if loop_s else None,
+            # nonblocking execution accounting (ISSUE 7): whether the fused
+            # loop ran, how deep the chains were, how many dispatches the
+            # loop cost, and how much of the chain wall time the host spent
+            # doing overlapped work (churn prep during device execution)
+            "live_nonblocking": nonblocking,
+            "live_fuse_depth": fuse_depth if nonblocking else None,
+            "live_fused_chain_dispatches": (
+                fused_chain_dispatches if nonblocking else None
+            ),
+            "live_eager_fallback_rounds": eager_rounds if nonblocking else None,
+            "live_overlap_occupancy": overlap_occupancy,
             "live_rounds": rounds,
             "live_lanes_groups": n_groups,
             "live_lanes_seeds_per_group": seeds_per_group,
@@ -734,6 +918,12 @@ async def main() -> None:
             "mirror_patches": gdev.mirror_patches,
             "mirror_rebuilds": gdev.mirror_rebuilds,
             "mirror_patch_ms": round(gdev.mirror_patch_s * 1e3, 1),
+            # patch-time breakdown (ISSUE 7 satellite): host numpy
+            # bookkeeping vs device row-scatter dispatches — r05's
+            # 1090.7 ms/11k edges was unattributable without it (it was
+            # nearly all dispatch; the fused quad scatter halves it)
+            "mirror_patch_host_ms": round(gdev.mirror_patch_host_s * 1e3, 1),
+            "mirror_patch_device_ms": round(gdev.mirror_patch_device_s * 1e3, 1),
             "mirror_patch_ms_per_edge": (
                 round(
                     gdev.mirror_patch_s * 1e3 / churn_edges_actual, 4
@@ -765,6 +955,11 @@ async def main() -> None:
                 "lane_program_warm_s": round(lane_warm_s, 2),
                 "union_program_warm_s": round(union_warm_s, 2),
                 "refresh_program_warm_s": round(refresh_warm_s, 2),
+                # the fused burst→refresh chain compiles (ISSUE 7) — one
+                # per chain depth, persisted like every other program
+                "chain_program_warm_s": (
+                    round(chain_warm_s, 2) if chain_warm_s is not None else None
+                ),
                 # the WARM-start alternative (ISSUE 6): restore the durable
                 # graph snapshot instead of rebuilding — restore_s is what a
                 # rolling restart pays; program_cache counts the compiled

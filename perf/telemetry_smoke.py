@@ -11,7 +11,12 @@ gateway's ``/metrics`` and assert
 - ``/trace`` serves JSON with the monitor report (waves + delivery +
   recorder), and ``?section=`` bounds the payload to one section,
 - ``/explain?key=`` assembles a causal chain that NAMES the burst wave's
-  cause id (the ISSUE 4 acceptance: the "why" answer works over HTTP).
+  cause id (the ISSUE 4 acceptance: the "why" answer works over HTTP),
+- the NONBLOCKING fused path actually ENGAGES (ISSUE 7 CI gate): after
+  driving the wave pipeline, ``fusion_wave_fused_depth`` is non-empty with
+  p50 > 1, ``/trace?section=waves`` shows fused entries
+  (``fused_depth`` > 1), and zero waves fell back to eager dispatch — a
+  silent regression to one-wave-per-dispatch fails the build.
 
 Prints ONE JSON summary line on stdout; exits non-zero on any failed check.
 
@@ -191,6 +196,50 @@ async def main() -> int:
         )
         assert explain_payload["invalidation"]["clients_fenced"] >= 1
 
+        # -------- nonblocking fused chain (ISSUE 7 CI gate): drive the
+        # wave pipeline and assert the fused path ENGAGED — the histogram,
+        # the /trace entries, and the zero-eager-fallback check together
+        # make a silent regression to eager dispatch a red build
+        stale = np.nonzero(table._stale_host)[0]
+        if stale.size:
+            table.read_batch(stale)
+        backend.flush()
+        pipe = hub.enable_nonblocking(fuse_depth=4)
+        for k in range(4):
+            pipe.submit_rows(block, [k])
+        pipe.drain()
+        assert pipe.stats()["eager_waves"] == 0, (
+            "pipeline fell back to eager dispatch", pipe.stats(),
+        )
+        status, body = await http_get(
+            gateway.host, gateway.port, "/trace?section=waves"
+        )
+        assert status.endswith("200 OK"), status
+        waves_sec = json.loads(body)["report"]["waves"]
+        fused_recent = [
+            r for r in waves_sec["recent"] if r.get("fused_depth", 1) > 1
+        ]
+        assert fused_recent, (
+            "no fused chain entries in /trace?section=waves",
+            waves_sec["recent"][-4:],
+        )
+        fused_p50 = waves_sec.get("fused_depth_p50")
+        assert fused_p50 is not None and fused_p50 > 1, (
+            "fusion_wave_fused_depth p50 must exceed 1 (fused path engaged)",
+            fused_p50,
+        )
+        status, body = await http_get(gateway.host, gateway.port, "/metrics")
+        assert status.endswith("200 OK"), status
+        samples = parse_exposition(body.decode())
+        assert samples.get("fusion_wave_fused_depth_count", 0) >= 1, (
+            "fused-depth histogram missing from /metrics"
+        )
+        note(
+            f"fused path engaged: depth p50 {fused_p50}, "
+            f"{len(fused_recent)} fused /trace entries, 0 eager fallbacks"
+        )
+        pipe.dispose()
+
         print(json.dumps({
             "metric": "telemetry_smoke",
             "ok": True,
@@ -203,6 +252,8 @@ async def main() -> int:
             "cause": cause,
             "explain_chain": explain_payload["chain"],
             "recorder_events": report["recorder"]["events_recorded"],
+            "fused_depth_p50": fused_p50,
+            "fused_trace_entries": len(fused_recent),
         }))
         monitor.dispose()
         await gateway.stop()

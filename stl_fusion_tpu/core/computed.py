@@ -250,6 +250,26 @@ class Computed(Generic[T]):
             hub.on_invalidated(node)
         return transitioned
 
+    def invalidate_eventually(self) -> bool:
+        """GraphBLAS-style NONBLOCKING invalidate (ISSUE 7): enqueue this
+        node as a seed in the hub's wave pipeline instead of cascading now.
+        The transitive closure materializes when the pipeline's next fused
+        chain is harvested — ``pipeline.drain()`` is the barrier; until
+        then this node (and its dependents) still read consistent. The lazy
+        accumulator batches seeds arriving between dispatches, so N calls
+        cost one fused device dispatch, not N.
+
+        Falls back to ``invalidate(immediately=True)`` when no pipeline is
+        attached (``FusionHub.enable_nonblocking``), so call sites can
+        adopt the nonblocking form unconditionally. Returns True when the
+        invalidation was enqueued or applied."""
+        backend = self.input.function.hub._graph_backend
+        pipeline = getattr(backend, "pipeline", None) if backend is not None else None
+        if pipeline is None:
+            return self.invalidate(immediately=True)
+        pipeline.submit([self])
+        return True
+
     def invalidate_local(self, _detail: Optional[str] = None) -> bool:
         """Single-node invalidation WITHOUT cascading — used when a device
         wave already computed the full transitive closure and the host just

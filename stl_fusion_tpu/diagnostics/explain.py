@@ -81,6 +81,16 @@ def _resolve(key: Any, hub) -> tuple:
 MAX_REGISTRY_SCAN = 100_000  # string-key resolution cap; see _resolve
 
 
+def _rec_covers_seq(rec: dict, wave: int) -> bool:
+    """Does this profiler record describe wave ``wave``? Exact seq match,
+    or — for a physically-fused chain — any seq inside the record's
+    ``seq_span`` (one seq per logical wave, ISSUE 7)."""
+    if rec["seq"] == wave:
+        return True
+    span = rec.get("seq_span")
+    return span is not None and span[0] <= wave <= span[1]
+
+
 def explain(
     key: Any,
     hub=None,
@@ -221,14 +231,19 @@ def explain(
     # wave record: an exact seq match wins outright (several waves can
     # share one span-shaped cause — e.g. two cascades under one command
     # span — and a cause-first scan would grab the NEWEST of them, not the
-    # one that actually invalidated this key); cause matching is only the
-    # fallback for events that carried no seq
+    # one that actually invalidated this key); a logical wave physically
+    # FUSED into a chain has no record of its own — any seq inside a
+    # record's seq_span resolves to the fused record (ISSUE 7), with the
+    # logical wave still named by its own seq in the chain text; cause
+    # matching is only the fallback for events that carried no seq
     wave_rec = None
     profiler = getattr(backend, "profiler", None)
     if profiler is not None:
         recs = profiler.recent()
         if wave is not None:
-            wave_rec = next((r for r in reversed(recs) if r["seq"] == wave), None)
+            wave_rec = next(
+                (r for r in reversed(recs) if _rec_covers_seq(r, wave)), None
+            )
         if wave_rec is None and wave is None and cause is not None:
             wave_rec = next((r for r in reversed(recs) if r["cause"] == cause), None)
 
@@ -265,7 +280,13 @@ def explain(
         "cause": cause,
         "host": host,
         "wave": wave_rec,
-        "wave_seq": wave_rec["seq"] if wave_rec is not None else wave,
+        # the LOGICAL wave's seq when the event recorded one (a fused
+        # record's own seq is just the chain head — naming it here would
+        # misattribute every non-head wave in the chain)
+        "wave_seq": (
+            wave if wave is not None
+            else (wave_rec["seq"] if wave_rec is not None else None)
+        ),
         "span": span_dict,
         "oplog": oplog,
         "clients_fenced": clients_fenced,
@@ -279,11 +300,28 @@ def explain(
     chain: List[str] = []
     inv_detail = (inv_event.get("detail") or "") if inv_event is not None else ""
     if wave_rec is not None:
-        chain.append(
-            f"{key_str} invalidated by wave #{wave_rec['seq']} "
-            f"({wave_rec['kind']}, {wave_rec['seeds']} seed(s), "
-            f"{wave_rec['newly']} newly invalid)"
-        )
+        span = wave_rec.get("seq_span")
+        if (
+            span is not None
+            and wave is not None
+            and wave_rec.get("fused_depth", 1) > 1
+        ):
+            # the LOGICAL wave keeps its own name even though it was
+            # physically fused — the operator greps for "wave#<seq>" and
+            # must land on the chain that actually ran it
+            chain.append(
+                f"{key_str} invalidated by wave #{wave} (physically fused "
+                f"into chain #{span[0]}–#{span[1]}, depth "
+                f"{wave_rec['fused_depth']}, {wave_rec['kind']}: "
+                f"{wave_rec['seeds']} seed(s), {wave_rec['newly']} newly "
+                f"invalid across the chain)"
+            )
+        else:
+            chain.append(
+                f"{key_str} invalidated by wave #{wave_rec['seq']} "
+                f"({wave_rec['kind']}, {wave_rec['seeds']} seed(s), "
+                f"{wave_rec['newly']} newly invalid)"
+            )
     elif wave is not None:
         chain.append(f"{key_str} invalidated by wave #{wave}")
     elif inv_detail == LAZY_WAVE_DETAIL:

@@ -429,6 +429,12 @@ class WaveProfiler:
         self.flush_ms_total = 0.0
         self.newly_total = 0
         self._pending_flush: Optional[dict] = None
+        #: fused-chain accounting (ISSUE 7): logical waves per physical
+        #: dispatch, and the bench-layer negative-timing rejects that were
+        #: previously counted only inside BENCH_*.json
+        self.fused_dispatches = 0
+        self.fused_waves_total = 0
+        self.timing_rejects_total = 0
 
     # ------------------------------------------------------------------ feed
     def note_flush(self, journal_pre: int, journal_post: int, host_ms: float) -> None:
@@ -446,6 +452,41 @@ class WaveProfiler:
             "flush_ms": round(host_ms, 3),
         }
 
+    def note_fused_dispatch(self, fused_depth: int) -> None:
+        """One physical device dispatch that FUSED ``fused_depth`` > 1
+        logical waves (ISSUE 7 wave-chain fusion). Feeds the
+        ``fusion_wave_fused_depth`` histogram — the CI live smoke asserts
+        it is non-empty with p50 > 1, i.e. the fused path actually engaged
+        instead of silently falling back to eager one-wave dispatches.
+        Plain one-wave dispatches are NOT recorded: a workload full of
+        ordinary lone bursts would otherwise dilute the engagement metric
+        below the gate even while every chain-eligible wave fused (and the
+        log-bucket interpolation of depth-1 samples reads below 1.0)."""
+        if not self.enabled or fused_depth <= 1:
+            return
+        self.fused_dispatches += 1
+        self.fused_waves_total += int(fused_depth)
+        self.metrics.histogram(
+            "fusion_wave_fused_depth",
+            help="logical waves per physical device dispatch (wave-chain fusion; depth>1 only)",
+            unit="waves", lo=1.0, hi=4096.0,
+        ).record(float(fused_depth))
+
+    def note_timing_rejects(self, n: int, source: str = "") -> None:
+        """Negative chain-difference samples rejected by the PR-6 timing
+        belt (bench.py / live_path.py) — previously bench-local counters;
+        exported here as ``fusion_wave_timing_rejects_total`` and surfaced
+        in ``FusionMonitor.report()["waves"]`` so the belt is observable
+        in production scrapes, not just BENCH_*.json."""
+        if n <= 0:
+            return
+        self.timing_rejects_total += int(n)
+        c = self.metrics.counter(
+            "fusion_wave_timing_rejects_total",
+            help="negative per-wave timing samples rejected as measurement artifacts",
+        )
+        c.inc(int(n))
+
     def record_wave(
         self,
         kind: str,
@@ -456,6 +497,9 @@ class WaveProfiler:
         cause: Optional[str] = None,
         groups: Optional[int] = None,
         seq: Optional[int] = None,
+        fused_depth: Optional[int] = None,
+        seq_span: Optional[tuple] = None,
+        dispatches: Optional[int] = None,
     ) -> None:
         if not self.enabled:
             return
@@ -471,6 +515,16 @@ class WaveProfiler:
         }
         if groups is not None:
             rec["groups"] = int(groups)
+        if fused_depth is not None:
+            # per-logical-wave identity survives physical fusion: the
+            # record covers the CONTIGUOUS seq span [seq_span[0],
+            # seq_span[1]] (one seq per logical wave), and explain()
+            # resolves any seq inside the span to this record
+            rec["fused_depth"] = int(fused_depth)
+        if seq_span is not None:
+            rec["seq_span"] = [int(seq_span[0]), int(seq_span[1])]
+        if dispatches is not None:
+            rec["dispatches"] = int(dispatches)
         if self._pending_flush is not None:
             rec.update(self._pending_flush)
             self._pending_flush = None
@@ -493,6 +547,7 @@ class WaveProfiler:
 
     def summary(self) -> dict:
         dev = self.metrics.find("fusion_wave_device_ms")
+        fused = self.metrics.find("fusion_wave_fused_depth")
         return {
             "enabled": self.enabled,
             "waves_recorded": self.waves_recorded,
@@ -507,6 +562,20 @@ class WaveProfiler:
             "device_ms_p99": (
                 round(dev.percentile(99), 4) if dev is not None and dev.count else None
             ),
+            # fused-chain engagement (ISSUE 7): dispatches carrying >1
+            # logical wave; the live smoke asserts fused_depth_p50 > 1
+            "fused_dispatches": self.fused_dispatches,
+            "fused_waves_total": self.fused_waves_total,
+            "fused_depth_p50": (
+                round(fused.percentile(50), 2)
+                if fused is not None and fused.count else None
+            ),
+            "fused_depth_p99": (
+                round(fused.percentile(99), 2)
+                if fused is not None and fused.count else None
+            ),
+            # the PR-6 negative-timing belt, observable (ISSUE 7 satellite)
+            "timing_rejects": self.timing_rejects_total,
         }
 
     def report(self, recent: int = 32) -> dict:

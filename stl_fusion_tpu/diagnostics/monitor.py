@@ -265,7 +265,15 @@ class FusionMonitor:
         backend = getattr(self.hub, "graph_backend", None)
         profiler = getattr(backend, "profiler", None)
         if profiler is not None:
+            # includes fused_depth_p50/p99 + timing_rejects (ISSUE 7): the
+            # fused-path engagement and the negative-timing belt are part
+            # of the standard waves report, not bench-only fields
             extra["waves"] = profiler.report()
+        # nonblocking wave pipeline (ISSUE 7): accumulator depth, fused
+        # dispatch count, eager/fault fallbacks, overlap occupancy
+        pipeline = getattr(backend, "pipeline", None)
+        if pipeline is not None:
+            extra["pipeline"] = pipeline.stats()
         # end-to-end delivery: wave applied server-side -> client apply,
         # measured INSIDE the system (the $sys-c origin timestamp), not by
         # a harness. find(), not histogram(): reporting must never mint an

@@ -1,12 +1,15 @@
 """Host↔device graph backend: DeviceGraph container + live hub mirror."""
 from .backend import RowBlock, TpuGraphBackend
 from .device_graph import DeviceGraph
+from .nonblocking import WavePipeline, WaveTicket
 from .program_cache import enable_program_cache, program_cache_stats
 
 __all__ = [
     "TpuGraphBackend",
     "RowBlock",
     "DeviceGraph",
+    "WavePipeline",
+    "WaveTicket",
     "enable_program_cache",
     "program_cache_stats",
 ]

@@ -147,6 +147,114 @@ class RowBlock:
         return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
 
+def _finish_block_refresh_bookkeeping(table, cleared: np.ndarray) -> None:
+    """THE shared host bookkeeping tail of a columnar device refresh —
+    stale accounting for the rows the device recomputed, the table version
+    bump, and the non-backend ``on_refresh`` fan-out. Used by BOTH the
+    sequential path (``refresh_block_on_device``) and the fused chain
+    ticket, so the two can never drift. ``cleared`` is a bool mask over
+    the table's rows."""
+    was_stale = table._stale_host & cleared
+    table._stale_count -= int(np.count_nonzero(was_stale))
+    table._stale_host &= ~cleared
+    table._bump()
+    extern = [
+        h for h in table.on_refresh if not getattr(h, "_backend_hook", False)
+    ]
+    if extern and cleared.any():
+        ids_np = np.nonzero(cleared)[0].astype(np.int32)
+        for h in extern:
+            h(ids_np)
+
+
+class _RefreshChainTicket:
+    """In-flight burst→refresh chain (``cascade_rows_lanes_refresh_chain``
+    with ``nonblocking=True``): the dispatches are enqueued; ``harvest()``
+    blocks on the results and runs the two-tier host apply per logical
+    wave. ``dispatched_at`` lets the caller account the overlap window
+    (host work done between dispatch and harvest ran concurrently with the
+    chain's device execution)."""
+
+    __slots__ = (
+        "backend", "block", "n_bursts", "stage_burst", "stages", "refresh",
+        "pending", "cause", "seqs", "pre_block_invalid", "dispatched_at",
+        "update_valid", "done", "cleared_total",
+    )
+
+    def __init__(self, backend, block, n_bursts, stage_burst, stages, refresh,
+                 pending, cause, seqs, pre_block_invalid, dispatched_at,
+                 update_valid):
+        self.backend = backend
+        self.block = block
+        self.n_bursts = n_bursts
+        self.stage_burst = stage_burst
+        self.stages = stages
+        self.refresh = refresh
+        self.pending = pending
+        self.cause = cause
+        self.seqs = seqs
+        self.pre_block_invalid = pre_block_invalid
+        self.dispatched_at = dispatched_at
+        self.update_valid = update_valid
+        self.done = False
+        #: filled at harvest: total block rows the chained refreshes
+        #: recomputed (the churn-recompute accounting of the fused loop)
+        self.cleared_total = 0
+
+    def harvest(self) -> list:
+        """Block on the chain, apply every stage's newly-mask under its own
+        wave seq, and finish the refresh bookkeeping. Returns one int64
+        newly-count array per burst. Idempotent-guarded (a second harvest
+        raises — the state was already consumed)."""
+        if self.done:
+            raise RuntimeError("refresh chain already harvested")
+        self.done = True
+        backend = self.backend
+        block, table = self.block, self.block.table
+        seqs, stages = self.seqs, self.stages
+        dg = backend.graph
+        stage_counts, stage_masks = dg.harvest_waves_lanes_chain(self.pending)
+        t1 = time.perf_counter()
+        # commit the chained table state (same contract as
+        # refresh_block_on_device: values recomputed, validity caught up)
+        table._values = self.refresh["values"]
+        if self.update_valid:
+            table._valid_dev = self.refresh["valid_dev"]
+        # two-tier host apply PER STAGE, each under its own wave seq — the
+        # recorder/fanout events of one logical wave never blur into its
+        # chain siblings; overlap_active is visible to the fan-out index
+        # when another chain is already executing
+        backend.last_cause_id = self.cause
+        per_burst = [np.empty(0, dtype=np.int64) for _ in range(self.n_bursts)]
+        cleared_rows = self.pre_block_invalid.copy()
+        total_newly = 0
+        for i, (cnts, mask) in enumerate(zip(stage_counts, stage_masks)):
+            backend.last_wave_seq = seqs[i]
+            backend._apply_newly(mask)
+            sub = mask[block.base : block.end()]
+            cleared_rows |= sub
+            self.cleared_total += int(sub.sum())
+            bi = self.stage_burst[i]
+            per_burst[bi] = np.concatenate([per_burst[bi], cnts])
+            total_newly += int(mask.sum())
+        backend.last_wave_seq = seqs[0]
+        # refresh bookkeeping once, at the end state: the device refreshed
+        # every block row that was invalid at ANY stage
+        _finish_block_refresh_bookkeeping(table, cleared_rows)
+        total_counts = sum(int(c.sum()) for c in stage_counts)
+        backend.waves_run += sum(len(s) for s in stages)
+        backend.device_invalidations += total_counts
+        backend._profile_wave(
+            "lanes_refresh_chain",
+            sum(len(g) for s in stages for g in s), self.cause,
+            self.dispatched_at, t1, total_newly, seqs[0],
+            groups=sum(len(s) for s in stages),
+            fused_depth=len(stages), seq_span=(seqs[0], seqs[-1]),
+            dispatches=self.pending["dispatches"],
+        )
+        return per_burst
+
+
 class TpuGraphBackend:
     def __init__(self, hub: "FusionHub", node_capacity: int = 4096, edge_capacity: int = 16384):
         self.hub = hub
@@ -180,6 +288,14 @@ class TpuGraphBackend:
         #: dispatches route through it (deadline + fault containment with a
         #: split-host-loop fallback); None = direct dispatch, zero overhead
         self.watchdog = None
+        #: optional graph.nonblocking.WavePipeline (ISSUE 7): the lazy seed
+        #: accumulator + fused-chain dispatcher; Computed.invalidate_eventually
+        #: and FusionHub.enable_nonblocking route here
+        self.pipeline = None
+        #: True while a pipeline harvest applies wave N-1's newly-mask WITH
+        #: wave N still executing on device — the fan-out index reads it to
+        #: count fences drained in the overlap window (ISSUE 7 stage c)
+        self.overlap_active = False
         self.waves_run = 0
         self.device_invalidations = 0
         #: fired on every wave application with the newly-invalid set AS
@@ -249,7 +365,34 @@ class TpuGraphBackend:
         self.last_cause_id = cause
         return cause, self.last_wave_seq
 
-    def _profile_wave(self, kind, seeds, cause, t0, t1, newly, seq, groups=None) -> None:
+    def _begin_wave_span(self, n: int):
+        """Mint ``n`` logical-wave seqs for ONE physically-fused dispatch
+        (ISSUE 7): every logical wave fused into a chain keeps its own seq
+        — the recorder stamps per-stage events with the stage's seq, the
+        profiler record carries the whole span, and explain() resolves any
+        seq in the span back to the fused record. The chain's cause id
+        names the span (``wave#s0-s1``) unless a tracing span is open —
+        same precedence as :meth:`_begin_wave`.
+
+        Returns ``(cause, seqs)`` with ``seqs`` a list of n ints
+        (contiguous absent concurrent minters — the span bounds in the
+        profiler record are [seqs[0], seqs[-1]])."""
+        seqs = [next_wave_seq() for _ in range(max(n, 1))]
+        self.last_wave_seq = seqs[0]
+        span = current_span()
+        if span is not None:
+            cause = span_cause_id(span)
+        elif len(seqs) == 1:
+            cause = f"{_CAUSE_PREFIX}/wave#{seqs[0]}"
+        else:
+            cause = f"{_CAUSE_PREFIX}/wave#{seqs[0]}-{seqs[-1]}"
+        self.last_cause_id = cause
+        return cause, seqs
+
+    def _profile_wave(
+        self, kind, seeds, cause, t0, t1, newly, seq, groups=None,
+        fused_depth=None, seq_span=None, dispatches=None,
+    ) -> None:
         if self.profiler.enabled:
             self.profiler.record_wave(
                 kind,
@@ -260,13 +403,24 @@ class TpuGraphBackend:
                 cause=cause,
                 groups=groups,
                 seq=seq,
+                fused_depth=fused_depth,
+                seq_span=seq_span,
+                dispatches=dispatches,
             )
+            if fused_depth is not None and dispatches:
+                # per-dispatch depth samples feed the engagement histogram
+                per = max(int(round(fused_depth / dispatches)), 1)
+                for _ in range(int(dispatches)):
+                    self.profiler.note_fused_dispatch(per)
         if RECORDER.enabled:
+            detail = f"{kind}: seeds={seeds} newly={newly}"
+            if fused_depth is not None:
+                detail += f" fused_depth={fused_depth}"
             RECORDER.note(
                 "wave",
                 cause=cause,
                 wave=seq,
-                detail=f"{kind}: seeds={seeds} newly={newly}",
+                detail=detail,
             )
 
     # ------------------------------------------------------------------ event feed
@@ -733,6 +887,84 @@ class TpuGraphBackend:
         self._profile_wave("union", len(nids), cause, t0, t1, len(newly_ids), wave_seq)
         return total
 
+    def cascade_rows_lanes_refresh_chain(
+        self, block: RowBlock, bursts, nonblocking: bool = False
+    ):
+        """K consecutive rounds of (lane burst → columnar device refresh)
+        in ONE fused dispatch chain — the nonblocking live-loop composition
+        (ISSUE 7 tentpole): burst ``i`` cascades, the block's stale rows
+        recompute through the table's DEVICE loader, and burst ``i+1`` then
+        cascades against a consistent block, all device-side with zero host
+        round trips between rounds (before this, every round paid a relay
+        RTT per dispatch plus a serialized host apply).
+
+        ``bursts`` is a list of row-group lists; each burst's semantics are
+        exactly :meth:`cascade_rows_lanes` followed by
+        :meth:`refresh_block_on_device`. Per-logical-wave identity is kept:
+        each stage carries its own wave seq (recorder events during that
+        stage's host apply stamp it) and the profiler record spans the
+        chain with ``fused_depth``. Returns one int64 newly-count array per
+        burst — or, with ``nonblocking=True``, a ticket whose
+        ``harvest()`` returns them later: the chain is ENQUEUED and the
+        caller overlaps host work (churn prep, the previous chain's fence
+        fan-out) with its device execution. Until harvest, journal APPENDS
+        are safe but ``flush()`` and reads of the host invalid mirror are
+        not — harvest first. Requires a full-table bind with a device
+        loader and a fusible mirror (callers fall back to the sequential
+        pair)."""
+        self.flush()
+        table = block.table
+        fn = table.device_compute_fn
+        if fn is None:
+            raise TypeError(
+                "table has no device loader — declare "
+                "TableBacking(device_batch=...) or run the sequential "
+                "cascade_rows_lanes + table.refresh() pair"
+            )
+        if block.n_rows != table.n_rows:
+            raise ValueError(
+                "cascade_rows_lanes_refresh_chain requires a FULL table bind"
+            )
+        # one stage per burst chunk; stage→burst mapping folds counts back
+        stages: List[List[List[int]]] = []
+        stage_burst: List[int] = []
+        for bi, groups in enumerate(bursts):
+            seed_lists = [
+                (block.base + self._check_rows(block, g)).tolist()
+                for g in groups
+            ]
+            for c0 in range(0, max(len(seed_lists), 1), self._LANES_CHUNK):
+                stages.append(seed_lists[c0 : c0 + self._LANES_CHUNK])
+                stage_burst.append(bi)
+        update_valid = not table._valid_dev_dirty
+        loader_args = (
+            tuple(table.device_loader_args())
+            if table.device_loader_args is not None
+            else ()
+        )
+        refresh = {
+            "base": block.base,
+            "n_rows": block.n_rows,
+            "fn": fn,
+            "largs": loader_args,
+            "values": table._values,
+            "valid_dev": table.valid_mask if update_valid else table._valid_dev,
+            "update_valid": update_valid,
+            "cache": block._dev_refresh,
+        }
+        dg = self.graph
+        pre_block_invalid = dg._h_invalid[block.base : block.end()].copy()
+        cause, seqs = self._begin_wave_span(len(stages))
+        t0 = time.perf_counter()
+        pending = dg.dispatch_waves_lanes_chain(stages, refresh=refresh)
+        ticket = _RefreshChainTicket(
+            self, block, len(bursts), stage_burst, stages, refresh, pending,
+            cause, seqs, pre_block_invalid, t0, update_valid,
+        )
+        if nonblocking:
+            return ticket
+        return ticket.harvest()
+
     def refresh_block_on_device(self, block: RowBlock) -> int:
         """Recompute ALL stale rows of a bound table ON DEVICE, from the
         device-resident invalid state, through the table's DEVICE loader
@@ -810,18 +1042,10 @@ class TpuGraphBackend:
             return 0
         dg._h_invalid[block.base : block.end()] = False
         dg.invalid_version += 1
-        was_stale = table._stale_host & cleared
-        table._stale_count -= int(np.count_nonzero(was_stale))
-        table._stale_host &= ~cleared
-        table._bump()
-        # non-backend on_refresh subscribers still get the refreshed ids;
-        # the backend's own hook is skipped — its job (clearing the device
-        # invalid bits) is what this method just did in-program
-        extern = [h for h in table.on_refresh if not getattr(h, "_backend_hook", False)]
-        if extern:
-            ids_np = np.nonzero(cleared)[0].astype(np.int32)
-            for h in extern:
-                h(ids_np)
+        # non-backend on_refresh subscribers still get the refreshed ids
+        # inside the shared tail; the backend's own hook is skipped — its
+        # job (clearing the device invalid bits) was just done in-program
+        _finish_block_refresh_bookkeeping(table, cleared)
         return n_cleared
 
     def warm_block_on_device(self, block: RowBlock) -> int:
@@ -887,44 +1111,66 @@ class TpuGraphBackend:
         burst-of-independent-invalidations shape: M commands complete,
         each invalidating its own row set, one dispatch + one readback
         total via the lat mirror (host loop fallback otherwise). Returns
-        per-batch newly counts int64[M]."""
+        per-batch newly counts int64[M].
+
+        This IS the wave chain (ISSUE 7): M logical waves physically fused
+        — each keeps its own seq, the profiler record carries the span +
+        ``fused_depth=M``."""
         self.flush()
         seed_lists = [
             (block.base + self._check_rows(block, rows)).tolist()
             for rows in row_batches
         ]
-        cause, wave_seq = self._begin_wave()
+        cause, seqs = self._begin_wave_span(len(seed_lists))
+        lat_before = self.graph.lat_waves
         t0 = time.perf_counter()
         counts, union_ids = self._wave_union_seq(seed_lists)
         t1 = time.perf_counter()
         self._apply_newly(union_ids)
         self.waves_run += len(seed_lists)
         self.device_invalidations += int(counts.sum())
+        fused = self.graph.lat_waves > lat_before  # lat chain vs host loop
         self._profile_wave(
             "seq", sum(len(s) for s in seed_lists), cause, t0, t1,
-            int(counts.sum()), wave_seq, groups=len(seed_lists),
+            int(counts.sum()), seqs[0], groups=len(seed_lists),
+            fused_depth=len(seed_lists), seq_span=(seqs[0], seqs[-1]),
+            dispatches=1 if fused else len(seed_lists),
         )
         return counts
+
+    #: groups per lane chunk at the default word width (32 * max_words=16)
+    _LANES_CHUNK = 512
 
     def cascade_rows_lanes(self, block: RowBlock, row_groups) -> np.ndarray:
         """Lane-packed columnar burst: each row group cascades independently
         in its own bit lane (32 groups per packed word, one topo-mirror
         sweep per chunk) seeded DIRECTLY by table rows — no per-seed
-        Computed capture. Returns per-group newly counts."""
+        Computed capture. Multi-chunk bursts fuse into the loop-carried
+        chain (one dispatch per FUSE_CHAIN_MAX chunks — ISSUE 7). Returns
+        per-group newly counts."""
         self.flush()
         seed_lists = [
             (block.base + self._check_rows(block, g)).tolist() for g in row_groups
         ]
-        cause, wave_seq = self._begin_wave()
+        n_stages = max(-(-len(seed_lists) // self._LANES_CHUNK), 1)
+        cause, seqs = self._begin_wave_span(n_stages)
+        # cleared first: a watchdog-degraded burst runs the host loop and
+        # never touches it — stamping the PREVIOUS burst's fused identity
+        # on a host-loop wave would fake engagement during the exact
+        # regime the CI gate exists to expose
+        self.graph.last_lanes_info = None
         t0 = time.perf_counter()
         counts, union_ids = self._wave_lanes(seed_lists)
         t1 = time.perf_counter()
         self._apply_newly(union_ids)
         self.waves_run += len(seed_lists)
         self.device_invalidations += int(counts.sum())
+        info = self.graph.last_lanes_info or {}
         self._profile_wave(
             "lanes", sum(len(s) for s in seed_lists), cause, t0, t1,
-            int(counts.sum()), wave_seq, groups=len(seed_lists),
+            int(counts.sum()), seqs[0], groups=len(seed_lists),
+            fused_depth=info.get("depth"), seq_span=(seqs[0], seqs[-1]),
+            dispatches=info.get("dispatches"),
         )
         return counts
 
@@ -1009,16 +1255,21 @@ class TpuGraphBackend:
                 else:
                     ids.append(nid)
             seed_lists.append(ids)
-        cause, wave_seq = self._begin_wave()
+        n_stages = max(-(-len(seed_lists) // self._LANES_CHUNK), 1)
+        cause, seqs = self._begin_wave_span(n_stages)
+        self.graph.last_lanes_info = None  # see cascade_rows_lanes
         t0 = time.perf_counter()
         counts, union_ids = self._wave_lanes(seed_lists)
         t1 = time.perf_counter()
         self._apply_newly(union_ids)
         self.waves_run += len(groups)
         self.device_invalidations += int(counts.sum())
+        info = self.graph.last_lanes_info or {}
         self._profile_wave(
             "lanes", sum(len(s) for s in seed_lists), cause, t0, t1,
-            int(counts.sum()), wave_seq, groups=len(groups),
+            int(counts.sum()), seqs[0], groups=len(groups),
+            fused_depth=info.get("depth"), seq_span=(seqs[0], seqs[-1]),
+            dispatches=info.get("dispatches"),
         )
         return counts + fallback
 

@@ -106,6 +106,14 @@ def _fused_pair_scatter():
     return fused_pair_scatter()
 
 
+def _fused_quad_scatter():
+    """Shared double-mirror row scatter (ops/bitops): topo + lat patch
+    applications in ONE dispatch instead of two."""
+    from ..ops.bitops import fused_quad_scatter
+
+    return fused_quad_scatter()
+
+
 def _pack_mask_kernel():
     """Jitted bool→uint32 bit pack (overflow readbacks ship 1 bit/node
     through the relay); one shared definition in ops/bitops."""
@@ -162,6 +170,10 @@ class DeviceGraph:
         self.invalid_version = 0
         self.mirror_bursts = 0  # observability: bursts served by the mirror
         self.lat_waves = 0  # observability: unions served by the lat mirror
+        #: shape of the last lane-burst execution: {"depth": logical
+        #: stages, "dispatches": physical device dispatches} — the backend
+        #: reads it to stamp fused-depth identity on profiler records
+        self.last_lanes_info: Optional[dict] = None
         self.mirror_cache_hits = 0  # disk-cache loads (build_topo_mirror)
         self.mirror_cache_misses = 0  # full host builds with a cache root set
         # incremental topo-mirror maintenance (VERDICT r3 #1): structural
@@ -179,6 +191,11 @@ class DeviceGraph:
         self.mirror_patches = 0  # patch applications (batches, not deltas)
         self.mirror_rebuilds = 0  # full topo rebuilds
         self.mirror_patch_s = 0.0  # cumulative patch time
+        # patch-time breakdown (ISSUE 7 satellite: BENCH_r05 charged
+        # 1090.7 ms to "mirror_patch_ms" with no way to tell numpy
+        # bookkeeping from relay dispatches — record both halves)
+        self.mirror_patch_host_s = 0.0  # numpy slot/level bookkeeping
+        self.mirror_patch_device_s = 0.0  # device row-scatter dispatches
         # auxiliary structural-delta subscribers (the backend's MESH
         # mirrors, VERDICT r4 #4): each gets the same ordered delta stream
         # the topo mirror consumes; an overflowing or broken log marks
@@ -671,6 +688,7 @@ class DeviceGraph:
             m["validated_at"] = self._struct_version
             return True
         t0 = _time.perf_counter()
+        dev_s0 = self.mirror_patch_device_s
         h = m["h_in_src"]
         inv_perm = m["inv_perm"]
         n_tot = m["n_tot"]
@@ -765,14 +783,27 @@ class DeviceGraph:
                 h[rv, slot] = ru
                 changed_parts.append(rv)
                 mutated = True
-        if changed_parts:
+        t_dev0 = _time.perf_counter()
+        if changed_parts and lat is not None and lat_changed_parts:
+            # BOTH mirrors changed (the common churn shape: every added
+            # edge touches a topo in-row and a lat out-row): ONE fused
+            # dispatch — through the relay each dispatch costs ~a round
+            # trip, and the two scatters were nearly all of
+            # mirror_patch_ms (BENCH_r05: ~182 ms/patch for ~2k edges
+            # of host-side numpy)
+            self._scatter_mirror_and_lat_rows(
+                m, np.unique(np.concatenate(changed_parts)), n_tot,
+                lat, np.unique(np.concatenate(lat_changed_parts)),
+            )
+        elif changed_parts:
             self._scatter_mirror_rows(
                 m, np.unique(np.concatenate(changed_parts)), n_tot
             )
-        if lat is not None and lat_changed_parts:
+        elif lat is not None and lat_changed_parts:
             self._scatter_lat_rows(
                 lat, np.unique(np.concatenate(lat_changed_parts))
             )
+        self.mirror_patch_device_s += _time.perf_counter() - t_dev0
         if n_viol != int(m.get("n_viol", 0)):
             # pass counts ≤ FUSED_PASS_MAX each key one fused one-dispatch
             # program (compiled once per level layout, persisted — the
@@ -785,7 +816,13 @@ class DeviceGraph:
         m["validated_at"] = self._struct_version
         m["fp"] = None  # build-time fingerprint no longer describes the tables
         self.mirror_patches += 1
-        self.mirror_patch_s += _time.perf_counter() - t0
+        dt = _time.perf_counter() - t0
+        self.mirror_patch_s += dt
+        # host half = everything that was not the device scatter window
+        # (slot ranking, dedup, level checks — all numpy)
+        self.mirror_patch_host_s += max(
+            dt - (self.mirror_patch_device_s - dev_s0), 0.0
+        )
         return True
 
     @staticmethod
@@ -811,6 +848,28 @@ class DeviceGraph:
             jnp.asarray(new_rows), jnp.asarray(epoch_rows),
         )
         m["garrays"] = g._replace(in_src=in_src2, edge_epoch=epoch2)
+
+    def _scatter_mirror_and_lat_rows(
+        self, m, rows: np.ndarray, n_tot: int, lat: dict, lat_rows: np.ndarray
+    ) -> None:
+        """Both mirrors' patched rows in ONE device dispatch (see
+        ops/bitops.fused_quad_scatter) — identical per-table semantics to
+        :meth:`_scatter_mirror_rows` + :meth:`_scatter_lat_rows`."""
+        jnp = self._jnp
+        q = self._quantize_scatter_rows(rows, n_tot)
+        new_rows = m["h_in_src"][q]
+        epoch_rows = np.where(new_rows != n_tot, 0, -1).astype(np.int32)
+        ql = self._quantize_scatter_rows(lat_rows, lat["n_tot"])
+        g = m["garrays"]
+        in_src2, epoch2, ell_dst2, ell_epoch2 = _fused_quad_scatter()(
+            g.in_src, g.edge_epoch, jnp.asarray(q),
+            jnp.asarray(new_rows), jnp.asarray(epoch_rows),
+            lat["ell_dst"], lat["ell_epoch"], jnp.asarray(ql),
+            jnp.asarray(lat["h_ell_dst"][ql]),
+            jnp.asarray(lat["h_ell_epoch"][ql]),
+        )
+        m["garrays"] = g._replace(in_src=in_src2, edge_epoch=epoch2)
+        lat["ell_dst"], lat["ell_epoch"] = ell_dst2, ell_epoch2
 
     def _scatter_lat_rows(self, lat: dict, rows: np.ndarray) -> None:
         jnp = self._jnp
@@ -1510,6 +1569,251 @@ class DeviceGraph:
         count = int(count)
         return count, self._patch_host_invalid(count, out_ids, bool(overflow))
 
+    #: chain stages fused per dispatch (run_waves_lanes_chain): deep chains
+    #: split into this many stages per compiled scan — a bounded program
+    #: set (one per depth ≤ the cap) while still collapsing K dispatches
+    #: into ceil(K/8)
+    FUSE_CHAIN_MAX = 8
+
+    def dispatch_waves_lanes_chain(
+        self,
+        stage_groups: Sequence[Sequence[Sequence[int]]],
+        max_words: int = 16,
+        refresh: Optional[dict] = None,
+    ) -> dict:
+        """ENQUEUE ``depth`` consecutive lane bursts as
+        ``ceil(depth/FUSE_CHAIN_MAX)`` chained device dispatches WITHOUT
+        reading anything back — the nonblocking half of the wave chain
+        (ISSUE 7). The dispatches chain device-side through the carried
+        invalid array (jax enqueues them immediately), so the caller can
+        do host work — or enqueue the NEXT chain — while the device runs;
+        :meth:`harvest_waves_lanes_chain` blocks on the results and applies
+        them to the host mirror.
+
+        ``refresh`` folds a columnar device refresh into EVERY stage (the
+        churn-recompute composition the live loop runs): after a stage's
+        sweep, the block's invalid rows recompute through the table's
+        device loader and their invalid bits clear, so the next stage
+        cascades against a consistent block — K rounds of (burst →
+        refresh) in one dispatch. Keys:
+        ``{"base", "n_rows", "fn", "largs", "values", "valid_dev",
+        "update_valid", "cache"}`` (``cache`` holds the compiled chain
+        programs across calls — RowBlock._dev_refresh).
+
+        Requires a fusible mirror (valid, ``passes <= FUSED_PASS_MAX``);
+        raises RuntimeError otherwise — callers fall back to the split
+        per-burst path. Returns the pending-handles dict for harvest."""
+        from ..ops.pull_wave import pack_lane_matrix
+
+        jnp = self._jnp
+        m = self.build_topo_mirror()
+        if not self._mirror_valid():
+            raise RuntimeError("topo mirror unavailable — chain needs the fused path")
+        passes = m.get("passes", 1)
+        if passes > self.FUSED_PASS_MAX:
+            raise RuntimeError(
+                f"mirror carries {passes} sweep passes > FUSED_PASS_MAX — "
+                "chain fusion serves only the fused one-dispatch regime"
+            )
+        n_tot = m["n_tot"]
+        # common lane geometry for the whole chain (scan stages must share
+        # one shape): words covers the widest stage, width the widest group
+        words = 1
+        max_groups = max((len(s) for s in stage_groups), default=1)
+        while 32 * words < max_groups:
+            words <<= 1
+        if words > max_words:
+            raise ValueError(
+                f"a stage carries {max_groups} groups > 32*max_words="
+                f"{32 * max_words}; chunk stages before chaining"
+            )
+        width = 1
+        max_seeds = max(
+            (len(g) for s in stage_groups for g in s), default=1
+        )
+        while width < max_seeds:
+            width <<= 1
+        L = 32 * words
+
+        def pack_stage(stage, base_index):
+            mat, _w = pack_lane_matrix(
+                stage, pad_id=n_tot, n_valid=m["n_nodes"],
+                id_map=m["inv_perm"], base_index=base_index,
+            )
+            if mat.shape == (L, width):
+                return mat
+            out = np.full((L, width), n_tot, dtype=np.int32)
+            out[: mat.shape[0], : mat.shape[1]] = mat
+            return out
+
+        batches: list = []
+        group_base = 0
+        depth_cap = self.FUSE_CHAIN_MAX
+        for b0 in range(0, len(stage_groups), depth_cap):
+            batch = stage_groups[b0 : b0 + depth_cap]
+            parts = []
+            for s in batch:
+                parts.append(pack_stage(s, group_base))
+                group_base += len(s)
+            mats = np.stack(parts)
+            g = self.device_arrays()
+            if refresh is None:
+                from ..ops.topo_wave import topo_mirror_fused_lanes_chain_step
+
+                chain = topo_mirror_fused_lanes_chain_step(
+                    m["level_starts"], n_tot, words, passes, len(batch)
+                )
+                g_inv2, lane_counts_d, packed_d = chain(
+                    m["garrays"], m["node_epoch0"], m["perm_clipped"],
+                    g.invalid, jnp.asarray(mats),
+                )
+            else:
+                chain = self._refresh_chain_program(
+                    m, refresh, words, passes, len(batch)
+                )
+                (
+                    g_inv2, values2, valid2, lane_counts_d, packed_d,
+                ) = chain(
+                    refresh["values"], refresh["valid_dev"],
+                    m["garrays"], m["node_epoch0"], m["perm_clipped"],
+                    g.invalid, jnp.asarray(mats), *refresh["largs"],
+                )
+                # thread the table state into the next batch's dispatch
+                refresh["values"] = values2
+                refresh["valid_dev"] = valid2
+            # commit the device handle NOW so the next batch (or the next
+            # chain the caller enqueues) chains device-side
+            self._g = g._replace(invalid=g_inv2)
+            self.mirror_bursts += len(batch)
+            batches.append((lane_counts_d, packed_d, [len(s) for s in batch]))
+        self.last_lanes_info = {
+            "depth": len(stage_groups),
+            "dispatches": len(batches),
+        }
+        return {
+            "batches": batches,
+            "refresh": refresh,
+            "depth": len(stage_groups),
+            "dispatches": len(batches),
+        }
+
+    def _refresh_chain_program(self, m, refresh: dict, words: int, passes: int, depth: int):
+        """Build (or reuse) the jitted burst→refresh chain for one block:
+        per stage, the lane sweep's result state feeds the block's device
+        loader (stale rows recompute in-program) and the block's invalid
+        bits clear — the loop-carried composition of
+        ``run_waves_lanes`` + ``refresh_block_on_device``. Cached in the
+        caller-owned ``refresh["cache"]`` dict keyed on everything that
+        shapes the program (level layout included: a re-level must never
+        serve a stale chain)."""
+        key = (
+            "lanes_refresh_chain", words, passes, depth,
+            refresh["update_valid"], m["n_tot"], m["level_starts"],
+            refresh["base"], refresh["n_rows"],
+        )
+        cache = refresh["cache"]
+        prog = cache.get(key)
+        if prog is not None:
+            return prog
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops.bitops import pack_bool_bits
+        from ..ops.topo_wave import _lanes_stage_body
+
+        level_starts = m["level_starts"]
+        n_tot = m["n_tot"]
+        W = words
+        base, n_rows = refresh["base"], refresh["n_rows"]
+        fn = refresh["fn"]
+        update_valid = refresh["update_valid"]
+
+        @jax.jit
+        def chain(values, valid_dev, garrays, node_epoch0, perm_clipped,
+                  g_invalid, seed_mats, *largs):
+            def stage(carry, seed_new_ids):
+                g_inv, values, valid_dev = carry
+                g_inv2, lane_counts, newly_dense = _lanes_stage_body(
+                    level_starts, n_tot, W, passes,
+                    garrays, node_epoch0, perm_clipped, g_inv, seed_new_ids,
+                )
+                stale = lax.slice_in_dim(g_inv2, base, base + n_rows)
+                ids = jnp.arange(n_rows, dtype=jnp.int32)
+                fresh = fn(ids, *largs)
+                mask = stale.reshape((n_rows,) + (1,) * (values.ndim - 1))
+                values2 = jnp.where(mask, fresh, values)
+                inv3 = lax.dynamic_update_slice_in_dim(
+                    g_inv2,
+                    jnp.zeros(n_rows, dtype=g_inv2.dtype), base, 0,
+                )
+                valid2 = (valid_dev | stale) if update_valid else valid_dev
+                return (inv3, values2, valid2), (
+                    lane_counts, pack_bool_bits(newly_dense)
+                )
+
+            (inv_f, values_f, valid_f), (lane_counts, packed) = lax.scan(
+                stage, (g_invalid, values, valid_dev), seed_mats
+            )
+            return inv_f, values_f, valid_f, lane_counts, packed
+
+        cache[key] = chain
+        return chain
+
+    def harvest_waves_lanes_chain(self, pending: dict) -> Tuple[list, list]:
+        """Block on a :meth:`dispatch_waves_lanes_chain` ticket and fold the
+        results into the host mirror. Returns ``(stage_counts,
+        stage_masks)``: per-stage int64 newly counts and per-stage dense
+        newly BOOL masks over node ids (the mask a stage's fence fan-out
+        drains). For a refresh chain the block's rows read consistent
+        afterwards (host mirror cleared to match the device state)."""
+        import jax
+
+        stage_counts: list = []
+        stage_masks: list = []
+        any_newly = False
+        for lane_counts_d, packed_d, sizes in pending["batches"]:
+            lane_counts, packed = jax.device_get((lane_counts_d, packed_d))
+            for d, size in enumerate(sizes):
+                stage_counts.append(lane_counts[d, :size].astype(np.int64))
+                mask = np.unpackbits(
+                    packed[d].view(np.uint8),
+                    count=len(self._h_invalid),
+                    bitorder="little",
+                ).astype(bool)
+                stage_masks.append(mask)
+                if mask.any():
+                    any_newly = True
+                    self._h_invalid |= mask
+        refresh = pending["refresh"]
+        if refresh is not None:
+            # the device cleared the block's invalid bits at every stage;
+            # the host mirror catches up once, at the end state
+            base, n_rows = refresh["base"], refresh["n_rows"]
+            self._h_invalid[base : base + n_rows] = False
+            any_newly = True
+        if any_newly:
+            self.invalid_version += 1
+        return stage_counts, stage_masks
+
+    def run_waves_lanes_chain(
+        self,
+        stage_groups: Sequence[Sequence[Sequence[int]]],
+        max_words: int = 16,
+    ) -> Tuple[list, list]:
+        """``depth`` CONSECUTIVE lane bursts — stage ``i`` cascades against
+        the invalid state stages ``< i`` left — fused into
+        ``ceil(depth/FUSE_CHAIN_MAX)`` device dispatches via the loop-
+        carried ``lax.scan`` chain. Oracle-identical to calling
+        :meth:`run_waves_lanes` once per stage; the dispatch count is the
+        only difference. Dispatch + harvest in one call — the nonblocking
+        halves are :meth:`dispatch_waves_lanes_chain` /
+        :meth:`harvest_waves_lanes_chain` (what the WavePipeline overlaps).
+        """
+        return self.harvest_waves_lanes_chain(
+            self.dispatch_waves_lanes_chain(stage_groups, max_words=max_words)
+        )
+
     def run_waves_lanes(
         self, seed_id_lists: Sequence[Sequence[int]], max_words: int = 16
     ) -> Tuple[np.ndarray, np.ndarray]:
@@ -1526,6 +1830,12 @@ class DeviceGraph:
         rows, so the union travels and applies as a dense bitmask end to
         end (1 bit/node on the wire, vectorized mask ops on the host; the
         id materialization every burst was ~a third of r4's burst cost).
+
+        Multi-chunk bursts FUSE: the sequential chunk walk (each chunk one
+        dispatch + one readback) is replaced by the loop-carried chain —
+        same semantics, ``ceil(chunks/FUSE_CHAIN_MAX)`` dispatches
+        (ISSUE 7); a mirror needing the split multi-pass pipeline keeps the
+        per-chunk walk.
         """
         import jax
 
@@ -1544,6 +1854,22 @@ class DeviceGraph:
         union_mask = np.zeros(self.n_cap + 1, dtype=bool)
         any_newly = False
         chunk_size = 32 * max_words
+        if (
+            B > chunk_size
+            and self._mirror_valid()
+            and m.get("passes", 1) <= self.FUSED_PASS_MAX
+        ):
+            stages = [
+                seed_id_lists[c0 : c0 + chunk_size]
+                for c0 in range(0, B, chunk_size)
+            ]
+            stage_counts, stage_masks = self.run_waves_lanes_chain(
+                stages, max_words=max_words
+            )
+            counts = np.concatenate(stage_counts)
+            for mask in stage_masks:
+                union_mask |= mask
+            return counts, union_mask
         for c0 in range(0, B, chunk_size):
             chunk = seed_id_lists[c0 : c0 + chunk_size]
             mat, words = pack_lane_matrix(
@@ -1593,6 +1919,8 @@ class DeviceGraph:
                 union_mask |= newly
         if any_newly:
             self.invalid_version += 1
+        n_chunks = max(-(-B // chunk_size), 1)
+        self.last_lanes_info = {"depth": n_chunks, "dispatches": n_chunks}
         return counts, union_mask
 
     def run_wave_frontier(self, seed_frontier, sync_host: bool = False) -> int:

@@ -9,7 +9,12 @@ from __future__ import annotations
 
 import functools
 
-__all__ = ["fused_pair_scatter", "pack_bool_bits", "pack_bool_bits_jit"]
+__all__ = [
+    "fused_pair_scatter",
+    "fused_quad_scatter",
+    "pack_bool_bits",
+    "pack_bool_bits_jit",
+]
 
 
 def pack_bool_bits(mask):
@@ -43,5 +48,28 @@ def fused_pair_scatter():
     @jax.jit
     def scat(t1, t2, rows, v1, v2):
         return t1.at[rows].set(v1), t2.at[rows].set(v2)
+
+    return scat
+
+
+@functools.lru_cache(maxsize=1)
+def fused_quad_scatter():
+    """One jitted row scatter updating TWO paired-table mirrors at once
+    (topo in-rows + lat out-rows of a patch application): through a relay
+    every dispatch costs ~a round trip, and a churn patch touching both
+    mirrors paid two — the dominant share of ``mirror_patch_ms`` (BENCH_r05:
+    1090.7 ms for ~11k edges, nearly all of it dispatch, not numpy). The
+    row batches are independent scatters; fusing them is purely a dispatch-
+    count change."""
+    import jax
+
+    @jax.jit
+    def scat(a1, a2, rows_a, va1, va2, b1, b2, rows_b, vb1, vb2):
+        return (
+            a1.at[rows_a].set(va1),
+            a2.at[rows_a].set(va2),
+            b1.at[rows_b].set(vb1),
+            b2.at[rows_b].set(vb2),
+        )
 
     return scat

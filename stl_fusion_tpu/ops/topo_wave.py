@@ -51,6 +51,7 @@ __all__ = [
     "topo_mirror_finish_step",
     "topo_mirror_fused_union_step",
     "topo_mirror_fused_lanes_step",
+    "topo_mirror_fused_lanes_chain_step",
     "topo_mirror_gate_lanes_step",
     "topo_mirror_finish_lanes_step",
     "run_topo_sweep_passes",
@@ -508,53 +509,109 @@ def topo_mirror_fused_lanes_step(
     import jax.numpy as jnp
 
     W = words
-    L = 32 * W
 
     @jax.jit
     def burst(garrays: TopoGraphArrays, node_epoch0, perm_clipped, g_invalid, seed_new_ids):
-        is_real = garrays.is_real
-        blocked = (
-            jnp.where(is_real, g_invalid[perm_clipped], False)
-            .astype(jnp.int32)
-            .at[n_tot]
-            .set(0)
+        g_invalid2, lane_counts, newly_dense = _lanes_stage_body(
+            level_starts, n_tot, W, passes,
+            garrays, node_epoch0, perm_clipped, g_invalid, seed_new_ids,
         )
-        node_epoch = jnp.where(blocked.astype(bool), -3, node_epoch0)
-        lanes = jnp.arange(L, dtype=jnp.int32)
-        word_of = lanes // 32
-        bit_of = jnp.left_shift(jnp.int32(1), lanes % 32)
-        flat = seed_new_ids * W + word_of[:, None]
-        vals = jnp.broadcast_to(bit_of[:, None], seed_new_ids.shape)
-        seed_bits = (
-            jnp.zeros((n_tot + 1) * W, jnp.int32)
-            .at[flat.ravel()]
-            .add(vals.ravel())
-            .reshape(n_tot + 1, W)
-            .at[n_tot]
-            .set(0)
-        )
-        state = TopoState(node_epoch, jnp.zeros((n_tot + 1, W), dtype=jnp.int32))
-        sb = seed_bits
-        for _ in range(passes):
-            state, _ = _topo_sweep_impl(level_starts, garrays, sb, state, 0)
-            sb = jnp.zeros_like(seed_bits)  # only the first pass seeds
-        newly_bits = jnp.where(
-            is_real[:, None] & ~g_invalid[perm_clipped][:, None],
-            state.invalid_bits, 0,
-        )
-        lane_counts = _lane_counts_blocked(newly_bits, W)
-        union = (newly_bits != 0).any(axis=1)
-        union_count = union.sum(dtype=jnp.int32)
-        oob = g_invalid.shape[0]
-        newly_dense = (
-            jnp.zeros_like(g_invalid)
-            .at[jnp.where(union, perm_clipped, oob)]
-            .set(True, mode="drop")
-        )
-        g_invalid2 = g_invalid | newly_dense
+        union_count = newly_dense.sum(dtype=jnp.int32)
         return g_invalid2, lane_counts, union_count, _pack_bool_bits(newly_dense)
 
     return burst
+
+
+def _lanes_stage_body(
+    level_starts, n_tot: int, W: int, passes: int,
+    garrays: TopoGraphArrays, node_epoch0, perm_clipped, g_invalid, seed_new_ids,
+):
+    """One lane-burst stage against ``g_invalid`` (the shared body of the
+    single-burst program and the chained scan below): gate → sweep×passes →
+    newly accounting. Returns (g_invalid2, lane_counts, newly_dense)."""
+    import jax.numpy as jnp
+
+    L = 32 * W
+    is_real = garrays.is_real
+    blocked = (
+        jnp.where(is_real, g_invalid[perm_clipped], False)
+        .astype(jnp.int32)
+        .at[n_tot]
+        .set(0)
+    )
+    node_epoch = jnp.where(blocked.astype(bool), -3, node_epoch0)
+    lanes = jnp.arange(L, dtype=jnp.int32)
+    word_of = lanes // 32
+    bit_of = jnp.left_shift(jnp.int32(1), lanes % 32)
+    flat = seed_new_ids * W + word_of[:, None]
+    vals = jnp.broadcast_to(bit_of[:, None], seed_new_ids.shape)
+    seed_bits = (
+        jnp.zeros((n_tot + 1) * W, jnp.int32)
+        .at[flat.ravel()]
+        .add(vals.ravel())
+        .reshape(n_tot + 1, W)
+        .at[n_tot]
+        .set(0)
+    )
+    state = TopoState(node_epoch, jnp.zeros((n_tot + 1, W), dtype=jnp.int32))
+    sb = seed_bits
+    for _ in range(passes):
+        state, _ = _topo_sweep_impl(level_starts, garrays, sb, state, 0)
+        sb = jnp.zeros_like(seed_bits)  # only the first pass seeds
+    newly_bits = jnp.where(
+        is_real[:, None] & ~g_invalid[perm_clipped][:, None],
+        state.invalid_bits, 0,
+    )
+    lane_counts = _lane_counts_blocked(newly_bits, W)
+    union = (newly_bits != 0).any(axis=1)
+    oob = g_invalid.shape[0]
+    newly_dense = (
+        jnp.zeros_like(g_invalid)
+        .at[jnp.where(union, perm_clipped, oob)]
+        .set(True, mode="drop")
+    )
+    return g_invalid | newly_dense, lane_counts, newly_dense
+
+
+@functools.lru_cache(maxsize=8)
+def topo_mirror_fused_lanes_chain_step(
+    level_starts: Tuple[int, ...], n_tot: int, words: int, passes: int,
+    depth: int,
+):
+    """``depth`` consecutive lane bursts in ONE dispatch — the loop-carried-
+    dependence composition of the wave chain (PAPERS.md "Julia GraphBLAS
+    with Nonblocking Execution"): a ``lax.scan`` carries the dense invalid
+    state from stage to stage, so stage ``i`` sees exactly the state stages
+    ``< i`` left, with NO host round trip between them. Semantics per stage
+    = :func:`topo_mirror_fused_lanes_step` (groups within a stage are
+    snapshot-independent; stages apply sequentially) — a fused chain of K
+    stages is oracle-identical to K sequential burst dispatches.
+
+    Takes ``seed_mats`` int32[depth, 32*words, S] (NEW-id seed rows, padded
+    with ``n_tot``) and returns ``(g_invalid2, lane_counts
+    int32[depth, 32*words], packed_stages uint32[depth, ceil(dense/32)])``
+    — per-STAGE newly masks, so the host can apply (and fence) each
+    logical wave under its own identity while the next chain runs."""
+    import jax
+    from jax import lax
+
+    W = words
+
+    @jax.jit
+    def chain(garrays: TopoGraphArrays, node_epoch0, perm_clipped, g_invalid, seed_mats):
+        def stage(g_inv, seed_new_ids):
+            g_inv2, lane_counts, newly_dense = _lanes_stage_body(
+                level_starts, n_tot, W, passes,
+                garrays, node_epoch0, perm_clipped, g_inv, seed_new_ids,
+            )
+            return g_inv2, (lane_counts, _pack_bool_bits(newly_dense))
+
+        g_invalid2, (lane_counts, packed_stages) = lax.scan(
+            stage, g_invalid, seed_mats
+        )
+        return g_invalid2, lane_counts, packed_stages
+
+    return chain
 
 
 @functools.lru_cache(maxsize=8)

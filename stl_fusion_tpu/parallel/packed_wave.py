@@ -387,7 +387,13 @@ class PackedShardedGraph:
         ``DeviceGraph.run_waves_lanes``. Chunks of ≤``32*max_words`` groups
         per dispatch (later chunks see earlier chunks' union as blocked).
         Returns (per-group counts int64[B], union newly ids or None on
-        overflow, updated blocked mask, overflow flag)."""
+        overflow, updated blocked mask, overflow flag).
+
+        Chunk dispatches are SOFTWARE-PIPELINED (ISSUE 7: the mesh burst's
+        share of the nonblocking work): chunk ``c+1`` is enqueued — chained
+        device-side through the carried blocked mask — before chunk ``c``'s
+        results are read back, so the host-side unpack of one chunk
+        overlaps the next chunk's collective execution."""
         from ..ops.pull_wave import pack_lane_matrix
 
         B = len(seed_id_lists)
@@ -395,6 +401,18 @@ class PackedShardedGraph:
         union_parts: list = []
         any_overflow = False
         chunk_size = 32 * max_words
+        pending = None  # (device handles, chunk slice) awaiting readback
+
+        def harvest(p) -> None:
+            nonlocal any_overflow
+            handles, c0_h, n_h = p
+            lane_counts, count, ids, overflow = jax.device_get(handles)
+            counts[c0_h : c0_h + n_h] = lane_counts[:n_h].astype(np.int64)
+            if overflow:
+                any_overflow = True
+            else:
+                union_parts.append(ids[: int(count)])
+
         for c0 in range(0, B, chunk_size):
             chunk = seed_id_lists[c0 : c0 + chunk_size]
             mat, words = pack_lane_matrix(
@@ -410,14 +428,11 @@ class PackedShardedGraph:
                 jnp.asarray(mat), self.in_src, self.edge_epoch, self.node_epoch,
                 self.is_real, blocked,
             )
-            lane_counts, count, ids, overflow = jax.device_get(
-                (lane_counts, count, ids, overflow)
-            )
-            counts[c0 : c0 + len(chunk)] = lane_counts[: len(chunk)].astype(np.int64)
-            if overflow:
-                any_overflow = True
-            else:
-                union_parts.append(ids[: int(count)])
+            if pending is not None:
+                harvest(pending)
+            pending = ((lane_counts, count, ids, overflow), c0, len(chunk))
+        if pending is not None:
+            harvest(pending)
         union_ids = (
             None
             if any_overflow

@@ -52,6 +52,11 @@ class ComputeFanoutIndex:
         self.subscriptions = 0  # live entries
         self.registered_total = 0
         self.drained_total = 0  # subscriptions fenced via the mask path
+        #: fences drained INSIDE a WavePipeline overlap window — i.e. the
+        #: host shipped wave N-1's invalidations into per-peer outboxes
+        #: while wave N executed on device (ISSUE 7 stage c); zero means
+        #: the fan-out still serializes with device execution
+        self.drained_overlapped = 0
         self.waves_seen = 0
         self._disposed = False
 
@@ -144,6 +149,11 @@ class ComputeFanoutIndex:
             if newly_ids.size == 0:
                 return
             hits = nids[np.isin(nids, newly_ids)]
+        # entries batch PER PEER and post under one outbox kick each (the
+        # overlap drain shape: a wave's whole fence set for a peer is one
+        # wake-up, not one per subscription)
+        per_peer: Dict[int, Tuple[object, list]] = {}
+        total_posted = 0
         for nid in hits.tolist():
             subs = self._by_nid.pop(nid, None)
             if subs is None:
@@ -163,10 +173,12 @@ class ComputeFanoutIndex:
                         # computed invalidates host-side too) but must not
                         # ship this subscription a second time
                         call._invalidation_pushed = True
-                peer.outbox.post_invalidation(
-                    call_id, version, cause=cause, origin_ts=origin_ts
-                )
+                entry = per_peer.get(id(peer))
+                if entry is None:
+                    entry = per_peer[id(peer)] = (peer, [])
+                entry[1].append((call_id, version, cause, origin_ts))
                 posted += 1
+            total_posted += posted
             if posted and RECORDER.enabled:
                 # one event per fenced KEY (never per subscription), with
                 # the count of fences actually POSTED — dead peers skipped
@@ -179,12 +191,19 @@ class ComputeFanoutIndex:
                     count=posted,
                     detail=f"{posted} subscription(s) via mask drain",
                 )
+        for peer, entries in per_peer.values():
+            peer.outbox.post_invalidations(entries)
+        if total_posted and getattr(self.backend, "overlap_active", False):
+            # this drain ran inside a pipeline harvest with the next chain
+            # already executing on device — the ISSUE 7 overlap in action
+            self.drained_overlapped += total_posted
 
     def stats(self) -> dict:
         return {
             "subscriptions": self.subscriptions,
             "registered_total": self.registered_total,
             "drained_total": self.drained_total,
+            "drained_overlapped": self.drained_overlapped,
             "waves_seen": self.waves_seen,
         }
 

@@ -139,6 +139,30 @@ class PeerOutbox:
         self._pending_inval[call_id] = (version, cause, origin_ts)
         self._kick()
 
+    def post_invalidations(self, entries) -> None:
+        """Batch :meth:`post_invalidation`: ``entries`` is an iterable of
+        ``(call_id, version, cause, origin_ts)`` tuples, merged into the
+        pending map under ONE drain wake-up. The overlap drain
+        (rpc/fanout.py riding a WavePipeline harvest, ISSUE 7) ships a
+        whole wave's fences for a peer with one kick instead of one per
+        subscription — the kick marshals to the home loop, so per-call
+        kicks from the wave-apply thread were a measurable share of the
+        drain."""
+        if self._stopped:
+            self.pending_dropped += sum(1 for _ in entries)
+            return
+        posted = False
+        for call_id, version, cause, origin_ts in entries:
+            self.invalidations_posted += 1
+            if call_id in self._pending_inval:
+                self.invalidations_coalesced += 1
+            elif not self._pending_inval:
+                self._pending_since = time.perf_counter()
+            self._pending_inval[call_id] = (version, cause, origin_ts)
+            posted = True
+        if posted:
+            self._kick()
+
     def _kick(self) -> None:
         try:
             asyncio.get_running_loop()
