@@ -556,6 +556,33 @@ def run_cluster_section():
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def run_edge_section():
+    """Embedded edge-tier measurement (ISSUE 8): perf/edge_path.py as a
+    subprocess — FUSION_BENCH_EDGE_SESSIONS simulated end-user sessions
+    behind N edge gateways, each holding one upstream subscription per
+    distinct key, recording fence→client-visible p50/p99 and per-edge
+    memory. FUSION_BENCH_EDGE_SESSIONS=0 skips."""
+    import subprocess
+
+    sessions = int(os.environ.get("FUSION_BENCH_EDGE_SESSIONS", 1_000_000))
+    if sessions <= 0:
+        return None
+    env = dict(os.environ, EDGE_SESSIONS=str(sessions))
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "perf", "edge_path.py"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], env=env, stdout=subprocess.PIPE, text=True,
+            timeout=3600,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "edge path timed out"}
+    if proc.returncode != 0:
+        return {"error": f"edge path failed rc={proc.returncode} (stderr inherited above)"}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def main() -> None:
     import jax
 
@@ -590,6 +617,9 @@ def main() -> None:
     cluster = run_cluster_section()
     if cluster is not None:
         detail["cluster"] = cluster
+    edge = run_edge_section()
+    if edge is not None:
+        detail["edge"] = edge
     result = {
         "metric": "cascading_invalidations_per_sec",
         "value": round(inv_per_sec, 1),
@@ -604,7 +634,7 @@ def main() -> None:
     print("# full record: " + json.dumps(result), file=sys.stderr, flush=True)
     print(
         json.dumps(
-            _compact_result(inv_per_sec, detail, live, fanout, cluster),
+            _compact_result(inv_per_sec, detail, live, fanout, cluster, edge),
             separators=(",", ":"),
         )
     )
@@ -636,7 +666,9 @@ def _pos_ms(fields: dict) -> dict:
     return fields
 
 
-def _compact_result(inv_per_sec: float, detail: dict, live, fanout=None, cluster=None) -> dict:
+def _compact_result(
+    inv_per_sec: float, detail: dict, live, fanout=None, cluster=None, edge=None
+) -> dict:
     """The single stdout line: every headline metric, nothing that scales
     with run verbosity, target well under the driver's tail window."""
     out = {
@@ -756,6 +788,27 @@ def _compact_result(inv_per_sec: float, detail: dict, live, fanout=None, cluster
             "restore_replayed": cluster.get("restore_replayed"),
             "restore_fenced": cluster.get("restore_fenced"),
             "restore_violations": cluster.get("restore_violations"),
+        }
+    if edge is not None and "error" in edge:
+        out["edge"] = {"error": edge["error"]}
+    elif edge is not None:
+        # the edge tier (ISSUE 8): the first record where "millions of
+        # users" is a measured number — subscribers, fenced/s, the
+        # system's own fence→client-visible distribution, per-edge memory
+        out["edge"] = {
+            "subs": edge.get("subscribers"),
+            "edge_nodes": edge.get("edge_nodes"),
+            "distinct_keys": edge.get("distinct_keys"),
+            "upstream_subs_total": edge.get("upstream_subs_total"),
+            "fenced_per_s": _r(edge.get("fenced_per_s"), 0),
+            "fenced_total": edge.get("fenced_total"),
+            "fanout_s": _r(edge.get("fanout_s")),
+            "delivery_ms_p50": edge.get("delivery_ms_p50"),
+            "delivery_ms_p99": edge.get("delivery_ms_p99"),
+            "per_edge_rss_mb": edge.get("per_edge_rss_mb"),
+            "attach_sessions_per_s": _r(edge.get("attach_sessions_per_s"), 0),
+            "evictions": edge.get("evictions"),
+            "coalesced_frames": edge.get("coalesced_frames"),
         }
     # cold vs warm start (ISSUE 6): the rebuild bill a restart used to pay
     # (mirror build + program warm-up) beside what the durable path pays

@@ -1,0 +1,542 @@
+#!/usr/bin/env python
+"""Edge-tier benchmark: 1M simulated subscribers behind the live burst.
+
+ISSUE 8 acceptance: the first measurement where "millions of users" is a
+number, not a slogan. The stack under test, end to end:
+
+- **server**: the live-path stack (FusionHub + TpuGraphBackend + a
+  table-backed DAG service, columnar bulk ingest, topo mirror) driving
+  lane-packed bursts over EDGE_GRAPH_NODES rows — every fence leaves the
+  server as a coalesced ``$sys-c`` batch frame;
+- **edges**: EDGE_NODES in-process EdgeNode gateways, each on its own
+  RpcHub over a codec-faithful twisted channel pair, each holding EXACTLY
+  ONE upstream subscription per distinct key (asserted, and
+  metric-asserted in smoke mode);
+- **sessions**: EDGE_SESSIONS simulated end-user sessions spread over the
+  edges, each subscribed to EDGE_KEYS_PER_SESSION keys drawn zipf-style
+  from EDGE_KEYS distinct keys (popularity skew: the hottest key carries
+  a large share of the fan-out). Sessions are synchronous-sink
+  EdgeSessions — client-visible the moment the sink returns — because a
+  million pump tasks would measure the scheduler, not the fan-out.
+- **measurement**: per round the burst fences every distinct key; the
+  recorded numbers are when each session OBSERVED its frame. Reported:
+  ``fenced_per_s`` (session deliveries / post-burst fan-out seconds),
+  ``delivery_ms_p50/p99`` — fence (server wave apply) → client-visible —
+  read from the system's own ``fusion_edge_delivery_ms`` histogram
+  (checkpoint-diffed per round), and ``per_edge_rss_mb`` (resident-set
+  delta of building the edges + sessions, divided by EDGE_NODES).
+
+Hard asserts (the script FAILS on violation, so CI can run it as a gate):
+upstream subscriptions per edge == distinct keys (single-upstream
+coalescing engaged — not sessions×keys fan-in), zero evictions (no
+session stalled), every expected delivery arrived.
+
+EDGE_SMOKE=1 additionally boots a real EdgeHttpServer, attaches live SSE
+consumers over TCP, and asserts the `/metrics` exposition shows
+``fusion_edge_sessions``, a non-empty ``fusion_edge_delivery_ms``
+histogram and the upstream-subscription invariant — the tier1.yml step.
+
+Env: EDGE_GRAPH_NODES (default 2_000_000), EDGE_NODES (4), EDGE_SESSIONS
+(1_000_000), EDGE_KEYS (512), EDGE_KEYS_PER_SESSION (2), EDGE_ZIPF (1.1),
+EDGE_ROUNDS (2), EDGE_GROUPS (16), EDGE_SEEDS_PER_GROUP (2),
+EDGE_TIMEOUT_S (600), EDGE_WIRE (1), EDGE_SMOKE (0).
+
+Prints ONE JSON line (stdout); progress notes go to stderr.
+"""
+import asyncio
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def note(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+def _setup_jax_cache() -> None:
+    import jax
+
+    cache = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+    )
+    os.environ.setdefault(
+        "FUSION_MIRROR_CACHE", os.path.join(os.path.dirname(cache), ".fusion_mirror_cache")
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        note(f"compilation cache unavailable: {e}")
+
+
+from stl_fusion_tpu.client import install_compute_call_type  # noqa: E402
+from stl_fusion_tpu.core import (  # noqa: E402
+    ComputeService,
+    FusionHub,
+    TableBacking,
+    compute_method,
+    memo_table_of,
+    set_default_hub,
+)
+from stl_fusion_tpu.diagnostics import global_metrics  # noqa: E402
+from stl_fusion_tpu.edge import EdgeNode  # noqa: E402
+from stl_fusion_tpu.graph import TpuGraphBackend  # noqa: E402
+from stl_fusion_tpu.graph.synthetic import power_law_dag  # noqa: E402
+from stl_fusion_tpu.rpc import RpcHub, RpcTestTransport  # noqa: E402
+
+
+def make_dag_service(n: int):
+    class DagTable(ComputeService):
+        """The benchmark DAG as a table-backed service (fanout_path's
+        shape): row values derive from a base array; device loader serves
+        warms/refreshes."""
+
+        def __init__(self, hub=None):
+            super().__init__(hub)
+            self.base = np.arange(n, dtype=np.float32)
+            self._base_dev = None
+
+        def load(self, ids):
+            return self.base[np.asarray(ids, dtype=np.int64)]
+
+        def load_dev(self, ids, base_dev):
+            return base_dev[ids]
+
+        def load_dev_args(self):
+            if self._base_dev is None:
+                import jax.numpy as jnp
+
+                self._base_dev = jnp.asarray(self.base)
+            return (self._base_dev,)
+
+        @compute_method(
+            table=TableBacking(
+                rows=n, batch="load",
+                device_batch="load_dev", device_args="load_dev_args",
+            )
+        )
+        async def node(self, i: int) -> float:
+            return float(self.base[i])
+
+    return DagTable
+
+
+class Observer:
+    """Counts fence deliveries across ALL sessions (one shared sink per
+    edge — a million per-session closures would be pure overhead)."""
+
+    def __init__(self):
+        self.fenced = 0
+        self.expected = 0
+        self.event = asyncio.Event()
+
+    def arm(self, expected: int) -> None:
+        self.fenced = 0
+        self.expected = expected
+        self.event.clear()
+
+    def sink(self, frame) -> None:
+        # fence frames carry the wave-apply origin timestamp; initial
+        # attach frames do not and stay uncounted
+        if frame[4] is not None:
+            self.fenced += 1
+            if self.fenced >= self.expected:
+                self.event.set()
+
+
+def rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+def zipf_weights(n: int, a: float) -> np.ndarray:
+    ranks = np.arange(1, n + 1, dtype=np.float64)
+    w = 1.0 / ranks**a
+    return w / w.sum()
+
+
+async def settle(seconds: float = 0.05) -> None:
+    deadline = time.perf_counter() + seconds
+    while time.perf_counter() < deadline:
+        await asyncio.sleep(0.005)
+
+
+async def until(pred, timeout_s: float, what: str) -> None:
+    deadline = time.perf_counter() + timeout_s
+    while not pred():
+        if time.perf_counter() > deadline:
+            raise SystemExit(f"EDGE PATH FAILED: timed out waiting for {what}")
+        await asyncio.sleep(0.01)
+
+
+def require(cond: bool, what: str) -> None:
+    if not cond:
+        raise SystemExit(f"EDGE PATH FAILED: {what}")
+
+
+class Edge:
+    """One in-process edge gateway: own fusion graph + RpcHub + transport
+    (codec-faithful) + EdgeNode + shared delivery observer."""
+
+    def __init__(self, i: int, server_rpc: RpcHub, wire_codec: bool):
+        self.i = i
+        self.fusion = FusionHub()
+        self.rpc = RpcHub(f"edge-{i}")
+        install_compute_call_type(self.rpc)
+        self.transport = RpcTestTransport(
+            self.rpc, server_rpc, wire_codec=wire_codec, client_name=f"e{i}"
+        )
+        self.node = EdgeNode("dag", self.rpc, self.fusion, name=f"edge-{i}")
+        self.observer = Observer()
+
+
+async def main() -> None:
+    _setup_jax_cache()
+    n = int(os.environ.get("EDGE_GRAPH_NODES", 2_000_000))
+    n_edges = int(os.environ.get("EDGE_NODES", 4))
+    n_sessions = int(os.environ.get("EDGE_SESSIONS", 1_000_000))
+    n_keys = int(os.environ.get("EDGE_KEYS", 512))
+    keys_per_session = int(os.environ.get("EDGE_KEYS_PER_SESSION", 2))
+    zipf_a = float(os.environ.get("EDGE_ZIPF", 1.1))
+    rounds = int(os.environ.get("EDGE_ROUNDS", 2))
+    n_groups = int(os.environ.get("EDGE_GROUPS", 16))
+    seeds_per_group = int(os.environ.get("EDGE_SEEDS_PER_GROUP", 2))
+    timeout_s = float(os.environ.get("EDGE_TIMEOUT_S", 600))
+    wire_codec = os.environ.get("EDGE_WIRE", "1") == "1"
+    smoke = os.environ.get("EDGE_SMOKE", "0") == "1"
+    rng = np.random.default_rng(523)
+
+    note(f"generating {n}-node power-law DAG...")
+    src, dst = power_law_dag(n, avg_degree=3, seed=7)
+
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    try:
+        backend = TpuGraphBackend(
+            hub, node_capacity=n + 64,
+            edge_capacity=len(src) + max(65536, 8 * n_edges * n_keys * (rounds + 2)),
+        )
+        Dag = make_dag_service(n)
+        svc = Dag(hub)
+        hub.add_service(svc, "dag")
+        table = memo_table_of(svc.node)
+
+        note("columnar build + device warm...")
+        t0 = time.perf_counter()
+        block = backend.bind_table_rows(table)
+        backend.declare_row_edges(block, src, block, dst)
+        backend.warm_block_on_device(block)
+        backend.flush()
+        build_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        backend.graph.build_topo_mirror()
+        mirror_s = time.perf_counter() - t0
+        note(f"built in {build_s:.1f}s, mirror in {mirror_s:.1f}s")
+
+        server_rpc = RpcHub("server")
+        install_compute_call_type(server_rpc)
+        server_rpc.add_service("dag", svc)
+        from stl_fusion_tpu.rpc import install_compute_fanout
+
+        fanout_index = install_compute_fanout(server_rpc, backend)
+
+        # distinct keys: tail rows (shallow own-closures; the deep seeds
+        # below give the wave its full-scale walk)
+        key_rows = np.sort(
+            n - 1 - rng.choice(n // 4, size=n_keys, replace=False)
+        )
+        key_specs = [("node", int(r)) for r in key_rows]
+
+        # burst groups: every subscribed row round-robined across groups,
+        # plus deep random seeds for the full-graph closure
+        groups = [list() for _ in range(n_groups)]
+        for j, r in enumerate(key_rows.tolist()):
+            groups[j % n_groups].append(int(r))
+        deep = rng.choice(n // 10, size=(n_groups, seeds_per_group), replace=False)
+        for gi in range(n_groups):
+            groups[gi].extend(int(s) for s in deep[gi])
+
+        note("warming lane + refresh programs (untimed)...")
+        t0 = time.perf_counter()
+        backend.cascade_rows_lanes(block, groups)
+        backend.refresh_block_on_device(block)
+        backend.flush()
+        note(f"programs warm ({time.perf_counter() - t0:.1f}s)")
+
+        # ---------------------------------------------------------- edges
+        rss_before = rss_mb()
+        edges = [Edge(i, server_rpc, wire_codec) for i in range(n_edges)]
+        note(f"subscribing {n_edges} edges × {n_keys} keys upstream...")
+        t0 = time.perf_counter()
+        # prime every edge's upstream subs by attaching one probe session
+        # per edge over ALL keys (sessions proper ride the same subs)
+        for e in edges:
+            e.node.attach(key_specs, sink=e.observer.sink, track_versions=False)
+        for e in edges:
+            await until(
+                lambda e=e: len(e.node._subs) == n_keys
+                and all(s.version >= 1 for s in e.node._subs.values()),
+                timeout_s, f"edge {e.i} upstream warm",
+            )
+        subscribe_s = time.perf_counter() - t0
+
+        note(f"attaching {n_sessions} sessions (zipf a={zipf_a} over {n_keys} keys)...")
+        t0 = time.perf_counter()
+        weights = zipf_weights(n_keys, zipf_a)
+        per_edge = n_sessions // n_edges
+        for e in edges:
+            picks = rng.choice(n_keys, size=(per_edge, keys_per_session), p=weights)
+            sink = e.observer.sink
+            attach = e.node.attach
+            for row in picks:
+                specs = [key_specs[k] for k in set(row.tolist())]
+                attach(specs, sink=sink, track_versions=False, replay_current=False)
+        attach_s = time.perf_counter() - t0
+        rss_after = rss_mb()
+        per_edge_rss_mb = (rss_after - rss_before) / n_edges
+        total_sessions = sum(len(e.node._sessions) for e in edges)
+        expected_per_round = sum(
+            len(sub.sessions) for e in edges for sub in e.node._subs.values()
+        )
+        note(
+            f"attached in {attach_s:.1f}s; {total_sessions} sessions, "
+            f"{expected_per_round} subscriptions, "
+            f"{per_edge_rss_mb:.0f} MB/edge"
+        )
+
+        # ------------------------------------------------- invariant: ONE
+        # upstream subscription per distinct key per edge, and the server
+        # sees exactly edges×keys subscriptions — not sessions×keys
+        for e in edges:
+            require(
+                len(e.node._subs) == n_keys,
+                f"edge {e.i} holds {len(e.node._subs)} upstream subs, want {n_keys}",
+            )
+        await until(
+            lambda: fanout_index.subscriptions == n_edges * n_keys,
+            timeout_s, "server-side subscription registration",
+        )
+
+        # ---------------------------------------------------------- rounds
+        hist = global_metrics().histogram(
+            "fusion_edge_delivery_ms",
+            help="server fence (wave apply) -> edge session client-visible",
+        )
+        fanout_s = 0.0
+        burst_s = 0.0
+        round_deliveries = 0
+        delivery: dict = {}
+        for rnd in range(rounds):
+            # all upstream subs re-registered (the previous round's fences
+            # unindexed them until each edge's re-read landed)
+            await until(
+                lambda: fanout_index.subscriptions == n_edges * n_keys,
+                timeout_s, f"round {rnd} re-subscription",
+            )
+            backend.flush()
+            for e in edges:
+                e.observer.arm(
+                    sum(len(sub.sessions) for sub in e.node._subs.values())
+                )
+            cp = hist.checkpoint()
+            t0 = time.perf_counter()
+            counts = backend.cascade_rows_lanes(block, groups)
+            t_burst = time.perf_counter()
+            await asyncio.wait_for(
+                asyncio.gather(*(e.observer.event.wait() for e in edges)),
+                timeout_s,
+            )
+            t_all = time.perf_counter()
+            burst_s += t_burst - t0
+            fanout_s += t_all - t_burst
+            round_deliveries += sum(e.observer.fenced for e in edges)
+            delivery = hist.since(cp)  # last round's distribution
+            note(
+                f"round {rnd}: burst {t_burst - t0:.2f}s "
+                f"({int(counts.sum()):,} inv), fan-out {t_all - t_burst:.2f}s "
+                f"({sum(e.observer.fenced for e in edges):,} deliveries), "
+                f"delivery p50/p99 {delivery['p50']}/{delivery['p99']} ms"
+            )
+            backend.refresh_block_on_device(block)
+            backend.flush()
+            await settle()
+
+        evictions = sum(e.node.evictions for e in edges)
+        require(evictions == 0, f"{evictions} sessions were evicted mid-run")
+        require(
+            round_deliveries == expected_per_round * rounds,
+            f"deliveries {round_deliveries} != expected {expected_per_round * rounds}",
+        )
+
+        smoke_result = None
+        if smoke:
+            smoke_result = await run_smoke(
+                edges[0], n_edges * n_keys, fanout_index, backend, block, groups,
+                timeout_s,
+            )
+
+        result = {
+            "metric": "edge_path",
+            "graph_nodes": n,
+            "edges_graph": int(backend.edge_count),
+            "edge_nodes": n_edges,
+            "subscribers": total_sessions,
+            "sessions_per_edge": per_edge,
+            "distinct_keys": n_keys,
+            "keys_per_session": keys_per_session,
+            "zipf_a": zipf_a,
+            "subscriptions": expected_per_round,
+            "upstream_subs_per_edge": n_keys,
+            "upstream_subs_total": n_edges * n_keys,
+            "rounds": rounds,
+            "wire_codec": wire_codec,
+            "build_s": round(build_s, 2),
+            "mirror_build_s": round(mirror_s, 2),
+            "subscribe_s": round(subscribe_s, 2),
+            "attach_s": round(attach_s, 2),
+            "attach_sessions_per_s": round(total_sessions / attach_s, 0) if attach_s else None,
+            "burst_s": round(burst_s, 3),
+            "fanout_s": round(fanout_s, 3),
+            "fenced_total": round_deliveries,
+            "fenced_per_s": round(round_deliveries / fanout_s, 1) if fanout_s else None,
+            # the system's own fence→client-visible histogram (last round)
+            "delivery_ms_p50": delivery.get("p50"),
+            "delivery_ms_p99": delivery.get("p99"),
+            "system_delivery_ms": delivery,
+            "per_edge_rss_mb": round(per_edge_rss_mb, 1),
+            "evictions": evictions,
+            "coalesced_frames": sum(e.node.coalesced_frames for e in edges),
+        }
+        if smoke_result is not None:
+            result["smoke"] = smoke_result
+        print(json.dumps(result))
+        note("done")
+        for e in edges:
+            await e.node.close()
+            await e.rpc.stop()
+        await server_rpc.stop()
+    finally:
+        set_default_hub(old)
+
+
+async def run_smoke(
+    edge: "Edge", expected_upstream_total: int, fanout_index, backend, block,
+    groups, timeout_s: float,
+) -> dict:
+    """EDGE_SMOKE=1 (tier1.yml): boot a REAL EdgeHttpServer on the first
+    edge, attach live SSE consumers over TCP, burst once, and assert the
+    `/metrics` exposition shows the tier working: fusion_edge_sessions,
+    a non-empty delivery histogram, and upstream subscriptions == distinct
+    keys (coalescing actually engaged, not N× fan-in)."""
+    import urllib.parse
+
+    from stl_fusion_tpu.edge import EdgeHttpServer
+
+    node = edge.node
+    http = await EdgeHttpServer(node, heartbeat_interval=5.0).start()
+    note(f"smoke: SSE server at {http.url}")
+    key_specs = [
+        (sub.method, *sub.args) for sub in list(node._subs.values())[:2]
+    ]
+    keys_q = urllib.parse.quote(json.dumps([list(k) for k in key_specs]))
+    readers = []
+    for _ in range(2):
+        reader, writer = await asyncio.open_connection(http.host, http.port)
+        writer.write(
+            f"GET /edge/sse?keys={keys_q} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        )
+        await writer.drain()
+        while True:
+            line = (await asyncio.wait_for(reader.readline(), 30.0)).decode()
+            require(line != "", "smoke: SSE connection closed during headers")
+            if line in ("\r\n", "\n"):
+                break
+        readers.append((reader, writer))
+
+    async def read_event(reader):
+        fields = {}
+        while True:
+            line = (await asyncio.wait_for(reader.readline(), 30.0)).decode()
+            require(line != "", "smoke: SSE stream closed early")
+            if line in ("\n", "\r\n"):
+                if fields:
+                    return fields
+                continue
+            if line.startswith(":"):
+                continue
+            name, _, value = line.rstrip("\n").partition(":")
+            fields[name] = value.strip()
+
+    for reader, _w in readers:
+        hello = await read_event(reader)
+        require(hello.get("event") == "hello", f"smoke: bad hello {hello}")
+        for _ in key_specs:
+            ev = await read_event(reader)  # initial values
+            require(ev.get("event") == "update", f"smoke: bad initial {ev}")
+
+    # the measured rounds' fences unindexed every subscription until each
+    # edge's re-read landed: wait for full re-registration (the round
+    # loop's own guard) or the smoke burst can miss a still-unindexed key
+    await until(
+        lambda: fanout_index.subscriptions == expected_upstream_total,
+        timeout_s, "smoke re-subscription",
+    )
+    backend.flush()
+    backend.cascade_rows_lanes(block, groups)
+    seen = []
+    for reader, _w in readers:
+        ev = await read_event(reader)
+        require(ev.get("event") == "update", f"smoke: bad update {ev}")
+        seen.append(json.loads(ev["data"]))
+    require(all("t0" in d for d in seen), "smoke: frames lost the origin timestamp")
+
+    # scrape /metrics over real HTTP and assert the exposition
+    reader, writer = await asyncio.open_connection(http.host, http.port)
+    writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+    await writer.drain()
+    raw = await asyncio.wait_for(reader.read(), 30.0)
+    writer.close()
+    text = raw.decode("utf-8", "replace")
+    metrics = {}
+    for line in text.splitlines():
+        if line.startswith("fusion_edge_"):
+            name, _, value = line.partition(" ")
+            try:
+                metrics[name] = float(value)
+            except ValueError:
+                pass
+    sessions = metrics.get("fusion_edge_sessions", 0)
+    subs = metrics.get("fusion_edge_upstream_subscriptions", 0)
+    require(sessions >= 1, f"smoke: fusion_edge_sessions missing ({metrics})")
+    require(
+        metrics.get("fusion_edge_delivery_ms_count", 0) > 0,
+        "smoke: edge delivery histogram is empty",
+    )
+    # all edges in this process export into one registry: the scrape's
+    # total must equal edges × distinct keys — never sessions × keys
+    require(
+        subs == expected_upstream_total,
+        f"smoke: upstream subscriptions {subs} != distinct-key total "
+        f"{expected_upstream_total} — coalescing not engaged",
+    )
+    for _r, w in readers:
+        w.close()
+    await http.stop()
+    return {
+        "sse_consumers": len(readers),
+        "metrics_sessions": sessions,
+        "metrics_upstream_subs": subs,
+        "delivery_count": metrics.get("fusion_edge_delivery_ms_count"),
+    }
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -130,6 +130,15 @@ class ClientComputed(Computed):
         call_cause = self.call.invalidation_cause if self.call is not None else None
         return call_cause or self._invalidation_cause
 
+    @property
+    def invalidation_origin_ts(self):
+        """Server-side wave-apply timestamp the fence carried (perf_counter
+        epoch, same-host trust caveat as ``fusion_e2e_delivery_ms``) —
+        what lets the edge tier (ISSUE 8) measure fence → edge → session
+        delivery end to end; None while consistent, for cache-only nodes,
+        or when the server predates timestamp stamping."""
+        return self.call.invalidation_origin_ts if self.call is not None else None
+
     # -- cache synchronization gate ---------------------------------------
     @property
     def is_synchronized(self) -> bool:

@@ -83,6 +83,11 @@ class RpcOutboundComputeCall(RpcOutboundCall):
         #: this call (ISSUE 3 trace propagation); None until invalidated or
         #: when the server predates cause stamping
         self.invalidation_cause: Optional[str] = None
+        #: server-side wave-apply timestamp the fence carried (perf_counter
+        #: epoch — trustworthy same-host only, like the delivery histogram).
+        #: Kept so a DOWNSTREAM tier (the edge gateway, ISSUE 8) can extend
+        #: the delivery measurement one more hop: fence → edge → session.
+        self.invalidation_origin_ts: Optional[float] = None
         self.when_invalidated: asyncio.Future = asyncio.get_event_loop().create_future()
         #: sync callbacks run INSIDE set_invalidated — the bound
         #: ClientComputed invalidates in the same dispatch that applied the
@@ -153,6 +158,7 @@ class RpcOutboundComputeCall(RpcOutboundCall):
                 detail=f"call#{self.call_id} peer={getattr(self.peer, 'ref', '?')}",
             )
         if origin_ts is not None:
+            self.invalidation_origin_ts = origin_ts
             delta_ms = (time.perf_counter() - origin_ts) * 1e3
             if 0.0 <= delta_ms < 3.6e6:  # range guard, NOT skew detection
                 _record_delivery(delta_ms)

@@ -274,6 +274,14 @@ def explain(
         e.get("count", 1) for e in fence_events if e.get("key") in keys
     )
     wave_clients_fenced = sum(e.get("count", 1) for e in fence_events)
+    # the edge hop (ISSUE 8): an EdgeNode on this process journals one
+    # "edge_fenced" per re-fanned key with the count of downstream
+    # sessions — the chain then spans server wave → edge → session
+    edge_events = recorder.for_cause(cause, kind="edge_fenced") if cause else []
+    edge_sessions_fenced = sum(
+        e.get("count", 1) for e in edge_events if e.get("key") in keys
+    )
+    wave_edge_sessions_fenced = sum(e.get("count", 1) for e in edge_events)
 
     host = cause.split("/", 1)[0] if cause and "/" in cause else None
     out["invalidation"] = {
@@ -292,6 +300,9 @@ def explain(
         "clients_fenced": clients_fenced,
         "wave_clients_fenced": wave_clients_fenced,
     }
+    if edge_events:
+        out["invalidation"]["edge_sessions_fenced"] = edge_sessions_fenced
+        out["invalidation"]["wave_edge_sessions_fenced"] = wave_edge_sessions_fenced
     if oplog_batch_upto is not None:
         out["invalidation"]["oplog_batch_upto"] = oplog_batch_upto
 
@@ -367,6 +378,19 @@ def explain(
         chain.append(
             f"the wave fenced {wave_clients_fenced} client subscription(s) "
             f"(none recorded on this key)"
+        )
+    if edge_sessions_fenced:
+        line = (
+            f"edge re-fanned to {edge_sessions_fenced} downstream session(s) "
+            f"on this key"
+        )
+        if wave_edge_sessions_fenced > edge_sessions_fenced:
+            line += f" ({wave_edge_sessions_fenced} across the wave)"
+        chain.append(line)
+    elif wave_edge_sessions_fenced:
+        chain.append(
+            f"the edge re-fanned {wave_edge_sessions_fenced} downstream "
+            f"session(s) (none recorded on this key)"
         )
     out["chain"] = chain
     return out

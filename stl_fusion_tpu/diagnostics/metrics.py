@@ -150,12 +150,23 @@ class Histogram:
         self.max = -math.inf
 
     def record(self, value: float) -> None:
+        self.record_many(value, 1)
+
+    def record_many(self, value: float, n: int) -> None:
+        """``n`` samples of the same value in one bucket update — the edge
+        fan-out records one client-visible instant for a whole batch of
+        synchronous-sink sessions (a per-session record() there would put
+        a registry histogram inside a million-iteration loop). The single-
+        sample :meth:`record` delegates here so the clamp + bucket logic
+        exists once."""
+        if n <= 0:
+            return
         v = float(value)
         if v < 0.0 or v != v:  # clock skew / NaN: clamp, never throw
             v = 0.0
-        self.buckets[bisect.bisect_left(self.edges, v)] += 1
-        self.count += 1
-        self.sum += v
+        self.buckets[bisect.bisect_left(self.edges, v)] += n
+        self.count += n
+        self.sum += v * n
         if v < self.min:
             self.min = v
         if v > self.max:

@@ -55,6 +55,9 @@ class FusionMonitor:
         #: cluster control-plane parts (attach_cluster): member / router /
         #: rebalancer snapshots merged into report()["cluster"]
         self._cluster_parts: list = []
+        #: edge gateway nodes (attach_edge): per-node snapshots listed in
+        #: report()["edge"] — sessions, upstream subs, eviction/delivery
+        self._edge_nodes: list = []
         # the hot-cache fast path counts amortized on the registry (every
         # 16th hit — see core/service.py) instead of firing a hook per hit
         self._fast_hits0 = getattr(hub.registry, "fast_hits", 0)
@@ -193,6 +196,23 @@ class FusionMonitor:
             self._cluster_parts.append(weakref.ref(part))
         return self
 
+    def attach_edge(self, *nodes) -> "FusionMonitor":
+        """Export edge gateway state in :meth:`report` under ``"edge"``:
+        one snapshot per attached :class:`~..edge.EdgeNode` (sessions,
+        upstream subscriptions, evictions, resume/resubscribe counters,
+        the fence→client-visible delivery histogram). Weakly referenced,
+        like the RPC hubs."""
+        import weakref
+
+        for node in nodes:
+            self._edge_nodes.append(weakref.ref(node))
+        return self
+
+    def _edge_report(self):
+        nodes = [ref() for ref in self._edge_nodes]
+        snaps = [n.snapshot() for n in nodes if n is not None]
+        return snaps or None
+
     def _cluster_report(self):
         merged = None
         for ref in self._cluster_parts:
@@ -261,6 +281,9 @@ class FusionMonitor:
         cluster = self._cluster_report()
         if cluster is not None:
             extra["cluster"] = cluster
+        edge = self._edge_report()
+        if edge is not None:
+            extra["edge"] = edge
         # per-wave timelines: the hub's graph backend carries the profiler
         backend = getattr(self.hub, "graph_backend", None)
         profiler = getattr(backend, "profiler", None)

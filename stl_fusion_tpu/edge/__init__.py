@@ -1,0 +1,29 @@
+"""Edge tier (ISSUE 8) — million-subscriber live-query fan-out.
+
+An :class:`EdgeNode` holds EXACTLY ONE upstream ``$sys-c`` subscription
+per distinct key (riding the coalesced batch frames) and re-fans each
+fence to thousands of downstream SSE/WebSocket sessions with per-session
+bounded outboxes, latest-wins coalescing, slow-consumer eviction with
+resume tokens, and shard-map-aware upstream affinity. EDGE.md is the
+runbook.
+"""
+from .gateway import EdgeNode
+from .server import EdgeHttpServer, EdgeWebSocketServer
+from .session import (
+    EdgeSession,
+    KeyedMailbox,
+    LatestWinsMailbox,
+    frame_to_dict,
+    pump_payloads,
+)
+
+__all__ = [
+    "EdgeNode",
+    "EdgeHttpServer",
+    "EdgeWebSocketServer",
+    "EdgeSession",
+    "KeyedMailbox",
+    "LatestWinsMailbox",
+    "frame_to_dict",
+    "pump_payloads",
+]

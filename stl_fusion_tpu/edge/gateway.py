@@ -1,0 +1,759 @@
+"""EdgeNode — the live-query edge gateway (ISSUE 8 tentpole).
+
+The missing analogue of the reference's UI tier at scale: where a Blazor
+circuit holds one ComputedState per component and SignalR pushes each
+re-render, an :class:`EdgeNode` turns server-fenced computeds into live
+queries for END-USER sessions — thousands to hundreds of thousands of
+SSE/WebSocket subscribers per edge process — without the server ever
+seeing more than ONE subscription per distinct key per edge:
+
+- **single-upstream coalescing**: the first session to ask for a key
+  creates one ``_KeySub`` — one :class:`~..client.FusionClient` compute
+  call whose invalidation rides PR 2's coalesced ``$sys-c`` batch frames
+  (the server's fan-out cost is per-EDGE, not per-user). Every later
+  session for that key attaches to the same sub. The invariant the CI
+  smoke asserts: upstream subscriptions == distinct keys, never
+  sessions × keys.
+- **hierarchical re-fan**: each upstream fence re-reads the key once and
+  re-fans the new value to the sub's sessions through per-session bounded
+  outboxes (edge/session.py) — latest-wins per key, slow-consumer
+  eviction with resume tokens, heartbeats. The shape is Tascade's
+  asynchronous reduction tree (PAPERS.md) run in reverse: a wave reaches
+  N·M browsers through N edge subscriptions.
+- **shard-map-aware affinity**: with a cluster
+  :class:`~..cluster.router.ShardMapRouter` installed, each key's
+  upstream subscription pins at the key's OWNER member (same rendezvous
+  placement the servers use), and an applied ``reshard:<epoch>`` — via
+  gossip (``$sys-m.map``), a carried ``ShardMovedError`` map, or the
+  owner's own reshard fence — re-subscribes exactly the moved keys at
+  their new owner WITHOUT touching downstream sessions: a browser never
+  reconnects because the cluster rebalanced.
+- **observable end to end**: ``fusion_edge_*`` metrics (sessions, subs,
+  frames, coalesced frames, evictions, the fence→client-visible delivery
+  histogram), flight-recorder ``edge_fenced`` events carrying the
+  originating wave's cause id (``explain()`` spans server wave → edge →
+  session), and ``snapshot()`` for ``FusionMonitor.report()["edge"]``.
+
+Scale notes: sessions and frames are slotted/tuple-shaped (the
+1M-subscriber simulation in perf/edge_path.py runs in one process);
+sink-flavor sessions deliver synchronously with no per-session task, so
+a million subscribers cost memory, not scheduler load.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple, Union
+
+from ..client.client_function import FusionClient
+from ..core.context import capture
+from ..diagnostics.flight_recorder import RECORDER, call_key
+from ..diagnostics.metrics import global_metrics
+from .session import EdgeSession, Frame, KeyedMailbox
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["EdgeNode", "KeySpec"]
+
+
+def _is_shard_moved(e: BaseException) -> bool:
+    """Function-local cluster import (client_function.py's rule): the edge
+    loads without the cluster package; the check must never cycle."""
+    try:
+        from ..cluster.shard_map import ShardMovedError
+    except ImportError:  # pragma: no cover — cluster ships with the package
+        return False
+    return isinstance(e, ShardMovedError)
+
+#: a key is named by (method, *args) on the edge's upstream service —
+#: e.g. ``("node", 17)`` subscribes ``dag.node(17)``
+KeySpec = Union[Tuple[Any, ...], List[Any]]
+
+
+class _KeySub:
+    """One distinct key's upstream subscription + downstream fan list."""
+
+    __slots__ = (
+        "key_str",
+        "method",
+        "args",
+        "version",
+        "last_frame",
+        "sessions",
+        "task",
+        "peer_ref",
+        "closed",
+        "parked_refs",
+        "repin_cause",
+        "_repin",
+    )
+
+    def __init__(self, key_str: str, method: str, args: tuple):
+        self.key_str = key_str
+        self.method = method
+        self.args = args
+        #: monotonic per-key version — the resume ordering (Last-Event-ID
+        #: style): bumped once per fanned frame, never reused
+        self.version = 0
+        self.last_frame: Optional[Frame] = None
+        self.sessions: Set[EdgeSession] = set()
+        self.task: Optional[asyncio.Task] = None
+        self.peer_ref: Optional[str] = None
+        self.closed = False
+        #: parked (evicted/disconnected) sessions holding this key — the
+        #: sub must outlive its live sessions while a resume could return
+        self.parked_refs = 0
+        #: set when a shard-map change moved this key's owner: the watch
+        #: loop re-subscribes there and stamps the next frame's cause
+        self.repin_cause: Optional[str] = None
+        self._repin = asyncio.Event()
+
+    def repin(self, cause: str) -> None:
+        self.repin_cause = cause
+        self._repin.set()
+
+
+class EdgeNode:
+    """One edge gateway process: holds exactly one upstream subscription
+    per distinct key and re-fans each fence to its downstream sessions.
+
+    ``rpc_hub`` is the edge's OWN client hub (dialing the server tier);
+    ``fusion_hub`` its own computed graph (ClientComputeds intern there).
+    ``router`` (optional) is a cluster ``ShardMapRouter`` — when present
+    it is installed as the hub's call router, upstream subscriptions pin
+    at each key's owner, and epoch changes re-pin moved keys."""
+
+    def __init__(
+        self,
+        service: str,
+        rpc_hub,
+        fusion_hub=None,
+        router=None,
+        default_peer: str = "default",
+        name: str = "edge",
+        resume_ttl: float = 60.0,
+        max_pending: int = 4096,
+        error_backoff: float = 0.05,
+        allowed_methods=None,
+        max_keys_per_session: int = 1024,
+    ):
+        from ..core.hub import FusionHub
+
+        self.service = service
+        self.rpc_hub = rpc_hub
+        self.fusion_hub = fusion_hub or FusionHub()
+        self.router = router
+        self.default_peer = default_peer
+        self.name = name
+        self.resume_ttl = resume_ttl
+        self.max_pending = max_pending
+        self.error_backoff = error_backoff
+        #: method allowlist for key specs. The edge transports forward
+        #: client-supplied (method, args) into upstream compute calls, so
+        #: a node behind a PUBLIC EdgeHttpServer/EdgeWebSocketServer
+        #: should name exactly its live-query read methods here; None
+        #: (the in-process/trusted default) allows any public method —
+        #: EDGE.md documents the trust boundary.
+        self.allowed_methods = (
+            frozenset(allowed_methods) if allowed_methods is not None else None
+        )
+        #: distinct keys one session may subscribe: bounds the upstream
+        #: subscription state a single connection can mint
+        self.max_keys_per_session = max_keys_per_session
+        if router is not None:
+            # affinity + gossip: route through the cluster map, and re-pin
+            # moved keys on every applied epoch (membership pushes /
+            # ShardMovedError-carried maps both land in apply_map)
+            rpc_hub.call_router = router
+            router.on_map_change.append(self._on_map_change)
+        self._subs: Dict[str, _KeySub] = {}
+        self._clients: Dict[str, FusionClient] = {}
+        self._sessions: Set[EdgeSession] = set()
+        #: token → (key specs, delivered-version map, expiry deadline)
+        self._parked: Dict[str, Tuple[tuple, Dict[str, int], float]] = {}
+        #: next full expiry sweep (monotonic): the purge amortizes — a
+        #: full scan per detach would make a reconnect storm O(parked²)
+        self._next_purge = 0.0
+        #: timer for the QUIESCENT sweep: with no attach/detach traffic
+        #: nothing else calls the purge, and the last disconnectors'
+        #: parked refs would pin their subs (and upstream subscriptions)
+        #: past resume_ttl forever
+        self._sweep_handle = None
+        self._closed = False
+        # -- counters (collector-exported as fusion_edge_*) ---------------
+        self.frames_fanned = 0
+        self.coalesced_frames = 0  # latest-wins drops inside session mailboxes
+        self.evictions = 0
+        self.resumes = 0
+        self.resubscribes = 0  # upstream re-pins after a shard move
+        self.upstream_fences = 0
+        self.upstream_errors = 0
+        self.sessions_attached_total = 0
+        self._delivery_hist = global_metrics().histogram(
+            "fusion_edge_delivery_ms",
+            help="server fence (wave apply) -> edge session client-visible",
+        )
+        global_metrics().register_collector(self, EdgeNode._collect_metrics)
+
+    # ------------------------------------------------------------------ metrics
+    def _collect_metrics(self) -> dict:
+        return {
+            "fusion_edge_sessions": len(self._sessions),
+            "fusion_edge_parked_sessions": len(self._parked),
+            "fusion_edge_upstream_subscriptions": len(self._subs),
+            "fusion_edge_frames_sent_total": self.frames_fanned,
+            "fusion_edge_coalesced_frames_total": self.coalesced_frames,
+            "fusion_edge_evictions_total": self.evictions,
+            "fusion_edge_resumes_total": self.resumes,
+            "fusion_edge_resubscribes_total": self.resubscribes,
+            "fusion_edge_upstream_fences_total": self.upstream_fences,
+            "fusion_edge_upstream_errors_total": self.upstream_errors,
+        }
+
+    def snapshot(self) -> dict:
+        """Operator view (FusionMonitor.report()["edge"], GET /shards-style
+        merges): counts + upstream placement."""
+        owners: Dict[str, int] = {}
+        for sub in self._subs.values():
+            if sub.peer_ref is not None:
+                owners[sub.peer_ref] = owners.get(sub.peer_ref, 0) + 1
+        return {
+            "name": self.name,
+            "service": self.service,
+            "sessions": len(self._sessions),
+            "parked_sessions": len(self._parked),
+            "upstream_subscriptions": len(self._subs),
+            "upstream_by_owner": owners,
+            "frames_fanned": self.frames_fanned,
+            "coalesced_frames": self.coalesced_frames,
+            "evictions": self.evictions,
+            "resumes": self.resumes,
+            "resubscribes": self.resubscribes,
+            "upstream_fences": self.upstream_fences,
+            "upstream_errors": self.upstream_errors,
+            # the delivery histogram is ONE process-wide registry metric
+            # (every in-process edge node records into it) — named so a
+            # multi-node report is never misread as this node's own
+            # distribution; per-node triage uses the counters above
+            "delivery_ms_process": self._delivery_hist.snapshot(),
+        }
+
+    # ------------------------------------------------------------------ keys
+    def _normalize(self, spec: KeySpec) -> Tuple[str, tuple]:
+        if isinstance(spec, str):
+            raise TypeError(
+                f"key spec must be (method, *args), got string {spec!r} — "
+                f"the HTTP layer parses wire keys before attach()"
+            )
+        method, *args = tuple(spec)
+        method = str(method)
+        if method.startswith("_") or (
+            self.allowed_methods is not None and method not in self.allowed_methods
+        ):
+            raise ValueError(f"method {method!r} is not subscribable on this edge")
+        return method, tuple(args)
+
+    def key_str(self, spec: KeySpec) -> str:
+        method, args = self._normalize(spec)
+        # the SAME call-shaped journal key the rpc client stamps its fence
+        # events with — what lets explain() join server wave → edge hop
+        return call_key(self.service, method, args)
+
+    def _owner_of(self, method: str, args: tuple) -> str:
+        router = self.router
+        if router is not None:
+            owner = router.shard_map.owner_of(
+                router.key_for(self.service, method, args)
+            )
+            if owner is not None:
+                return owner
+        return self.default_peer
+
+    def _client_for(self, peer_ref: str) -> FusionClient:
+        client = self._clients.get(peer_ref)
+        if client is None:
+            client = FusionClient(
+                self.service,
+                self.rpc_hub,
+                self.fusion_hub,
+                peer_ref,
+                cluster_routed=self.router is not None,
+            )
+            self._clients[peer_ref] = client
+        return client
+
+    # ------------------------------------------------------------------ attach
+    def attach(
+        self,
+        keys: Sequence[KeySpec],
+        sink=None,
+        mailbox: Optional[KeyedMailbox] = None,
+        track_versions: bool = True,
+        replay_current: bool = True,
+    ) -> EdgeSession:
+        """Register one downstream session over ``keys``. Exactly one of
+        ``sink`` (synchronous delivery) / ``mailbox`` (pump-drained) —
+        see :class:`~.session.EdgeSession`. Each key's upstream
+        subscription is created on FIRST use and shared by every later
+        session (the single-upstream invariant). With ``replay_current``
+        the session immediately receives each key's latest known frame."""
+        if self._closed:
+            raise RuntimeError(f"edge node {self.name} is closed")
+        if len(keys) > self.max_keys_per_session:
+            raise ValueError(
+                f"session asks for {len(keys)} keys; this edge caps at "
+                f"{self.max_keys_per_session} per session"
+            )
+        specs = [self._normalize(k) for k in keys]
+        key_strs = tuple(call_key(self.service, m, a) for m, a in specs)
+        session = EdgeSession(
+            key_strs, sink=sink, mailbox=mailbox, track_versions=track_versions
+        )
+        self._sessions.add(session)
+        self.sessions_attached_total += 1
+        for (method, args), ks in zip(specs, key_strs):
+            sub = self._sub_for(ks, method, args)
+            sub.sessions.add(session)
+        if replay_current:
+            # replay AFTER the session joined every sub: a replay that
+            # evicts (broken sink, overflow) has detached the session from
+            # all of them — adding it to later subs afterwards would leave
+            # a ghost that pins the sub forever
+            for ks in key_strs:
+                if session.evicted:
+                    break
+                sub = self._subs.get(ks)
+                if sub is not None and sub.last_frame is not None:
+                    self._deliver_contained(session, sub.last_frame)
+        return session
+
+    def _deliver_contained(self, session: EdgeSession, frame: Frame) -> None:
+        """Replay-path delivery with the same broken-consumer containment
+        as the fan loop: a sink that raises (or a mailbox that overflows)
+        evicts THAT session instead of bubbling into attach()/resume().
+
+        The replayed frame ships WITHOUT the fence's origin timestamp: the
+        fence happened while this session was absent, so recording (or
+        letting the client record) now-minus-then as "delivery latency"
+        would poison the fence→client-visible histogram with reconnect
+        gaps. The cause id stays — causality is still true."""
+        if frame[4] is not None:
+            frame = (frame[0], frame[1], frame[2], frame[3], None, frame[5])
+        try:
+            ok = session.deliver(frame)
+        except Exception:  # noqa: BLE001
+            log.exception("edge %s: session sink failed on replay; evicting", self.name)
+            ok = False
+        if not ok and not session.evicted:
+            self.evict(session, reason="replay delivery failed")
+
+    def _sub_for(self, key_str: str, method: str, args: tuple) -> _KeySub:
+        sub = self._subs.get(key_str)
+        if sub is None:
+            sub = self._subs[key_str] = _KeySub(key_str, method, args)
+            sub.task = asyncio.get_event_loop().create_task(self._watch(sub))
+        return sub
+
+    def detach(self, session: EdgeSession, park: bool = True) -> Optional[str]:
+        """Remove a session. With ``park`` (the disconnect default) its
+        delivered-version map is kept for ``resume_ttl`` seconds under the
+        session's token, so a reconnect resumes exactly where it left off;
+        returns the token (None when not parked). An upstream sub whose
+        last live AND parked reference is gone tears down — the server
+        subscription count follows the distinct-key demand."""
+        if session not in self._sessions:
+            return None
+        self._sessions.discard(session)
+        session.evicted = True
+        token: Optional[str] = None
+        if park:
+            self._purge_parked()
+            self._parked[session.token] = (
+                session.keys,
+                session.resume_state(),
+                time.monotonic() + self.resume_ttl,
+            )
+            token = session.token
+            self._arm_sweep()
+        for ks in session.keys:
+            sub = self._subs.get(ks)
+            if sub is None:
+                continue
+            sub.sessions.discard(session)
+            if park:
+                sub.parked_refs += 1
+            if not sub.sessions and sub.parked_refs <= 0:
+                self._teardown_sub(sub)
+        return token
+
+    def resume(self, token: str, sink=None, mailbox=None) -> EdgeSession:
+        """Re-attach a parked session by its resume token (query param or
+        SSE ``Last-Event-ID`` — every event carries the token as its id).
+        Replays each key whose CURRENT version is newer than the last one
+        this session saw (latest-wins: intermediates are gone by design —
+        the monotonic versions say *whether* it missed, the live frame
+        says *what is true now*). Raises ``KeyError`` on unknown/expired
+        tokens: the client falls back to a fresh attach."""
+        if (sink is None) == (mailbox is None):
+            # validate BEFORE consuming the parked entry: a bad call must
+            # not destroy the token's resume state or strand parked_refs
+            raise ValueError("resume needs exactly one of sink= or mailbox=")
+        self._purge_parked()
+        entry = self._parked.pop(token, None)
+        if entry is None:
+            raise KeyError(f"unknown or expired resume token {token!r}")
+        key_strs, versions, deadline = entry
+        if deadline < time.monotonic():
+            # expired but not yet swept (the sweep is amortized): release
+            # its sub pins and reject like any unknown token
+            self._drop_parked_refs(key_strs)
+            raise KeyError(f"unknown or expired resume token {token!r}")
+        session = EdgeSession(key_strs, sink=sink, mailbox=mailbox, token=token)
+        if session.versions is not None:
+            session.versions.update(versions)
+        self._sessions.add(session)
+        self.resumes += 1
+        for ks in key_strs:
+            sub = self._subs.get(ks)
+            if sub is None:  # torn down while parked (should not happen —
+                continue  # parked_refs pins it — but never KeyError a resume)
+            sub.parked_refs -= 1
+            sub.sessions.add(session)
+        for ks in key_strs:  # replay after joining every sub (see attach)
+            if session.evicted:
+                break
+            sub = self._subs.get(ks)
+            if (
+                sub is not None
+                and sub.last_frame is not None
+                and sub.version > versions.get(ks, 0)
+            ):
+                self._deliver_contained(session, sub.last_frame)
+        return session
+
+    def _purge_parked(self) -> None:
+        """Amortized expiry sweep: a full scan runs at most every
+        resume_ttl/4 seconds — per-detach full scans would cost O(parked²)
+        across a reconnect storm. An expired-but-unswept token is also
+        rejected at :meth:`resume` time (deadline check there)."""
+        now = time.monotonic()
+        if now < self._next_purge:
+            return
+        self._next_purge = now + max(1.0, self.resume_ttl / 4)
+        expired = [t for t, (_k, _v, dl) in self._parked.items() if dl < now]
+        for t in expired:
+            key_strs, _versions, _dl = self._parked.pop(t)
+            self._drop_parked_refs(key_strs)
+
+    def _arm_sweep(self) -> None:
+        """Schedule the quiescent expiry sweep: the ONLY caller of the
+        purge when no connection churn drives it. Re-arms while anything
+        stays parked; idle + empty means no timer."""
+        if self._sweep_handle is not None or self._closed:
+            return
+        try:
+            loop = asyncio.get_event_loop()
+        except RuntimeError:  # no loop (sync teardown): nothing to sweep for
+            return
+        self._sweep_handle = loop.call_later(
+            max(1.0, self.resume_ttl / 2), self._sweep
+        )
+
+    def _sweep(self) -> None:
+        self._sweep_handle = None
+        self._next_purge = 0.0  # the timer IS the amortization: force
+        self._purge_parked()
+        if self._parked:
+            self._arm_sweep()
+
+    def _drop_parked_refs(self, key_strs) -> None:
+        for ks in key_strs:
+            sub = self._subs.get(ks)
+            if sub is None:
+                continue
+            sub.parked_refs -= 1
+            if not sub.sessions and sub.parked_refs <= 0:
+                self._teardown_sub(sub)
+
+    def evict(self, session: EdgeSession, reason: str = "stalled") -> Optional[str]:
+        """Drop a slow consumer WITH a resume token (the pump's timeout
+        path, the mailbox-overflow path and broken-sink containment all
+        land here). Counted; the flight recorder notes it so an operator
+        can see who got cut. The session's ``on_evicted`` transport hook
+        runs LAST, so an eviction that did not originate in the transport
+        pump still aborts the peer's connection. Idempotent: racing
+        eviction paths (overflow in the fan loop vs the pump's send
+        timeout) count — and fire the transport hook — exactly once."""
+        if session not in self._sessions:
+            return None  # already detached/evicted
+        token = self.detach(session, park=True)
+        self.evictions += 1
+        if RECORDER.enabled:
+            RECORDER.note(
+                "edge_evicted",
+                key=session.keys[0] if session.keys else None,
+                detail=f"edge={self.name} reason={reason} token={token}",
+            )
+        if session.on_evicted is not None:
+            try:
+                session.on_evicted()
+            except Exception:  # noqa: BLE001 — shutdown hooks must not bubble
+                log.exception("edge %s: on_evicted hook failed", self.name)
+        return token
+
+    def _teardown_sub(self, sub: _KeySub) -> None:
+        sub.closed = True
+        sub._repin.set()  # unblock a parked watch loop so it exits
+        self._subs.pop(sub.key_str, None)
+        if sub.task is not None and not sub.task.done():
+            sub.task.cancel()
+
+    # ------------------------------------------------------------------ upstream
+    async def _watch(self, sub: _KeySub) -> None:
+        """The key's single upstream loop: capture (one compute call = one
+        ``$sys-c`` subscription at the key's owner) → fan the value →
+        await the fence (or a shard-move re-pin) → re-capture. Latest-wins
+        upstream too: fences that land during a re-read collapse into the
+        next capture."""
+        pending_cause: Optional[str] = None
+        pending_t0: Optional[float] = None
+        backoff = self.error_backoff
+        try:
+            while not sub.closed and not self._closed:
+                owner = self._owner_of(sub.method, sub.args)
+                client = self._client_for(owner)
+                err: Optional[str] = None
+                node = None
+                try:
+                    node = await capture(
+                        lambda: getattr(client, sub.method)(*sub.args)
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — routing/link failures
+                    if _is_shard_moved(e):
+                        # routing transient: the reshard raced our map sync
+                        # and the rejection's carried map was already
+                        # applied (client_function note_moved) — retry at
+                        # the new owner without fanning a phantom error
+                        # frame to every session (resubscribes is counted
+                        # by the fence/repin paths, never here: this IS
+                        # one of those re-pins, mid-flight)
+                        await asyncio.sleep(self.error_backoff)
+                        continue
+                    err = f"{type(e).__name__}: {e}"
+                if node is not None:
+                    out = node._output
+                    if out is not None and out.has_error:
+                        err = f"{type(out.error).__name__}: {out.error}"
+                sub.peer_ref = owner
+                if err is not None:
+                    self.upstream_errors += 1
+                    self._fan(sub, None, pending_cause, pending_t0, err)
+                    pending_cause = pending_t0 = None
+                    await asyncio.sleep(backoff)
+                    backoff = min(1.0, backoff * 2)
+                    continue
+                backoff = self.error_backoff
+                self._fan(
+                    sub, out.value if out is not None else None,
+                    pending_cause, pending_t0, None,
+                )
+                pending_cause = pending_t0 = None
+                # wait for the fence OR a shard-move re-pin, whichever
+                # first; spurious re-pins (the gossip arriving AFTER the
+                # owner's own reshard fence already re-pinned us) are
+                # absorbed here, never as a duplicate re-read + re-fan
+                while True:
+                    sub._repin.clear()
+                    if sub.repin_cause is None and not node.is_invalidated:
+                        inval = node.when_invalidated()
+                        repin_task = asyncio.get_event_loop().create_task(
+                            sub._repin.wait()
+                        )
+                        try:
+                            await asyncio.wait(
+                                {inval, repin_task},
+                                return_when=asyncio.FIRST_COMPLETED,
+                            )
+                        finally:
+                            repin_task.cancel()
+                    if sub.closed or self._closed:
+                        return
+                    if sub.repin_cause is not None:
+                        repin_cause, sub.repin_cause = sub.repin_cause, None
+                        if not node.is_invalidated and sub.peer_ref == self._owner_of(
+                            sub.method, sub.args
+                        ):
+                            continue  # already pinned at the new owner: absorb
+                        # the owner moved: drop the old subscription locally
+                        # (its server end dies with the owner's own reshard
+                        # fence) and re-capture at the new owner
+                        pending_cause = repin_cause
+                        self.resubscribes += 1
+                        if not node.is_invalidated:
+                            node.invalidate(immediately=True)
+                        break
+                    if node.is_invalidated:
+                        self.upstream_fences += 1
+                        pending_cause = node.invalidation_cause
+                        pending_t0 = node.invalidation_origin_ts
+                        if pending_cause is not None and pending_cause.startswith(
+                            "reshard:"
+                        ):
+                            # fenced BY the reshard itself (gossip not yet
+                            # applied here): the re-capture re-routes via
+                            # the map the ShardMovedError retry carries
+                            self.resubscribes += 1
+                        break
+                    # stray wake (absorbed repin / cancelled waiter): rearm
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — a watch loop must never die silently
+            log.exception("edge %s: watch loop for %s failed", self.name, sub.key_str)
+
+    def _fan(
+        self,
+        sub: _KeySub,
+        value: Any,
+        cause: Optional[str],
+        origin_ts: Optional[float],
+        err: Optional[str],
+    ) -> None:
+        """Re-fan one upstream frame to every attached session. Sessions
+        whose bounded mailbox overflowed are evicted (with resume tokens)
+        AFTER the loop — a slow consumer never stalls its siblings, it
+        just stops being a consumer."""
+        sub.version += 1
+        frame: Frame = (sub.key_str, sub.version, value, cause, origin_ts, err)
+        sub.last_frame = frame
+        if not sub.sessions:
+            return
+        dead: Optional[List[Tuple[EdgeSession, str]]] = None
+        n = 0
+        sinks = 0
+        for session in sub.sessions:
+            mailbox = session.mailbox
+            was_coalesced = mailbox.coalesced if mailbox is not None else 0
+            try:
+                ok = session.deliver(frame)
+            except Exception:  # noqa: BLE001 — ONE broken consumer sink
+                # must never kill the key's watch loop for its siblings:
+                # contain it as an eviction (parked; a fixed consumer can
+                # resume from its token)
+                log.exception(
+                    "edge %s: session sink failed for %s; evicting",
+                    self.name, sub.key_str,
+                )
+                ok = False
+                if dead is None:
+                    dead = []
+                dead.append((session, "sink raised"))
+            else:
+                if not ok:
+                    if dead is None:
+                        dead = []
+                    dead.append((session, "mailbox overflow"))
+            if ok and mailbox is None:
+                sinks += 1  # counted in THIS loop — the fan over the
+                # hottest zipf key must not pay a second O(sessions) pass
+            if mailbox is not None:
+                self.coalesced_frames += mailbox.coalesced - was_coalesced
+            n += 1
+        if dead:
+            # evict BEFORE the counters/histogram below: a failed delivery
+            # must not ride the fan total, the recorder count, or the
+            # delivery distribution as if a client saw it
+            for session, reason in dead:
+                self.evict(session, reason=reason)
+            n -= len(dead)
+        self.frames_fanned += n
+        if origin_ts is not None:
+            # sink-flavor sessions are client-visible NOW (synchronous
+            # delivery); one timestamp after the loop bounds them all.
+            # Mailbox sessions record at pump-send time instead (the pump
+            # calls record_delivery per drained frame).
+            delta_ms = (time.perf_counter() - origin_ts) * 1e3
+            if 0.0 <= delta_ms < 3.6e6 and sinks:  # range guard as $sys-c e2e
+                self._delivery_hist.record_many(delta_ms, sinks)
+        if (cause is not None or err is not None) and RECORDER.enabled and n > 0:
+            # the edge hop of the causal chain: explain() joins this to
+            # the client-side "fenced" event (same call-shaped key, same
+            # cause) and renders "edge re-fanned to N session(s)";
+            # causeless initial-value fans stay un-journaled (they are
+            # attach mechanics, not invalidation causality), error fans
+            # are journaled so an operator sees who saw the failure
+            RECORDER.note(
+                "edge_fenced",
+                key=sub.key_str,
+                cause=cause,
+                count=n,
+                detail=f"edge={self.name} v{sub.version} owner={sub.peer_ref}",
+            )
+
+    def record_delivery(self, frame: Frame) -> None:
+        """Pump callback: a mailbox frame reached its peer — record the
+        fence→client-visible sample (the transport half of the histogram
+        sink-flavor sessions record inline)."""
+        origin_ts = frame[4]
+        if origin_ts is None:
+            return
+        delta_ms = (time.perf_counter() - origin_ts) * 1e3
+        if 0.0 <= delta_ms < 3.6e6:
+            self._delivery_hist.record(delta_ms)
+
+    # ------------------------------------------------------------------ reshard
+    def _on_map_change(self, old, new) -> None:
+        """Router callback on every applied epoch: re-pin exactly the subs
+        whose key's owner moved. Downstream sessions notice nothing — the
+        next frame just says ``cause=reshard:<epoch>``."""
+        from ..cluster.shard_map import ShardMap
+
+        moved = set(ShardMap.diff(old, new))
+        if not moved:
+            return
+        cause = f"reshard:{new.epoch}"
+        for sub in self._subs.values():
+            shard = new.shard_of(
+                self.router.key_for(self.service, sub.method, sub.args)
+            )
+            if shard in moved:
+                sub.repin(cause)
+
+    def apply_map(self, new_map) -> bool:
+        """Adopt a shard map directly (tests / static deployments without
+        a gossip feed)."""
+        if self.router is None:
+            raise RuntimeError("edge node has no shard-map router")
+        return self.router.apply_map(new_map)
+
+    # ------------------------------------------------------------------ lifecycle
+    async def close(self) -> None:
+        """Stop every watch loop and drop session state (the rpc/fusion
+        hubs are the caller's to stop — they may be shared)."""
+        self._closed = True
+        subs = list(self._subs.values())
+        self._subs.clear()
+        for sub in subs:
+            sub.closed = True
+            sub._repin.set()
+            if sub.task is not None and not sub.task.done():
+                sub.task.cancel()
+        for sub in subs:
+            if sub.task is not None:
+                try:
+                    await sub.task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+        self._sessions.clear()
+        self._parked.clear()
+        if self._sweep_handle is not None:
+            self._sweep_handle.cancel()
+            self._sweep_handle = None
+        if self.router is not None:
+            try:
+                self.router.on_map_change.remove(self._on_map_change)
+            except ValueError:
+                pass
+        global_metrics().unregister_collector(self)

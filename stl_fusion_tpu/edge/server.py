@@ -1,0 +1,434 @@
+"""Edge transports: SSE over stdlib asyncio + optional WebSocket.
+
+The browser-facing surface of the edge tier (ISSUE 8): an
+:class:`EdgeHttpServer` serves ``text/event-stream`` live queries against
+an :class:`~.gateway.EdgeNode` with zero dependencies beyond the standard
+library (the same asyncio-streams shape as ``rpc/http_gateway.py``), and
+:class:`EdgeWebSocketServer` serves the same sessions over WebSocket when
+the optional ``websockets`` package is installed (gated exactly like
+``ui/web.py`` — environments without it still get SSE).
+
+Protocol (SSE):
+
+    GET /edge/sse?keys=<urlencoded JSON [[method, arg...], ...]>
+    GET /edge/sse?resume=<token>          (or the Last-Event-ID header)
+
+Every event carries the session's RESUME TOKEN as its SSE ``id`` — so the
+browser's own ``Last-Event-ID`` reconnect header IS the resume handle
+(EventSource does this without any client code). Event stream:
+
+    event: hello            data: {"token": ..., "keys": [...]}
+    event: update           data: {"key", "ver", "value", "cause", "t0"}
+    : hb                    (comment heartbeat every heartbeat_interval)
+
+``ver`` is the key's monotonic version; ``cause``/``t0`` are the upstream
+fence's identity and wave-apply timestamp (the explain()/delivery-
+histogram hop propagation). A reconnect with a token replays exactly the
+keys whose current version is newer than the last the session saw.
+
+Observability routes (loopback-only, matching the gateway's trust
+default): ``GET /metrics`` (Prometheus exposition of the process
+registry — ``fusion_edge_*`` included) and ``GET /edge/stats`` (the
+node's snapshot). A slow consumer — a peer that stops reading while the
+transport buffer is full — is EVICTED after ``send_timeout`` and handed
+its resume token in the close; siblings never notice.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import urllib.parse
+from typing import Optional
+
+from .gateway import EdgeNode
+from .session import KeyedMailbox, frame_to_dict, pump_payloads
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["EdgeHttpServer", "EdgeWebSocketServer"]
+
+
+def _validate_keys(specs):
+    """Wire key-spec shape check shared by BOTH transports: a list of
+    non-empty ``[method, arg...]`` arrays. A flat ``["node"]`` must fail
+    loudly here — ``tuple("node")`` would silently become the garbage
+    method ``'n'``."""
+    if not isinstance(specs, list):
+        raise ValueError("keys must be a JSON array of [method, arg...] arrays")
+    out = []
+    for spec in specs:
+        if not isinstance(spec, list) or not spec:
+            raise ValueError(f"bad key spec {spec!r} (want [method, arg...])")
+        out.append(tuple(spec))
+    return out
+
+
+def _parse_keys(raw: Optional[str]):
+    if not raw:
+        return []
+    return _validate_keys(json.loads(raw))
+
+
+class EdgeHttpServer:
+    """SSE live queries for one :class:`EdgeNode` (stdlib-only)."""
+
+    def __init__(
+        self,
+        node: EdgeNode,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        heartbeat_interval: float = 15.0,
+        send_timeout: Optional[float] = 10.0,
+        min_send_interval: float = 0.0,
+    ):
+        self.node = node
+        self.host = host
+        self.port = port
+        self.heartbeat_interval = heartbeat_interval
+        self.send_timeout = send_timeout
+        self.min_send_interval = min_send_interval
+        self.connections = 0
+        #: live per-connection pump tasks: stop() cancels them so shutdown
+        #: never hangs behind a healthy long-lived stream (Python ≥3.12
+        #: wait_closed() waits for connection handlers)
+        self._pumps: set = set()
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> "EdgeHttpServer":
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            for task in list(self._pumps):
+                task.cancel()
+            if self._pumps:
+                await asyncio.gather(*self._pumps, return_exceptions=True)
+            await self._server.wait_closed()
+            self._server = None
+
+    # ------------------------------------------------------------------ http
+    @staticmethod
+    async def _write_json(writer, status: str, payload) -> None:
+        from ..rpc.http_gateway import FusionHttpServer
+
+        await FusionHttpServer._write_json(writer, status, payload)
+
+    @staticmethod
+    def _is_loopback(writer) -> bool:
+        from ..rpc.http_gateway import _normalize_ip
+
+        peer = writer.get_extra_info("peername")
+        return bool(peer) and _normalize_ip(peer[0]) in ("127.0.0.1", "::1")
+
+    async def _handle(self, reader, writer) -> None:
+        from ..rpc.http_gateway import read_request_head
+
+        try:
+            method, target, headers = await read_request_head(reader)
+            if method is None:
+                return
+            parsed = urllib.parse.urlsplit(target)
+            path = parsed.path
+            query = urllib.parse.parse_qs(parsed.query)
+            if method != "GET":
+                await self._write_json(
+                    writer, "405 Method Not Allowed",
+                    {"error": {"type": "MethodNotAllowed", "message": method}},
+                )
+                return
+            if path == "/edge/sse":
+                await self._serve_sse(reader, writer, query, headers)
+                return
+            if path == "/metrics" and self._is_loopback(writer):
+                from ..rpc.http_gateway import write_metrics_response
+
+                await write_metrics_response(writer)
+                return
+            if path == "/edge/stats" and self._is_loopback(writer):
+                await self._write_json(writer, "200 OK", self.node.snapshot())
+                return
+            await self._write_json(
+                writer, "404 Not Found",
+                {"error": {"type": "NotFound", "message": path}},
+            )
+        except Exception:  # noqa: BLE001 — one bad request never kills the server
+            log.exception("edge http request failed")
+        finally:
+            writer.close()
+
+    # ------------------------------------------------------------------ sse
+    async def _serve_sse(self, reader, writer, query, headers) -> None:
+        node = self.node
+        token = (
+            query.get("resume", [None])[0]
+            or headers.get("last-event-id")
+            or None
+        )
+        try:
+            keys = _parse_keys(query.get("keys", [None])[0])
+        except (ValueError, TypeError) as e:
+            await self._write_json(
+                writer, "400 Bad Request",
+                {"error": {"type": "BadRequest", "message": str(e)}},
+            )
+            return
+        mailbox = KeyedMailbox(max_pending=node.max_pending)
+        session = None
+        if token:
+            try:
+                session = node.resume(token, mailbox=mailbox)
+            except KeyError:
+                session = None  # expired: fall back to a fresh attach below
+        if session is None:
+            if not keys:
+                await self._write_json(
+                    writer, "410 Gone",
+                    {"error": {
+                        "type": "ResumeExpired",
+                        "message": "token unknown/expired and no keys= given",
+                    }},
+                )
+                return
+            try:
+                session = node.attach(keys, mailbox=mailbox)
+            except (ValueError, TypeError) as e:
+                # allowlist rejection / per-session key cap / bad specs —
+                # the CLIENT's bad input, answered, never a dropped socket
+                await self._write_json(
+                    writer, "400 Bad Request",
+                    {"error": {"type": "BadRequest", "message": str(e)}},
+                )
+                return
+        if session.evicted:
+            # the attach/resume REPLAY itself evicted the session (mailbox
+            # bound smaller than the key set): answer loudly — streaming
+            # would be exactly the silent heartbeat-alive dead
+            # subscription the eviction hook exists to prevent
+            await self._write_json(
+                writer, "409 Conflict",
+                {"error": {
+                    "type": "Evicted",
+                    "message": "replay overflowed the session outbox "
+                               "(more keys than max_pending?)",
+                    "resume": session.token,
+                }},
+            )
+            return
+        self.connections += 1
+        sid = session.token
+        writer.write(
+            b"HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\nConnection: close\r\n\r\n"
+        )
+        hello = json.dumps({"token": sid, "keys": list(session.keys)})
+        writer.write(f"id: {sid}\nevent: hello\ndata: {hello}\n\n".encode())
+
+        async def send(batch) -> None:
+            chunks = []
+            for frame in batch:
+                data = json.dumps(frame_to_dict(frame), default=repr)
+                chunks.append(f"id: {sid}\nevent: update\ndata: {data}\n\n")
+            writer.write("".join(chunks).encode())
+            await writer.drain()
+            # delivered: advance the resume map + the fence→visible samples
+            session.mark_delivered(batch)
+            for frame in batch:
+                node.record_delivery(frame)
+
+        async def heartbeat() -> None:
+            writer.write(b": hb\n\n")
+            await writer.drain()
+
+        pump_task = asyncio.ensure_future(
+            pump_payloads(
+                mailbox,
+                send,
+                min_send_interval=self.min_send_interval,
+                send_timeout=self.send_timeout,
+                heartbeat_interval=self.heartbeat_interval,
+                heartbeat=heartbeat,
+                on_evict=lambda: node.evict(session, reason="sse send timeout"),
+            )
+        )
+
+        def shutdown_transport() -> None:
+            # runs from EdgeNode.evict (any eviction path — send timeout,
+            # mailbox overflow, broken sink): the peer must see the stream
+            # DIE so its reconnect logic engages, never a silent
+            # heartbeat-alive stream that stopped updating
+            transport = writer.transport
+            if transport is not None:
+                transport.abort()
+            if not pump_task.done():
+                pump_task.cancel()
+
+        session.on_evicted = shutdown_transport
+        self._pumps.add(pump_task)
+        try:
+            outcome = await pump_task
+            if outcome == "closed":
+                # normal disconnect: park for resume_ttl so the browser's
+                # Last-Event-ID reconnect picks up where it left off
+                node.detach(session, park=True)
+        except asyncio.CancelledError:
+            if not session.evicted:
+                # cancelled from OUTSIDE (server stop, handler teardown):
+                # park so the client can resume against a restarted server
+                node.detach(session, park=True)
+                raise
+            # eviction-driven cancel: the session is already parked
+        finally:
+            self._pumps.discard(pump_task)
+            self.connections -= 1
+
+
+class EdgeWebSocketServer:
+    """The same sessions over WebSocket (optional ``websockets`` dep,
+    gated like ``ui/web.py``). Protocol: the client's FIRST message is
+    ``{"keys": [[method, arg...], ...]}`` or ``{"resume": token}``; the
+    server replies ``{"hello": {"token", "keys"}}`` and then streams
+    ``{"frames": [frame...]}`` batches (latest-wins per key between
+    sends) and ``{"ping": t}`` heartbeats."""
+
+    def __init__(
+        self,
+        node: EdgeNode,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        heartbeat_interval: float = 15.0,
+        send_timeout: Optional[float] = 10.0,
+        min_send_interval: float = 0.0,
+    ):
+        self.node = node
+        self.host = host
+        self.port = port
+        self.heartbeat_interval = heartbeat_interval
+        self.send_timeout = send_timeout
+        self.min_send_interval = min_send_interval
+        self.connections = 0
+        self._server = None
+
+    async def start(self) -> "EdgeWebSocketServer":
+        try:
+            from websockets.asyncio.server import serve
+        except ImportError as e:  # pragma: no cover — optional dependency
+            raise RuntimeError(
+                "EdgeWebSocketServer needs the optional 'websockets' package; "
+                "the SSE transport (EdgeHttpServer) is dependency-free"
+            ) from e
+        self._server = await serve(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"ws://{self.host}:{self.port}/edge/ws"
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, ws) -> None:
+        node = self.node
+        loop = asyncio.get_running_loop()
+        try:
+            first = json.loads(await ws.recv())
+            if not isinstance(first, dict):
+                raise ValueError("hello must be a JSON object")
+        except Exception as e:  # noqa: BLE001 — bad hello: answer, close
+            try:
+                await ws.send(json.dumps({"error": f"bad hello: {e}"}))
+            except Exception:  # noqa: BLE001 — peer already gone
+                pass
+            await ws.close()
+            return
+        mailbox = KeyedMailbox(max_pending=node.max_pending)
+        session = None
+        token = first.get("resume")
+        if token:
+            try:
+                session = node.resume(token, mailbox=mailbox)
+            except KeyError:
+                session = None
+        if session is None:
+            try:
+                keys = _validate_keys(first.get("keys", []))
+                if not keys:
+                    raise ValueError("no keys and no valid resume token")
+                session = node.attach(keys, mailbox=mailbox)
+            except (ValueError, TypeError) as e:
+                await ws.send(json.dumps({"error": str(e)}))
+                await ws.close()
+                return
+        if session.evicted:  # replay overflow: same contract as SSE's 409
+            await ws.send(
+                json.dumps({"error": "replay overflowed the session outbox",
+                            "resume": session.token})
+            )
+            await ws.close()
+            return
+        async def send(batch) -> None:
+            await ws.send(
+                json.dumps(
+                    {"frames": [frame_to_dict(f) for f in batch]}, default=repr
+                )
+            )
+            session.mark_delivered(batch)
+            for frame in batch:
+                node.record_delivery(frame)
+
+        async def heartbeat() -> None:
+            await ws.send(json.dumps({"ping": loop.time()}))
+
+        pump_task = asyncio.ensure_future(
+            pump_payloads(
+                mailbox,
+                send,
+                min_send_interval=self.min_send_interval,
+                send_timeout=self.send_timeout,
+                heartbeat_interval=self.heartbeat_interval,
+                heartbeat=heartbeat,
+                on_evict=lambda: node.evict(session, reason="ws send timeout"),
+            )
+        )
+
+        def shutdown_transport() -> None:
+            # any eviction path (send timeout, overflow, broken sink) must
+            # kill the socket so the peer's reconnect logic engages
+            transport = getattr(ws, "transport", None)
+            if transport is not None:
+                transport.abort()
+            if not pump_task.done():
+                pump_task.cancel()
+
+        session.on_evicted = shutdown_transport
+        self.connections += 1
+        # EVERY await from here on sits under the finally: a peer that
+        # drops right after subscribing (the hello send raising) must
+        # still detach — a ghost session would be fanned to forever and
+        # pin its subs
+        try:
+            await ws.send(
+                json.dumps(
+                    {"hello": {"token": session.token, "keys": list(session.keys)}}
+                )
+            )
+            async for _raw in ws:  # inbound ignored; the stream is one-way
+                pass
+        except Exception:  # noqa: BLE001 — a dying socket is a normal exit
+            pass
+        finally:
+            self.connections -= 1
+            pump_task.cancel()
+            if not session.evicted:  # evict() already parked it otherwise
+                node.detach(session, park=True)

@@ -100,6 +100,45 @@ class RestError(Exception):
         self.type_name = type_name
 
 
+async def read_request_head(
+    reader: asyncio.StreamReader,
+) -> Tuple[Optional[str], Optional[str], Dict[str, str]]:
+    """Parse one HTTP request line + header block into
+    ``(method, target, lowercase-name headers)`` — the one parser every
+    asyncio-streams server in the tree rides (this gateway and the edge
+    tier's ``EdgeHttpServer``), so header handling never drifts between
+    them. Returns ``(None, None, {})`` on an empty (closed) stream."""
+    request_line = (await reader.readline()).decode("latin1").strip()
+    if not request_line:
+        return None, None, {}
+    method, target, _version = request_line.split(" ", 2)
+    headers: Dict[str, str] = {}
+    while True:
+        line = (await reader.readline()).decode("latin1").strip()
+        if not line:
+            break
+        name, _, value = line.partition(":")
+        headers[name.lower()] = value.strip()
+    return method, target, headers
+
+
+async def write_metrics_response(writer: asyncio.StreamWriter) -> None:
+    """One Prometheus-exposition HTTP response off the process registry —
+    shared by every server that mounts a ``/metrics`` route (this gateway
+    and the edge tier's ``EdgeHttpServer``), so the exposition headers
+    never drift between them."""
+    from ..diagnostics.metrics import global_metrics
+
+    raw = global_metrics().render_prometheus().encode()
+    writer.write(
+        "HTTP/1.1 200 OK\r\n"
+        "Content-Type: text/plain; version=0.0.4; charset=utf-8\r\n"
+        f"Content-Length: {len(raw)}\r\nConnection: close\r\n\r\n".encode()
+        + raw
+    )
+    await writer.drain()
+
+
 class FusionHttpServer:
     """Serves registered services of an RpcHub (or any object registry with
     ``service_registry.invoke``) over HTTP."""
@@ -199,24 +238,11 @@ class FusionHttpServer:
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         try:
-            request_line = (await reader.readline()).decode("latin1").strip()
-            if not request_line:
+            method, target, headers = await read_request_head(reader)
+            if method is None:
                 return
-            method, target, _version = request_line.split(" ", 2)
-            content_length = 0
-            cookie_header = ""
-            headers: dict = {}
-            while True:
-                line = (await reader.readline()).decode("latin1").strip()
-                if not line:
-                    break
-                name, _, value = line.partition(":")
-                lname = name.lower()
-                headers[lname] = value.strip()
-                if lname == "content-length":
-                    content_length = int(value.strip())
-                elif lname == "cookie":
-                    cookie_header = value.strip()
+            content_length = int(headers.get("content-length", 0))
+            cookie_header = headers.get("cookie", "")
             body = await reader.readexactly(content_length) if content_length else b""
             peer = writer.get_extra_info("peername")
             headers["_ip"] = peer[0] if peer else ""
@@ -232,16 +258,7 @@ class FusionHttpServer:
                 and self._is_trusted_proxy(headers)
             )
             if observability and path == "/metrics":
-                from ..diagnostics.metrics import global_metrics
-
-                raw = global_metrics().render_prometheus().encode()
-                writer.write(
-                    "HTTP/1.1 200 OK\r\n"
-                    "Content-Type: text/plain; version=0.0.4; charset=utf-8\r\n"
-                    f"Content-Length: {len(raw)}\r\nConnection: close\r\n\r\n".encode()
-                    + raw
-                )
-                await writer.drain()
+                await write_metrics_response(writer)
                 return
             if observability and path == "/trace":
                 from ..diagnostics.tracing import recent_spans

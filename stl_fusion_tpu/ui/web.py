@@ -24,6 +24,7 @@ import json
 import logging
 from typing import Any, Callable, Optional
 
+from ..edge.session import LatestWinsMailbox, pump_payloads
 from .live_component import LiveComponent
 
 log = logging.getLogger("stl_fusion_tpu")
@@ -50,52 +51,22 @@ class HtmlComponent(LiveComponent):
         self.push({"error": f"{type(error).__name__}: {error}"})
 
 
-class _RenderSlot:
-    """Latest-wins render mailbox (one per connection): a render that lands
-    while an older one is still pending simply REPLACES it — the Blazor
-    render-current-state rule (ComputedStateComponent.cs:27-132). A stalled
-    browser therefore holds ONE pending payload no matter how many
-    invalidations fire; intermediate renders nobody could have seen are
-    dropped, counted in ``coalesced``."""
-
-    _EMPTY = object()
-    __slots__ = ("_payload", "_event", "pushed", "coalesced")
-
-    def __init__(self):
-        self._payload: Any = self._EMPTY
-        self._event = asyncio.Event()
-        self.pushed = 0
-        self.coalesced = 0
-
-    def push(self, payload: Any) -> None:
-        if self._payload is not self._EMPTY:
-            self.coalesced += 1
-        self._payload = payload
-        self.pushed += 1
-        self._event.set()
-
-    async def take(self) -> Any:
-        await self._event.wait()
-        self._event.clear()
-        payload, self._payload = self._payload, self._EMPTY
-        return payload
-
-    def take_nowait(self, default: Any) -> Any:
-        """The newest payload if one landed since, else ``default`` (used
-        after a rate-limit sleep so the send is never stale)."""
-        if self._payload is self._EMPTY:
-            return default
-        self._event.clear()
-        payload, self._payload = self._payload, self._EMPTY
-        return payload
+#: the per-connection latest-wins mailbox now lives in the shared edge
+#: session core (ISSUE 8 satellite: the UI layer rides the same bounded-
+#: outbox machinery as the edge gateway's SSE/WebSocket sessions); the
+#: historic name stays importable — behavior is byte-identical
+_RenderSlot = LatestWinsMailbox
 
 
 class LiveViewServer:
     """Hosts per-connection LiveComponents over plain-JSON websockets.
 
-    Delivery is latest-wins per connection (see :class:`_RenderSlot`);
+    Delivery rides the shared edge session core (edge/session.py):
+    latest-wins per connection (see :class:`LatestWinsMailbox`);
     ``min_send_interval`` optionally rate-limits pushes (the newest payload
-    at the end of the interval is what ships), and a send that can't make
+    at the end of the interval is what ships); ``heartbeat_interval``
+    keeps idle connections alive with ``{"ping": t}`` frames (off by
+    default — historic wire behavior); and a send that can't make
     progress for ``send_timeout`` seconds — a browser that stopped reading
     while the transport buffer is full — EVICTS the connection, unmounting
     its component so it stops consuming invalidations."""
@@ -107,12 +78,14 @@ class LiveViewServer:
         port: int = 0,
         min_send_interval: float = 0.0,
         send_timeout: Optional[float] = 30.0,
+        heartbeat_interval: Optional[float] = None,
     ):
         self.component_factory = component_factory
         self.host = host
         self.port = port
         self.min_send_interval = min_send_interval
         self.send_timeout = send_timeout
+        self.heartbeat_interval = heartbeat_interval
         self.connections = 0
         self.evictions = 0  # observability: slow clients closed mid-send
         self._server = None
@@ -138,35 +111,36 @@ class LiveViewServer:
         self.connections += 1
         loop = asyncio.get_running_loop()
 
-        async def pump() -> None:
-            last_send = -float("inf")
-            while True:
-                payload = await slot.take()
-                if self.min_send_interval > 0:
-                    wait = self.min_send_interval - (loop.time() - last_send)
-                    if wait > 0:
-                        await asyncio.sleep(wait)
-                        payload = slot.take_nowait(payload)  # newest at send time
-                try:
-                    await asyncio.wait_for(
-                        ws.send(json.dumps(payload)), self.send_timeout
-                    )
-                except (asyncio.TimeoutError, TimeoutError):
-                    # the browser stopped draining: evict it rather than
-                    # letting a dead tab pin the component forever. Abort —
-                    # a graceful close would wait close_timeout for a close
-                    # handshake the dead peer will never answer, through
-                    # the very buffer that is already full
-                    self.evictions += 1
-                    transport = getattr(ws, "transport", None)
-                    if transport is not None:
-                        transport.abort()
-                    else:
-                        await ws.close()
-                    return
-                last_send = loop.time()
+        async def send(payload) -> None:
+            await ws.send(json.dumps(payload))
 
-        pump_task = asyncio.ensure_future(pump())
+        async def heartbeat() -> None:
+            await ws.send(json.dumps({"ping": loop.time()}))
+
+        def on_evict() -> None:
+            # the browser stopped draining: evict it rather than letting a
+            # dead tab pin the component forever. Abort — a graceful close
+            # would wait close_timeout for a close handshake the dead peer
+            # will never answer, through the very buffer that is already
+            # full
+            self.evictions += 1
+            transport = getattr(ws, "transport", None)
+            if transport is not None:
+                transport.abort()
+            else:
+                asyncio.ensure_future(ws.close())
+
+        pump_task = asyncio.ensure_future(
+            pump_payloads(
+                slot,
+                send,
+                min_send_interval=self.min_send_interval,
+                send_timeout=self.send_timeout,
+                heartbeat_interval=self.heartbeat_interval,
+                heartbeat=heartbeat,
+                on_evict=on_evict,
+            )
+        )
         try:
             # hold until the browser goes away; inbound messages reach the
             # component's optional on_message (local-input hook, ≈ the

@@ -63,11 +63,31 @@ def test_compact_record_stays_under_tail_window():
             "burst_s": 28.48, "maintain_s": 0.0,
         },
     }
+    edge = {
+        "subscribers": 1_000_000,
+        "edge_nodes": 4,
+        "distinct_keys": 512,
+        "upstream_subs_total": 2048,
+        "fenced_per_s": 412345.6,
+        "fenced_total": 2_031_122,
+        "fanout_s": 4.927,
+        "delivery_ms_p50": 310.1234,
+        "delivery_ms_p99": 2480.5678,
+        "per_edge_rss_mb": 212.4,
+        "attach_sessions_per_s": 31022.0,
+        "evictions": 0,
+        "coalesced_frames": 123,
+    }
     line = json.dumps(
-        _compact_result(7.07e9, detail, live), separators=(",", ":")
+        _compact_result(7.07e9, detail, live, edge=edge), separators=(",", ":")
     )
-    assert len(line) < 2100, f"compact record grew to {len(line)} bytes"
+    assert len(line) < 2500, f"compact record grew to {len(line)} bytes"
     d = json.loads(line)
+    # the edge tier (ISSUE 8): the million-subscriber numbers make the capture
+    assert d["edge"]["subs"] == 1_000_000 and d["edge"]["fenced_per_s"] == 412346
+    assert d["edge"]["delivery_ms_p99"] == 2480.5678
+    assert d["edge"]["per_edge_rss_mb"] == 212.4
+    assert d["edge"]["upstream_subs_total"] == 2048 and d["edge"]["evictions"] == 0
     # every headline field the judge reads must be IN the capture
     assert d["static"]["inv_per_s"] and d["live"]["inv_per_s"]
     assert d["live"]["sustained_inv_per_s"] and d["live"]["wave_chain_ms_p99"]

@@ -1,0 +1,817 @@
+"""Edge tier tests (ISSUE 8): single-upstream coalescing, bounded session
+outboxes, slow-consumer eviction + resume tokens, SSE transport, shard-map
+affinity with mid-run resharding under seeded drop/dup/reorder chaos, and
+the explain()/metrics hop propagation.
+
+The chaos suite's contract: sessions CONVERGE to the oracle (the servers'
+backing store), an evicted slow consumer resumes correctly from its token,
+eviction never delays healthy siblings, and the one-upstream-subscription-
+per-key invariant holds throughout.
+"""
+import asyncio
+import json
+import time
+import urllib.parse
+
+import pytest
+
+from stl_fusion_tpu.client import install_compute_call_type
+from stl_fusion_tpu.core import (
+    ComputeService,
+    FusionHub,
+    compute_method,
+    invalidating,
+    set_default_hub,
+)
+from stl_fusion_tpu.diagnostics import explain, get_activity_source, global_metrics
+from stl_fusion_tpu.edge import (
+    EdgeHttpServer,
+    EdgeNode,
+    KeyedMailbox,
+    LatestWinsMailbox,
+    pump_payloads,
+)
+from stl_fusion_tpu.rpc import RpcHub, RpcTestTransport
+from stl_fusion_tpu.rpc.testing import RpcMultiServerTestTransport
+
+
+class CounterService(ComputeService):
+    """The canonical live test service (test_fanout idiom): a dict of
+    counters; ``increment`` bumps + host-invalidates the read."""
+
+    def __init__(self, hub=None, store=None):
+        super().__init__(hub)
+        self.counters = store if store is not None else {}
+
+    @compute_method
+    async def get(self, key: str) -> int:
+        return self.counters.get(key, 0)
+
+    async def increment(self, key: str):
+        self.counters[key] = self.counters.get(key, 0) + 1
+        with invalidating():
+            await self.get(key)
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub():
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    yield hub
+    set_default_hub(old)
+
+
+def make_stack(wire_codec=True):
+    server_fusion = FusionHub()
+    server_rpc = RpcHub("server")
+    install_compute_call_type(server_rpc)
+    svc = CounterService(server_fusion)
+    server_rpc.add_service("counters", svc)
+    edge_rpc = RpcHub("edge")
+    install_compute_call_type(edge_rpc)
+    transport = RpcTestTransport(edge_rpc, server_rpc, wire_codec=wire_codec)
+    node = EdgeNode("counters", edge_rpc, resume_ttl=30.0)
+    return svc, node, transport, edge_rpc, server_rpc
+
+
+async def settle(seconds: float = 0.05) -> None:
+    deadline = time.perf_counter() + seconds
+    while time.perf_counter() < deadline:
+        await asyncio.sleep(0.005)
+
+
+async def until(pred, timeout: float = 5.0) -> None:
+    async def wait():
+        while not pred():
+            await asyncio.sleep(0.005)
+
+    await asyncio.wait_for(wait(), timeout)
+
+
+async def stop_all(node, *hubs):
+    await node.close()
+    for h in hubs:
+        await h.stop()
+
+
+# ------------------------------------------------------- upstream coalescing
+
+
+async def test_single_upstream_subscription_per_key():
+    """40 sessions over 8 distinct keys cost the server EIGHT ``$sys-c``
+    subscriptions (one inbound compute call per key), not 40×keys — the
+    tentpole invariant. Every session still sees every fence."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    try:
+        keys = [f"k{i}" for i in range(8)]
+        got = [[] for _ in range(40)]
+        sessions = [
+            node.attach(
+                [("get", keys[i % 8]), ("get", keys[(i + 1) % 8])],
+                sink=got[i].append,
+            )
+            for i in range(40)
+        ]
+        await until(lambda: all(len(g) >= 2 for g in got))
+        assert len(node._subs) == 8  # NOT 80
+        # the server holds exactly one registered compute call per key
+        (peer,) = server_rpc.peers.values()
+        await until(lambda: len(peer.inbound_calls) == 8)
+
+        for g in got:
+            g.clear()
+        await svc.increment("k3")
+        # exactly the sessions subscribed to k3 get fenced, with the value
+        expected = [i for i in range(40) if 3 in (i % 8, (i + 1) % 8)]
+        await until(lambda: all(len(got[i]) == 1 for i in expected))
+        for i in expected:
+            key_str, _ver, value, _cause, _t0, err = got[i][0]
+            assert value == 1 and err is None
+            assert key_str == node.key_str(("get", "k3"))
+        assert all(not got[i] for i in range(40) if i not in expected)
+        # metric-asserted: the exposition carries the invariant
+        text = global_metrics().render_prometheus()
+        assert "fusion_edge_sessions 40" in text
+        assert "fusion_edge_upstream_subscriptions 8" in text
+        del sessions
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_mailbox_latest_wins_coalescing():
+    """A non-draining session's mailbox holds ONE pending frame per key no
+    matter how many fences land; the drained batch carries the newest
+    value; drops are counted in the node's coalesced-frames counter."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    try:
+        mailbox = KeyedMailbox()
+        node.attach([("get", "a")], mailbox=mailbox)
+        await until(lambda: len(mailbox) == 1)  # initial value pending
+        for _ in range(5):
+            await svc.increment("a")
+            await until(lambda: node._subs[node.key_str(("get", "a"))].version >= 2)
+        await until(lambda: len(node._subs[node.key_str(("get", "a"))].sessions) == 1)
+        # let the upstream loop drain all five fences
+        await until(lambda: svc.counters["a"] == 5)
+
+        async def drained():
+            while True:
+                batch = await mailbox.take()
+                if any(f[2] == 5 for f in batch):
+                    return batch
+
+        batch = await asyncio.wait_for(drained(), 5.0)
+        assert len(batch) == 1  # one key -> one pending frame
+        assert len(mailbox) == 0
+        assert node.coalesced_frames >= 1
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+# ------------------------------------------------------- eviction + resume
+
+
+async def test_slow_consumer_evicted_without_delaying_healthy():
+    """A stalled session (send never completes) is evicted after
+    send_timeout WITH a resume token; a healthy sibling on the SAME key
+    observes the fence orders of magnitude sooner than the eviction
+    timeout — the chaos-suite measurement that eviction never stalls
+    siblings."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    try:
+        healthy_at: list = []
+        healthy_box = KeyedMailbox()
+        healthy = node.attach([("get", "a")], mailbox=healthy_box)
+
+        async def healthy_send(batch):
+            healthy_at.append(time.perf_counter())
+            healthy.mark_delivered(batch)
+
+        stalled_box = KeyedMailbox()
+        stalled = node.attach([("get", "a")], mailbox=stalled_box)
+        stall_gate = asyncio.Event()  # never set: the peer stopped reading
+
+        async def stalled_send(batch):
+            await stall_gate.wait()
+
+        send_timeout = 0.5
+        tokens: list = []
+
+        def on_evict():
+            tokens.append(node.evict(stalled, reason="test stall"))
+
+        pumps = [
+            asyncio.ensure_future(pump_payloads(healthy_box, healthy_send)),
+            asyncio.ensure_future(
+                pump_payloads(
+                    stalled_box, stalled_send,
+                    send_timeout=send_timeout, on_evict=on_evict,
+                )
+            ),
+        ]
+        await until(lambda: len(healthy_at) >= 1)  # initial frames flowing
+        healthy_at.clear()
+
+        t0 = time.perf_counter()
+        await svc.increment("a")
+        await until(lambda: len(healthy_at) >= 1)
+        healthy_latency = healthy_at[0] - t0
+        assert healthy_latency < send_timeout / 2, (
+            f"healthy delivery took {healthy_latency:.3f}s — delayed by the "
+            f"stalled sibling"
+        )
+        # the stalled session is evicted (with a token), healthy untouched
+        await until(lambda: node.evictions >= 1, timeout=send_timeout * 4)
+        assert tokens and tokens[0] is not None
+        assert stalled.evicted and not healthy.evicted
+        assert stalled.token in node._parked
+
+        # ... and the evictee RESUMES from its token: it sees the current
+        # value it missed (version-gated replay)
+        await svc.increment("a")
+        await until(lambda: svc.counters["a"] == 2)
+        await settle()
+        resumed_frames: list = []
+        resumed = node.resume(tokens[0], sink=resumed_frames.append)
+        await until(lambda: len(resumed_frames) >= 1)
+        assert resumed_frames[-1][2] == 2  # converged to the oracle
+        assert resumed.token == tokens[0]
+        assert node.resumes == 1
+        for p in pumps:
+            p.cancel()
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_mailbox_overflow_evicts_with_resume():
+    """A session whose pending set outgrows max_pending (a slow consumer
+    under a many-key burst) is evicted with a resume token instead of
+    growing without bound."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    node.max_pending = 2
+    try:
+        mailbox = KeyedMailbox(max_pending=2)
+        session = node.attach(
+            [("get", "a"), ("get", "b"), ("get", "c"), ("get", "d")],
+            mailbox=mailbox,
+        )
+        # four initial frames against a bound of two: overflow -> evicted
+        await until(lambda: session.evicted)
+        assert node.evictions == 1
+        assert session.token in node._parked
+        resumed: list = []
+        node.resume(session.token, sink=resumed.append)
+        await until(lambda: len(resumed) == 4)  # replays all four keys
+        assert {f[0] for f in resumed} == set(session.keys)
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_broken_sink_evicted_without_killing_the_key():
+    """Review hardening: one consumer whose sink RAISES is contained as an
+    eviction (with its on_evicted transport hook fired) — the key's watch
+    loop and every sibling session keep flowing."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    try:
+        good: list = []
+        node.attach([("get", "a")], sink=good.append)
+
+        def bad_sink(frame):
+            raise RuntimeError("consumer bug")
+
+        shutdowns: list = []
+        # replay_current=False: the hook is installed before ANY delivery,
+        # so containment fires in the fan loop (the transport shape)
+        broken = node.attach([("get", "a")], sink=bad_sink, replay_current=False)
+        broken.on_evicted = lambda: shutdowns.append(1)
+        await until(lambda: len(good) >= 1)
+        await svc.increment("a")
+        await until(lambda: broken.evicted)  # the fence trips containment
+        assert node.evictions == 1 and shutdowns == [1]
+        assert broken.token in node._parked
+
+        good.clear()
+        await svc.increment("a")  # the key is still live for the sibling
+        await until(lambda: any(f[2] == 2 for f in good))
+        sub = node._subs[node.key_str(("get", "a"))]
+        assert not sub.task.done()  # the watch loop survived the bad sink
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_resume_replays_only_missed_keys():
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    try:
+        got: list = []
+        session = node.attach([("get", "a"), ("get", "b")], sink=got.append)
+        await until(lambda: len(got) >= 2)
+        token = node.detach(session, park=True)
+        assert token is not None
+        await svc.increment("b")  # only b moves while parked
+        await until(lambda: svc.counters.get("b") == 1)
+        await settle()
+        resumed: list = []
+        node.resume(token, sink=resumed.append)
+        await until(lambda: len(resumed) >= 1)
+        await settle(0.02)
+        assert len(resumed) == 1 and resumed[0][0] == node.key_str(("get", "b"))
+        assert resumed[0][2] == 1
+        with pytest.raises(KeyError):
+            node.resume("es-nonsense-0", sink=lambda f: None)
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+# ------------------------------------------------------- chaos + resharding
+
+
+async def test_chaos_reshard_sessions_converge_to_oracle():
+    """The acceptance scenario: seeded drop/dup/reorder on the upstream
+    link, two servers, a MID-RUN reshard moving ~half the keys to a new
+    owner — sessions converge to the oracle, the single-upstream invariant
+    holds throughout, and moved keys re-pin at the map's owner without any
+    downstream session noticing (no detach, no eviction)."""
+    from stl_fusion_tpu.cluster import ShardMap, ShardMapRouter
+    from stl_fusion_tpu.resilience import ChaosPolicy
+
+    store: dict = {}  # shared backing truth = the oracle
+    servers = {}
+    services = {}
+    for ref in ("s0", "s1"):
+        fusion = FusionHub()
+        rpc = RpcHub(ref)
+        install_compute_call_type(rpc)
+        svc = CounterService(fusion, store=store)
+        rpc.add_service("counters", svc)
+        servers[ref] = rpc
+        services[ref] = svc
+
+    edge_rpc = RpcHub("edge")
+    install_compute_call_type(edge_rpc)
+    transport = RpcMultiServerTestTransport(edge_rpc, servers, wire_codec=True)
+    transport.set_chaos(ChaosPolicy(seed=1234, drop=0.06, duplicate=0.05, reorder_window=3))
+    router = ShardMapRouter(edge_rpc, shard_map=ShardMap.initial(["s0"], epoch=1))
+    node = EdgeNode("counters", edge_rpc, router=router)
+
+    async def write(key: str) -> None:
+        """One oracle write: bump the store, invalidate on BOTH servers
+        (each sees the shared truth; whichever owns the key fences the
+        edge's subscription there)."""
+        store[key] = store.get(key, 0) + 1
+        for svc in services.values():
+            with invalidating():
+                await svc.get(key)
+
+    try:
+        keys = [f"key-{i}" for i in range(16)]
+        key_of = {node.key_str(("get", k)): k for k in keys}
+        last_seen: dict = {}
+
+        def sink_for(sid):
+            def sink(frame):
+                last_seen[(sid, frame[0])] = frame
+            return sink
+
+        sessions = [
+            node.attach([("get", k) for k in keys[i % 4 :: 4]], sink=sink_for(i))
+            for i in range(12)
+        ]
+        await until(lambda: len(node._subs) == 16)
+
+        for round_no in range(3):
+            for i, k in enumerate(keys):
+                if (i + round_no) % 3 == 0:
+                    await write(k)
+            assert len(node._subs) == 16  # invariant under churn
+            await settle(0.05)
+            if round_no == 1:
+                # MID-RUN reshard: add s1 -> ~half the shards move
+                old_map = router.shard_map
+                node.apply_map(old_map.with_members(["s0", "s1"]))
+                moved = ShardMap.diff(old_map, router.shard_map)
+                assert moved  # the scenario actually moved something
+
+        await until(lambda: node.resubscribes > 0, timeout=10.0)  # keys re-pinned
+        assert all(not s.evicted for s in sessions)
+        transport.set_chaos(None)
+
+        # final writes after the storm; then CONVERGENCE: every session's
+        # last-seen value per key equals the oracle
+        for k in keys:
+            await write(k)
+
+        def converged() -> bool:
+            for sid, session in enumerate(sessions):
+                for ks in session.keys:
+                    frame = last_seen.get((sid, ks))
+                    if frame is None or frame[5] is not None:
+                        return False
+                    if frame[2] != store[key_of[ks]]:
+                        return False
+            return True
+
+        await until(converged, timeout=20.0)
+        # upstream placement settles at the final map's owners (a repin's
+        # re-capture can still be in flight right at convergence), one sub
+        # per key throughout
+        assert len(node._subs) == 16
+
+        def placed() -> bool:
+            return all(
+                sub.peer_ref
+                == router.shard_map.owner_of(
+                    router.key_for("counters", sub.method, sub.args)
+                )
+                for sub in node._subs.values()
+            )
+
+        await until(placed, timeout=10.0)
+        assert node.evictions == 0  # chaos never cost a downstream session
+    finally:
+        await node.close()
+        await edge_rpc.stop()
+        for rpc in servers.values():
+            await rpc.stop()
+
+
+# ------------------------------------------------------- observability hop
+
+
+async def test_explain_spans_server_edge_session_and_metrics():
+    """Satellite: the fence's cause id + origin timestamp propagate into
+    edge frames; ClientComputed exposes invalidation_origin_ts; the edge
+    delivery histogram records fence→client-visible; explain() renders the
+    extra hop ("edge re-fanned to N downstream session(s))"."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    try:
+        frames: list = []
+        node.attach([("get", "a")], sink=frames.append)
+        node.attach([("get", "a")], sink=frames.append)
+        await until(lambda: len(frames) >= 2)
+        frames.clear()
+        hist = global_metrics().histogram(
+            "fusion_edge_delivery_ms",
+            help="server fence (wave apply) -> edge session client-visible",
+        )
+        count0 = hist.count
+        with get_activity_source("edge.test").span("bump"):
+            await svc.increment("a")
+        await until(lambda: len(frames) >= 2)
+        for _key, ver, value, cause, t0, err in frames:
+            assert ver == 2 and value == 1 and err is None
+            assert cause is not None and "edge.test:bump" in cause
+            assert t0 is not None
+        # the system's own delivery number moved
+        assert hist.count == count0 + 2
+        # the upstream ClientComputed carries the origin timestamp
+        key_str = node.key_str(("get", "a"))
+        sub = node._subs[key_str]
+        assert sub.version == 2
+        ex = explain(key_str)
+        assert any(
+            "edge re-fanned to 2 downstream session(s)" in line
+            for line in ex["chain"]
+        ), ex["chain"]
+        assert ex["invalidation"]["edge_sessions_fenced"] == 2
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_client_computed_exposes_invalidation_origin_ts():
+    from stl_fusion_tpu.client import compute_client
+    from stl_fusion_tpu.core import capture
+
+    server_fusion = FusionHub()
+    server_rpc = RpcHub("server")
+    install_compute_call_type(server_rpc)
+    svc = CounterService(server_fusion)
+    server_rpc.add_service("counters", svc)
+    client_rpc = RpcHub("client")
+    install_compute_call_type(client_rpc)
+    RpcTestTransport(client_rpc, server_rpc, wire_codec=True)
+    client = compute_client("counters", client_rpc, FusionHub())
+    try:
+        node = await capture(lambda: client.get("a"))
+        assert node.invalidation_origin_ts is None  # consistent: no fence yet
+        await svc.increment("a")
+        await asyncio.wait_for(node.when_invalidated(), 5.0)
+        assert node.invalidation_origin_ts is not None
+    finally:
+        await client_rpc.stop()
+        await server_rpc.stop()
+
+
+async def test_monitor_reports_edge_section(fresh_hub):
+    from stl_fusion_tpu.diagnostics import FusionMonitor
+
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    monitor = FusionMonitor(fresh_hub).attach_edge(node)
+    try:
+        got: list = []
+        node.attach([("get", "a")], sink=got.append)
+        await until(lambda: len(got) >= 1)
+        report = monitor.report()
+        (snap,) = report["edge"]
+        assert snap["sessions"] == 1 and snap["upstream_subscriptions"] == 1
+        assert snap["frames_fanned"] >= 1
+    finally:
+        monitor.dispose()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+# ------------------------------------------------------- shared pump core
+
+
+async def test_pump_rate_limit_ships_newest():
+    """The shared pump (ui/web.py + edge transports): under a rate limit a
+    burst collapses to the NEWEST payload at send time."""
+    slot = LatestWinsMailbox()
+    sent: list = []
+
+    async def send(p):
+        sent.append(p)
+
+    task = asyncio.ensure_future(
+        pump_payloads(slot, send, min_send_interval=0.1)
+    )
+    try:
+        slot.push("v0")
+        await until(lambda: sent == ["v0"])
+        for i in range(10):
+            slot.push(f"v{i + 1}")
+            await asyncio.sleep(0.005)
+        await until(lambda: len(sent) >= 2)
+        assert sent[1] == "v10"  # newest at send time, not v1
+        assert slot.coalesced >= 1
+    finally:
+        task.cancel()
+
+
+async def test_pump_heartbeat_and_eviction():
+    """Idle connections heartbeat; a send that cannot progress for
+    send_timeout evicts (on_evict ran, pump exited 'evicted')."""
+    slot = LatestWinsMailbox()
+    beats: list = []
+    gate = asyncio.Event()
+    evicted: list = []
+
+    async def send(p):
+        await gate.wait()  # stalled peer
+
+    async def heartbeat():
+        beats.append(1)
+
+    task = asyncio.ensure_future(
+        pump_payloads(
+            slot, send,
+            send_timeout=0.2, heartbeat_interval=0.05,
+            heartbeat=heartbeat, on_evict=lambda: evicted.append(1),
+        )
+    )
+    await until(lambda: len(beats) >= 2)  # idle -> heartbeats flow
+    slot.push("payload")
+    assert await asyncio.wait_for(task, 5.0) == "evicted"
+    assert evicted == [1]
+
+
+async def test_idle_gateway_sweeps_expired_parked_sessions():
+    """Review hardening: a gateway that goes QUIESCENT after its last
+    disconnect still releases expired parked refs (timer-driven sweep) —
+    upstream subscriptions follow distinct-key demand even with no
+    further connection churn to drive the purge."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    node.resume_ttl = 0.3
+    try:
+        got: list = []
+        session = node.attach([("get", "a")], sink=got.append)
+        await until(lambda: len(got) >= 1)
+        node.detach(session, park=True)
+        assert len(node._subs) == 1  # parked ref pins the sub for resume
+        # NO further activity: the sweep timer alone must tear it down
+        # (fires at max(1s, ttl/2) after the park)
+        await until(
+            lambda: not node._parked and len(node._subs) == 0, timeout=5.0
+        )
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_keyed_take_nowait_merges_rate_limited_batch():
+    """Review hardening: under a rate limit, frames that land during the
+    sleep MERGE per key with the already-taken batch — another key's only
+    update must never be dropped wholesale."""
+    mailbox = KeyedMailbox()
+    mailbox.push(("A", 1, "a1", None, None, None))
+    taken = await mailbox.take()
+    assert [f[0] for f in taken] == ["A"]
+    mailbox.push(("B", 1, "b1", None, None, None))
+    merged = mailbox.take_nowait(taken)
+    assert {f[0] for f in merged} == {"A", "B"}  # A survived the merge
+    # a newer frame for the SAME key supersedes the taken one
+    mailbox.push(("A", 2, "a2", None, None, None))
+    merged = mailbox.take_nowait(merged)
+    by_key = {f[0]: f for f in merged}
+    assert by_key["A"][1] == 2 and by_key["B"][1] == 1
+
+
+async def test_evict_is_idempotent():
+    """Racing eviction paths (fan-loop overflow vs pump send-timeout)
+    count — and fire the transport hook — exactly once."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    try:
+        got: list = []
+        session = node.attach([("get", "a")], sink=got.append)
+        hooks: list = []
+        session.on_evicted = lambda: hooks.append(1)
+        await until(lambda: len(got) >= 1)
+        token1 = node.evict(session, reason="first")
+        token2 = node.evict(session, reason="racing second")
+        assert token1 is not None and token2 is None
+        assert node.evictions == 1 and hooks == [1]
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_key_allowlist_and_per_session_cap():
+    """Review hardening: the browser-facing key specs are gated — a method
+    allowlist (underscore names always rejected) and a per-session
+    distinct-key cap bound what one connection can reach and mint; the
+    SSE surface answers 400, never executes."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    node.allowed_methods = frozenset({"get"})
+    node.max_keys_per_session = 2
+    try:
+        with pytest.raises(ValueError):
+            node.attach([("increment", "a")], sink=lambda f: None)
+        with pytest.raises(ValueError):
+            node.attach([("_secret",)], sink=lambda f: None)
+        with pytest.raises(ValueError):
+            node.attach(
+                [("get", "a"), ("get", "b"), ("get", "c")], sink=lambda f: None
+            )
+        assert len(node._subs) == 0 and len(node._sessions) == 0
+
+        http = await EdgeHttpServer(node).start()
+        try:
+            bad = urllib.parse.quote(json.dumps([["increment", "a"]]))
+            reader, writer = await asyncio.open_connection(http.host, http.port)
+            writer.write(f"GET /edge/sse?keys={bad} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+            await writer.drain()
+            assert "400" in await skip_headers(reader)
+            writer.close()
+        finally:
+            await http.stop()
+        assert svc.counters == {}  # the disallowed method never ran
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_resume_validates_args_without_consuming_token():
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    try:
+        got: list = []
+        session = node.attach([("get", "a")], sink=got.append)
+        await until(lambda: len(got) >= 1)
+        token = node.detach(session, park=True)
+        with pytest.raises(ValueError):
+            node.resume(token)  # neither sink nor mailbox: API misuse
+        # the parked entry SURVIVED the bad call — a correct resume works
+        resumed: list = []
+        node.resume(token, sink=resumed.append)
+        assert node.resumes == 1
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+# ------------------------------------------------------- SSE transport
+
+
+async def read_sse_event(reader) -> dict:
+    fields: dict = {}
+    while True:
+        line = (await asyncio.wait_for(reader.readline(), 5.0)).decode()
+        if line == "":
+            raise EOFError("stream closed")
+        if line in ("\n", "\r\n"):
+            if fields:
+                return fields
+            continue
+        if line.startswith(":"):
+            fields.setdefault("comment", line[1:].strip())
+            continue
+        name, _, value = line.rstrip("\n").partition(":")
+        fields[name] = value.strip()
+
+
+async def skip_headers(reader) -> str:
+    status = (await asyncio.wait_for(reader.readline(), 5.0)).decode()
+    while True:
+        line = (await asyncio.wait_for(reader.readline(), 5.0)).decode()
+        if line == "":
+            raise EOFError("connection closed during headers")
+        if line in ("\r\n", "\n"):
+            return status
+
+
+async def test_sse_stream_heartbeat_and_last_event_id_resume():
+    """A real SSE consumer over TCP: hello (id = resume token), initial
+    value, live update, comment heartbeat; after a disconnect the
+    browser-style Last-Event-ID reconnect replays the newest missed value
+    exactly once (latest-wins: offline fences coalesce)."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    http = await EdgeHttpServer(node, heartbeat_interval=0.15).start()
+    try:
+        keys = urllib.parse.quote(json.dumps([["get", "a"]]))
+        reader, writer = await asyncio.open_connection(http.host, http.port)
+        writer.write(f"GET /edge/sse?keys={keys} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+        await writer.drain()
+        assert "200" in await skip_headers(reader)
+        hello = await read_sse_event(reader)
+        assert hello["event"] == "hello"
+        token = hello["id"]
+        first = json.loads((await read_sse_event(reader))["data"])
+        assert first["value"] == 0 and first["ver"] == 1
+        await svc.increment("a")
+        update = json.loads((await read_sse_event(reader))["data"])
+        assert update["value"] == 1 and update["ver"] == 2
+        assert "t0" in update  # origin timestamp propagated to the wire
+        heartbeat = await read_sse_event(reader)
+        assert "comment" in heartbeat
+        writer.close()
+        await until(lambda: token in node._parked, timeout=10.0)
+
+        await svc.increment("a")
+        await svc.increment("a")
+        await until(lambda: svc.counters["a"] == 3)
+        await settle()
+        reader, writer = await asyncio.open_connection(http.host, http.port)
+        writer.write(
+            f"GET /edge/sse HTTP/1.1\r\nHost: x\r\nLast-Event-ID: {token}\r\n\r\n".encode()
+        )
+        await writer.drain()
+        assert "200" in await skip_headers(reader)
+        hello = await read_sse_event(reader)
+        assert hello["event"] == "hello" and hello["id"] == token
+        replay = json.loads((await read_sse_event(reader))["data"])
+        # offline fences coalesced: ONE replay, at the oracle value
+        assert replay["value"] == 3 and replay["ver"] >= 3
+        writer.close()
+        assert node.resumes == 1
+    finally:
+        await http.stop()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_sse_answers_409_when_replay_overflows():
+    """Review hardening: an attach whose REPLAY overflows the session
+    outbox (mailbox bound below the key count) answers 409 with the
+    resume token — never a silent heartbeat-alive stream on a dead,
+    already-evicted subscription."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    node.max_pending = 2
+    http = await EdgeHttpServer(node).start()
+    try:
+        warm: list = []
+        node.attach([("get", k) for k in "abcd"], sink=warm.append)
+        await until(lambda: len(warm) >= 4)  # all four keys hold a frame
+        keys = urllib.parse.quote(json.dumps([["get", k] for k in "abcd"]))
+        reader, writer = await asyncio.open_connection(http.host, http.port)
+        writer.write(f"GET /edge/sse?keys={keys} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+        await writer.drain()
+        status = await skip_headers(reader)
+        body = await asyncio.wait_for(reader.read(), 5.0)
+        writer.close()
+        assert "409" in status
+        payload = json.loads(body)
+        assert payload["error"]["type"] == "Evicted" and payload["error"]["resume"]
+    finally:
+        await http.stop()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_sse_rejects_bad_requests():
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    http = await EdgeHttpServer(node).start()
+    try:
+        async def get(path):
+            reader, writer = await asyncio.open_connection(http.host, http.port)
+            writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+            await writer.drain()
+            status = await skip_headers(reader)
+            body = await asyncio.wait_for(reader.read(), 5.0)
+            writer.close()
+            return status, body
+
+        status, _ = await get("/edge/sse?keys=not-json")
+        assert "400" in status
+        status, _ = await get("/edge/sse?resume=es-unknown-1")
+        assert "410" in status
+        status, body = await get("/edge/stats")
+        assert "200" in status and b"upstream_subscriptions" in body
+        status, body = await get("/metrics")
+        assert "200" in status and b"fusion_edge_sessions" in body
+        status, _ = await get("/nope")
+        assert "404" in status
+    finally:
+        await http.stop()
+        await stop_all(node, edge_rpc, server_rpc)
