@@ -556,6 +556,46 @@ def run_cluster_section():
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def run_mesh_section():
+    """Embedded mesh-sharded graph measurement (ISSUE 9): perf/mesh_path.py
+    as a subprocess under a FUSION_BENCH_MESH_DEVICES virtual device pool —
+    the north-star sharded graph (FUSION_BENCH_MESH_NODES, default 80M =
+    8x the single-device 10M) sustaining cascading invalidation with
+    cross-shard frontiers resolved via collectives, oracle-exact, plus the
+    live routed-pipeline leg (fused chains, mid-burst device-shard
+    reshard, relay-scope gate). FUSION_BENCH_MESH_NODES=0 skips."""
+    import subprocess
+
+    nodes = int(os.environ.get("FUSION_BENCH_MESH_NODES", 80_000_000))
+    if nodes <= 0:
+        return None
+    devices = int(os.environ.get("FUSION_BENCH_MESH_DEVICES", 8))
+    env = dict(os.environ, MESH_NODES=str(nodes), JAX_PLATFORMS="cpu")
+    # the subprocess needs its own virtual pool — REPLACE any inherited
+    # single-device XLA_FLAGS rather than appending a duplicate flag
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "perf", "mesh_path.py"
+    )
+    try:
+        # the 80M static leg measured ~33 min end to end on the 2-core
+        # virtual mesh (MULTICHIP_r06 / PERF.md §6) — give it slack
+        proc = subprocess.run(
+            [sys.executable, script], env=env, stdout=subprocess.PIPE, text=True,
+            timeout=5400,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "mesh path timed out"}
+    if proc.returncode != 0:
+        return {"error": f"mesh path failed rc={proc.returncode} (stderr inherited above)"}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def run_edge_section():
     """Embedded edge-tier measurement (ISSUE 8): perf/edge_path.py as a
     subprocess — FUSION_BENCH_EDGE_SESSIONS simulated end-user sessions
@@ -620,6 +660,9 @@ def main() -> None:
     edge = run_edge_section()
     if edge is not None:
         detail["edge"] = edge
+    mesh = run_mesh_section()
+    if mesh is not None:
+        detail["mesh"] = mesh
     result = {
         "metric": "cascading_invalidations_per_sec",
         "value": round(inv_per_sec, 1),
@@ -634,7 +677,7 @@ def main() -> None:
     print("# full record: " + json.dumps(result), file=sys.stderr, flush=True)
     print(
         json.dumps(
-            _compact_result(inv_per_sec, detail, live, fanout, cluster, edge),
+            _compact_result(inv_per_sec, detail, live, fanout, cluster, edge, mesh),
             separators=(",", ":"),
         )
     )
@@ -667,7 +710,8 @@ def _pos_ms(fields: dict) -> dict:
 
 
 def _compact_result(
-    inv_per_sec: float, detail: dict, live, fanout=None, cluster=None, edge=None
+    inv_per_sec: float, detail: dict, live, fanout=None, cluster=None, edge=None,
+    mesh=None,
 ) -> dict:
     """The single stdout line: every headline metric, nothing that scales
     with run verbosity, target well under the driver's tail window."""
@@ -809,6 +853,37 @@ def _compact_result(
             "attach_sessions_per_s": _r(edge.get("attach_sessions_per_s"), 0),
             "evictions": edge.get("evictions"),
             "coalesced_frames": edge.get("coalesced_frames"),
+        }
+    if mesh is not None and "error" in mesh:
+        out["mesh"] = {"error": mesh["error"]}
+    elif mesh is not None:
+        # the mesh-sharded device graph (ISSUE 9): MULTICHIP numbers stop
+        # living only in the dry-run tail string — the north-star sharded
+        # graph + the live routed-pipeline leg, compact
+        st = mesh.get("static") or {}
+        lv = mesh.get("live") or {}
+        out["mesh"] = {
+            "ok": mesh.get("ok"),
+            "devices": mesh.get("mesh_devices"),
+            "nodes": st.get("nodes"),
+            "edges": st.get("edges"),
+            "vs_single_device_10m": st.get("vs_single_device_10m"),
+            "exchange": st.get("exchange"),
+            "waves": st.get("waves"),
+            "total_inv": st.get("total_invalidated"),
+            "inv_per_s": st.get("inv_per_s"),
+            "exchange_levels": st.get("exchange_levels"),
+            "oracle_exact": st.get("oracle_exact"),
+            "build_s": st.get("build_s"),
+            "live_nodes": lv.get("nodes"),
+            "routed_waves": lv.get("routed_waves"),
+            "wave_chain_ms_p50": lv.get("wave_chain_ms_p50"),
+            "wave_chain_ms_p99": lv.get("wave_chain_ms_p99"),
+            "reshard_moves": lv.get("reshard_moves"),
+            "oracle_divergence": lv.get("oracle_divergence"),
+            "mesh_member_relays": lv.get("mesh_member_relays"),
+            "eager_waves": (lv.get("pipeline") or {}).get("eager_waves"),
+            "violations": mesh.get("violations"),
         }
     # cold vs warm start (ISSUE 6): the rebuild bill a restart used to pay
     # (mirror build + program warm-up) beside what the durable path pays

@@ -136,15 +136,14 @@ class RpcOutboundComputeCall(RpcOutboundCall):
         ``cause``/``origin_ts`` arrive from the ``$sys-c`` frame: the cause
         links this fence to its originating server wave; the origin
         timestamp yields the end-to-end delivery sample recorded into the
-        process histogram (``fusion_e2e_delivery_ms``). The timestamp is a
-        ``perf_counter`` value — the histogram is only TRUSTWORTHY when
-        both ends share the clock (in-process / same-host stacks, the
-        bench/test/CI shape). Across hosts perf_counter epochs are
-        unrelated: the range guard below rejects the samples that land
-        outside [0, 1h) but CANNOT detect epochs that happen to differ by
-        less — a cross-host deployment must treat this histogram as
-        unreliable until a wall-clock variant ships (OBSERVABILITY.md
-        lists it as an open item)."""
+        process histogram (``fusion_e2e_delivery_ms``). The timestamp is
+        the sender's ``perf_counter`` value; since ISSUE 9 it is mapped
+        onto the local timeline through the peer's probed clock offset
+        (diagnostics/clocksync.py — one NTP-style probe per connect, so
+        cross-host samples are accurate to ~RTT/2 instead of meaningless).
+        Never-probed peers keep the identity mapping, which is exact for
+        the in-process / same-host stacks. The range guard below remains
+        the belt for unprobed cross-host epochs."""
         if cause is not None:
             self.invalidation_cause = cause
         if RECORDER.enabled:
@@ -158,6 +157,17 @@ class RpcOutboundComputeCall(RpcOutboundCall):
                 detail=f"call#{self.call_id} peer={getattr(self.peer, 'ref', '?')}",
             )
         if origin_ts is not None:
+            # map the sender's perf_counter stamp onto the LOCAL timeline
+            # through the peer's probed clock offset (ISSUE 9: cross-host
+            # clock-safe delivery timestamps — identity for never-probed
+            # same-clock stacks, so in-process transports keep the exact
+            # old behavior). The corrected value is what we STORE, so the
+            # edge tier's delivery hop inherits the correction for free.
+            from ..diagnostics.clocksync import global_clock_sync
+
+            origin_ts = global_clock_sync().to_local(
+                getattr(self.peer, "ref", None), origin_ts
+            )
             self.invalidation_origin_ts = origin_ts
             delta_ms = (time.perf_counter() - origin_ts) * 1e3
             if 0.0 <= delta_ms < 3.6e6:  # range guard, NOT skew detection

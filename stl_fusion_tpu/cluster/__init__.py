@@ -26,8 +26,13 @@ metrics registry. CLUSTER.md is the runbook.
   above its watermark, re-announce to membership, and fence exactly the
   keys whose shard assignment changed between the snapshot epoch and the
   current epoch. DURABILITY.md is the runbook.
+- :mod:`.placement` — ``DevicePlacement`` (ISSUE 9): the shard map's
+  DEVICE half — the same epoch-versioned assignment extended onto the
+  accelerator mesh, pinning each member's CSR slice to its devices; the
+  layout contract parallel/routed_wave.py builds on.
 """
 from .membership import ClusterMember
+from .placement import DevicePlacement, PlacementError
 from .rebalancer import ClusterRebalancer
 from .rejoin import RejoinReport, fence_moved_keys, verify_restore, warm_rejoin
 from .router import (
@@ -44,6 +49,8 @@ __all__ = [
     "ClusterMember",
     "ClusterRebalancer",
     "DEFAULT_SHARDS",
+    "DevicePlacement",
+    "PlacementError",
     "EPOCH_HEADER",
     "FAILOVER_HEADER",
     "RejoinReport",

@@ -58,6 +58,11 @@ class ClusterRebalancer:
         #: RoutingComputeProxy instances whose per-peer FusionClients this
         #: rebalancer evicts when their peer departs
         self._proxies: List = []
+        #: TpuGraphBackends with mesh routing enabled: an applied epoch
+        #: MOVES their device shards (ISSUE 9 — the rebalancer relocates
+        #: the graph slice itself, not just the cached calls)
+        self._backends: List = []
+        self.device_shards_moved = 0
         self.resharded_keys = 0
         self.peers_retired = 0
         self.rebalances = 0
@@ -70,11 +75,21 @@ class ClusterRebalancer:
             "fusion_resharded_keys_total": self.resharded_keys,
             "fusion_cluster_peers_retired_total": self.peers_retired,
             "fusion_rebalances_total": self.rebalances,
+            "fusion_mesh_rebalancer_shards_moved_total": self.device_shards_moved,
         }
 
     def attach_proxy(self, proxy) -> "ClusterRebalancer":
         """Register a ``RoutingComputeProxy`` for departed-peer eviction."""
         self._proxies.append(proxy)
+        return self
+
+    def attach_backend(self, backend) -> "ClusterRebalancer":
+        """Register a mesh-routing ``TpuGraphBackend``: every applied epoch
+        then moves the reassigned DEVICE SHARDS on the mesh (state blocks
+        transfer on-device, exchange routes re-pack) in the same change
+        that fences the moved keys' client caches — the cache-fencing +
+        shard-moving pair the ISSUE 9 acceptance requires."""
+        self._backends.append(backend)
         return self
 
     def dispose(self) -> None:
@@ -122,6 +137,11 @@ class ClusterRebalancer:
                     fenced += 1
         self.resharded_keys += fenced
         self.rebalances += 1
+        for backend in self._backends:
+            try:
+                self.device_shards_moved += backend.apply_mesh_reshard(new)
+            except Exception:  # noqa: BLE001 — a mesh move must never block the map
+                log.exception("mesh device-shard move failed; mirror will rebuild")
         departed = set(old.members) - set(new.members)
         for ref in departed:
             self._retire_peer(ref)
@@ -181,4 +201,5 @@ class ClusterRebalancer:
             "resharded_keys": self.resharded_keys,
             "peers_retired": self.peers_retired,
             "rebalances": self.rebalances,
+            "device_shards_moved": self.device_shards_moved,
         }

@@ -355,6 +355,30 @@ def explain(
         )
     else:
         chain.append(f"{key_str} invalidated (host-led, no device wave)")
+    mesh_info = wave_rec.get("mesh") if wave_rec is not None else None
+    if mesh_info is not None:
+        # ISSUE 9: the shard hop, named. The frontier crossed device
+        # shards INSIDE the wave (mesh collectives) — the ~80 ms per-key
+        # host-relay hop this line used to imply is gone for on-mesh keys.
+        line = (
+            f"cross-shard frontier exchanged on-mesh via {mesh_info['exchange']} "
+            f"collectives ({mesh_info['levels']} level(s) over "
+            f"{mesh_info['n_dev']} devices, placement epoch "
+            f"{mesh_info['epoch']}) — no host-relay hop"
+        )
+        # place THIS key's device shard when the backend can
+        entry = getattr(backend, "_routed_mirror", None) if backend is not None else None
+        nid = backend.id_for(computed) if (backend is not None and computed is not None) else None
+        if entry is not None and nid is not None:
+            pl = entry["graph"].placement
+            shard = pl.shard_of_node(nid)
+            if pl.on_mesh(shard):
+                dev = int(pl.shard_dev[shard])
+                line += (
+                    f"; key's device shard #{shard} lives on device {dev} "
+                    f"(member {pl.member_of_device(dev)})"
+                )
+        chain.append(line)
     if cause is not None:
         line = f"caused by {cause}"
         if host is not None:

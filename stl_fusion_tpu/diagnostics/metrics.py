@@ -511,6 +511,7 @@ class WaveProfiler:
         fused_depth: Optional[int] = None,
         seq_span: Optional[tuple] = None,
         dispatches: Optional[int] = None,
+        mesh: Optional[dict] = None,
     ) -> None:
         if not self.enabled:
             return
@@ -536,6 +537,10 @@ class WaveProfiler:
             rec["seq_span"] = [int(seq_span[0]), int(seq_span[1])]
         if dispatches is not None:
             rec["dispatches"] = int(dispatches)
+        if mesh is not None:
+            # the shard hop: exchange mode, collective levels, placement
+            # epoch — explain() renders it ("frontier exchanged on-mesh")
+            rec["mesh"] = dict(mesh)
         if self._pending_flush is not None:
             rec.update(self._pending_flush)
             self._pending_flush = None

@@ -284,6 +284,8 @@ class TpuGraphBackend:
         self._block_by_table: Dict[int, RowBlock] = {}
         self._sharded_mirror: Optional[dict] = None  # see sharded_mirror
         self._packed_mirror: Optional[dict] = None  # see packed_mirror
+        self._routed_mirror: Optional[dict] = None  # see routed_mirror
+        self._routed_config: Optional[dict] = None  # see enable_mesh_routing
         #: optional resilience.WaveWatchdog: when attached, union/lane burst
         #: dispatches route through it (deadline + fault containment with a
         #: split-host-loop fallback); None = direct dispatch, zero overhead
@@ -391,7 +393,7 @@ class TpuGraphBackend:
 
     def _profile_wave(
         self, kind, seeds, cause, t0, t1, newly, seq, groups=None,
-        fused_depth=None, seq_span=None, dispatches=None,
+        fused_depth=None, seq_span=None, dispatches=None, mesh=None,
     ) -> None:
         if self.profiler.enabled:
             self.profiler.record_wave(
@@ -406,6 +408,7 @@ class TpuGraphBackend:
                 fused_depth=fused_depth,
                 seq_span=seq_span,
                 dispatches=dispatches,
+                mesh=mesh,
             )
             if fused_depth is not None and dispatches:
                 # per-dispatch depth samples feed the engagement histogram
@@ -1574,41 +1577,75 @@ class TpuGraphBackend:
         return self._packed_mirror
 
     def _try_patch_packed(self, entry: dict, aux: dict) -> bool:
-        """Replay the recorded structural deltas onto the mesh mirror in
-        order. Returns False (and breaks the log) on anything the in-place
-        path can't absorb — the caller rebuilds."""
-        deltas = aux["deltas"]
-        if not deltas:
-            return True
+        """Replay the recorded structural deltas onto the mesh mirror —
+        the WHOLE stream coalesced into one fused device dispatch
+        (``PackedShardedGraph.patch_batch``; ISSUE 9 satellite: BENCH_r05
+        measured 1090.7 ms for 6 patches, ~all of it per-patch dispatch
+        overhead). The packed mirror's epochs are REBASED to 0 at build,
+        so the shared coalescer's absolute epochs translate through the
+        build base here. Returns False (and breaks the log) on anything
+        the in-place path can't absorb — the caller rebuilds."""
         pg = entry["graph"]
         base = entry["epoch_base"]
-        n = pg.n_nodes
+        coalesced = self._coalesce_mirror_deltas(aux["deltas"], pg.n_nodes)
+        if coalesced is None:
+            aux["broken"] = True  # nodes born after the build
+            return False
+        bumps, u, v, ep = coalesced
+        if not len(bumps) and not len(u):
+            aux["deltas"] = []
+            return True
+        # the first in-place mutation invalidates the BUILD fingerprint
+        # forever: a later failed replay must never let the fp path
+        # revalidate half-patched tables (r5 review)
+        entry["fp"] = None
+        if not pg.patch_batch(bumps, u, v, ep - base[v]):
+            aux["broken"] = True  # slot overflow / unknown nodes
+            return False
+        aux["deltas"] = []
+        global_metrics().counter(
+            "fusion_mirror_patch_batches_total",
+            help="structural churn bursts applied to the packed mesh mirror in one fused dispatch",
+        ).inc()
+        return True
+
+    @staticmethod
+    def _coalesce_mirror_deltas(deltas, n: int):
+        """Collect a recorded structural-delta stream into concatenated
+        ``(bumps, u, v, ep_abs)`` for a ONE-dispatch patch batch — the one
+        coalescer both mesh-mirror flavors (packed/rebased and
+        routed/absolute) replay through. Coalescing is final-state-safe:
+        bumps are epoch increments and adds carry captured epochs, so the
+        result is order-independent; bump payloads arrive UNIQUIFIED
+        (device_graph.bump_epochs dedups before recording), so plain
+        concatenation preserves the sequential replay's semantics — once
+        per id per payload, accumulating across payloads. Returns None
+        when an add references nodes born after the mirror's build (the
+        rebuild signal)."""
+        bumps: List[np.ndarray] = []
+        us: List[np.ndarray] = []
+        vs: List[np.ndarray] = []
+        eps: List[np.ndarray] = []
         for kind, payload in deltas:
             if kind == "bump":
                 ids = np.asarray(payload, dtype=np.int64)
                 ids = ids[ids < n]
                 if ids.size:
-                    # the first in-place mutation invalidates the BUILD
-                    # fingerprint forever: a later failed replay must never
-                    # let the fp path revalidate half-patched tables (r5
-                    # review — the dense graph's cumulative no-op churn can
-                    # restore the build fp while the mesh sits mid-replay)
-                    entry["fp"] = None
-                    pg.patch_bumps(ids)
+                    bumps.append(ids)
             else:
                 u, v, ep = payload
                 u64 = np.asarray(u, dtype=np.int64)
                 v64 = np.asarray(v, dtype=np.int64)
                 if u64.size and (int(u64.max()) >= n or int(v64.max()) >= n):
-                    aux["broken"] = True  # nodes born after the build
-                    return False
-                ep_rel = np.asarray(ep, dtype=np.int64) - base[v64]
-                entry["fp"] = None
-                if not pg.patch_adds(u64, v64, ep_rel):
-                    aux["broken"] = True  # slot overflow
-                    return False
-        aux["deltas"] = []
-        return True
+                    return None
+                us.append(u64)
+                vs.append(v64)
+                eps.append(np.asarray(ep, dtype=np.int64))
+
+        def cat(parts):
+            return np.concatenate(parts) if parts else np.empty(0, np.int64)
+
+        return cat(bumps), cat(us), cat(vs), cat(eps)
 
     def invalidate_cascade_batch_lanes_sharded(
         self, groups: Sequence[Sequence["Computed"]], mesh=None
@@ -1676,6 +1713,348 @@ class TpuGraphBackend:
             int(counts.sum()), wave_seq, groups=len(seed_lists),
         )
         return counts
+
+    # ------------------------------------------------------------------ routed mesh
+    def enable_mesh_routing(
+        self,
+        shard_map,
+        mesh=None,
+        mesh_members=None,
+        exchange: str = "a2a",
+    ) -> None:
+        """Pin the live graph's CSR shards onto mesh devices per the
+        CLUSTER shard map (ISSUE 9 tentpole): each member's shard-map
+        assignment also places its slice of the mirror on its mesh
+        devices, and cross-shard invalidation frontiers thereafter resolve
+        via collectives inside the wave (``_union_routed_nids`` /
+        the WavePipeline's routed chain) instead of surfacing to the host
+        and re-entering through per-key RPC. ``mesh_members`` names the
+        members co-located on THIS mesh (default: all map members — the
+        single-host cluster); shards owned by off-mesh members stay on the
+        DCN relay path (rpc/fanout.py counts it). The mirror itself builds
+        lazily on first routed wave."""
+        self._routed_config = {
+            "shard_map": shard_map,
+            "mesh": mesh,
+            "mesh_members": tuple(mesh_members) if mesh_members is not None else None,
+            "exchange": exchange,
+        }
+        self._routed_mirror = None  # rebuild under the new config
+
+    def mesh_routing_active(self) -> bool:
+        return self._routed_config is not None
+
+    def routed_mirror(self) -> dict:
+        """Fingerprint-cached routed mesh mirror of the live graph.
+        Structural churn since the last wave PATCHES the resident shards in
+        place from the graph's ordered delta stream — the whole batch
+        coalesced into ONE fused device dispatch (ISSUE 9 satellite: the
+        per-patch dispatch overhead, not the per-edge cost, dominated
+        BENCH_r05's mirror_patch_ms). Anything the in-place path can't
+        absorb (new nodes, slot/bucket overflow) rebuilds, counted."""
+        from ..cluster.placement import DevicePlacement, PlacementError
+        from ..parallel.routed_wave import RoutedShardedGraph
+        from .device_graph import check_structure_cache
+
+        cfg = self._routed_config
+        if cfg is None:
+            raise RuntimeError("mesh routing not enabled (enable_mesh_routing)")
+        self.flush()
+        dg = self.graph
+        sv = dg._struct_version
+        cached = self._routed_mirror
+        if cached is not None:
+            if cached["validated_at"] == sv:
+                return cached
+            aux = cached["aux_log"]
+            if not aux["broken"] and self._try_patch_routed(cached, aux):
+                cached["validated_at"] = sv
+                return cached
+            if cached["fp"] is not None and check_structure_cache(
+                cached, sv, lambda: self._routed_fingerprint()
+            ):
+                return cached
+        if cached is not None:
+            dg.drop_aux_delta_log(cached["aux_log"])
+            global_metrics().counter(
+                "fusion_mesh_rebuilds_total",
+                help="routed mesh mirrors rebuilt (patch path could not absorb the churn)",
+            ).inc()
+        mesh = cfg["mesh"]
+        import jax as _jax
+
+        n_dev = mesh.devices.size if mesh is not None else len(_jax.devices())
+        smap = cfg["shard_map"]
+        members = cfg["mesh_members"] or smap.members
+        placement = DevicePlacement.build(smap, n_dev, dg.n_nodes, mesh_members=members)
+        m = dg.n_edges
+        graph = RoutedShardedGraph(
+            dg._h_edge_src[:m].copy(),
+            dg._h_edge_dst[:m].copy(),
+            dg.n_nodes,
+            placement,
+            mesh=mesh,
+            exchange=cfg["exchange"],
+            edge_dst_epoch=dg._h_edge_dst_epoch[:m].copy(),
+            node_epoch=dg._h_node_epoch[: dg.n_nodes],
+        )
+        self._routed_mirror = {
+            "fp": self._routed_fingerprint(),
+            "validated_at": sv,
+            "graph": graph,
+            "aux_log": dg.register_aux_delta_log(),
+            # absent invalid_version ⇒ next wave full-syncs from dense
+        }
+        return self._routed_mirror
+
+    def _routed_fingerprint(self) -> bytes:
+        import hashlib
+
+        dg = self.graph
+        m = dg.n_edges
+        h = hashlib.blake2b(digest_size=16)
+        h.update(np.int64(dg.n_nodes).tobytes())
+        h.update(dg._h_edge_src[:m].tobytes())
+        h.update(dg._h_edge_dst[:m].tobytes())
+        h.update(dg._h_edge_dst_epoch[:m].tobytes())
+        h.update(dg._h_node_epoch[: dg.n_nodes].tobytes())
+        return h.digest()
+
+    def _try_patch_routed(self, entry: dict, aux: dict) -> bool:
+        """Coalesce the WHOLE recorded delta stream into one batched patch
+        (bumps are epoch increments and adds carry absolute captured
+        epochs, so the final device state is order-independent — the
+        property that makes same-burst batching safe) and apply it in ONE
+        fused dispatch. False ⇒ rebuild."""
+        graph = entry["graph"]
+        coalesced = self._coalesce_mirror_deltas(aux["deltas"], graph.n_nodes)
+        if coalesced is None:
+            aux["broken"] = True  # nodes born after the build
+            return False
+        bumps, u, v, ep = coalesced
+        if not len(bumps) and not len(u):
+            aux["deltas"] = []
+            return True
+        entry["fp"] = None  # in-place mutation: the build fp never revalidates
+        # the routed mirror keeps ABSOLUTE epochs — no rebase translation
+        if not graph.patch_batch(bumps, u, v, ep.astype(np.int32)):
+            aux["broken"] = True
+            return False
+        aux["deltas"] = []
+        global_metrics().counter(
+            "fusion_mesh_patch_batches_total",
+            help="structural churn batches applied to the routed mesh mirror in one fused dispatch",
+        ).inc()
+        return True
+
+    def apply_mesh_reshard(self, new_map, mesh_members=None) -> int:
+        """MOVE the resident device shards the new epoch reassigns (the
+        rebalancer's device half): state blocks transfer on-device, edge
+        slices + exchange buckets re-pack for the touched devices only.
+        Returns the number of shard moves (0 when no mirror is live yet —
+        the next build derives placement from the new map directly).
+        A move the placement can't absorb drops the mirror (rebuild on
+        next use) — counted, never silent."""
+        from ..cluster.placement import PlacementError
+
+        cfg = self._routed_config
+        if cfg is None:
+            return 0
+        cfg["shard_map"] = new_map
+        if mesh_members is not None:
+            cfg["mesh_members"] = tuple(mesh_members)
+        entry = self._routed_mirror
+        if entry is None:
+            return 0
+        if entry.get("inflight", 0) and self.pipeline is not None:
+            # a fused chain mid-flight references the CURRENT row layout;
+            # moving shards under it would make its harvest map rows
+            # through the new permutation (dropped invalidations). Drain
+            # first — the reshard then applies to a quiesced mirror.
+            self.pipeline.drain()
+            entry = self._routed_mirror
+            if entry is None:
+                return 0
+        graph = entry["graph"]
+        members = cfg["mesh_members"] or new_map.members
+        try:
+            placement, moves = graph.placement.moved_to(new_map, mesh_members=members)
+            graph.apply_placement(placement, moves)
+        except PlacementError as e:
+            log.warning("mesh reshard forced a rebuild: %s", e)
+            self.graph.drop_aux_delta_log(entry["aux_log"])
+            self._routed_mirror = None
+            global_metrics().counter("fusion_mesh_rebuilds_total").inc()
+            return 0
+        global_metrics().counter(
+            "fusion_mesh_shard_moves_total",
+            help="device shards moved between mesh devices by reshards",
+        ).inc(len(moves))
+        global_metrics().counter("fusion_mesh_reshards_total").inc()
+        if RECORDER.enabled:
+            RECORDER.note(
+                "mesh_reshard",
+                key=None,
+                cause=f"reshard:{new_map.epoch}",
+                count=len(moves),
+                detail=(
+                    f"epoch {new_map.epoch}: moved {len(moves)} device "
+                    f"shard(s) on-mesh (placement epoch {placement.epoch})"
+                ),
+            )
+        return len(moves)
+
+    def invalidate_cascade_batch_routed(self, computeds: Sequence["Computed"]) -> int:
+        """The live routed burst: one union wave whose cross-shard frontier
+        resolves via mesh collectives (a2a buckets / reduction tree —
+        parallel/routed_wave.py), applied back to the hub exactly like the
+        single-chip path. Missing computeds fall back to immediate host
+        invalidation, counted."""
+        seeds: List[int] = []
+        fallback = 0
+        for c in computeds:
+            nid = self._id_by_input.get(c.input)
+            if nid is None:
+                c.invalidate(immediately=True)
+                fallback += 1
+            else:
+                seeds.append(nid)
+        if not seeds:
+            return fallback
+        return self._union_routed_nids(seeds) + fallback
+
+    def cascade_rows_batch_routed(self, block: RowBlock, rows) -> int:
+        nids = block.base + self._check_rows(block, rows)
+        return self._union_routed_nids(nids.tolist())
+
+    def _routed_sync(self, entry: dict) -> None:
+        dg = self.graph
+        if entry.get("invalid_version") != dg.invalid_version:
+            mask = dg.invalid_mask()
+            dg._h_invalid[: dg.n_nodes] = mask
+            entry["graph"].set_invalid(mask)
+        # out-of-sync until the dense apply completes (same failure
+        # containment as the sharded union bridge)
+        entry.pop("invalid_version", None)
+
+    def _union_routed_nids(self, seeds: List[int]) -> int:
+        entry = self.routed_mirror()
+        if entry.get("inflight", 0) and self.pipeline is not None:
+            # a fused chain is mid-flight: its device advance must land
+            # before a blocking union syncs from the dense mirror (drain
+            # is the nonblocking-mode barrier — same rule as flush)
+            self.pipeline.drain()
+            entry = self.routed_mirror()
+        graph = entry["graph"]
+        dg = self.graph
+        self._routed_sync(entry)
+        cause, wave_seq = self._begin_wave()
+        t0 = time.perf_counter()
+        levels0 = graph.levels_total
+        count, newly_ids, overflow = graph.run_wave_collect(seeds)
+        if overflow:
+            newly = graph.invalid_mask() & ~dg._h_invalid[: graph.n_nodes]
+            newly_ids = np.nonzero(newly)[0].astype(np.int32)
+        dg.mark_invalid(newly_ids)
+        entry["invalid_version"] = dg.invalid_version
+        t1 = time.perf_counter()
+        levels = graph.levels_total - levels0
+        self._apply_newly(newly_ids)
+        self.waves_run += 1
+        self.device_invalidations += count
+        global_metrics().counter(
+            "fusion_mesh_routed_waves_total",
+            help="union waves whose cross-shard frontier resolved via mesh collectives",
+        ).inc()
+        global_metrics().counter(
+            "fusion_mesh_exchange_levels_total",
+            help="collective frontier-exchange rounds run on the mesh",
+        ).inc(levels)
+        self._profile_wave(
+            "routed_union", len(seeds), cause, t0, t1, len(newly_ids), wave_seq,
+            mesh={
+                "exchange": graph.exchange,
+                "levels": int(levels),
+                "epoch": graph.placement.epoch,
+                "n_dev": graph.n_dev,
+            },
+        )
+        return count
+
+    def dispatch_waves_routed_chain(self, stage_seed_lists: Sequence[Sequence[int]]) -> dict:
+        """K logical waves in ONE routed lax.scan dispatch with NO readback
+        — the frontier exchange composed into the nonblocking loop-carried
+        chain (graph/nonblocking.py rides this when mesh routing is on).
+        Raises RuntimeError for contract violations the pipeline treats as
+        the eager fallback (out-of-range seeds).
+
+        With a chain already IN FLIGHT the device state is AHEAD of the
+        dense mirror by exactly that chain's work — the dense full-sync
+        must be SKIPPED (it would overwrite the in-flight advance with
+        pre-chain state and double-count its cascade at harvest); the
+        loop-carried device state is the consistent one. Host-led invalid
+        changes between overlapped dispatches are covered by the
+        pipeline's journal guard + ``drain()`` barrier, same contract as
+        the single-chip lanes chain."""
+        if any(len(s) == 0 for s in stage_seed_lists):
+            raise RuntimeError("routed chain stages need non-empty seed sets")
+        entry = self.routed_mirror()
+        graph = entry["graph"]
+        if entry.get("inflight", 0) == 0:
+            self._routed_sync(entry)
+        levels0 = graph.levels_total
+        pending = graph.dispatch_union_chain(stage_seed_lists)
+        entry["inflight"] = entry.get("inflight", 0) + 1  # after dispatch succeeds
+        pending["entry"] = entry
+        pending["levels0"] = levels0
+        return pending
+
+    def harvest_waves_routed_chain(self, pending: dict):
+        """Block on a routed chain ticket: (per-stage counts, per-stage
+        newly id arrays). An overflowed stage's ids are recovered from one
+        mask diff against the pre-chain dense mirror and attributed to the
+        FIRST overflowed stage — containment preserves the SET (the counts
+        stay device-exact); invalidation is idempotent."""
+        entry = pending["entry"]
+        graph = entry["graph"]
+        dg = self.graph
+        try:
+            counts, stage_ids, info = graph.harvest_union_chain(pending)
+        except Exception:
+            # a failed harvest leaves the device state unknowable: clear
+            # the in-flight accounting and stay out-of-sync so the next
+            # wave full-syncs from the dense truth (the pipeline's fault
+            # containment re-runs the waves on the split host loop)
+            entry["inflight"] = 0
+            entry.pop("invalid_version", None)
+            raise
+        if info["overflowed"]:
+            newly = graph.invalid_mask() & ~dg._h_invalid[: graph.n_nodes]
+            all_ids = np.nonzero(newly)[0].astype(np.int64)
+            attributed = [i for i in stage_ids if i is not None]
+            seen = (
+                np.concatenate(attributed) if attributed else np.empty(0, np.int64)
+            )
+            leftover = np.setdiff1d(all_ids, seen)
+            first = True
+            for i, ids in enumerate(stage_ids):
+                if ids is None:
+                    stage_ids[i] = leftover if first else np.empty(0, np.int64)
+                    first = False
+        union = (
+            np.concatenate(stage_ids) if stage_ids else np.empty(0, np.int64)
+        )
+        dg.mark_invalid(union)
+        entry["inflight"] = max(entry.get("inflight", 1) - 1, 0)
+        if entry["inflight"] == 0:
+            # only a FULLY-drained mirror reads in-sync: with another chain
+            # still executing, the device state is ahead of the dense
+            # mirror until that chain harvests too
+            entry["invalid_version"] = dg.invalid_version
+        levels = graph.levels_total - pending["levels0"]
+        global_metrics().counter("fusion_mesh_routed_waves_total").inc(len(stage_ids))
+        global_metrics().counter("fusion_mesh_exchange_levels_total").inc(levels)
+        return counts, stage_ids
 
     def computed_for(self, node_id: int):
         """The live Computed for a backend node id (None if collected)."""

@@ -208,9 +208,20 @@ class WavePipeline:
             self._on_chain_fault(e, waves, seqs, cause)
             return
         try:
-            pending = backend.graph.dispatch_waves_lanes_chain(
-                [[w.seeds] for w in waves], max_words=self.max_words
-            )
+            if backend.mesh_routing_active():
+                # ISSUE 9: the frontier-exchange step composed into the
+                # loop-carried chain — cross-shard frontiers resolve via
+                # mesh collectives INSIDE the fused dispatch, never via
+                # the per-key host relay
+                pending = backend.dispatch_waves_routed_chain(
+                    [w.seeds for w in waves]
+                )
+                harvest = backend.harvest_waves_routed_chain
+            else:
+                pending = backend.graph.dispatch_waves_lanes_chain(
+                    [[w.seeds] for w in waves], max_words=self.max_words
+                )
+                harvest = backend.graph.harvest_waves_lanes_chain
         except (RuntimeError, ValueError):
             # not a fault: the mirror cannot serve the fused path right
             # now (invalid, multi-pass, out-of-contract seeds) — eager
@@ -222,7 +233,7 @@ class WavePipeline:
             return
         self._inflight.append(
             {"pending": pending, "waves": waves, "seqs": seqs,
-             "cause": cause, "t0": t0}
+             "cause": cause, "t0": t0, "harvest": harvest}
         )
         while len(self._inflight) > self.MAX_INFLIGHT:
             self._harvest(self._inflight.popleft())
@@ -243,9 +254,7 @@ class WavePipeline:
         waves: List[WaveTicket] = ticket["waves"]
         seqs = ticket["seqs"]
         try:
-            stage_counts, stage_masks = backend.graph.harvest_waves_lanes_chain(
-                ticket["pending"]
-            )
+            stage_counts, stage_masks = ticket["harvest"](ticket["pending"])
         except Exception as e:  # noqa: BLE001 — harvest fault: contain + degrade
             self._on_chain_fault(e, waves, seqs, ticket["cause"])
             return

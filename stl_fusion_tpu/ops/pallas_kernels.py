@@ -131,6 +131,22 @@ def _ring_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem, *, axis: str)
     lax.fori_loop(0, n_dev - 1, step_body, 0)
 
 
+def ring_all_gather_supported() -> bool:
+    """The ring kernel leans on newer-jax APIs (``lax.axis_size``, varying
+    manual-axes ShapeDtypeStructs); older jax runs every other exchange
+    but must DECLINE this one loudly instead of failing mid-trace."""
+    import inspect
+
+    import jax as _jax
+
+    try:
+        return hasattr(lax, "axis_size") and "vma" in inspect.signature(
+            _jax.ShapeDtypeStruct
+        ).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 def make_ring_all_gather(axis: str, interpret: Optional[bool] = None):
     """A shard_map-inner ``all_gather(..., tiled=True)`` replacement.
 
@@ -139,6 +155,11 @@ def make_ring_all_gather(axis: str, interpret: Optional[bool] = None):
     ``(n_dev * chunk,)`` gathered vector, moved hop-by-hop over the ICI
     ring with double-buffered RDMA. ``chunk`` must be a multiple of 128.
     """
+    if not ring_all_gather_supported():
+        raise NotImplementedError(
+            "the Pallas ring all-gather needs lax.axis_size + vma-aware "
+            "ShapeDtypeStruct (newer jax); use exchange='packed'/'bool'"
+        )
     if interpret is None:
         interpret = not _on_tpu()
 

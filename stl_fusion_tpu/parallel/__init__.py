@@ -1,14 +1,17 @@
 """Multi-chip execution: mesh helpers + sharded invalidation waves."""
 from .mesh import GRAPH_AXIS, graph_mesh
 from .packed_wave import PackedShardedGraph, build_packed_sharded_wave
+from .routed_wave import RoutedShardedGraph, build_routed_wave
 from .sharded_wave import ShardedDeviceGraph, ShardedGraphArrays, build_sharded_wave
 
 __all__ = [
     "GRAPH_AXIS",
     "graph_mesh",
     "PackedShardedGraph",
+    "RoutedShardedGraph",
     "ShardedDeviceGraph",
     "ShardedGraphArrays",
     "build_packed_sharded_wave",
+    "build_routed_wave",
     "build_sharded_wave",
 ]

@@ -28,10 +28,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from ..ops.pull_wave import pack_seed_words
-from .mesh import GRAPH_AXIS, graph_mesh
+from .mesh import GRAPH_AXIS, graph_mesh, shard_map_compat
 
 __all__ = ["PackedShardedGraph", "build_packed_sharded_wave"]
 
@@ -41,6 +40,24 @@ def _patch_scatter_add():
     @jax.jit
     def f(arr, ids):
         return arr.at[ids].add(1, mode="drop")  # pads index OOB → dropped
+
+    return f
+
+
+@functools.lru_cache(maxsize=1)
+def _fused_patch_apply():
+    """ONE dispatch for a whole burst's patches (ISSUE 9 satellite —
+    BENCH_r05's 1090.7 ms mirror_patch bill was per-PATCH dispatch
+    overhead, not per-edge cost): epoch bumps scatter-add (+1 per
+    occurrence, so concatenated bump payloads keep their cumulative
+    effect) and spliced rows pair-scatter, all OOB pads dropped."""
+
+    @jax.jit
+    def f(nep, in_src, eep, bump_ids, rows, rows_src, rows_ep):
+        nep = nep.at[bump_ids].add(1, mode="drop")
+        in_src = in_src.at[rows].set(rows_src, mode="drop")
+        eep = eep.at[rows].set(rows_ep, mode="drop")
+        return nep, in_src, eep
 
     return f
 
@@ -57,8 +74,7 @@ def build_packed_sharded_wave(mesh: Mesh):
     node_spec = P(GRAPH_AXIS)
     word_spec = P(GRAPH_AXIS, None)
 
-    @functools.partial(
-        shard_map,
+    @shard_map_compat(
         mesh=mesh,
         in_specs=(word_spec, word_spec, word_spec, node_spec, node_spec, word_spec),
         out_specs=(word_spec, P()),
@@ -312,6 +328,75 @@ class PackedShardedGraph:
         self.in_src, self.edge_epoch = fused_pair_scatter()(
             self.in_src, self.edge_epoch, jnp.asarray(q),
             jnp.asarray(hd[q]), jnp.asarray(he[q]),
+        )
+        self.patches += 1
+        return True
+
+    def patch_batch(
+        self, bump_ids: np.ndarray, u64: np.ndarray, v64: np.ndarray, ep_rel: np.ndarray
+    ) -> bool:
+        """A whole burst's structural patches in ONE fused device dispatch
+        (vs one per :meth:`patch_bumps`/:meth:`patch_adds` call — the
+        ISSUE 9 amortization satellite). Safe to coalesce because the
+        final state is order-independent: bumps are epoch INCREMENTS
+        (``bump_ids`` may repeat — each occurrence adds 1) and adds carry
+        their captured epochs; dup detection matches the sequential
+        path's (within-batch dups collapse exactly like a later call
+        seeing the earlier call's splice). Returns False on slot overflow
+        or unknown nodes — caller rebuilds, same contract as patch_adds."""
+        bump_ids = np.asarray(bump_ids, dtype=np.int64)
+        u64 = np.asarray(u64, dtype=np.int64)
+        v64 = np.asarray(v64, dtype=np.int64)
+        ep_rel = np.asarray(ep_rel, dtype=np.int64)
+        n = self.n_nodes
+        if bump_ids.size and int(bump_ids.max()) >= self.n_global:
+            return False
+        if u64.size and (int(u64.max()) >= n or int(v64.max()) >= n):
+            return False
+        rows = np.empty(0, np.int64)
+        hd, he = self.h_in_src, self.h_edge_epoch
+        if u64.size:
+            pad = self.n_tot
+            dup = ((hd[v64] == u64[:, None]) & (he[v64] == ep_rel[:, None])).any(axis=1)
+            u, v, e = u64[~dup], v64[~dup], ep_rel[~dup]
+            if u.size:
+                order = np.lexsort((e, u, v))
+                u, v, e = u[order], v[order], e[order]
+                first = np.ones(len(u), dtype=bool)
+                first[1:] = (v[1:] != v[:-1]) | (u[1:] != u[:-1]) | (e[1:] != e[:-1])
+                u, v, e = u[first], v[first], e[first]
+                idx = np.arange(len(v))
+                grp_start = np.ones(len(v), dtype=bool)
+                grp_start[1:] = v[1:] != v[:-1]
+                rank = idx - np.maximum.accumulate(np.where(grp_start, idx, 0))
+                free_cum = (hd[v] == pad).cumsum(axis=1)
+                need = rank + 1
+                if (free_cum[:, -1] < need).any():
+                    return False  # in-row overflow: cheaper to rebuild
+                slot = (free_cum == need[:, None]).argmax(axis=1)
+                hd[v, slot] = u
+                he[v, slot] = e
+                rows = np.unique(v)
+        if bump_ids.size:
+            uniq, counts = np.unique(bump_ids, return_counts=True)
+            live = uniq < self.n_global
+            np.add.at(self.h_node_epoch, uniq[live], counts[live].astype(np.int32))
+        if not bump_ids.size and not rows.size:
+            return True
+
+        def _pad(a, fill):
+            w = max(256, 1 << int(max(len(a), 1) - 1).bit_length())
+            out = np.full(w, fill, dtype=np.int64)
+            out[: len(a)] = a
+            return out
+
+        pb = _pad(bump_ids, self.n_global)  # OOB pad → dropped by scatter
+        pr = _pad(rows, self.n_global)
+        gather_rows = np.minimum(pr, self.n_global - 1)  # values for dropped
+        self.node_epoch, self.in_src, self.edge_epoch = _fused_patch_apply()(
+            self.node_epoch, self.in_src, self.edge_epoch,
+            jnp.asarray(pb), jnp.asarray(pr),
+            jnp.asarray(hd[gather_rows]), jnp.asarray(he[gather_rows]),
         )
         self.patches += 1
         return True

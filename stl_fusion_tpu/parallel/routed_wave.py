@@ -1,0 +1,964 @@
+"""Cluster-routed CSR shards with collective frontier exchange (ISSUE 9).
+
+The unification of the cluster control plane with the mesh path: node rows
+live on the device that owns their cluster shard (:class:`~..cluster.
+placement.DevicePlacement` — the shard map's device half), edges shard by
+DESTINATION owner device, and each BFS level exchanges the invalidation
+frontier with mesh collectives instead of surfacing to the host:
+
+- ``exchange="a2a"`` (default, the routed protocol): each device bit-packs
+  its newly-lit frontier into uint32 words and sends each consumer device
+  ONLY the words that consumer's edges actually reference — static
+  per-(producer, consumer) word buckets delivered by one ``lax.all_to_all``
+  per level. Exchange volume is O(cut words), not O(n): a frontier bit
+  travels only to device shards whose edges need it (the "cluster-routed"
+  step PAPER.md's collectives thesis asks for).
+- ``exchange="tree"``: the full packed frontier replicates through a
+  log2(n_dev)-round recursive-doubling ``ppermute`` reduction tree — the
+  Tascade-style merge (PAPERS.md), each round OR-combining block pairs at
+  doubling distance; the explicit-tree alternative to ``lax.all_gather``.
+- ``exchange="gather"``: plain ``lax.all_gather`` of packed words — the
+  reference for equivalence tests.
+
+Per level, after the exchange: local row gather (``node_epoch[dst]`` —
+device-local by construction, the reason edges shard by destination),
+version-masked fire, local scatter, and a ``psum`` for the continuation
+flag. The while_loop carries the flag, so no collective runs in ``cond``.
+
+The **chain faces** (:meth:`RoutedShardedGraph.dispatch_union_chain` /
+:meth:`harvest_union_chain`) run K logical waves in ONE ``lax.scan`` with
+per-stage compacted newly-id readback — the frontier exchange composed
+into the nonblocking loop-carried chain (graph/nonblocking.py rides them
+when mesh routing is enabled), so a cross-shard frontier resolves inside
+the fused dispatch instead of re-entering through per-key host RPC.
+
+A live reshard MOVES a device shard (:meth:`apply_placement`): the moved
+shard's fixed-width row block transfers on-device to its new owner's free
+slot, the two affected consumer devices' edge slices + exchange buckets
+re-pack host-side, and everything else stays resident. Structural churn
+patches route by owner (:meth:`patch_batch` — bumps scatter absolute
+epochs, adds splice into per-device slack slots) and apply in ONE fused
+dispatch per batch (ISSUE 9 satellite: per-patch dispatch overhead, not
+per-edge cost, dominated BENCH_r05's mirror_patch_ms).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..cluster.placement import DevicePlacement, PlacementError
+from .mesh import GRAPH_AXIS, graph_mesh, shard_map_compat
+
+__all__ = ["RoutedShardedGraph", "build_routed_wave"]
+
+_EXCHANGES = ("a2a", "tree", "gather")
+
+
+def build_routed_wave(mesh: Mesh, n_global: int, n_dev: int, exchange: str):
+    """Compile the routed union wave for a mesh + geometry. Returns
+    ``wave(frontier, send_idx, eslot, ebit, edst, eepoch, nepoch, invalid)
+    -> (invalid', count, levels)`` — all arrays GRAPH_AXIS-sharded; seeds
+    conduct even when already invalid (the r4 union rule); ``levels`` is
+    the number of frontier exchanges the wave ran (the collective-rounds
+    telemetry ``fusion_mesh_exchange_levels`` aggregates)."""
+    if exchange not in _EXCHANGES:
+        raise ValueError(f"unknown exchange {exchange!r}")
+    n_local = n_global // n_dev
+    assert n_local % 32 == 0
+    w_local = n_local // 32
+    if exchange == "tree" and (n_dev & (n_dev - 1)):
+        raise ValueError("tree exchange needs a power-of-two device count")
+
+    node_spec = P(GRAPH_AXIS)
+    edge_spec = P(GRAPH_AXIS)
+    send_spec = P(GRAPH_AXIS, None)
+
+    def _pack_words(f_l):
+        lanes = jnp.arange(32, dtype=jnp.uint32)[None, :]
+        return jnp.sum(
+            f_l.reshape(-1, 32).astype(jnp.uint32) << lanes, axis=1, dtype=jnp.uint32
+        )
+
+    def _exchange_words(f_l, send_idx_l):
+        """One frontier exchange: local packed words → the flat word vector
+        the per-edge ``eslot`` indexes into (layout differs per mode)."""
+        words = _pack_words(f_l)
+        if exchange == "gather":
+            return lax.all_gather(words, GRAPH_AXIS, tiled=True)
+        if exchange == "a2a":
+            words_p = jnp.concatenate([words, jnp.zeros(1, jnp.uint32)])  # pad word
+            send = words_p[send_idx_l]  # [n_dev, cap] — bucket per consumer
+            recv = lax.all_to_all(
+                send, GRAPH_AXIS, split_axis=0, concat_axis=0, tiled=True
+            )
+            return recv.reshape(-1)  # row p = words from producer p
+        # tree: recursive-doubling ppermute — log2(n_dev) OR-merge rounds
+        acc = words
+        idx = lax.axis_index(GRAPH_AXIS)
+        step = 1
+        while step < n_dev:
+            perm = [(i, i ^ step) for i in range(n_dev)]
+            recv = lax.ppermute(acc, GRAPH_AXIS, perm)
+            low = (idx & step) == 0  # my block sits in the lower half
+            acc = jnp.where(
+                low,
+                jnp.concatenate([acc, recv]),
+                jnp.concatenate([recv, acc]),
+            )
+            step *= 2
+        return acc  # full packed frontier, device order
+
+    @shard_map_compat(
+        mesh=mesh,
+        in_specs=(
+            node_spec, send_spec, edge_spec, edge_spec, edge_spec, edge_spec,
+            node_spec, node_spec,
+        ),
+        out_specs=(node_spec, P(), P()),
+    )
+    def _wave(seeds_l, send_idx_l, eslot_l, ebit_l, edst_l, eepoch_l, nepoch_l, inv_l):
+        fresh = seeds_l & ~inv_l
+        inv_l = inv_l | seeds_l
+        count0 = lax.psum(fresh.sum(dtype=jnp.int32), GRAPH_AXIS)
+        go0 = lax.psum(seeds_l.any().astype(jnp.int32), GRAPH_AXIS) > 0
+
+        def cond(carry):
+            return carry[4]
+
+        def body(carry):
+            f_l, inv_l, count, levels, _go = carry
+            flat = _exchange_words(f_l, send_idx_l)
+            word = flat[eslot_l]
+            src_active = ((word >> ebit_l.astype(jnp.uint32)) & 1).astype(bool)
+            ver_ok = nepoch_l[edst_l] == eepoch_l  # gather clamps; -1 never matches
+            fire = src_active & ver_ok & ~inv_l[edst_l]
+            nxt_l = jnp.zeros_like(f_l).at[edst_l].max(fire)  # OOB pads dropped
+            inv_l = inv_l | nxt_l
+            newly = lax.psum(nxt_l.sum(dtype=jnp.int32), GRAPH_AXIS)
+            return nxt_l, inv_l, count + newly, levels + 1, newly > 0
+
+        _f, inv_l, count, levels, _go = lax.while_loop(
+            cond, body, (seeds_l, inv_l, count0, jnp.int32(0), go0)
+        )
+        return inv_l, count, levels
+
+    return jax.jit(_wave)
+
+
+def build_routed_compact(mesh: Mesh, n_global: int, n_dev: int, capd: int):
+    """Per-device LOCAL newly-id compaction (ISSUE 9): each device cumsums
+    its own shard rows into a ``capd``-sized buffer — no cross-device
+    cumsum/scatter (the global compaction was super-linear on the mesh:
+    XLA lowered it to collective permutes that dominated the wave itself
+    past ~100K rows). Returns ``(counts int32[n_dev], bufs
+    int32[n_dev*capd])``; device d's newly GLOBAL rows are
+    ``bufs[d*capd : d*capd + counts[d]]``; ``counts[d] > capd`` = that
+    device overflowed (caller mask-diffs)."""
+    n_local = n_global // n_dev
+    node_spec = P(GRAPH_AXIS)
+
+    @shard_map_compat(
+        mesh=mesh,
+        in_specs=(node_spec, node_spec, node_spec),
+        out_specs=(P(GRAPH_AXIS), P(GRAPH_AXIS)),
+    )
+    def _compact(inv2_l, inv_l, real_l):
+        newly_l = inv2_l & ~inv_l & real_l
+        count = newly_l.sum(dtype=jnp.int32)
+        pos = jnp.cumsum(newly_l.astype(jnp.int32)) - 1
+        base = (lax.axis_index(GRAPH_AXIS) * n_local).astype(jnp.int32)
+        rows = base + jnp.arange(n_local, dtype=jnp.int32)
+        scatter_pos = jnp.where(newly_l & (pos < capd), pos, capd)
+        buf = jnp.full(capd, -1, jnp.int32).at[scatter_pos].set(rows, mode="drop")
+        return count[None], buf
+
+    return _compact
+
+
+class RoutedShardedGraph:
+    """Mesh-sharded device graph whose layout IS the cluster shard map."""
+
+    def __init__(
+        self,
+        edges_src: np.ndarray,
+        edges_dst: np.ndarray,
+        n_nodes: int,
+        placement: DevicePlacement,
+        mesh: Optional[Mesh] = None,
+        exchange: str = "a2a",
+        edge_dst_epoch: Optional[np.ndarray] = None,
+        node_epoch: Optional[np.ndarray] = None,
+        invalid: Optional[np.ndarray] = None,
+        bucket_headroom: float = 1.3,
+        edge_headroom: float = 1.3,
+    ):
+        self.mesh = mesh or graph_mesh()
+        if self.mesh.devices.size != placement.n_dev:
+            raise PlacementError(
+                f"placement spans {placement.n_dev} devices, mesh has "
+                f"{self.mesh.devices.size}"
+            )
+        if exchange not in _EXCHANGES:
+            raise ValueError(f"unknown exchange {exchange!r}")
+        if exchange == "tree" and (placement.n_dev & (placement.n_dev - 1)):
+            exchange = "gather"  # tree needs 2^k devices; honest fallback
+        self.placement = placement
+        self.exchange = exchange
+        self.n_nodes = n_nodes
+        self.n_dev = placement.n_dev
+        self.n_local = placement.n_local
+        self.n_global = placement.n_global
+        self.w_local = self.n_local // 32
+        #: set when a failed in-place reshard left device/host layout
+        #: inconsistent — every wave entry point then refuses (rebuild)
+        self.broken = False
+        # -- telemetry --
+        self.waves_run = 0
+        self.levels_total = 0  # frontier exchanges (collective rounds)
+        self.shard_moves = 0
+        self.patches = 0
+        self.patch_dispatches = 0
+
+        # int32 host truth: node ids always fit (n_global is int32-bound),
+        # and at 240M edges the int64 sorted copies alone were ~5 GB
+        src = np.asarray(edges_src, dtype=np.int32)
+        dst = np.asarray(edges_dst, dtype=np.int32)
+        ep = (
+            np.zeros(len(dst), dtype=np.int32)
+            if edge_dst_epoch is None
+            else np.asarray(edge_dst_epoch, dtype=np.int32)
+        )
+        # host truth: per-DST-SHARD edge lists (absolute node ids + absolute
+        # captured epochs) — the unit a reshard re-partitions by owner
+        ips = placement.ids_per_shard
+        shard_of_dst = dst.astype(np.int64) // ips
+        order = np.argsort(shard_of_dst, kind="stable")
+        src, dst, ep, sh = src[order], dst[order], ep[order], shard_of_dst[order]
+        self._shard_edges: Dict[int, List[np.ndarray]] = {}
+        if len(sh):
+            bounds = np.flatnonzero(np.diff(sh)) + 1
+            starts = np.concatenate([[0], bounds])
+            ends = np.concatenate([bounds, [len(sh)]])
+            for a, b in zip(starts, ends):
+                self._shard_edges[int(sh[a])] = [src[a:b], dst[a:b], ep[a:b]]
+
+        # capacities sized from the initial partition + headroom
+        dev_edges = np.zeros(self.n_dev, dtype=np.int64)
+        for s, (es, _ed, _ee) in self._shard_edges.items():
+            d = int(placement.shard_dev[s])
+            if d >= 0:
+                dev_edges[d] += len(es)
+        self.e_cap = max(int(dev_edges.max() * edge_headroom) + 32, 64)
+        self.bucket_headroom = bucket_headroom
+        self._node_sh = NamedSharding(self.mesh, P(GRAPH_AXIS))
+        self._edge_sh = NamedSharding(self.mesh, P(GRAPH_AXIS))
+        self._send_sh = NamedSharding(self.mesh, P(GRAPH_AXIS, None))
+
+        perm, inv_perm = placement.permutation()
+        self.perm, self.inv_perm = perm, inv_perm
+        self._real_rows = np.flatnonzero(inv_perm >= 0)
+        self._real_nodes = inv_perm[self._real_rows]
+
+        # node state, absolute epochs (no rebase: patches translate nothing)
+        nep = np.zeros(self.n_global, dtype=np.int32)
+        inv0 = np.zeros(self.n_global, dtype=bool)
+        if node_epoch is not None:
+            nep[perm[: len(node_epoch)][perm[: len(node_epoch)] >= 0]] = np.asarray(
+                node_epoch, dtype=np.int32
+            )[perm[: len(node_epoch)] >= 0]
+        if invalid is not None:
+            m = np.asarray(invalid, dtype=bool)
+            rows = perm[: len(m)]
+            ok = rows >= 0
+            inv0[rows[ok]] = m[ok]
+        self._h_is_real = np.zeros(self.n_global, dtype=bool)
+        self._h_is_real[self._real_rows] = True
+
+        self._build_exchange_and_edges()
+        self.g_node_epoch = jax.device_put(nep, self._node_sh)
+        self.g_invalid = jax.device_put(inv0, self._node_sh)
+        self.g_is_real = jax.device_put(self._h_is_real, self._node_sh)
+        self._wave = build_routed_wave(
+            self.mesh, self.n_global, self.n_dev, self.exchange
+        )
+        self._collect_cache: dict = {}
+        self._chain_cache: dict = {}
+        self._patch_cache: dict = {}
+        self._move_cache: dict = {}
+
+    # ------------------------------------------------------------------ build
+    def _consumer_pack(self, d: int):
+        """Pack consumer device ``d``'s edge slice + its word buckets from
+        the host per-shard edge lists. Returns (eslot, ebit, edst, eep,
+        buckets) where buckets[p] = local word indices producer p sends d.
+        ``eslot`` uses the exchange's layout (a2a: p*cap+j; tree/gather:
+        global word id)."""
+        pl = self.placement
+        srcs: List[np.ndarray] = []
+        dsts: List[np.ndarray] = []
+        eps: List[np.ndarray] = []
+        for s in range(pl.shard_map.n_shards):
+            if int(pl.shard_dev[s]) != d:
+                continue
+            ent = self._shard_edges.get(s)
+            if ent is None:
+                continue
+            srcs.append(ent[0])
+            dsts.append(ent[1])
+            eps.append(ent[2])
+        if srcs:
+            src = np.concatenate(srcs)
+            dst = np.concatenate(dsts)
+            ep = np.concatenate(eps)
+        else:
+            src = dst = np.empty(0, np.int64)
+            ep = np.empty(0, np.int32)
+        if len(src) > self.e_cap:
+            raise PlacementError(
+                f"device {d} edge slice {len(src)} exceeds capacity {self.e_cap}"
+            )
+        src_rows = self.perm[src] if len(src) else src
+        dst_rows = self.perm[dst] if len(dst) else dst
+        if len(src) and (src_rows.min() < 0 or dst_rows.min() < 0):
+            raise PlacementError("edge endpoints land on off-mesh shards")
+        words = src_rows >> 5
+        buckets: Dict[int, np.ndarray] = {}
+        eslot = np.zeros(self.e_cap, dtype=np.int32)
+        ebit = np.zeros(self.e_cap, dtype=np.int32)
+        edst = np.full(self.e_cap, self.n_local, dtype=np.int32)  # pad: dropped
+        eep = np.full(self.e_cap, -1, dtype=np.int32)  # pad: never matches
+        if self.exchange == "a2a":
+            prod = (src_rows // self.n_local).astype(np.int64)
+            slots = np.empty(len(src), dtype=np.int64)
+            for p in range(self.n_dev):
+                sel = prod == p
+                if not sel.any():
+                    buckets[p] = np.empty(0, np.int64)
+                    continue
+                wl = words[sel] - p * self.w_local
+                uniq = np.unique(wl)
+                buckets[p] = uniq
+                slots[sel] = np.searchsorted(uniq, wl)
+            # sorted build-time buckets: slot lookup at patch time is a
+            # searchsorted, never a V×words Python dict (100M-node scale)
+            self._buckets[d] = buckets
+            self._patch_slots[d] = {}
+            self._bucket_fill[d] = {p: len(b) for p, b in buckets.items()}
+            if len(src):
+                # final eslot needs bucket_cap (p*cap + j) — filled by the
+                # caller once the global cap is known; stash raw (p, j)
+                eslot_raw = (prod, slots)
+            else:
+                eslot_raw = (np.empty(0, np.int64), np.empty(0, np.int64))
+        else:
+            eslot_raw = None
+            if len(src):
+                eslot[: len(src)] = words.astype(np.int32)
+        if len(src):
+            ebit[: len(src)] = (src_rows & 31).astype(np.int32)
+            edst[: len(src)] = (dst_rows - d * self.n_local).astype(np.int32)
+            eep[: len(src)] = ep
+        self._dev_edge_count[d] = len(src)
+        return eslot, ebit, edst, eep, buckets, eslot_raw, len(src)
+
+    def _build_exchange_and_edges(self) -> None:
+        """(Re)build the full host-side edge partition + exchange tables and
+        upload. Called at construction and on a rebuild-grade change."""
+        n_dev = self.n_dev
+        #: consumer dev → {producer dev → sorted build-time word bucket}
+        self._buckets: Dict[int, Dict[int, np.ndarray]] = {}
+        #: consumer dev → {(producer, word) → slot} for PATCH-added words
+        #: only (build-time slots resolve by searchsorted in _buckets)
+        self._patch_slots: Dict[int, Dict[Tuple[int, int], int]] = {}
+        self._bucket_fill: Dict[int, Dict[int, int]] = {}
+        self._dev_edge_count = np.zeros(n_dev, dtype=np.int64)
+        packs = [self._consumer_pack(d) for d in range(n_dev)]
+        if self.exchange == "a2a":
+            peak = max(
+                (max(f.values(), default=0) for f in (self._bucket_fill[d] for d in range(n_dev))),
+                default=0,
+            )
+            self.bucket_cap = max(int(peak * self.bucket_headroom) + 8, 16)
+            send = np.full((n_dev, n_dev, self.bucket_cap), self.w_local, np.int32)
+            for d in range(n_dev):
+                eslot, ebit, edst, eep, buckets, (prod, slots), n_e = packs[d]
+                for p, wl in buckets.items():
+                    send[p, d, : len(wl)] = wl
+                if n_e:
+                    eslot[:n_e] = (prod * self.bucket_cap + slots).astype(np.int32)
+            self._h_send = send.reshape(n_dev * n_dev, self.bucket_cap)
+        else:
+            self.bucket_cap = 16  # unused; kernel signature stays uniform
+            self._h_send = np.zeros((n_dev * n_dev, self.bucket_cap), np.int32)
+        self._h_eslot = np.concatenate([p[0] for p in packs])
+        self._h_ebit = np.concatenate([p[1] for p in packs])
+        self._h_edst = np.concatenate([p[2] for p in packs])
+        self._h_eep = np.concatenate([p[3] for p in packs])
+        self._upload_edges()
+
+    def _upload_edges(self) -> None:
+        self.g_send = jax.device_put(self._h_send, self._send_sh)
+        self.g_eslot = jax.device_put(self._h_eslot, self._edge_sh)
+        self.g_ebit = jax.device_put(self._h_ebit, self._edge_sh)
+        self.g_edst = jax.device_put(self._h_edst, self._edge_sh)
+        self.g_eep = jax.device_put(self._h_eep, self._edge_sh)
+
+    # ------------------------------------------------------------------ waves
+    def run_wave_collect(
+        self, seed_node_ids: Sequence[int], cap: int = 65536
+    ) -> Tuple[int, np.ndarray, bool]:
+        """Union wave from node ids with O(wave) host exchange: seed ids up,
+        compacted newly NODE ids back, one dispatch. Returns (count, newly
+        node ids, overflow)."""
+        self._check_usable()
+        k = len(seed_node_ids)
+        width = 1
+        while width < max(k, 1):
+            width <<= 1
+        rows = np.full(width, self.n_global, dtype=np.int64)  # pad: dropped
+        if k:
+            r = self.perm[np.asarray(seed_node_ids, dtype=np.int64)]
+            if r.min() < 0:
+                raise PlacementError("seed node lands on an off-mesh shard")
+            rows[:k] = r
+        capd = max(cap // self.n_dev, 1024)
+        fn = self._collect_cache.get((capd, width))
+        if fn is None:
+            fn = self._build_collect(capd)
+            self._collect_cache[(capd, width)] = fn
+        self.g_invalid, counts, levels, bufs = fn(
+            jnp.asarray(rows), self.g_send, self.g_eslot, self.g_ebit,
+            self.g_edst, self.g_eep, self.g_node_epoch, self.g_invalid,
+            self.g_is_real,
+        )
+        counts, levels, bufs = jax.device_get((counts, levels, bufs))
+        self.waves_run += 1
+        self.levels_total += int(levels)
+        count = int(counts.sum())
+        if (counts > capd).any():
+            return count, np.empty(0, np.int64), True
+        ids = np.concatenate(
+            [bufs[d * capd : d * capd + int(counts[d])] for d in range(self.n_dev)]
+        )
+        return count, self.inv_perm[ids], False
+
+    def _build_collect(self, capd: int):
+        wave = self._wave
+        compact = build_routed_compact(self.mesh, self.n_global, self.n_dev, capd)
+        node_sh = self._node_sh
+        n_global = self.n_global
+
+        @jax.jit
+        def collect(seed_rows, send, eslot, ebit, edst, eep, nepoch, inv, is_real):
+            frontier = lax.with_sharding_constraint(
+                jnp.zeros(n_global, bool).at[seed_rows].set(True, mode="drop"),
+                node_sh,
+            )
+            inv2, _count, levels = wave(
+                frontier, send, eslot, ebit, edst, eep, nepoch, inv
+            )
+            counts, bufs = compact(inv2, inv, is_real)
+            return inv2, counts, levels, bufs
+
+        return collect
+
+    # ------------------------------------------------------------------ chain
+    def dispatch_union_chain(
+        self, stage_seed_lists: Sequence[Sequence[int]], cap: int = 65536
+    ) -> dict:
+        """K logical union waves in ONE lax.scan dispatch, NO readback:
+        stage i cascades against the invalid state stages < i left (each
+        result equals a sequential per-stage dispatch). Returns a pending
+        ticket for :meth:`harvest_union_chain`; the device invalid state
+        advances immediately (futures)."""
+        self._check_usable()
+        K = len(stage_seed_lists)
+        if K == 0:
+            raise ValueError("empty chain")
+        width = 1
+        kmax = max((len(s) for s in stage_seed_lists), default=1)
+        while width < max(kmax, 1):
+            width <<= 1
+        mat = np.full((K, width), self.n_global, dtype=np.int64)
+        for i, seeds in enumerate(stage_seed_lists):
+            if seeds:
+                r = self.perm[np.asarray(seeds, dtype=np.int64)]
+                if r.min() < 0:
+                    raise PlacementError("seed node lands on an off-mesh shard")
+                mat[i, : len(seeds)] = r
+        capd = max(cap // self.n_dev, 1024)
+        fn = self._chain_cache.get((K, width, capd))
+        if fn is None:
+            fn = self._build_chain(capd)
+            self._chain_cache[(K, width, capd)] = fn
+        self.g_invalid, counts, levels, bufs = fn(
+            jnp.asarray(mat), self.g_send, self.g_eslot, self.g_ebit,
+            self.g_edst, self.g_eep, self.g_node_epoch, self.g_invalid,
+            self.g_is_real,
+        )
+        return {"counts": counts, "levels": levels, "bufs": bufs,
+                "stages": K, "capd": capd, "dispatches": 1}
+
+    def _build_chain(self, capd: int):
+        wave = self._wave
+        compact = build_routed_compact(self.mesh, self.n_global, self.n_dev, capd)
+        node_sh = self._node_sh
+        n_global = self.n_global
+
+        @jax.jit
+        def chain(seed_mat, send, eslot, ebit, edst, eep, nepoch, inv0, is_real):
+            def body(inv, seed_rows):
+                frontier = lax.with_sharding_constraint(
+                    jnp.zeros(n_global, bool).at[seed_rows].set(True, mode="drop"),
+                    node_sh,
+                )
+                inv2, _c, levels = wave(
+                    frontier, send, eslot, ebit, edst, eep, nepoch, inv
+                )
+                counts, bufs = compact(inv2, inv, is_real)
+                return inv2, (counts, levels, bufs)
+
+            inv, (counts, levels, bufs) = lax.scan(body, inv0, seed_mat)
+            return inv, counts, levels, bufs
+
+        return chain
+
+    def harvest_union_chain(self, pending: dict) -> Tuple[np.ndarray, List[np.ndarray], dict]:
+        """Block on a chain ticket: (per-stage counts, per-stage newly NODE
+        id arrays, info). An overflowed stage returns ``None`` in its slot —
+        the caller mask-diffs against its dense mirror."""
+        counts_dev, levels, bufs = jax.device_get(
+            (pending["counts"], pending["levels"], pending["bufs"])
+        )
+        capd = pending["capd"]
+        self.waves_run += pending["stages"]
+        self.levels_total += int(levels.sum())
+        counts = counts_dev.astype(np.int64).sum(axis=1)
+        stage_ids: List[Optional[np.ndarray]] = []
+        overflowed = False
+        for i in range(pending["stages"]):
+            if (counts_dev[i] > capd).any():
+                stage_ids.append(None)
+                overflowed = True
+            else:
+                stage_ids.append(
+                    self.inv_perm[
+                        np.concatenate(
+                            [
+                                bufs[i, d * capd : d * capd + int(counts_dev[i, d])]
+                                for d in range(self.n_dev)
+                            ]
+                        )
+                    ]
+                )
+        info = {"levels": levels.astype(np.int64), "overflowed": overflowed}
+        return counts, stage_ids, info
+
+    # ------------------------------------------------------------------ state
+    def invalid_mask(self) -> np.ndarray:
+        """bool[n_nodes] in NODE space (reads the device state once)."""
+        arr = np.asarray(self.g_invalid)
+        out = np.zeros(self.n_nodes, dtype=bool)
+        out[self._real_nodes] = arr[self._real_rows]
+        return out
+
+    def set_invalid(self, mask: np.ndarray) -> None:
+        inv = np.zeros(self.n_global, dtype=bool)
+        m = np.asarray(mask[: self.n_nodes], dtype=bool)
+        rows = self.perm[: len(m)]
+        ok = rows >= 0
+        inv[rows[ok]] = m[ok]
+        self.g_invalid = jax.device_put(inv, self._node_sh)
+
+    def clear_invalid(self) -> None:
+        self.g_invalid = jax.device_put(
+            np.zeros(self.n_global, dtype=bool), self._node_sh
+        )
+
+    # ------------------------------------------------------------------ reshard
+    def apply_placement(self, new_placement: DevicePlacement, moves) -> None:
+        """MOVE the listed device shards to their new owners: each moved
+        shard's fixed-width row block transfers on-device (one fused
+        gather/scatter dispatch for node state), and the affected consumer
+        devices' edge slices + exchange buckets re-pack — affected means
+        the old/new OWNER devices plus every consumer whose edges SOURCE
+        from a moved shard (their eslot/bucket routes reference the
+        vacated rows; missing them loses invalidations silently — caught
+        in review with a single-shard-move repro). State for unmoved
+        shards never leaves its device. Raises :class:`PlacementError` on
+        slot/edge-capacity overflow, after which the graph is BROKEN
+        (every wave entry point refuses) — the caller rebuilds."""
+        if not moves:
+            self.placement = new_placement
+            return
+        old_rows_l: List[np.ndarray] = []
+        new_rows_l: List[np.ndarray] = []
+        affected_devs: set = set()
+        ips = self.placement.ids_per_shard
+        for s, old_dev, new_dev in moves:
+            if old_dev >= 0:
+                affected_devs.add(old_dev)
+            if new_dev >= 0:
+                affected_devs.add(new_dev)
+            if old_dev < 0 or new_dev < 0:
+                # shard entering/leaving the mesh changes real-row coverage:
+                # that is a rebuild-grade change, not an in-place move
+                raise PlacementError(f"shard {s} crossed the mesh boundary")
+            base_old = old_dev * self.n_local + int(self.placement.shard_slot[s]) * self.placement.slot_rows
+            base_new = new_dev * self.n_local + int(new_placement.shard_slot[s]) * new_placement.slot_rows
+            n = min(ips, self.n_nodes - s * ips)
+            if n <= 0:
+                continue
+            old_rows_l.append(np.arange(base_old, base_old + n, dtype=np.int64))
+            new_rows_l.append(np.arange(base_new, base_new + n, dtype=np.int64))
+        # consumers whose edge SOURCES moved: their exchange routes (a2a
+        # buckets / global word slots) point at the old rows
+        moved_shards = np.fromiter((m[0] for m in moves), dtype=np.int64)
+        for shard, ent in self._shard_edges.items():
+            d = int(new_placement.shard_dev[shard])
+            if d < 0 or d in affected_devs:
+                continue
+            if len(ent[0]) and np.isin(ent[0] // ips, moved_shards).any():
+                affected_devs.add(d)
+        self.placement = new_placement
+        self.perm, self.inv_perm = new_placement.permutation()
+        self._real_rows = np.flatnonzero(self.inv_perm >= 0)
+        self._real_nodes = self.inv_perm[self._real_rows]
+        self._h_is_real = np.zeros(self.n_global, dtype=bool)
+        self._h_is_real[self._real_rows] = True
+        self.g_is_real = jax.device_put(self._h_is_real, self._node_sh)
+        if old_rows_l:
+            old_rows = np.concatenate(old_rows_l)
+            new_rows = np.concatenate(new_rows_l)
+            width = 1 << int(len(old_rows) - 1).bit_length()
+            po = np.full(width, self.n_global, dtype=np.int64)
+            pn = np.full(width, self.n_global, dtype=np.int64)
+            po[: len(old_rows)] = old_rows
+            pn[: len(new_rows)] = new_rows
+            fn = self._move_cache.get(width)
+            if fn is None:
+                fn = self._build_move()
+                self._move_cache[width] = fn
+            self.g_node_epoch, self.g_invalid = fn(
+                self.g_node_epoch, self.g_invalid, jnp.asarray(po), jnp.asarray(pn)
+            )
+        # re-pack edges + buckets for the touched consumer devices only
+        try:
+            self._repack_devices(sorted(affected_devs))
+        except PlacementError:
+            # the state blocks already moved and some devices may be half
+            # repacked — a partial rollback would LOOK usable while being
+            # wrong (review finding). Mark broken; every wave entry point
+            # refuses until the caller rebuilds.
+            self.broken = True
+            raise
+        self.shard_moves += len(moves)
+
+    def _build_move(self):
+        node_sh = self._node_sh
+
+        @jax.jit
+        def move(ep, inv, old_rows, new_rows):
+            mep = ep.at[old_rows].get(mode="fill", fill_value=0)
+            minv = inv.at[old_rows].get(mode="fill", fill_value=False)
+            ep = ep.at[old_rows].set(0, mode="drop").at[new_rows].set(mep, mode="drop")
+            inv = (
+                inv.at[old_rows].set(False, mode="drop")
+                .at[new_rows].set(minv, mode="drop")
+            )
+            return (
+                lax.with_sharding_constraint(ep, node_sh),
+                lax.with_sharding_constraint(inv, node_sh),
+            )
+
+        return move
+
+    def _repack_devices(self, devs: Sequence[int]) -> None:
+        """Host-side re-pack of the listed consumer devices' edge slices and
+        (a2a) their bucket columns from every producer, then one upload per
+        touched array slice."""
+        packs = {d: self._consumer_pack(d) for d in devs}
+        if self.exchange == "a2a":
+            for d, (eslot, ebit, edst, eep, buckets, raw, n_e) in packs.items():
+                for p, wl in buckets.items():
+                    col = np.full(self.bucket_cap, self.w_local, np.int32)
+                    if len(wl) > self.bucket_cap:
+                        raise PlacementError(
+                            f"bucket ({p}->{d}) {len(wl)} exceeds cap {self.bucket_cap}"
+                        )
+                    col[: len(wl)] = wl
+                    self._h_send[p * self.n_dev + d] = col
+                if n_e:
+                    prod, slots = raw
+                    eslot[:n_e] = (prod * self.bucket_cap + slots).astype(np.int32)
+        for d, (eslot, ebit, edst, eep, _b, _raw, _n) in packs.items():
+            sl = slice(d * self.e_cap, (d + 1) * self.e_cap)
+            self._h_eslot[sl] = eslot
+            self._h_ebit[sl] = ebit
+            self._h_edst[sl] = edst
+            self._h_eep[sl] = eep
+        self._upload_edges()
+
+    # ------------------------------------------------------------------ patches
+    def patch_batch(
+        self,
+        bump_ids: np.ndarray,
+        add_u: np.ndarray,
+        add_v: np.ndarray,
+        add_ep: np.ndarray,
+    ) -> bool:
+        """Apply a WHOLE burst's structural patches in one fused device
+        dispatch (the ISSUE 9 amortization satellite): epoch bumps
+        scatter-add (+k for k bumps of one row — final state is
+        order-independent because bumps are increments and adds carry
+        absolute captured epochs), new edges splice into per-device slack
+        slots routed by their destination's OWNER. Returns False on any
+        capacity overflow (caller rebuilds)."""
+        self._check_usable()
+        bump_rows = np.empty(0, np.int64)
+        bump_counts = np.empty(0, np.int32)
+        if len(bump_ids):
+            ids = np.asarray(bump_ids, dtype=np.int64)
+            uniq, counts = np.unique(ids, return_counts=True)
+            rows = self.perm[uniq]
+            if rows.min() < 0:
+                return False
+            bump_rows, bump_counts = rows, counts.astype(np.int32)
+            # host truth for future repacks: nothing — node epochs live only
+            # on device + dense mirror; shard edge lists carry captured
+            # epochs, which bumps do not rewrite
+        e_rows = np.empty(0, np.int64)
+        e_slot = np.empty(0, np.int32)
+        e_bit = np.empty(0, np.int32)
+        e_dst = np.empty(0, np.int32)
+        e_ep = np.empty(0, np.int32)
+        s_rows = np.empty(0, np.int64)
+        s_vals = np.empty(0, np.int32)
+        if len(add_u):
+            u = np.asarray(add_u, dtype=np.int64)
+            v = np.asarray(add_v, dtype=np.int64)
+            ep = np.asarray(add_ep, dtype=np.int32)
+            if (u >= self.n_nodes).any() or (v >= self.n_nodes).any():
+                return False  # nodes born after the build: rebuild
+            ips = self.placement.ids_per_shard
+            u_rows = self.perm[u]
+            v_rows = self.perm[v]
+            if len(u_rows) and (u_rows.min() < 0 or v_rows.min() < 0):
+                return False
+            shards = v // ips
+            devs = (v_rows // self.n_local).astype(np.int64)
+            er, es, eb, ed, ee, sr, sv = [], [], [], [], [], [], []
+            for d in np.unique(devs).tolist():
+                sel = devs == d
+                k = int(sel.sum())
+                base = int(self._dev_edge_count[d])
+                if base + k > self.e_cap:
+                    return False  # edge slack exhausted
+                self._dev_edge_count[d] = base + k
+                rows = d * self.e_cap + base + np.arange(k, dtype=np.int64)
+                ur, vr = u_rows[sel], v_rows[sel]
+                er.append(rows)
+                eb.append((ur & 31).astype(np.int32))
+                ed.append((vr - d * self.n_local).astype(np.int32))
+                ee.append(ep[sel])
+                if self.exchange == "a2a":
+                    prod = (ur // self.n_local).astype(np.int64)
+                    wl = (ur >> 5) - prod * self.w_local
+                    built = self._buckets[d]
+                    patch_slots = self._patch_slots[d]
+                    fill = self._bucket_fill[d]
+                    slots = np.empty(k, dtype=np.int64)
+                    for i, (p, w) in enumerate(zip(prod.tolist(), wl.tolist())):
+                        bucket = built.get(p)
+                        j = None
+                        if bucket is not None and len(bucket):
+                            pos = int(np.searchsorted(bucket, w))
+                            if pos < len(bucket) and bucket[pos] == w:
+                                j = pos
+                        if j is None:
+                            j = patch_slots.get((p, w))
+                        if j is None:
+                            j = fill.get(p, 0)
+                            if j >= self.bucket_cap:
+                                return False  # bucket slack exhausted
+                            patch_slots[(p, w)] = j
+                            fill[p] = j + 1
+                            sr.append(np.array([(p * self.n_dev + d) * self.bucket_cap + j]))
+                            sv.append(np.array([w], dtype=np.int32))
+                            self._h_send[p * self.n_dev + d, j] = w
+                        slots[i] = j
+                    es.append((prod * self.bucket_cap + slots).astype(np.int32))
+                else:
+                    es.append(((ur >> 5)).astype(np.int32))
+                # host truth for future repacks
+                for s in np.unique(shards[sel]).tolist():
+                    ss = sel & (shards == s)
+                    ent = self._shard_edges.setdefault(
+                        int(s),
+                        [np.empty(0, np.int64), np.empty(0, np.int64), np.empty(0, np.int32)],
+                    )
+                    ent[0] = np.concatenate([ent[0], u[ss]])
+                    ent[1] = np.concatenate([ent[1], v[ss]])
+                    ent[2] = np.concatenate([ent[2], ep[ss]])
+                # mirror into host edge arrays
+                self._h_eslot[rows] = es[-1]
+                self._h_ebit[rows] = eb[-1]
+                self._h_edst[rows] = ed[-1]
+                self._h_eep[rows] = ee[-1]
+            e_rows = np.concatenate(er) if er else e_rows
+            e_slot = np.concatenate(es) if es else e_slot
+            e_bit = np.concatenate(eb) if eb else e_bit
+            e_dst = np.concatenate(ed) if ed else e_dst
+            e_ep = np.concatenate(ee) if ee else e_ep
+            if sr:
+                s_rows = np.concatenate(sr)
+                s_vals = np.concatenate(sv)
+        if not len(bump_rows) and not len(e_rows):
+            return True
+        # ONE fused dispatch for the whole batch — pad each index family to
+        # a pow2 width (OOB pads dropped) so program shapes cache
+        def _pad(a, fill, dtype=np.int64):
+            w = max(64, 1 << int(max(len(a), 1) - 1).bit_length())
+            out = np.full(w, fill, dtype=dtype)
+            out[: len(a)] = a
+            return out
+
+        pb = _pad(bump_rows, self.n_global)
+        pbc = _pad(bump_counts, 0, np.int32)
+        pe = _pad(e_rows, self.n_dev * self.e_cap)
+        pes = _pad(e_slot, 0, np.int32)
+        peb = _pad(e_bit, 0, np.int32)
+        ped = _pad(e_dst, self.n_local, np.int32)
+        pee = _pad(e_ep, -1, np.int32)
+        ps = _pad(s_rows, self.n_dev * self.n_dev * self.bucket_cap)
+        psv = _pad(s_vals, self.w_local, np.int32)
+        key = (len(pb), len(pe), len(ps))
+        fn = self._patch_cache.get(key)
+        if fn is None:
+            fn = self._build_patch()
+            self._patch_cache[key] = fn
+        (
+            self.g_node_epoch, self.g_eslot, self.g_ebit, self.g_edst,
+            self.g_eep, self.g_send,
+        ) = fn(
+            self.g_node_epoch, self.g_eslot, self.g_ebit, self.g_edst,
+            self.g_eep, self.g_send,
+            jnp.asarray(pb), jnp.asarray(pbc), jnp.asarray(pe),
+            jnp.asarray(pes), jnp.asarray(peb), jnp.asarray(ped),
+            jnp.asarray(pee), jnp.asarray(ps), jnp.asarray(psv),
+        )
+        self.patches += 1
+        self.patch_dispatches += 1
+        return True
+
+    def _build_patch(self):
+        node_sh, edge_sh, send_sh = self._node_sh, self._edge_sh, self._send_sh
+        cap = self.bucket_cap
+
+        @jax.jit
+        def patch(nep, eslot, ebit, edst, eep, send,
+                  b_rows, b_counts, e_rows, e_slot, e_bit, e_dst, e_ep,
+                  s_rows, s_vals):
+            nep = nep.at[b_rows].add(b_counts, mode="drop")
+            eslot = eslot.at[e_rows].set(e_slot, mode="drop")
+            ebit = ebit.at[e_rows].set(e_bit, mode="drop")
+            edst = edst.at[e_rows].set(e_dst, mode="drop")
+            eep = eep.at[e_rows].set(e_ep, mode="drop")
+            flat = send.reshape(-1).at[s_rows].set(s_vals, mode="drop")
+            return (
+                lax.with_sharding_constraint(nep, node_sh),
+                lax.with_sharding_constraint(eslot, edge_sh),
+                lax.with_sharding_constraint(ebit, edge_sh),
+                lax.with_sharding_constraint(edst, edge_sh),
+                lax.with_sharding_constraint(eep, edge_sh),
+                lax.with_sharding_constraint(flat.reshape(send.shape), send_sh),
+            )
+
+        return patch
+
+    # ------------------------------------------------------------------ snapshots
+    def export_shard_state(self) -> dict:
+        """Per-device-shard node state keyed by VIRTUAL SHARD id (the unit
+        that survives a reshard): checkpoint/durable.py stores this so a
+        warm restart re-pins each shard under whatever placement the
+        restarting process derives — layout-independent by construction."""
+        ep = np.asarray(self.g_node_epoch)
+        inv = np.asarray(self.g_invalid)
+        pl = self.placement
+        shards: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        for s in range(pl.shard_map.n_shards):
+            if pl.shard_dev[s] < 0:
+                continue
+            lo = s * pl.ids_per_shard
+            n = min(pl.ids_per_shard, self.n_nodes - lo)
+            if n <= 0:
+                continue
+            base = pl.row_of_shard(s)
+            shards[s] = (ep[base : base + n].copy(), inv[base : base + n].copy())
+        return {
+            "epoch": pl.epoch,
+            "n_nodes": self.n_nodes,
+            "n_shards": pl.shard_map.n_shards,
+            "shards": shards,
+        }
+
+    def import_shard_state(self, snap: dict) -> int:
+        """Re-pin snapshotted shard states under THIS graph's placement.
+        Returns the number of shards restored (shards the snapshot lacks
+        keep their built state)."""
+        pl = self.placement
+        if snap.get("n_nodes") != self.n_nodes or snap.get("n_shards") != pl.shard_map.n_shards:
+            # shard keying is only meaningful under the SAME (n_nodes, V)
+            # geometry — ids_per_shard derives from both, and restoring a
+            # wider snapshot would write past a shard's slot into its
+            # neighbour's rows (silent cross-shard corruption). Refuse.
+            raise ValueError(
+                f"mesh shard snapshot geometry (n_nodes={snap.get('n_nodes')}, "
+                f"n_shards={snap.get('n_shards')}) does not match this graph "
+                f"({self.n_nodes}, {pl.shard_map.n_shards}); cold-build instead"
+            )
+        ep = np.asarray(self.g_node_epoch).copy()
+        inv = np.asarray(self.g_invalid).copy()
+        restored = 0
+        for s, (sep, sinv) in snap["shards"].items():
+            s = int(s)
+            if s >= pl.shard_map.n_shards or pl.shard_dev[s] < 0:
+                continue
+            base = pl.row_of_shard(s)
+            # belt on top of the geometry check: never write past the
+            # shard's real-id extent
+            n = min(len(sep), max(self.n_nodes - s * pl.ids_per_shard, 0), pl.slot_rows)
+            ep[base : base + n] = sep[:n]
+            inv[base : base + n] = sinv[:n]
+            restored += 1
+        self.g_node_epoch = jax.device_put(ep, self._node_sh)
+        self.g_invalid = jax.device_put(inv, self._node_sh)
+        return restored
+
+    def _check_usable(self) -> None:
+        if self.broken:
+            raise PlacementError(
+                "routed graph broken by a failed in-place reshard; rebuild"
+            )
+
+    # ------------------------------------------------------------------ stats
+    def stats(self) -> dict:
+        return {
+            "exchange": self.exchange,
+            "n_dev": self.n_dev,
+            "n_nodes": self.n_nodes,
+            "n_global": self.n_global,
+            "e_cap": self.e_cap,
+            "bucket_cap": self.bucket_cap,
+            "placement_epoch": self.placement.epoch,
+            "waves_run": self.waves_run,
+            "exchange_levels_total": self.levels_total,
+            "shard_moves": self.shard_moves,
+            "patches": self.patches,
+            "patch_dispatches": self.patch_dispatches,
+        }

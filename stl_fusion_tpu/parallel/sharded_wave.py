@@ -32,9 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
-from .mesh import GRAPH_AXIS, graph_mesh
+from .mesh import GRAPH_AXIS, graph_mesh, shard_map_compat
 
 __all__ = ["ShardedGraphArrays", "ShardedDeviceGraph", "build_sharded_wave"]
 
@@ -109,12 +108,10 @@ def build_sharded_wave(mesh: Mesh, n_global: int, exchange: str = "packed"):
         word = full[dev * wp + (within >> 5)]
         return ((word >> (within & 31).astype(jnp.uint32)) & 1).astype(bool)
 
-    @functools.partial(
-        shard_map,
+    @shard_map_compat(
         mesh=mesh,
         in_specs=(node_spec, edge_spec, edge_spec, edge_spec, node_spec, node_spec),
         out_specs=(node_spec, node_spec, P()),
-        check_vma=False,  # pallas interpret-mode lowering can't track vma
     )
     def _wave(seeds_l, esrc_l, edst_l, eepoch_l, nepoch_l, inv_l):
         # seeds CONDUCT even when already invalid (r4, same rule as the

@@ -58,6 +58,17 @@ class ComputeFanoutIndex:
         #: the fan-out still serializes with device execution
         self.drained_overlapped = 0
         self.waves_seen = 0
+        #: ISSUE 9 relay scoping. Members co-located on this process's
+        #: mesh observe cross-shard frontiers through the collectives —
+        #: a per-key relay post to one of them means the mesh path
+        #: DISENGAGED (the CI mesh smoke fails on it). Members NOT on the
+        #: mesh are cross-host: the relay is their legitimate DCN
+        #: fallback, counted separately. Everything else is an ordinary
+        #: external client subscription (the system's edge).
+        self.mesh_members: frozenset = frozenset()
+        self.cluster_members: frozenset = frozenset()
+        self.mesh_member_relays = 0  # must stay 0 while the mesh path serves
+        self.dcn_fallback_relays = 0  # cross-host members: expected
         self._disposed = False
 
     def dispose(self) -> None:
@@ -74,6 +85,9 @@ class ComputeFanoutIndex:
             pass
         if self.rpc_hub.compute_fanout is self:
             self.rpc_hub.compute_fanout = None
+        from ..diagnostics.metrics import global_metrics
+
+        global_metrics().unregister_collector(self)
         self._by_nid.clear()
         self._nid_arr = None
         self.subscriptions = 0
@@ -178,6 +192,11 @@ class ComputeFanoutIndex:
                     entry = per_peer[id(peer)] = (peer, [])
                 entry[1].append((call_id, version, cause, origin_ts))
                 posted += 1
+                ref = getattr(peer, "ref", None)
+                if ref in self.mesh_members:
+                    self.mesh_member_relays += 1
+                elif ref in self.cluster_members:
+                    self.dcn_fallback_relays += 1
             total_posted += posted
             if posted and RECORDER.enabled:
                 # one event per fenced KEY (never per subscription), with
@@ -198,6 +217,27 @@ class ComputeFanoutIndex:
             # already executing on device — the ISSUE 7 overlap in action
             self.drained_overlapped += total_posted
 
+    def set_mesh_scope(self, mesh_members, cluster_members=None) -> None:
+        """Name the members co-located on this process's mesh (their
+        cross-shard traffic must ride the collectives, never this relay)
+        and, optionally, the full cluster membership (members off the mesh
+        are counted as DCN fallback rather than plain client fan-out)."""
+        from ..diagnostics.metrics import global_metrics
+
+        self.mesh_members = frozenset(mesh_members)
+        self.cluster_members = frozenset(
+            cluster_members if cluster_members is not None else mesh_members
+        )
+        reg = global_metrics()
+        reg.unregister_collector(self)  # idempotent re-scope
+        reg.register_collector(self, ComputeFanoutIndex._collect_mesh_metrics)
+
+    def _collect_mesh_metrics(self) -> dict:
+        return {
+            "fusion_mesh_member_relays_total": self.mesh_member_relays,
+            "fusion_mesh_dcn_fallback_total": self.dcn_fallback_relays,
+        }
+
     def stats(self) -> dict:
         return {
             "subscriptions": self.subscriptions,
@@ -205,6 +245,8 @@ class ComputeFanoutIndex:
             "drained_total": self.drained_total,
             "drained_overlapped": self.drained_overlapped,
             "waves_seen": self.waves_seen,
+            "mesh_member_relays": self.mesh_member_relays,
+            "dcn_fallback_relays": self.dcn_fallback_relays,
         }
 
 

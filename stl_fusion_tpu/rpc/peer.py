@@ -189,6 +189,24 @@ class RpcPeer(WorkerBase):
                 continue
             self._resend_failures = 0
             try:
+                # one clock probe per (re)connect: delivery histograms can
+                # then map this peer's origin_ts stamps onto the local
+                # timeline (ISSUE 9 — cross-host clock-safe timestamps).
+                # The PREVIOUS connection's sample is dropped first: a
+                # peer-host reboot resets its perf_counter epoch, and a
+                # pinned min-RTT sample from the old epoch would be wildly
+                # wrong forever (offsets are per-connection truths).
+                # Best-effort: a link that dies here dies in receive() too.
+                from ..diagnostics.clocksync import global_clock_sync
+
+                global_clock_sync().forget(self.ref)
+                await self.probe_clock()
+            except asyncio.CancelledError:
+                conn.close()
+                raise
+            except Exception:  # noqa: BLE001 — telemetry must not wedge the pump
+                pass
+            try:
                 while True:
                     message = await conn.reader.receive()
                     await self.process_message(message)
@@ -384,9 +402,42 @@ class RpcPeer(WorkerBase):
             # surface as an unhandled-task error on the serving loop
             log.debug("diagnostics handler failed: %s", exc)
 
+    async def probe_clock(self) -> None:
+        """Send one NTP-style clock probe (ISSUE 9 satellite: cross-host
+        clock-safe delivery timestamps). The ``clock-r`` reply lands the
+        ``(t_send, t_remote, t_recv)`` sample in the process-wide
+        :class:`~stl_fusion_tpu.diagnostics.clocksync.ClockSync`, keyed by
+        this peer's ref; delivery histograms then map the peer's
+        ``origin_ts`` stamps onto the local timeline."""
+        import time as _time
+
+        await self.send_system("clock", [_time.perf_counter()])
+
     def _process_system(self, message: RpcMessage) -> None:
-        """$sys: ok / error / cancel / not-found (RpcSystemCalls.cs:6-71)."""
+        """$sys: ok / error / cancel / not-found (RpcSystemCalls.cs:6-71)
+        + clock/clock-r (the ISSUE 9 offset probe)."""
         method = message.method
+        if method == "clock":
+            import time as _time
+
+            (t_send,) = loads(message.argument_data)
+            reply = self.send_system("clock-r", [t_send, _time.perf_counter()])
+            # fire-and-forget on the pump's loop: a probe reply must never
+            # block message processing (same discipline as $sys-d)
+            task = asyncio.get_event_loop().create_task(reply)
+            self._diag_tasks.add(task)
+            task.add_done_callback(self._on_diag_done)
+            return
+        if method == "clock-r":
+            import time as _time
+
+            from ..diagnostics.clocksync import global_clock_sync
+
+            t_send, t_remote = loads(message.argument_data)
+            global_clock_sync().note_sample(
+                self.ref, float(t_send), float(t_remote), _time.perf_counter()
+            )
+            return
         if method == "ok":
             call = self.outbound_calls.get(message.call_id)
             if call is not None:

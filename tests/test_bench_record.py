@@ -78,10 +78,35 @@ def test_compact_record_stays_under_tail_window():
         "evictions": 0,
         "coalesced_frames": 123,
     }
+    mesh = {
+        "mesh_devices": 8,
+        "violations": [],
+        "ok": True,
+        "static": {
+            "nodes": 80_000_000, "edges": 239_999_431, "mesh_devices": 8,
+            "members": 4, "shards": 256, "exchange": "a2a", "waves": 2,
+            "seeds_per_wave": 100_000, "total_invalidated": 159_998_712,
+            "inv_per_s": 512345.6, "wave_s": [120.5, 130.2],
+            "exchange_levels": 34, "oracle_exact": True, "oracle_s": 95.1,
+            "build_s": 210.4, "compile_s": 44.2, "gen_s": 140.1,
+            "vs_single_device_10m": 8.0,
+        },
+        "live": {
+            "nodes": 20000, "members": 2, "rounds": 3, "burst_s": 1.12,
+            "pipeline": {"fuse_depth": 4, "waves_submitted": 12,
+                         "fused_dispatches": 3, "eager_waves": 0},
+            "routed_waves": 15, "exchange_levels": 72,
+            "wave_chain_ms_p50": 10.553, "wave_chain_ms_p99": 16.637,
+            "wave_chain_rejects": 0, "reshard_moves": 29,
+            "oracle_divergence": 0, "mesh_member_relays": 0,
+            "dcn_fallback_relays": 0,
+        },
+    }
     line = json.dumps(
-        _compact_result(7.07e9, detail, live, edge=edge), separators=(",", ":")
+        _compact_result(7.07e9, detail, live, edge=edge, mesh=mesh),
+        separators=(",", ":"),
     )
-    assert len(line) < 2500, f"compact record grew to {len(line)} bytes"
+    assert len(line) < 3100, f"compact record grew to {len(line)} bytes"
     d = json.loads(line)
     # the edge tier (ISSUE 8): the million-subscriber numbers make the capture
     assert d["edge"]["subs"] == 1_000_000 and d["edge"]["fenced_per_s"] == 412346
@@ -97,6 +122,12 @@ def test_compact_record_stays_under_tail_window():
     assert d["live"]["overlap_occupancy"] == 0.4312
     assert d["live"]["eager_fallback_rounds"] == 0
     assert d["live"]["mirror_patch_device_ms"] == 1590.4
+    # the mesh-sharded graph (ISSUE 9): the north-star scale + oracle
+    # verdict + routed-path engagement ride the capture
+    assert d["mesh"]["nodes"] == 80_000_000 and d["mesh"]["oracle_exact"] is True
+    assert d["mesh"]["vs_single_device_10m"] == 8.0
+    assert d["mesh"]["reshard_moves"] == 29 and d["mesh"]["mesh_member_relays"] == 0
+    assert d["mesh"]["eager_waves"] == 0 and d["mesh"]["ok"] is True
 
 
 def test_compact_record_handles_live_error_and_sharded():

@@ -80,6 +80,10 @@ def test_packed_exchange_matches_bool(seed):
 
 
 def test_ring_exchange_matches_bool():
+    from stl_fusion_tpu.ops.pallas_kernels import ring_all_gather_supported
+
+    if not ring_all_gather_supported():
+        pytest.skip("jax on this image lacks the ring kernel's APIs")
     rng = np.random.default_rng(5)
     n = 500
     edges = random_dag(rng, n, avg_deg=3.0)
