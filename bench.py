@@ -853,6 +853,15 @@ def _compact_result(
             "attach_sessions_per_s": _r(edge.get("attach_sessions_per_s"), 0),
             "evictions": edge.get("evictions"),
             "coalesced_frames": edge.get("coalesced_frames"),
+            # the ISSUE 10 delivery plane: multi-process pool size, the
+            # parent's fan-shard count, the serialize-once amortization
+            # ratio (deliveries per encode) and per-worker throughput
+            "workers": edge.get("edge_workers"),
+            "fan_workers": edge.get("fan_workers"),
+            "encode_ratio": edge.get("encode_ratio"),
+            "deliveries_per_s_per_worker": _r(
+                edge.get("deliveries_per_s_per_worker"), 0
+            ),
         }
     if mesh is not None and "error" in mesh:
         out["mesh"] = {"error": mesh["error"]}

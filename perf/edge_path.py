@@ -36,10 +36,28 @@ consumers over TCP, and asserts the `/metrics` exposition shows
 ``fusion_edge_sessions``, a non-empty ``fusion_edge_delivery_ms``
 histogram and the upstream-subscription invariant — the tier1.yml step.
 
+ISSUE 10 additions — the serialize-once multi-process delivery plane:
+
+- with **EDGE_WORKERS > 0** (the default) each edge runs an
+  ``EdgeWorkerPool``: the parent EdgeNode keeps the upstream
+  subscriptions and encodes each fenced frame ONCE; the simulated
+  sessions live in N OS worker processes that receive the shared bytes
+  over a pipe and pay the per-session envelope assembly — deliveries/s
+  scales with processes instead of the one-interpreter fan loop.
+  ``EDGE_WORKERS=0`` is the single-process A/B (the PR 8 shape).
+- **amortization invariant (hard assert)**: encodes ≈ distinct fenced
+  (key, version) pairs and ≪ deliveries — any per-session encode
+  re-entry fails the run; the encode ratio (deliveries per encode) must
+  clear a floor scaled to the configured fan-out (100 at the canonical
+  zipf workload).
+- **EDGE_FAN_WORKERS** sets the parent's fan-shard count (the in-parent
+  session partitions drained concurrently).
+
 Env: EDGE_GRAPH_NODES (default 2_000_000), EDGE_NODES (4), EDGE_SESSIONS
 (1_000_000), EDGE_KEYS (512), EDGE_KEYS_PER_SESSION (2), EDGE_ZIPF (1.1),
 EDGE_ROUNDS (2), EDGE_GROUPS (16), EDGE_SEEDS_PER_GROUP (2),
-EDGE_TIMEOUT_S (600), EDGE_WIRE (1), EDGE_SMOKE (0).
+EDGE_TIMEOUT_S (600), EDGE_WIRE (1), EDGE_SMOKE (0), EDGE_WORKERS (2),
+EDGE_FAN_WORKERS (2).
 
 Prints ONE JSON line (stdout); progress notes go to stderr.
 """
@@ -84,7 +102,7 @@ from stl_fusion_tpu.core import (  # noqa: E402
     set_default_hub,
 )
 from stl_fusion_tpu.diagnostics import global_metrics  # noqa: E402
-from stl_fusion_tpu.edge import EdgeNode  # noqa: E402
+from stl_fusion_tpu.edge import EdgeNode, EdgeWorkerPool  # noqa: E402
 from stl_fusion_tpu.graph import TpuGraphBackend  # noqa: E402
 from stl_fusion_tpu.graph.synthetic import power_law_dag  # noqa: E402
 from stl_fusion_tpu.rpc import RpcHub, RpcTestTransport  # noqa: E402
@@ -184,9 +202,13 @@ def require(cond: bool, what: str) -> None:
 
 class Edge:
     """One in-process edge gateway: own fusion graph + RpcHub + transport
-    (codec-faithful) + EdgeNode + shared delivery observer."""
+    (codec-faithful) + EdgeNode + shared delivery observer (+ optional
+    multi-process delivery pool)."""
 
-    def __init__(self, i: int, server_rpc: RpcHub, wire_codec: bool):
+    def __init__(
+        self, i: int, server_rpc: RpcHub, wire_codec: bool,
+        fan_workers: int = 2,
+    ):
         self.i = i
         self.fusion = FusionHub()
         self.rpc = RpcHub(f"edge-{i}")
@@ -194,8 +216,30 @@ class Edge:
         self.transport = RpcTestTransport(
             self.rpc, server_rpc, wire_codec=wire_codec, client_name=f"e{i}"
         )
-        self.node = EdgeNode("dag", self.rpc, self.fusion, name=f"edge-{i}")
+        self.node = EdgeNode(
+            "dag", self.rpc, self.fusion, name=f"edge-{i}",
+            fan_workers=fan_workers,
+        )
         self.observer = Observer()
+        self.pool = None
+        #: per-worker (subscriptions, baseline-deliveries) for the round
+        #: accounting in pool mode
+        self.worker_expected: list = []
+        self.worker_base: list = []
+        self.sim_subs = 0
+
+    async def workers_done(self) -> tuple:
+        """(done, delivered-so-far-this-round) against the armed
+        baselines — one stats round trip per call (which also merges the
+        workers' delivery histograms into the process registry)."""
+        stats = await self.pool.stats()
+        delivered = [
+            s["deliveries"] - b for s, b in zip(stats, self.worker_base)
+        ]
+        done = all(
+            d >= exp for d, exp in zip(delivered, self.worker_expected)
+        )
+        return done, sum(delivered)
 
 
 async def main() -> None:
@@ -212,6 +256,8 @@ async def main() -> None:
     timeout_s = float(os.environ.get("EDGE_TIMEOUT_S", 600))
     wire_codec = os.environ.get("EDGE_WIRE", "1") == "1"
     smoke = os.environ.get("EDGE_SMOKE", "0") == "1"
+    n_workers = int(os.environ.get("EDGE_WORKERS", 2))
+    fan_workers = int(os.environ.get("EDGE_FAN_WORKERS", 2))
     rng = np.random.default_rng(523)
 
     note(f"generating {n}-node power-law DAG...")
@@ -273,7 +319,14 @@ async def main() -> None:
 
         # ---------------------------------------------------------- edges
         rss_before = rss_mb()
-        edges = [Edge(i, server_rpc, wire_codec) for i in range(n_edges)]
+        edges = [
+            Edge(i, server_rpc, wire_codec, fan_workers=fan_workers)
+            for i in range(n_edges)
+        ]
+        if n_workers > 0:
+            note(f"starting {n_workers} delivery workers per edge...")
+            for e in edges:
+                e.pool = await EdgeWorkerPool(e.node, workers=n_workers).start()
         note(f"subscribing {n_edges} edges × {n_keys} keys upstream...")
         t0 = time.perf_counter()
         # prime every edge's upstream subs by attaching one probe session
@@ -288,28 +341,60 @@ async def main() -> None:
             )
         subscribe_s = time.perf_counter() - t0
 
-        note(f"attaching {n_sessions} sessions (zipf a={zipf_a} over {n_keys} keys)...")
+        note(
+            f"attaching {n_sessions} sessions (zipf a={zipf_a} over "
+            f"{n_keys} keys, "
+            + (f"{n_workers} worker procs/edge" if n_workers else "in-parent")
+            + ")..."
+        )
         t0 = time.perf_counter()
         weights = zipf_weights(n_keys, zipf_a)
         per_edge = n_sessions // n_edges
+        sim_subs_total = 0
         for e in edges:
             picks = rng.choice(n_keys, size=(per_edge, keys_per_session), p=weights)
-            sink = e.observer.sink
-            attach = e.node.attach
-            for row in picks:
-                specs = [key_specs[k] for k in set(row.tolist())]
-                attach(specs, sink=sink, track_versions=False, replay_current=False)
+            if n_workers > 0:
+                # sessions round-robin over the edge's worker processes;
+                # each worker holds the per-session envelope prefixes, the
+                # parent only the per-worker subscription COUNTS
+                counts: list = [dict() for _ in range(n_workers)]
+                for si, row in enumerate(picks):
+                    c = counts[si % n_workers]
+                    for k in set(row.tolist()):
+                        spec = key_specs[k]
+                        c[spec] = c.get(spec, 0) + 1
+                e.worker_expected = []
+                for w, cmap in enumerate(counts):
+                    added = await e.pool.add_sim_sessions(w, cmap)
+                    e.worker_expected.append(added)
+                    sim_subs_total += added
+                e.sim_subs = sum(e.worker_expected)
+            else:
+                sink = e.observer.sink
+                attach = e.node.attach
+                for row in picks:
+                    specs = [key_specs[k] for k in set(row.tolist())]
+                    attach(
+                        specs, sink=sink, track_versions=False,
+                        replay_current=False,
+                    )
         attach_s = time.perf_counter() - t0
         rss_after = rss_mb()
         per_edge_rss_mb = (rss_after - rss_before) / n_edges
-        total_sessions = sum(len(e.node._sessions) for e in edges)
-        expected_per_round = sum(
-            len(sub.sessions) for e in edges for sub in e.node._subs.values()
+        parent_sessions = sum(len(e.node._sessions) for e in edges)
+        total_sessions = parent_sessions + (
+            per_edge * n_edges if n_workers > 0 else 0
         )
+        parent_subs_per_round = sum(
+            sub.session_count
+            for e in edges
+            for sub in e.node._subs.values()
+        )
+        expected_per_round = parent_subs_per_round + sim_subs_total
         note(
             f"attached in {attach_s:.1f}s; {total_sessions} sessions, "
             f"{expected_per_round} subscriptions, "
-            f"{per_edge_rss_mb:.0f} MB/edge"
+            f"{per_edge_rss_mb:.0f} MB/edge (parent)"
         )
 
         # ------------------------------------------------- invariant: ONE
@@ -344,8 +429,12 @@ async def main() -> None:
             backend.flush()
             for e in edges:
                 e.observer.arm(
-                    sum(len(sub.sessions) for sub in e.node._subs.values())
+                    sum(sub.session_count for sub in e.node._subs.values())
                 )
+                if e.pool is not None:
+                    e.worker_base = [
+                        s["deliveries"] for s in await e.pool.stats()
+                    ]
             cp = hist.checkpoint()
             t0 = time.perf_counter()
             counts = backend.cascade_rows_lanes(block, groups)
@@ -354,33 +443,105 @@ async def main() -> None:
                 asyncio.gather(*(e.observer.event.wait() for e in edges)),
                 timeout_s,
             )
+            t_obs = time.perf_counter()
+            worker_round = 0
+            if n_workers > 0:
+                # the worker processes reach their round quota in
+                # parallel; each poll also merges the worker histograms
+                # into the process delivery histogram
+                deadline = time.perf_counter() + timeout_s
+                pending = list(edges)
+                while pending:
+                    still = []
+                    for e in pending:
+                        done, delivered = await e.workers_done()
+                        if not done:
+                            still.append(e)
+                    if still and time.perf_counter() > deadline:
+                        raise SystemExit(
+                            "EDGE PATH FAILED: timed out waiting for "
+                            f"round {rnd} worker deliveries"
+                        )
+                    pending = still
+                    if pending:
+                        await asyncio.sleep(0.02)
+                for e in edges:
+                    _done, delivered = await e.workers_done()
+                    worker_round += delivered
             t_all = time.perf_counter()
             burst_s += t_burst - t0
             fanout_s += t_all - t_burst
-            round_deliveries += sum(e.observer.fenced for e in edges)
+            round_total = sum(e.observer.fenced for e in edges) + worker_round
+            round_deliveries += round_total
             delivery = hist.since(cp)  # last round's distribution
             note(
                 f"round {rnd}: burst {t_burst - t0:.2f}s "
                 f"({int(counts.sum()):,} inv), fan-out {t_all - t_burst:.2f}s "
-                f"({sum(e.observer.fenced for e in edges):,} deliveries), "
+                f"(upstream+probe {t_obs - t_burst:.2f}s, workers "
+                f"{t_all - t_obs:.2f}s; {round_total:,} deliveries), "
                 f"delivery p50/p99 {delivery['p50']}/{delivery['p99']} ms"
             )
             backend.refresh_block_on_device(block)
             backend.flush()
             await settle()
 
-        evictions = sum(e.node.evictions for e in edges)
+        worker_evictions = 0
+        worker_rss = []
+        deliveries_by_worker = []
+        if n_workers > 0:
+            for e in edges:
+                for s in await e.pool.stats():
+                    worker_evictions += s.get("evictions", 0)
+                    worker_rss.append(s.get("rss_mb", 0.0))
+                    deliveries_by_worker.append(s.get("deliveries", 0))
+        evictions = sum(e.node.evictions for e in edges) + worker_evictions
         require(evictions == 0, f"{evictions} sessions were evicted mid-run")
         require(
             round_deliveries == expected_per_round * rounds,
             f"deliveries {round_deliveries} != expected {expected_per_round * rounds}",
         )
 
+        # ---------------------------------------- amortization invariant
+        # (ISSUE 10): encodes ≈ distinct fanned (key, version) pairs —
+        # sub.version counts exactly the fanned versions per key — and
+        # STRICTLY ≪ deliveries; any per-session encode re-entry explodes
+        # frames_encoded past the version total and fails here
+        frames_encoded_total = sum(e.node.frames_encoded for e in edges)
+        versions_total = sum(
+            sub.version for e in edges for sub in e.node._subs.values()
+        )
+        deliveries_total = sum(e.node.deliveries for e in edges) + sum(
+            deliveries_by_worker
+        )
+        require(
+            frames_encoded_total >= n_edges * n_keys,
+            "serialize-once cache never engaged "
+            f"(encodes {frames_encoded_total})",
+        )
+        require(
+            frames_encoded_total <= versions_total + n_edges * n_keys,
+            f"per-session encode re-entry: {frames_encoded_total} encodes "
+            f"for {versions_total} fanned (key, version) pairs",
+        )
+        encode_ratio = (
+            deliveries_total / frames_encoded_total if frames_encoded_total else 0.0
+        )
+        # the floor scales with the configured fan-out and caps at the
+        # canonical 100 (ISSUE 10 acceptance at the zipf workload)
+        ratio_floor = min(
+            100.0, max(2.0, expected_per_round / (n_edges * n_keys * 2))
+        )
+        require(
+            encode_ratio >= ratio_floor,
+            f"encode ratio {encode_ratio:.1f} below floor {ratio_floor:.1f} "
+            f"({deliveries_total} deliveries / {frames_encoded_total} encodes)",
+        )
+
         smoke_result = None
         if smoke:
             smoke_result = await run_smoke(
                 edges[0], n_edges * n_keys, fanout_index, backend, block, groups,
-                timeout_s,
+                timeout_s, [e.node for e in edges],
             )
 
         result = {
@@ -398,6 +559,11 @@ async def main() -> None:
             "upstream_subs_total": n_edges * n_keys,
             "rounds": rounds,
             "wire_codec": wire_codec,
+            "edge_workers": n_workers,
+            "fan_workers": fan_workers,
+            "frames_encoded": frames_encoded_total,
+            "deliveries_total": deliveries_total,
+            "encode_ratio": round(encode_ratio, 1),
             "build_s": round(build_s, 2),
             "mirror_build_s": round(mirror_s, 2),
             "subscribe_s": round(subscribe_s, 2),
@@ -407,11 +573,21 @@ async def main() -> None:
             "fanout_s": round(fanout_s, 3),
             "fenced_total": round_deliveries,
             "fenced_per_s": round(round_deliveries / fanout_s, 1) if fanout_s else None,
+            "deliveries_per_s_per_worker": round(
+                round_deliveries / fanout_s / (n_edges * n_workers), 1
+            )
+            if fanout_s and n_workers
+            else None,
             # the system's own fence→client-visible histogram (last round)
             "delivery_ms_p50": delivery.get("p50"),
             "delivery_ms_p99": delivery.get("p99"),
             "system_delivery_ms": delivery,
             "per_edge_rss_mb": round(per_edge_rss_mb, 1),
+            "per_worker_rss_mb": round(
+                sum(worker_rss) / len(worker_rss), 1
+            )
+            if worker_rss
+            else None,
             "evictions": evictions,
             "coalesced_frames": sum(e.node.coalesced_frames for e in edges),
         }
@@ -429,7 +605,7 @@ async def main() -> None:
 
 async def run_smoke(
     edge: "Edge", expected_upstream_total: int, fanout_index, backend, block,
-    groups, timeout_s: float,
+    groups, timeout_s: float, all_nodes=None,
 ) -> dict:
     """EDGE_SMOKE=1 (tier1.yml): boot a REAL EdgeHttpServer on the first
     edge, attach live SSE consumers over TCP, burst once, and assert the
@@ -527,15 +703,83 @@ async def run_smoke(
         f"smoke: upstream subscriptions {subs} != distinct-key total "
         f"{expected_upstream_total} — coalescing not engaged",
     )
+    # the ISSUE 10 amortization invariant, asserted from the EXPOSITION
+    # (what an operator's scrape would show): encodes present, bounded by
+    # the fanned version totals (no per-session encode re-entry), and
+    # strictly below the delivery total
+    enc = metrics.get("fusion_edge_frames_encoded_total", 0)
+    # worker deliveries ride the same encodes — the collector exports the
+    # pool's last-pulled cumulative beside the parent's own count
+    deliv = metrics.get("fusion_edge_deliveries_total", 0) + metrics.get(
+        "fusion_edge_worker_deliveries_total", 0
+    )
+    # the scrape sums every edge node in the process: the version bound
+    # must span them all too
+    nodes = all_nodes if all_nodes is not None else [node]
+    versions_total = sum(
+        sub.version for nd in nodes for sub in nd._subs.values()
+    )
+    subs_slack = sum(len(nd._subs) for nd in nodes)
+    require(enc > 0, "smoke: fusion_edge_frames_encoded_total missing/zero")
+    require(
+        enc <= versions_total + subs_slack,
+        f"smoke: per-session encode re-entry — {enc} encodes for "
+        f"{versions_total} fanned (key, version) pairs",
+    )
+    require(
+        deliv >= 2 * enc,
+        f"smoke: encode amortization not engaged — {deliv} deliveries "
+        f"vs {enc} encodes",
+    )
+    smoke_workers = None
+    if edge.pool is not None:
+        # one REAL consumer through the SO_REUSEPORT worker listener: the
+        # multi-process plane serves hello + the cached replay end to end
+        port = await edge.pool.listen()
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            f"GET /edge/sse?keys={keys_q} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        )
+        await writer.drain()
+        while True:
+            line = (await asyncio.wait_for(reader.readline(), 30.0)).decode()
+            require(line != "", "smoke: worker SSE closed during headers")
+            if line in ("\r\n", "\n"):
+                break
+        hello = await read_event(reader)
+        require(
+            hello.get("event") == "hello", f"smoke: bad worker hello {hello}"
+        )
+        replays = [await read_event(reader) for _ in key_specs]
+        require(
+            all(ev.get("event") == "update" for ev in replays),
+            f"smoke: bad worker replay {replays}",
+        )
+        require(
+            all("t0" not in json.loads(ev["data"]) for ev in replays),
+            "smoke: worker replay leaked the stale fence origin_ts",
+        )
+        writer.close()
+        stats = await edge.pool.stats()
+        smoke_workers = {
+            "workers": len(stats),
+            "worker_deliveries": sum(s["deliveries"] for s in stats),
+            "listen_port": port,
+        }
     for _r, w in readers:
         w.close()
     await http.stop()
-    return {
+    out = {
         "sse_consumers": len(readers),
         "metrics_sessions": sessions,
         "metrics_upstream_subs": subs,
         "delivery_count": metrics.get("fusion_edge_delivery_ms_count"),
+        "frames_encoded": enc,
+        "deliveries": deliv,
     }
+    if smoke_workers is not None:
+        out["worker_pool"] = smoke_workers
+    return out
 
 
 if __name__ == "__main__":

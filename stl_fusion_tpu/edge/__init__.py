@@ -11,17 +11,21 @@ from .gateway import EdgeNode
 from .server import EdgeHttpServer, EdgeWebSocketServer
 from .session import (
     EdgeSession,
+    EncodedFrame,
     KeyedMailbox,
     LatestWinsMailbox,
     frame_to_dict,
     pump_payloads,
 )
+from .worker_pool import EdgeWorkerPool
 
 __all__ = [
     "EdgeNode",
     "EdgeHttpServer",
     "EdgeWebSocketServer",
     "EdgeSession",
+    "EdgeWorkerPool",
+    "EncodedFrame",
     "KeyedMailbox",
     "LatestWinsMailbox",
     "frame_to_dict",

@@ -50,7 +50,7 @@ from ..client.client_function import FusionClient
 from ..core.context import capture
 from ..diagnostics.flight_recorder import RECORDER, call_key
 from ..diagnostics.metrics import global_metrics
-from .session import EdgeSession, Frame, KeyedMailbox
+from .session import EdgeSession, EncodedFrame, Frame, KeyedMailbox
 
 log = logging.getLogger("stl_fusion_tpu")
 
@@ -72,7 +72,14 @@ KeySpec = Union[Tuple[Any, ...], List[Any]]
 
 
 class _KeySub:
-    """One distinct key's upstream subscription + downstream fan list."""
+    """One distinct key's upstream subscription + downstream fan list.
+
+    Sessions are PARTITIONED into the node's fan shards (``shards[w]`` is
+    the set of attached sessions whose ``session.shard == w``), so the
+    hottest key's fan-out is drained by W parallel workers instead of one
+    sequential loop (ISSUE 10b). ``sessions`` is the compat union view —
+    iteration/len only; membership mutations go through the shard sets.
+    """
 
     __slots__ = (
         "key_str",
@@ -80,16 +87,17 @@ class _KeySub:
         "args",
         "version",
         "last_frame",
-        "sessions",
+        "shards",
         "task",
         "peer_ref",
         "closed",
         "parked_refs",
+        "pins",
         "repin_cause",
         "_repin",
     )
 
-    def __init__(self, key_str: str, method: str, args: tuple):
+    def __init__(self, key_str: str, method: str, args: tuple, n_shards: int = 1):
         self.key_str = key_str
         self.method = method
         self.args = args
@@ -97,21 +105,118 @@ class _KeySub:
         #: style): bumped once per fanned frame, never reused
         self.version = 0
         self.last_frame: Optional[Frame] = None
-        self.sessions: Set[EdgeSession] = set()
+        self.shards: List[Set[EdgeSession]] = [set() for _ in range(n_shards)]
         self.task: Optional[asyncio.Task] = None
         self.peer_ref: Optional[str] = None
         self.closed = False
         #: parked (evicted/disconnected) sessions holding this key — the
         #: sub must outlive its live sessions while a resume could return
         self.parked_refs = 0
+        #: sessionless holds (EdgeNode.acquire_keys — the worker pool's
+        #: remote sessions): the sub must outlive local sessions while a
+        #: delivery-plane worker still serves the key
+        self.pins = 0
         #: set when a shard-map change moved this key's owner: the watch
         #: loop re-subscribes there and stamps the next frame's cause
         self.repin_cause: Optional[str] = None
         self._repin = asyncio.Event()
 
+    @property
+    def sessions(self) -> Set[EdgeSession]:
+        """Union view over the shard partitions (tests/operators; the hot
+        paths use the shard sets and :attr:`session_count` directly)."""
+        if len(self.shards) == 1:
+            return self.shards[0]
+        out: Set[EdgeSession] = set()
+        for bucket in self.shards:
+            out |= bucket
+        return out
+
+    @property
+    def session_count(self) -> int:
+        return sum(len(bucket) for bucket in self.shards)
+
+    def add_session(self, session: EdgeSession) -> None:
+        self.shards[session.shard].add(session)
+
+    def discard_session(self, session: EdgeSession) -> None:
+        self.shards[session.shard].discard(session)
+
+    @property
+    def unreferenced(self) -> bool:
+        return (
+            self.session_count == 0 and self.parked_refs <= 0 and self.pins <= 0
+        )
+
     def repin(self, cause: str) -> None:
         self.repin_cause = cause
         self._repin.set()
+
+
+class _FanShard:
+    """One fan worker: a latest-wins (per key) queue of encoded frames +
+    the drain task that walks ITS partition of each sub's sessions. The
+    watch loop posts once per shard instead of walking every session
+    itself, so W shards drain the hottest key concurrently and a fence
+    for another key never queues behind a 250k-session fan."""
+
+    __slots__ = ("node", "index", "_pending", "_event", "task",
+                 "busy_ms", "delivered", "drains", "coalesced")
+
+    def __init__(self, node: "EdgeNode", index: int):
+        self.node = node
+        self.index = index
+        #: key_str -> (sub, frame, encoded) — latest-wins: a newer version
+        #: posted before the drain REPLACES the older one (those sessions
+        #: could never have seen it; counted as coalesced)
+        self._pending: Dict[str, tuple] = {}
+        self._event = asyncio.Event()
+        self.task: Optional[asyncio.Task] = None
+        self.busy_ms = 0.0
+        self.delivered = 0
+        self.drains = 0
+        self.coalesced = 0
+
+    def post(self, sub: _KeySub, frame: Frame, encoded: EncodedFrame) -> None:
+        if sub.key_str in self._pending:
+            self.coalesced += 1
+        self._pending[sub.key_str] = (sub, frame, encoded)
+        self._event.set()
+        if self.task is None or self.task.done():
+            self.task = asyncio.get_event_loop().create_task(self._run())
+
+    def snapshot(self) -> dict:
+        return {
+            "busy_ms": round(self.busy_ms, 3),
+            "delivered": self.delivered,
+            "drains": self.drains,
+            "coalesced": self.coalesced,
+            "pending": len(self._pending),
+        }
+
+    async def _run(self) -> None:
+        try:
+            while True:
+                while not self._pending:
+                    self._event.clear()
+                    await self._event.wait()
+                self._event.clear()
+                batch = list(self._pending.values())
+                self._pending.clear()
+                t0 = time.perf_counter()
+                for sub, frame, encoded in batch:
+                    self.node._fan_shard_deliver(self, sub, frame, encoded)
+                self.busy_ms += (time.perf_counter() - t0) * 1e3
+                self.drains += 1
+                # yield between drains: siblings (and the watch loops) get
+                # the loop even while one shard stays hot
+                await asyncio.sleep(0)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — a fan shard must never die silently
+            log.exception(
+                "edge %s: fan shard %d failed", self.node.name, self.index
+            )
 
 
 class EdgeNode:
@@ -137,6 +242,7 @@ class EdgeNode:
         error_backoff: float = 0.05,
         allowed_methods=None,
         max_keys_per_session: int = 1024,
+        fan_workers: int = 1,
     ):
         from ..core.hub import FusionHub
 
@@ -161,6 +267,25 @@ class EdgeNode:
         #: distinct keys one session may subscribe: bounds the upstream
         #: subscription state a single connection can mint
         self.max_keys_per_session = max_keys_per_session
+        #: fan shards (ISSUE 10b): sessions partition round-robin over W
+        #: parallel fan workers; each upstream fence posts ONE encoded
+        #: frame per shard instead of walking every session in the watch
+        #: loop
+        self.fan_workers = max(1, int(fan_workers))
+        self._fan_shards = [_FanShard(self, w) for w in range(self.fan_workers)]
+        self._shard_rr = 0
+        #: version-keyed serialize-once cache (ISSUE 10a): key_str -> the
+        #: latest fanned frame's EncodedFrame; every downstream transport
+        #: writes the same immutable bytes. Bounded by live distinct keys
+        #: (entries drop with their sub's teardown — the parked-session
+        #: sweep path included).
+        self._encoded: Dict[str, EncodedFrame] = {}
+        #: delivery-plane broadcast hooks (the multi-process worker pool):
+        #: called once per fanned frame with (key_str, frame, encoded)
+        self._broadcasts: List = []
+        #: attached EdgeWorkerPool (set by EdgeWorkerPool.start) — owned
+        #: by the caller unless attached, then close() stops it
+        self.worker_pool = None
         if router is not None:
             # affinity + gossip: route through the cluster map, and re-pin
             # moved keys on every applied epoch (membership pushes /
@@ -184,6 +309,16 @@ class EdgeNode:
         # -- counters (collector-exported as fusion_edge_*) ---------------
         self.frames_fanned = 0
         self.coalesced_frames = 0  # latest-wins drops inside session mailboxes
+        #: distinct (key, version) wire payloads actually serialized — the
+        #: amortization numerator: deliveries / encodes is the serialize-
+        #: once win (CI gates encodes ≈ fenced pairs ≪ deliveries)
+        self.frames_encoded = 0
+        #: encodes that fell back to repr for a non-JSON payload —
+        #: detected ONCE at encode time, never silently per session
+        self.frames_lossy = 0
+        #: client-visible session deliveries (sink returns + transport-
+        #: accepted pump batches); the amortization denominator
+        self.deliveries = 0
         self.evictions = 0
         self.resumes = 0
         self.resubscribes = 0  # upstream re-pins after a shard move
@@ -198,18 +333,32 @@ class EdgeNode:
 
     # ------------------------------------------------------------------ metrics
     def _collect_metrics(self) -> dict:
-        return {
+        out = {
             "fusion_edge_sessions": len(self._sessions),
             "fusion_edge_parked_sessions": len(self._parked),
             "fusion_edge_upstream_subscriptions": len(self._subs),
             "fusion_edge_frames_sent_total": self.frames_fanned,
             "fusion_edge_coalesced_frames_total": self.coalesced_frames,
+            "fusion_edge_frames_encoded_total": self.frames_encoded,
+            "fusion_edge_frames_lossy_total": self.frames_lossy,
+            "fusion_edge_deliveries_total": self.deliveries,
+            "fusion_edge_fan_shard_busy_ms": round(
+                sum(s.busy_ms for s in self._fan_shards), 3
+            ),
+            "fusion_edge_fan_workers": self.fan_workers,
             "fusion_edge_evictions_total": self.evictions,
             "fusion_edge_resumes_total": self.resumes,
             "fusion_edge_resubscribes_total": self.resubscribes,
             "fusion_edge_upstream_fences_total": self.upstream_fences,
             "fusion_edge_upstream_errors_total": self.upstream_errors,
         }
+        pool = self.worker_pool
+        if pool is not None:
+            # last-pulled worker aggregates (the pool's stats() refreshes
+            # them; collectors must stay sync)
+            out["fusion_edge_workers"] = pool.n_workers
+            out["fusion_edge_worker_deliveries_total"] = pool.deliveries_seen
+        return out
 
     def snapshot(self) -> dict:
         """Operator view (FusionMonitor.report()["edge"], GET /shards-style
@@ -218,7 +367,8 @@ class EdgeNode:
         for sub in self._subs.values():
             if sub.peer_ref is not None:
                 owners[sub.peer_ref] = owners.get(sub.peer_ref, 0) + 1
-        return {
+        pool = self.worker_pool
+        out = {
             "name": self.name,
             "service": self.service,
             "sessions": len(self._sessions),
@@ -227,6 +377,24 @@ class EdgeNode:
             "upstream_by_owner": owners,
             "frames_fanned": self.frames_fanned,
             "coalesced_frames": self.coalesced_frames,
+            "frames_encoded": self.frames_encoded,
+            "frames_lossy": self.frames_lossy,
+            "deliveries": self.deliveries,
+            # deliveries per encode — the serialize-once amortization
+            # ratio an operator reads first (ISSUE 10); worker-pool
+            # deliveries ride the SAME encodes, so they count
+            "encode_ratio": round(
+                (
+                    self.deliveries
+                    + (pool.deliveries_seen if pool is not None else 0)
+                )
+                / self.frames_encoded,
+                1,
+            )
+            if self.frames_encoded
+            else None,
+            "fan_workers": self.fan_workers,
+            "fan_shards": [s.snapshot() for s in self._fan_shards],
             "evictions": self.evictions,
             "resumes": self.resumes,
             "resubscribes": self.resubscribes,
@@ -238,6 +406,9 @@ class EdgeNode:
             # distribution; per-node triage uses the counters above
             "delivery_ms_process": self._delivery_hist.snapshot(),
         }
+        if pool is not None:
+            out["worker_pool"] = pool.snapshot()
+        return out
 
     # ------------------------------------------------------------------ keys
     def _normalize(self, spec: KeySpec) -> Tuple[str, tuple]:
@@ -310,11 +481,12 @@ class EdgeNode:
         session = EdgeSession(
             key_strs, sink=sink, mailbox=mailbox, track_versions=track_versions
         )
+        self._assign_shard(session)
         self._sessions.add(session)
         self.sessions_attached_total += 1
         for (method, args), ks in zip(specs, key_strs):
             sub = self._sub_for(ks, method, args)
-            sub.sessions.add(session)
+            sub.add_session(session)
         if replay_current:
             # replay AFTER the session joined every sub: a replay that
             # evicts (broken sink, overflow) has detached the session from
@@ -348,12 +520,84 @@ class EdgeNode:
         if not ok and not session.evicted:
             self.evict(session, reason="replay delivery failed")
 
+    def _assign_shard(self, session: EdgeSession) -> None:
+        """Round-robin fan-shard placement by attach ordinal — sessions
+        partition evenly over the W fan workers."""
+        session.shard = self._shard_rr % self.fan_workers
+        self._shard_rr += 1
+
     def _sub_for(self, key_str: str, method: str, args: tuple) -> _KeySub:
         sub = self._subs.get(key_str)
         if sub is None:
-            sub = self._subs[key_str] = _KeySub(key_str, method, args)
+            sub = self._subs[key_str] = _KeySub(
+                key_str, method, args, n_shards=self.fan_workers
+            )
             sub.task = asyncio.get_event_loop().create_task(self._watch(sub))
         return sub
+
+    # ------------------------------------------------------------------ pinning
+    def acquire_keys(self, keys: Sequence[KeySpec]) -> List[str]:
+        """Hold upstream subscriptions WITHOUT a local session (the
+        multi-process delivery plane: workers own the sockets, this node
+        owns the upstream subs). Each acquired key's sub stays alive until
+        the matching :meth:`release_keys`. Returns the key_strs (the
+        broadcast identity). Validation (allowlist, underscore methods)
+        applies exactly as for attach()."""
+        if self._closed:
+            raise RuntimeError(f"edge node {self.name} is closed")
+        specs = [self._normalize(k) for k in keys]
+        key_strs = [call_key(self.service, m, a) for m, a in specs]
+        for (method, args), ks in zip(specs, key_strs):
+            sub = self._sub_for(ks, method, args)
+            sub.pins += 1
+        return key_strs
+
+    def release_keys(self, key_strs: Sequence[str]) -> None:
+        """Release :meth:`acquire_keys` holds; a sub with no sessions, no
+        parked refs and no pins tears down (and its encoded-cache entry
+        drops with it)."""
+        for ks in key_strs:
+            sub = self._subs.get(ks)
+            if sub is None:
+                continue
+            sub.pins -= 1
+            if sub.unreferenced:
+                self._teardown_sub(sub)
+
+    # ------------------------------------------------------------------ encode
+    def encode_frame(self, frame: Frame) -> EncodedFrame:
+        """The serialize-once cache (ISSUE 10a): ONE wire encode per
+        (key, version), shared by every downstream session's pump, the
+        fan shards and the worker-pool broadcast. A cache hit is a dict
+        probe; the cache holds the LATEST version per key (latest-wins
+        delivery means older versions can only be asked for by a pump
+        that raced a newer fence — encoded then, but never cached over a
+        newer entry)."""
+        key, version = frame[0], frame[1]
+        has_t0 = frame[4] is not None
+        cached = self._encoded.get(key)
+        if cached is not None and cached.version == version:
+            if cached.has_t0 == has_t0:
+                return cached
+            # the t0-stripped replay twin (attach/resume replays must not
+            # ship the stale fence timestamp): encoded once, cached on
+            # the canonical entry
+            variant = cached.replay_variant
+            if variant is not None and variant.has_t0 == has_t0:
+                return variant
+            variant = EncodedFrame(frame)
+            self.frames_encoded += 1
+            if variant.lossy:
+                self.frames_lossy += 1
+            cached.replay_variant = variant
+            return variant
+        encoded = EncodedFrame(frame)
+        self.frames_encoded += 1
+        if encoded.lossy:
+            self.frames_lossy += 1
+        if cached is None or version > cached.version:
+            self._encoded[key] = encoded
+        return encoded
 
     def detach(self, session: EdgeSession, park: bool = True) -> Optional[str]:
         """Remove a session. With ``park`` (the disconnect default) its
@@ -380,10 +624,10 @@ class EdgeNode:
             sub = self._subs.get(ks)
             if sub is None:
                 continue
-            sub.sessions.discard(session)
+            sub.discard_session(session)
             if park:
                 sub.parked_refs += 1
-            if not sub.sessions and sub.parked_refs <= 0:
+            if sub.unreferenced:
                 self._teardown_sub(sub)
         return token
 
@@ -412,6 +656,7 @@ class EdgeNode:
         session = EdgeSession(key_strs, sink=sink, mailbox=mailbox, token=token)
         if session.versions is not None:
             session.versions.update(versions)
+        self._assign_shard(session)
         self._sessions.add(session)
         self.resumes += 1
         for ks in key_strs:
@@ -419,7 +664,7 @@ class EdgeNode:
             if sub is None:  # torn down while parked (should not happen —
                 continue  # parked_refs pins it — but never KeyError a resume)
             sub.parked_refs -= 1
-            sub.sessions.add(session)
+            sub.add_session(session)
         for ks in key_strs:  # replay after joining every sub (see attach)
             if session.evicted:
                 break
@@ -473,7 +718,7 @@ class EdgeNode:
             if sub is None:
                 continue
             sub.parked_refs -= 1
-            if not sub.sessions and sub.parked_refs <= 0:
+            if sub.unreferenced:
                 self._teardown_sub(sub)
 
     def evict(self, session: EdgeSession, reason: str = "stalled") -> Optional[str]:
@@ -506,6 +751,10 @@ class EdgeNode:
         sub.closed = True
         sub._repin.set()  # unblock a parked watch loop so it exits
         self._subs.pop(sub.key_str, None)
+        # the serialize-once cache entry dies with the sub (this is the
+        # eviction path the parked-session sweep drives: last parked ref
+        # expires -> sub tears down -> cached bytes are released)
+        self._encoded.pop(sub.key_str, None)
         if sub.task is not None and not sub.task.done():
             sub.task.cancel()
 
@@ -568,17 +817,25 @@ class EdgeNode:
                 while True:
                     sub._repin.clear()
                     if sub.repin_cause is None and not node.is_invalidated:
-                        inval = node.when_invalidated()
-                        repin_task = asyncio.get_event_loop().create_task(
-                            sub._repin.wait()
-                        )
-                        try:
-                            await asyncio.wait(
-                                {inval, repin_task},
-                                return_when=asyncio.FIRST_COMPLETED,
+                        if self.router is None:
+                            # no router ⇒ nothing ever calls repin(): wait
+                            # on the fence alone — the repin side-task +
+                            # asyncio.wait pair is measurable per-cycle
+                            # overhead across a 512-key fence storm
+                            # (teardown/close cancel this task directly)
+                            await node.when_invalidated()
+                        else:
+                            inval = node.when_invalidated()
+                            repin_task = asyncio.get_event_loop().create_task(
+                                sub._repin.wait()
                             )
-                        finally:
-                            repin_task.cancel()
+                            try:
+                                await asyncio.wait(
+                                    {inval, repin_task},
+                                    return_when=asyncio.FIRST_COMPLETED,
+                                )
+                            finally:
+                                repin_task.cancel()
                     if sub.closed or self._closed:
                         return
                     if sub.repin_cause is not None:
@@ -621,27 +878,59 @@ class EdgeNode:
         origin_ts: Optional[float],
         err: Optional[str],
     ) -> None:
-        """Re-fan one upstream frame to every attached session. Sessions
-        whose bounded mailbox overflowed are evicted (with resume tokens)
-        AFTER the loop — a slow consumer never stalls its siblings, it
-        just stops being a consumer."""
+        """Fan one upstream frame: serialize the wire payload ONCE (the
+        version-keyed encode cache), hand the shared bytes to the
+        delivery-plane broadcasts (worker pool), and post one entry per
+        fan shard — the shard workers walk their session partitions
+        concurrently instead of this watch loop walking every session
+        sequentially (ISSUE 10a+b)."""
         sub.version += 1
         frame: Frame = (sub.key_str, sub.version, value, cause, origin_ts, err)
         sub.last_frame = frame
-        if not sub.sessions:
+        # encode-once, eagerly: one dumps per fanned (key, version) makes
+        # the amortization ratio exact and the shared bytes ready before
+        # any pump or worker asks
+        encoded = self.encode_frame(frame)
+        if self._broadcasts:
+            for hook in self._broadcasts:
+                try:
+                    hook(sub.key_str, frame, encoded)
+                except Exception:  # noqa: BLE001 — a broken delivery plane
+                    # must not kill the key's watch loop
+                    log.exception(
+                        "edge %s: broadcast hook failed for %s",
+                        self.name, sub.key_str,
+                    )
+        for bucket, shard in zip(sub.shards, self._fan_shards):
+            if bucket:
+                shard.post(sub, frame, encoded)
+
+    def _fan_shard_deliver(
+        self, shard: _FanShard, sub: _KeySub, frame: Frame,
+        encoded: EncodedFrame,
+    ) -> None:
+        """One fan shard's delivery walk over ITS partition of the sub's
+        sessions. Sessions whose bounded mailbox overflowed (or whose
+        sink raised) are evicted (with resume tokens) AFTER the loop — a
+        slow consumer never stalls its siblings, it just stops being a
+        consumer."""
+        bucket = sub.shards[shard.index]
+        if not bucket:
             return
+        cause, origin_ts = frame[3], frame[4]
+        err = frame[5]
         dead: Optional[List[Tuple[EdgeSession, str]]] = None
         n = 0
         sinks = 0
-        for session in sub.sessions:
+        for session in bucket:
             mailbox = session.mailbox
             was_coalesced = mailbox.coalesced if mailbox is not None else 0
             try:
                 ok = session.deliver(frame)
             except Exception:  # noqa: BLE001 — ONE broken consumer sink
-                # must never kill the key's watch loop for its siblings:
-                # contain it as an eviction (parked; a fixed consumer can
-                # resume from its token)
+                # must never kill the fan for its siblings: contain it as
+                # an eviction (parked; a fixed consumer can resume from
+                # its token)
                 log.exception(
                     "edge %s: session sink failed for %s; evicting",
                     self.name, sub.key_str,
@@ -669,39 +958,62 @@ class EdgeNode:
                 self.evict(session, reason=reason)
             n -= len(dead)
         self.frames_fanned += n
+        self.deliveries += sinks  # sink sessions are client-visible NOW;
+        # mailbox sessions count at record_delivery (transport-accepted)
+        shard.delivered += n
         if origin_ts is not None:
-            # sink-flavor sessions are client-visible NOW (synchronous
-            # delivery); one timestamp after the loop bounds them all.
-            # Mailbox sessions record at pump-send time instead (the pump
-            # calls record_delivery per drained frame).
+            # sink-flavor sessions became client-visible in this drain —
+            # one timestamp after the loop bounds them all, INCLUDING the
+            # shard-queue wait (fence → visible, honestly). Mailbox
+            # sessions record at pump-send time instead (the pump calls
+            # record_delivery per drained frame).
             delta_ms = (time.perf_counter() - origin_ts) * 1e3
             if 0.0 <= delta_ms < 3.6e6 and sinks:  # range guard as $sys-c e2e
                 self._delivery_hist.record_many(delta_ms, sinks)
         if (cause is not None or err is not None) and RECORDER.enabled and n > 0:
             # the edge hop of the causal chain: explain() joins this to
             # the client-side "fenced" event (same call-shaped key, same
-            # cause) and renders "edge re-fanned to N session(s)";
-            # causeless initial-value fans stay un-journaled (they are
-            # attach mechanics, not invalidation causality), error fans
-            # are journaled so an operator sees who saw the failure
+            # cause) and SUMS per-shard counts into "edge re-fanned to N
+            # session(s)"; causeless initial-value fans stay un-journaled
+            # (they are attach mechanics, not invalidation causality),
+            # error fans are journaled so an operator sees who saw the
+            # failure
             RECORDER.note(
                 "edge_fenced",
                 key=sub.key_str,
                 cause=cause,
                 count=n,
-                detail=f"edge={self.name} v{sub.version} owner={sub.peer_ref}",
+                detail=(
+                    f"edge={self.name} v{frame[1]} shard={shard.index} "
+                    f"owner={sub.peer_ref}"
+                ),
             )
 
     def record_delivery(self, frame: Frame) -> None:
-        """Pump callback: a mailbox frame reached its peer — record the
-        fence→client-visible sample (the transport half of the histogram
-        sink-flavor sessions record inline)."""
+        """Pump callback: a mailbox frame reached its peer — count the
+        client-visible delivery and record the fence→client-visible
+        sample (the transport half of the histogram sink-flavor sessions
+        record inline)."""
+        self.deliveries += 1
         origin_ts = frame[4]
         if origin_ts is None:
             return
         delta_ms = (time.perf_counter() - origin_ts) * 1e3
         if 0.0 <= delta_ms < 3.6e6:
             self._delivery_hist.record(delta_ms)
+
+    # ------------------------------------------------------------------ plane
+    def attach_broadcast(self, hook) -> None:
+        """Register a delivery-plane broadcast: ``hook(key_str, frame,
+        encoded)`` runs once per fanned frame with the SHARED encoded
+        bytes (the worker pool's feed)."""
+        self._broadcasts.append(hook)
+
+    def detach_broadcast(self, hook) -> None:
+        try:
+            self._broadcasts.remove(hook)
+        except ValueError:
+            pass
 
     # ------------------------------------------------------------------ reshard
     def _on_map_change(self, old, new) -> None:
@@ -733,8 +1045,15 @@ class EdgeNode:
         """Stop every watch loop and drop session state (the rpc/fusion
         hubs are the caller's to stop — they may be shared)."""
         self._closed = True
+        pool, self.worker_pool = self.worker_pool, None
+        if pool is not None:
+            try:
+                await pool.stop()
+            except Exception:  # noqa: BLE001 — teardown must not bubble
+                log.exception("edge %s: worker pool stop failed", self.name)
         subs = list(self._subs.values())
         self._subs.clear()
+        self._encoded.clear()
         for sub in subs:
             sub.closed = True
             sub._repin.set()
@@ -744,6 +1063,15 @@ class EdgeNode:
             if sub.task is not None:
                 try:
                     await sub.task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+        for shard in self._fan_shards:
+            if shard.task is not None and not shard.task.done():
+                shard.task.cancel()
+        for shard in self._fan_shards:
+            if shard.task is not None:
+                try:
+                    await shard.task
                 except (asyncio.CancelledError, Exception):  # noqa: BLE001
                     pass
         self._sessions.clear()

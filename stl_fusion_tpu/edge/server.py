@@ -42,7 +42,7 @@ import urllib.parse
 from typing import Optional
 
 from .gateway import EdgeNode
-from .session import KeyedMailbox, frame_to_dict, pump_payloads
+from .session import KeyedMailbox, pump_payloads
 
 log = logging.getLogger("stl_fusion_tpu")
 
@@ -153,6 +153,14 @@ class EdgeHttpServer:
                 await write_metrics_response(writer)
                 return
             if path == "/edge/stats" and self._is_loopback(writer):
+                pool = self.node.worker_pool
+                if pool is not None:
+                    # refresh the per-worker stats the snapshot embeds
+                    # (each worker replies over its control channel)
+                    try:
+                        await pool.stats()
+                    except Exception:  # noqa: BLE001 — stats are best-effort
+                        log.exception("edge worker pool stats failed")
                 await self._write_json(writer, "200 OK", self.node.snapshot())
                 return
             await self._write_json(
@@ -230,13 +238,17 @@ class EdgeHttpServer:
         )
         hello = json.dumps({"token": sid, "keys": list(session.keys)})
         writer.write(f"id: {sid}\nevent: hello\ndata: {hello}\n\n".encode())
+        #: per-session envelope — the ONLY per-session bytes on the hot
+        #: path; the event body is the node's shared serialize-once cache
+        id_prefix = f"id: {sid}\n".encode()
 
         async def send(batch) -> None:
-            chunks = []
-            for frame in batch:
-                data = json.dumps(frame_to_dict(frame), default=repr)
-                chunks.append(f"id: {sid}\nevent: update\ndata: {data}\n\n")
-            writer.write("".join(chunks).encode())
+            writer.write(
+                b"".join(
+                    node.encode_frame(frame).sse_event(id_prefix)
+                    for frame in batch
+                )
+            )
             await writer.drain()
             # delivered: advance the resume map + the fence→visible samples
             session.mark_delivered(batch)
@@ -378,10 +390,13 @@ class EdgeWebSocketServer:
             await ws.close()
             return
         async def send(batch) -> None:
+            # the frame bodies are the node's shared serialize-once cache
+            # (decoded to str at most once per (key, version)); only the
+            # tiny batch envelope is assembled per send
             await ws.send(
-                json.dumps(
-                    {"frames": [frame_to_dict(f) for f in batch]}, default=repr
-                )
+                '{"frames":['
+                + ",".join(node.encode_frame(f).text for f in batch)
+                + "]}"
             )
             session.mark_delivered(batch)
             for frame in batch:

@@ -43,11 +43,13 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import json
 import os
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 __all__ = [
     "EdgeSession",
+    "EncodedFrame",
     "Frame",
     "KeyedMailbox",
     "LatestWinsMailbox",
@@ -76,6 +78,75 @@ def frame_to_dict(frame: Frame) -> dict:
     if origin_ts is not None:
         out["t0"] = origin_ts
     return out
+
+
+class EncodedFrame:
+    """One frame's wire payload, serialized EXACTLY ONCE (ISSUE 10).
+
+    The fan path used to pay ``json.dumps(frame_to_dict(frame))`` per
+    session per frame — a 250k-session hot key re-encoded the same JSON
+    250k times. An :class:`EncodedFrame` is minted once per (key,
+    version) and every downstream pump writes the same immutable
+    ``bytes``; only the per-session envelope (the SSE ``id:`` line — the
+    resume token) stays per-session, written as a cheap prefix around the
+    shared body.
+
+    ``body`` is the canonical JSON object bytes (compact separators — the
+    wire shape of :func:`frame_to_dict`). ``sse`` is the shared SSE tail
+    (``event: update\\ndata: <body>\\n\\n``); a transport prepends its
+    session's ``id: <token>\\n`` line. ``text`` is the lazily-decoded str
+    for WebSocket text frames (decoded at most once per encoded frame,
+    and only when a WS session exists).
+
+    ``lossy`` latches when the payload was not JSON-serializable and fell
+    back to ``repr`` — detected HERE, at encode time, once per frame
+    (counted by the node as ``fusion_edge_frames_lossy_total``) instead
+    of silently repr-ing per session inside the old per-delivery dumps.
+
+    Immutability contract: the bytes are built from the payload at encode
+    time — a caller that mutates the payload dict afterwards changes
+    nothing a session will see (regression-tested).
+    """
+
+    __slots__ = (
+        "key", "version", "body", "sse", "lossy", "has_t0",
+        "replay_variant", "_text",
+    )
+
+    def __init__(self, frame: Frame):
+        self.key = frame[0]
+        self.version = frame[1]
+        #: whether the body carries the fence origin timestamp. Replays
+        #: (attach/resume/reconnect) ship WITHOUT it — now-minus-then is a
+        #: reconnect gap, not delivery latency — so a replay asks for the
+        #: t0-stripped twin, cached as :attr:`replay_variant` on the
+        #: canonical entry (still one encode per variant, ever).
+        self.has_t0 = frame[4] is not None
+        self.replay_variant: Optional["EncodedFrame"] = None
+        payload = frame_to_dict(frame)
+        try:
+            body = json.dumps(payload, separators=(",", ":")).encode()
+            self.lossy = False
+        except (TypeError, ValueError):
+            body = json.dumps(
+                payload, separators=(",", ":"), default=repr
+            ).encode()
+            self.lossy = True
+        self.body = body
+        self.sse = b"event: update\ndata: " + body + b"\n\n"
+        self._text: Optional[str] = None
+
+    @property
+    def text(self) -> str:
+        """The body as str (WS text frames) — decoded at most once."""
+        if self._text is None:
+            self._text = self.body.decode()
+        return self._text
+
+    def sse_event(self, id_prefix: bytes) -> bytes:
+        """The full per-session SSE event: the session's ``id:`` prefix
+        (its resume token envelope) + the SHARED tail bytes."""
+        return id_prefix + self.sse
 
 
 class LatestWinsMailbox:
@@ -291,6 +362,7 @@ class EdgeSession:
         "evicted",
         "delivered",
         "on_evicted",
+        "shard",
     )
 
     def __init__(
@@ -310,6 +382,9 @@ class EdgeSession:
         self.mailbox = mailbox
         self.evicted = False
         self.delivered = 0
+        #: fan-shard index (assigned by EdgeNode at attach/resume): which
+        #: of the node's parallel fan workers delivers to this session
+        self.shard = 0
         #: transport shutdown hook the owning connection handler installs:
         #: EdgeNode.evict() calls it after parking, so an eviction that did
         #: NOT originate in the transport pump (mailbox overflow, broken

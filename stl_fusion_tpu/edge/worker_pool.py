@@ -1,0 +1,919 @@
+"""Multi-process edge delivery plane (ISSUE 10c).
+
+The PR 8 edge tier measured a pure-Python ceiling: one process fans
+~292k session-deliveries/s no matter how cheap the per-delivery work
+gets, because one interpreter walks every session. This module moves the
+DELIVERY half of the edge onto N OS worker processes while the parent
+:class:`~.gateway.EdgeNode` keeps the UPSTREAM half — the single
+subscription per distinct key, the shard-map affinity, the resume/park
+state. The split rides the serialize-once contract end to end:
+
+- the parent encodes each fenced frame ONCE (``EdgeNode.encode_frame``)
+  and pushes the immutable body bytes over a per-worker socketpair —
+  one ``F`` message per (worker, key, version), never per session;
+- each worker owns its sockets and ONLY writes bytes: the per-session
+  work is assembling ``id: <token>\\n`` + the shared SSE tail and
+  pushing it down the connection — no JSON, no Python object graph, no
+  upstream state;
+- deliveries/s therefore scales with worker processes (measured in
+  perf/edge_path.py; the bench records ``deliveries_per_s_per_worker``).
+
+**Socket ownership: SO_REUSEPORT, not send_fds.** Each worker binds the
+SAME (host, port) with ``SO_REUSEPORT`` and the kernel load-balances
+accepted connections across workers — no accept loop in the parent, no
+fd passing, workers are symmetric and independently restartable. The
+tradeoff vs a parent accept loop + ``socket.send_fds`` handoff: the
+kernel's balance is per-connection-hash (no app-level placement), and a
+RECONNECT may land on a different worker, so resume tokens are
+worker-local — a resume that misses falls back to a fresh attach (the
+protocol already defines that fallback). ``send_fds`` would preserve
+parent-controlled placement at the cost of a single-process accept
+bottleneck and a parent that must outlive every handoff. EDGE.md
+documents the choice.
+
+Wire protocol (parent <-> worker, framed ``!BI`` type+length):
+
+    parent -> worker                     worker -> parent
+    K {id, key}        register key
+    S {sessions}       add sim sessions
+    F key_id ver t0 body  one encoded frame
+    L {host, port}     start SSE listener  P {port}   actual bound port
+    Q {seq}            stats request       R {...}    stats reply
+    X                  shutdown            U {conn, keys}  SSE subscribe
+                                           D {conn, key_ids} SSE closed
+
+Workers are spawned as ``python <this file> --worker`` subprocesses so
+they import NOTHING beyond the standard library — no jax, no package
+``__init__`` — and are serving in tens of milliseconds.
+
+Simulated sessions (``S``) are the 1M-subscriber benchmark's population:
+a worker-held list of per-session envelope prefixes per key; a frame
+"delivery" assembles the exact bytes a socket write would take (prefix +
+shared tail) and accounts for it, without a million real TCP peers. The
+REAL path (``L`` + SSE over SO_REUSEPORT) serves actual browsers with
+the same code path and is what the CI smoke drives.
+"""
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import os
+import socket
+import struct
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+log = logging.getLogger("stl_fusion_tpu")
+
+__all__ = ["EdgeWorkerPool"]
+
+_HEADER = struct.Struct("!BI")
+_FRAME = struct.Struct("!IId")  # key_id, version, t0 (-1.0 = none)
+
+# log-scale histogram buckets — MUST mirror diagnostics.metrics.Histogram
+# (lo * 2^k up to hi, + overflow) so the parent can merge worker counts
+# into fusion_edge_delivery_ms bucket-for-bucket
+_HIST_LO, _HIST_HI = 0.001, 120_000.0
+
+
+def _hist_edges() -> List[float]:
+    edges, edge = [], _HIST_LO
+    while edge <= _HIST_HI:
+        edges.append(edge)
+        edge *= 2.0
+    return edges
+
+
+def _bisect_left(edges: List[float], v: float) -> int:
+    lo, hi = 0, len(edges)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if edges[mid] < v:
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo
+
+
+# ======================================================================
+# parent side
+# ======================================================================
+
+
+class _Worker:
+    """Parent-side handle to one delivery worker process."""
+
+    __slots__ = (
+        "index", "proc", "sock", "reader", "writer", "reader_task",
+        "interest", "sim_keys", "conn_refs", "stats_futures", "port_future",
+        "last_stats", "last_hist", "sim_sessions", "outbuf",
+    )
+
+    def __init__(self, index: int):
+        self.index = index
+        self.proc = None
+        self.sock: Optional[socket.socket] = None
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.reader_task: Optional[asyncio.Task] = None
+        #: key_ids this worker has sessions (sim or real) on — the frame
+        #: broadcast filter. Materialized from ``sim_keys`` (permanent for
+        #: the pool's life) ∪ keys with a live real-connection refcount —
+        #: pruned on disconnect so a key nobody watches stops costing a
+        #: pipe write per fence
+        self.interest: set = set()
+        self.sim_keys: set = set()
+        self.conn_refs: Dict[int, int] = {}
+        self.stats_futures: Dict[int, asyncio.Future] = {}
+        self.port_future: Optional[asyncio.Future] = None
+        self.last_stats: Optional[dict] = None
+        #: previous cumulative histogram buckets (delta-merge source)
+        self.last_hist: Optional[List[int]] = None
+        self.sim_sessions = 0
+        #: pending outbound messages — flushed as ONE write per event-loop
+        #: tick (a write per message would wake the worker per frame; the
+        #: wake-up preemption ping-pong measurably halves the parent's
+        #: upstream throughput during a burst)
+        self.outbuf: List[bytes] = []
+
+    def send(self, mtype: bytes, payload: bytes) -> None:
+        if self.writer is None or self.writer.is_closing():
+            return
+        self.outbuf.append(_HEADER.pack(mtype[0], len(payload)) + payload)
+
+    def send_json(self, mtype: bytes, obj: Any) -> None:
+        self.send(mtype, json.dumps(obj).encode())
+
+    def flush(self) -> None:
+        if not self.outbuf:
+            return
+        buf, self.outbuf = self.outbuf, []
+        if self.writer is None or self.writer.is_closing():
+            return
+        self.writer.write(b"".join(buf))
+
+
+class EdgeWorkerPool:
+    """N OS delivery processes behind one :class:`~.gateway.EdgeNode`.
+
+    ``await pool.start()`` spawns the workers and registers the pool as
+    the node's delivery-plane broadcast: every fanned frame's SHARED
+    encoded bytes go to each worker with sessions on that key, exactly
+    once per (worker, key, version).
+
+    - :meth:`add_sim_sessions` populates the benchmark population;
+    - :meth:`listen` starts the real SO_REUSEPORT SSE listeners;
+    - :meth:`stats` pulls per-worker counters and merges the workers'
+      delivery histograms into the process ``fusion_edge_delivery_ms``
+      (so the system's own histogram stays the single source of truth).
+    """
+
+    def __init__(self, node, workers: int = 2, stats_timeout: float = 10.0,
+                 flush_interval: float = 0.02):
+        if workers < 1:
+            raise ValueError("worker pool needs at least 1 worker")
+        self.node = node
+        self.n_workers = workers
+        self.stats_timeout = stats_timeout
+        #: frame-pipe flush window. Every write to a worker pipe WAKES the
+        #: worker process, and on a saturated box the sender-preemption
+        #: ping-pong (one wake per fanned frame per worker) measurably
+        #: halves the parent's upstream fence throughput — so frame posts
+        #: buffer up to this long and ship as one write per worker. The
+        #: added delivery latency (≤ the window) is noise against the
+        #: fence→visible distribution; control round-trips (stats, listen,
+        #: shutdown) flush immediately.
+        self.flush_interval = flush_interval
+        self._workers: List[_Worker] = []
+        self._key_ids: Dict[str, int] = {}
+        self._key_specs: Dict[str, tuple] = {}
+        #: upstream pins held for simulated sessions (released at stop)
+        self._sim_acquired: List[str] = []
+        #: (worker, conn) -> acquired key_strs for real SSE connections
+        self._conn_keys: Dict[tuple, List[str]] = {}
+        self._stats_seq = 0
+        self._started = False
+        self._flush_scheduled = False
+        self.listen_port: Optional[int] = None
+        #: cumulative deliveries last pulled from workers (sync-readable
+        #: by the node's metrics collector)
+        self.deliveries_seen = 0
+        self._hist_edges = _hist_edges()
+
+    # -------------------------------------------------------------- lifecycle
+    async def start(self) -> "EdgeWorkerPool":
+        if self._started:
+            return self
+        loop = asyncio.get_event_loop()
+        script = os.path.abspath(__file__)
+        for i in range(self.n_workers):
+            w = _Worker(i)
+            parent_sock, child_sock = socket.socketpair()
+            parent_sock.setblocking(False)
+            import subprocess
+
+            w.proc = subprocess.Popen(
+                [sys.executable, script, "--worker", str(i),
+                 str(child_sock.fileno())],
+                pass_fds=(child_sock.fileno(),),
+                close_fds=True,
+            )
+            child_sock.close()
+            w.sock = parent_sock
+            w.reader, w.writer = await asyncio.open_connection(sock=parent_sock)
+            w.reader_task = loop.create_task(self._read_worker(w))
+            self._workers.append(w)
+        self._started = True
+        self.node.worker_pool = self
+        self.node.attach_broadcast(self._on_frame)
+        return self
+
+    async def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        self.node.detach_broadcast(self._on_frame)
+        if self.node.worker_pool is self:
+            self.node.worker_pool = None
+        for w in self._workers:
+            try:
+                w.send(b"X", b"")
+                w.flush()
+                if w.writer is not None:
+                    await w.writer.drain()
+            except Exception:  # noqa: BLE001 — already-dead worker
+                pass
+        for w in self._workers:
+            if w.reader_task is not None:
+                w.reader_task.cancel()
+            if w.writer is not None:
+                try:
+                    w.writer.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            if w.proc is not None:
+                # reap off-loop: a blocking wait() here would freeze every
+                # other edge's watch loops and pumps for up to the timeout
+                try:
+                    await asyncio.get_event_loop().run_in_executor(
+                        None, w.proc.wait, 5.0
+                    )
+                except Exception:  # noqa: BLE001 — escalate
+                    try:
+                        w.proc.kill()
+                        await asyncio.get_event_loop().run_in_executor(
+                            None, w.proc.wait, 5.0
+                        )
+                    except Exception:  # noqa: BLE001 — a zombie must not
+                        # fail stop(); the OS reaps it with the parent
+                        log.exception(
+                            "edge worker %d did not exit after kill", w.index
+                        )
+        # release every key real connections + sim sessions still held
+        for (_wi, _conn), (key_strs, _kids) in list(self._conn_keys.items()):
+            self.node.release_keys(key_strs)
+        self._conn_keys.clear()
+        self.node.release_keys(self._sim_acquired)
+        self._sim_acquired.clear()
+        self._workers.clear()
+
+    # -------------------------------------------------------------- flushing
+    def _kick_flush(self) -> None:
+        """Coalesce up to ``flush_interval`` of outbound messages into ONE
+        write per worker (see the knob's comment: per-frame writes cost
+        the parent half its upstream throughput in wake-up preemption)."""
+        if self._flush_scheduled:
+            return
+        self._flush_scheduled = True
+        asyncio.get_event_loop().call_later(self.flush_interval, self._flush_all)
+
+    def _flush_all(self) -> None:
+        self._flush_scheduled = False
+        for w in self._workers:
+            w.flush()
+
+    # -------------------------------------------------------------- keys
+    def _key_id_for(self, key_str: str, spec: tuple) -> int:
+        kid = self._key_ids.get(key_str)
+        if kid is None:
+            kid = self._key_ids[key_str] = len(self._key_ids)
+            self._key_specs[key_str] = spec
+            for w in self._workers:
+                w.send_json(b"K", {"id": kid, "key": key_str})
+        return kid
+
+    # -------------------------------------------------------------- sim
+    async def add_sim_sessions(
+        self, worker: int, counts: Dict[Any, int], acquire: bool = True
+    ) -> int:
+        """Register simulated sessions on one worker: ``counts`` maps a
+        key spec ``(method, *args)`` to how many sessions subscribe it
+        there. With ``acquire`` the parent pins the upstream subs (the
+        node must keep watching these keys while the worker serves
+        them). Returns the number of (session, key) subscriptions
+        added."""
+        w = self._workers[worker]
+        specs = list(counts.keys())
+        if acquire:
+            key_strs = self.node.acquire_keys(specs)
+            self._sim_acquired.extend(key_strs)
+        else:
+            key_strs = [self.node.key_str(s) for s in specs]
+        payload: Dict[str, int] = {}
+        total = 0
+        for spec, ks in zip(specs, key_strs):
+            kid = self._key_id_for(ks, tuple(spec))
+            n = int(counts[spec])
+            payload[str(kid)] = n
+            w.interest.add(kid)
+            w.sim_keys.add(kid)
+            total += n
+        w.sim_sessions += total
+        w.send_json(b"S", {"sessions": payload})
+        self._flush_all()
+        if w.writer is not None:
+            await w.writer.drain()
+        return total
+
+    # -------------------------------------------------------------- real SSE
+    async def listen(self, host: str = "127.0.0.1", port: int = 0,
+                     heartbeat_interval: float = 15.0) -> int:
+        """Start the SO_REUSEPORT SSE listener on every worker. With
+        ``port=0`` worker 0 binds an ephemeral port and the others join
+        it. Returns the bound port."""
+        loop = asyncio.get_event_loop()
+        first = self._workers[0]
+        first.port_future = loop.create_future()
+        first.send_json(b"L", {"host": host, "port": port,
+                               "heartbeat": heartbeat_interval})
+        self._flush_all()
+        bound = await asyncio.wait_for(first.port_future, self.stats_timeout)
+        for w in self._workers[1:]:
+            w.port_future = loop.create_future()
+            w.send_json(b"L", {"host": host, "port": bound,
+                               "heartbeat": heartbeat_interval})
+            self._flush_all()
+            await asyncio.wait_for(w.port_future, self.stats_timeout)
+        self.listen_port = bound
+        return bound
+
+    # -------------------------------------------------------------- frames
+    def _on_frame(self, key_str: str, frame, encoded) -> None:
+        """EdgeNode broadcast hook: ship the SHARED encoded body to every
+        worker with sessions on this key — the message bytes are built
+        once and written to W pipes, never per session."""
+        kid = self._key_ids.get(key_str)
+        if kid is None:
+            return
+        t0 = frame[4] if frame[4] is not None else -1.0
+        payload = _FRAME.pack(kid, frame[1], t0) + encoded.body
+        msg = _HEADER.pack(ord("F"), len(payload)) + payload
+        for w in self._workers:
+            if kid in w.interest:
+                w.outbuf.append(msg)
+        self._kick_flush()
+
+    # -------------------------------------------------------------- stats
+    async def stats(self) -> List[dict]:
+        """Pull per-worker stats; merges the workers' delivery-histogram
+        DELTAS into the process ``fusion_edge_delivery_ms`` histogram and
+        refreshes :attr:`deliveries_seen` + each worker's
+        ``last_stats`` (what ``/edge/stats`` embeds)."""
+        loop = asyncio.get_event_loop()
+        self._stats_seq += 1
+        seq = self._stats_seq
+        futures = []
+        for w in self._workers:
+            fut = loop.create_future()
+            w.stats_futures[seq] = fut
+            w.send_json(b"Q", {"seq": seq})
+            futures.append(fut)
+        self._flush_all()
+        replies = await asyncio.wait_for(
+            asyncio.gather(*futures), self.stats_timeout
+        )
+        from ..diagnostics.metrics import global_metrics
+
+        hist = global_metrics().histogram(
+            "fusion_edge_delivery_ms",
+            help="server fence (wave apply) -> edge session client-visible",
+        )
+        total = 0
+        for w, stats in zip(self._workers, replies):
+            w.last_stats = stats
+            total += int(stats.get("deliveries", 0))
+            buckets = stats.get("hist") or []
+            prev = w.last_hist or [0] * len(buckets)
+            for i, count in enumerate(buckets):
+                delta = count - (prev[i] if i < len(prev) else 0)
+                if delta <= 0:
+                    continue
+                # the bucket's upper edge re-buckets to the same slot in
+                # the registry histogram (mirrored edges)
+                if i < len(self._hist_edges):
+                    hist.record_many(self._hist_edges[i], delta)
+                else:
+                    hist.record_many(self._hist_edges[-1] * 2.0, delta)
+            w.last_hist = list(buckets)
+        self.deliveries_seen = total
+        return replies
+
+    def snapshot(self) -> dict:
+        """Sync view for ``EdgeNode.snapshot()`` — the last pulled
+        per-worker stats (call :meth:`stats` to refresh)."""
+        return {
+            "workers": self.n_workers,
+            "listen_port": self.listen_port,
+            "deliveries": self.deliveries_seen,
+            "per_worker": [w.last_stats for w in self._workers],
+        }
+
+    # -------------------------------------------------------------- inbound
+    async def _read_worker(self, w: _Worker) -> None:
+        try:
+            while True:
+                head = await w.reader.readexactly(_HEADER.size)
+                mtype, length = _HEADER.unpack(head)
+                payload = await w.reader.readexactly(length) if length else b""
+                ch = chr(mtype)
+                if ch == "R":
+                    stats = json.loads(payload)
+                    fut = w.stats_futures.pop(stats.get("seq", 0), None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(stats)
+                elif ch == "P":
+                    info = json.loads(payload)
+                    if w.port_future is not None and not w.port_future.done():
+                        if "error" in info:
+                            w.port_future.set_exception(
+                                RuntimeError(info["error"])
+                            )
+                        else:
+                            w.port_future.set_result(info["port"])
+                elif ch == "U":
+                    self._handle_subscribe(w, json.loads(payload))
+                elif ch == "D":
+                    self._handle_disconnect(w, json.loads(payload))
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass  # worker exited
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — reader must not die silently
+            log.exception("edge worker %d reader failed", w.index)
+
+    def _handle_subscribe(self, w: _Worker, req: dict) -> None:
+        """A worker's real SSE connection asked for keys: acquire the
+        upstream subs, assign key ids, ack with the ids + the current
+        cached frames (the attach replay, base64 over the control
+        channel)."""
+        conn = req.get("conn")
+        try:
+            specs = [tuple(k) for k in req.get("keys", [])]
+            if not specs:
+                raise ValueError("no keys")
+            if len(specs) > self.node.max_keys_per_session:
+                raise ValueError(
+                    f"session asks for {len(specs)} keys; this edge caps "
+                    f"at {self.node.max_keys_per_session} per session"
+                )
+            key_strs = self.node.acquire_keys(specs)
+        except Exception as e:  # noqa: BLE001 — the CLIENT's bad input
+            w.send_json(b"A", {"conn": conn, "error": str(e)})
+            self._kick_flush()
+            return
+        keys_out = []
+        replays = []
+        kids = []
+        for spec, ks in zip(specs, key_strs):
+            kid = self._key_id_for(ks, spec)
+            w.interest.add(kid)
+            w.conn_refs[kid] = w.conn_refs.get(kid, 0) + 1
+            kids.append(kid)
+            keys_out.append({"id": kid, "key": ks})
+            sub = self.node._subs.get(ks)
+            if sub is not None and sub.last_frame is not None:
+                # replayed frames ship WITHOUT the stale origin_ts — same
+                # contract as EdgeNode._deliver_contained (the encode
+                # cache keeps the stripped twin beside the canonical)
+                lf = sub.last_frame
+                if lf[4] is not None:
+                    lf = (lf[0], lf[1], lf[2], lf[3], None, lf[5])
+                encoded = self.node.encode_frame(lf)
+                replays.append({
+                    "id": kid,
+                    "ver": encoded.version,
+                    "body": base64.b64encode(encoded.body).decode(),
+                })
+        self._conn_keys[(w.index, conn)] = (key_strs, kids)
+        w.send_json(b"A", {"conn": conn, "keys": keys_out, "replay": replays})
+        self._kick_flush()
+
+    def _handle_disconnect(self, w: _Worker, req: dict) -> None:
+        entry = self._conn_keys.pop((w.index, req.get("conn")), None)
+        if entry is None:
+            return
+        key_strs, kids = entry
+        self.node.release_keys(key_strs)
+        for kid in kids:
+            left = w.conn_refs.get(kid, 0) - 1
+            if left > 0:
+                w.conn_refs[kid] = left
+            else:
+                # last real connection for this key on this worker: stop
+                # shipping its frames there (sim populations keep theirs)
+                w.conn_refs.pop(kid, None)
+                if kid not in w.sim_keys:
+                    w.interest.discard(kid)
+
+
+# ======================================================================
+# worker side (stdlib only — this file runs as a standalone script)
+# ======================================================================
+
+
+class _WorkerHist:
+    """The worker's delivery histogram: same log-scale buckets as the
+    parent registry's Histogram so counts merge bucket-for-bucket."""
+
+    def __init__(self):
+        self.edges = _hist_edges()
+        self.buckets = [0] * (len(self.edges) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+
+    def record_many(self, value: float, n: int) -> None:
+        if n <= 0:
+            return
+        v = max(0.0, float(value))
+        self.buckets[_bisect_left(self.edges, v)] += n
+        self.count += n
+        self.sum += v * n
+        if v > self.max:
+            self.max = v
+
+
+class _WorkerMain:
+    """One delivery worker: control-channel loop + local session tables +
+    (optionally) the SO_REUSEPORT SSE listener."""
+
+    def __init__(self, index: int, fd: int):
+        self.index = index
+        sock = socket.socket(fileno=fd)
+        sock.setblocking(False)
+        self.sock = sock
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.keys: Dict[int, str] = {}
+        #: key_id -> list of per-session SSE id-prefix bytes (the sim
+        #: population: everything per-session the delivery pays for)
+        self.sim: Dict[int, List[bytes]] = {}
+        #: key_id -> set of live real connections
+        self.conns_by_key: Dict[int, set] = {}
+        self.conn_seq = 0
+        self.pending_subscribes: Dict[int, asyncio.Future] = {}
+        #: conn_id -> not-yet-open _SseConn: registered into conns_by_key
+        #: by the CONTROL LOOP the moment the subscribe ack arrives, so a
+        #: frame in the same pipe batch as the ack lands in the conn's
+        #: backlog instead of being dropped before the handler resumes
+        self.pending_conns: Dict[int, "_SseConn"] = {}
+        self.deliveries = 0
+        self.delivery_bytes = 0
+        self.busy_ms = 0.0
+        self.frames = 0
+        self.evictions = 0
+        self.connections = 0
+        self.hist = _WorkerHist()
+        self.heartbeat_interval = 15.0
+        self.server: Optional[asyncio.AbstractServer] = None
+        self._sim_minted = 0
+        #: write-buffer bound per real connection: a peer that stops
+        #: reading past this is evicted (aborted), never blocks siblings
+        self.max_buffer = 1 << 20
+
+    # ---------------------------------------------------------- control
+    def send(self, mtype: str, payload: bytes) -> None:
+        self.writer.write(_HEADER.pack(ord(mtype), len(payload)) + payload)
+
+    def send_json(self, mtype: str, obj: Any) -> None:
+        self.send(mtype, json.dumps(obj).encode())
+
+    async def run(self) -> None:
+        self.reader, self.writer = await asyncio.open_connection(sock=self.sock)
+        try:
+            while True:
+                head = await self.reader.readexactly(_HEADER.size)
+                mtype, length = _HEADER.unpack(head)
+                payload = (
+                    await self.reader.readexactly(length) if length else b""
+                )
+                ch = chr(mtype)
+                if ch == "F":
+                    self.on_frame(payload)
+                elif ch == "K":
+                    info = json.loads(payload)
+                    self.keys[int(info["id"])] = info["key"]
+                elif ch == "S":
+                    self.on_sim(json.loads(payload))
+                elif ch == "A":
+                    self.on_subscribe_ack(json.loads(payload))
+                elif ch == "L":
+                    await self.on_listen(json.loads(payload))
+                elif ch == "Q":
+                    self.on_stats(json.loads(payload))
+                elif ch == "X":
+                    break
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass  # parent died: exit
+        finally:
+            if self.server is not None:
+                self.server.close()
+
+    # ---------------------------------------------------------- sim
+    def on_sim(self, req: dict) -> None:
+        for kid_str, count in req.get("sessions", {}).items():
+            kid = int(kid_str)
+            lst = self.sim.setdefault(kid, [])
+            for _ in range(int(count)):
+                self._sim_minted += 1
+                lst.append(
+                    f"id: es-w{self.index}-{self._sim_minted}\n".encode()
+                )
+
+    # ---------------------------------------------------------- frames
+    def on_frame(self, payload: bytes) -> None:
+        kid, version, t0 = _FRAME.unpack_from(payload)
+        body = payload[_FRAME.size:]
+        # the shared tail is assembled ONCE per (worker, frame); each
+        # session pays only its envelope prefix + the concat/write
+        tail = b"event: update\ndata: " + body + b"\n\n"
+        t_start = time.perf_counter()
+        n = 0
+        nbytes = 0
+        prefixes = self.sim.get(kid)
+        if prefixes:
+            for prefix in prefixes:
+                chunk = prefix + tail  # the per-session delivery assembly
+                nbytes += len(chunk)
+            n += len(prefixes)
+        conns = self.conns_by_key.get(kid)
+        if conns:
+            dead = None
+            for conn in conns:
+                if conn.deliver(kid, version, tail):
+                    n += 1
+                    nbytes += len(conn.prefix) + len(tail)
+                else:
+                    dead = dead or []
+                    dead.append(conn)
+            for conn in dead or ():
+                conn.abort()
+                self.evictions += 1
+        now = time.perf_counter()
+        self.deliveries += n
+        self.delivery_bytes += nbytes
+        self.frames += 1
+        self.busy_ms += (now - t_start) * 1e3
+        if t0 >= 0.0 and n:
+            # perf_counter is CLOCK_MONOTONIC — one timeline across the
+            # processes of one host, so fence -> worker-visible is real
+            self.hist.record_many((now - t0) * 1e3, n)
+
+    # ---------------------------------------------------------- stats
+    def on_stats(self, req: dict) -> None:
+        rss = 0.0
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        rss = int(line.split()[1]) / 1024.0
+                        break
+        except OSError:
+            pass
+        self.send_json("R", {
+            "seq": req.get("seq", 0),
+            "worker": self.index,
+            "pid": os.getpid(),
+            "deliveries": self.deliveries,
+            "delivery_bytes": self.delivery_bytes,
+            "frames": self.frames,
+            "busy_ms": round(self.busy_ms, 3),
+            "rss_mb": round(rss, 1),
+            "sim_sessions": sum(len(v) for v in self.sim.values()),
+            "connections": self.connections,
+            "evictions": self.evictions,
+            "hist": self.hist.buckets,
+            "hist_count": self.hist.count,
+            "hist_sum": round(self.hist.sum, 3),
+            "hist_max": round(self.hist.max, 3),
+        })
+
+    # ---------------------------------------------------------- real SSE
+    async def on_listen(self, req: dict) -> None:
+        try:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            sock.bind((req.get("host", "127.0.0.1"), int(req.get("port", 0))))
+            sock.listen(128)
+            self.heartbeat_interval = float(req.get("heartbeat", 15.0))
+            self.server = await asyncio.start_server(self._handle_conn, sock=sock)
+            self.send_json("P", {"port": sock.getsockname()[1]})
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            self.send_json("P", {"error": f"{type(e).__name__}: {e}"})
+
+    def on_subscribe_ack(self, ack: dict) -> None:
+        conn_id = ack.get("conn")
+        fut = self.pending_subscribes.pop(conn_id, None)
+        if "error" not in ack:
+            # register in the CONTROL LOOP, synchronously: any frame the
+            # parent fanned right after the ack (possibly in the same
+            # coalesced pipe write) must find the conn and backlog, not
+            # vanish before the handler task resumes
+            conn = self.pending_conns.get(conn_id)
+            if conn is not None:
+                conn.key_ids = [k["id"] for k in ack.get("keys", [])]
+                for kid in conn.key_ids:
+                    self.conns_by_key.setdefault(kid, set()).add(conn)
+        if fut is not None and not fut.done():
+            fut.set_result(ack)
+
+    async def _handle_conn(self, reader, writer) -> None:
+        conn_id = self.conn_seq = self.conn_seq + 1
+        self.connections += 1
+        conn = None
+        sent_u = False
+        try:
+            request = await asyncio.wait_for(
+                reader.readuntil(b"\r\n\r\n"), 30.0
+            )
+            line = request.split(b"\r\n", 1)[0].decode("latin-1")
+            parts = line.split(" ")
+            if len(parts) < 2 or parts[0] != "GET":
+                writer.write(b"HTTP/1.1 405 Method Not Allowed\r\n\r\n")
+                return
+            target = parts[1]
+            path, _, query = target.partition("?")
+            if path != "/edge/sse":
+                writer.write(b"HTTP/1.1 404 Not Found\r\n\r\n")
+                return
+            keys_raw = ""
+            for pair in query.split("&"):
+                k, _, v = pair.partition("=")
+                if k == "keys":
+                    from urllib.parse import unquote
+
+                    keys_raw = unquote(v)
+            try:
+                specs = json.loads(keys_raw) if keys_raw else []
+                assert isinstance(specs, list) and specs
+            except Exception:  # noqa: BLE001
+                writer.write(
+                    b"HTTP/1.1 400 Bad Request\r\n\r\n"
+                )
+                return
+            token = f"es-w{self.index}-c{conn_id}"
+            conn = _SseConn(self, conn_id, token, [], writer)
+            self.pending_conns[conn_id] = conn
+            fut = asyncio.get_event_loop().create_future()
+            self.pending_subscribes[conn_id] = fut
+            self.send_json("U", {"conn": conn_id, "keys": specs})
+            sent_u = True
+            ack = await asyncio.wait_for(fut, 30.0)
+            if "error" in ack:
+                body = json.dumps({"error": ack["error"]}).encode()
+                writer.write(
+                    b"HTTP/1.1 400 Bad Request\r\nContent-Type: "
+                    b"application/json\r\nContent-Length: "
+                    + str(len(body)).encode() + b"\r\n\r\n" + body
+                )
+                return
+            writer.write(
+                b"HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
+                b"Cache-Control: no-cache\r\nConnection: close\r\n\r\n"
+            )
+            hello = json.dumps(
+                {"token": token, "keys": [k["key"] for k in ack["keys"]],
+                 "worker": self.index}
+            )
+            writer.write(
+                f"id: {token}\nevent: hello\ndata: {hello}\n\n".encode()
+            )
+            replayed: Dict[int, int] = {}
+            for rep in ack.get("replay", []):
+                tail = (b"event: update\ndata: "
+                        + base64.b64decode(rep["body"]) + b"\n\n")
+                conn.write_frame(tail)
+                replayed[rep["id"]] = rep.get("ver", 0)
+                self.deliveries += 1
+            # open the stream: ship backlogged frames that raced in
+            # between the ack and now, skipping versions the replay
+            # already covered (the control loop registered the conn at
+            # ack time so nothing was dropped)
+            conn.open_stream(replayed)
+            hb = asyncio.get_event_loop().create_task(self._heartbeat(conn))
+            try:
+                while await reader.read(4096):
+                    pass  # inbound ignored; the stream is one-way
+            finally:
+                hb.cancel()
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                ConnectionResetError, asyncio.LimitOverrunError):
+            pass
+        except Exception:  # noqa: BLE001 — one bad conn never kills the worker
+            pass
+        finally:
+            self.connections -= 1
+            self.pending_conns.pop(conn_id, None)
+            self.pending_subscribes.pop(conn_id, None)
+            if conn is not None:
+                for kid in conn.key_ids:
+                    peers = self.conns_by_key.get(kid)
+                    if peers is not None:
+                        peers.discard(conn)
+                        if not peers:
+                            self.conns_by_key.pop(kid, None)
+            if sent_u:
+                # ALWAYS pair the U with a D once sent — even on an ack
+                # timeout where the parent may have acquired the pins
+                # after we stopped waiting (an unpaired U leaks the
+                # upstream pins until pool.stop())
+                self.send_json(
+                    "D",
+                    {"conn": conn_id,
+                     "key_ids": conn.key_ids if conn is not None else []},
+                )
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _heartbeat(self, conn: "_SseConn") -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.heartbeat_interval)
+                conn.writer.write(b": hb\n\n")
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+
+
+class _SseConn:
+    __slots__ = ("worker", "conn_id", "prefix", "key_ids", "writer",
+                 "open", "backlog")
+
+    def __init__(self, worker, conn_id, token, key_ids, writer):
+        self.worker = worker
+        self.conn_id = conn_id
+        self.prefix = f"id: {token}\n".encode()
+        self.key_ids = key_ids
+        self.writer = writer
+        #: False until the handler wrote headers + hello + replay: frames
+        #: arriving meanwhile (registered by the control loop at ack
+        #: time) buffer in ``backlog`` instead of corrupting the HTTP
+        #: preamble or being dropped
+        self.open = False
+        self.backlog: List[tuple] = []
+
+    def deliver(self, kid: int, version: int, tail: bytes) -> bool:
+        if not self.open:
+            self.backlog.append((kid, version, tail))
+            return True
+        return self.write_frame(tail)
+
+    def open_stream(self, replayed: Dict[int, int]) -> None:
+        backlog, self.backlog = self.backlog, []
+        self.open = True
+        for kid, version, tail in backlog:
+            if version > replayed.get(kid, 0):
+                self.write_frame(tail)
+
+    def write_frame(self, tail: bytes) -> bool:
+        """Write one shared-tail frame with this conn's envelope; False
+        when the peer stopped draining (evict)."""
+        transport = self.writer.transport
+        if transport is None or transport.is_closing():
+            return False
+        if transport.get_write_buffer_size() > self.worker.max_buffer:
+            return False  # slow consumer: the caller aborts us
+        self.writer.write(self.prefix + tail)
+        return True
+
+    def abort(self) -> None:
+        transport = self.writer.transport
+        if transport is not None:
+            transport.abort()
+
+
+def _worker_entry(argv: List[str]) -> None:
+    index = int(argv[0])
+    fd = int(argv[1])
+    asyncio.run(_WorkerMain(index, fd).run())
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--worker":
+        _worker_entry(sys.argv[2:])
+    else:
+        sys.exit("usage: worker_pool.py --worker <index> <fd>")

@@ -61,7 +61,7 @@ def fresh_hub():
     set_default_hub(old)
 
 
-def make_stack(wire_codec=True):
+def make_stack(wire_codec=True, fan_workers=1):
     server_fusion = FusionHub()
     server_rpc = RpcHub("server")
     install_compute_call_type(server_rpc)
@@ -70,7 +70,7 @@ def make_stack(wire_codec=True):
     edge_rpc = RpcHub("edge")
     install_compute_call_type(edge_rpc)
     transport = RpcTestTransport(edge_rpc, server_rpc, wire_codec=wire_codec)
-    node = EdgeNode("counters", edge_rpc, resume_ttl=30.0)
+    node = EdgeNode("counters", edge_rpc, resume_ttl=30.0, fan_workers=fan_workers)
     return svc, node, transport, edge_rpc, server_rpc
 
 
@@ -814,4 +814,192 @@ async def test_sse_rejects_bad_requests():
         assert "404" in status
     finally:
         await http.stop()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+# ------------------------------------------- serialize-once encode cache
+
+
+async def test_encode_cache_hit_miss_and_fan_eagerness():
+    """ISSUE 10a: the fan path encodes each (key, version) exactly once —
+    transports asking afterwards HIT the cache (no second dumps); a new
+    fence (new version) is a miss that replaces the cached entry."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    try:
+        frames: list = []
+        node.attach([("get", "a")], sink=frames.append)
+        await until(lambda: len(frames) >= 1)
+        assert node.frames_encoded == 1  # the initial fan encoded eagerly
+        key_str = node.key_str(("get", "a"))
+        sub = node._subs[key_str]
+        ef = node.encode_frame(sub.last_frame)
+        ef2 = node.encode_frame(sub.last_frame)
+        assert ef is ef2 and node.frames_encoded == 1  # cache hits
+        assert json.loads(ef.body)["ver"] == 1
+
+        await svc.increment("a")
+        await until(lambda: sub.version >= 2)
+        await until(lambda: len(frames) >= 2)
+        assert node.frames_encoded == 2  # one more fence, one more encode
+        newer = node.encode_frame(sub.last_frame)
+        assert newer is not ef and newer.version == 2
+        assert json.loads(newer.body)["value"] == 1
+        # an OLDER frame raced in by a slow pump re-encodes but never
+        # clobbers the newer cached entry
+        old_frame = (key_str, 1, 0, None, None, None)
+        older = node.encode_frame(old_frame)
+        assert older.version == 1
+        assert node.encode_frame(sub.last_frame) is newer
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_encode_cache_entry_drops_with_sub_teardown():
+    """The cache is bounded by live distinct keys: when the last session
+    detaches un-parked (and with the parked sweep having released any
+    parked refs), the sub tears down and its cached bytes drop."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    node.resume_ttl = 0.2
+    try:
+        frames: list = []
+        session = node.attach([("get", "a")], sink=frames.append)
+        await until(lambda: len(frames) >= 1)
+        key_str = node.key_str(("get", "a"))
+        assert key_str in node._encoded
+        node.detach(session, park=False)
+        assert key_str not in node._encoded and key_str not in node._subs
+
+        # parked variant: the entry lives while the parked ref pins the
+        # sub, and is released by the quiescent expiry sweep
+        frames2: list = []
+        session2 = node.attach([("get", "a")], sink=frames2.append)
+        await until(lambda: len(frames2) >= 1)
+        node.detach(session2, park=True)
+        assert key_str in node._encoded  # parked ref still pins the sub
+        await until(lambda: key_str not in node._subs, timeout=5.0)
+        assert key_str not in node._encoded
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_resume_replay_uses_cached_bytes_without_stale_t0():
+    """A resume replay serves the CACHED encoded frame — and ships the
+    t0-stripped twin (a reconnect gap must not ride the wire as delivery
+    latency), encoded at most once no matter how many sessions resume."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    http = await EdgeHttpServer(node).start()
+    try:
+        warm: list = []
+        node.attach([("get", "a")], sink=warm.append)
+        await until(lambda: len(warm) >= 1)
+        await svc.increment("a")  # a fenced frame WITH origin_ts
+        key_str = node.key_str(("get", "a"))
+        sub = node._subs[key_str]
+        await until(lambda: sub.version >= 2)
+        assert sub.last_frame[4] is not None
+        encodes_before = node.frames_encoded
+
+        async def attach_and_drop():
+            keys = urllib.parse.quote(json.dumps([["get", "a"]]))
+            reader, writer = await asyncio.open_connection(http.host, http.port)
+            writer.write(
+                f"GET /edge/sse?keys={keys} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+            )
+            await writer.drain()
+            await skip_headers(reader)
+            hello = await read_sse_event(reader)
+            replay = await read_sse_event(reader)
+            writer.close()
+            return json.loads(replay["data"])
+
+        seen = [await attach_and_drop() for _ in range(3)]
+        # every replay is the cached v2 body, WITHOUT the fence timestamp
+        assert all(d["ver"] == 2 and "t0" not in d for d in seen), seen
+        # one t0-stripped twin encode, total — not one per session
+        assert node.frames_encoded == encodes_before + 1
+    finally:
+        await http.stop()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_encoded_bytes_immune_to_payload_mutation():
+    """Regression (ISSUE 10a): the shared bytes are built at encode time —
+    a service that mutates the returned dict AFTER the fan must not leak
+    the mutation into later deliveries of the same version."""
+    from stl_fusion_tpu.edge import EncodedFrame
+
+    payload = {"rows": [1, 2, 3]}
+    frame = ("svc.q('a',)", 7, payload, None, None, None)
+    encoded = EncodedFrame(frame)
+    before = bytes(encoded.body)
+    payload["rows"].append(999)  # mutate after encode
+    payload["hacked"] = True
+    assert encoded.body == before
+    assert b"999" not in encoded.body and b"hacked" not in encoded.body
+    assert not encoded.lossy
+    # lossy detection happens ONCE, at encode time, and is flagged
+    lossy = EncodedFrame(("k", 1, object(), None, None, None))
+    assert lossy.lossy and b"object object" in lossy.body
+
+
+async def test_lossy_frames_counted_once_per_encode():
+    """A non-JSON payload falls back to repr at ENCODE time and bumps
+    fusion_edge_frames_lossy_total once per frame — never per session
+    (the old transports repr-ed per delivery via ``default=repr`` and
+    counted nothing)."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    try:
+        # an in-process fan of a JSON-hostile value (the rpc wire codec
+        # rejects unregistered types upstream, so exercise the encode
+        # surface the transports actually share)
+        frame = ("counters.get('x',)", 1, object(), None, None, None)
+        encoded = node.encode_frame(frame)
+        assert encoded.lossy and b"object object" in encoded.body
+        assert node.frames_lossy == 1 and node.frames_encoded == 1
+        # five sessions' pumps asking again all HIT the cache: still one
+        # lossy encode, not one per session
+        for _ in range(5):
+            assert node.encode_frame(frame) is encoded
+        assert node.frames_lossy == 1 and node.frames_encoded == 1
+        text = global_metrics().render_prometheus()
+        assert "fusion_edge_frames_lossy_total 1" in text
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+# --------------------------------------------------------- fan shards
+
+
+async def test_fan_shards_partition_and_deliver_all_sessions():
+    """ISSUE 10b: with W fan workers, sessions partition round-robin over
+    the shards and every session still sees every fence; the shard busy
+    counter moves; eviction containment still works per shard."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack(fan_workers=3)
+    try:
+        got = [[] for _ in range(9)]
+        for i in range(9):
+            node.attach([("get", "a")], sink=got[i].append)
+        key_str = node.key_str(("get", "a"))
+        sub = node._subs[key_str]
+        assert [len(b) for b in sub.shards] == [3, 3, 3]
+        await until(lambda: all(len(g) >= 1 for g in got))
+        await svc.increment("a")
+        await until(lambda: all(len(g) >= 2 for g in got))
+        assert all(g[-1][2] == 1 for g in got)
+        snap = node.snapshot()
+        assert snap["fan_workers"] == 3 and len(snap["fan_shards"]) == 3
+        assert sum(s["delivered"] for s in snap["fan_shards"]) >= 18
+
+        # a broken sink in one shard evicts ONLY that session
+        def bad_sink(frame):
+            raise RuntimeError("boom")
+
+        node.attach([("get", "a")], sink=bad_sink)
+        for g in got:
+            g.clear()
+        await svc.increment("a")
+        await until(lambda: all(len(g) >= 1 for g in got))
+        assert node.evictions == 1
+        assert sub.session_count == 9  # the broken one is gone
+    finally:
         await stop_all(node, edge_rpc, server_rpc)

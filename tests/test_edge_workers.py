@@ -1,0 +1,268 @@
+"""Multi-process edge delivery plane (ISSUE 10c): EdgeWorkerPool.
+
+Real OS worker subprocesses over socketpair control channels — the
+serialize-once broadcast, simulated-session accounting, the SO_REUSEPORT
+SSE listener, per-worker stats with histogram merge-back, and upstream
+key pinning (acquire/release) that preserves the single-upstream
+invariant.
+"""
+import asyncio
+import json
+import urllib.parse
+
+import pytest
+
+from stl_fusion_tpu.client import install_compute_call_type
+from stl_fusion_tpu.core import (
+    ComputeService,
+    FusionHub,
+    compute_method,
+    invalidating,
+    set_default_hub,
+)
+from stl_fusion_tpu.diagnostics import global_metrics
+from stl_fusion_tpu.edge import EdgeNode, EdgeWorkerPool
+from stl_fusion_tpu.rpc import RpcHub, RpcTestTransport
+
+
+class CounterService(ComputeService):
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self.counters = {}
+
+    @compute_method
+    async def get(self, key: str) -> int:
+        return self.counters.get(key, 0)
+
+    async def increment(self, key: str):
+        self.counters[key] = self.counters.get(key, 0) + 1
+        with invalidating():
+            await self.get(key)
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub():
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    yield hub
+    set_default_hub(old)
+
+
+def make_stack():
+    server_fusion = FusionHub()
+    server_rpc = RpcHub("server")
+    install_compute_call_type(server_rpc)
+    svc = CounterService(server_fusion)
+    server_rpc.add_service("counters", svc)
+    edge_rpc = RpcHub("edge")
+    install_compute_call_type(edge_rpc)
+    RpcTestTransport(edge_rpc, server_rpc, wire_codec=True)
+    node = EdgeNode("counters", edge_rpc, resume_ttl=30.0, fan_workers=2)
+    return svc, node, edge_rpc, server_rpc
+
+
+async def until(pred, timeout: float = 10.0):
+    async def wait():
+        while not pred():
+            await asyncio.sleep(0.01)
+
+    await asyncio.wait_for(wait(), timeout)
+
+
+async def until_async(pred, timeout: float = 10.0):
+    async def wait():
+        while not await pred():
+            await asyncio.sleep(0.02)
+
+    await asyncio.wait_for(wait(), timeout)
+
+
+async def stop_all(pool, node, *hubs):
+    if pool is not None:
+        await pool.stop()
+    await node.close()
+    for hub in hubs:
+        await hub.stop()
+
+
+async def test_sim_sessions_deliver_with_single_encode_per_frame():
+    """The benchmark population: sim sessions across 2 workers see every
+    fence; the parent encoded each fanned (key, version) ONCE (the
+    amortization invariant at test scale); per-worker stats report the
+    deliveries and the merged histogram lands in the process registry."""
+    svc, node, edge_rpc, server_rpc = make_stack()
+    pool = None
+    try:
+        pool = await EdgeWorkerPool(node, workers=2, flush_interval=0.005).start()
+        added = await pool.add_sim_sessions(0, {("get", "a"): 40, ("get", "b"): 10})
+        added += await pool.add_sim_sessions(1, {("get", "a"): 25})
+        assert added == 75
+        # the upstream subs exist without any parent session (pins)
+        assert len(node._subs) == 2
+        await until(lambda: all(s.version >= 1 for s in node._subs.values()))
+        hist = global_metrics().histogram(
+            "fusion_edge_delivery_ms",
+            help="server fence (wave apply) -> edge session client-visible",
+        )
+        cp = hist.checkpoint()
+        await svc.increment("a")
+        await svc.increment("b")
+
+        async def drained():
+            stats = await pool.stats()
+            # initial fans (75, no t0) + the two fences' re-fans (75)
+            return sum(s["deliveries"] for s in stats) >= 150
+
+        await until_async(drained)
+        stats = await pool.stats()
+        by_worker = [s["deliveries"] for s in stats]
+        assert by_worker == [100, 50]
+        # w0: a v1+v2, b v1+v2; w1: a v1+v2 — one frame per (worker,
+        # key, version), never per session
+        assert sum(s["frames"] for s in stats) == 6
+        assert all(s["evictions"] == 0 for s in stats)
+        # worker-measured fence→visible samples merged into the registry
+        # (initial fans carry no t0 and stay out of the histogram)
+        assert hist.since(cp)["count"] >= 75
+        # serialize-once: 2 keys × (initial + fence) = 4 encodes, 150
+        # deliveries — never an encode per session
+        assert node.frames_encoded == 4
+        snap = node.snapshot()
+        assert snap["worker_pool"]["workers"] == 2
+        assert snap["worker_pool"]["deliveries"] >= 75
+        assert snap["encode_ratio"] is not None and snap["encode_ratio"] > 10
+    finally:
+        await stop_all(pool, node, edge_rpc, server_rpc)
+
+
+async def test_release_keys_tears_down_pinned_subs():
+    """acquire/release bracket the upstream lifetime: releasing the last
+    pin (no sessions, no parked refs) tears the sub down and drops its
+    encoded-cache entry — the upstream count follows worker demand."""
+    svc, node, edge_rpc, server_rpc = make_stack()
+    pool = None
+    try:
+        pool = await EdgeWorkerPool(node, workers=1).start()
+        await pool.add_sim_sessions(0, {("get", "a"): 3})
+        assert len(node._subs) == 1
+        key_str = node.key_str(("get", "a"))
+        await until(lambda: node._subs[key_str].version >= 1)
+        assert key_str in node._encoded
+        node.release_keys([key_str])
+        assert key_str not in node._subs and key_str not in node._encoded
+    finally:
+        await stop_all(pool, node, edge_rpc, server_rpc)
+
+
+async def test_reuseport_sse_serves_hello_replay_and_live_update():
+    """The REAL path: a worker-owned SO_REUSEPORT SSE socket answers the
+    hello, replays the cached frame WITHOUT the stale fence t0, then
+    streams live updates; disconnect releases the parent's key pins."""
+    svc, node, edge_rpc, server_rpc = make_stack()
+    pool = None
+    try:
+        pool = await EdgeWorkerPool(node, workers=2, flush_interval=0.005).start()
+        # warm the key so the attach has a frame to replay (with t0)
+        await pool.add_sim_sessions(0, {("get", "a"): 1})
+        await until(lambda: len(node._subs) == 1)
+        sub = next(iter(node._subs.values()))
+        # the initial capture must land (upstream subscription live)
+        # before the fence, or the increment precedes the subscription
+        await until(lambda: sub.version >= 1)
+        await svc.increment("a")
+        await until(lambda: sub.version >= 2)
+        assert sub.last_frame[4] is not None
+
+        port = await pool.listen()
+        keys_q = urllib.parse.quote(json.dumps([["get", "a"]]))
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            f"GET /edge/sse?keys={keys_q} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        )
+        await writer.drain()
+        while True:
+            line = await asyncio.wait_for(reader.readline(), 10.0)
+            assert line, "SSE closed during headers"
+            if line in (b"\r\n", b"\n"):
+                break
+
+        async def read_event():
+            fields = {}
+            while True:
+                line = (await asyncio.wait_for(reader.readline(), 10.0)).decode()
+                assert line, "SSE stream closed early"
+                if line in ("\n", "\r\n"):
+                    if fields:
+                        return fields
+                    continue
+                if line.startswith(":"):
+                    continue
+                name, _, value = line.rstrip("\n").partition(":")
+                fields[name] = value.strip()
+
+        hello = await read_event()
+        assert hello["event"] == "hello"
+        hello_data = json.loads(hello["data"])
+        assert hello_data["token"].startswith("es-w")
+        replay = json.loads((await read_event())["data"])
+        assert replay["ver"] == 2 and replay["value"] == 1
+        assert "t0" not in replay  # reconnect gap never rides the wire
+        await svc.increment("a")
+        update = json.loads((await read_event())["data"])
+        assert update["ver"] == 3 and update["value"] == 2
+        assert "t0" in update  # live fences DO carry the origin stamp
+        writer.close()
+        # the disconnect releases the conn's pins; the sim pin remains
+        await until(lambda: next(iter(node._subs.values())).pins == 1)
+    finally:
+        await stop_all(pool, node, edge_rpc, server_rpc)
+
+
+async def test_reuseport_sse_rejects_bad_keys_via_parent_validation():
+    """Worker connections ride the SAME trust boundary as the in-parent
+    transports: the allowlist/underscore validation happens in the parent
+    (acquire_keys) and a rejection answers 400 from the worker."""
+    svc, node, edge_rpc, server_rpc = make_stack()
+    node.allowed_methods = frozenset(["get"])
+    pool = None
+    try:
+        pool = await EdgeWorkerPool(node, workers=1).start()
+        port = await pool.listen()
+
+        async def try_keys(spec_json):
+            q = urllib.parse.quote(spec_json)
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(
+                f"GET /edge/sse?keys={q} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+            )
+            await writer.drain()
+            status = (await asyncio.wait_for(reader.readline(), 10.0)).decode()
+            writer.close()
+            return status
+
+        assert "400" in await try_keys(json.dumps([["increment", "a"]]))
+        assert "400" in await try_keys(json.dumps([["_secret"]]))
+        assert "400" in await try_keys("not-json")
+        assert len(node._subs) == 0  # nothing leaked past validation
+    finally:
+        await stop_all(pool, node, edge_rpc, server_rpc)
+
+
+async def test_pool_stop_is_clean_and_releases_pins():
+    """stop() shuts workers down (processes exit), releases sim pins, and
+    detaches from the node — a second stop is a no-op."""
+    svc, node, edge_rpc, server_rpc = make_stack()
+    pool = await EdgeWorkerPool(node, workers=2).start()
+    try:
+        await pool.add_sim_sessions(0, {("get", "a"): 5})
+        assert node.worker_pool is pool and len(node._subs) == 1
+        procs = [w.proc for w in pool._workers]
+        await pool.stop()
+        assert node.worker_pool is None
+        assert all(p.poll() is not None for p in procs)  # all exited
+        assert len(node._subs) == 0  # sim pins released
+        await pool.stop()  # idempotent
+    finally:
+        await node.close()
+        await edge_rpc.stop()
+        await server_rpc.stop()

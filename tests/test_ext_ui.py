@@ -393,6 +393,7 @@ async def test_live_view_server_pushes_renders_per_connection():
     disconnect unmounts (a closed tab stops consuming invalidations)."""
     import json
 
+    pytest.importorskip("websockets")  # optional dep: skip, not fail
     from websockets.asyncio.client import connect
 
     from stl_fusion_tpu.state import MutableState
@@ -433,6 +434,7 @@ async def test_live_view_component_error_payload():
     """A failing compute pushes an error payload instead of dying silently."""
     import json
 
+    pytest.importorskip("websockets")  # optional dep: skip, not fail
     from websockets.asyncio.client import connect
 
     from stl_fusion_tpu.state import MutableState
@@ -848,6 +850,7 @@ async def test_live_view_stalled_reader_gets_newest_only(fresh_hub):
     the 1000 intermediates."""
     import json
 
+    pytest.importorskip("websockets")  # optional dep: skip, not fail
     from websockets.asyncio.client import connect
 
     from stl_fusion_tpu.state import MutableState
@@ -895,6 +898,7 @@ async def test_live_view_evicts_stalled_client(fresh_hub):
     frames into process memory even with max_queue=1/pause_reading, so it
     cannot model a dead tab; only an un-read socket makes the server's
     drain() actually block."""
+    pytest.importorskip("websockets")  # optional dep: skip, not fail
     import base64
     import os as _os
 
@@ -960,6 +964,7 @@ async def test_live_view_min_send_interval_rate_limits(fresh_hub):
     per interval — and it is the newest at send time."""
     import json
 
+    pytest.importorskip("websockets")  # optional dep: skip, not fail
     from websockets.asyncio.client import connect
 
     from stl_fusion_tpu.state import MutableState

@@ -3,6 +3,8 @@ in-proc stack over a real socket; RandomTimeSpan jitters; CI detection."""
 import asyncio
 import random
 
+import pytest
+
 from stl_fusion_tpu.core import ComputeService, capture, compute_method, invalidating
 from stl_fusion_tpu.testing import RandomTimeSpan, TestWebHost, is_build_agent
 
@@ -23,6 +25,7 @@ class CounterService(ComputeService):
 
 
 async def test_test_web_host_end_to_end():
+    pytest.importorskip("websockets")  # TestWebHost binds a real ws listener
     async with TestWebHost() as host:
         svc = host.add_service("counters", CounterService(host.fusion))
         client = await host.new_client("counters")
@@ -36,6 +39,7 @@ async def test_test_web_host_end_to_end():
 
 
 async def test_test_web_host_isolated_clients():
+    pytest.importorskip("websockets")  # TestWebHost binds a real ws listener
     async with TestWebHost() as host:
         svc = host.add_service("counters", CounterService(host.fusion))
         c1 = await host.new_client("counters")
@@ -51,6 +55,7 @@ async def test_test_web_host_isolated_clients():
 
 
 async def test_test_web_host_http_gateway():
+    pytest.importorskip("websockets")  # TestWebHost binds a real ws listener
     from stl_fusion_tpu.rpc.http_gateway import RestClient
 
     async with TestWebHost(use_http_gateway=True) as host:
