@@ -862,6 +862,13 @@ def _compact_result(
             "deliveries_per_s_per_worker": _r(
                 edge.get("deliveries_per_s_per_worker"), 0
             ),
+            # the ISSUE 11 upstream value plane: how the fence bursts were
+            # served — rpcs/burst == 0 with block_hit_ratio 1.0 means the
+            # publish-on-wave plane carried every re-read
+            "value_plane": edge.get("value_plane"),
+            "upstream_rpcs_per_burst": edge.get("upstream_rpcs_per_burst"),
+            "block_hit_ratio": edge.get("block_hit_ratio"),
+            "reread_batch_size": edge.get("reread_batch_size"),
         }
     if mesh is not None and "error" in mesh:
         out["mesh"] = {"error": mesh["error"]}

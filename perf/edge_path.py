@@ -53,11 +53,36 @@ ISSUE 10 additions — the serialize-once multi-process delivery plane:
 - **EDGE_FAN_WORKERS** sets the parent's fan-shard count (the in-parent
   session partitions drained concurrently).
 
+ISSUE 11 additions — the upstream value plane (what the fence→visible
+p99 now measures is the upstream re-read storm, so this is where it
+amortizes):
+
+- **EDGE_VALUE_PLANE** selects the upstream serving mode:
+  ``block`` (default) = publish-on-wave value blocks: the server
+  recomputes the burst's hot-set once, pushes ONE columnar
+  ``value_block`` frame per edge, and a block-warm burst costs ZERO
+  per-key upstream re-read RPCs (hard gate); ``batch`` = batched
+  multi-key re-read only (one ``recompute_batch`` frame per edge per
+  burst); ``perkey`` = the PR 10 per-key A/B shape.
+- **value-plane gates (hard asserts)**: per-key upstream re-read RPCs
+  ≤ keys on the first burst in batch/block modes, == 0 across the
+  MEASURED bursts; in block mode the measured bursts must also add
+  ZERO batch frames (the block was the fence AND the value) and every
+  fence must be a block hit.
+- reported: ``upstream_rpcs_per_burst``, ``block_hit_ratio``,
+  ``reread_batch_size`` (bench.py `edge` record fields).
+- EDGE_SMOKE additionally drives a WebSocket consumer when the optional
+  ``websockets`` package is installed (the WS load leg).
+- **EDGE_ACCEPT_PLANE** (``send_fds`` default / ``reuseport``) selects
+  the worker pool's socket-ownership plane (portable resume tokens vs
+  kernel-hash placement).
+
 Env: EDGE_GRAPH_NODES (default 2_000_000), EDGE_NODES (4), EDGE_SESSIONS
 (1_000_000), EDGE_KEYS (512), EDGE_KEYS_PER_SESSION (2), EDGE_ZIPF (1.1),
 EDGE_ROUNDS (2), EDGE_GROUPS (16), EDGE_SEEDS_PER_GROUP (2),
 EDGE_TIMEOUT_S (600), EDGE_WIRE (1), EDGE_SMOKE (0), EDGE_WORKERS (2),
-EDGE_FAN_WORKERS (2).
+EDGE_FAN_WORKERS (2), EDGE_VALUE_PLANE (block), EDGE_ACCEPT_PLANE
+(send_fds).
 
 Prints ONE JSON line (stdout); progress notes go to stderr.
 """
@@ -207,7 +232,7 @@ class Edge:
 
     def __init__(
         self, i: int, server_rpc: RpcHub, wire_codec: bool,
-        fan_workers: int = 2,
+        fan_workers: int = 2, value_plane: str = "block",
     ):
         self.i = i
         self.fusion = FusionHub()
@@ -219,6 +244,8 @@ class Edge:
         self.node = EdgeNode(
             "dag", self.rpc, self.fusion, name=f"edge-{i}",
             fan_workers=fan_workers,
+            reread_batch=value_plane != "perkey",
+            value_blocks=value_plane == "block",
         )
         self.observer = Observer()
         self.pool = None
@@ -258,6 +285,12 @@ async def main() -> None:
     smoke = os.environ.get("EDGE_SMOKE", "0") == "1"
     n_workers = int(os.environ.get("EDGE_WORKERS", 2))
     fan_workers = int(os.environ.get("EDGE_FAN_WORKERS", 2))
+    value_plane = os.environ.get("EDGE_VALUE_PLANE", "block")
+    accept_plane = os.environ.get("EDGE_ACCEPT_PLANE", "send_fds")
+    require(
+        value_plane in ("block", "batch", "perkey"),
+        f"EDGE_VALUE_PLANE must be block|batch|perkey, got {value_plane!r}",
+    )
     rng = np.random.default_rng(523)
 
     note(f"generating {n}-node power-law DAG...")
@@ -290,9 +323,12 @@ async def main() -> None:
         server_rpc = RpcHub("server")
         install_compute_call_type(server_rpc)
         server_rpc.add_service("dag", svc)
-        from stl_fusion_tpu.rpc import install_compute_fanout
+        from stl_fusion_tpu.rpc import install_compute_fanout, install_value_publisher
 
         fanout_index = install_compute_fanout(server_rpc, backend)
+        publisher = None
+        if value_plane == "block":
+            publisher = install_value_publisher(server_rpc)
 
         # distinct keys: tail rows (shallow own-closures; the deep seeds
         # below give the wave its full-scale walk)
@@ -320,13 +356,21 @@ async def main() -> None:
         # ---------------------------------------------------------- edges
         rss_before = rss_mb()
         edges = [
-            Edge(i, server_rpc, wire_codec, fan_workers=fan_workers)
+            Edge(
+                i, server_rpc, wire_codec, fan_workers=fan_workers,
+                value_plane=value_plane,
+            )
             for i in range(n_edges)
         ]
         if n_workers > 0:
-            note(f"starting {n_workers} delivery workers per edge...")
+            note(
+                f"starting {n_workers} delivery workers per edge "
+                f"({accept_plane} accept plane)..."
+            )
             for e in edges:
-                e.pool = await EdgeWorkerPool(e.node, workers=n_workers).start()
+                e.pool = await EdgeWorkerPool(
+                    e.node, workers=n_workers, accept_plane=accept_plane
+                ).start()
         note(f"subscribing {n_edges} edges × {n_keys} keys upstream...")
         t0 = time.perf_counter()
         # prime every edge's upstream subs by attaching one probe session
@@ -419,6 +463,33 @@ async def main() -> None:
         burst_s = 0.0
         round_deliveries = 0
         delivery: dict = {}
+
+        def upstream_counts():
+            return {
+                "rpcs": sum(e.node.upstream_rpcs for e in edges),
+                "per_key": sum(e.node.per_key_rereads for e in edges),
+                "batches": sum(e.node.reread_batches for e in edges),
+                "block_hits": sum(e.node.block_hits for e in edges),
+                "fences": sum(e.node.upstream_fences for e in edges),
+            }
+
+        # the FIRST-burst gate (ISSUE 11): the warm subscribe storm itself
+        # must already ride the value plane — per-key re-read RPCs stay ≤
+        # keys (batch/block modes run it as recompute_batch frames)
+        warm = upstream_counts()
+        if value_plane in ("batch", "block"):
+            require(
+                warm["per_key"] <= n_edges * n_keys,
+                f"first-burst per-key re-reads {warm['per_key']} exceed "
+                f"{n_edges * n_keys} keys — the batched path never engaged",
+            )
+            require(
+                warm["batches"] >= n_edges,
+                f"no recompute_batch frames on the warm subscribe "
+                f"({warm['batches']})",
+            )
+        measured_base = warm
+        prev_counts = warm
         for rnd in range(rounds):
             # all upstream subs re-registered (the previous round's fences
             # unindexed them until each edge's re-read landed)
@@ -474,16 +545,71 @@ async def main() -> None:
             round_total = sum(e.observer.fenced for e in edges) + worker_round
             round_deliveries += round_total
             delivery = hist.since(cp)  # last round's distribution
+            now_counts = upstream_counts()
             note(
                 f"round {rnd}: burst {t_burst - t0:.2f}s "
                 f"({int(counts.sum()):,} inv), fan-out {t_all - t_burst:.2f}s "
                 f"(upstream+probe {t_obs - t_burst:.2f}s, workers "
                 f"{t_all - t_obs:.2f}s; {round_total:,} deliveries), "
-                f"delivery p50/p99 {delivery['p50']}/{delivery['p99']} ms"
+                f"delivery p50/p99 {delivery['p50']}/{delivery['p99']} ms; "
+                f"upstream rpcs +{now_counts['rpcs'] - prev_counts['rpcs']}, "
+                f"block hits +{now_counts['block_hits'] - prev_counts['block_hits']}"
             )
+            prev_counts = now_counts
             backend.refresh_block_on_device(block)
             backend.flush()
             await settle()
+
+        # --------------------------------------- value-plane gates (ISSUE 11)
+        final = upstream_counts()
+        measured_rpcs = final["rpcs"] - measured_base["rpcs"]
+        measured_per_key = final["per_key"] - measured_base["per_key"]
+        measured_batches = final["batches"] - measured_base["batches"]
+        measured_hits = final["block_hits"] - measured_base["block_hits"]
+        measured_fences = final["fences"] - measured_base["fences"]
+        if value_plane in ("batch", "block"):
+            require(
+                measured_per_key == 0,
+                f"{measured_per_key} per-key upstream re-read RPCs re-entered "
+                f"during the measured bursts — the value plane disengaged",
+            )
+        if value_plane == "block":
+            # block-warm bursts: the block IS the fence + the value — any
+            # upstream re-read round trip (batched included) fails the run
+            require(
+                measured_rpcs == 0,
+                f"{measured_rpcs} upstream re-read RPCs on block-warm bursts "
+                f"(want 0: every fence must be served from a wave block)",
+            )
+            require(
+                measured_hits == n_edges * n_keys * rounds,
+                f"block hits {measured_hits} != "
+                f"{n_edges * n_keys * rounds} fences — some keys left the "
+                f"value plane mid-run",
+            )
+            require(
+                publisher is not None and publisher.stats()["fallback_fences"] == 0,
+                "publisher fell back to plain fences "
+                f"({publisher.stats()['fallback_fences'] if publisher else '?'})",
+            )
+        upstream_rpcs_per_burst = (
+            round(measured_rpcs / rounds, 2) if rounds else None
+        )
+        block_hit_ratio = (
+            round(measured_hits / measured_fences, 4) if measured_fences else None
+        )
+        total_batches = sum(e.node.reread_batches for e in edges)
+        reread_batch_size = (
+            round(sum(e.node.reread_batch_keys for e in edges) / total_batches, 1)
+            if total_batches
+            else None
+        )
+        note(
+            f"value plane [{value_plane}]: measured bursts took "
+            f"{measured_rpcs} upstream RPCs ({measured_per_key} per-key, "
+            f"{measured_batches} batch frames), block hits {measured_hits}"
+            f"/{measured_fences} fences"
+        )
 
         worker_evictions = 0
         worker_rss = []
@@ -541,7 +667,7 @@ async def main() -> None:
         if smoke:
             smoke_result = await run_smoke(
                 edges[0], n_edges * n_keys, fanout_index, backend, block, groups,
-                timeout_s, [e.node for e in edges],
+                timeout_s, [e.node for e in edges], value_plane,
             )
 
         result = {
@@ -561,6 +687,17 @@ async def main() -> None:
             "wire_codec": wire_codec,
             "edge_workers": n_workers,
             "fan_workers": fan_workers,
+            "accept_plane": accept_plane if n_workers else None,
+            # the upstream value plane (ISSUE 11)
+            "value_plane": value_plane,
+            "upstream_rpcs_per_burst": upstream_rpcs_per_burst,
+            "block_hit_ratio": block_hit_ratio,
+            "reread_batch_size": reread_batch_size,
+            "upstream_rpcs_total": final["rpcs"],
+            "per_key_rereads_total": final["per_key"],
+            "reread_fallbacks": sum(e.node.reread_fallbacks for e in edges),
+            "block_hits_total": final["block_hits"],
+            "publisher": publisher.stats() if publisher is not None else None,
             "frames_encoded": frames_encoded_total,
             "deliveries_total": deliveries_total,
             "encode_ratio": round(encode_ratio, 1),
@@ -605,13 +742,16 @@ async def main() -> None:
 
 async def run_smoke(
     edge: "Edge", expected_upstream_total: int, fanout_index, backend, block,
-    groups, timeout_s: float, all_nodes=None,
+    groups, timeout_s: float, all_nodes=None, value_plane: str = "block",
 ) -> dict:
     """EDGE_SMOKE=1 (tier1.yml): boot a REAL EdgeHttpServer on the first
-    edge, attach live SSE consumers over TCP, burst once, and assert the
-    `/metrics` exposition shows the tier working: fusion_edge_sessions,
-    a non-empty delivery histogram, and upstream subscriptions == distinct
-    keys (coalescing actually engaged, not N× fan-in)."""
+    edge, attach live SSE consumers over TCP (plus a WebSocket consumer
+    when the optional ``websockets`` package is installed — the WS load
+    leg), burst once, and assert the `/metrics` exposition shows the tier
+    working: fusion_edge_sessions, a non-empty delivery histogram,
+    upstream subscriptions == distinct keys (coalescing actually engaged,
+    not N× fan-in), and the ISSUE 11 value-plane gate (block mode: block
+    hits present, zero per-key re-entry on the block-served burst)."""
     import urllib.parse
 
     from stl_fusion_tpu.edge import EdgeHttpServer
@@ -623,6 +763,32 @@ async def run_smoke(
         (sub.method, *sub.args) for sub in list(node._subs.values())[:2]
     ]
     keys_q = urllib.parse.quote(json.dumps([list(k) for k in key_specs]))
+    try:
+        import websockets  # noqa: F401 — optional: the WS load leg
+        has_websockets = True
+    except ImportError:
+        has_websockets = False
+        note("smoke: websockets not installed — WS leg skipped")
+    ws_server = None
+    ws_conn = None
+    if has_websockets:
+        from websockets.asyncio.client import connect as ws_connect
+
+        from stl_fusion_tpu.edge import EdgeWebSocketServer
+
+        ws_server = await EdgeWebSocketServer(
+            node, heartbeat_interval=5.0
+        ).start()
+        note(f"smoke: WS server at {ws_server.url}")
+        ws_conn = await ws_connect(ws_server.url)
+        await ws_conn.send(json.dumps({"keys": [list(k) for k in key_specs]}))
+        ws_hello = json.loads(await asyncio.wait_for(ws_conn.recv(), 30.0))
+        require("hello" in ws_hello, f"smoke: bad WS hello {ws_hello}")
+        ws_replay = json.loads(await asyncio.wait_for(ws_conn.recv(), 30.0))
+        require(
+            len(ws_replay.get("frames", [])) >= 1,
+            f"smoke: WS replay missing ({ws_replay})",
+        )
     readers = []
     for _ in range(2):
         reader, writer = await asyncio.open_connection(http.host, http.port)
@@ -666,6 +832,8 @@ async def run_smoke(
         timeout_s, "smoke re-subscription",
     )
     backend.flush()
+    per_key_before = sum(nd.per_key_rereads for nd in (all_nodes or [node]))
+    rpcs_before = sum(nd.upstream_rpcs for nd in (all_nodes or [node]))
     backend.cascade_rows_lanes(block, groups)
     seen = []
     for reader, _w in readers:
@@ -673,6 +841,44 @@ async def run_smoke(
         require(ev.get("event") == "update", f"smoke: bad update {ev}")
         seen.append(json.loads(ev["data"]))
     require(all("t0" in d for d in seen), "smoke: frames lost the origin timestamp")
+    ws_update_frames = None
+    if ws_conn is not None:
+        # the WS leg sees the same burst (frames batches; skip pings)
+        deadline = time.perf_counter() + 30.0
+        while ws_update_frames is None:
+            require(
+                time.perf_counter() < deadline, "smoke: WS update never arrived"
+            )
+            msg = json.loads(await asyncio.wait_for(ws_conn.recv(), 30.0))
+            frames = msg.get("frames")
+            if frames and any(f.get("t0") is not None for f in frames):
+                ws_update_frames = len(frames)
+    # ISSUE 11 smoke gate: per-key re-reads never re-enter on a
+    # block-served burst; in batch/block modes the CUMULATIVE per-key
+    # total stays ≤ keys (fallback slack only — the perkey A/B mode
+    # legitimately accumulates ~keys per burst and is exempt)
+    nodes_for_gate = all_nodes or [node]
+    per_key_after = sum(nd.per_key_rereads for nd in nodes_for_gate)
+    if value_plane != "perkey":
+        require(
+            per_key_after <= expected_upstream_total,
+            f"smoke: {per_key_after} per-key re-reads exceed the "
+            f"{expected_upstream_total} distinct-key total",
+        )
+    if value_plane == "block":
+        require(
+            per_key_after == per_key_before,
+            f"smoke: {per_key_after - per_key_before} per-key re-read(s) "
+            f"re-entered on a block-served burst",
+        )
+        await until(
+            lambda: sum(nd.block_hits for nd in nodes_for_gate) > 0,
+            30.0, "smoke: value-block hits",
+        )
+        require(
+            sum(nd.upstream_rpcs for nd in nodes_for_gate) == rpcs_before,
+            "smoke: upstream re-read RPCs on a block-served burst",
+        )
 
     # scrape /metrics over real HTTP and assert the exposition
     reader, writer = await asyncio.open_connection(http.host, http.port)
@@ -768,9 +974,20 @@ async def run_smoke(
         }
     for _r, w in readers:
         w.close()
+    if ws_conn is not None:
+        await ws_conn.close()
+    if ws_server is not None:
+        await ws_server.stop()
     await http.stop()
     out = {
         "sse_consumers": len(readers),
+        "ws_consumers": 1 if ws_update_frames is not None else 0,
+        "ws_update_frames": ws_update_frames,
+        "value_plane": value_plane,
+        "block_hits": sum(nd.block_hits for nd in (all_nodes or [node])),
+        "per_key_rereads": sum(
+            nd.per_key_rereads for nd in (all_nodes or [node])
+        ),
         "metrics_sessions": sessions,
         "metrics_upstream_subs": subs,
         "delivery_count": metrics.get("fusion_edge_delivery_ms_count"),

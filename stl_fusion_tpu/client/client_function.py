@@ -295,6 +295,101 @@ class ClientComputeMethodFunction(FunctionBase):
                     self.hub.timeouts.schedule_invalidate(computed, ttl)
             return computed
 
+    # ------------------------------------------------------------------ batch
+    async def compute_batch(self, requests):
+        """Batched remote compute (ISSUE 11 level 1): ``requests`` is a
+        list of ``(method, args, publish)`` triples, all bound for THIS
+        function's pinned peer; every entry becomes a real registered
+        outbound compute call (reconnect re-send and redelivery dedup are
+        the per-key machinery, untouched) but ONE
+        ``$sys-c.recompute_batch`` frame carries them all and ONE
+        ``recompute_batch_r`` frame answers — the RPC/codec/loop-hop
+        envelope is paid once per burst instead of once per key.
+
+        Returns one result per request, positionally: a registered
+        :class:`ClientComputed` on success, or the Exception that entry
+        died with (``ResultMissedError``/``ShardMovedError``/server
+        errors) — the CALLER owns the per-key fallback ladder; this
+        method never silently degrades, so fallbacks stay countable.
+        Versions are the server computed's own LTags — oracle-exact with
+        the per-key path."""
+        if not requests:
+            return []
+        router = self.rpc_hub.call_router
+        peer_ref = self.peer_ref or "default"
+        peer = self.rpc_hub.client_peer(peer_ref)
+        await peer.when_connected()
+        from ..rpc.message import COMPUTE_SYSTEM_SERVICE, RpcMessage
+
+        calls, entries = [], []
+        for method, args, publish in requests:
+            args = tuple(args)
+            headers: tuple = ()
+            if self.cluster_routed and hasattr(router, "headers_for"):
+                headers = router.headers_for(
+                    self.service, method, args, peer_ref=peer_ref
+                )
+            call = RpcOutboundComputeCall(
+                peer, self.service, method, args, headers=headers
+            )
+            peer.outbound_calls[call.call_id] = call
+            calls.append(call)
+            entries.append(
+                [
+                    call.call_id,
+                    self.service,
+                    method,
+                    list(args),
+                    bool(publish),
+                    [list(h) for h in headers],
+                ]
+            )
+        message = RpcMessage(
+            call_type_id=calls[0].call_type_id,
+            call_id=0,
+            service=COMPUTE_SYSTEM_SERVICE,
+            method="recompute_batch",
+            argument_data=dumps([entries]),
+        )
+        try:
+            await peer.send(message)
+        except Exception:  # noqa: BLE001 — not connected: the calls stay
+            # registered and the reconnect re-send replays them per-key
+            pass
+        outcomes = await asyncio.gather(
+            *(c.future for c in calls), return_exceptions=True
+        )
+        results = []
+        for (method, args, _publish), call, outcome in zip(requests, calls, outcomes):
+            if isinstance(outcome, BaseException):
+                if _is_shard_moved(outcome) and hasattr(router, "note_moved"):
+                    # apply the rejection's carried map BEFORE handing the
+                    # error back (the per-key path's contract): the
+                    # caller's retry re-routes against the new owner
+                    # instead of spinning on the retired one
+                    router.note_moved(outcome)
+                call.unregister()
+                results.append(outcome)
+                continue
+            if call.when_invalidated.done():
+                # result arrived already invalidated: the per-key path's
+                # bounded retry loop owns this shape — surface retriable
+                results.append(
+                    ResultMissedError(
+                        f"batch entry {call.call_id} arrived already invalidated"
+                    )
+                )
+                continue
+            input = ClientComputeMethodInput(self, method, tuple(args))
+            version = call.result_version or self.hub.version_generator.next()
+            computed = ClientComputed(input, LTag(version), self.options, call)
+            computed.try_set_output(Result.ok(outcome))
+            self.hub.registry.register(computed)
+            if self.cache is not None:
+                self.cache.set(input.cache_key(), dumps(outcome))
+            results.append(computed)
+        return results
+
 
 class FusionClient:
     """The client proxy: attribute access → client compute method.
@@ -316,6 +411,14 @@ class FusionClient:
             fusion_hub or default_hub(), rpc_hub, service, peer_ref, cache, options,
             cluster_routed=cluster_routed,
         )
+
+    def capture_batch(self, requests):
+        """Batched twin of ``capture(lambda: client.method(*args))`` × N
+        (ISSUE 11): ``requests`` = ``[(method, args, publish), ...]`` →
+        one ``recompute_batch`` frame; returns per-request
+        ``ClientComputed`` or Exception (see
+        :meth:`ClientComputeMethodFunction.compute_batch`)."""
+        return self._function.compute_batch(requests)
 
     def __getattr__(self, method: str):
         if method.startswith("_"):

@@ -13,6 +13,21 @@ Re-expression of src/Stl.Fusion/Client/Internal/ — RpcOutboundComputeCall
 
 This is THE mechanism that makes a remote cache coherent: every remote read
 is implicitly a subscription.
+
+ISSUE 11 adds the BATCHED flavor of the same contract (the upstream value
+plane's level 1): ``$sys-c.recompute_batch`` carries a whole fence-burst's
+worth of per-key compute calls in ONE frame — each entry is a real
+client-allocated outbound call (so reconnect re-send, redelivery dedup and
+the invalidation subscription machinery are IDENTICAL to the per-key
+path), but the RPC/codec/loop-hop envelope is paid once per burst instead
+of once per key. The server answers every successfully-captured entry in
+ONE ``recompute_batch_r`` frame; per-entry failures are answered through
+the ordinary per-call ``$sys.error`` wire shape so the client's routing /
+retry semantics (ShardMovedError, ResultMissedError) stay byte-identical
+with the per-key path. Entries may additionally request PUBLISH mode
+(level 2): the serving member then keeps a standing registration
+(rpc/fanout.py ``WaveValuePublisher``) and answers later wave fences with
+pushed ``value_block`` frames instead of plain invalidations.
 """
 from __future__ import annotations
 
@@ -24,12 +39,14 @@ from typing import TYPE_CHECKING, Any, Optional
 from ..core.context import try_capture
 from ..diagnostics.flight_recorder import RECORDER, call_key
 from ..diagnostics.metrics import global_metrics
+from ..utils.errors import ExceptionInfo
 from ..utils.ltag import LTag
 from ..utils.serialization import dumps, loads
 from ..rpc.calls import RpcInboundCall, RpcOutboundCall
 from ..rpc.message import (
     CALL_TYPE_COMPUTE,
     COMPUTE_SYSTEM_SERVICE,
+    SYSTEM_SERVICE,
     VERSION_HEADER,
     RpcMessage,
 )
@@ -89,6 +106,11 @@ class RpcOutboundComputeCall(RpcOutboundCall):
         #: the delivery measurement one more hop: fence → edge → session.
         self.invalidation_origin_ts: Optional[float] = None
         self.when_invalidated: asyncio.Future = asyncio.get_event_loop().create_future()
+        #: True when this call rode a ``recompute_batch`` entry that asked
+        #: for publish mode AND the server armed a standing registration —
+        #: later fences for this key arrive as ``value_block`` pushes, not
+        #: plain invalidations (the edge's zero-RPC path, ISSUE 11)
+        self.publish_armed = False
         #: sync callbacks run INSIDE set_invalidated — the bound
         #: ClientComputed invalidates in the same dispatch that applied the
         #: frame instead of one call_soon hop later; at fan-out scale those
@@ -183,6 +205,27 @@ class RpcOutboundComputeCall(RpcOutboundCall):
                 cb()
         self.peer.outbound_calls.pop(self.call_id, None)
 
+    def set_batch_result(self, version: Optional[str], value: Any, publish_armed: bool = False) -> None:
+        """Result delivery through a ``recompute_batch_r`` frame entry —
+        the batched twin of :meth:`set_result` (version rides inline in
+        the entry instead of as a ``@version`` header). The redelivered-
+        result version-mismatch rule applies unchanged: a done future with
+        a moved-on version means the invalidation for OUR version died
+        with an old link."""
+        v = LTag.parse(version) if version else None
+        if self.future is not None and self.future.done():
+            if (
+                v is not None
+                and self.result_version is not None
+                and v != self.result_version
+            ):
+                self.set_invalidated()
+            return
+        self.publish_armed = bool(publish_armed)
+        self.result_version = v
+        if self.future is not None:
+            self.future.set_result(value)
+
     def unregister(self) -> None:
         self.peer.outbound_calls.pop(self.call_id, None)
 
@@ -228,17 +271,20 @@ class RpcInboundComputeCall(RpcInboundCall):
                 pass
             self.peer.inbound_calls.pop(self.call_id, None)
             return
-        # stay registered; push $sys-c when the computed dies. The push is
-        # armed as a SYNC on_invalidated handler, not a parked watch task:
-        # under coalescing the push is a dict insert into the peer outbox
-        # (flushed as one $sys-c.invalidate_batch per tick), so a burst
-        # fencing 10k subscriptions costs 10k inserts + N frames — not 10k
-        # task wakeups + 10k awaited sends. Graph-resident computeds ALSO
-        # index into the hub's fanout index (rpc/fanout.py) so a device
-        # burst's newly-mask drains them during wave application; the
-        # handler then just cleans up (``_invalidation_pushed``).
-        # (index registration honors the wire-compat flag: a hub serving
-        # per-key frames must not let the mask drain ship batch frames)
+        self._arm_subscription(computed)
+
+    def _arm_subscription(self, computed) -> None:
+        """Stay registered; push $sys-c when the computed dies. The push is
+        armed as a SYNC on_invalidated handler, not a parked watch task:
+        under coalescing the push is a dict insert into the peer outbox
+        (flushed as one $sys-c.invalidate_batch per tick), so a burst
+        fencing 10k subscriptions costs 10k inserts + N frames — not 10k
+        task wakeups + 10k awaited sends. Graph-resident computeds ALSO
+        index into the hub's fanout index (rpc/fanout.py) so a device
+        burst's newly-mask drains them during wave application; the
+        handler then just cleans up (``_invalidation_pushed``).
+        (index registration honors the wire-compat flag: a hub serving
+        per-key frames must not let the mask drain ship batch frames)"""
         fanout = getattr(self.peer.hub, "compute_fanout", None)
         nid = getattr(computed, "_backend_nid", None)
         if (
@@ -252,13 +298,92 @@ class RpcInboundComputeCall(RpcInboundCall):
             )
         computed.on_invalidated(self._on_computed_invalidated)
 
+    async def serve_inline(self, publish: bool = False):
+        """Batch-entry flavor of :meth:`_run` (``recompute_batch``, ISSUE
+        11): capture + arm the subscription exactly like a per-key call,
+        but RETURN the response entry ``[call_id, version, value,
+        publish_armed]`` for the caller to fold into ONE
+        ``recompute_batch_r`` frame instead of sending a per-call reply.
+        Failures (capture errors AND memoized compute errors) are answered
+        through the ordinary per-call ``$sys.error`` wire shape — the
+        client's per-key fallback ladder owns them — and return None.
+
+        With ``publish`` (and a :class:`~..rpc.fanout.WaveValuePublisher`
+        installed on the hub) the captured computed additionally registers
+        a STANDING publish subscription: later wave fences ship a pushed
+        ``value_block`` entry instead of a plain invalidation."""
+        self.peer.inbound_calls[self.call_id] = self
+        try:
+            computed = await self._capture_target()
+        except asyncio.CancelledError:
+            self.peer.inbound_calls.pop(self.call_id, None)
+            raise
+        except Exception as e:  # noqa: BLE001 — capture failed outright
+            self.peer.inbound_calls.pop(self.call_id, None)
+            await self._send_entry_error(e)
+            return None
+        self.computed = computed
+        out = computed._output
+        if out is not None and out.has_error:
+            self.peer.inbound_calls.pop(self.call_id, None)
+            await self._send_entry_error(out.error)
+            return None
+        armed = False
+        if publish:
+            publisher = getattr(self.peer.hub, "value_publisher", None)
+            if publisher is not None:
+                armed = publisher.register_standing(
+                    self.peer,
+                    self.call_id,
+                    self.message.service,
+                    self.message.method,
+                    loads(self.message.argument_data),
+                    computed,
+                )
+        self._arm_subscription(computed)
+        return [
+            self.call_id,
+            computed.version.format(),
+            out.value if out is not None else None,
+            armed,
+        ]
+
+    async def _send_entry_error(self, error: BaseException) -> None:
+        """Per-entry error reply for the batch path — the per-key wire
+        shape ($sys.error with this entry's call id), so the client's
+        existing completion/ShardMoved handling applies untouched. A
+        transport death is swallowed: the client's reconnect re-send
+        replays the entry as an ordinary per-key call."""
+        try:
+            await self.peer.send(self._error_message(error))
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — link died; reconnect re-serves
+            pass
+
     def restart(self) -> None:
         """Re-delivery after reconnect: if our computed already died, the
         result is stale — push the invalidation instead (≈ version-mismatch
         handling, RpcInboundCall.Restart + RpcOutboundComputeCall version
-        checks)."""
+        checks). A batch-served call (``serve_inline``) stored no
+        result_message — rebuild the per-key OK reply from the live
+        computed so the client's re-sent call never hangs."""
         if self.computed is not None and self.computed.is_invalidated:
             asyncio.get_event_loop().create_task(self._send_invalidation())
+        elif self.result_message is None and self.computed is not None:
+            out = self.computed._output
+            headers = ((VERSION_HEADER, self.computed.version.format()),)
+            try:
+                if out is not None and out.has_error:
+                    self._build_error(out.error)
+                else:
+                    self._build_ok(
+                        out.value if out is not None else None, headers=headers
+                    )
+            except Exception:  # noqa: BLE001 — unserializable: invalidate
+                asyncio.get_event_loop().create_task(self._send_invalidation())
+                return
+            super().restart()
         else:
             super().restart()
 
@@ -288,6 +413,14 @@ class RpcInboundComputeCall(RpcInboundCall):
         self.peer.inbound_calls.pop(self.call_id, None)
         if self._invalidation_pushed:
             return  # the wave drain already batched this subscription
+        # a HOST-LED invalidation (reshard fence, manual invalidate — not a
+        # wave the publisher intercepted): a standing publish registration
+        # must not outlive it — the plain invalidation below tells the edge
+        # to re-read and re-arm, and a stale standing record would keep
+        # publishing values for a subscription the client already replaced
+        publisher = getattr(self.peer.hub, "value_publisher", None)
+        if publisher is not None:
+            publisher.drop_standing(self.peer, self.call_id)
         pushed = False
         if getattr(self.peer.hub, "coalesce_invalidations", True):
             self._invalidation_pushed = True
@@ -388,6 +521,106 @@ class RpcInboundComputeCall(RpcInboundCall):
         pass  # compute calls manage their own registration lifetime
 
 
+async def _serve_recompute_batch(peer: "RpcPeer", message: RpcMessage) -> None:
+    """Server side of ``$sys-c.recompute_batch`` (ISSUE 11 level 1): ONE
+    inbound frame carries a whole fence-burst's per-key compute calls —
+    ``[[call_id, service, method, args, publish, headers], ...]`` — and
+    ONE ``recompute_batch_r`` frame answers every entry that captured
+    cleanly. Each entry is dispatched as its own synthetic per-key message
+    THROUGH the hub's inbound middleware chain, so the cluster shard guard
+    (and any auth middleware) sees exactly the per-key wire shape: a
+    stale-epoch entry is rejected with the carried map via the normal
+    per-call ``$sys.error`` path and simply doesn't appear in the batch
+    answer. The recompute itself still runs per key through the capture
+    machinery — what this batches is the RPC/codec/loop-hop ENVELOPE."""
+    from ..rpc.peer import _run_middlewares
+
+    (entries,) = loads(message.argument_data)
+    hub = peer.hub
+
+    async def _serve_entry(entry):
+        call_id = entry[0]
+        service, method = entry[1], entry[2]
+        args = entry[3]
+        publish = bool(entry[4]) if len(entry) > 4 else False
+        headers = (
+            tuple((str(k), str(v)) for k, v in entry[5]) if len(entry) > 5 else ()
+        )
+        existing = peer.inbound_calls.get(call_id)
+        if existing is not None:
+            existing.restart()  # duplicate delivery after reconnect
+            return None
+        if call_id in peer._completed_inbound:
+            return None  # already served and pruned
+        sub_msg = RpcMessage(
+            call_type_id=CALL_TYPE_COMPUTE,
+            call_id=call_id,
+            service=service,
+            method=method,
+            argument_data=dumps(list(args)),
+            headers=headers,
+        )
+        served: dict = {}
+
+        async def _terminal(msg: RpcMessage) -> None:
+            inbound = RpcInboundComputeCall(peer, msg)
+            result = await inbound.serve_inline(publish=publish)
+            if result is not None:
+                served["entry"] = result
+
+        try:
+            mws = hub.inbound_middlewares
+            if mws:
+                await _run_middlewares(mws, peer, sub_msg, _terminal)
+            else:
+                await _terminal(sub_msg)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — one entry's failure must
+            # never poison its batch siblings: answer it per-key
+            try:
+                await peer.send(
+                    RpcMessage(
+                        CALL_TYPE_COMPUTE,
+                        call_id,
+                        SYSTEM_SERVICE,
+                        "error",
+                        dumps(ExceptionInfo.capture(e)),
+                    )
+                )
+            except Exception:  # noqa: BLE001 — link died; reconnect re-serves
+                pass
+            return None
+        return served.get("entry")
+
+    # entries capture CONCURRENTLY (registry single-flight dedups shared
+    # keys): one slow recompute must not head-of-line-block its batch
+    # siblings — the per-key path ran each inbound call as its own task,
+    # and the reply frame matches entries by call id, so order is free
+    results = await asyncio.gather(
+        *(_serve_entry(entry) for entry in entries), return_exceptions=True
+    )
+    ok_entries = []
+    for result in results:
+        if isinstance(result, asyncio.CancelledError):
+            raise result
+        if isinstance(result, BaseException):
+            log.exception("recompute_batch entry failed", exc_info=result)
+            continue
+        if result is not None:
+            ok_entries.append(result)
+    if ok_entries:
+        await peer.send(
+            RpcMessage(
+                call_type_id=CALL_TYPE_COMPUTE,
+                call_id=0,
+                service=COMPUTE_SYSTEM_SERVICE,
+                method="recompute_batch_r",
+                argument_data=dumps([ok_entries]),
+            )
+        )
+
+
 def install_compute_call_type(rpc_hub: "RpcHub") -> None:
     """Register call type 1 + the $sys-c dispatcher on an RPC hub
     (≈ RpcComputeCallType.cs registration)."""
@@ -403,6 +636,17 @@ def install_compute_call_type(rpc_hub: "RpcHub") -> None:
                     cause=message.header("@cause"),
                     origin_ts=float(t0) if t0 else None,
                 )
+            else:
+                # a publish-mode key's client call retires once the value
+                # plane takes over (the edge invalidated its local node) —
+                # a FALLBACK fence for it routes to the value-plane client
+                vpc = getattr(peer.hub, "value_plane_client", None)
+                if vpc is not None:
+                    t0 = message.header("@t0")
+                    vpc.on_block_fence(
+                        peer, call_id, message.header("@cause"),
+                        float(t0) if t0 else None,
+                    )
         elif message.method == "invalidate_batch":
             # one frame, many subscriptions: [[call_id, version|None], ...].
             # Application is per-entry identical to a per-key invalidate —
@@ -414,6 +658,7 @@ def install_compute_call_type(rpc_hub: "RpcHub") -> None:
             # no-ops). The version rides for dedup at the sender and
             # diagnostics here.
             (entries,) = loads(message.argument_data)
+            vpc = None
             for entry in entries:
                 call = peer.outbound_calls.get(entry[0])
                 if isinstance(call, RpcOutboundComputeCall):
@@ -423,5 +668,41 @@ def install_compute_call_type(rpc_hub: "RpcHub") -> None:
                         cause=entry[2] if len(entry) > 2 else None,
                         origin_ts=entry[3] if len(entry) > 3 else None,
                     )
+                else:
+                    if vpc is None:
+                        vpc = getattr(peer.hub, "value_plane_client", None)
+                    if vpc is not None:
+                        vpc.on_block_fence(
+                            peer,
+                            entry[0],
+                            entry[2] if len(entry) > 2 else None,
+                            entry[3] if len(entry) > 3 else None,
+                        )
+        elif message.method == "recompute_batch":
+            # ISSUE 11 level 1: a whole fence-burst's re-reads in one
+            # frame. Async (capture) — spawned, never awaited inline, the
+            # same discipline as $sys-d: a slow recompute must not
+            # head-of-line-block this link's invalidation frames
+            task = asyncio.get_event_loop().create_task(
+                _serve_recompute_batch(peer, message)
+            )
+            peer._diag_tasks.add(task)
+            task.add_done_callback(peer._on_diag_done)
+        elif message.method == "recompute_batch_r":
+            (entries,) = loads(message.argument_data)
+            for entry in entries:
+                call = peer.outbound_calls.get(entry[0])
+                if isinstance(call, RpcOutboundComputeCall):
+                    call.set_batch_result(
+                        entry[1], entry[2],
+                        bool(entry[3]) if len(entry) > 3 else False,
+                    )
+        elif message.method == "value_block":
+            # ISSUE 11 level 2: a wave's recomputed hot-set pushed as ONE
+            # columnar frame — routed to whoever installed the value-plane
+            # client on this hub (the EdgeNode)
+            vpc = getattr(peer.hub, "value_plane_client", None)
+            if vpc is not None:
+                vpc.on_value_block(peer, message)
 
     rpc_hub.compute_system_handler = handle_compute_system

@@ -410,6 +410,15 @@ def explain(
         )
         if wave_edge_sessions_fenced > edge_sessions_fenced:
             line += f" ({wave_edge_sessions_fenced} across the wave)"
+        # the value-plane rung that produced the fanned value (ISSUE 11):
+        # the edge stamps "value served from wave block / batched re-read /
+        # per-key re-read" into its journal detail — surface it so an
+        # operator can see WHICH upstream path a fence actually took
+        for e in edge_events:
+            detail = e.get("detail") or ""
+            if e.get("key") in keys and "value served from" in detail:
+                line += f" ({detail[detail.index('value served from'):]})"
+                break
         chain.append(line)
     elif wave_edge_sessions_fenced:
         chain.append(

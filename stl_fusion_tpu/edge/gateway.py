@@ -94,10 +94,24 @@ class _KeySub:
         "parked_refs",
         "pins",
         "repin_cause",
-        "_repin",
+        "_wake",
+        "node",
+        "needs_reread",
+        "pending_fence",
+        "backoff",
+        "upstream_version",
+        "block_mode",
+        "block_call_id",
+        "block_seq",
+        "block_pending",
+        "block_size",
+        "last_src",
     )
 
-    def __init__(self, key_str: str, method: str, args: tuple, n_shards: int = 1):
+    def __init__(
+        self, key_str: str, method: str, args: tuple, n_shards: int = 1,
+        backoff: float = 0.05,
+    ):
         self.key_str = key_str
         self.method = method
         self.args = args
@@ -119,7 +133,36 @@ class _KeySub:
         #: set when a shard-map change moved this key's owner: the watch
         #: loop re-subscribes there and stamps the next frame's cause
         self.repin_cause: Optional[str] = None
-        self._repin = asyncio.Event()
+        #: the watch loop's wake event: repins, value-block arrivals and
+        #: fallback fences all signal it (one event, not one side-task per
+        #: wake source per cycle)
+        self._wake = asyncio.Event()
+        #: the current upstream ClientComputed (None while block-fed: the
+        #: value plane retires the local node once blocks own the key)
+        self.node = None
+        #: set by fallback fences / block evictions / reconnects: the next
+        #: serve cycle must go upstream (batched re-read)
+        self.needs_reread = False
+        #: (cause, origin_ts) carried by a fallback fence, stamped onto
+        #: the re-read's fanned frame
+        self.pending_fence: Optional[Tuple[Optional[str], Optional[float]]] = None
+        #: per-sub exponential error backoff (reset on a healthy read)
+        self.backoff = backoff
+        #: last upstream LTag observed (diagnostics/tests — oracle checks)
+        self.upstream_version: Optional[str] = None
+        #: True once the server armed a standing publish registration for
+        #: this key: fences arrive as value-block pushes, zero per-key RPCs
+        self.block_mode = False
+        self.block_call_id: Optional[int] = None
+        #: last applied block seq — the monotonic stale-entry gate
+        self.block_seq = 0
+        #: latest unserved block entry (seq, version, value, cause, t0) —
+        #: latest-wins: a newer entry replaces an unserved older one
+        self.block_pending: Optional[tuple] = None
+        self.block_size = 0  # pending entry's payload bytes (budget share)
+        #: how the latest fanned frame's value was served ("wave block" /
+        #: "batched re-read" / "per-key re-read") — explain() names it
+        self.last_src: Optional[str] = None
 
     @property
     def sessions(self) -> Set[EdgeSession]:
@@ -150,7 +193,7 @@ class _KeySub:
 
     def repin(self, cause: str) -> None:
         self.repin_cause = cause
-        self._repin.set()
+        self._wake.set()
 
 
 class _FanShard:
@@ -219,6 +262,89 @@ class _FanShard:
             )
 
 
+class _RereadBatcher:
+    """The upstream value plane's LEVEL 1 (ISSUE 11): fence-burst re-reads
+    coalesce into ONE ``$sys-c.recompute_batch`` RPC per owner peer. A
+    ``$sys-c`` batch frame wakes every fenced key's watch loop in the same
+    event-loop ticks; each loop submits here and awaits its own entry, and
+    the batcher flushes the owner's bucket after ``reread_batch_window``
+    (or at ``reread_batch_max`` keys) — the per-key capture still runs on
+    the server, but the RPC/codec/loop-hop envelope is paid once per burst
+    instead of once per key (the PR 10 ~2 ms/key storm tail)."""
+
+    __slots__ = ("node", "_pending", "_timers")
+
+    def __init__(self, node: "EdgeNode"):
+        self.node = node
+        #: owner peer ref -> [(sub, future)] awaiting the next flush
+        self._pending: Dict[str, list] = {}
+        self._timers: Dict[str, Any] = {}
+
+    def submit(self, owner: str, sub: _KeySub) -> "asyncio.Future":
+        loop = asyncio.get_event_loop()
+        future = loop.create_future()
+        bucket = self._pending.setdefault(owner, [])
+        bucket.append((sub, future))
+        if len(bucket) >= self.node.reread_batch_max:
+            self._fire(owner)
+        elif owner not in self._timers:
+            window = self.node.reread_batch_window
+            if window > 0:
+                self._timers[owner] = loop.call_later(window, self._fire, owner)
+            else:
+                self._timers[owner] = loop.call_soon(self._fire, owner)
+        return future
+
+    def _fire(self, owner: str) -> None:
+        timer = self._timers.pop(owner, None)
+        if timer is not None:
+            timer.cancel()
+        batch = self._pending.pop(owner, None)
+        if batch:
+            asyncio.get_event_loop().create_task(self._flush(owner, batch))
+
+    async def _flush(self, owner: str, batch: list) -> None:
+        node = self.node
+        client = node._client_for(owner)
+        node.reread_batches += 1
+        node.upstream_rpcs += 1
+        node.reread_batch_keys += len(batch)
+        node._batch_size_hist.record(len(batch))
+        requests = [
+            (sub.method, sub.args, node.value_blocks) for sub, _f in batch
+        ]
+        try:
+            results = await client.capture_batch(requests)
+        except asyncio.CancelledError:
+            for _sub, future in batch:
+                if not future.done():
+                    future.cancel()
+            raise
+        except Exception as e:  # noqa: BLE001 — whole-frame failure: every
+            # entry falls back per-key in its own watch loop (counted there)
+            for _sub, future in batch:
+                if not future.done():
+                    future.set_exception(e)
+            return
+        for (_sub, future), result in zip(batch, results):
+            if future.done():
+                continue
+            if isinstance(result, BaseException):
+                future.set_exception(result)
+            else:
+                future.set_result(result)
+
+    def cancel_all(self) -> None:
+        for timer in self._timers.values():
+            timer.cancel()
+        self._timers.clear()
+        pending, self._pending = self._pending, {}
+        for bucket in pending.values():
+            for _sub, future in bucket:
+                if not future.done():
+                    future.cancel()
+
+
 class EdgeNode:
     """One edge gateway process: holds exactly one upstream subscription
     per distinct key and re-fans each fence to its downstream sessions.
@@ -240,9 +366,15 @@ class EdgeNode:
         resume_ttl: float = 60.0,
         max_pending: int = 4096,
         error_backoff: float = 0.05,
+        error_backoff_max: float = 1.0,
         allowed_methods=None,
         max_keys_per_session: int = 1024,
         fan_workers: int = 1,
+        reread_batch: bool = True,
+        reread_batch_window: float = 0.002,
+        reread_batch_max: int = 512,
+        value_blocks: bool = True,
+        block_budget_bytes: int = 64 << 20,
     ):
         from ..core.hub import FusionHub
 
@@ -255,6 +387,23 @@ class EdgeNode:
         self.resume_ttl = resume_ttl
         self.max_pending = max_pending
         self.error_backoff = error_backoff
+        #: exponential-backoff cap for the watch loops' upstream retry
+        #: paths (errors AND shard-move transients): a flapping upstream
+        #: key backs off per-sub instead of hot-spinning 512 loops
+        self.error_backoff_max = max(error_backoff, error_backoff_max)
+        #: ISSUE 11 level 1: coalesce fence-burst re-reads into ONE
+        #: recompute_batch RPC per owner (False = the per-key A/B shape)
+        self.reread_batch = reread_batch
+        self.reread_batch_window = reread_batch_window
+        self.reread_batch_max = max(1, int(reread_batch_max))
+        #: ISSUE 11 level 2: ask the serving member for publish-on-wave
+        #: value blocks — fences then arrive WITH the recomputed value and
+        #: a block-warm burst costs zero per-key upstream RPCs
+        self.value_blocks = value_blocks
+        #: bound on UNSERVED pending block-entry bytes across keys; an
+        #: entry over budget is dropped (counted) and its key falls back
+        #: to the batched re-read — never silent, never unbounded
+        self.block_budget_bytes = block_budget_bytes
         #: method allowlist for key specs. The edge transports forward
         #: client-supplied (method, args) into upstream compute calls, so
         #: a node behind a PUBLIC EdgeHttpServer/EdgeWebSocketServer
@@ -294,6 +443,30 @@ class EdgeNode:
             router.on_map_change.append(self._on_map_change)
         self._subs: Dict[str, _KeySub] = {}
         self._clients: Dict[str, FusionClient] = {}
+        #: the level-1 batcher (one per node; buckets per owner peer)
+        self._batcher = _RereadBatcher(self)
+        #: publish-mode routing: upstream call_id -> its sub (the block
+        #: frames and fallback fences address subscriptions by call id)
+        self._block_calls: Dict[int, _KeySub] = {}
+        #: total UNSERVED pending block bytes (the block_budget_bytes gauge)
+        self._block_pending_bytes = 0
+        #: per-owner reconnect monitor tasks (block-fed keys have no
+        #: registered outbound call to ride the reconnect re-send, so the
+        #: node itself re-reads them when an upstream link returns)
+        self._monitor_tasks: List[asyncio.Task] = []
+        if value_blocks:
+            # route inbound $sys-c value_block frames + fallback fences
+            # for retired publish-mode calls to this node. One value-plane
+            # client per rpc hub: a second node on the SAME hub keeps the
+            # plain re-read ladder (counted path, never silently wrong).
+            if getattr(rpc_hub, "value_plane_client", None) is None:
+                rpc_hub.value_plane_client = self
+            else:
+                log.warning(
+                    "edge %s: rpc hub %s already has a value-plane client; "
+                    "value blocks disabled on this node", name, rpc_hub.name,
+                )
+                self.value_blocks = False
         self._sessions: Set[EdgeSession] = set()
         #: token → (key specs, delivered-version map, expiry deadline)
         self._parked: Dict[str, Tuple[tuple, Dict[str, int], float]] = {}
@@ -325,9 +498,30 @@ class EdgeNode:
         self.upstream_fences = 0
         self.upstream_errors = 0
         self.sessions_attached_total = 0
+        # -- the upstream value plane (ISSUE 11) --------------------------
+        #: upstream RPC round trips: batch frames + per-key captures — the
+        #: CI gate's numerator (block-warm bursts must keep this flat)
+        self.upstream_rpcs = 0
+        self.per_key_rereads = 0  # per-key capture round trips
+        self.reread_batches = 0  # recompute_batch frames sent
+        self.reread_batch_keys = 0  # keys those frames carried
+        self.reread_fallbacks = 0  # batch entries that fell back per-key
+        self.upstream_backoffs = 0  # error/transient backoff sleeps
+        self.block_hits = 0  # fans served from a wave value block (0 RPCs)
+        self.block_entries = 0  # block entries received
+        self.block_stale = 0  # entries dropped by the seq gate
+        self.block_evictions = 0  # entries dropped by the byte budget
+        self.block_fences = 0  # fallback fences for block-fed keys
+        self.block_reshard_drops = 0  # pending entries dropped by repins
+        self.block_orphans = 0  # entries for unknown/closed call ids
+        self.reconnect_rereads = 0  # block-fed keys re-read on reconnect
         self._delivery_hist = global_metrics().histogram(
             "fusion_edge_delivery_ms",
             help="server fence (wave apply) -> edge session client-visible",
+        )
+        self._batch_size_hist = global_metrics().histogram(
+            "fusion_edge_reread_batch_size",
+            help="keys per recompute_batch upstream frame",
         )
         global_metrics().register_collector(self, EdgeNode._collect_metrics)
 
@@ -351,6 +545,18 @@ class EdgeNode:
             "fusion_edge_resubscribes_total": self.resubscribes,
             "fusion_edge_upstream_fences_total": self.upstream_fences,
             "fusion_edge_upstream_errors_total": self.upstream_errors,
+            "fusion_edge_upstream_rpcs_total": self.upstream_rpcs,
+            "fusion_edge_per_key_rereads_total": self.per_key_rereads,
+            "fusion_edge_reread_batches_total": self.reread_batches,
+            "fusion_edge_reread_batch_keys_total": self.reread_batch_keys,
+            "fusion_edge_reread_fallbacks_total": self.reread_fallbacks,
+            "fusion_edge_upstream_backoffs_total": self.upstream_backoffs,
+            "fusion_edge_value_block_hits_total": self.block_hits,
+            "fusion_edge_value_block_entries_total": self.block_entries,
+            "fusion_edge_value_block_stale_total": self.block_stale,
+            "fusion_edge_value_block_evictions_total": self.block_evictions,
+            "fusion_edge_value_block_fences_total": self.block_fences,
+            "fusion_edge_value_block_pending_bytes": self._block_pending_bytes,
         }
         pool = self.worker_pool
         if pool is not None:
@@ -400,6 +606,32 @@ class EdgeNode:
             "resubscribes": self.resubscribes,
             "upstream_fences": self.upstream_fences,
             "upstream_errors": self.upstream_errors,
+            # the upstream value plane (ISSUE 11): how this node's fences
+            # were actually served — an operator reads block_hit_ratio
+            # first (1.0 = zero per-key upstream RPCs on warm bursts)
+            "value_plane": {
+                "reread_batch": self.reread_batch,
+                "value_blocks": self.value_blocks,
+                "upstream_rpcs": self.upstream_rpcs,
+                "per_key_rereads": self.per_key_rereads,
+                "reread_batches": self.reread_batches,
+                "reread_batch_keys": self.reread_batch_keys,
+                "reread_fallbacks": self.reread_fallbacks,
+                "block_hits": self.block_hits,
+                "block_entries": self.block_entries,
+                "block_stale": self.block_stale,
+                "block_evictions": self.block_evictions,
+                "block_fences": self.block_fences,
+                "block_fed_keys": sum(
+                    1 for s in self._subs.values() if s.block_mode
+                ),
+                "block_hit_ratio": round(
+                    self.block_hits / self.upstream_fences, 3
+                )
+                if self.upstream_fences
+                else None,
+                "upstream_backoffs": self.upstream_backoffs,
+            },
             # the delivery histogram is ONE process-wide registry metric
             # (every in-process edge node records into it) — named so a
             # multi-node report is never misread as this node's own
@@ -452,7 +684,51 @@ class EdgeNode:
                 cluster_routed=self.router is not None,
             )
             self._clients[peer_ref] = client
+            if self.value_blocks:
+                # block-fed keys hold no registered outbound call, so the
+                # reconnect re-send machinery cannot heal them — this
+                # monitor re-reads them when the owner's link returns
+                # (one task per OWNER peer, never per key)
+                try:
+                    self._monitor_tasks.append(
+                        asyncio.get_event_loop().create_task(
+                            self._reconnect_monitor(peer_ref)
+                        )
+                    )
+                except RuntimeError:  # no loop (sync construction in tests)
+                    pass
         return client
+
+    async def _reconnect_monitor(self, peer_ref: str) -> None:
+        """Watch one owner peer's connection state: every reconnect marks
+        that owner's BLOCK-FED subs for a (batched) re-read — a block or
+        fallback fence lost with the dead link must not strand a key on a
+        stale value forever. Terminated peers are the repin machinery's."""
+        try:
+            peer = self.rpc_hub.client_peer(peer_ref)
+            ev = peer.connection_state.latest()
+            while not self._closed:
+                ev = await ev.when(lambda s: s.is_connected or s.is_terminated)
+                if ev.value.is_terminated or self._closed:
+                    return
+                ev = await ev.when(lambda s: not s.is_connected)
+                if ev.value.is_terminated or self._closed:
+                    return
+                # the link dropped; when it returns, re-read block-fed keys
+                ev = await ev.when(lambda s: s.is_connected or s.is_terminated)
+                if ev.value.is_terminated or self._closed:
+                    return
+                for sub in self._subs.values():
+                    if sub.block_mode and sub.peer_ref == peer_ref:
+                        sub.needs_reread = True
+                        self.reconnect_rereads += 1
+                        sub._wake.set()
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — a monitor must never die silently
+            log.exception(
+                "edge %s: reconnect monitor for %s failed", self.name, peer_ref
+            )
 
     # ------------------------------------------------------------------ attach
     def attach(
@@ -530,7 +806,8 @@ class EdgeNode:
         sub = self._subs.get(key_str)
         if sub is None:
             sub = self._subs[key_str] = _KeySub(
-                key_str, method, args, n_shards=self.fan_workers
+                key_str, method, args, n_shards=self.fan_workers,
+                backoff=self.error_backoff,
             )
             sub.task = asyncio.get_event_loop().create_task(self._watch(sub))
         return sub
@@ -749,8 +1026,9 @@ class EdgeNode:
 
     def _teardown_sub(self, sub: _KeySub) -> None:
         sub.closed = True
-        sub._repin.set()  # unblock a parked watch loop so it exits
+        sub._wake.set()  # unblock a parked watch loop so it exits
         self._subs.pop(sub.key_str, None)
+        self._drop_block_state(sub)
         # the serialize-once cache entry dies with the sub (this is the
         # eviction path the parked-session sweep drives: last parked ref
         # expires -> sub tears down -> cached bytes are released)
@@ -760,99 +1038,144 @@ class EdgeNode:
 
     # ------------------------------------------------------------------ upstream
     async def _watch(self, sub: _KeySub) -> None:
-        """The key's single upstream loop: capture (one compute call = one
-        ``$sys-c`` subscription at the key's owner) → fan the value →
-        await the fence (or a shard-move re-pin) → re-capture. Latest-wins
-        upstream too: fences that land during a re-read collapse into the
-        next capture."""
+        """The key's single upstream loop, now a three-rung value plane
+        (ISSUE 11). Serve order per cycle:
+
+        1. **repin** — the key's owner moved: drop local + block state,
+           re-read at the new owner (batched);
+        2. **value block** — a publish-on-wave entry is pending: fan it
+           directly, ZERO upstream RPCs (the local node retires — the
+           block stream is the subscription's truth now);
+        3. **re-read** — the node fenced / a fallback fence or eviction
+           marked the key: ONE ``recompute_batch`` entry shared with every
+           other key this burst fenced (per-key capture only as the
+           counted fallback rung).
+
+        Latest-wins at every rung: fences landing mid-read collapse into
+        the next cycle; a newer block entry replaces an unserved one.
+        Errors and shard-move transients re-arm with per-sub exponential
+        backoff (capped, counted) — a flapping upstream key cannot
+        hot-spin the node's watch loops."""
         pending_cause: Optional[str] = None
         pending_t0: Optional[float] = None
-        backoff = self.error_backoff
         try:
             while not sub.closed and not self._closed:
-                owner = self._owner_of(sub.method, sub.args)
-                client = self._client_for(owner)
-                err: Optional[str] = None
-                node = None
-                try:
-                    node = await capture(
-                        lambda: getattr(client, sub.method)(*sub.args)
-                    )
-                except asyncio.CancelledError:
-                    raise
-                except Exception as e:  # noqa: BLE001 — routing/link failures
-                    if _is_shard_moved(e):
+                # ---- rung 0: owner moved (repin precedes everything —
+                # a pending block entry from the OLD owner dies with it)
+                if sub.repin_cause is not None:
+                    repin_cause, sub.repin_cause = sub.repin_cause, None
+                    node = sub.node
+                    if (
+                        node is not None
+                        and not node.is_invalidated
+                        and sub.block_pending is None
+                        and not sub.needs_reread
+                        and sub.peer_ref == self._owner_of(sub.method, sub.args)
+                    ):
+                        pass  # already pinned at the new owner: absorb
+                    else:
+                        pending_cause = repin_cause
+                        self.resubscribes += 1
+                        self._drop_block_state(sub, reshard=True)
+                        self._retire_node(sub)
+                        sub.needs_reread = True
+                # ---- rung 1: publish-on-wave value block (zero RPCs)
+                entry = sub.block_pending
+                if entry is not None:
+                    _seq, version, value, cause, t0 = entry
+                    sub.block_pending = None
+                    self._block_pending_bytes -= sub.block_size
+                    sub.block_size = 0
+                    sub.upstream_version = version
+                    self.upstream_fences += 1
+                    self.block_hits += 1
+                    # the value plane owns this key now: the local node is
+                    # a stale shadow — retire it (once) so nothing on this
+                    # edge's graph can read the superseded value
+                    self._retire_node(sub)
+                    self._fan(sub, value, cause, t0, None, src="wave block")
+                    pending_cause = pending_t0 = None
+                # ---- rung 2: upstream (re)read — batched, per-key fallback
+                elif (
+                    sub.needs_reread
+                    or sub.node is None
+                    or sub.node.is_invalidated
+                ):
+                    if sub.pending_fence is not None:
+                        fence_cause, fence_t0 = sub.pending_fence
+                        sub.pending_fence = None
+                        if fence_cause is not None:
+                            pending_cause = fence_cause
+                        if fence_t0 is not None:
+                            pending_t0 = fence_t0
+                    sub.needs_reread = False
+                    node, err, src = await self._reread(sub)
+                    if sub.closed or self._closed:
+                        return
+                    if node is None and err is None:
                         # routing transient: the reshard raced our map sync
                         # and the rejection's carried map was already
                         # applied (client_function note_moved) — retry at
                         # the new owner without fanning a phantom error
-                        # frame to every session (resubscribes is counted
-                        # by the fence/repin paths, never here: this IS
-                        # one of those re-pins, mid-flight)
-                        await asyncio.sleep(self.error_backoff)
+                        # frame to every session
+                        sub.needs_reread = True
+                        await self._backoff_sleep(sub)
                         continue
-                    err = f"{type(e).__name__}: {e}"
-                if node is not None:
+                    if err is not None:
+                        self.upstream_errors += 1
+                        self._fan(sub, None, pending_cause, pending_t0, err, src=src)
+                        pending_cause = pending_t0 = None
+                        sub.needs_reread = True
+                        await self._backoff_sleep(sub)
+                        continue
+                    sub.backoff = self.error_backoff  # healthy: reset
                     out = node._output
-                    if out is not None and out.has_error:
-                        err = f"{type(out.error).__name__}: {out.error}"
-                sub.peer_ref = owner
-                if err is not None:
-                    self.upstream_errors += 1
-                    self._fan(sub, None, pending_cause, pending_t0, err)
+                    self._fan(
+                        sub, out.value if out is not None else None,
+                        pending_cause, pending_t0, None, src=src,
+                    )
                     pending_cause = pending_t0 = None
-                    await asyncio.sleep(backoff)
-                    backoff = min(1.0, backoff * 2)
-                    continue
-                backoff = self.error_backoff
-                self._fan(
-                    sub, out.value if out is not None else None,
-                    pending_cause, pending_t0, None,
-                )
-                pending_cause = pending_t0 = None
-                # wait for the fence OR a shard-move re-pin, whichever
-                # first; spurious re-pins (the gossip arriving AFTER the
-                # owner's own reshard fence already re-pinned us) are
-                # absorbed here, never as a duplicate re-read + re-fan
+                # ---- wait for the next fence / block / repin
                 while True:
-                    sub._repin.clear()
-                    if sub.repin_cause is None and not node.is_invalidated:
-                        if self.router is None:
-                            # no router ⇒ nothing ever calls repin(): wait
-                            # on the fence alone — the repin side-task +
-                            # asyncio.wait pair is measurable per-cycle
-                            # overhead across a 512-key fence storm
-                            # (teardown/close cancel this task directly)
+                    sub._wake.clear()
+                    node = sub.node
+                    if (
+                        sub.repin_cause is None
+                        and sub.block_pending is None
+                        and not sub.needs_reread
+                        and (node is None or not node.is_invalidated)
+                    ):
+                        if node is None:
+                            # block-fed: the wake event is the only signal
+                            await sub._wake.wait()
+                        elif self.router is None and not sub.block_mode:
+                            # plain single-server sub: nothing ever calls
+                            # repin()/wake — wait on the fence alone (the
+                            # side-task pair is measurable per-cycle
+                            # overhead across a 512-key fence storm)
                             await node.when_invalidated()
                         else:
                             inval = node.when_invalidated()
-                            repin_task = asyncio.get_event_loop().create_task(
-                                sub._repin.wait()
+                            wake_task = asyncio.get_event_loop().create_task(
+                                sub._wake.wait()
                             )
                             try:
                                 await asyncio.wait(
-                                    {inval, repin_task},
+                                    {inval, wake_task},
                                     return_when=asyncio.FIRST_COMPLETED,
                                 )
                             finally:
-                                repin_task.cancel()
+                                wake_task.cancel()
                     if sub.closed or self._closed:
                         return
-                    if sub.repin_cause is not None:
-                        repin_cause, sub.repin_cause = sub.repin_cause, None
-                        if not node.is_invalidated and sub.peer_ref == self._owner_of(
-                            sub.method, sub.args
-                        ):
-                            continue  # already pinned at the new owner: absorb
-                        # the owner moved: drop the old subscription locally
-                        # (its server end dies with the owner's own reshard
-                        # fence) and re-capture at the new owner
-                        pending_cause = repin_cause
-                        self.resubscribes += 1
-                        if not node.is_invalidated:
-                            node.invalidate(immediately=True)
-                        break
-                    if node.is_invalidated:
+                    if (
+                        sub.repin_cause is not None
+                        or sub.block_pending is not None
+                        or sub.needs_reread
+                    ):
+                        break  # the serve rungs above decide
+                    node = sub.node
+                    if node is not None and node.is_invalidated:
                         self.upstream_fences += 1
                         pending_cause = node.invalidation_cause
                         pending_t0 = node.invalidation_origin_ts
@@ -870,6 +1193,221 @@ class EdgeNode:
         except Exception:  # noqa: BLE001 — a watch loop must never die silently
             log.exception("edge %s: watch loop for %s failed", self.name, sub.key_str)
 
+    async def _reread(self, sub: _KeySub):
+        """One upstream read: the batched rung when enabled (ONE
+        ``recompute_batch`` frame per owner per burst window), the per-key
+        capture as the counted fallback. Returns ``(node, err, src)`` —
+        ``(None, None, _)`` is the shard-moved transient (caller re-arms
+        with backoff). A healthy result also (re)arms publish mode from
+        the server's echo."""
+        owner = self._owner_of(sub.method, sub.args)
+        src = "batched re-read"
+        node = None
+        if self.reread_batch:
+            try:
+                node = await self._batcher.submit(owner, sub)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — entry-level failure
+                if _is_shard_moved(e):
+                    return None, None, src
+                self.reread_fallbacks += 1
+                node = None
+        if node is None:
+            src = "per-key re-read"
+            client = self._client_for(owner)
+            self.per_key_rereads += 1
+            self.upstream_rpcs += 1
+            try:
+                node = await capture(
+                    lambda: getattr(client, sub.method)(*sub.args)
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — routing/link failures
+                if _is_shard_moved(e):
+                    return None, None, src
+                return None, f"{type(e).__name__}: {e}", src
+        if sub.node is not None and sub.node is not node:
+            # a still-live node superseded by this re-read (reconnect
+            # re-read, block budget eviction, undecodable entry): retire
+            # it so its outbound call never leaks in peer.outbound_calls
+            self._retire_node(sub)
+        sub.peer_ref = owner
+        sub.node = node
+        sub.upstream_version = node.version.format()
+        self._arm_block_mode(sub, node)
+        out = node._output
+        if out is not None and out.has_error:
+            return None, f"{type(out.error).__name__}: {out.error}", src
+        return node, None, src
+
+    async def _backoff_sleep(self, sub: _KeySub) -> None:
+        """Per-sub exponential backoff with a cap and a counter: the
+        re-read error/transient paths re-arm through here, so a flapping
+        upstream key costs one bounded retry cadence, never a hot spin
+        across every watch loop (ISSUE 11 satellite)."""
+        delay = sub.backoff
+        sub.backoff = min(self.error_backoff_max, delay * 2)
+        self.upstream_backoffs += 1
+        await asyncio.sleep(delay)
+
+    def _retire_node(self, sub: _KeySub) -> None:
+        """Retire the sub's local ClientComputed (block mode took over, or
+        a repin dropped the old owner's subscription): invalidating it
+        unregisters the outbound call and keeps this edge's own computed
+        graph honest — the value plane, not the node, carries the truth."""
+        node, sub.node = sub.node, None
+        if node is not None and not node.is_invalidated:
+            node.invalidate(immediately=True)
+
+    def _arm_block_mode(self, sub: _KeySub, node) -> None:
+        """Adopt the server's publish echo for this sub's NEW upstream
+        call: block frames and fallback fences address subscriptions by
+        call id, so the routing entry follows the live call exactly."""
+        old_cid = sub.block_call_id
+        if old_cid is not None and self._block_calls.get(old_cid) is sub:
+            self._block_calls.pop(old_cid, None)
+        sub.block_call_id = None
+        call = getattr(node, "call", None)
+        armed = (
+            self.value_blocks
+            and call is not None
+            and getattr(call, "publish_armed", False)
+        )
+        sub.block_mode = bool(armed)
+        if armed:
+            sub.block_call_id = call.call_id
+            self._block_calls[call.call_id] = sub
+            # the seq gate's scope is ONE call's block stream (frames are
+            # routed by call id; a late frame for the old call is an
+            # orphan): a new owner's publisher counts from its own epoch,
+            # so carrying the old high-water mark would drop every fresh
+            # entry as stale — silently-stale forever, since the server
+            # diverted the plain fence into the block
+            sub.block_seq = 0
+
+    def _drop_block_state(self, sub: _KeySub, reshard: bool = False) -> None:
+        """Clear a sub's value-plane state (teardown / repin): the pending
+        entry — minted under the OLD owner — is invalidated, and the call
+        routing entry dies so a late block for it counts as an orphan."""
+        if sub.block_pending is not None:
+            sub.block_pending = None
+            self._block_pending_bytes -= sub.block_size
+            sub.block_size = 0
+            if reshard:
+                self.block_reshard_drops += 1
+        cid = sub.block_call_id
+        if cid is not None and self._block_calls.get(cid) is sub:
+            self._block_calls.pop(cid, None)
+        sub.block_call_id = None
+        sub.block_mode = False
+
+    # ------------------------------------------------------------------ value plane
+    def on_value_block(self, peer, message) -> None:
+        """Inbound ``$sys-c.value_block`` frame (the publish-on-wave push,
+        ISSUE 11 level 2): columnar ``(call_id, version, seq, cause, t0,
+        offset)`` over one shared payload blob. Each entry is gated by the
+        per-sub monotonic seq (a stale/duplicate entry is dropped,
+        counted), budgeted (an entry over ``block_budget_bytes`` falls
+        back to the batched re-read, counted), decoded ONCE, and parked
+        latest-wins for the key's watch loop."""
+        from ..diagnostics.clocksync import global_clock_sync
+        from ..utils.serialization import loads as wire_loads
+
+        try:
+            cids, vers, seqs, causes, t0s, offsets, payload = wire_loads(
+                message.argument_data
+            )
+        except Exception:  # noqa: BLE001 — a malformed frame must not kill
+            # the receive pump; the keys heal through their fence fallbacks
+            log.exception("edge %s: bad value_block frame", self.name)
+            return
+        sync = global_clock_sync()
+        peer_ref = getattr(peer, "ref", None)
+        for i, cid in enumerate(cids):
+            sub = self._block_calls.get(cid)
+            if sub is None or sub.closed or not sub.block_mode:
+                self.block_orphans += 1
+                continue
+            seq = int(seqs[i])
+            if seq <= sub.block_seq:
+                self.block_stale += 1
+                continue
+            raw = payload[offsets[i]: offsets[i + 1]]
+            size = len(raw)
+            if (
+                self._block_pending_bytes - sub.block_size + size
+                > self.block_budget_bytes
+            ):
+                # over budget: drop the entry AND any unserved older one
+                # (latest-wins — fanning the superseded value before the
+                # corrective re-read would hand every session stale
+                # data), fall back to the batched re-read — counted, and
+                # the fence is never lost
+                self.block_evictions += 1
+                if sub.block_pending is not None:
+                    sub.block_pending = None
+                    self._block_pending_bytes -= sub.block_size
+                    sub.block_size = 0
+                sub.block_seq = seq
+                sub.needs_reread = True
+                sub.pending_fence = (
+                    causes[i],
+                    sync.to_local(peer_ref, t0s[i]) if t0s[i] is not None else None,
+                )
+                sub._wake.set()
+                continue
+            try:
+                value = wire_loads(raw)
+            except Exception:  # noqa: BLE001 — undecodable entry: re-read
+                log.exception(
+                    "edge %s: undecodable value_block entry for %s",
+                    self.name, sub.key_str,
+                )
+                sub.block_seq = seq
+                sub.needs_reread = True
+                sub._wake.set()
+                continue
+            t0 = sync.to_local(peer_ref, t0s[i]) if t0s[i] is not None else None
+            self.block_entries += 1
+            self._block_pending_bytes += size - sub.block_size
+            sub.block_size = size
+            sub.block_seq = seq
+            # latest-wins: an unserved older entry is superseded (those
+            # sessions could never have seen it)
+            if sub.block_pending is not None:
+                self.coalesced_frames += 1
+            sub.block_pending = (seq, vers[i], value, causes[i], t0)
+            sub._wake.set()
+
+    def on_block_fence(
+        self, peer, call_id: int, cause: Optional[str], origin_ts: Optional[float],
+    ) -> None:
+        """A plain invalidation addressed to a RETIRED publish-mode call
+        (the publisher's fallback ladder: recompute error, reshard,
+        overflow, dead-link block). The key leaves block mode and
+        re-reads — batched — carrying the fence's cause and timestamp."""
+        sub = self._block_calls.get(call_id)
+        if sub is None or sub.closed:
+            return
+        from ..diagnostics.clocksync import global_clock_sync
+
+        self.block_fences += 1
+        self.upstream_fences += 1
+        t0 = (
+            global_clock_sync().to_local(getattr(peer, "ref", None), origin_ts)
+            if origin_ts is not None
+            else None
+        )
+        sub.pending_fence = (cause, t0)
+        sub.block_mode = False  # the server dropped the standing sub;
+        # the re-read's publish echo re-arms it
+        sub.needs_reread = True
+        if cause is not None and cause.startswith("reshard:"):
+            self.resubscribes += 1
+        sub._wake.set()
+
     def _fan(
         self,
         sub: _KeySub,
@@ -877,13 +1415,16 @@ class EdgeNode:
         cause: Optional[str],
         origin_ts: Optional[float],
         err: Optional[str],
+        src: Optional[str] = None,
     ) -> None:
         """Fan one upstream frame: serialize the wire payload ONCE (the
         version-keyed encode cache), hand the shared bytes to the
         delivery-plane broadcasts (worker pool), and post one entry per
         fan shard — the shard workers walk their session partitions
         concurrently instead of this watch loop walking every session
-        sequentially (ISSUE 10a+b)."""
+        sequentially (ISSUE 10a+b). ``src`` names the value-plane rung
+        that produced the value (recorder detail → explain())."""
+        sub.last_src = src
         sub.version += 1
         frame: Frame = (sub.key_str, sub.version, value, cause, origin_ts, err)
         sub.last_frame = frame
@@ -986,6 +1527,11 @@ class EdgeNode:
                 detail=(
                     f"edge={self.name} v{frame[1]} shard={shard.index} "
                     f"owner={sub.peer_ref}"
+                    + (
+                        f" value served from {sub.last_src}"
+                        if sub.last_src is not None
+                        else ""
+                    )
                 ),
             )
 
@@ -1051,12 +1597,22 @@ class EdgeNode:
                 await pool.stop()
             except Exception:  # noqa: BLE001 — teardown must not bubble
                 log.exception("edge %s: worker pool stop failed", self.name)
+        if self.rpc_hub is not None and getattr(
+            self.rpc_hub, "value_plane_client", None
+        ) is self:
+            self.rpc_hub.value_plane_client = None
+        self._batcher.cancel_all()
+        for task in self._monitor_tasks:
+            if not task.done():
+                task.cancel()
+        self._monitor_tasks.clear()
+        self._block_calls.clear()
         subs = list(self._subs.values())
         self._subs.clear()
         self._encoded.clear()
         for sub in subs:
             sub.closed = True
-            sub._repin.set()
+            sub._wake.set()
             if sub.task is not None and not sub.task.done():
                 sub.task.cancel()
         for sub in subs:

@@ -18,18 +18,23 @@ state. The split rides the serialize-once contract end to end:
 - deliveries/s therefore scales with worker processes (measured in
   perf/edge_path.py; the bench records ``deliveries_per_s_per_worker``).
 
-**Socket ownership: SO_REUSEPORT, not send_fds.** Each worker binds the
-SAME (host, port) with ``SO_REUSEPORT`` and the kernel load-balances
-accepted connections across workers — no accept loop in the parent, no
-fd passing, workers are symmetric and independently restartable. The
-tradeoff vs a parent accept loop + ``socket.send_fds`` handoff: the
-kernel's balance is per-connection-hash (no app-level placement), and a
-RECONNECT may land on a different worker, so resume tokens are
-worker-local — a resume that misses falls back to a fresh attach (the
-protocol already defines that fallback). ``send_fds`` would preserve
-parent-controlled placement at the cost of a single-process accept
-bottleneck and a parent that must outlive every handoff. EDGE.md
-documents the choice.
+**Socket ownership: a ``send_fds`` accept plane (ISSUE 11), REUSEPORT
+as the fallback knob.** PR 10 shipped per-worker ``SO_REUSEPORT``
+listeners — symmetric workers, no parent accept loop — at the cost of
+kernel-hash placement: a RECONNECT could land on a different worker, so
+resume tokens were worker-local. The default accept plane now closes
+that tradeoff: the PARENT owns one listening socket, reads just the
+request head off each accepted connection, routes by the resume token's
+worker ordinal (``es-w<N>-…``, from the ``Last-Event-ID`` header or the
+``resume=`` query param; tokenless connections round-robin), and hands
+the fd to that worker over a dedicated ``socket.send_fds`` channel
+along with the already-read head bytes. A resume token is therefore
+valid on ANY connection — the parent delivers it to the worker that
+parked it, which replays only the versions the session missed.
+``accept_plane="reuseport"`` keeps the PR 10 shape (symmetric
+independently-restartable workers, no parent accept hop) for
+deployments that prefer it; its resume misses still fall back to a
+fresh attach. EDGE.md documents both planes' capacity math.
 
 Wire protocol (parent <-> worker, framed ``!BI`` type+length):
 
@@ -38,9 +43,13 @@ Wire protocol (parent <-> worker, framed ``!BI`` type+length):
     S {sessions}       add sim sessions
     F key_id ver t0 body  one encoded frame
     L {host, port}     start SSE listener  P {port}   actual bound port
+    G {heartbeat, resume_ttl}  SSE config (send_fds plane: no bind)
     Q {seq}            stats request       R {...}    stats reply
     X                  shutdown            U {conn, keys}  SSE subscribe
                                            D {conn, key_ids} SSE closed
+
+    (fd channel, send_fds plane only: one sendmsg per accepted conn —
+     ``!I``-framed JSON {head: b64} + the connection fd as ancillary)
 
 Workers are spawned as ``python <this file> --worker`` subprocesses so
 they import NOTHING beyond the standard library — no jax, no package
@@ -107,15 +116,22 @@ class _Worker:
     """Parent-side handle to one delivery worker process."""
 
     __slots__ = (
-        "index", "proc", "sock", "reader", "writer", "reader_task",
-        "interest", "sim_keys", "conn_refs", "stats_futures", "port_future",
-        "last_stats", "last_hist", "sim_sessions", "outbuf",
+        "index", "proc", "sock", "fd_sock", "fd_lock", "reader", "writer",
+        "reader_task", "interest", "sim_keys", "conn_refs", "stats_futures",
+        "port_future", "last_stats", "last_hist", "sim_sessions", "outbuf",
     )
 
     def __init__(self, index: int):
         self.index = index
         self.proc = None
         self.sock: Optional[socket.socket] = None
+        #: the send_fds channel: accepted-connection fds ride here (one
+        #: sendmsg per conn), never the framed control stream above.
+        #: NON-blocking + lock-serialized: a wedged worker must cost
+        #: dropped handoffs, never a frozen parent event loop, and two
+        #: concurrent handoffs must never interleave a partial frame
+        self.fd_sock: Optional[socket.socket] = None
+        self.fd_lock: Optional[asyncio.Lock] = None
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
         self.reader_task: Optional[asyncio.Task] = None
@@ -172,12 +188,26 @@ class EdgeWorkerPool:
     """
 
     def __init__(self, node, workers: int = 2, stats_timeout: float = 10.0,
-                 flush_interval: float = 0.02):
+                 flush_interval: float = 0.02, accept_plane: str = "send_fds",
+                 resume_ttl: float = 60.0):
         if workers < 1:
             raise ValueError("worker pool needs at least 1 worker")
+        if accept_plane not in ("send_fds", "reuseport"):
+            raise ValueError(
+                f"accept_plane must be 'send_fds' or 'reuseport', "
+                f"got {accept_plane!r}"
+            )
         self.node = node
         self.n_workers = workers
         self.stats_timeout = stats_timeout
+        #: "send_fds" (default): the parent accepts, routes by resume
+        #: token, and hands each fd to the owning worker — portable resume
+        #: tokens (ISSUE 11). "reuseport": per-worker SO_REUSEPORT
+        #: listeners, kernel-hash placement, worker-local tokens (PR 10).
+        self.accept_plane = accept_plane
+        #: how long a worker parks a disconnected SSE session's delivered-
+        #: version map under its token (the resume replay source)
+        self.resume_ttl = resume_ttl
         #: frame-pipe flush window. Every write to a worker pipe WAKES the
         #: worker process, and on a saturated box the sender-preemption
         #: ping-pong (one wake per fanned frame per worker) measurably
@@ -198,6 +228,14 @@ class EdgeWorkerPool:
         self._started = False
         self._flush_scheduled = False
         self.listen_port: Optional[int] = None
+        #: the send_fds plane's parent listener + accept machinery
+        self._listen_sock: Optional[socket.socket] = None
+        self._accept_task: Optional[asyncio.Task] = None
+        self._route_tasks: set = set()
+        self._accept_rr = 0
+        self.routed_conns = 0  # fds handed to workers
+        self.routed_by_token = 0  # of which: placed by a resume token
+        self.route_errors = 0
         #: cumulative deliveries last pulled from workers (sync-readable
         #: by the node's metrics collector)
         self.deliveries_seen = 0
@@ -213,16 +251,23 @@ class EdgeWorkerPool:
             w = _Worker(i)
             parent_sock, child_sock = socket.socketpair()
             parent_sock.setblocking(False)
+            # the fd-handoff channel (send_fds accept plane) — created
+            # unconditionally so the plane can be chosen at listen() time
+            parent_fd_sock, child_fd_sock = socket.socketpair()
+            parent_fd_sock.setblocking(False)
+            w.fd_lock = asyncio.Lock()
             import subprocess
 
             w.proc = subprocess.Popen(
                 [sys.executable, script, "--worker", str(i),
-                 str(child_sock.fileno())],
-                pass_fds=(child_sock.fileno(),),
+                 str(child_sock.fileno()), str(child_fd_sock.fileno())],
+                pass_fds=(child_sock.fileno(), child_fd_sock.fileno()),
                 close_fds=True,
             )
             child_sock.close()
+            child_fd_sock.close()
             w.sock = parent_sock
+            w.fd_sock = parent_fd_sock
             w.reader, w.writer = await asyncio.open_connection(sock=parent_sock)
             w.reader_task = loop.create_task(self._read_worker(w))
             self._workers.append(w)
@@ -238,6 +283,17 @@ class EdgeWorkerPool:
         self.node.detach_broadcast(self._on_frame)
         if self.node.worker_pool is self:
             self.node.worker_pool = None
+        if self._accept_task is not None:
+            self._accept_task.cancel()
+            self._accept_task = None
+        for task in list(self._route_tasks):
+            task.cancel()
+        if self._listen_sock is not None:
+            try:
+                self._listen_sock.close()
+            except OSError:
+                pass
+            self._listen_sock = None
         for w in self._workers:
             try:
                 w.send(b"X", b"")
@@ -272,6 +328,12 @@ class EdgeWorkerPool:
                         log.exception(
                             "edge worker %d did not exit after kill", w.index
                         )
+        for w in self._workers:
+            if w.fd_sock is not None:
+                try:
+                    w.fd_sock.close()
+                except OSError:
+                    pass
         # release every key real connections + sim sessions still held
         for (_wi, _conn), (key_strs, _kids) in list(self._conn_keys.items()):
             self.node.release_keys(key_strs)
@@ -341,24 +403,209 @@ class EdgeWorkerPool:
     # -------------------------------------------------------------- real SSE
     async def listen(self, host: str = "127.0.0.1", port: int = 0,
                      heartbeat_interval: float = 15.0) -> int:
-        """Start the SO_REUSEPORT SSE listener on every worker. With
-        ``port=0`` worker 0 binds an ephemeral port and the others join
-        it. Returns the bound port."""
+        """Start the SSE surface on the configured accept plane.
+
+        ``send_fds`` (default): the PARENT binds one listener, reads each
+        accepted connection's request head, routes by the resume token's
+        worker ordinal (tokenless conns round-robin) and hands the fd to
+        that worker — resume tokens are portable across the whole pool.
+        ``reuseport``: every worker binds the same (host, port) with
+        SO_REUSEPORT and the kernel places connections (PR 10's shape).
+        Returns the bound port."""
         loop = asyncio.get_event_loop()
+        if self.accept_plane == "send_fds":
+            for w in self._workers:
+                w.send_json(b"G", {"heartbeat": heartbeat_interval,
+                                   "resume_ttl": self.resume_ttl})
+            self._flush_all()
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((host, port))
+            sock.listen(256)
+            sock.setblocking(False)
+            self._listen_sock = sock
+            self._accept_task = loop.create_task(self._accept_loop(sock))
+            self.listen_port = sock.getsockname()[1]
+            return self.listen_port
         first = self._workers[0]
         first.port_future = loop.create_future()
         first.send_json(b"L", {"host": host, "port": port,
-                               "heartbeat": heartbeat_interval})
+                               "heartbeat": heartbeat_interval,
+                               "resume_ttl": self.resume_ttl})
         self._flush_all()
         bound = await asyncio.wait_for(first.port_future, self.stats_timeout)
         for w in self._workers[1:]:
             w.port_future = loop.create_future()
             w.send_json(b"L", {"host": host, "port": bound,
-                               "heartbeat": heartbeat_interval})
+                               "heartbeat": heartbeat_interval,
+                               "resume_ttl": self.resume_ttl})
             self._flush_all()
             await asyncio.wait_for(w.port_future, self.stats_timeout)
         self.listen_port = bound
         return bound
+
+    async def _accept_loop(self, sock: socket.socket) -> None:
+        """The send_fds plane's parent accept loop: accept, then route
+        each connection in its own task — a slow client reading its head
+        never delays the next accept."""
+        loop = asyncio.get_event_loop()
+        try:
+            while True:
+                try:
+                    conn, _addr = await loop.sock_accept(sock)
+                except OSError:
+                    return  # listener closed
+                task = loop.create_task(self._route_conn(conn))
+                self._route_tasks.add(task)
+                task.add_done_callback(self._route_tasks.discard)
+        except asyncio.CancelledError:
+            raise
+
+    async def _route_conn(self, conn: socket.socket) -> None:
+        """Read one accepted connection's request head (bounded), pick
+        the worker — the resume token's minted ordinal when present, else
+        round-robin — and hand the fd + head over ``socket.send_fds``.
+        The worker receives a DUPLICATE fd; the parent's copy closes
+        either way, so a handoff failure costs the client one reconnect,
+        never a leaked socket."""
+        loop = asyncio.get_event_loop()
+        try:
+            conn.setblocking(False)
+            head = b""
+            # 64 KB cap = the reuseport path's StreamReader limit: a key
+            # list that fits max_keys_per_session in the URL must route
+            # the same on both planes
+            while b"\r\n\r\n" not in head and len(head) < 65536:
+                chunk = await asyncio.wait_for(loop.sock_recv(conn, 8192), 10.0)
+                if not chunk:
+                    return
+                head += chunk
+            if b"\r\n\r\n" not in head:
+                self.route_errors += 1  # oversized/garbage head: drop, counted
+                return
+            index, by_token = self._route_index(head)
+            w = self._workers[index]
+            if w.fd_sock is None:
+                # the owner's fd channel died (torn handoff): fail over
+                # to any live sibling — the resume token misses there and
+                # the session fresh-attaches, the documented fallback
+                by_token = False
+                for offset in range(1, self.n_workers):
+                    sibling = self._workers[(index + offset) % self.n_workers]
+                    if sibling.fd_sock is not None:
+                        w = sibling
+                        break
+                else:
+                    self.route_errors += 1
+                    return
+            payload = json.dumps(
+                {"head": base64.b64encode(head).decode()}
+            ).encode()
+            framed = struct.pack("!I", len(payload)) + payload
+            await self._send_handoff(w, framed, conn.fileno())
+            self.routed_conns += 1
+            if by_token:
+                self.routed_by_token += 1
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            pass
+        except Exception:  # noqa: BLE001 — one conn must not kill the plane
+            self.route_errors += 1
+            log.exception("edge accept plane: routing a connection failed")
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    async def _send_handoff(self, w: _Worker, framed: bytes, fd: int,
+                            timeout: float = 10.0) -> None:
+        """One fd handoff over the NON-blocking channel: per-worker
+        lock-serialized (a partially-sent frame must never interleave
+        with a sibling's), waiting out transient backpressure and giving
+        up — counted by the caller's error path — after ``timeout``
+        rather than ever blocking the parent's event loop on a wedged
+        worker."""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        async with w.fd_lock:
+            while True:
+                try:
+                    sent = socket.send_fds(w.fd_sock, [framed], [fd])
+                    break
+                except (BlockingIOError, InterruptedError):
+                    if loop.time() > deadline:
+                        raise TimeoutError(
+                            f"worker {w.index} fd channel backpressured"
+                        )
+                    await self._wait_writable(w.fd_sock, 0.25)
+            if sent < len(framed):
+                # the fd rode the first sendmsg's ancillary data; finish
+                # the frame bytes (still under the lock). A MID-FRAME
+                # failure leaves a torn length-prefixed frame on the wire
+                # — every later handoff would desync and mispair fds — so
+                # the channel dies with it: routing fails over to live
+                # siblings (counted; a token miss is a fresh attach).
+                try:
+                    await asyncio.wait_for(
+                        loop.sock_sendall(w.fd_sock, framed[sent:]),
+                        max(0.1, deadline - loop.time()),
+                    )
+                except BaseException:
+                    sock, w.fd_sock = w.fd_sock, None
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    raise
+
+    @staticmethod
+    async def _wait_writable(sock: socket.socket, timeout: float) -> None:
+        loop = asyncio.get_event_loop()
+        future = loop.create_future()
+        fd = sock.fileno()
+
+        def _on_writable() -> None:
+            if not future.done():
+                future.set_result(None)
+
+        loop.add_writer(fd, _on_writable)
+        try:
+            await asyncio.wait_for(future, timeout)
+        except (asyncio.TimeoutError, TimeoutError):
+            pass  # the caller's deadline decides when to give up
+        finally:
+            loop.remove_writer(fd)
+
+    def _route_index(self, head: bytes):
+        """(worker index, routed-by-token) for one request head. The
+        token's ``es-w<N>-`` prefix names the worker that minted (and
+        parked) it; anything else round-robins."""
+        token = None
+        request_line, _, rest = head.partition(b"\r\n")
+        parts = request_line.decode("latin-1", "replace").split(" ")
+        if len(parts) >= 2:
+            _path, _, query = parts[1].partition("?")
+            for pair in query.split("&"):
+                k, _, v = pair.partition("=")
+                if k == "resume" and v:
+                    from urllib.parse import unquote
+
+                    token = unquote(v)
+                    break
+        if token is None:
+            for line in rest.split(b"\r\n"):
+                if line.lower().startswith(b"last-event-id:"):
+                    token = line.split(b":", 1)[1].strip().decode("latin-1")
+                    break
+        if token is not None and token.startswith("es-w"):
+            ordinal, _, _tail = token[4:].partition("-")
+            if ordinal.isdigit():
+                index = int(ordinal)
+                if index < self.n_workers:
+                    return index, True
+        index = self._accept_rr % self.n_workers
+        self._accept_rr += 1
+        return index, False
 
     # -------------------------------------------------------------- frames
     def _on_frame(self, key_str: str, frame, encoded) -> None:
@@ -427,6 +674,10 @@ class EdgeWorkerPool:
         return {
             "workers": self.n_workers,
             "listen_port": self.listen_port,
+            "accept_plane": self.accept_plane,
+            "routed_conns": self.routed_conns,
+            "routed_by_token": self.routed_by_token,
+            "route_errors": self.route_errors,
             "deliveries": self.deliveries_seen,
             "per_worker": [w.last_stats for w in self._workers],
         }
@@ -560,11 +811,19 @@ class _WorkerMain:
     """One delivery worker: control-channel loop + local session tables +
     (optionally) the SO_REUSEPORT SSE listener."""
 
-    def __init__(self, index: int, fd: int):
+    def __init__(self, index: int, fd: int, fd_channel: Optional[int] = None):
         self.index = index
         sock = socket.socket(fileno=fd)
         sock.setblocking(False)
         self.sock = sock
+        #: the send_fds handoff channel (accepted-connection fds + their
+        #: pre-read request heads arrive here, outside the framed stream)
+        self.fd_sock: Optional[socket.socket] = None
+        if fd_channel is not None:
+            self.fd_sock = socket.socket(fileno=fd_channel)
+            self.fd_sock.setblocking(False)
+        self._fd_buf = b""
+        self._fd_pending: list = []  # fds awaiting their framed head
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
         self.keys: Dict[int, str] = {}
@@ -588,6 +847,13 @@ class _WorkerMain:
         self.connections = 0
         self.hist = _WorkerHist()
         self.heartbeat_interval = 15.0
+        self.resume_ttl = 60.0
+        #: token -> ({kid: delivered version}, deadline) — what a resumed
+        #: connection replays AGAINST (only newer versions ship). Under
+        #: the send_fds plane the parent routes a token back HERE, so the
+        #: park is reachable from any listener port.
+        self.parked: Dict[str, tuple] = {}
+        self.resumes = 0
         self.server: Optional[asyncio.AbstractServer] = None
         self._sim_minted = 0
         #: write-buffer bound per real connection: a peer that stops
@@ -603,6 +869,10 @@ class _WorkerMain:
 
     async def run(self) -> None:
         self.reader, self.writer = await asyncio.open_connection(sock=self.sock)
+        if self.fd_sock is not None:
+            asyncio.get_event_loop().add_reader(
+                self.fd_sock.fileno(), self._on_fd_readable
+            )
         try:
             while True:
                 head = await self.reader.readexactly(_HEADER.size)
@@ -622,6 +892,10 @@ class _WorkerMain:
                     self.on_subscribe_ack(json.loads(payload))
                 elif ch == "L":
                     await self.on_listen(json.loads(payload))
+                elif ch == "G":
+                    cfg = json.loads(payload)
+                    self.heartbeat_interval = float(cfg.get("heartbeat", 15.0))
+                    self.resume_ttl = float(cfg.get("resume_ttl", 60.0))
                 elif ch == "Q":
                     self.on_stats(json.loads(payload))
                 elif ch == "X":
@@ -629,8 +903,72 @@ class _WorkerMain:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass  # parent died: exit
         finally:
+            if self.fd_sock is not None:
+                try:
+                    asyncio.get_event_loop().remove_reader(self.fd_sock.fileno())
+                except (OSError, RuntimeError):
+                    pass
             if self.server is not None:
                 self.server.close()
+
+    # ---------------------------------------------------------- fd handoff
+    def _on_fd_readable(self) -> None:
+        """The send_fds accept plane's inbound side: each parent sendmsg
+        carries one ``!I``-framed {head} JSON + the connection fd as
+        ancillary data. Linux delivers ancillary data as a read barrier,
+        so fds pair with their frames FIFO even under coalesced reads."""
+        try:
+            msg, fds, _flags, _addr = socket.recv_fds(self.fd_sock, 65536, 8)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            try:
+                asyncio.get_event_loop().remove_reader(self.fd_sock.fileno())
+            except (OSError, RuntimeError):
+                pass
+            return
+        if not msg and not fds:
+            try:  # parent closed the channel
+                asyncio.get_event_loop().remove_reader(self.fd_sock.fileno())
+            except (OSError, RuntimeError):
+                pass
+            return
+        self._fd_buf += msg
+        self._fd_pending.extend(fds)
+        while len(self._fd_buf) >= 4:
+            (length,) = struct.unpack_from("!I", self._fd_buf)
+            if len(self._fd_buf) < 4 + length:
+                break
+            payload = self._fd_buf[4: 4 + length]
+            self._fd_buf = self._fd_buf[4 + length:]
+            if not self._fd_pending:
+                continue  # frame without its fd (handoff raced a close)
+            fd = self._fd_pending.pop(0)
+            try:
+                info = json.loads(payload)
+                head = base64.b64decode(info["head"])
+                conn_sock = socket.socket(fileno=fd)
+                conn_sock.setblocking(False)
+            except Exception:  # noqa: BLE001 — drop the broken handoff
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+                continue
+            asyncio.get_event_loop().create_task(
+                self._handle_handoff(conn_sock, head)
+            )
+
+    async def _handle_handoff(self, conn_sock: socket.socket, head: bytes) -> None:
+        try:
+            reader, writer = await asyncio.open_connection(sock=conn_sock)
+        except Exception:  # noqa: BLE001 — peer vanished during handoff
+            try:
+                conn_sock.close()
+            except OSError:
+                pass
+            return
+        await self._serve_conn(reader, writer, head)
 
     # ---------------------------------------------------------- sim
     def on_sim(self, req: dict) -> None:
@@ -705,6 +1043,8 @@ class _WorkerMain:
             "sim_sessions": sum(len(v) for v in self.sim.values()),
             "connections": self.connections,
             "evictions": self.evictions,
+            "resumes": self.resumes,
+            "parked": len(self.parked),
             "hist": self.hist.buckets,
             "hist_count": self.hist.count,
             "hist_sum": round(self.hist.sum, 3),
@@ -719,6 +1059,7 @@ class _WorkerMain:
             sock.bind((req.get("host", "127.0.0.1"), int(req.get("port", 0))))
             sock.listen(128)
             self.heartbeat_interval = float(req.get("heartbeat", 15.0))
+            self.resume_ttl = float(req.get("resume_ttl", 60.0))
             self.server = await asyncio.start_server(self._handle_conn, sock=sock)
             self.send_json("P", {"port": sock.getsockname()[1]})
         except Exception as e:  # noqa: BLE001 — report, don't die
@@ -741,14 +1082,35 @@ class _WorkerMain:
             fut.set_result(ack)
 
     async def _handle_conn(self, reader, writer) -> None:
-        conn_id = self.conn_seq = self.conn_seq + 1
-        self.connections += 1
-        conn = None
-        sent_u = False
+        """REUSEPORT-plane entry: read the head here, then serve. (The
+        send_fds plane arrives through ``_handle_handoff`` with the head
+        the PARENT already read off the socket.)"""
         try:
             request = await asyncio.wait_for(
                 reader.readuntil(b"\r\n\r\n"), 30.0
             )
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                ConnectionResetError, asyncio.LimitOverrunError):
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+            return
+        await self._serve_conn(reader, writer, request)
+
+    def _sweep_parked(self) -> None:
+        now = time.monotonic()
+        expired = [t for t, (_v, dl) in self.parked.items() if dl < now]
+        for t in expired:
+            self.parked.pop(t, None)
+
+    async def _serve_conn(self, reader, writer, request: bytes) -> None:
+        conn_id = self.conn_seq = self.conn_seq + 1
+        self.connections += 1
+        conn = None
+        sent_u = False
+        token = None
+        try:
             line = request.split(b"\r\n", 1)[0].decode("latin-1")
             parts = line.split(" ")
             if len(parts) < 2 or parts[0] != "GET":
@@ -760,12 +1122,26 @@ class _WorkerMain:
                 writer.write(b"HTTP/1.1 404 Not Found\r\n\r\n")
                 return
             keys_raw = ""
+            resume_token = None
             for pair in query.split("&"):
                 k, _, v = pair.partition("=")
                 if k == "keys":
                     from urllib.parse import unquote
 
                     keys_raw = unquote(v)
+                elif k == "resume" and v:
+                    from urllib.parse import unquote
+
+                    resume_token = unquote(v)
+            if resume_token is None:
+                # the browser's own reconnect handle (EventSource re-sends
+                # the original URL + this header)
+                for hline in request.split(b"\r\n")[1:]:
+                    if hline.lower().startswith(b"last-event-id:"):
+                        resume_token = (
+                            hline.split(b":", 1)[1].strip().decode("latin-1")
+                        )
+                        break
             try:
                 specs = json.loads(keys_raw) if keys_raw else []
                 assert isinstance(specs, list) and specs
@@ -774,8 +1150,25 @@ class _WorkerMain:
                     b"HTTP/1.1 400 Bad Request\r\n\r\n"
                 )
                 return
-            token = f"es-w{self.index}-c{conn_id}"
+            # resume: a token this worker parked replays only what the
+            # session missed, and the session keeps its identity. Under
+            # the send_fds plane the PARENT routed the token here, so a
+            # reconnect through any port finds its park; a miss (expired,
+            # reuseport cross-worker hash) is the documented fresh-attach
+            # fallback.
+            self._sweep_parked()
+            parked_versions: Optional[Dict[int, int]] = None
+            if resume_token is not None:
+                entry = self.parked.pop(resume_token, None)
+                if entry is not None and entry[1] >= time.monotonic():
+                    parked_versions = entry[0]
+                    token = resume_token
+                    self.resumes += 1
+            if token is None:
+                token = f"es-w{self.index}-c{conn_id}"
             conn = _SseConn(self, conn_id, token, [], writer)
+            if parked_versions:
+                conn.versions.update(parked_versions)
             self.pending_conns[conn_id] = conn
             fut = asyncio.get_event_loop().create_future()
             self.pending_subscribes[conn_id] = fut
@@ -796,17 +1189,26 @@ class _WorkerMain:
             )
             hello = json.dumps(
                 {"token": token, "keys": [k["key"] for k in ack["keys"]],
-                 "worker": self.index}
+                 "worker": self.index,
+                 "resumed": parked_versions is not None}
             )
             writer.write(
                 f"id: {token}\nevent: hello\ndata: {hello}\n\n".encode()
             )
-            replayed: Dict[int, int] = {}
+            replayed: Dict[int, int] = dict(conn.versions)
             for rep in ack.get("replay", []):
+                kid = rep["id"]
+                ver = rep.get("ver", 0)
+                if parked_versions is not None and ver <= conn.versions.get(kid, 0):
+                    # the session already saw this version before its
+                    # disconnect: latest-wins resume ships nothing
+                    replayed[kid] = max(replayed.get(kid, 0), ver)
+                    continue
                 tail = (b"event: update\ndata: "
                         + base64.b64decode(rep["body"]) + b"\n\n")
                 conn.write_frame(tail)
-                replayed[rep["id"]] = rep.get("ver", 0)
+                conn.versions[kid] = ver
+                replayed[kid] = max(replayed.get(kid, 0), ver)
                 self.deliveries += 1
             # open the stream: ship backlogged frames that raced in
             # between the ack and now, skipping versions the replay
@@ -835,6 +1237,14 @@ class _WorkerMain:
                         peers.discard(conn)
                         if not peers:
                             self.conns_by_key.pop(kid, None)
+                if token is not None:
+                    # park the delivered-version map under the token: the
+                    # resume replay source (portable across the pool under
+                    # the send_fds plane — the parent routes it back here)
+                    self.parked[token] = (
+                        dict(conn.versions),
+                        time.monotonic() + self.resume_ttl,
+                    )
             if sent_u:
                 # ALWAYS pair the U with a D once sent — even on an ack
                 # timeout where the parent may have acquired the pins
@@ -861,7 +1271,7 @@ class _WorkerMain:
 
 class _SseConn:
     __slots__ = ("worker", "conn_id", "prefix", "key_ids", "writer",
-                 "open", "backlog")
+                 "open", "backlog", "versions")
 
     def __init__(self, worker, conn_id, token, key_ids, writer):
         self.worker = worker
@@ -875,19 +1285,26 @@ class _SseConn:
         #: preamble or being dropped
         self.open = False
         self.backlog: List[tuple] = []
+        #: kid -> highest version this peer was sent — parked under the
+        #: resume token at disconnect (the resume replay gate)
+        self.versions: Dict[int, int] = {}
 
     def deliver(self, kid: int, version: int, tail: bytes) -> bool:
         if not self.open:
             self.backlog.append((kid, version, tail))
             return True
-        return self.write_frame(tail)
+        if self.write_frame(tail):
+            self.versions[kid] = version
+            return True
+        return False
 
     def open_stream(self, replayed: Dict[int, int]) -> None:
         backlog, self.backlog = self.backlog, []
         self.open = True
         for kid, version, tail in backlog:
             if version > replayed.get(kid, 0):
-                self.write_frame(tail)
+                if self.write_frame(tail):
+                    self.versions[kid] = version
 
     def write_frame(self, tail: bytes) -> bool:
         """Write one shared-tail frame with this conn's envelope; False
@@ -909,11 +1326,12 @@ class _SseConn:
 def _worker_entry(argv: List[str]) -> None:
     index = int(argv[0])
     fd = int(argv[1])
-    asyncio.run(_WorkerMain(index, fd).run())
+    fd_channel = int(argv[2]) if len(argv) > 2 else None
+    asyncio.run(_WorkerMain(index, fd, fd_channel).run())
 
 
 if __name__ == "__main__":
     if len(sys.argv) >= 4 and sys.argv[1] == "--worker":
         _worker_entry(sys.argv[2:])
     else:
-        sys.exit("usage: worker_pool.py --worker <index> <fd>")
+        sys.exit("usage: worker_pool.py --worker <index> <fd> [<fd-channel>]")

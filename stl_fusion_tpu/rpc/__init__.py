@@ -1,6 +1,11 @@
 """RPC layer — distributed communication backend (SURVEY.md §2.4)."""
 from .calls import RpcCallTypeRegistry, RpcInboundCall, RpcOutboundCall
-from .fanout import ComputeFanoutIndex, install_compute_fanout
+from .fanout import (
+    ComputeFanoutIndex,
+    WaveValuePublisher,
+    install_compute_fanout,
+    install_value_publisher,
+)
 from .hub import RpcClientProxy, RpcHub, consistent_hash_router
 from .outbox import PeerOutbox
 from .message import COMPUTE_SYSTEM_SERVICE, SYSTEM_SERVICE, VERSION_HEADER, RpcMessage
@@ -18,7 +23,9 @@ from .testing import RpcMultiServerTestTransport, RpcTestTransport
 __all__ = [
     "ComputeFanoutIndex",
     "PeerOutbox",
+    "WaveValuePublisher",
     "install_compute_fanout",
+    "install_value_publisher",
     "RpcCallTypeRegistry",
     "RpcInboundCall",
     "RpcOutboundCall",

@@ -83,6 +83,16 @@ class RpcHub:
         #: optional ComputeFanoutIndex (rpc/fanout.py): lets a device
         #: wave's newly-mask drain straight into per-peer batches
         self.compute_fanout: Optional[Any] = None
+        #: optional WaveValuePublisher (rpc/fanout.py, ISSUE 11 level 2):
+        #: SERVER side of the publish-on-wave value plane — keys with a
+        #: standing publish registration answer wave fences with pushed
+        #: ``$sys-c.value_block`` frames instead of plain invalidations
+        self.value_publisher: Optional[Any] = None
+        #: CLIENT side of the value plane (the EdgeNode installs itself):
+        #: routes inbound ``value_block`` frames + fallback fences for
+        #: retired publish-mode calls (``on_value_block`` /
+        #: ``on_block_fence``)
+        self.value_plane_client: Optional[Any] = None
         #: $sys-t dispatch hook (per-table row fences + subscriptions),
         #: installed by client/remote_table.py on both ends
         self.table_system_handler: Optional[Callable[[RpcPeer, RpcMessage], None]] = None

@@ -81,6 +81,10 @@ def test_compact_record_stays_under_tail_window():
         "fan_workers": 2,
         "encode_ratio": 634.4,
         "deliveries_per_s_per_worker": 54649.8,
+        "value_plane": "block",
+        "upstream_rpcs_per_burst": 0.0,
+        "block_hit_ratio": 1.0,
+        "reread_batch_size": 512.0,
     }
     mesh = {
         "mesh_devices": 8,
@@ -122,6 +126,13 @@ def test_compact_record_stays_under_tail_window():
     assert d["edge"]["workers"] == 2 and d["edge"]["fan_workers"] == 2
     assert d["edge"]["encode_ratio"] == 634.4
     assert d["edge"]["deliveries_per_s_per_worker"] == 54650
+    # the ISSUE 11 upstream value plane rides the capture: serving mode,
+    # upstream RPCs per burst (0 = publish-on-wave carried every fence),
+    # the block hit ratio and the batched-re-read frame size
+    assert d["edge"]["value_plane"] == "block"
+    assert d["edge"]["upstream_rpcs_per_burst"] == 0.0
+    assert d["edge"]["block_hit_ratio"] == 1.0
+    assert d["edge"]["reread_batch_size"] == 512.0
     # every headline field the judge reads must be IN the capture
     assert d["static"]["inv_per_s"] and d["live"]["inv_per_s"]
     assert d["live"]["sustained_inv_per_s"] and d["live"]["wave_chain_ms_p99"]

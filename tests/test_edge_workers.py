@@ -248,6 +248,173 @@ async def test_reuseport_sse_rejects_bad_keys_via_parent_validation():
         await stop_all(pool, node, edge_rpc, server_rpc)
 
 
+async def read_sse_event(reader):
+    fields = {}
+    while True:
+        line = (await asyncio.wait_for(reader.readline(), 10.0)).decode()
+        assert line, "SSE stream closed early"
+        if line in ("\n", "\r\n"):
+            if fields:
+                return fields
+            continue
+        if line.startswith(":"):
+            continue
+        name, _, value = line.rstrip("\n").partition(":")
+        fields[name] = value.strip()
+
+
+async def open_sse(port, keys_q, extra_headers=""):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(
+        (
+            f"GET /edge/sse?keys={keys_q} HTTP/1.1\r\nHost: x\r\n"
+            f"{extra_headers}\r\n"
+        ).encode()
+    )
+    await writer.drain()
+    while True:
+        line = await asyncio.wait_for(reader.readline(), 10.0)
+        assert line, "SSE closed during headers"
+        if line in (b"\r\n", b"\n"):
+            break
+    return reader, writer
+
+
+async def test_send_fds_resume_token_is_portable_across_the_pool():
+    """ISSUE 11 satellite: under the send_fds accept plane the PARENT
+    routes a reconnect to the worker that minted (and parked) its resume
+    token — the token is valid on the pool's one public port, whichever
+    worker owns it. The resumed stream replays ONLY what the session
+    missed: nothing when it saw the current version, exactly the newer
+    version otherwise."""
+    svc, node, edge_rpc, server_rpc = make_stack()
+    pool = None
+    try:
+        pool = await EdgeWorkerPool(
+            node, workers=2, flush_interval=0.005
+        ).start()
+        assert pool.accept_plane == "send_fds"
+        await pool.add_sim_sessions(0, {("get", "a"): 1})
+        sub = next(iter(node._subs.values()))
+        await until(lambda: sub.version >= 1)
+        port = await pool.listen()
+        keys_q = urllib.parse.quote(json.dumps([["get", "a"]]))
+
+        reader, writer = await open_sse(port, keys_q)
+        hello = json.loads((await read_sse_event(reader))["data"])
+        token = hello["token"]
+        owner = hello["worker"]
+        assert not hello["resumed"]
+        replay = json.loads((await read_sse_event(reader))["data"])
+        seen_ver = replay["ver"]
+        writer.close()
+        await until(lambda: sub.pins == 1)  # conn's pin released
+
+        # reconnect WITH the token (the browser's Last-Event-ID shape):
+        # routed to the minting worker, resumed, and — the session having
+        # seen the current version — NOTHING replays before a live fence
+        reader, writer = await open_sse(
+            port, keys_q, extra_headers=f"Last-Event-ID: {token}\r\n"
+        )
+        hello2 = json.loads((await read_sse_event(reader))["data"])
+        assert hello2["token"] == token
+        assert hello2["worker"] == owner
+        assert hello2["resumed"]
+        assert pool.routed_by_token >= 1
+        await svc.increment("a")
+        update = json.loads((await read_sse_event(reader))["data"])
+        assert update["ver"] == seen_ver + 1 and update["value"] == 1
+        writer.close()
+
+        # third leg: disconnect mid-stream, fence while away, resume —
+        # exactly the missed version replays (latest-wins)
+        await asyncio.sleep(0.05)  # let the park land
+        await svc.increment("a")
+        await until(lambda: sub.version >= 3)
+        reader, writer = await open_sse(
+            port, keys_q, extra_headers=f"Last-Event-ID: {token}\r\n"
+        )
+        hello3 = json.loads((await read_sse_event(reader))["data"])
+        assert hello3["resumed"] and hello3["worker"] == owner
+        missed = json.loads((await read_sse_event(reader))["data"])
+        assert missed["value"] == 2  # the fence it missed, once
+        writer.close()
+    finally:
+        await stop_all(pool, node, edge_rpc, server_rpc)
+
+
+async def test_reuseport_fallback_knob_still_serves():
+    """accept_plane="reuseport" keeps the PR 10 shape: per-worker
+    SO_REUSEPORT listeners, hello + replay + live updates served, tokens
+    worker-local (a token miss is a fresh attach, not an error)."""
+    svc, node, edge_rpc, server_rpc = make_stack()
+    pool = None
+    try:
+        pool = await EdgeWorkerPool(
+            node, workers=2, flush_interval=0.005, accept_plane="reuseport"
+        ).start()
+        await pool.add_sim_sessions(0, {("get", "a"): 1})
+        sub = next(iter(node._subs.values()))
+        await until(lambda: sub.version >= 1)
+        port = await pool.listen()
+        keys_q = urllib.parse.quote(json.dumps([["get", "a"]]))
+        reader, writer = await open_sse(port, keys_q)
+        hello = json.loads((await read_sse_event(reader))["data"])
+        assert hello["token"].startswith("es-w")
+        replay = json.loads((await read_sse_event(reader))["data"])
+        assert replay["ver"] >= 1
+        await svc.increment("a")
+        update = json.loads((await read_sse_event(reader))["data"])
+        assert update["value"] == 1
+        writer.close()
+        assert pool.routed_conns == 0  # the parent accept plane is idle
+    finally:
+        await stop_all(pool, node, edge_rpc, server_rpc)
+
+
+async def test_websocket_delivery_beside_worker_pool():
+    """The WS load leg (ISSUE 11 satellite, websockets-gated): an
+    EdgeWebSocketServer session on the PARENT node delivers live fences
+    while the worker pool serves the same key — both planes ride the one
+    upstream subscription and the shared encode cache."""
+    websockets = pytest.importorskip("websockets")
+    from stl_fusion_tpu.edge import EdgeWebSocketServer
+
+    svc, node, edge_rpc, server_rpc = make_stack()
+    pool = None
+    ws_server = None
+    try:
+        pool = await EdgeWorkerPool(node, workers=1, flush_interval=0.005).start()
+        await pool.add_sim_sessions(0, {("get", "a"): 5})
+        sub = next(iter(node._subs.values()))
+        await until(lambda: sub.version >= 1)
+        ws_server = await EdgeWebSocketServer(node, heartbeat_interval=5.0).start()
+        async with websockets.connect(ws_server.url) as ws:
+            await ws.send(json.dumps({"keys": [["get", "a"]]}))
+            hello = json.loads(await asyncio.wait_for(ws.recv(), 10.0))
+            assert "hello" in hello
+            replay = json.loads(await asyncio.wait_for(ws.recv(), 10.0))
+            assert replay["frames"][0]["ver"] >= 1
+            encodes_before = node.frames_encoded
+            await svc.increment("a")
+            update = json.loads(await asyncio.wait_for(ws.recv(), 10.0))
+            assert update["frames"][0]["value"] == 1
+            # one upstream sub, one encode per (key, version) — the WS
+            # text and the worker bytes share it
+            assert len(node._subs) == 1
+
+            async def worker_saw_fence():
+                stats = await pool.stats()
+                return sum(s["deliveries"] for s in stats) >= 10
+
+            await until_async(worker_saw_fence)
+            assert node.frames_encoded == encodes_before + 1
+    finally:
+        if ws_server is not None:
+            await ws_server.stop()
+        await stop_all(pool, node, edge_rpc, server_rpc)
+
+
 async def test_pool_stop_is_clean_and_releases_pins():
     """stop() shuts workers down (processes exit), releases sim pins, and
     detaches from the node — a second stop is a no-op."""

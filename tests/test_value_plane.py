@@ -1,0 +1,566 @@
+"""Upstream value plane tests (ISSUE 11): batched multi-key re-read +
+publish-on-wave value blocks.
+
+Level 1 contract: a fence burst's re-reads coalesce into ONE
+``$sys-c.recompute_batch`` frame per owner, oracle-equivalent to the
+per-key path (values AND upstream versions) under seeded
+drop/dup/reorder chaos; a partial-batch failure falls back per-key and
+is counted, never silent.
+
+Level 2 contract: a wave's recomputed hot-set arrives as ONE columnar
+``value_block`` frame and the edge serves the burst with ZERO per-key
+upstream RPCs; stale entries are seq-gated; the budget ladder and the
+reshard repin invalidate exactly what they should and always fall back
+to the batched re-read.
+"""
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from stl_fusion_tpu.client import compute_client, install_compute_call_type
+from stl_fusion_tpu.core import (
+    ComputeService,
+    FusionHub,
+    TableBacking,
+    compute_method,
+    invalidating,
+    memo_table_of,
+    set_default_hub,
+)
+from stl_fusion_tpu.diagnostics.flight_recorder import RECORDER
+from stl_fusion_tpu.edge import EdgeNode
+from stl_fusion_tpu.graph import TpuGraphBackend
+from stl_fusion_tpu.resilience import ChaosPolicy
+from stl_fusion_tpu.rpc import (
+    RpcHub,
+    RpcTestTransport,
+    install_compute_fanout,
+    install_value_publisher,
+)
+
+
+class CounterService(ComputeService):
+    def __init__(self, hub=None, store=None):
+        super().__init__(hub)
+        self.counters = store if store is not None else {}
+        self.fail_once: set = set()
+
+    @compute_method
+    async def get(self, key: str) -> int:
+        if key in self.fail_once:
+            self.fail_once.discard(key)
+            raise RuntimeError(f"transient failure for {key}")
+        return self.counters.get(key, 0)
+
+    async def increment(self, key: str):
+        self.counters[key] = self.counters.get(key, 0) + 1
+        with invalidating():
+            await self.get(key)
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub():
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    yield hub
+    set_default_hub(old)
+
+
+async def until(pred, timeout: float = 10.0) -> None:
+    async def wait():
+        while not pred():
+            await asyncio.sleep(0.005)
+
+    await asyncio.wait_for(wait(), timeout)
+
+
+async def settle(seconds: float = 0.05) -> None:
+    deadline = time.perf_counter() + seconds
+    while time.perf_counter() < deadline:
+        await asyncio.sleep(0.005)
+
+
+def make_counter_stack(**edge_kwargs):
+    server_fusion = FusionHub()
+    server_rpc = RpcHub("server")
+    install_compute_call_type(server_rpc)
+    svc = CounterService(server_fusion)
+    server_rpc.add_service("counters", svc)
+    edge_rpc = RpcHub("edge")
+    install_compute_call_type(edge_rpc)
+    transport = RpcTestTransport(edge_rpc, server_rpc, wire_codec=True)
+    node = EdgeNode("counters", edge_rpc, resume_ttl=30.0, **edge_kwargs)
+    return svc, node, transport, edge_rpc, server_rpc
+
+
+async def stop_all(node, *hubs):
+    await node.close()
+    for h in hubs:
+        await h.stop()
+
+
+# ---------------------------------------------------------------- level 1
+
+
+async def test_batched_reread_equivalent_to_per_key_under_chaos():
+    """Oracle equivalence: one BATCHED edge and one PER-KEY edge dial the
+    same server over seeded drop/dup/reorder channels; both converge to
+    the backing store after every burst, with the same upstream versions
+    — and the batched edge actually batched (frames ≪ keys)."""
+    store: dict = {}
+    server_fusion = FusionHub()
+    server_rpc = RpcHub("server")
+    install_compute_call_type(server_rpc)
+    svc = CounterService(server_fusion, store)
+    server_rpc.add_service("counters", svc)
+
+    edges = []
+    for name, batched in (("batched", True), ("perkey", False)):
+        rpc = RpcHub(f"edge-{name}")
+        install_compute_call_type(rpc)
+        transport = RpcTestTransport(
+            rpc, server_rpc, wire_codec=True, client_name=name
+        )
+        transport.set_chaos(
+            ChaosPolicy(seed=99, drop=0.05, duplicate=0.04, reorder_window=3)
+        )
+        node = EdgeNode(
+            "counters", rpc, name=f"edge-{name}",
+            reread_batch=batched, value_blocks=False,
+        )
+        edges.append((node, rpc))
+    try:
+        keys = [f"k{i}" for i in range(12)]
+        seen = {id(n): {} for n, _r in edges}
+
+        def sink_for(node):
+            mine = seen[id(node)]
+
+            def sink(frame):
+                if frame[5] is None:  # value frames only
+                    mine[frame[0]] = frame[2]
+
+            return sink
+
+        for node, _rpc in edges:
+            node.attach([("get", k) for k in keys], sink=sink_for(node))
+        await until(
+            lambda: all(len(seen[id(n)]) == len(keys) for n, _r in edges)
+        )
+        for round_no in range(3):
+            for k in keys[round_no::2]:
+                await svc.increment(k)
+            await settle(0.2)
+
+        def converged():
+            for node, _rpc in edges:
+                mine = seen[id(node)]
+                for k in keys:
+                    ks = node.key_str(("get", k))
+                    if mine[ks] != store.get(k, 0):
+                        return False
+            return True
+
+        await until(converged, timeout=20.0)
+        batched_node = edges[0][0]
+        perkey_node = edges[1][0]
+        # the batched edge coalesced its bursts: batch frames engaged and
+        # per-key round trips stayed the counted fallback, not the path
+        assert batched_node.reread_batches >= 1
+        assert batched_node.reread_batch_keys >= len(keys)
+        assert perkey_node.reread_batches == 0
+        assert perkey_node.per_key_rereads >= len(keys)
+        # oracle-exact versions: both edges hold the SAME server LTag per
+        # key (the server's registered computed version, not a local mint)
+        for k in keys:
+            ks_b = batched_node.key_str(("get", k))
+            ks_p = perkey_node.key_str(("get", k))
+            vb = batched_node._subs[ks_b].upstream_version
+            vp = perkey_node._subs[ks_p].upstream_version
+            assert vb is not None and vb == vp, (k, vb, vp)
+        assert all(n.evictions == 0 for n, _r in edges)
+    finally:
+        for node, rpc in edges:
+            await node.close()
+            await rpc.stop()
+        await server_rpc.stop()
+
+
+async def test_partial_batch_failure_falls_back_per_key_and_is_counted():
+    """One key's compute raises during the batch: its entry errors, the
+    edge retries it PER KEY (counted in reread_fallbacks), and the other
+    entries of the same frame are served normally."""
+    svc, node, _t, edge_rpc, server_rpc = make_counter_stack(
+        value_blocks=False, error_backoff=0.01,
+    )
+    svc.fail_once.add("bad")
+    got: dict = {}
+    errs: dict = {}
+    try:
+        def sink(frame):
+            if frame[5] is None:
+                got[frame[0]] = frame[2]
+            else:
+                errs[frame[0]] = frame[5]
+
+        node.attach([("get", "a"), ("get", "b"), ("get", "bad")], sink=sink)
+        ks_bad = node.key_str(("get", "bad"))
+        # a and b are served from the batch; bad's entry failed, fell back
+        # per-key — and the per-key read memoizes the (still transient)
+        # error as an error frame first
+        await until(lambda: len(got) + len(errs) >= 3)
+        assert node.reread_fallbacks >= 1
+        assert node.per_key_rereads >= 1
+        assert node.reread_batches >= 1
+        # the failure heals: invalidate the bad key; the re-read now
+        # computes cleanly and the session converges
+        await svc.increment("bad")
+        await until(lambda: got.get(ks_bad) == 1)
+        assert node.evictions == 0
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+# ---------------------------------------------------------------- level 2
+
+
+def make_wave_stack(n=32, **edge_kwargs):
+    """Table-backed service + device graph + fanout index + publisher —
+    the publish-on-wave stack (test_fanout idiom), plus one edge."""
+    from stl_fusion_tpu.core import default_hub
+
+    hub = default_hub()
+    backend = TpuGraphBackend(hub, node_capacity=n + 8, edge_capacity=256)
+
+    class Tbl(ComputeService):
+        def __init__(self, h=None):
+            super().__init__(h)
+            self.base = np.arange(n, dtype=np.float32)
+
+        def load(self, ids):
+            return self.base[np.asarray(ids, dtype=np.int64)]
+
+        @compute_method(table=TableBacking(rows=n, batch="load"))
+        async def node(self, i: int) -> float:
+            return float(self.base[i])
+
+    svc = Tbl(hub)
+    hub.add_service(svc, "tbl")
+    table = memo_table_of(svc.node)
+    block = backend.bind_table_rows(table)
+    src = np.arange(0, n - 1, dtype=np.int64)
+    dst = np.arange(1, n, dtype=np.int64)  # chain 0 -> 1 -> ... -> n-1
+    backend.declare_row_edges(block, src, block, dst)
+    table.read_batch(np.arange(n))
+    backend.flush()
+
+    server_rpc = RpcHub("server")
+    install_compute_call_type(server_rpc)
+    server_rpc.add_service("tbl", svc)
+    index = install_compute_fanout(server_rpc, backend)
+    publisher = install_value_publisher(server_rpc)
+
+    edge_rpc = RpcHub("edge")
+    install_compute_call_type(edge_rpc)
+    RpcTestTransport(edge_rpc, server_rpc, wire_codec=True)
+    node = EdgeNode("tbl", edge_rpc, **edge_kwargs)
+    return svc, backend, block, table, index, publisher, node, edge_rpc, server_rpc
+
+
+async def test_value_block_serves_wave_with_zero_upstream_rpcs():
+    """The level-2 acceptance at test scale: after the warm read, a wave
+    burst reaches the session THROUGH a value block — zero re-read RPCs,
+    the standing subscription re-registers server-side, and explain()'s
+    journal names the block rung."""
+    (svc, backend, block, table, index, publisher, node,
+     edge_rpc, server_rpc) = make_wave_stack()
+    RECORDER.enabled = True
+    got = []
+    try:
+        rows = [5, 9]
+        node.attach([("node", r) for r in rows], sink=got.append)
+        await until(
+            lambda: len([f for f in got if f[5] is None]) >= 2
+        )
+        subs = list(node._subs.values())
+        # publish mode armed off the batch echo
+        await until(lambda: all(s.block_mode for s in subs))
+        assert all(s.node is not None for s in subs)
+        rpcs_before = node.upstream_rpcs
+        per_key_before = node.per_key_rereads
+        values_before = {f[0]: f[2] for f in got if f[5] is None}
+
+        # the wave: bump the base so the recompute yields NEW values, then
+        # cascade from row 0 — the chain fences every row
+        svc.base = svc.base + 100.0
+        backend.cascade_rows_batch(block, [0])
+        await until(lambda: node.block_hits >= 2)
+        await settle(0.1)
+        # zero upstream re-read RPCs: the block WAS the fence + the value
+        assert node.upstream_rpcs == rpcs_before
+        assert node.per_key_rereads == per_key_before
+        assert node.block_hits == 2
+        new_values = {f[0]: f[2] for f in got if f[5] is None}
+        for r in rows:
+            ks = node.key_str(("node", r))
+            assert new_values[ks] == values_before[ks] + 100.0
+        # the standing subscription re-registered without a client RPC
+        await until(lambda: index.subscriptions == 2)
+        assert publisher.stats()["blocks_sent"] >= 1
+        assert publisher.stats()["values_serialized"] >= 2
+        # ONE columnar frame carried the burst's entries for this edge
+        assert publisher.stats()["block_keys_sent"] >= 2
+        # the journal names the rung (explain()'s source line)
+        events = [
+            e for e in RECORDER.recent(kind="edge_fenced")
+            if "value served from wave block" in (e.get("detail") or "")
+        ]
+        assert events, "edge_fenced journal lost the value-plane rung"
+
+        # a SECOND wave stays block-warm too (re-warm the rows first —
+        # the fanout-suite idiom: a wave only drains NEWLY-invalid rows)
+        table.read_batch(np.arange(32))
+        backend.flush()
+        backend.graph.clear_invalid()
+        svc.base = svc.base + 1.0
+        backend.cascade_rows_batch(block, [0])
+        await until(lambda: node.block_hits >= 4)
+        assert node.upstream_rpcs == rpcs_before
+    finally:
+        RECORDER.enabled = False
+        publisher.dispose()
+        index.dispose()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_stale_block_entry_is_seq_gated():
+    """The version gate: a block entry whose seq is not newer than the
+    last applied one is dropped (counted) — duplicate/reordered frames
+    after a reconnect can never regress a key."""
+    (svc, backend, block, table, index, publisher, node,
+     edge_rpc, server_rpc) = make_wave_stack()
+    got = []
+    try:
+        node.attach([("node", 3)], sink=got.append)
+        await until(lambda: len(got) >= 1)
+        sub = next(iter(node._subs.values()))
+        await until(lambda: sub.block_mode)
+        svc.base = svc.base + 50.0
+        backend.cascade_rows_batch(block, [0])
+        await until(lambda: node.block_hits >= 1)
+        seq_now = sub.block_seq
+        assert seq_now >= 1
+        fans_before = sub.version
+        # replay a STALE entry directly through the inbound handler (what
+        # a duplicated/reordered frame would deliver)
+        from stl_fusion_tpu.utils.serialization import dumps as wire_dumps
+
+        class _FakeMsg:
+            argument_data = wire_dumps(
+                [[sub.block_call_id], ["@1"], [seq_now], [None], [None],
+                 [0, 9], wire_dumps(123.0)]
+            )
+
+        peer = next(iter(edge_rpc.peers.values()))
+        node.on_value_block(peer, _FakeMsg())
+        await settle(0.05)
+        assert node.block_stale == 1
+        assert sub.version == fans_before  # nothing was fanned
+    finally:
+        publisher.dispose()
+        index.dispose()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_block_budget_eviction_falls_back_to_reread():
+    """The byte budget: an entry that would blow ``block_budget_bytes``
+    is dropped (counted) and the key converges through the batched
+    re-read instead — the fence is never lost."""
+    (svc, backend, block, table, index, publisher, node,
+     edge_rpc, server_rpc) = make_wave_stack(block_budget_bytes=2)
+    got = []
+    try:
+        node.attach([("node", 7)], sink=got.append)
+        await until(lambda: len(got) >= 1)
+        sub = next(iter(node._subs.values()))
+        await until(lambda: sub.block_mode)
+        svc.base = svc.base + 9.0
+        backend.cascade_rows_batch(block, [0])
+        ks = node.key_str(("node", 7))
+        await until(
+            lambda: any(
+                f[0] == ks and f[5] is None and f[2] == 7.0 + 9.0 for f in got
+            )
+        )
+        assert node.block_evictions >= 1
+        assert node.block_hits == 0  # budget 2B: nothing ever fit
+        # the fallback rung actually went upstream again
+        assert node.upstream_rpcs >= 2
+    finally:
+        publisher.dispose()
+        index.dispose()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_host_led_invalidation_drops_standing_and_fences_plain():
+    """A HOST-LED invalidation (not a wave) of a publish-mode key takes
+    the fallback ladder: the publisher drops the standing registration,
+    the edge receives a plain fence routed through on_block_fence, and
+    the batched re-read re-arms publish mode."""
+    svc, node, _t, edge_rpc, server_rpc = make_counter_stack()
+    publisher = install_value_publisher(server_rpc)
+    got = []
+    try:
+        def sink(frame):
+            if frame[5] is None:
+                got.append(frame)
+
+        node.attach([("get", "x")], sink=sink)
+        await until(lambda: len(got) >= 1)
+        sub = next(iter(node._subs.values()))
+        # CounterService.get is NOT graph-resident → publish must decline
+        # (register_standing returns False without a backend nid)
+        await settle(0.05)
+        assert not sub.block_mode
+        assert publisher.stats()["standing_subs"] == 0
+        # the key still converges through the plain fence + batched re-read
+        await svc.increment("x")
+        await until(lambda: any(f[2] == 1 for f in got))
+    finally:
+        publisher.dispose()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_wave_then_host_led_reshard_style_fence_falls_back():
+    """After block mode engaged (wave stack), a host-led invalidation of
+    the standing computed (the reshard-fence shape) posts a plain fence:
+    the edge leaves block mode, re-reads batched, and re-arms."""
+    (svc, backend, block, table, index, publisher, node,
+     edge_rpc, server_rpc) = make_wave_stack()
+    got = []
+    try:
+        node.attach([("node", 4)], sink=got.append)
+        await until(lambda: len(got) >= 1)
+        sub = next(iter(node._subs.values()))
+        await until(lambda: sub.block_mode)
+        svc.base = svc.base + 10.0
+        backend.cascade_rows_batch(block, [0])
+        await until(lambda: node.block_hits >= 1)
+        assert sub.node is None  # the block stream owns the key
+        batches_before = node.reread_batches
+
+        # host-led: invalidate the server-side computed directly (what a
+        # reshard fence does at the old owner) — NOT via a wave
+        svc.base = svc.base + 5.0
+        from stl_fusion_tpu.core.context import get_existing
+
+        server_node = await get_existing(lambda: svc.node(4))
+        assert server_node is not None
+        server_node.invalidate(immediately=True)
+        ks = node.key_str(("node", 4))
+        await until(
+            lambda: any(
+                f[0] == ks and f[5] is None and f[2] == 4.0 + 15.0 for f in got
+            )
+        )
+        assert node.block_fences >= 1
+        assert node.reread_batches > batches_before
+        assert publisher.stats()["fallback_fences"] >= 1
+        # publish re-armed on the re-read
+        await until(lambda: sub.block_mode)
+    finally:
+        publisher.dispose()
+        index.dispose()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_reconnect_style_reread_supersedes_old_call_and_standing():
+    """A needs_reread while the local node is still LIVE (the reconnect-
+    monitor / budget-eviction shape) must retire the superseded call on
+    the edge AND the old call id's standing registration on the server —
+    otherwise every later wave publishes blocks for a call the edge only
+    counts as orphans, and peer.outbound_calls grows forever."""
+    (svc, backend, block, table, index, publisher, node,
+     edge_rpc, server_rpc) = make_wave_stack()
+    got = []
+    try:
+        node.attach([("node", 8)], sink=got.append)
+        await until(lambda: len(got) >= 1)
+        sub = next(iter(node._subs.values()))
+        await until(lambda: sub.block_mode)
+        old_cid = sub.block_call_id
+        assert sub.node is not None and not sub.node.is_invalidated
+        peer = next(iter(edge_rpc.peers.values()))
+        assert old_cid in peer.outbound_calls
+        # the reconnect-monitor shape: force a re-read while live
+        sub.needs_reread = True
+        sub._wake.set()
+        await until(lambda: sub.block_call_id != old_cid)
+        # edge side: the superseded call left the registry, and the seq
+        # gate reset with the new call's stream (a new owner's publisher
+        # counts from its own epoch — a carried high-water mark would
+        # drop every fresh entry as stale)
+        assert old_cid not in peer.outbound_calls
+        assert sub.block_seq == 0
+        # server side: exactly one standing registration for the key —
+        # the old call id's was retired at re-arm time
+        cids = [s.call_id for s in publisher._standing.values()]
+        assert sub.block_call_id in cids and old_cid not in cids
+        assert len(cids) == 1
+        # and a wave still serves the key through the NEW registration
+        svc.base = svc.base + 3.0
+        backend.cascade_rows_batch(block, [0])
+        ks = node.key_str(("node", 8))
+        await until(
+            lambda: any(
+                f[0] == ks and f[5] is None and f[2] == 8.0 + 3.0 for f in got
+            )
+        )
+        assert node.block_orphans == 0
+    finally:
+        publisher.dispose()
+        index.dispose()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_reshard_repin_invalidates_exactly_moved_block_entries():
+    """A repin (the shard-map-change path) drops EXACTLY the moved key's
+    pending block entry + block mode; an unmoved key's pending state is
+    untouched. (ShardMap.diff → repin() wiring is covered by the edge
+    reshard suite; this pins the value-plane half of the contract.)"""
+    (svc, backend, block, table, index, publisher, node,
+     edge_rpc, server_rpc) = make_wave_stack()
+    got = []
+    try:
+        rows = [2, 6]
+        node.attach([("node", r) for r in rows], sink=got.append)
+        await until(lambda: len([f for f in got if f[5] is None]) >= 2)
+        subs = {s.args[0]: s for s in node._subs.values()}
+        await until(lambda: all(s.block_mode for s in subs.values()))
+        # park a pending entry on BOTH subs without letting the loops
+        # serve them: stage entries directly (the loops are mid-wait)
+        for s in subs.values():
+            s.block_pending = (s.block_seq + 1, "@9", 1.0, None, None)
+            s.block_size = 8
+            node._block_pending_bytes += 8
+        moved, kept = subs[2], subs[6]
+        old_cid = moved.block_call_id
+        moved.repin("reshard:7")
+        await until(lambda: moved.block_pending is None)
+        assert node.block_reshard_drops == 1
+        # the old owner's call routing died with the repin (a late block
+        # for it is an orphan); the kept key is untouched — exactly the
+        # moved key's block state was invalidated
+        await until(lambda: moved.block_call_id != old_cid)
+        assert old_cid not in node._block_calls
+        assert kept.block_pending is not None
+        assert kept.block_mode
+        # the moved key re-read at its owner and re-armed
+        await until(lambda: moved.block_mode)
+        assert node.resubscribes >= 1
+    finally:
+        publisher.dispose()
+        index.dispose()
+        await stop_all(node, edge_rpc, server_rpc)
