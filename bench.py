@@ -623,6 +623,35 @@ def run_edge_section():
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def run_traffic_section():
+    """Embedded adversarial-traffic measurement (ISSUE 12):
+    perf/traffic_path.py as a subprocess — the full five-scenario run
+    (zipf hot-set migration, flash crowd through admission control,
+    mass-reconnect storm, rolling drain, reshard-mid-crowd) with its SLO
+    gates enforced; the record carries admitted/shed per lane, the drain
+    loss (must be 0) and the flash p99.
+    FUSION_BENCH_TRAFFIC_SESSIONS=0 skips."""
+    import subprocess
+
+    sessions = int(os.environ.get("FUSION_BENCH_TRAFFIC_SESSIONS", 20_000))
+    if sessions <= 0:
+        return None
+    env = dict(os.environ, TRAFFIC_SESSIONS=str(sessions))
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "perf", "traffic_path.py"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], env=env, stdout=subprocess.PIPE, text=True,
+            timeout=3600,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "traffic path timed out"}
+    if proc.returncode != 0:
+        return {"error": f"traffic path failed rc={proc.returncode} (stderr inherited above)"}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def main() -> None:
     import jax
 
@@ -660,6 +689,9 @@ def main() -> None:
     edge = run_edge_section()
     if edge is not None:
         detail["edge"] = edge
+    traffic = run_traffic_section()
+    if traffic is not None:
+        detail["traffic"] = traffic
     mesh = run_mesh_section()
     if mesh is not None:
         detail["mesh"] = mesh
@@ -677,7 +709,9 @@ def main() -> None:
     print("# full record: " + json.dumps(result), file=sys.stderr, flush=True)
     print(
         json.dumps(
-            _compact_result(inv_per_sec, detail, live, fanout, cluster, edge, mesh),
+            _compact_result(
+                inv_per_sec, detail, live, fanout, cluster, edge, mesh, traffic
+            ),
             separators=(",", ":"),
         )
     )
@@ -711,7 +745,7 @@ def _pos_ms(fields: dict) -> dict:
 
 def _compact_result(
     inv_per_sec: float, detail: dict, live, fanout=None, cluster=None, edge=None,
-    mesh=None,
+    mesh=None, traffic=None,
 ) -> dict:
     """The single stdout line: every headline metric, nothing that scales
     with run verbosity, target well under the driver's tail window."""
@@ -900,6 +934,37 @@ def _compact_result(
             "mesh_member_relays": lv.get("mesh_member_relays"),
             "eager_waves": (lv.get("pipeline") or {}).get("eager_waves"),
             "violations": mesh.get("violations"),
+        }
+    if traffic is not None and "error" in traffic:
+        out["traffic"] = {"error": traffic["error"]}
+    elif traffic is not None:
+        # the overload plane (ISSUE 12): adversarial traffic as a measured
+        # record — admitted/shed per lane (counted, never silent), the
+        # rolling-drain loss (MUST be 0: resume replay covers the gap),
+        # the flash-crowd and reshard p99s, and the audit verdicts
+        flash = traffic.get("flash") or {}
+        drain = traffic.get("drain") or {}
+        audit = traffic.get("audit") or {}
+        out["traffic"] = {
+            "ok": traffic.get("ok"),
+            "sessions": traffic.get("base_sessions"),
+            "flash_attempts": flash.get("attempts"),
+            "flash_admitted": flash.get("admitted"),
+            "flash_shed": flash.get("shed"),
+            "by_lane": flash.get("by_lane"),
+            "gold_shed_rate": flash.get("gold_shed_rate"),
+            "anon_shed_rate": flash.get("anon_shed_rate"),
+            "flash_p99_ms": flash.get("p99_ms"),
+            "reconnect_resumed": (traffic.get("reconnect") or {}).get("resumed"),
+            "reconnect_storm_s": (traffic.get("reconnect") or {}).get("storm_s"),
+            "drain_loss": drain.get("drain_loss"),
+            "sessions_drained": drain.get("sessions_drained"),
+            "reshard_p99_ms": (traffic.get("reshard") or {}).get("p99_ms"),
+            "zipf_migrated_p99_ms": (traffic.get("zipf") or {}).get(
+                "migrated_p99_ms"
+            ),
+            "audit_violations": audit.get("violations"),
+            "stale_keys": audit.get("stale"),
         }
     # cold vs warm start (ISSUE 6): the rebuild bill a restart used to pay
     # (mirror build + program warm-up) beside what the durable path pays

@@ -228,6 +228,23 @@ def explain(
         ]
         return out
 
+    # drain cause family (ISSUE 12c): the frame was not an invalidation at
+    # all — an edge node draining for a rolling deploy hinted this session
+    # to reconnect, carrying its resume token; nothing upstream changed
+    # and resume replay covers any fence that lands during the gap
+    if cause is not None and cause.startswith("drain:"):
+        edge_name = cause.partition(":")[2]
+        out["invalidation"] = {"cause": cause, "drain_edge": edge_name}
+        out["chain"] = [
+            f"{key_str}: session hinted to reconnect — edge '{edge_name}' "
+            f"drained (rolling deploy)",
+            f"caused by {cause}",
+            "the client resumes elsewhere with the carried token; "
+            "latest-wins replay covers anything fenced during the gap "
+            "(zero deliveries lost)",
+        ]
+        return out
+
     # wave record: an exact seq match wins outright (several waves can
     # share one span-shaped cause — e.g. two cascades under one command
     # span — and a cause-first scan would grab the NEWEST of them, not the
@@ -424,6 +441,26 @@ def explain(
         chain.append(
             f"the edge re-fanned {wave_edge_sessions_fenced} downstream "
             f"session(s) (none recorded on this key)"
+        )
+    # the overload plane (ISSUE 12): sheds journaled against this key —
+    # an operator asking "why is this subscriber not seeing updates" gets
+    # told the edge turned its attaches away, and why
+    shed_events = [e for e in events if e.get("kind") == "edge_shed"]
+    if shed_events:
+        reasons: dict = {}
+        for e in shed_events:
+            detail = e.get("detail") or ""
+            reason = (
+                detail.split("reason=", 1)[1].split()[0]
+                if "reason=" in detail
+                else "?"
+            )
+            reasons[reason] = reasons.get(reason, 0) + 1
+        chain.append(
+            "the edge SHED "
+            + ", ".join(f"{n}× {r}" for r, n in sorted(reasons.items()))
+            + " attach(es) naming this key (counted in "
+            "fusion_edge_shed_total; clients retry per Retry-After)"
         )
     out["chain"] = chain
     return out

@@ -4,10 +4,19 @@ An :class:`EdgeNode` holds EXACTLY ONE upstream ``$sys-c`` subscription
 per distinct key (riding the coalesced batch frames) and re-fans each
 fence to thousands of downstream SSE/WebSocket sessions with per-session
 bounded outboxes, latest-wins coalescing, slow-consumer eviction with
-resume tokens, and shard-map-aware upstream affinity. EDGE.md is the
+resume tokens, and shard-map-aware upstream affinity. The overload plane
+(ISSUE 12) sits in front of it: an :class:`AdmissionController` with
+per-tenant rate limits, priority lanes and pressure-fed shedding, plus
+graceful :meth:`EdgeNode.drain` for rolling deploys. EDGE.md is the
 runbook.
 """
-from .gateway import EdgeNode
+from .admission import (
+    AdmissionController,
+    AdmissionDecision,
+    AdmissionRejected,
+    rejection_bytes,
+)
+from .gateway import DRAIN_KEY, EdgeNode
 from .server import EdgeHttpServer, EdgeWebSocketServer
 from .session import (
     EdgeSession,
@@ -20,6 +29,10 @@ from .session import (
 from .worker_pool import EdgeWorkerPool
 
 __all__ = [
+    "AdmissionController",
+    "AdmissionDecision",
+    "AdmissionRejected",
+    "DRAIN_KEY",
     "EdgeNode",
     "EdgeHttpServer",
     "EdgeWebSocketServer",
@@ -30,4 +43,5 @@ __all__ = [
     "LatestWinsMailbox",
     "frame_to_dict",
     "pump_payloads",
+    "rejection_bytes",
 ]

@@ -50,11 +50,23 @@ from ..client.client_function import FusionClient
 from ..core.context import capture
 from ..diagnostics.flight_recorder import RECORDER, call_key
 from ..diagnostics.metrics import global_metrics
+from .admission import (
+    LANE_ANONYMOUS,
+    LANE_RESUME,
+    AdmissionDecision,
+    AdmissionRejected,
+)
 from .session import EdgeSession, EncodedFrame, Frame, KeyedMailbox
 
 log = logging.getLogger("stl_fusion_tpu")
 
-__all__ = ["EdgeNode", "KeySpec"]
+__all__ = ["EdgeNode", "KeySpec", "DRAIN_KEY"]
+
+#: the pseudo-key of drain hint frames (ISSUE 12c): EdgeNode.drain() ships
+#: one per live session — ``value`` carries the session's resume token,
+#: ``cause`` the ``drain:<edge-name>`` family explain() understands. It is
+#: not a subscribable key; sinks/transports route on it.
+DRAIN_KEY = "$edge/drain"
 
 
 def _is_shard_moved(e: BaseException) -> bool:
@@ -288,7 +300,10 @@ class _RereadBatcher:
         if len(bucket) >= self.node.reread_batch_max:
             self._fire(owner)
         elif owner not in self._timers:
-            window = self.node.reread_batch_window
+            # pressure-widened (ISSUE 12b): under load the window grows so
+            # each upstream frame amortizes more keys; it snaps back to
+            # the configured base as soon as the pressure sources drop
+            window = self.node.effective_reread_window()
             if window > 0:
                 self._timers[owner] = loop.call_later(window, self._fire, owner)
             else:
@@ -375,6 +390,9 @@ class EdgeNode:
         reread_batch_max: int = 512,
         value_blocks: bool = True,
         block_budget_bytes: int = 64 << 20,
+        admission=None,
+        pressure_widen: float = 4.0,
+        pressure_fan_depth: int = 1024,
     ):
         from ..core.hub import FusionHub
 
@@ -416,6 +434,23 @@ class EdgeNode:
         #: distinct keys one session may subscribe: bounds the upstream
         #: subscription state a single connection can mint
         self.max_keys_per_session = max_keys_per_session
+        #: the overload-safety plane (ISSUE 12): an AdmissionController
+        #: consulted by attach()/resume() (unless the transport already
+        #: admitted) and by both transports; None = no admission control
+        #: (the in-process/benchmark default — existing behavior)
+        self.admission = admission
+        #: how far the upstream re-read batching window widens under
+        #: pressure: effective = base * (1 + pressure_widen * pressure).
+        #: Overload then degrades to bigger (cheaper per key) upstream
+        #: batches — higher latency — before it degrades to evictions.
+        self.pressure_widen = pressure_widen
+        #: fan-shard queue depth (pending distinct keys across shards) at
+        #: which the fan plane reports FULL pressure (1.0)
+        self.pressure_fan_depth = max(1, int(pressure_fan_depth))
+        if admission is not None:
+            admission.add_pressure_source(
+                f"{name}:fan_shards", self._fan_pressure
+            )
         #: fan shards (ISSUE 10b): sessions partition round-robin over W
         #: parallel fan workers; each upstream fence posts ONE encoded
         #: frame per shard instead of walking every session in the watch
@@ -479,6 +514,10 @@ class EdgeNode:
         #: past resume_ttl forever
         self._sweep_handle = None
         self._closed = False
+        #: set by drain(): no new admissions, live sessions hinted +
+        #: parked; the node keeps serving resumes of OTHER nodes' state
+        #: only through import_parked on a fresh node
+        self._draining = False
         # -- counters (collector-exported as fusion_edge_*) ---------------
         self.frames_fanned = 0
         self.coalesced_frames = 0  # latest-wins drops inside session mailboxes
@@ -494,7 +533,15 @@ class EdgeNode:
         self.deliveries = 0
         self.evictions = 0
         self.resumes = 0
+        self.resumes_expired = 0  # resume() hit an expired-unswept token
         self.resubscribes = 0  # upstream re-pins after a shard move
+        # -- overload safety (ISSUE 12) -----------------------------------
+        self.drains = 0  # drain() invocations (fusion_edge_drains_total)
+        self.sessions_drained = 0  # sessions hinted + parked by drains
+        #: shed counts when NO AdmissionController is installed (the
+        #: transports' unified rejection path still counts); with a
+        #: controller, sheds ride its per-reason counters instead
+        self._shed_local: Dict[str, int] = {}
         self.upstream_fences = 0
         self.upstream_errors = 0
         self.sessions_attached_total = 0
@@ -523,6 +570,11 @@ class EdgeNode:
             "fusion_edge_reread_batch_size",
             help="keys per recompute_batch upstream frame",
         )
+        # the effective window is non-additive: N nodes at 2 ms are at
+        # 2 ms, not 2N ms (fusion_edge_draining stays summed — the count
+        # of currently-draining nodes in the process IS the operator
+        # signal during a rolling deploy)
+        global_metrics().set_aggregation("fusion_edge_reread_window_ms", "max")
         global_metrics().register_collector(self, EdgeNode._collect_metrics)
 
     # ------------------------------------------------------------------ metrics
@@ -542,7 +594,14 @@ class EdgeNode:
             "fusion_edge_fan_workers": self.fan_workers,
             "fusion_edge_evictions_total": self.evictions,
             "fusion_edge_resumes_total": self.resumes,
+            "fusion_edge_resumes_expired_total": self.resumes_expired,
             "fusion_edge_resubscribes_total": self.resubscribes,
+            "fusion_edge_drains_total": self.drains,
+            "fusion_edge_sessions_drained_total": self.sessions_drained,
+            "fusion_edge_draining": 1 if self._draining else 0,
+            "fusion_edge_reread_window_ms": round(
+                self.effective_reread_window() * 1e3, 3
+            ),
             "fusion_edge_upstream_fences_total": self.upstream_fences,
             "fusion_edge_upstream_errors_total": self.upstream_errors,
             "fusion_edge_upstream_rpcs_total": self.upstream_rpcs,
@@ -558,6 +617,9 @@ class EdgeNode:
             "fusion_edge_value_block_fences_total": self.block_fences,
             "fusion_edge_value_block_pending_bytes": self._block_pending_bytes,
         }
+        if self.admission is None:
+            for reason, count in self._shed_local.items():
+                out[f'fusion_edge_shed_total{{reason="{reason}"}}'] = count
         pool = self.worker_pool
         if pool is not None:
             # last-pulled worker aggregates (the pool's stats() refreshes
@@ -603,9 +665,20 @@ class EdgeNode:
             "fan_shards": [s.snapshot() for s in self._fan_shards],
             "evictions": self.evictions,
             "resumes": self.resumes,
+            "resumes_expired": self.resumes_expired,
             "resubscribes": self.resubscribes,
             "upstream_fences": self.upstream_fences,
             "upstream_errors": self.upstream_errors,
+            # overload safety (ISSUE 12): drain + admission state — an
+            # operator mid-deploy reads draining/drained first
+            "draining": self._draining,
+            "drains": self.drains,
+            "sessions_drained": self.sessions_drained,
+            "admission": (
+                self.admission.snapshot() if self.admission is not None
+                else {"shed": dict(self._shed_local)}
+            ),
+            "reread_window_ms": round(self.effective_reread_window() * 1e3, 3),
             # the upstream value plane (ISSUE 11): how this node's fences
             # were actually served — an operator reads block_hit_ratio
             # first (1.0 = zero per-key upstream RPCs on warm bursts)
@@ -738,15 +811,37 @@ class EdgeNode:
         mailbox: Optional[KeyedMailbox] = None,
         track_versions: bool = True,
         replay_current: bool = True,
+        tenant: str = "",
+        lane: Optional[str] = None,
+        admitted=None,
     ) -> EdgeSession:
         """Register one downstream session over ``keys``. Exactly one of
         ``sink`` (synchronous delivery) / ``mailbox`` (pump-drained) —
         see :class:`~.session.EdgeSession`. Each key's upstream
         subscription is created on FIRST use and shared by every later
         session (the single-upstream invariant). With ``replay_current``
-        the session immediately receives each key's latest known frame."""
+        the session immediately receives each key's latest known frame.
+
+        With an :class:`~.admission.AdmissionController` installed the
+        attach is ADMITTED OR SHED first (``tenant``/``lane`` feed the
+        decision; a shed raises :class:`AdmissionRejected`, counted) —
+        unless the transport already admitted and passes its decision as
+        ``admitted``. Without a controller only a drain refuses."""
         if self._closed:
             raise RuntimeError(f"edge node {self.name} is closed")
+        if self._draining:
+            # checked FIRST (even for pre-admitted/transport calls, and
+            # with no controller installed): a draining node answers a
+            # counted shed the transports turn into a 503 — never an
+            # uncounted exception that drops the socket
+            raise self._drain_rejection(lane)
+        if self.admission is not None and admitted is None:
+            decision = self.admission.admit(
+                tenant_id=tenant, lane=lane, keys=len(keys)
+            )
+            if not decision.admitted:
+                self._note_shed_event(decision.reason, lane=decision.lane)
+                raise AdmissionRejected(decision)
         if len(keys) > self.max_keys_per_session:
             raise ValueError(
                 f"session asks for {len(keys)} keys; this edge caps at "
@@ -908,18 +1003,35 @@ class EdgeNode:
                 self._teardown_sub(sub)
         return token
 
-    def resume(self, token: str, sink=None, mailbox=None) -> EdgeSession:
+    def resume(
+        self, token: str, sink=None, mailbox=None, tenant: str = "",
+        admitted=None,
+    ) -> EdgeSession:
         """Re-attach a parked session by its resume token (query param or
         SSE ``Last-Event-ID`` — every event carries the token as its id).
         Replays each key whose CURRENT version is newer than the last one
         this session saw (latest-wins: intermediates are gone by design —
         the monotonic versions say *whether* it missed, the live frame
         says *what is true now*). Raises ``KeyError`` on unknown/expired
-        tokens: the client falls back to a fresh attach."""
+        tokens: the client falls back to a fresh attach. With an
+        admission controller installed, resumes ride the RESERVED resume
+        lane (admitted ahead of cold attaches; shed only by a full gate,
+        the resume-rate bucket, or a drain)."""
         if (sink is None) == (mailbox is None):
             # validate BEFORE consuming the parked entry: a bad call must
             # not destroy the token's resume state or strand parked_refs
             raise ValueError("resume needs exactly one of sink= or mailbox=")
+        if self._draining:
+            # a hinted session must resume on the SUCCESSOR, not back
+            # here: re-attaching to a draining node would strand it with
+            # no hint when the caller closes the node (the drain hints
+            # each session exactly once) — shed, counted
+            raise self._drain_rejection(LANE_RESUME)
+        if self.admission is not None and admitted is None:
+            decision = self.admission.admit(tenant_id=tenant, lane=LANE_RESUME)
+            if not decision.admitted:
+                self._note_shed_event(decision.reason, lane=LANE_RESUME)
+                raise AdmissionRejected(decision)
         self._purge_parked()
         entry = self._parked.pop(token, None)
         if entry is None:
@@ -927,8 +1039,13 @@ class EdgeNode:
         key_strs, versions, deadline = entry
         if deadline < time.monotonic():
             # expired but not yet swept (the sweep is amortized): release
-            # its sub pins and reject like any unknown token
+            # the entry's parked refs IMMEDIATELY — a mass-reconnect storm
+            # of expired tokens must not pin upstream subscriptions until
+            # the next timer sweep (ISSUE 12 satellite; counted, and the
+            # sweep re-arms since there is evidence of expiry)
+            self.resumes_expired += 1
             self._drop_parked_refs(key_strs)
+            self._arm_sweep()
             raise KeyError(f"unknown or expired resume token {token!r}")
         session = EdgeSession(key_strs, sink=sink, mailbox=mailbox, token=token)
         if session.versions is not None:
@@ -1023,6 +1140,223 @@ class EdgeNode:
             except Exception:  # noqa: BLE001 — shutdown hooks must not bubble
                 log.exception("edge %s: on_evicted hook failed", self.name)
         return token
+
+    # ------------------------------------------------------------------ overload
+    def _fan_pressure(self) -> float:
+        """Fan-plane load signal, 0..1: pending distinct keys queued
+        across the fan shards against the configured depth. Registered as
+        an admission pressure source at construction."""
+        pending = sum(len(s._pending) for s in self._fan_shards)
+        return min(1.0, pending / self.pressure_fan_depth)
+
+    def effective_reread_window(self) -> float:
+        """The upstream re-read batching window, WIDENED under pressure
+        (ISSUE 12b): overload buys bigger recompute_batch frames — more
+        keys amortized per upstream RPC, higher latency — instead of
+        deeper queues and evictions. Returns to the configured baseline
+        the moment the pressure sources drop (pull-time, no hysteresis
+        state to get stuck)."""
+        base = self.reread_batch_window
+        adm = self.admission
+        if adm is None or base <= 0:
+            return base
+        p = adm.pressure()
+        if p <= 0.0:
+            return base
+        return base * (1.0 + self.pressure_widen * p)
+
+    def _note_shed_event(
+        self, reason: str, lane: Optional[str] = None, key: Optional[str] = None,
+    ) -> None:
+        """Journal one shed (the counter already moved — admission's
+        per-reason map, or count_shed's local fallback): explain()/an
+        operator can see WHO was turned away and why."""
+        if RECORDER.enabled:
+            RECORDER.note(
+                "edge_shed",
+                key=key,
+                detail=f"edge={self.name} reason={reason}"
+                + (f" lane={lane}" if lane else ""),
+            )
+
+    def _drain_rejection(self, lane: Optional[str] = None) -> AdmissionRejected:
+        """A COUNTED draining shed (attach/resume on a draining node —
+        with or without a controller installed): the transports turn the
+        carried decision into a 503 + Retry-After, in-process callers get
+        the typed exception."""
+        decision = AdmissionDecision(
+            False,
+            lane or LANE_ANONYMOUS,
+            "",
+            reason="draining",
+            retry_after=(
+                self.admission.retry_after
+                if self.admission is not None
+                else 1.0
+            ),
+        )
+        self.count_shed("draining", lane=decision.lane)
+        return AdmissionRejected(decision)
+
+    def count_shed(
+        self, reason: str, lane: Optional[str] = None, key: Optional[str] = None,
+    ) -> None:
+        """The transports' unified rejection counter (ISSUE 12
+        satellite): admission rejections, key-allowlist 400s,
+        replay-evicted 409s and dropped worker handoffs all land here —
+        counted in ``fusion_edge_shed_total{reason=}`` (through the
+        controller when installed, a node-local map otherwise) and
+        journaled. Never silent."""
+        if self.admission is not None:
+            self.admission.note_shed(reason)
+        else:
+            self._shed_local[reason] = self._shed_local.get(reason, 0) + 1
+        self._note_shed_event(reason, lane=lane, key=key)
+
+    async def drain(self, retry_after: Optional[float] = None) -> dict:
+        """Graceful drain for rolling deploys (ISSUE 12c): stop admitting
+        (the controller sheds ``draining``), ship every live session ONE
+        ``reconnect`` hint frame carrying its resume token (transports
+        forward it as an SSE ``event: reconnect`` / WS hint and close the
+        stream CLEANLY), park each session's delivered-version state, and
+        return the parked-state export a successor node adopts via
+        :meth:`import_parked`. Zero deliveries are lost across the
+        handoff: resume replay covers the gap (latest-wins — the
+        reconnected session sees the newest value of anything it missed).
+        Idempotent; the caller closes the node (and hands its listener
+        off) afterwards."""
+        export = None
+        if not self._draining:
+            self._draining = True
+            if self.admission is not None:
+                self.admission.begin_drain()
+            self.drains += 1
+            cause = f"drain:{self.name}"
+            pool = self.worker_pool
+            if pool is not None:
+                # the delivery plane first: worker-held SSE sessions get
+                # their reconnect hints + clean closes too (a pooled
+                # deployment's sessions are not the parent's _sessions)
+                try:
+                    self.sessions_drained += await pool.drain()
+                except Exception:  # noqa: BLE001 — a wedged pool must
+                    # not stop the parent-side drain
+                    log.exception(
+                        "edge %s: worker pool drain failed", self.name
+                    )
+            sessions = list(self._sessions)
+            for session in sessions:
+                hint: Frame = (
+                    DRAIN_KEY,
+                    0,
+                    {"resume": session.token, "retry_after": retry_after},
+                    cause,
+                    None,
+                    None,
+                )
+                try:
+                    if session.on_drain is not None:
+                        # transport hook: write the reconnect event and
+                        # wind the connection down cleanly (not abort —
+                        # the hint must reach the peer)
+                        session.on_drain(hint)
+                    else:
+                        session.deliver(hint)
+                except Exception:  # noqa: BLE001 — one broken consumer
+                    # must not stop the drain for its siblings
+                    log.exception(
+                        "edge %s: drain hint failed for a session", self.name
+                    )
+                self.detach(session, park=True)
+                self.sessions_drained += 1
+            if RECORDER.enabled:
+                RECORDER.note(
+                    "edge_drained",
+                    key=None,
+                    count=len(sessions),
+                    detail=(
+                        f"edge={self.name} sessions={len(sessions)} parked "
+                        f"for resume (rolling deploy)"
+                    ),
+                )
+            # one loop tick: transports flush their reconnect hints before
+            # the caller tears the listener/process down
+            await asyncio.sleep(0)
+        export = self.export_parked()
+        return export
+
+    def export_parked(self) -> dict:
+        """The drain handoff payload: every parked token with its key
+        SPECS (method + args — a successor node must be able to re-mint
+        the subscriptions) and remaining TTL. Wire-serializable (JSON)."""
+        now = time.monotonic()
+        parked = []
+        for token, (key_strs, versions, deadline) in self._parked.items():
+            specs = []
+            for ks in key_strs:
+                sub = self._subs.get(ks)
+                specs.append(
+                    [sub.method, list(sub.args)] if sub is not None else None
+                )
+            parked.append(
+                {
+                    "token": token,
+                    "specs": specs,
+                    "ttl": max(0.0, deadline - now),
+                }
+            )
+        return {"name": self.name, "service": self.service, "parked": parked}
+
+    def import_parked(self, state: dict) -> int:
+        """Adopt a drained sibling's parked sessions (the rolling-restart
+        successor): each token's keys are re-pinned here (parked refs mint
+        the upstream subscriptions, so the successor is already watching
+        before anyone resumes) and the delivered-version maps reset to
+        ZERO — this node's per-key versions restart, so a resume replays
+        every key: correct (latest-wins hands the newest value), just not
+        minimal, which is exactly what zero-loss across a restart needs.
+        Keys that fail this node's allowlist are skipped (counted as a
+        shed — a drain export is still client-named key state). Returns
+        the number of tokens adopted."""
+        now = time.monotonic()
+        adopted = 0
+        for entry in state.get("parked", []):
+            token = entry.get("token")
+            if not token or token in self._parked:
+                continue
+            # honor the EXPORTED remaining TTL (capped at this node's
+            # resume_ttl): an entry that was a second from expiry on the
+            # exporter must not get a fresh full lease here — on a mass
+            # drain that would re-pin the whole parked population's
+            # upstream subs for clients that will never return. Already-
+            # expired entries are not adopted at all.
+            ttl = min(float(entry.get("ttl", 0.0)), self.resume_ttl)
+            if ttl <= 0.0:
+                continue
+            key_strs = []
+            for spec in entry.get("specs", []):
+                if not spec:
+                    continue
+                try:
+                    method, args = self._normalize(
+                        (spec[0], *tuple(spec[1]))
+                    )
+                except (ValueError, TypeError):
+                    self.count_shed("import_rejected")
+                    continue
+                ks = call_key(self.service, method, args)
+                sub = self._sub_for(ks, method, args)
+                sub.parked_refs += 1
+                key_strs.append(ks)
+            self._parked[token] = (tuple(key_strs), {}, now + ttl)
+            adopted += 1
+        if adopted:
+            self._arm_sweep()
+        return adopted
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     def _teardown_sub(self, sub: _KeySub) -> None:
         sub.closed = True
@@ -1640,4 +1974,10 @@ class EdgeNode:
                 self.router.on_map_change.remove(self._on_map_change)
             except ValueError:
                 pass
+        if self.admission is not None:
+            # a SHARED controller must stop reading this node's fan
+            # shards: close() leaves their _pending populated, so a stale
+            # bound-method source would report phantom pressure (and pin
+            # the node graph) forever
+            self.admission.clear_pressure(f"{self.name}:fan_shards")
         global_metrics().unregister_collector(self)

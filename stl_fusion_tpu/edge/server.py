@@ -41,8 +41,9 @@ import logging
 import urllib.parse
 from typing import Optional
 
+from .admission import LANE_RESUME, AdmissionRejected, rejection_bytes
 from .gateway import EdgeNode
-from .session import KeyedMailbox, pump_payloads
+from .session import KeyedMailbox, frame_to_dict, pump_payloads
 
 log = logging.getLogger("stl_fusion_tpu")
 
@@ -104,6 +105,14 @@ class EdgeHttpServer:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    async def drain(self) -> None:
+        """Hand the listen port off (rolling deploy, ISSUE 12c): stop
+        ACCEPTING — the successor process can bind — while live streams
+        stay up until ``node.drain()`` hints them to reconnect. Call this
+        first, then ``await node.drain()``, then :meth:`stop`."""
+        if self._server is not None:
+            self._server.close()  # idempotent; stop() finishes the teardown
+
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
@@ -120,6 +129,44 @@ class EdgeHttpServer:
         from ..rpc.http_gateway import FusionHttpServer
 
         await FusionHttpServer._write_json(writer, status, payload)
+
+    async def _reject(
+        self, writer, status: str, payload: dict, reason: str,
+        retry_after=None, count: bool = True, note: bool = True,
+    ) -> None:
+        """The unified COUNTED rejection responder (ISSUE 12 satellite):
+        admission 503s, key-allowlist/bad-spec 400s, replay-evicted 409s
+        and expired-resume 410s all ride one path — correct Retry-After +
+        ``Connection: close`` headers, one ``fusion_edge_shed_total``
+        count per response, one journal note. ``count=False`` for
+        rejections the admission controller already counted (admit()
+        moved the per-reason counter; double counting would make the shed
+        totals lie); ``note=False`` when the raiser already journaled
+        too (EdgeNode's draining shed)."""
+        node = self.node
+        if count:
+            node.count_shed(reason)
+        elif note:
+            node._note_shed_event(reason)
+        writer.write(rejection_bytes(status, payload, retry_after))
+        try:
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass  # the peer is already gone; the count stands
+
+    async def _reject_admission(self, writer, decision, note=True) -> None:
+        await self._reject(
+            writer, "503 Service Unavailable",
+            {"error": {
+                "type": "AdmissionRejected",
+                "reason": decision.reason,
+                "retry_after": decision.retry_after,
+            }},
+            reason=decision.reason,
+            retry_after=decision.retry_after,
+            count=False,  # the controller/node already counted this shed
+            note=note,
+        )
 
     @staticmethod
     def _is_loopback(writer) -> bool:
@@ -183,53 +230,113 @@ class EdgeHttpServer:
         try:
             keys = _parse_keys(query.get("keys", [None])[0])
         except (ValueError, TypeError) as e:
-            await self._write_json(
+            await self._reject(
                 writer, "400 Bad Request",
                 {"error": {"type": "BadRequest", "message": str(e)}},
+                reason="bad_request",
             )
             return
+        # -- admission (ISSUE 12a): admit or shed BEFORE any session/
+        # upstream state exists. Tenant rides the request head (?tenant=
+        # or X-Tenant), resolved through the controller's TenantResolver;
+        # reconnects ride the reserved resume lane. The gate slot is HELD
+        # across attach + replay (the expensive setup), released when the
+        # stream starts.
+        admission = node.admission
+        tenant_id = (
+            query.get("tenant", [None])[0] or headers.get("x-tenant") or ""
+        )
+        decision = None
+        if admission is not None:
+            # the reserved resume lane (and its global bucket) only for a
+            # token this node actually PARKED: a forged/expired
+            # ?resume=<garbage> is a cold attach — granting the lane on
+            # the token's mere presence would let a flood of garbage
+            # tokens bypass the per-tenant buckets AND starve the resume
+            # bucket genuine post-deploy reconnects depend on
+            decision = admission.admit(
+                tenant_id=tenant_id,
+                lane=LANE_RESUME if (token and token in node._parked) else None,
+                keys=len(keys),
+                hold=True,
+            )
+            if not decision.admitted:
+                await self._reject_admission(writer, decision)
+                return
         mailbox = KeyedMailbox(max_pending=node.max_pending)
         session = None
-        if token:
-            try:
-                session = node.resume(token, mailbox=mailbox)
-            except KeyError:
-                session = None  # expired: fall back to a fresh attach below
-        if session is None:
-            if not keys:
-                await self._write_json(
-                    writer, "410 Gone",
+        try:
+            if token:
+                try:
+                    session = node.resume(
+                        token, mailbox=mailbox, admitted=decision
+                    )
+                except KeyError:
+                    session = None  # expired: fresh attach below
+                    if admission is not None and decision.lane == LANE_RESUME:
+                        # admitted on the RESERVED resume lane but the
+                        # park vanished between the admit and the resume
+                        # (expired/raced): this is a COLD attach now —
+                        # re-admit on the cold lane so the request pays
+                        # the per-tenant buckets/pressure/ceiling like
+                        # any other (a cold-lane admission stands as-is)
+                        admission.release(decision)
+                        decision = admission.admit(
+                            tenant_id=tenant_id, lane=None,
+                            keys=len(keys), hold=True,
+                        )
+                        if not decision.admitted:
+                            await self._reject_admission(writer, decision)
+                            return
+            if session is None:
+                if not keys:
+                    await self._reject(
+                        writer, "410 Gone",
+                        {"error": {
+                            "type": "ResumeExpired",
+                            "message": "token unknown/expired and no keys= given",
+                        }},
+                        reason="resume_expired",
+                    )
+                    return
+                try:
+                    session = node.attach(keys, mailbox=mailbox, admitted=decision)
+                except (ValueError, TypeError) as e:
+                    # allowlist rejection / per-session key cap / bad specs —
+                    # the CLIENT's bad input, answered, never a dropped socket
+                    await self._reject(
+                        writer, "400 Bad Request",
+                        {"error": {"type": "BadRequest", "message": str(e)}},
+                        reason="bad_request",
+                    )
+                    return
+            if session.evicted:
+                # the attach/resume REPLAY itself evicted the session (mailbox
+                # bound smaller than the key set): answer loudly — streaming
+                # would be exactly the silent heartbeat-alive dead
+                # subscription the eviction hook exists to prevent
+                await self._reject(
+                    writer, "409 Conflict",
                     {"error": {
-                        "type": "ResumeExpired",
-                        "message": "token unknown/expired and no keys= given",
+                        "type": "Evicted",
+                        "message": "replay overflowed the session outbox "
+                                   "(more keys than max_pending?)",
+                        "resume": session.token,
                     }},
+                    reason="replay_evicted",
                 )
                 return
-            try:
-                session = node.attach(keys, mailbox=mailbox)
-            except (ValueError, TypeError) as e:
-                # allowlist rejection / per-session key cap / bad specs —
-                # the CLIENT's bad input, answered, never a dropped socket
-                await self._write_json(
-                    writer, "400 Bad Request",
-                    {"error": {"type": "BadRequest", "message": str(e)}},
-                )
-                return
-        if session.evicted:
-            # the attach/resume REPLAY itself evicted the session (mailbox
-            # bound smaller than the key set): answer loudly — streaming
-            # would be exactly the silent heartbeat-alive dead
-            # subscription the eviction hook exists to prevent
-            await self._write_json(
-                writer, "409 Conflict",
-                {"error": {
-                    "type": "Evicted",
-                    "message": "replay overflowed the session outbox "
-                               "(more keys than max_pending?)",
-                    "resume": session.token,
-                }},
-            )
+        except AdmissionRejected as e:
+            # the NODE refused (a draining edge — with or without a
+            # controller installed): answered 503 + Retry-After, counted
+            # by the raiser, never a dropped socket
+            await self._reject_admission(writer, e.decision, note=False)
             return
+        finally:
+            # the gate covers head-read -> attach -> replay; streaming is
+            # bounded by the session machinery itself
+            if admission is not None:
+                admission.release(decision)
         self.connections += 1
         sid = session.token
         writer.write(
@@ -282,7 +389,25 @@ class EdgeHttpServer:
             if not pump_task.done():
                 pump_task.cancel()
 
+        def drain_hint(frame) -> None:
+            # EdgeNode.drain(): write the reconnect hint — the resume
+            # token rides the data payload AND the id line — then wind
+            # the pump down; the handler's normal teardown CLOSES (not
+            # aborts) the stream so the hint reaches the peer
+            try:
+                payload = json.dumps(
+                    frame_to_dict(frame), separators=(",", ":")
+                )
+                writer.write(
+                    f"id: {sid}\nevent: reconnect\ndata: {payload}\n\n".encode()
+                )
+            except Exception:  # noqa: BLE001 — a dying peer mid-drain
+                pass
+            if not pump_task.done():
+                pump_task.cancel()
+
         session.on_evicted = shutdown_transport
+        session.on_drain = drain_hint
         self._pumps.add(pump_task)
         try:
             outcome = await pump_task
@@ -344,6 +469,19 @@ class EdgeWebSocketServer:
     def url(self) -> str:
         return f"ws://{self.host}:{self.port}/edge/ws"
 
+    async def drain(self) -> None:
+        """Stop accepting (the SSE twin's rolling-deploy contract): live
+        WS streams stay up until ``node.drain()`` hints them. Unlike
+        asyncio's plain ``Server.close()``, the websockets server's
+        default also closes every OPEN connection — which would kill the
+        streams BEFORE the reconnect hints could reach them — so the
+        listener alone is closed here."""
+        if self._server is not None:
+            try:
+                self._server.close(close_connections=False)
+            except TypeError:  # older websockets: no kwarg; stop() will
+                self._server.close()  # close everything at teardown anyway
+
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
@@ -354,41 +492,112 @@ class EdgeWebSocketServer:
         node = self.node
         loop = asyncio.get_running_loop()
         try:
-            first = json.loads(await ws.recv())
+            raw = await ws.recv()
+        except Exception:  # noqa: BLE001 — the peer left before a hello
+            # (health probes, stray disconnects): a normal exit, NOT a
+            # shed — counting it would pollute bad_request on a healthy
+            # node and make the counters untrustworthy
+            return
+        try:
+            first = json.loads(raw)
             if not isinstance(first, dict):
                 raise ValueError("hello must be a JSON object")
         except Exception as e:  # noqa: BLE001 — bad hello: answer, close
+            node.count_shed("bad_request")
             try:
                 await ws.send(json.dumps({"error": f"bad hello: {e}"}))
             except Exception:  # noqa: BLE001 — peer already gone
                 pass
             await ws.close()
             return
+        token = first.get("resume")
+        # -- admission (ISSUE 12a): the WS twin of the SSE path — tenant
+        # rides the hello ({"tenant": ...}), reconnects the resume lane; a
+        # shed answers a CLEAN error frame + close 1013 (Try Again Later),
+        # never a dropped socket
+        async def reject_ws(decision) -> None:
+            # the WS twin of _reject_admission: ONE clean error frame +
+            # close 1013 (Try Again Later) — every WS shed rides it
+            try:
+                await ws.send(json.dumps({
+                    "error": "admission rejected",
+                    "reason": decision.reason,
+                    "retry_after": decision.retry_after,
+                }))
+            finally:
+                await ws.close(code=1013)
+
+        admission = node.admission
+        decision = None
+        if admission is not None:
+            raw_keys = first.get("keys")
+            # resume lane only for a token this node PARKED (the SSE rule)
+            decision = admission.admit(
+                tenant_id=first.get("tenant") or "",
+                lane=LANE_RESUME if (token and token in node._parked) else None,
+                keys=len(raw_keys) if isinstance(raw_keys, list) else 0,
+                hold=True,
+            )
+            if not decision.admitted:
+                node._note_shed_event(decision.reason, lane=decision.lane)
+                await reject_ws(decision)
+                return
         mailbox = KeyedMailbox(max_pending=node.max_pending)
         session = None
-        token = first.get("resume")
-        if token:
-            try:
-                session = node.resume(token, mailbox=mailbox)
-            except KeyError:
-                session = None
-        if session is None:
-            try:
-                keys = _validate_keys(first.get("keys", []))
-                if not keys:
-                    raise ValueError("no keys and no valid resume token")
-                session = node.attach(keys, mailbox=mailbox)
-            except (ValueError, TypeError) as e:
-                await ws.send(json.dumps({"error": str(e)}))
+        try:
+            if token:
+                try:
+                    session = node.resume(
+                        token, mailbox=mailbox, admitted=decision
+                    )
+                except KeyError:
+                    session = None
+                    if admission is not None and decision.lane == LANE_RESUME:
+                        # resume-lane admission whose park vanished (the
+                        # SSE twin's rule): re-admit as the cold attach
+                        # it now is; a cold-lane admission stands as-is
+                        admission.release(decision)
+                        raw_keys = first.get("keys")
+                        decision = admission.admit(
+                            tenant_id=first.get("tenant") or "",
+                            lane=None,
+                            keys=len(raw_keys)
+                            if isinstance(raw_keys, list) else 0,
+                            hold=True,
+                        )
+                        if not decision.admitted:
+                            node._note_shed_event(
+                                decision.reason, lane=decision.lane
+                            )
+                            await reject_ws(decision)
+                            return
+            if session is None:
+                try:
+                    keys = _validate_keys(first.get("keys", []))
+                    if not keys:
+                        raise ValueError("no keys and no valid resume token")
+                    session = node.attach(keys, mailbox=mailbox, admitted=decision)
+                except (ValueError, TypeError) as e:
+                    node.count_shed("bad_request")
+                    await ws.send(json.dumps({"error": str(e)}))
+                    await ws.close()
+                    return
+            if session.evicted:  # replay overflow: same contract as SSE's 409
+                node.count_shed("replay_evicted")
+                await ws.send(
+                    json.dumps({"error": "replay overflowed the session outbox",
+                                "resume": session.token})
+                )
                 await ws.close()
                 return
-        if session.evicted:  # replay overflow: same contract as SSE's 409
-            await ws.send(
-                json.dumps({"error": "replay overflowed the session outbox",
-                            "resume": session.token})
-            )
-            await ws.close()
+        except AdmissionRejected as e:
+            # the NODE refused (a draining edge): a clean answered close,
+            # counted by the raiser
+            await reject_ws(e.decision)
             return
+        finally:
+            if admission is not None:
+                admission.release(decision)
         async def send(batch) -> None:
             # the frame bodies are the node's shared serialize-once cache
             # (decoded to str at most once per (key, version)); only the
@@ -426,7 +635,24 @@ class EdgeWebSocketServer:
             if not pump_task.done():
                 pump_task.cancel()
 
+        def drain_hint(frame) -> None:
+            # EdgeNode.drain(): send the reconnect hint as its own frame,
+            # then close 1001 (Going Away) — the peer reconnects with the
+            # carried resume token; never an abort (the hint must arrive)
+            async def _send_and_close() -> None:
+                try:
+                    await ws.send(
+                        json.dumps({"reconnect": frame_to_dict(frame)})
+                    )
+                finally:
+                    await ws.close(code=1001)
+
+            loop.create_task(_send_and_close())
+            if not pump_task.done():
+                pump_task.cancel()
+
         session.on_evicted = shutdown_transport
+        session.on_drain = drain_hint
         self.connections += 1
         # EVERY await from here on sits under the finally: a peer that
         # drops right after subscribing (the hello send raising) must

@@ -362,6 +362,7 @@ class EdgeSession:
         "evicted",
         "delivered",
         "on_evicted",
+        "on_drain",
         "shard",
     )
 
@@ -391,6 +392,12 @@ class EdgeSession:
         #: sink) still aborts the connection instead of leaving the peer
         #: on a silent, heartbeat-alive stream that will never update
         self.on_evicted: Optional[Callable[[], None]] = None
+        #: drain hook (ISSUE 12c): EdgeNode.drain() calls it with the
+        #: reconnect hint frame INSTEAD of the sink/mailbox — transports
+        #: write the hint and close the stream CLEANLY (the peer must
+        #: receive its resume token, so this is never an abort); sessions
+        #: without a hook get the hint through their normal surface
+        self.on_drain: Optional[Callable[[Frame], None]] = None
 
     def deliver(self, frame: Frame) -> bool:
         """Hand one frame to this session. Returns False when the session

@@ -82,6 +82,22 @@ __all__ = ["EdgeWorkerPool"]
 _HEADER = struct.Struct("!BI")
 _FRAME = struct.Struct("!IId")  # key_id, version, t0 (-1.0 = none)
 
+#: the worker's drain-time 503 for connections caught MID-ATTACH — the
+#: same wire shape as edge.admission.rejection_bytes (status, JSON body,
+#: Retry-After, Connection: close), inlined because the worker half of
+#: this file is stdlib-only and cannot import the package
+_DRAIN_503_BODY = (
+    b'{"error":{"type":"AdmissionRejected","reason":"draining",'
+    b'"retry_after":1}}'
+)
+_DRAIN_503 = (
+    b"HTTP/1.1 503 Service Unavailable\r\n"
+    b"Content-Type: application/json\r\n"
+    b"Content-Length: " + str(len(_DRAIN_503_BODY)).encode() + b"\r\n"
+    b"Cache-Control: no-cache\r\nConnection: close\r\nRetry-After: 1"
+    b"\r\n\r\n" + _DRAIN_503_BODY
+)
+
 # log-scale histogram buckets — MUST mirror diagnostics.metrics.Histogram
 # (lo * 2^k up to hi, + overflow) so the parent can merge worker counts
 # into fusion_edge_delivery_ms bucket-for-bucket
@@ -236,6 +252,21 @@ class EdgeWorkerPool:
         self.routed_conns = 0  # fds handed to workers
         self.routed_by_token = 0  # of which: placed by a resume token
         self.route_errors = 0
+        self.shed_conns = 0  # admission/overload rejections answered 503
+        #: tokens the workers reported PARKED (disconnect `D` messages
+        #: carry them): the accept plane grants the reserved resume lane
+        #: only to a token it knows is genuinely parked — a forged
+        #: ``?resume=es-w0-x`` rides the cold lane like any other cold
+        #: attach. token -> expiry (resume_ttl), amortized prune.
+        self._parked_tokens: Dict[str, float] = {}
+        self._next_token_prune = 0.0
+        #: recent dropped-handoff timestamps — the worker-pipe saturation
+        #: signal (ISSUE 12b): registered as an admission pressure source
+        #: at start(); ``drop_pressure_threshold`` drops inside
+        #: ``drop_pressure_window`` seconds reads as FULL pressure
+        self._drop_times: List[float] = []
+        self.drop_pressure_window = 5.0
+        self.drop_pressure_threshold = 8
         #: cumulative deliveries last pulled from workers (sync-readable
         #: by the node's metrics collector)
         self.deliveries_seen = 0
@@ -274,13 +305,36 @@ class EdgeWorkerPool:
         self._started = True
         self.node.worker_pool = self
         self.node.attach_broadcast(self._on_frame)
+        admission = getattr(self.node, "admission", None)
+        if admission is not None:
+            # worker-pipe saturation feeds the admission controller: a
+            # wedged delivery worker costs dropped handoffs (already
+            # counted in route_errors), and the drop rate IS the load
+            # signal that sheds anonymous cold attaches upstream of it
+            admission.add_pressure_source(
+                f"{self.node.name}:worker_pipe", self._pipe_pressure
+            )
         return self
+
+    # -------------------------------------------------------------- pressure
+    def _note_drop(self) -> None:
+        self._drop_times.append(time.monotonic())
+
+    def _pipe_pressure(self) -> float:
+        """0..1 worker-pipe saturation from recent dropped fd-handoffs
+        (pruned to the window on every pull)."""
+        cutoff = time.monotonic() - self.drop_pressure_window
+        self._drop_times = [t for t in self._drop_times if t >= cutoff]
+        return min(1.0, len(self._drop_times) / self.drop_pressure_threshold)
 
     async def stop(self) -> None:
         if not self._started:
             return
         self._started = False
         self.node.detach_broadcast(self._on_frame)
+        admission = getattr(self.node, "admission", None)
+        if admission is not None:
+            admission.clear_pressure(f"{self.node.name}:worker_pipe")
         if self.node.worker_pool is self:
             self.node.worker_pool = None
         if self._accept_task is not None:
@@ -341,6 +395,53 @@ class EdgeWorkerPool:
         self.node.release_keys(self._sim_acquired)
         self._sim_acquired.clear()
         self._workers.clear()
+
+    async def drain(self) -> int:
+        """The delivery plane's half of a graceful drain (ISSUE 12c):
+        stop accepting (both planes — the parent listener closes, each
+        worker closes its REUSEPORT listener), then every worker writes
+        its live SSE connections ONE ``event: reconnect`` hint carrying
+        the session's resume token and closes them cleanly, parking the
+        delivered-version maps. Returns the number of connections
+        hinted. Called by :meth:`EdgeNode.drain` — a pooled deployment's
+        sessions are NOT stranded when the node drains."""
+        if not self._started:
+            return 0
+        if self._accept_task is not None:
+            self._accept_task.cancel()
+            self._accept_task = None
+        if self._listen_sock is not None:
+            try:
+                self._listen_sock.close()
+            except OSError:
+                pass
+            self._listen_sock = None
+        loop = asyncio.get_event_loop()
+        self._stats_seq += 1
+        seq = self._stats_seq
+        futures = []
+        for w in self._workers:
+            fut = loop.create_future()
+            w.stats_futures[seq] = fut
+            w.send_json(b"Y", {"seq": seq})
+            futures.append(fut)
+        self._flush_all()
+        # per-future harvest: ONE wedged worker missing the deadline must
+        # not discard the healthy workers' counts (their sessions WERE
+        # hinted — under-reporting sessions_drained would make the drain
+        # accounting unreconcilable); its own clients reconnect on the
+        # dead socket instead
+        await asyncio.wait(futures, timeout=self.stats_timeout)
+        total = 0
+        for w, fut in zip(self._workers, futures):
+            if fut.done() and not fut.cancelled() and fut.exception() is None:
+                total += int(fut.result().get("drained", 0))
+            else:
+                log.warning(
+                    "edge worker %d never acked the drain", w.index
+                )
+                fut.cancel()
+        return total
 
     # -------------------------------------------------------------- flushing
     def _kick_flush(self) -> None:
@@ -462,12 +563,15 @@ class EdgeWorkerPool:
             raise
 
     async def _route_conn(self, conn: socket.socket) -> None:
-        """Read one accepted connection's request head (bounded), pick
-        the worker — the resume token's minted ordinal when present, else
-        round-robin — and hand the fd + head over ``socket.send_fds``.
-        The worker receives a DUPLICATE fd; the parent's copy closes
-        either way, so a handoff failure costs the client one reconnect,
-        never a leaked socket."""
+        """Read one accepted connection's request head (bounded), admit
+        or shed it (the node's AdmissionController — tenant from the head,
+        reconnects on the resume lane), pick the worker — the resume
+        token's minted ordinal when present, else round-robin — and hand
+        the fd + head over ``socket.send_fds``. The worker receives a
+        DUPLICATE fd; the parent's copy closes either way, so a handoff
+        failure costs the client one ANSWERED 503 (never a hung socket —
+        ISSUE 12 satellite: a dropped handoff is pressure, not a silent
+        failure)."""
         loop = asyncio.get_event_loop()
         try:
             conn.setblocking(False)
@@ -483,7 +587,28 @@ class EdgeWorkerPool:
             if b"\r\n\r\n" not in head:
                 self.route_errors += 1  # oversized/garbage head: drop, counted
                 return
-            index, by_token = self._route_index(head)
+            token, tenant = self._extract_route_info(head)
+            index, by_token = self._route_index(token)
+            admission = getattr(self.node, "admission", None)
+            if admission is not None:
+                # the resume lane is only for tokens this parent KNOWS a
+                # worker parked (disconnect messages report them): a
+                # forged/expired ?resume= is a cold attach and must ride
+                # the cold lane's buckets, pressure shed and ceiling —
+                # the token shape alone is guessable and proves nothing
+                decision = admission.admit(
+                    tenant_id=tenant,
+                    lane="resume" if self._token_parked(token) else None,
+                )
+                if not decision.admitted:
+                    self.shed_conns += 1
+                    self.node._note_shed_event(
+                        decision.reason, lane=decision.lane
+                    )
+                    await self._answer_reject(
+                        conn, decision.reason, decision.retry_after
+                    )
+                    return
             w = self._workers[index]
             if w.fd_sock is None:
                 # the owner's fd channel died (torn handoff): fail over
@@ -496,16 +621,41 @@ class EdgeWorkerPool:
                         w = sibling
                         break
                 else:
+                    # every delivery worker's channel is gone: shed with
+                    # an answer + Retry-After, count it as pipe pressure
                     self.route_errors += 1
+                    self._note_drop()
+                    self.node.count_shed("worker_pipe_drop")
+                    await self._answer_reject(conn, "worker_unavailable", None)
                     return
             payload = json.dumps(
                 {"head": base64.b64encode(head).decode()}
             ).encode()
             framed = struct.pack("!I", len(payload)) + payload
-            await self._send_handoff(w, framed, conn.fileno())
+            try:
+                await self._send_handoff(w, framed, conn.fileno())
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — wedged worker / torn channel
+                # the PR 11 dropped-handoff path: the client used to get a
+                # closed-without-answer socket; now the PARENT answers 503
+                # with Retry-After, the drop feeds the admission pressure
+                # signal, and the count is never silent
+                self.route_errors += 1
+                self._note_drop()
+                self.node.count_shed("worker_pipe_drop")
+                log.exception(
+                    "edge accept plane: fd handoff to worker %d dropped",
+                    w.index,
+                )
+                await self._answer_reject(conn, "worker_pipe_drop", None)
+                return
             self.routed_conns += 1
             if by_token:
                 self.routed_by_token += 1
+                # one shot: the worker consumes the park on resume, so a
+                # replayed token is a cold attach from here on
+                self._parked_tokens.pop(token, None)
         except (asyncio.TimeoutError, asyncio.CancelledError):
             pass
         except Exception:  # noqa: BLE001 — one conn must not kill the plane
@@ -516,6 +666,28 @@ class EdgeWorkerPool:
                 conn.close()
             except OSError:
                 pass
+
+    async def _answer_reject(
+        self, conn: socket.socket, reason: str, retry_after: Optional[float],
+    ) -> None:
+        """Best-effort 503 on a raw accepted socket — the SAME responder
+        bytes (headers, Retry-After, Connection: close) as the SSE
+        server's unified rejection path, so a client cannot tell which
+        plane shed it."""
+        from .admission import rejection_bytes
+
+        data = rejection_bytes(
+            "503 Service Unavailable",
+            {"error": {"type": "AdmissionRejected", "reason": reason,
+                       "retry_after": retry_after}},
+            retry_after if retry_after is not None else 1.0,
+        )
+        try:
+            await asyncio.wait_for(
+                asyncio.get_event_loop().sock_sendall(conn, data), 2.0
+            )
+        except Exception:  # noqa: BLE001 — the peer is gone; count stands
+            pass
 
     async def _send_handoff(self, w: _Worker, framed: bytes, fd: int,
                             timeout: float = 10.0) -> None:
@@ -558,6 +730,20 @@ class EdgeWorkerPool:
                         pass
                     raise
 
+    def _token_parked(self, token: Optional[str]) -> bool:
+        """Is this a token a worker reported parked (and unexpired)?
+        Amortized prune, the gateway's sweep shape."""
+        if token is None or not self._parked_tokens:
+            return False
+        now = time.monotonic()
+        if now >= self._next_token_prune:
+            self._next_token_prune = now + max(1.0, self.resume_ttl / 4)
+            self._parked_tokens = {
+                t: dl for t, dl in self._parked_tokens.items() if dl >= now
+            }
+        deadline = self._parked_tokens.get(token)
+        return deadline is not None and deadline >= now
+
     @staticmethod
     async def _wait_writable(sock: socket.socket, timeout: float) -> None:
         loop = asyncio.get_event_loop()
@@ -576,27 +762,40 @@ class EdgeWorkerPool:
         finally:
             loop.remove_writer(fd)
 
-    def _route_index(self, head: bytes):
-        """(worker index, routed-by-token) for one request head. The
-        token's ``es-w<N>-`` prefix names the worker that minted (and
-        parked) it; anything else round-robins."""
+    @staticmethod
+    def _extract_route_info(head: bytes):
+        """ONE pass over the request head for the accept plane's two
+        identities: the resume token (``resume=`` / ``Last-Event-ID``,
+        the routing AND lane identity) and the tenant id (``tenant=`` /
+        ``X-Tenant`` — the SAME wire contract as EdgeHttpServer's
+        admission hop). Returns ``(token, tenant)``."""
+        from urllib.parse import unquote
+
         token = None
+        tenant = ""
         request_line, _, rest = head.partition(b"\r\n")
         parts = request_line.decode("latin-1", "replace").split(" ")
         if len(parts) >= 2:
             _path, _, query = parts[1].partition("?")
             for pair in query.split("&"):
                 k, _, v = pair.partition("=")
-                if k == "resume" and v:
-                    from urllib.parse import unquote
-
+                if k == "resume" and v and token is None:
                     token = unquote(v)
-                    break
-        if token is None:
+                elif k == "tenant" and v and not tenant:
+                    tenant = unquote(v)
+        if token is None or not tenant:
             for line in rest.split(b"\r\n"):
-                if line.lower().startswith(b"last-event-id:"):
+                low = line.lower()
+                if token is None and low.startswith(b"last-event-id:"):
                     token = line.split(b":", 1)[1].strip().decode("latin-1")
-                    break
+                elif not tenant and low.startswith(b"x-tenant:"):
+                    tenant = line.split(b":", 1)[1].strip().decode("latin-1")
+        return token, tenant
+
+    def _route_index(self, token: Optional[str]):
+        """(worker index, routed-by-token) for one extracted token. The
+        token's ``es-w<N>-`` prefix names the worker that minted (and
+        parked) it; anything else round-robins."""
         if token is not None and token.startswith("es-w"):
             ordinal, _, _tail = token[4:].partition("-")
             if ordinal.isdigit():
@@ -678,6 +877,8 @@ class EdgeWorkerPool:
             "routed_conns": self.routed_conns,
             "routed_by_token": self.routed_by_token,
             "route_errors": self.route_errors,
+            "shed_conns": self.shed_conns,
+            "pipe_pressure": round(self._pipe_pressure(), 4),
             "deliveries": self.deliveries_seen,
             "per_worker": [w.last_stats for w in self._workers],
         }
@@ -721,8 +922,30 @@ class EdgeWorkerPool:
         cached frames (the attach replay, base64 over the control
         channel)."""
         conn = req.get("conn")
+        admission = getattr(self.node, "admission", None)
+        specs = [tuple(k) for k in req.get("keys", [])]
+        if admission is not None and not req.get("resumed") and specs:
+            # the per-tenant subscribe-rate debit this plane DEFERRED at
+            # the accept hop (the key specs were not readable there):
+            # same bucket as the SSE plane; resumed sessions replay and
+            # are exempt. Counted ONCE (admit_keys moved the per-reason
+            # counter — this must NOT fall into the bad_request path
+            # below, which would double-count the one rejection under
+            # two reasons); the worker answers the unified 503 shape.
+            verdict = admission.admit_keys(
+                tenant_id=req.get("tenant") or "", keys=len(specs)
+            )
+            if not verdict.admitted:
+                self.node._note_shed_event(verdict.reason)
+                w.send_json(b"A", {
+                    "conn": conn,
+                    "error": f"admission rejected ({verdict.reason})",
+                    "status": 503,
+                    "retry_after": verdict.retry_after,
+                })
+                self._kick_flush()
+                return
         try:
-            specs = [tuple(k) for k in req.get("keys", [])]
             if not specs:
                 raise ValueError("no keys")
             if len(specs) > self.node.max_keys_per_session:
@@ -732,6 +955,9 @@ class EdgeWorkerPool:
                 )
             key_strs = self.node.acquire_keys(specs)
         except Exception as e:  # noqa: BLE001 — the CLIENT's bad input
+            # counted on the SAME shed taxonomy as the SSE plane's 400s
+            # (the worker answers the HTTP 400; the parent owns the count)
+            self.node.count_shed("bad_request")
             w.send_json(b"A", {"conn": conn, "error": str(e)})
             self._kick_flush()
             return
@@ -763,6 +989,12 @@ class EdgeWorkerPool:
         self._kick_flush()
 
     def _handle_disconnect(self, w: _Worker, req: dict) -> None:
+        token = req.get("token")
+        if token:
+            # the worker parked this session's versions under its token:
+            # a reconnect carrying it is a GENUINE resume — eligible for
+            # the reserved lane at the accept hop
+            self._parked_tokens[token] = time.monotonic() + self.resume_ttl
         entry = self._conn_keys.pop((w.index, req.get("conn")), None)
         if entry is None:
             return
@@ -898,6 +1130,8 @@ class _WorkerMain:
                     self.resume_ttl = float(cfg.get("resume_ttl", 60.0))
                 elif ch == "Q":
                     self.on_stats(json.loads(payload))
+                elif ch == "Y":
+                    self.on_drain(json.loads(payload))
                 elif ch == "X":
                     break
         except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -1051,6 +1285,50 @@ class _WorkerMain:
             "hist_max": round(self.hist.max, 3),
         })
 
+    # ---------------------------------------------------------- drain
+    def on_drain(self, req: dict) -> None:
+        """Graceful drain (ISSUE 12c, the worker half): stop accepting,
+        write every live SSE connection ONE ``event: reconnect`` hint
+        (the session's resume token rides both the ``id:`` line and the
+        data payload) and CLOSE the stream cleanly — the handler's
+        teardown parks the delivered-version map under the token, so a
+        reconnect to this worker resumes, and a reconnect to a RESTARTED
+        pool misses the park and fresh-attaches at the current values
+        (latest-wins: still zero deliveries lost)."""
+        if self.server is not None:
+            self.server.close()
+            self.server = None
+        conns = set()
+        for peers in self.conns_by_key.values():
+            conns.update(peers)
+        conns.update(self.pending_conns.values())
+        drained = 0
+        for conn in conns:
+            token = conn.prefix[4:-1].decode("latin-1")
+            try:
+                if conn.open:
+                    hint = json.dumps({
+                        "key": "$edge/drain", "ver": 0,
+                        "value": {"resume": token},
+                        "cause": f"drain:worker-{self.index}",
+                    }).encode()
+                    conn.writer.write(
+                        conn.prefix + b"event: reconnect\ndata: " + hint
+                        + b"\n\n"
+                    )
+                    drained += 1
+                else:
+                    # mid-attach (headers not yet written): answer the
+                    # unified 503 shape instead of a status-less closed
+                    # socket; NOT counted as drained — it never streamed
+                    conn.writer.write(_DRAIN_503)
+                conn.writer.close()  # graceful: flushes the hint; the
+                # handler's finally parks versions + pairs the D
+            except Exception:  # noqa: BLE001 — a dying peer mid-drain
+                pass
+        self.send_json("R", {"seq": req.get("seq", 0),
+                             "worker": self.index, "drained": drained})
+
     # ---------------------------------------------------------- real SSE
     async def on_listen(self, req: dict) -> None:
         try:
@@ -1123,6 +1401,7 @@ class _WorkerMain:
                 return
             keys_raw = ""
             resume_token = None
+            tenant = ""
             for pair in query.split("&"):
                 k, _, v = pair.partition("=")
                 if k == "keys":
@@ -1133,6 +1412,17 @@ class _WorkerMain:
                     from urllib.parse import unquote
 
                     resume_token = unquote(v)
+                elif k == "tenant" and v:
+                    from urllib.parse import unquote
+
+                    tenant = unquote(v)
+            if not tenant:
+                for hline in request.split(b"\r\n")[1:]:
+                    if hline.lower().startswith(b"x-tenant:"):
+                        tenant = (
+                            hline.split(b":", 1)[1].strip().decode("latin-1")
+                        )
+                        break
             if resume_token is None:
                 # the browser's own reconnect handle (EventSource re-sends
                 # the original URL + this header)
@@ -1172,16 +1462,39 @@ class _WorkerMain:
             self.pending_conns[conn_id] = conn
             fut = asyncio.get_event_loop().create_future()
             self.pending_subscribes[conn_id] = fut
-            self.send_json("U", {"conn": conn_id, "keys": specs})
+            self.send_json("U", {
+                "conn": conn_id, "keys": specs, "tenant": tenant,
+                # resumed sessions replay — the parent exempts them from
+                # the subscribe-rate debit (they mint no new state)
+                "resumed": parked_versions is not None,
+            })
             sent_u = True
             ack = await asyncio.wait_for(fut, 30.0)
             if "error" in ack:
-                body = json.dumps({"error": ack["error"]}).encode()
-                writer.write(
-                    b"HTTP/1.1 400 Bad Request\r\nContent-Type: "
-                    b"application/json\r\nContent-Length: "
-                    + str(len(body)).encode() + b"\r\n\r\n" + body
+                # the parent's verdict names the status: bad input stays
+                # 400, an admission shed answers the unified 503 shape
+                # (Retry-After + Connection: close) — a rate-limited
+                # client must not be told its request was malformed
+                status = int(ack.get("status", 400))
+                status_line = (
+                    b"HTTP/1.1 503 Service Unavailable\r\n"
+                    if status == 503
+                    else b"HTTP/1.1 400 Bad Request\r\n"
                 )
+                body = json.dumps({"error": ack["error"]}).encode()
+                head = (
+                    status_line
+                    + b"Content-Type: application/json\r\nContent-Length: "
+                    + str(len(body)).encode()
+                    + b"\r\nConnection: close"
+                )
+                retry = ack.get("retry_after")
+                if status == 503 and isinstance(retry, (int, float)):
+                    head += (
+                        b"\r\nRetry-After: "
+                        + str(max(1, min(3600, int(retry + 1)))).encode()
+                    )
+                writer.write(head + b"\r\n\r\n" + body)
                 return
             writer.write(
                 b"HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
@@ -1253,7 +1566,11 @@ class _WorkerMain:
                 self.send_json(
                     "D",
                     {"conn": conn_id,
-                     "key_ids": conn.key_ids if conn is not None else []},
+                     "key_ids": conn.key_ids if conn is not None else [],
+                     # the parked token: the parent's accept plane grants
+                     # the reserved resume lane only to tokens it SAW
+                     # parked (a forged token rides the cold lane)
+                     "token": token if conn is not None else None},
                 )
             try:
                 writer.close()

@@ -37,6 +37,11 @@ class Tenant:
     id: str
     title: str = ""
     is_active: bool = True
+    #: overload-plane lane flag (ISSUE 12): a priority (paying) tenant's
+    #: attaches ride the edge AdmissionController's priority lane —
+    #: admitted ahead of anonymous cold attaches and exempt from
+    #: pressure shedding (EDGE.md "Overload behavior")
+    priority: bool = False
 
     @property
     def is_default(self) -> bool:

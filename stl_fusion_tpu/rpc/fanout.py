@@ -398,6 +398,9 @@ class WaveValuePublisher:
         self.overflow_fallbacks = 0  # of which: round-budget overflow
         from ..diagnostics.metrics import global_metrics
 
+        # publish pressure is non-additive: two half-loaded publishers
+        # are half loaded, not fully loaded
+        global_metrics().set_aggregation("fusion_value_publish_pressure", "max")
         global_metrics().register_collector(
             self, WaveValuePublisher._collect_metrics
         )
@@ -411,7 +414,17 @@ class WaveValuePublisher:
             "fusion_value_serialized_total": self.values_serialized,
             "fusion_value_publish_rounds_total": self.rounds,
             "fusion_value_fallback_fences_total": self.fallback_fences,
+            "fusion_value_publish_pressure": round(self.pressure(), 4),
         }
+
+    def pressure(self) -> float:
+        """Publish-plane load, 0..1 (ISSUE 12b): fenced keys waiting for
+        a publish round against the round budget. An edge-side admission
+        controller (or the traffic harness's SLO gates) can read this —
+        a backlog at the VALUE plane means fences are about to arrive
+        late no matter how fast the edges fan, so shedding should start
+        upstream of the fan, not after it."""
+        return min(1.0, len(self._pending) / max(1, self.max_keys_per_round))
 
     # ------------------------------------------------------------------ registry
     def register_standing(
@@ -744,6 +757,7 @@ class WaveValuePublisher:
             "fallback_fences": self.fallback_fences,
             "overflow_fallbacks": self.overflow_fallbacks,
             "pending_nids": len(self._pending),
+            "pressure": round(self.pressure(), 4),
         }
 
 

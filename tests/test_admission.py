@@ -1,0 +1,661 @@
+"""Overload-safety tests (ISSUE 12): token-bucket determinism under a
+fake clock, per-tenant isolation, priority-lane ordering under a full
+global gate, drain-then-resume zero loss, pressure-widened re-read
+windows returning to baseline, the expired-resume-storm regression
+(parked refs must release immediately, not at the next sweep), and the
+transports' unified counted rejection path.
+"""
+import asyncio
+import json
+import time
+
+import pytest
+
+from stl_fusion_tpu.client import install_compute_call_type
+from stl_fusion_tpu.core import (
+    ComputeService,
+    FusionHub,
+    compute_method,
+    invalidating,
+    set_default_hub,
+)
+from stl_fusion_tpu.edge import (
+    DRAIN_KEY,
+    AdmissionController,
+    AdmissionRejected,
+    EdgeHttpServer,
+    EdgeNode,
+    rejection_bytes,
+)
+from stl_fusion_tpu.edge.admission import TokenBucket
+from stl_fusion_tpu.ext.multitenancy import Tenant, TenantRegistry
+from stl_fusion_tpu.rpc import RpcHub, RpcTestTransport
+
+
+class CounterService(ComputeService):
+    def __init__(self, hub=None, store=None):
+        super().__init__(hub)
+        self.counters = store if store is not None else {}
+
+    @compute_method
+    async def get(self, key: str) -> int:
+        return self.counters.get(key, 0)
+
+    async def increment(self, key: str):
+        self.counters[key] = self.counters.get(key, 0) + 1
+        with invalidating():
+            await self.get(key)
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub():
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    yield hub
+    set_default_hub(old)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_stack(admission=None, resume_ttl=30.0):
+    server_fusion = FusionHub()
+    server_rpc = RpcHub("server")
+    install_compute_call_type(server_rpc)
+    svc = CounterService(server_fusion)
+    server_rpc.add_service("counters", svc)
+    edge_rpc = RpcHub("edge")
+    install_compute_call_type(edge_rpc)
+    transport = RpcTestTransport(edge_rpc, server_rpc, wire_codec=True)
+    node = EdgeNode(
+        "counters", edge_rpc, resume_ttl=resume_ttl, admission=admission
+    )
+    return svc, node, transport, edge_rpc, server_rpc
+
+
+async def settle(seconds: float = 0.05) -> None:
+    deadline = time.perf_counter() + seconds
+    while time.perf_counter() < deadline:
+        await asyncio.sleep(0.005)
+
+
+async def until(pred, timeout: float = 5.0) -> None:
+    async def wait():
+        while not pred():
+            await asyncio.sleep(0.005)
+
+    await asyncio.wait_for(wait(), timeout)
+
+
+async def stop_all(node, *hubs):
+    await node.close()
+    for h in hubs:
+        await h.stop()
+
+
+# ----------------------------------------------------------- token bucket
+
+
+def test_token_bucket_deterministic_under_fake_clock():
+    clock = FakeClock()
+    bucket = TokenBucket(rate=10.0, burst=5.0, clock=clock)
+    assert all(bucket.try_take() for _ in range(5))  # the burst
+    assert not bucket.try_take()  # empty — no wall time passed
+    # the honest Retry-After: one token at 10/s = 0.1s away
+    assert bucket.retry_after() == pytest.approx(0.1)
+    clock.advance(0.1)
+    assert bucket.try_take()  # exactly one refilled
+    assert not bucket.try_take()
+    clock.advance(10.0)  # refill caps at burst, never beyond
+    taken = sum(1 for _ in range(10) if bucket.try_take())
+    assert taken == 5
+
+
+def test_rejection_bytes_headers():
+    data = rejection_bytes(
+        "503 Service Unavailable", {"error": {"reason": "rate"}}, 2.4
+    )
+    head, _, body = data.partition(b"\r\n\r\n")
+    assert head.startswith(b"HTTP/1.1 503 Service Unavailable")
+    assert b"Retry-After: 3" in head  # ceil(2.4)
+    assert b"Connection: close" in head
+    assert json.loads(body)["error"]["reason"] == "rate"
+    # no Retry-After header when the shed is not retryable
+    assert b"Retry-After" not in rejection_bytes("400 Bad Request", {})
+
+
+# ----------------------------------------------------------- controller
+
+
+def test_per_tenant_rate_isolation():
+    """Tenant A's storm exhausts A's bucket; B keeps its full rate — one
+    tenant's flash crowd can never starve another's lane."""
+    clock = FakeClock()
+    registry = TenantRegistry(single_tenant=False)
+    registry.add(Tenant("a"))
+    registry.add(Tenant("b"))
+    ctrl = AdmissionController(
+        registry=registry, connect_rate=10.0, connect_burst=4.0, clock=clock
+    )
+    for _ in range(4):
+        assert ctrl.admit(tenant_id="a").admitted
+    storm = ctrl.admit(tenant_id="a")
+    assert not storm.admitted and storm.reason == "rate"
+    assert storm.retry_after == pytest.approx(0.1)
+    # B is untouched by A's storm
+    for _ in range(4):
+        assert ctrl.admit(tenant_id="b").admitted
+    assert ctrl.shed_by_reason["rate"] == 1
+    assert ctrl.admitted_by_lane["anonymous"] == 8
+
+
+def test_per_tenant_gate_share_isolation():
+    """Gate-slot isolation: tenant A HOLDING its share of the concurrent
+    gate cannot occupy B's — B still admits at A's saturation point."""
+    registry = TenantRegistry(single_tenant=False)
+    registry.add(Tenant("a"))
+    registry.add(Tenant("b"))
+    ctrl = AdmissionController(
+        registry=registry, connect_rate=1e9, connect_burst=1e9,
+        max_concurrent=10, resume_reserve=0.0, priority_reserve=0.0,
+        tenant_gate_share=0.5,
+    )
+    held = [ctrl.admit(tenant_id="a", hold=True) for _ in range(5)]
+    assert all(d.admitted for d in held)
+    blocked = ctrl.admit(tenant_id="a", hold=True)
+    assert not blocked.admitted and blocked.reason == "tenant_gate"
+    b = ctrl.admit(tenant_id="b", hold=True)
+    assert b.admitted  # B's floor survives A's storm
+    for d in held:
+        ctrl.release(d)
+    ctrl.release(d)  # release is idempotent per decision
+    assert ctrl.in_flight == 1  # only B's hold remains
+    assert ctrl.admit(tenant_id="a", hold=True).admitted
+
+
+def test_priority_lane_ordering_under_full_gate():
+    """The lane ORDER under a full gate: anonymous sheds first (its
+    ceiling excludes both reserves), priority next, resume rides to the
+    full gate — a reconnect storm is never starved by a cold crowd."""
+    registry = TenantRegistry(single_tenant=False)
+    registry.add(Tenant("gold", priority=True))
+    ctrl = AdmissionController(
+        registry=registry, connect_rate=1e9, connect_burst=1e9,
+        resume_rate=1e9, resume_burst=1e9,
+        max_concurrent=10, resume_reserve=0.2, priority_reserve=0.2,
+        tenant_gate_share=1.0,
+    )
+    held = []
+    for _ in range(6):  # anonymous ceiling = 10 * (1 - .2 - .2) = 6
+        d = ctrl.admit(hold=True)
+        assert d.admitted and d.lane == "anonymous"
+        held.append(d)
+    anon_full = ctrl.admit(hold=True)
+    assert not anon_full.admitted and anon_full.reason == "gate_full"
+    for _ in range(2):  # priority ceiling = 10 * (1 - .2) = 8
+        d = ctrl.admit(tenant_id="gold", hold=True)
+        assert d.admitted and d.lane == "priority"
+        held.append(d)
+    gold_full = ctrl.admit(tenant_id="gold", hold=True)
+    assert not gold_full.admitted and gold_full.reason == "gate_full"
+    for _ in range(2):  # the resume reserve: up to the FULL gate
+        d = ctrl.admit(lane="resume", hold=True)
+        assert d.admitted
+        held.append(d)
+    resume_full = ctrl.admit(lane="resume", hold=True)
+    assert not resume_full.admitted and resume_full.reason == "gate_full"
+    ctrl.release(held.pop())  # one slot frees: resume admits again
+    assert ctrl.admit(lane="resume", hold=True).admitted
+
+
+def test_pressure_sheds_anonymous_lane_first():
+    registry = TenantRegistry(single_tenant=False)
+    registry.add(Tenant("gold", priority=True))
+    ctrl = AdmissionController(
+        registry=registry, connect_rate=1e9, connect_burst=1e9,
+        resume_rate=1e9, resume_burst=1e9, shed_pressure=0.9,
+    )
+    ctrl.set_pressure("test", 0.95)
+    anon = ctrl.admit()
+    assert not anon.admitted and anon.reason == "pressure"
+    # priority and resume lanes keep admitting under pressure
+    assert ctrl.admit(tenant_id="gold").admitted
+    assert ctrl.admit(lane="resume").admitted
+    ctrl.set_pressure("test", 0.0)
+    assert ctrl.admit().admitted  # pressure dropped: baseline behavior
+    # a second source takes the MAX, not an average
+    ctrl.set_pressure("a", 0.2)
+    ctrl.set_pressure("b", 1.0)
+    assert ctrl.pressure() == 1.0
+
+
+def test_pressure_and_gate_sheds_do_not_burn_rate_budget():
+    """A request shed for pressure (or a full gate) must NOT consume the
+    tenant's rate tokens — retrying per Retry-After through sustained
+    pressure would otherwise drain the bucket and keep shedding 'rate'
+    on an idle node after the pressure clears."""
+    clock = FakeClock()
+    ctrl = AdmissionController(
+        connect_rate=10.0, connect_burst=2.0, shed_pressure=0.9, clock=clock
+    )
+    ctrl.set_pressure("test", 1.0)
+    for _ in range(50):  # a retry storm through the pressure window
+        d = ctrl.admit()
+        assert not d.admitted and d.reason == "pressure"
+    ctrl.set_pressure("test", 0.0)
+    # the bucket is untouched: the full burst admits immediately
+    assert ctrl.admit().admitted
+    assert ctrl.admit().admitted
+    assert ctrl.admit().reason == "rate"  # now genuinely empty
+
+
+def test_unknown_tenant_and_draining_shed():
+    ctrl = AdmissionController()  # single-tenant registry
+    bad = ctrl.admit(tenant_id="nope")
+    assert not bad.admitted and bad.reason == "unknown_tenant"
+    assert ctrl.admit().admitted  # the default tenant resolves
+    ctrl.begin_drain()
+    for lane in (None, "resume"):
+        d = ctrl.admit(lane=lane)
+        assert not d.admitted and d.reason == "draining"
+    snap = ctrl.snapshot()
+    assert snap["draining"] and snap["shed"]["draining"] == 2
+    # the labeled counters ride the collector export
+    out = ctrl._collect_metrics()
+    assert out['fusion_edge_shed_total{reason="draining"}'] == 2
+    assert out['fusion_edge_admitted_total{lane="anonymous"}'] == 1
+
+
+# ----------------------------------------------------------- edge node
+
+
+async def test_attach_enforcement_and_counted_shed():
+    """EdgeNode.attach/resume consult the installed controller; a shed
+    raises AdmissionRejected and is counted — and an already-admitted
+    session is NEVER torn down by later sheds."""
+    clock = FakeClock()
+    ctrl = AdmissionController(connect_rate=10.0, connect_burst=2.0, clock=clock)
+    svc, node, _t, edge_rpc, server_rpc = make_stack(admission=ctrl)
+    try:
+        got: list = []
+        s1 = node.attach([("get", "a")], sink=got.append)
+        s2 = node.attach([("get", "b")], sink=got.append)
+        with pytest.raises(AdmissionRejected) as exc:
+            node.attach([("get", "c")], sink=got.append)
+        assert exc.value.decision.reason == "rate"
+        assert exc.value.decision.retry_after == pytest.approx(0.1)
+        assert ctrl.shed_by_reason["rate"] == 1
+        # admitted sessions keep serving through the overload
+        await until(lambda: len(got) >= 2)
+        ka = node.key_str(("get", "a"))
+        await svc.increment("a")
+        await until(lambda: any(f[0] == ka and f[2] == 1 for f in got))
+        assert not s1.evicted and not s2.evicted
+        # pre-admitted attaches (the transports pass their decision) skip
+        # the node-level admit — no double charge
+        node.attach([("get", "d")], sink=got.append, admitted=True)
+        assert ctrl.shed_by_reason["rate"] == 1
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_pressure_widened_reread_window_returns_to_baseline():
+    ctrl = AdmissionController()
+    svc, node, _t, edge_rpc, server_rpc = make_stack(admission=ctrl)
+    try:
+        base = node.reread_batch_window
+        assert node.effective_reread_window() == base
+        ctrl.set_pressure("test", 1.0)
+        assert node.effective_reread_window() == pytest.approx(
+            base * (1.0 + node.pressure_widen)
+        )
+        ctrl.set_pressure("test", 0.5)
+        assert node.effective_reread_window() == pytest.approx(
+            base * (1.0 + 0.5 * node.pressure_widen)
+        )
+        # the load DROPS: the window returns to the exact baseline (the
+        # ISSUE 12 contract — no hysteresis state to get stuck on)
+        ctrl.set_pressure("test", 0.0)
+        assert node.effective_reread_window() == base
+        # the fan-shard source is registered at construction
+        assert any("fan_shards" in k for k in ctrl._pressure_sources)
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_drain_then_resume_zero_loss():
+    """The rolling-restart contract: drain hints every session with its
+    token, a successor imports the parked state, every session resumes
+    and converges — zero deliveries lost across the gap."""
+    ctrl = AdmissionController()
+    svc, node, _t, edge_rpc, server_rpc = make_stack(admission=ctrl)
+    successor = None
+    try:
+        frames: dict = {}
+
+        def sink_for(sid):
+            def sink(frame):
+                frames.setdefault(sid, []).append(frame)
+            return sink
+
+        keys = [("get", "a"), ("get", "b")]
+        ka = node.key_str(("get", "a"))
+        kb = node.key_str(("get", "b"))
+        sessions = [node.attach(keys, sink=sink_for(i)) for i in range(4)]
+        await until(lambda: all(len(frames.get(i, [])) >= 2 for i in range(4)))
+        await svc.increment("a")
+        await until(
+            lambda: all(
+                any(f[2] == 1 for f in frames[i] if f[0] == ka)
+                for i in range(4)
+            )
+        )
+        export = await node.drain()
+        # every session got its reconnect hint WITH its own token, and
+        # the drain is counted
+        for i, session in enumerate(sessions):
+            hints = [f for f in frames[i] if f[0] == DRAIN_KEY]
+            assert len(hints) == 1
+            assert hints[0][2]["resume"] == session.token
+            assert hints[0][3] == f"drain:{node.name}"
+        assert node.drains == 1 and node.sessions_drained == 4
+        assert node.draining
+        # a draining node sheds (counted) — and never tears down state
+        with pytest.raises(AdmissionRejected) as exc:
+            node.attach(keys, sink=lambda f: None)
+        assert exc.value.decision.reason == "draining"
+        # resume is ALSO shed on the draining node: a hinted session must
+        # return to the SUCCESSOR — re-attaching here would strand it
+        # unhinted when the caller closes the node
+        with pytest.raises(AdmissionRejected) as exc:
+            node.resume(sessions[0].token, sink=lambda f: None)
+        assert exc.value.decision.reason == "draining"
+        assert len(export["parked"]) == 4
+        # THE GAP: a fence lands while everyone is parked
+        await svc.increment("b")
+        await settle(0.05)
+        # successor node adopts the parked state; old node closes
+        await node.close()
+        successor = EdgeNode("counters", edge_rpc, name="edge-b")
+        assert successor.import_parked(export) == 4
+        resumed = [
+            successor.resume(s.token, sink=sink_for(f"r{i}"))
+            for i, s in enumerate(sessions)
+        ]
+        # zero loss: every resumed session replays the value fenced
+        # DURING the restart gap (b == 1) and the steady state (a == 1)
+        await until(
+            lambda: all(
+                any(f[2] == 1 for f in frames.get(f"r{i}", []) if f[0] == kb)
+                and any(f[2] == 1 for f in frames.get(f"r{i}", []) if f[0] == ka)
+                for i in range(4)
+            )
+        )
+        assert all(not s.evicted for s in resumed)
+        assert successor.resumes == 4
+    finally:
+        if successor is not None:
+            await successor.close()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_import_parked_honors_remaining_ttl():
+    """import_parked honors the EXPORTED remaining TTL (capped at this
+    node's resume_ttl) and refuses already-expired entries — a mass
+    drain must not re-lease the whole parked population a fresh TTL for
+    clients that will never return."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    try:
+        export = {
+            "parked": [
+                {"token": "es-live-1", "specs": [["get", ["a"]]], "ttl": 5.0},
+                {"token": "es-dead-1", "specs": [["get", ["b"]]], "ttl": 0.0},
+                {"token": "es-long-1", "specs": [["get", ["c"]]], "ttl": 9999.0},
+            ]
+        }
+        assert node.import_parked(export) == 2  # the expired entry refused
+        assert "es-dead-1" not in node._parked
+        now = time.monotonic()
+        _k, _v, dl_live = node._parked["es-live-1"]
+        _k, _v, dl_long = node._parked["es-long-1"]
+        assert dl_live - now == pytest.approx(5.0, abs=0.5)
+        # capped at this node's resume_ttl, never the raw 9999
+        assert dl_long - now <= node.resume_ttl + 0.5
+        # the expired entry pinned nothing
+        assert node.key_str(("get", "b")) not in node._subs
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_expired_resume_storm_releases_parked_refs():
+    """ISSUE 12 satellite regression: a storm of EXPIRED resume tokens
+    arriving while the amortized sweep timer is still parked must release
+    each expired entry's parked refs immediately — the upstream
+    subscriptions must not stay pinned until the next sweep."""
+    svc, node, _t, edge_rpc, server_rpc = make_stack(resume_ttl=0.05)
+    try:
+        tokens = []
+        for i in range(8):
+            session = node.attach([("get", f"k{i}")], sink=lambda f: None)
+            tokens.append(node.detach(session, park=True))
+        assert len(node._subs) == 8  # parked refs pin the upstream subs
+        # force the NEXT amortized sweep far into the future: the storm
+        # below must not depend on the sweep at all
+        node._next_purge = time.monotonic() + 3600.0
+        await asyncio.sleep(0.1)  # every token expires
+        for token in tokens:
+            with pytest.raises(KeyError):
+                node.resume(token, sink=lambda f: None)
+        # the storm itself released every pin: subs tore down WITHOUT a
+        # sweep, and the upstream subscriptions followed
+        assert len(node._subs) == 0
+        assert node.resumes_expired == 8
+        assert node._parked == {}
+    finally:
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+# ----------------------------------------------------------- transports
+
+
+async def test_sse_unified_rejection_path_and_503():
+    """The SSE transport's unified responder: admission 503 carries
+    Retry-After + Connection: close; allowlist 400s and bad requests ride
+    the same counted path (fusion_edge_shed_total{reason=})."""
+    import urllib.parse
+
+    clock = FakeClock()
+    ctrl = AdmissionController(connect_rate=10.0, connect_burst=1.0, clock=clock)
+    svc, node, _t, edge_rpc, server_rpc = make_stack(admission=ctrl)
+    http = await EdgeHttpServer(node).start()
+
+    async def get(path):
+        reader, writer = await asyncio.open_connection(http.host, http.port)
+        writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+        await writer.drain()
+        status = (await asyncio.wait_for(reader.readline(), 5.0)).decode()
+        headers = {}
+        while True:
+            line = (await asyncio.wait_for(reader.readline(), 5.0)).decode()
+            if line in ("\r\n", "\n", ""):
+                break
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        body = b""
+        if "content-length" in headers:
+            body = await asyncio.wait_for(
+                reader.readexactly(int(headers["content-length"])), 5.0
+            )
+        writer.close()
+        return status, headers, body
+
+    try:
+        keys_q = urllib.parse.quote(json.dumps([["get", "a"]]))
+        # first connection admits (burst=1)...
+        reader, writer = await asyncio.open_connection(http.host, http.port)
+        writer.write(
+            f"GET /edge/sse?keys={keys_q} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        )
+        await writer.drain()
+        line = (await asyncio.wait_for(reader.readline(), 5.0)).decode()
+        assert "200" in line
+        # ...the second sheds 503 with the retry contract
+        status, headers, body = await get(f"/edge/sse?keys={keys_q}")
+        assert "503" in status
+        assert headers.get("retry-after") == "1"
+        assert headers.get("connection") == "close"
+        assert json.loads(body)["error"]["reason"] == "rate"
+        assert ctrl.shed_by_reason["rate"] == 1
+        # bad key spec: the same counted responder, 400
+        clock.advance(10.0)  # refill so admission passes
+        bad_q = urllib.parse.quote(json.dumps(["get"]))
+        status, headers, body = await get(f"/edge/sse?keys={bad_q}")
+        assert "400" in status and headers.get("connection") == "close"
+        assert ctrl.shed_by_reason["bad_request"] == 1
+        # expired/unknown resume with no keys: 410, counted
+        clock.advance(10.0)
+        status, _h, _b = await get("/edge/sse?resume=es-nope-1")
+        assert "410" in status
+        assert ctrl.shed_by_reason["resume_expired"] == 1
+        writer.close()
+    finally:
+        await http.stop()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_sse_bogus_resume_token_cannot_ride_the_resume_lane():
+    """A cold attach with ?resume=garbage must NOT bypass admission on
+    the reserved resume lane: once the token misses, the request is
+    re-admitted on the cold lane — under pressure it sheds exactly like
+    any anonymous cold attach."""
+    import urllib.parse
+
+    ctrl = AdmissionController(shed_pressure=0.9)
+    svc, node, _t, edge_rpc, server_rpc = make_stack(admission=ctrl)
+    http = await EdgeHttpServer(node).start()
+    try:
+        ctrl.set_pressure("test", 1.0)
+        keys_q = urllib.parse.quote(json.dumps([["get", "a"]]))
+        reader, writer = await asyncio.open_connection(http.host, http.port)
+        writer.write(
+            f"GET /edge/sse?keys={keys_q}&resume=es-garbage-1 "
+            f"HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        )
+        await writer.drain()
+        status = (await asyncio.wait_for(reader.readline(), 5.0)).decode()
+        assert "503" in status
+        raw = await asyncio.wait_for(reader.read(), 5.0)
+        assert b'"reason": "pressure"' in raw or b'"reason":"pressure"' in raw
+        writer.close()
+        assert ctrl.shed_by_reason["pressure"] == 1
+        assert len(node._sessions) == 0  # nothing smuggled in
+        # a REAL token still rides the resume lane through the pressure
+        session = node.attach([("get", "a")], sink=lambda f: None, admitted=True)
+        token = node.detach(session, park=True)
+        reader, writer = await asyncio.open_connection(http.host, http.port)
+        writer.write(
+            f"GET /edge/sse?resume={token} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        )
+        await writer.drain()
+        status = (await asyncio.wait_for(reader.readline(), 5.0)).decode()
+        assert "200" in status
+        writer.close()
+    finally:
+        await http.stop()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_sse_draining_without_controller_answers_503():
+    """The no-controller default: a draining node still ANSWERS (503 +
+    Retry-After via the unified responder, counted in the node-local
+    shed map) — never an uncounted dropped socket."""
+    import urllib.parse
+
+    svc, node, _t, edge_rpc, server_rpc = make_stack()  # admission=None
+    http = await EdgeHttpServer(node).start()
+    try:
+        await node.drain()
+        keys_q = urllib.parse.quote(json.dumps([["get", "a"]]))
+        reader, writer = await asyncio.open_connection(http.host, http.port)
+        writer.write(
+            f"GET /edge/sse?keys={keys_q} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        )
+        await writer.drain()
+        status = (await asyncio.wait_for(reader.readline(), 5.0)).decode()
+        assert "503" in status
+        headers = {}
+        while True:
+            line = (await asyncio.wait_for(reader.readline(), 5.0)).decode()
+            if line in ("\r\n", "\n", ""):
+                break
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        assert headers.get("retry-after") == "1"
+        assert headers.get("connection") == "close"
+        writer.close()
+        assert node._shed_local["draining"] == 1
+    finally:
+        await http.stop()
+        await stop_all(node, edge_rpc, server_rpc)
+
+
+async def test_sse_drain_sends_reconnect_event_with_token():
+    """A live SSE stream's drain contract: the peer receives an
+    ``event: reconnect`` carrying its resume token, then a CLEAN close —
+    never an abort that could eat the hint."""
+    import urllib.parse
+
+    svc, node, _t, edge_rpc, server_rpc = make_stack()
+    http = await EdgeHttpServer(node, heartbeat_interval=5.0).start()
+    try:
+        keys_q = urllib.parse.quote(json.dumps([["get", "a"]]))
+        reader, writer = await asyncio.open_connection(http.host, http.port)
+        writer.write(
+            f"GET /edge/sse?keys={keys_q} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        )
+        await writer.drain()
+        while True:
+            line = (await asyncio.wait_for(reader.readline(), 5.0)).decode()
+            if line in ("\r\n", "\n"):
+                break
+
+        async def read_event():
+            fields = {}
+            while True:
+                line = (await asyncio.wait_for(reader.readline(), 5.0)).decode()
+                if line == "":
+                    return fields or None  # EOF
+                if line in ("\n", "\r\n"):
+                    if fields:
+                        return fields
+                    continue
+                name, _, value = line.rstrip("\n").partition(":")
+                fields[name] = value.strip()
+
+        hello = await read_event()
+        assert hello.get("event") == "hello"
+        token = json.loads(hello["data"])["token"]
+        await read_event()  # the initial value frame
+        await node.drain()
+        ev = await read_event()
+        assert ev is not None and ev.get("event") == "reconnect"
+        payload = json.loads(ev["data"])
+        assert payload["key"] == DRAIN_KEY
+        assert payload["value"]["resume"] == token
+        assert payload["cause"] == f"drain:{node.name}"
+        # the stream CLOSES cleanly after the hint
+        tail = await asyncio.wait_for(reader.read(), 5.0)
+        assert b"event: update" not in tail
+        writer.close()
+        assert node.drains == 1 and node.sessions_drained == 1
+    finally:
+        await http.stop()
+        await stop_all(node, edge_rpc, server_rpc)

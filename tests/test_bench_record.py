@@ -110,11 +110,32 @@ def test_compact_record_stays_under_tail_window():
             "dcn_fallback_relays": 0,
         },
     }
+    traffic = {
+        "ok": True,
+        "base_sessions": 20_000,
+        "flash": {
+            "attempts": 100_000, "admitted": 41_234, "shed": 58_766,
+            "by_lane": {"gold": {"admitted": 10_000, "shed": 0},
+                        "anon": {"admitted": 31_234, "shed": 58_766}},
+            "gold_shed_rate": 0.0, "anon_shed_rate": 0.653,
+            "arrival_s": 12.41, "p99_ms": 412.5, "p50_ms": 101.2,
+        },
+        "reconnect": {"storm": 10_000, "resumed": 10_000, "shed": 0,
+                      "storm_s": 1.92},
+        "drain": {"sessions_drained": 11_021, "audited_sessions": 10_000,
+                  "hints": 10_000, "adopted": 11_021, "drain_loss": 0},
+        "reshard": {"moved_shards": 137, "crowd": 25_000, "admitted": 24_000,
+                    "shed": 1_000, "resubscribes": 72, "p99_ms": 512.1},
+        "zipf": {"head_p99_ms": 301.2, "migrated_p99_ms": 288.7},
+        "audit": {"keys_audited": 128, "stale": 0, "violations": 0,
+                  "canary_staleness_ms": 0.31},
+    }
     line = json.dumps(
-        _compact_result(7.07e9, detail, live, edge=edge, mesh=mesh),
+        _compact_result(7.07e9, detail, live, edge=edge, mesh=mesh,
+                        traffic=traffic),
         separators=(",", ":"),
     )
-    assert len(line) < 3100, f"compact record grew to {len(line)} bytes"
+    assert len(line) < 3500, f"compact record grew to {len(line)} bytes"
     d = json.loads(line)
     # the edge tier (ISSUE 8): the million-subscriber numbers make the capture
     assert d["edge"]["subs"] == 1_000_000 and d["edge"]["fenced_per_s"] == 412346
@@ -148,6 +169,17 @@ def test_compact_record_stays_under_tail_window():
     assert d["mesh"]["vs_single_device_10m"] == 8.0
     assert d["mesh"]["reshard_moves"] == 29 and d["mesh"]["mesh_member_relays"] == 0
     assert d["mesh"]["eager_waves"] == 0 and d["mesh"]["ok"] is True
+    # the overload plane (ISSUE 12): admitted/shed per lane, the drain
+    # loss (must be 0) and the adversarial p99s ride the capture
+    assert d["traffic"]["ok"] is True
+    assert d["traffic"]["flash_admitted"] == 41_234
+    assert d["traffic"]["flash_shed"] == 58_766
+    assert d["traffic"]["by_lane"]["gold"]["shed"] == 0
+    assert d["traffic"]["gold_shed_rate"] == 0.0
+    assert d["traffic"]["drain_loss"] == 0
+    assert d["traffic"]["reconnect_resumed"] == 10_000
+    assert d["traffic"]["reshard_p99_ms"] == 512.1
+    assert d["traffic"]["audit_violations"] == 0
 
 
 def test_compact_record_handles_live_error_and_sharded():

@@ -415,6 +415,112 @@ async def test_websocket_delivery_beside_worker_pool():
         await stop_all(pool, node, edge_rpc, server_rpc)
 
 
+async def test_forged_resume_token_rides_cold_lane_on_accept_plane():
+    """ISSUE 12 hardening: the parent accept plane grants the reserved
+    resume lane only to tokens a worker REPORTED parked — a forged
+    ``?resume=es-w0-x`` is a cold attach (sheds under pressure like any
+    other), while a genuinely parked token resumes straight through."""
+    from stl_fusion_tpu.edge import AdmissionController
+
+    svc, node, edge_rpc, server_rpc = make_stack()
+    ctrl = AdmissionController(shed_pressure=0.9)
+    node.admission = ctrl
+    pool = None
+    try:
+        pool = await EdgeWorkerPool(node, workers=2, flush_interval=0.005).start()
+        port = await pool.listen()
+        keys_q = urllib.parse.quote(json.dumps([["get", "a"]]))
+        # a REAL session attaches, streams, disconnects (parks)
+        reader, writer = await open_sse(port, keys_q)
+        hello = json.loads((await read_sse_event(reader))["data"])
+        token = hello["token"]
+        await read_sse_event(reader)  # initial value
+        writer.close()
+        await until(lambda: token in pool._parked_tokens)
+        # pressure spikes: a FORGED token is a cold attach — shed 503
+        ctrl.set_pressure("test", 1.0)
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            f"GET /edge/sse?keys={keys_q}&resume=es-w0-zz "
+            f"HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        )
+        await writer.drain()
+        status = (await asyncio.wait_for(reader.readline(), 10.0)).decode()
+        assert "503" in status, status
+        writer.close()
+        assert ctrl.shed_by_reason.get("pressure", 0) == 1
+        assert pool.shed_conns == 1
+        # the GENUINE token rides the resume lane THROUGH the pressure
+        reader, writer = await open_sse(
+            port, keys_q, extra_headers=f"Last-Event-ID: {token}\r\n"
+        )
+        hello2 = json.loads((await read_sse_event(reader))["data"])
+        assert hello2["token"] == token and hello2["resumed"]
+        assert ctrl.admitted_by_lane["resume"] == 1
+        writer.close()
+    finally:
+        await stop_all(pool, node, edge_rpc, server_rpc)
+
+
+async def test_drain_hints_worker_held_sessions():
+    """ISSUE 12c, pooled deployments: node.drain() must hint WORKER-held
+    SSE sessions too — each live connection gets an ``event: reconnect``
+    carrying its resume token and a clean close (not a silent kill when
+    the pool stops)."""
+    svc, node, edge_rpc, server_rpc = make_stack()
+    pool = None
+    try:
+        pool = await EdgeWorkerPool(node, workers=2, flush_interval=0.005).start()
+        port = await pool.listen()
+        keys_q = urllib.parse.quote(json.dumps([["get", "a"]]))
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            f"GET /edge/sse?keys={keys_q} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        )
+        await writer.drain()
+        while True:
+            line = await asyncio.wait_for(reader.readline(), 10.0)
+            assert line, "SSE closed during headers"
+            if line in (b"\r\n", b"\n"):
+                break
+
+        async def read_event():
+            fields = {}
+            while True:
+                line = (await asyncio.wait_for(reader.readline(), 10.0)).decode()
+                if line == "":
+                    return fields or None  # EOF
+                if line in ("\n", "\r\n"):
+                    if fields:
+                        return fields
+                    continue
+                if line.startswith(":"):
+                    continue
+                name, _, value = line.rstrip("\n").partition(":")
+                fields[name] = value.strip()
+
+        hello = await read_event()
+        assert hello["event"] == "hello"
+        token = json.loads(hello["data"])["token"]
+        await read_event()  # the initial-value frame
+        drained = await node.drain()
+        assert isinstance(drained, dict)  # the parked export
+        ev = await read_event()
+        assert ev is not None and ev.get("event") == "reconnect", ev
+        payload = json.loads(ev["data"])
+        assert payload["value"]["resume"] == token
+        # the stream then closes cleanly (EOF, not an abort mid-hint)
+        tail = await asyncio.wait_for(reader.read(), 10.0)
+        assert b"event: update" not in tail
+        writer.close()
+        assert node.sessions_drained >= 1
+        # the worker parked the session under its token (resume source)
+        stats = await pool.stats()
+        assert sum(s.get("parked", 0) for s in stats) >= 1
+    finally:
+        await stop_all(pool, node, edge_rpc, server_rpc)
+
+
 async def test_pool_stop_is_clean_and_releases_pins():
     """stop() shuts workers down (processes exit), releases sim pins, and
     detaches from the node — a second stop is a no-op."""
