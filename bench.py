@@ -652,6 +652,42 @@ def run_traffic_section():
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def run_lint_section():
+    """fusionlint compact record (ISSUE 13): the static gate's verdict
+    beside the perf numbers — findings-by-rule (must stay empty),
+    per-rule suppression counts (`fusionlint_suppressions_total{rule=}`)
+    and the baseline size, so a silently growing suppression or
+    grandfathered set is visible release over release. Stdlib-ast only:
+    the subprocess never imports jax and runs in seconds.
+    FUSION_BENCH_LINT=0 skips."""
+    import subprocess
+
+    if os.environ.get("FUSION_BENCH_LINT", "1") == "0":
+        return None
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.fusionlint", "--json"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, text=True, timeout=300,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "fusionlint timed out"}
+    try:
+        summary = json.loads(proc.stdout)["summary"]
+    except (ValueError, KeyError):
+        return {"error": f"fusionlint output unparseable rc={proc.returncode}"}
+    return {
+        "ok": proc.returncode == 0,
+        "findings": summary["findings_total"],
+        "by_rule": summary["findings_by_rule"],
+        "suppressions": summary["fusionlint_suppressions_total"],
+        "suppressions_total": summary["suppressions_total"],
+        "baseline": summary["baseline_size"],
+        "baseline_stale": summary["baseline_stale"],
+        "files": summary["files_scanned"],
+    }
+
+
 def main() -> None:
     import jax
 
@@ -695,6 +731,9 @@ def main() -> None:
     mesh = run_mesh_section()
     if mesh is not None:
         detail["mesh"] = mesh
+    lint = run_lint_section()
+    if lint is not None:
+        detail["lint"] = lint
     result = {
         "metric": "cascading_invalidations_per_sec",
         "value": round(inv_per_sec, 1),
@@ -710,7 +749,8 @@ def main() -> None:
     print(
         json.dumps(
             _compact_result(
-                inv_per_sec, detail, live, fanout, cluster, edge, mesh, traffic
+                inv_per_sec, detail, live, fanout, cluster, edge, mesh, traffic,
+                lint,
             ),
             separators=(",", ":"),
         )
@@ -745,7 +785,7 @@ def _pos_ms(fields: dict) -> dict:
 
 def _compact_result(
     inv_per_sec: float, detail: dict, live, fanout=None, cluster=None, edge=None,
-    mesh=None, traffic=None,
+    mesh=None, traffic=None, lint=None,
 ) -> dict:
     """The single stdout line: every headline metric, nothing that scales
     with run verbosity, target well under the driver's tail window."""
@@ -982,6 +1022,20 @@ def _compact_result(
             "cluster_restore_to_serving_s": (
                 _r((cluster or {}).get("restore_to_serving_s"), 3)
             ),
+        }
+    if lint is not None and "error" in lint:
+        out["lint"] = {"error": lint["error"]}
+    elif lint is not None:
+        # the static gate (ISSUE 13): findings must be 0 on a releasable
+        # record; suppressions/baseline are compact per-rule maps so a
+        # silently growing suppression count is visible release to release
+        out["lint"] = {
+            "ok": lint.get("ok"),
+            "findings": lint.get("findings"),
+            "by_rule": lint.get("by_rule"),
+            "suppressions": lint.get("suppressions"),
+            "baseline": lint.get("baseline"),
+            "baseline_stale": lint.get("baseline_stale"),
         }
     return out
 

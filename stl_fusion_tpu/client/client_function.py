@@ -214,7 +214,14 @@ class ClientComputeMethodFunction(FunctionBase):
                 computed._mark_synchronized()
                 computed.invalidate(immediately=True)  # dependents re-pull the real node
 
-        asyncio.get_event_loop().create_task(synchronize())
+        # owned by the rpc hub's side-task set: a cache-sync still in
+        # flight when the hub stops is cancelled, not leaked (FL003)
+        try:
+            self.rpc_hub.side_tasks.spawn(synchronize())
+        except RuntimeError:
+            # hub mid-stop: serve the cached value unsynchronized — the
+            # cache-hit path must never raise for a teardown race
+            pass
         return computed
 
     async def _remote_compute(

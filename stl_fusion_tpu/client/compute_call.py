@@ -369,7 +369,9 @@ class RpcInboundComputeCall(RpcInboundCall):
         result_message — rebuild the per-key OK reply from the live
         computed so the client's re-sent call never hangs."""
         if self.computed is not None and self.computed.is_invalidated:
-            asyncio.get_event_loop().create_task(self._send_invalidation())
+            self.peer.track_side_task(
+                asyncio.get_event_loop().create_task(self._send_invalidation())
+            )
         elif self.result_message is None and self.computed is not None:
             out = self.computed._output
             headers = ((VERSION_HEADER, self.computed.version.format()),)
@@ -381,7 +383,9 @@ class RpcInboundComputeCall(RpcInboundCall):
                         out.value if out is not None else None, headers=headers
                     )
             except Exception:  # noqa: BLE001 — unserializable: invalidate
-                asyncio.get_event_loop().create_task(self._send_invalidation())
+                self.peer.track_side_task(
+                    asyncio.get_event_loop().create_task(self._send_invalidation())
+                )
                 return
             super().restart()
         else:
@@ -439,7 +443,9 @@ class RpcInboundComputeCall(RpcInboundCall):
         else:
             # per-key wire shape: the send awaits the channel — needs a task
             def _spawn():
-                asyncio.get_event_loop().create_task(self._send_invalidation())
+                self.peer.track_side_task(
+                    asyncio.get_event_loop().create_task(self._send_invalidation())
+                )
 
             try:
                 _spawn()

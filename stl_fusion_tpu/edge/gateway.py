@@ -50,6 +50,7 @@ from ..client.client_function import FusionClient
 from ..core.context import capture
 from ..diagnostics.flight_recorder import RECORDER, call_key
 from ..diagnostics.metrics import global_metrics
+from ..utils.async_utils import TaskSet
 from .admission import (
     LANE_ANONYMOUS,
     LANE_RESUME,
@@ -284,13 +285,17 @@ class _RereadBatcher:
     the server, but the RPC/codec/loop-hop envelope is paid once per burst
     instead of once per key (the PR 10 ~2 ms/key storm tail)."""
 
-    __slots__ = ("node", "_pending", "_timers")
+    __slots__ = ("node", "_pending", "_timers", "_flights")
 
     def __init__(self, node: "EdgeNode"):
         self.node = node
         #: owner peer ref -> [(sub, future)] awaiting the next flush
         self._pending: Dict[str, list] = {}
         self._timers: Dict[str, Any] = {}
+        #: in-flight flush tasks — a lifecycle owner, not a fire-and-forget
+        #: spawn: a flush mid-RPC when the node closes must be cancelled or
+        #: it races the teardown's future sweep (fusionlint FL003)
+        self._flights = TaskSet(name=f"edge-reread-flush")
 
     def submit(self, owner: str, sub: _KeySub) -> "asyncio.Future":
         loop = asyncio.get_event_loop()
@@ -315,8 +320,14 @@ class _RereadBatcher:
         if timer is not None:
             timer.cancel()
         batch = self._pending.pop(owner, None)
-        if batch:
-            asyncio.get_event_loop().create_task(self._flush(owner, batch))
+        if not batch:
+            return
+        if self._flights.closed:  # node closed between timer arm and fire
+            for _sub, future in batch:
+                if not future.done():
+                    future.cancel()
+            return
+        self._flights.spawn(self._flush(owner, batch))
 
     async def _flush(self, owner: str, batch: list) -> None:
         node = self.node
@@ -353,6 +364,7 @@ class _RereadBatcher:
         for timer in self._timers.values():
             timer.cancel()
         self._timers.clear()
+        self._flights.cancel()
         pending, self._pending = self._pending, {}
         for bucket in pending.values():
             for _sub, future in bucket:

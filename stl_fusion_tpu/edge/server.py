@@ -41,6 +41,7 @@ import logging
 import urllib.parse
 from typing import Optional
 
+from ..utils.async_utils import TaskSet
 from .admission import LANE_RESUME, AdmissionRejected, rejection_bytes
 from .gateway import EdgeNode
 from .session import KeyedMailbox, frame_to_dict, pump_payloads
@@ -452,6 +453,9 @@ class EdgeWebSocketServer:
         self.min_send_interval = min_send_interval
         self.connections = 0
         self._server = None
+        #: drain-hint send/close side tasks — owned so stop() can cancel a
+        #: hint still in flight instead of leaking it (fusionlint FL003)
+        self._side_tasks = TaskSet(name="edge-ws-side")
 
     async def start(self) -> "EdgeWebSocketServer":
         try:
@@ -483,6 +487,7 @@ class EdgeWebSocketServer:
                 self._server.close()  # close everything at teardown anyway
 
     async def stop(self) -> None:
+        await self._side_tasks.aclose()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -647,7 +652,10 @@ class EdgeWebSocketServer:
                 finally:
                     await ws.close(code=1001)
 
-            loop.create_task(_send_and_close())
+            try:
+                self._side_tasks.spawn(_send_and_close())
+            except RuntimeError:  # server already stopped: nothing to hint
+                pass
             if not pump_task.done():
                 pump_task.cancel()
 

@@ -1056,6 +1056,12 @@ class _WorkerMain:
             self.fd_sock.setblocking(False)
         self._fd_buf = b""
         self._fd_pending: list = []  # fds awaiting their framed head
+        #: in-flight handoff serving tasks — retained (the loop holds
+        #: tasks weakly; an unreferenced one can vanish mid-accept) and
+        #: cancelled at teardown so a dying worker can't leak half-served
+        #: connections (fusionlint FL003). Stdlib-only: no TaskSet import
+        #: here — workers run as `python <this file> --worker`.
+        self._handoff_tasks: set = set()
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
         self.keys: Dict[int, str] = {}
@@ -1142,6 +1148,9 @@ class _WorkerMain:
                     asyncio.get_event_loop().remove_reader(self.fd_sock.fileno())
                 except (OSError, RuntimeError):
                     pass
+            for task in list(self._handoff_tasks):
+                if not task.done():
+                    task.cancel()
             if self.server is not None:
                 self.server.close()
 
@@ -1189,9 +1198,11 @@ class _WorkerMain:
                 except OSError:
                     pass
                 continue
-            asyncio.get_event_loop().create_task(
+            task = asyncio.get_event_loop().create_task(
                 self._handle_handoff(conn_sock, head)
             )
+            self._handoff_tasks.add(task)
+            task.add_done_callback(self._handoff_tasks.discard)
 
     async def _handle_handoff(self, conn_sock: socket.socket, head: bytes) -> None:
         try:

@@ -30,6 +30,9 @@ class EntityResolver(Generic[K, V]):
         self.max_batch_size = max_batch_size
         self._pending: Dict[K, "asyncio.Future[Optional[V]]"] = {}
         self._flush_scheduled = False
+        #: in-flight flush tasks, retained (FL003): the loop holds tasks
+        #: weakly and a collected flush would strand every batched waiter
+        self._flush_tasks: set = set()
         self.batches = 0  # stats: backend round trips
         self.requests = 0
 
@@ -52,7 +55,9 @@ class EntityResolver(Generic[K, V]):
     def _spawn_flush(self) -> None:
         self._flush_scheduled = False
         if self._pending:
-            asyncio.ensure_future(self._flush())
+            task = asyncio.ensure_future(self._flush())
+            self._flush_tasks.add(task)
+            task.add_done_callback(self._flush_tasks.discard)
 
     async def _flush(self) -> None:
         while self._pending:

@@ -147,7 +147,9 @@ class RpcInboundCall:
         result if we have one; otherwise the original task is still running
         and will send it."""
         if self.result_message is not None:
-            asyncio.get_event_loop().create_task(self._resend_result())
+            self.peer.track_side_task(
+                asyncio.get_event_loop().create_task(self._resend_result())
+            )
 
     async def _resend_result(self) -> None:
         # a non-transport redelivery failure answers with a one-shot error

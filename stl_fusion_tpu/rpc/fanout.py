@@ -396,6 +396,9 @@ class WaveValuePublisher:
         self.values_serialized = 0  # ONE per (key, version), shared by peers
         self.fallback_fences = 0  # plain invalidations posted by the ladder
         self.overflow_fallbacks = 0  # of which: round-budget overflow
+        self.loop_faults = 0  # publisher loop crashes (FL002: counted, alertable)
+        self.recompute_errors = 0  # service retired / registry miss mid-publish
+        self.block_send_failures = 0  # value_block sends lost to a dead link
         from ..diagnostics.metrics import global_metrics
 
         # publish pressure is non-additive: two half-loaded publishers
@@ -414,6 +417,9 @@ class WaveValuePublisher:
             "fusion_value_serialized_total": self.values_serialized,
             "fusion_value_publish_rounds_total": self.rounds,
             "fusion_value_fallback_fences_total": self.fallback_fences,
+            "fusion_value_publisher_faults_total": self.loop_faults,
+            "fusion_value_recompute_errors_total": self.recompute_errors,
+            "fusion_value_block_send_failures_total": self.block_send_failures,
             "fusion_value_publish_pressure": round(self.pressure(), 4),
         }
 
@@ -560,6 +566,11 @@ class WaveValuePublisher:
         except asyncio.CancelledError:
             raise
         except Exception:  # noqa: BLE001 — the publisher must never die silently
+            # counted, not just logged: a dead publisher is every standing
+            # sub silently stale (the exact class FL002 exists to catch) —
+            # operators alert on this counter, and the next schedule()
+            # re-spawns the loop
+            self.loop_faults += 1
             log.exception("value publisher loop failed")
 
     # ------------------------------------------------------------------ publish
@@ -569,7 +580,9 @@ class WaveValuePublisher:
         try:
             service_def = self.rpc_hub.service_registry.require(service)
             fn = service_def.method(method).fn
-        except Exception:  # noqa: BLE001 — service retired mid-flight
+        except Exception:  # noqa: BLE001 — service retired mid-flight:
+            # counted; the caller's fallback fence handles the key
+            self.recompute_errors += 1
             return None
         self.recomputes += 1
         with suspend_dependency_capture():
@@ -718,7 +731,11 @@ class WaveValuePublisher:
                 raise
             except Exception:  # noqa: BLE001 — link died mid-block: fence
                 # plain; the pending invalidations ride the outbox across
-                # the reconnect and the edge's re-read re-arms publish
+                # the reconnect and the edge's re-read re-arms publish.
+                # The send failure itself is counted UNCONDITIONALLY — the
+                # per-sub fence below only fires for subs still standing,
+                # so a flapping link could otherwise drop blocks silently
+                self.block_send_failures += 1
                 for cid, cause, t0 in zip(cids, causes, t0s):
                     sub = self._standing.get((id(peer), cid))
                     if sub is not None:

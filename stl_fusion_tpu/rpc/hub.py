@@ -16,7 +16,7 @@ import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Sequence
 
 from ..diagnostics.metrics import global_metrics
-from ..utils.async_utils import ChannelPair
+from ..utils.async_utils import ChannelPair, TaskSet
 from .calls import RpcCallTypeRegistry, RpcOutboundCall
 from .message import RpcMessage
 from .peer import RpcClientPeer, RpcPeer, RpcServerPeer
@@ -53,6 +53,10 @@ class RpcHub:
         self._outbound_call_ids = itertools.count(1)
         #: transport factory for client peers: async (peer) -> ChannelPair
         self.client_connector: Optional[Callable[[RpcClientPeer], Awaitable[ChannelPair]]] = None
+        #: hub-lifecycle owner for fire-and-forget side tasks (cache
+        #: synchronize, etc. — the fusionlint FL003 contract): strong refs
+        #: until settled, cancelled at stop()
+        self.side_tasks = TaskSet(name=f"rpc-hub:{name}")
         self.call_router: RpcCallRouter = lambda service, method, args: "default"
         #: 0 = unlimited; n ≥ 1 serializes non-system inbound calls per peer
         #: through an n-permit gate (≈ InboundConcurrencyLevel, RpcPeer.cs:20)
@@ -140,6 +144,7 @@ class RpcHub:
             "fusion_batch_frames_sent_total": s["batch_frames_sent"],
             "fusion_batch_keys_sent_total": s["batch_keys_sent"],
             "fusion_outbox_pending_dropped_total": s["pending_dropped"],
+            "fusion_outbox_drain_faults_total": s["drain_faults"],
             "fusion_rpc_peers": len(self.peers),
         }
         fi = s.get("fanout_index")
@@ -252,6 +257,10 @@ class RpcHub:
                     router.note_moved(e)
 
     async def stop(self) -> None:
+        # cancel in-flight side tasks, then re-arm: stop() means "stop the
+        # current work", and tests reuse a stopped hub for a fresh connect
+        await self.side_tasks.aclose()
+        self.side_tasks = TaskSet(name=f"rpc-hub:{self.name}")
         for peer in list(self.peers.values()):
             await peer.stop()
 
@@ -267,6 +276,7 @@ class RpcHub:
             "batch_frames_sent": 0,
             "batch_keys_sent": 0,
             "pending_dropped": 0,
+            "drain_faults": 0,
             "queued": 0,
             "pending_invalidations": 0,
         }

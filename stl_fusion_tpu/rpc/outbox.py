@@ -94,6 +94,7 @@ class PeerOutbox:
         self.batch_frames_sent = 0
         self.batch_keys_sent = 0
         self.pending_dropped = 0  # give-up drops while disconnected
+        self.drain_faults = 0  # drain-loop crashes (counted, never just logged)
 
     # ------------------------------------------------------------------ enqueue
     def can_bypass(self) -> bool:
@@ -228,6 +229,10 @@ class PeerOutbox:
         except asyncio.CancelledError:
             raise
         except Exception:  # noqa: BLE001 — the drain must never die silently
+            # counted (FL002): a dead drain is a peer whose fences stop
+            # flowing while the link looks healthy — the next _kick
+            # re-spawns, but the fault must be visible on a scrape
+            self.drain_faults += 1
             log.exception("outbox %s: drain loop failed", peer.ref)
 
     async def _flush_invalidations(self) -> None:
@@ -330,6 +335,7 @@ class PeerOutbox:
             "batch_frames_sent": self.batch_frames_sent,
             "batch_keys_sent": self.batch_keys_sent,
             "pending_dropped": self.pending_dropped,
+            "drain_faults": self.drain_faults,
             "queued": len(self._fifo),
             "pending_invalidations": len(self._pending_inval),
         }

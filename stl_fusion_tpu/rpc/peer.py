@@ -320,6 +320,14 @@ class RpcPeer(WorkerBase):
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001
+            # counted (FL002): every degraded branch below is a fallback —
+            # an error-rate spike must be scrapeable, not only in the logs
+            from ..diagnostics.metrics import global_metrics
+
+            global_metrics().counter(
+                "fusion_rpc_process_failures_total",
+                help="inbound messages whose processing raised (per-branch recovery below)",
+            ).inc()
             log.exception(
                 "peer %s: processing %s.%s #%d failed",
                 self.ref, message.service, message.method, message.call_id,
@@ -390,6 +398,17 @@ class RpcPeer(WorkerBase):
                     task.add_done_callback(self._on_diag_done)
         else:
             self._process_inbound(message)
+
+    def track_side_task(self, task: "asyncio.Task") -> "asyncio.Task":
+        """Adopt a fire-and-forget task into this peer's lifecycle (the
+        fusionlint FL003 contract): a strong ref until it settles — the
+        loop holds tasks weakly — and cancellation at ``stop()``. Failures
+        ride the ``_on_diag_done`` swallow: side traffic (resends,
+        invalidation pushes, explain replies) times out at the asker and
+        must never surface as an unhandled-task error on the serving loop."""
+        self._diag_tasks.add(task)
+        task.add_done_callback(self._on_diag_done)
+        return task
 
     def _on_diag_done(self, task: "asyncio.Task") -> None:
         self._diag_tasks.discard(task)

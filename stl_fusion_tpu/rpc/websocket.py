@@ -155,10 +155,14 @@ class _WsAdapter:
         self._ws = ws
         self.reader = _WsAdapter._Reader(ws)
         self.writer = _WsAdapter._Writer(ws)
+        self._close_task: Optional["asyncio.Task"] = None
 
     def close(self, error: Optional[BaseException] = None) -> None:
         self.writer._task.cancel()
-        asyncio.ensure_future(self._ws.close())
+        if self._close_task is None or self._close_task.done():
+            # retained on the adapter (FL003): the loop holds tasks weakly,
+            # and a collected close task leaves the socket half-open
+            self._close_task = asyncio.ensure_future(self._ws.close())
 
 
 class RpcWebSocketServer:

@@ -25,6 +25,7 @@ import logging
 from typing import Any, Callable, Optional
 
 from ..edge.session import LatestWinsMailbox, pump_payloads
+from ..utils.async_utils import TaskSet
 from .live_component import LiveComponent
 
 log = logging.getLogger("stl_fusion_tpu")
@@ -89,6 +90,9 @@ class LiveViewServer:
         self.connections = 0
         self.evictions = 0  # observability: slow clients closed mid-send
         self._server = None
+        #: eviction-close side tasks, owned so stop() cancels a close still
+        #: in flight instead of leaking it (fusionlint FL003)
+        self._side_tasks = TaskSet(name="live-view-side")
 
     async def start(self) -> "LiveViewServer":
         from websockets.asyncio.server import serve
@@ -128,7 +132,10 @@ class LiveViewServer:
             if transport is not None:
                 transport.abort()
             else:
-                asyncio.ensure_future(ws.close())
+                try:
+                    self._side_tasks.spawn(ws.close())
+                except RuntimeError:  # server stopped: socket dies with it
+                    pass
 
         pump_task = asyncio.ensure_future(
             pump_payloads(
@@ -157,6 +164,7 @@ class LiveViewServer:
             await component.unmount()
 
     async def stop(self) -> None:
+        await self._side_tasks.aclose()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()

@@ -13,7 +13,10 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
+import logging
 from typing import Any, AsyncIterator, Generic, Hashable, Optional, Tuple, TypeVar
+
+log = logging.getLogger("stl_fusion_tpu")
 
 T = TypeVar("T")
 
@@ -24,8 +27,84 @@ __all__ = [
     "Channel",
     "ChannelClosedError",
     "ChannelPair",
+    "TaskSet",
     "create_twisted_pair",
 ]
+
+
+class TaskSet:
+    """Lifecycle owner for fire-and-forget tasks (the fusionlint FL003
+    contract): ``spawn()`` keeps a strong reference until the task settles
+    — the event loop holds tasks weakly, so a bare ``create_task(...)``
+    can be garbage-collected mid-flight — and teardown has one handle to
+    cancel every in-flight side task instead of leaking them past their
+    owner's close (the PR 8/10 ghost-session / leaked-pin class).
+
+    A failed task is logged by default (the bare-``create_task`` shape at
+    least produced asyncio's never-retrieved traceback; owning the task
+    must not make failures QUIETER) — pass ``on_error=`` to count or
+    contain instead. Spawning after ``cancel()`` raises ``RuntimeError``
+    so a closed owner can't quietly restart its side work.
+    """
+
+    __slots__ = ("_tasks", "_name", "_closed", "_on_error")
+
+    def __init__(self, name: str = "task-set", on_error=None):
+        self._tasks: set = set()
+        self._name = name
+        self._closed = False
+        self._on_error = on_error
+
+    def __len__(self) -> int:
+        return len(self._tasks)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def spawn(self, coro, name: Optional[str] = None) -> "asyncio.Task":
+        if self._closed:
+            coro.close()  # don't leave a never-awaited coroutine warning
+            raise RuntimeError(f"TaskSet {self._name!r} is closed")
+        task = asyncio.get_event_loop().create_task(
+            coro, name=name or f"{self._name}:task"
+        )
+        self._tasks.add(task)
+        task.add_done_callback(self._reap)
+        return task
+
+    def _reap(self, task: "asyncio.Task") -> None:
+        self._tasks.discard(task)
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is None:
+            return
+        if self._on_error is not None:
+            try:
+                self._on_error(task, exc)
+            except Exception:
+                pass  # a raising error hook inside a done-callback must not escape
+        else:
+            log.error(
+                "task-set %s: task %s failed", self._name, task.get_name(),
+                exc_info=exc,
+            )
+
+    def cancel(self) -> int:
+        """Cancel every in-flight task and close the set. Returns how many
+        were still running (teardown accounting)."""
+        self._closed = True
+        pending = [t for t in self._tasks if not t.done()]
+        for t in pending:
+            t.cancel()
+        return len(pending)
+
+    async def aclose(self) -> None:
+        """``cancel()`` then await the stragglers' completion."""
+        self.cancel()
+        if self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)
 
 
 class AsyncEvent(Generic[T]):

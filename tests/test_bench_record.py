@@ -130,12 +130,22 @@ def test_compact_record_stays_under_tail_window():
         "audit": {"keys_audited": 128, "stale": 0, "violations": 0,
                   "canary_staleness_ms": 0.31},
     }
+    lint = {
+        "ok": True,
+        "findings": 0,
+        "by_rule": {},
+        "suppressions": {"FL002": 3, "FL003": 1},
+        "suppressions_total": 4,
+        "baseline": 68,
+        "baseline_stale": 0,
+        "files": 135,
+    }
     line = json.dumps(
         _compact_result(7.07e9, detail, live, edge=edge, mesh=mesh,
-                        traffic=traffic),
+                        traffic=traffic, lint=lint),
         separators=(",", ":"),
     )
-    assert len(line) < 3500, f"compact record grew to {len(line)} bytes"
+    assert len(line) < 3700, f"compact record grew to {len(line)} bytes"
     d = json.loads(line)
     # the edge tier (ISSUE 8): the million-subscriber numbers make the capture
     assert d["edge"]["subs"] == 1_000_000 and d["edge"]["fenced_per_s"] == 412346
@@ -180,6 +190,12 @@ def test_compact_record_stays_under_tail_window():
     assert d["traffic"]["reconnect_resumed"] == 10_000
     assert d["traffic"]["reshard_p99_ms"] == 512.1
     assert d["traffic"]["audit_violations"] == 0
+    # the static gate (ISSUE 13): the lint verdict + per-rule suppression
+    # counts + baseline size ride the capture (a growing suppression or
+    # grandfathered set must be visible in the canonical record)
+    assert d["lint"]["ok"] is True and d["lint"]["findings"] == 0
+    assert d["lint"]["suppressions"] == {"FL002": 3, "FL003": 1}
+    assert d["lint"]["baseline"] == 68 and d["lint"]["baseline_stale"] == 0
 
 
 def test_compact_record_handles_live_error_and_sharded():

@@ -1,0 +1,600 @@
+"""fusionlint (ISSUE 13): per-rule fixtures distilled from the historical
+bug each rule encodes, suppression-reason enforcement, baseline no-growth,
+JSON schema stability, the repo-clean gate, and regression tests pinning
+the product defects the analyzer surfaced and this PR fixed.
+
+The fixtures run the REAL engine over throwaway mini-repos (the engine
+only scans ``<root>/stl_fusion_tpu/``), so every assertion exercises the
+same path CI runs: ``python -m tools.fusionlint``.
+"""
+import asyncio
+import json
+import textwrap
+
+import pytest
+
+from tools.fusionlint import JSON_SCHEMA_VERSION, Finding
+from tools.fusionlint.affinity import parse_toml_subset
+from tools.fusionlint.engine import baseline_from_findings, run_lint
+
+MINI_DOC = "# Observability\n\n(no metrics yet)\n"
+MINI_AFFINITY = """
+[marshals]
+helpers = ["call_soon_threadsafe", "run_coroutine_threadsafe"]
+
+[home_loop]
+"stl_fusion_tpu/pub.py::Publisher._schedule_on_loop" = ""
+"""
+
+
+def lint(tmp_path, files, doc=MINI_DOC, affinity=MINI_AFFINITY, use_baseline=False,
+         baseline=None):
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(content))
+    (tmp_path / "OBSERVABILITY.md").write_text(textwrap.dedent(doc))
+    aff = tmp_path / "affinity.toml"
+    aff.write_text(textwrap.dedent(affinity))
+    bl = tmp_path / "baseline.json"
+    if baseline is not None:
+        bl.write_text(json.dumps(baseline))
+    return run_lint(
+        root=str(tmp_path),
+        affinity_path=str(aff),
+        baseline_path=str(bl),
+        use_baseline=use_baseline or baseline is not None,
+    )
+
+
+def rules_of(report):
+    return sorted(f.rule for f in report.active)
+
+
+# ---------------------------------------------------------------------- FL001
+
+FL001_PUB = """
+    class Publisher:
+        def _schedule_on_loop(self, nids):
+            self._pending.update(nids)
+"""
+
+
+def test_fl001_flags_cross_module_direct_call(tmp_path):
+    """The PR 11 WaveValuePublisher.schedule class: an off-module caller
+    invoking the home-loop merge directly races the round's dict swap —
+    entries land in a dict nobody reads, silently stale forever."""
+    report = lint(tmp_path, {
+        "stl_fusion_tpu/pub.py": FL001_PUB,
+        "stl_fusion_tpu/drain.py": """
+            def on_wave(pub, nids):
+                pub._schedule_on_loop(nids)  # the distilled bug
+        """,
+    })
+    assert rules_of(report) == ["FL001"]
+    (f,) = report.active
+    assert f.path == "stl_fusion_tpu/drain.py"
+    assert "_schedule_on_loop" in f.message
+
+
+def test_fl001_marshaled_and_same_module_calls_pass(tmp_path):
+    report = lint(tmp_path, {
+        "stl_fusion_tpu/pub.py": FL001_PUB + """
+    def kick(pub, nids):
+        pub._schedule_on_loop(nids)  # same module owns the discipline
+""",
+        "stl_fusion_tpu/drain.py": """
+            def on_wave(loop, pub, nids):
+                loop.call_soon_threadsafe(pub._schedule_on_loop, dict(nids))
+
+            def on_wave_lambda(loop, pub, nids):
+                loop.call_soon_threadsafe(lambda: pub._schedule_on_loop(nids))
+        """,
+    })
+    assert report.active == []
+
+
+def test_fl001_inline_marker_registers_without_toml(tmp_path):
+    report = lint(tmp_path, {
+        "stl_fusion_tpu/owner.py": """
+            class Owner:
+                def _merge(self, x):  # fusionlint: home-loop
+                    self.state.update(x)
+        """,
+        "stl_fusion_tpu/caller.py": """
+            def use(o):
+                o._merge({})
+        """,
+    }, affinity="[marshals]\nhelpers = [\"call_soon_threadsafe\"]\n")
+    assert rules_of(report) == ["FL001"]
+
+
+# ---------------------------------------------------------------------- FL002
+
+def test_fl002_flags_silent_broad_handler(tmp_path):
+    """The counted-never-silent contract: a broad except re-entering a
+    degraded path without a counter is how the CHANGES.md review logs
+    kept re-finding silent fallbacks by hand."""
+    report = lint(tmp_path, {
+        "stl_fusion_tpu/edge/x.py": """
+            def serve(self):
+                try:
+                    self.fast_path()
+                except Exception:
+                    self.slow_path()  # degrades, nothing counted
+        """,
+    })
+    assert rules_of(report) == ["FL002"]
+
+
+def test_fl002_flags_uncounted_early_return_branch(tmp_path):
+    report = lint(tmp_path, {
+        "stl_fusion_tpu/rpc/x.py": """
+            def serve(self):
+                try:
+                    self.fast_path()
+                except Exception:
+                    if self.maybe():
+                        return None  # uncounted exit on ONE path
+                    self.fallbacks += 1
+        """,
+    })
+    assert rules_of(report) == ["FL002"]
+
+
+def test_fl002_counted_shapes_pass(tmp_path):
+    report = lint(tmp_path, {
+        "stl_fusion_tpu/edge/ok.py": """
+            def a(self):
+                try:
+                    self.fast()
+                except Exception:
+                    self.fallbacks += 1  # the hot-path attribute counter
+
+            def b(self, metrics):
+                try:
+                    self.fast()
+                except Exception:
+                    metrics.counter("x_total").inc()  # non-fusion name: no FL005 row needed
+
+            def c(self):
+                try:
+                    self.fast()
+                except Exception:
+                    raise RuntimeError("wrapped")  # re-raise is vacuous
+
+            def d(self):
+                try:
+                    self.fast()
+                except Exception:
+                    self._shed()  # counts through a local helper
+
+            def _shed(self):
+                self.shed_total += 1
+        """,
+        # outside edge/rpc/graph/parallel: the contract does not apply
+        "stl_fusion_tpu/core/ok.py": """
+            def a(self):
+                try:
+                    self.fast()
+                except Exception:
+                    pass
+        """,
+        # narrow catches are structural handling, not fallback ladders
+        "stl_fusion_tpu/edge/narrow.py": """
+            def a(self):
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+        """,
+    })
+    assert report.active == []
+
+
+# ---------------------------------------------------------------------- FL003
+
+def test_fl003_flags_fire_and_forget(tmp_path):
+    """The PR 8/10 ghost-session / leaked-pin class: the loop holds tasks
+    weakly, so a bare create_task can vanish mid-flight and teardown has
+    no handle to cancel."""
+    report = lint(tmp_path, {
+        "stl_fusion_tpu/edge/x.py": """
+            import asyncio
+
+            def fire(coro, cb):
+                asyncio.get_event_loop().create_task(coro())
+                asyncio.ensure_future(coro())
+                asyncio.create_task(coro()).add_done_callback(cb)  # cb is no owner
+        """,
+    })
+    assert rules_of(report) == ["FL003", "FL003", "FL003"]
+
+
+def test_fl003_retained_shapes_pass(tmp_path):
+    report = lint(tmp_path, {
+        "stl_fusion_tpu/edge/ok.py": """
+            import asyncio
+
+            async def ok(self, coro, tasks):
+                self._task = asyncio.get_event_loop().create_task(coro())
+                tasks.add(asyncio.create_task(coro()))
+                self.peer.track_side_task(asyncio.ensure_future(coro()))
+                await asyncio.create_task(coro())
+                return asyncio.create_task(coro())
+        """,
+    })
+    assert report.active == []
+
+
+# ---------------------------------------------------------------------- FL004
+
+def test_fl004_flags_blocking_in_async(tmp_path):
+    """The PR 10 frozen-pump class: a blocking wait()/sleep inside an
+    async def froze every other edge's pumps for seconds per worker."""
+    report = lint(tmp_path, {
+        "stl_fusion_tpu/edge/x.py": """
+            import asyncio
+            import subprocess
+            import time
+            from time import sleep as snooze
+
+            async def pump(self):
+                time.sleep(1)
+                snooze(0.1)
+                subprocess.run(["true"])
+                self.proc.wait(timeout=5)
+        """,
+    })
+    assert rules_of(report) == ["FL004", "FL004", "FL004", "FL004"]
+
+
+def test_fl004_sync_and_async_equivalents_pass(tmp_path):
+    report = lint(tmp_path, {
+        "stl_fusion_tpu/edge/ok.py": """
+            import asyncio
+            import time
+
+            def sync_path():
+                time.sleep(1)  # sync code may block
+
+            async def ok(self, loop):
+                await asyncio.sleep(1)
+                await self.proc.wait()  # asyncio subprocess: awaited
+                await self.event.wait()
+                loop.run_in_executor(None, time.sleep, 1)
+                fn = lambda: time.sleep(1)  # executes on a worker thread
+        """,
+    })
+    assert report.active == []
+
+
+# ---------------------------------------------------------------------- FL005
+
+FL005_CODE = """
+    from ..diagnostics.metrics import global_metrics
+
+    class C:
+        def boot(self):
+            global_metrics().counter("fusion_good_total").inc()
+            global_metrics().set_aggregation("fusion_depth", "max")
+
+        def _collect(self):
+            out = {"fusion_depth": self.depth, "fusion_undocumented_total": 1}
+            for lane, n in self.lanes.items():
+                out[f'fusion_laned_total{{lane="{lane}"}}'] = n
+            return {f"fusion_family_{k}_total": v for k, v in out.items()}
+"""
+
+FL005_DOC = """
+    # Observability
+
+    | metric | kind | meaning |
+    | --- | --- | --- |
+    | `fusion_good_total` | counter | fine |
+    | `fusion_depth` | gauge | MAX-aggregated depth |
+    | `fusion_laned_total{lane=}` | counter | per-lane |
+    | `fusion_family_<kind>_total` | counter | the family |
+    | `fusion_stale_total` | counter | removed from code long ago |
+"""
+
+
+def test_fl005_catalog_drift_both_directions(tmp_path):
+    report = lint(
+        tmp_path, {"stl_fusion_tpu/m.py": FL005_CODE},
+        doc=FL005_DOC,
+    )
+    msgs = sorted(f.message for f in report.active)
+    assert len(msgs) == 2
+    assert "fusion_undocumented_total" in msgs[1] and "no catalog row" in msgs[1]
+    assert "fusion_stale_total" in msgs[0] and "stale row" in msgs[0]
+    # matched entries: label sets, MAX marker, and the <kind> ↔ f-string
+    # placeholder normalization all line up — no drift reported for them
+    assert all("fusion_laned_total" not in m for m in msgs)
+    assert all("fusion_family" not in m for m in msgs)
+    assert all("fusion_depth" not in m for m in msgs)
+
+
+def test_fl005_label_and_max_drift(tmp_path):
+    doc = """
+        # Observability
+
+        | metric | kind | meaning |
+        | --- | --- | --- |
+        | `fusion_laned_total{tenant=}` | counter | WRONG label key |
+        | `fusion_depth` | gauge | no aggregation note |
+        | `fusion_good_total` | counter | fine |
+        | `fusion_undocumented_total` | counter | now documented |
+        | `fusion_family_<kind>_total` | counter | the family |
+    """
+    report = lint(tmp_path, {"stl_fusion_tpu/m.py": FL005_CODE}, doc=doc)
+    msgs = "\n".join(f.message for f in report.active)
+    assert "label drift on fusion_laned_total" in msgs
+    assert "does not say MAX" in msgs and "fusion_depth" in msgs
+    assert len(report.active) == 2
+
+
+# ------------------------------------------------------------- suppressions
+
+def test_suppression_requires_reason_and_counts(tmp_path):
+    files = {
+        "stl_fusion_tpu/edge/x.py": """
+            import asyncio
+
+            def fire(coro):
+                asyncio.create_task(coro())  # fusionlint: disable=FL003 owner outlives the loop here
+
+            def fire2(coro):
+                asyncio.create_task(coro())  # fusionlint: disable=FL003
+        """,
+    }
+    report = lint(tmp_path, files)
+    # the reasoned suppression holds; the reasonless one is FL000 AND the
+    # original finding stands (a bad suppression must not suppress)
+    assert rules_of(report) == ["FL000", "FL003"]
+    assert report.summary()["fusionlint_suppressions_total"] == {"FL003": 1}
+    assert report.summary()["suppressions_total"] == 1
+
+
+def test_suppression_standalone_comment_covers_next_line(tmp_path):
+    report = lint(tmp_path, {
+        "stl_fusion_tpu/edge/x.py": """
+            import asyncio
+
+            def fire(coro):
+                # fusionlint: disable=FL003 replay task dies with the socket
+                asyncio.create_task(coro())
+        """,
+    })
+    assert report.active == []
+    assert report.summary()["fusionlint_suppressions_total"] == {"FL003": 1}
+
+
+# ------------------------------------------------------------------ baseline
+
+BAD = """
+    import asyncio
+
+    def fire(coro):
+        asyncio.create_task(coro())
+"""
+
+
+def test_baseline_grandfathers_then_forbids_growth(tmp_path):
+    report = lint(tmp_path, {"stl_fusion_tpu/edge/x.py": BAD})
+    assert rules_of(report) == ["FL003"]
+    baseline = baseline_from_findings(report.findings)
+    assert baseline["entries"] == [
+        {"key": "FL003::stl_fusion_tpu/edge/x.py::fire", "count": 1}
+    ]
+    # grandfathered: clean
+    clean = lint(tmp_path, {"stl_fusion_tpu/edge/x.py": BAD}, baseline=baseline)
+    assert clean.active == [] and clean.baseline_matched == 1
+    # growth in the SAME bucket: exactly the new finding surfaces
+    grown = lint(tmp_path, {
+        "stl_fusion_tpu/edge/x.py": BAD + """
+    asyncio.create_task(fire(None))
+""",
+    }, baseline=baseline)
+    assert rules_of(grown) == ["FL003"]
+    # fixed finding: stale entry reported so the baseline can shrink
+    fixed = lint(tmp_path, {"stl_fusion_tpu/edge/x.py": "x = 1\n"}, baseline=baseline)
+    assert fixed.active == [] and fixed.baseline_stale == 1
+
+
+# ---------------------------------------------------------------- JSON schema
+
+def test_json_schema_stability(tmp_path):
+    report = lint(tmp_path, {"stl_fusion_tpu/edge/x.py": BAD})
+    data = report.to_json()
+    assert set(data) == {"version", "findings", "summary"}
+    assert data["version"] == JSON_SCHEMA_VERSION == 1
+    (finding,) = data["findings"]
+    assert set(finding) == {"rule", "path", "line", "col", "context", "message"}
+    assert set(data["summary"]) == {
+        "findings_total",
+        "findings_by_rule",
+        "suppressions_total",
+        "fusionlint_suppressions_total",
+        "baseline_size",
+        "baseline_matched",
+        "baseline_stale",
+        "files_scanned",
+    }
+    assert data["summary"]["findings_by_rule"] == {"FL003": 1}
+
+
+def test_affinity_toml_subset_parser():
+    data = parse_toml_subset(textwrap.dedent("""
+        # comment
+        [marshals]
+        helpers = ["a", "b"]  # trailing
+        [home_loop]
+        "m.py::C.f" = "domain-x"
+        [multi]
+        items = [
+          "one",
+          "two",
+        ]
+    """))
+    assert data["marshals"]["helpers"] == ["a", "b"]
+    assert data["home_loop"]['m.py::C.f'] == "domain-x"
+    assert data["multi"]["items"] == ["one", "two"]
+
+
+# ------------------------------------------------------------ the repo gate
+
+def test_repo_lints_clean_with_committed_baseline():
+    """The acceptance gate, mirrored in CI: zero unbaselined findings over
+    the real tree. A new violation fails HERE first."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    report = run_lint(root=root)
+    assert report.active == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in report.active
+    )
+    assert report.baseline_stale == 0, (
+        "baseline has stale entries — a finding was fixed; shrink with "
+        "python -m tools.fusionlint --write-baseline"
+    )
+
+
+# ----------------------------------------------- fixed-defect regressions
+
+async def test_taskset_tracks_cancels_and_refuses_after_close():
+    """FL003 fix core: TaskSet pins strong refs, cancels at teardown, and
+    a closed owner cannot quietly restart side work."""
+    from stl_fusion_tpu.utils.async_utils import TaskSet
+
+    ts = TaskSet(name="t")
+    started = asyncio.Event()
+
+    async def hang():
+        started.set()
+        await asyncio.Event().wait()
+
+    task = ts.spawn(hang())
+    await started.wait()
+    assert len(ts) == 1
+    assert ts.cancel() == 1
+    for _ in range(3):  # cancellation + done-callback each need a tick
+        await asyncio.sleep(0)
+    assert task.cancelled() and len(ts) == 0
+    with pytest.raises(RuntimeError):
+        ts.spawn(hang())
+    # completed tasks reap themselves
+    ts2 = TaskSet(name="t2")
+
+    async def quick():
+        return 7
+
+    t = ts2.spawn(quick())
+    await t
+    await asyncio.sleep(0)
+    assert len(ts2) == 0
+    await ts2.aclose()
+    # failures stay VISIBLE: on_error observes them (and without a hook
+    # the reaper logs — owning a task must not make failures quieter)
+    seen = []
+    ts3 = TaskSet(name="t3", on_error=lambda task, exc: seen.append(exc))
+
+    async def boom():
+        raise ValueError("induced")
+
+    with pytest.raises(ValueError):
+        await ts3.spawn(boom())
+    await asyncio.sleep(0)
+    assert len(seen) == 1 and isinstance(seen[0], ValueError)
+    await ts3.aclose()
+
+
+async def test_reread_batcher_flush_is_owned_and_cancelled_on_close():
+    """The representative FL003 leak this PR fixes (ISSUE 13 satellite):
+    the edge's batched re-read flush was a bare create_task — a node
+    closing mid-RPC left the flush (and its upstream call) in flight
+    forever. Now the batcher owns the task and cancel_all() reaps it."""
+    from stl_fusion_tpu.diagnostics.metrics import Histogram
+    from stl_fusion_tpu.edge.gateway import _RereadBatcher
+
+    flush_started = asyncio.Event()
+
+    class StubClient:
+        async def capture_batch(self, requests):
+            flush_started.set()
+            await asyncio.Event().wait()  # hang like a dead upstream
+
+    class StubNode:
+        reread_batch_max = 1  # submit fires immediately
+        value_blocks = False
+        reread_batches = 0
+        upstream_rpcs = 0
+        reread_batch_keys = 0
+        _batch_size_hist = Histogram("test_batch_size", unit="keys")
+
+        def effective_reread_window(self):
+            return 0.0
+
+        def _client_for(self, owner):
+            return StubClient()
+
+    class StubSub:
+        method = "node"
+        args = (1,)
+
+    batcher = _RereadBatcher(StubNode())
+    future = batcher.submit("m0", StubSub())
+    await flush_started.wait()
+    assert len(batcher._flights) == 1  # owned, not fire-and-forget
+    batcher.cancel_all()
+    await asyncio.sleep(0)
+    await asyncio.sleep(0)
+    assert len(batcher._flights) == 0
+    assert future.cancelled()
+    # and a post-close timer fire cannot resurrect a flush
+    batcher._pending["m0"] = [(StubSub(), asyncio.get_event_loop().create_future())]
+    batcher._fire("m0")
+    assert len(batcher._flights) == 0
+
+
+async def test_value_publisher_loop_fault_is_counted():
+    """The representative FL002 fix: a crashed publisher loop used to be
+    log-only — every standing sub silently stale with nothing scrapeable.
+    Now it counts (fusion_value_publisher_faults_total)."""
+    from stl_fusion_tpu.rpc.fanout import WaveValuePublisher
+    from stl_fusion_tpu.rpc.hub import RpcHub
+
+    pub = WaveValuePublisher(RpcHub("t"))
+    try:
+        async def boom(batch):
+            raise ValueError("induced")
+
+        pub._publish_round = boom
+        pub._schedule_on_loop({1: (None, None)})
+        assert pub._task is not None
+        await pub._task  # the loop contains the crash instead of raising
+        assert pub.loop_faults == 1
+        assert pub._collect_metrics()["fusion_value_publisher_faults_total"] == 1
+    finally:
+        pub.dispose()
+
+
+async def test_outbox_drain_fault_is_counted():
+    """Same class, delivery pump: a dead outbox drain is a peer whose
+    fences stop flowing on a healthy-looking link — now scrapeable."""
+    from stl_fusion_tpu.rpc.hub import RpcHub
+
+    hub = RpcHub("t")
+    peer = hub.server_peer("p0")
+    outbox = peer.outbox
+    assert outbox.stats()["drain_faults"] == 0
+
+    async def boom():
+        raise RuntimeError("induced")
+
+    # crash the loop body deterministically: _drain awaits _wake first
+    outbox._wake.wait = boom
+    outbox._kick()
+    await outbox._task
+    assert outbox.stats()["drain_faults"] == 1
+    assert hub.fanout_stats()["drain_faults"] == 1

@@ -1,0 +1,1 @@
+"""Repo-native developer tooling (no runtime dependencies on this package)."""

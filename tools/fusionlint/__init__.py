@@ -1,0 +1,81 @@
+"""fusionlint — a repo-native static analyzer for the invalidation pipeline.
+
+Five rules distilled from the measured bug history (see README.md in this
+directory for the full catalog, one section per rule with the CHANGES.md
+PR reference each rule encodes):
+
+- **FL001 cross-loop safety** — a function marked loop-affine
+  (``# fusionlint: home-loop`` on its ``def`` line, or registered in
+  ``affinity.toml``) must not be CALLED from a differently-affine module;
+  off-module callers go through ``call_soon_threadsafe`` / the marshaling
+  helpers, which pass the callable un-called. The PR 11
+  ``WaveValuePublisher.schedule`` pending-map-merge race class.
+- **FL002 counted-fallback** — a broad ``except`` handler inside
+  ``stl_fusion_tpu/{edge,rpc,graph,parallel}`` must reach a counter
+  increment / recorder event on every control-flow path (or exit via
+  ``raise``). The "counted, never silent" fallback-ladder contract.
+- **FL003 task retention** — ``asyncio.create_task`` / ``ensure_future``
+  results must be stored, awaited, or handed to a lifecycle owner; a bare
+  fire-and-forget expression is the PR 8/10 ghost-session and leaked-pin
+  class.
+- **FL004 no-blocking-in-async** — ``time.sleep``, sync subprocess /
+  socket ops, ``Popen.wait`` inside ``async def``: the PR 10 frozen-pump
+  class (a blocking ``wait()`` froze every other edge's pumps).
+- **FL005 telemetry catalog sync** — every ``fusion_*`` metric minted in
+  ``stl_fusion_tpu/`` appears in OBSERVABILITY.md with a matching label
+  set (and MAX-aggregation marker where code declares it), and vice
+  versa. Doubles as the doc linter.
+
+Stdlib-``ast`` only — linting never imports the code under analysis (no
+jax, runs in seconds). Entry point: ``python -m tools.fusionlint``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+__all__ = ["Finding", "RULES", "JSON_SCHEMA_VERSION"]
+
+#: bump ONLY with a migration note in README.md — tests pin this schema
+JSON_SCHEMA_VERSION = 1
+
+#: rule id -> one-line summary (FL000 is the meta-rule: suppressions
+#: themselves must carry a reason, and cannot be suppressed)
+RULES = {
+    "FL000": "suppression comment without a reason",
+    "FL001": "loop-affine function called from a differently-affine module",
+    "FL002": "broad except handler with an uncounted control-flow path",
+    "FL003": "fire-and-forget task with no retained handle or lifecycle owner",
+    "FL004": "blocking call inside an async function",
+    "FL005": "fusion_* metric catalog drift between code and OBSERVABILITY.md",
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    context: str = "<module>"  # enclosing function qualname (baseline key)
+    end_line: Optional[int] = None  # statement span end (suppression scope)
+    suppressed: bool = False
+    suppress_reason: str = ""
+    baselined: bool = False
+
+    def key(self) -> str:
+        """Line-number-independent baseline bucket: findings drift with
+        edits above them, so the committed baseline matches on
+        (rule, file, enclosing context) with a count per bucket."""
+        return f"{self.rule}::{self.path}::{self.context}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "context": self.context,
+            "message": self.message,
+        }

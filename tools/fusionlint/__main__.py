@@ -1,0 +1,73 @@
+"""``python -m tools.fusionlint`` — the CI gate and the dev loop.
+
+Exit codes: 0 clean (unbaselined findings == 0), 1 findings, 2 internal
+error. ``--json`` prints the machine record (schema pinned by
+tests/test_fusionlint.py); default output is human-readable with one
+``path:line:col: RULE [context] message`` per finding.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .engine import baseline_from_findings, run_lint
+
+
+def main(argv=None) -> int:
+    here = os.path.dirname(os.path.abspath(__file__))
+    default_root = os.path.dirname(os.path.dirname(here))
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.fusionlint",
+        description="repo-native static analyzer (FL001-FL005); see tools/fusionlint/README.md",
+    )
+    parser.add_argument("--root", default=default_root, help="repo root to scan")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument(
+        "--baseline",
+        default=os.path.join(here, "baseline.json"),
+        help="baseline file of grandfathered findings",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, grandfathered or not",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline from the current findings (shrink-only workflow)",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        report = run_lint(
+            root=os.path.abspath(args.root),
+            baseline_path=args.baseline,
+            use_baseline=not (args.no_baseline or args.write_baseline),
+        )
+    except Exception as exc:  # pragma: no cover - internal error surface
+        print(f"fusionlint: internal error: {exc!r}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        data = baseline_from_findings(report.findings)
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=1, sort_keys=False)
+            fh.write("\n")
+        print(
+            f"fusionlint: wrote {len(data['entries'])} baseline bucket(s) "
+            f"({sum(e['count'] for e in data['entries'])} finding(s)) to {args.baseline}"
+        )
+        return 0
+
+    if args.json:
+        print(json.dumps(report.to_json(), indent=1))
+    else:
+        print(report.render_human())
+    return 1 if report.active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

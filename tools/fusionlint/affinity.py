@@ -1,0 +1,146 @@
+"""Loop-affinity registry for FL001 + the TOML-subset loader.
+
+The image runs Python 3.10 (no stdlib ``tomllib``) and the repo bakes in
+no third-party deps, so ``affinity.toml`` is parsed by a small reader for
+the exact subset the registry uses: ``[section]`` headers, ``key = value``
+with bare or quoted keys, string values, and (possibly multiline) arrays
+of strings. That subset is a strict TOML subset — the file stays valid
+for real TOML tooling if the toolchain ever grows one.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional
+
+__all__ = ["Affinity", "HomeLoopFn", "load_affinity", "parse_toml_subset"]
+
+_SECTION_RE = re.compile(r"^\[([A-Za-z0-9_.-]+)\]\s*$")
+_KEY_RE = re.compile(r'^(?:"([^"]+)"|([A-Za-z0-9_.-]+))\s*=\s*(.*)$')
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a trailing comment (this subset forbids ``#`` inside strings
+    except via the quoted-value path handled before this runs)."""
+    out = []
+    in_str = False
+    for ch in line:
+        if ch == '"':
+            in_str = not in_str
+        if ch == "#" and not in_str:
+            break
+        out.append(ch)
+    return "".join(out).rstrip()
+
+
+def parse_toml_subset(text: str) -> Dict[str, Dict[str, object]]:
+    """``{section: {key: str | [str, ...]}}`` for the affinity subset."""
+    data: Dict[str, Dict[str, object]] = {}
+    section: Optional[str] = None
+    pending_key: Optional[str] = None
+    pending_items: List[str] = []
+    for raw in text.splitlines():
+        line = _strip_comment(raw).strip()
+        if not line:
+            continue
+        if pending_key is not None:
+            # inside a multiline array: collect quoted items until ]
+            pending_items.extend(re.findall(r'"([^"]*)"', line))
+            if line.endswith("]"):
+                data[section][pending_key] = pending_items  # type: ignore[index]
+                pending_key, pending_items = None, []
+            continue
+        m = _SECTION_RE.match(line)
+        if m:
+            section = m.group(1)
+            data.setdefault(section, {})
+            continue
+        m = _KEY_RE.match(line)
+        if m is None or section is None:
+            raise ValueError(f"affinity.toml: unparseable line {raw!r}")
+        key = m.group(1) or m.group(2)
+        value = m.group(3).strip()
+        if value.startswith("["):
+            items = re.findall(r'"([^"]*)"', value)
+            if value.endswith("]"):
+                data[section][key] = items
+            else:
+                pending_key, pending_items = key, items
+        elif value.startswith('"') and value.endswith('"'):
+            data[section][key] = value[1:-1]
+        else:
+            raise ValueError(f"affinity.toml: unsupported value in {raw!r}")
+    return data
+
+
+@dataclasses.dataclass
+class HomeLoopFn:
+    """One loop-affine function: call it from outside its domain only by
+    handing the (un-called) callable to a marshal helper."""
+
+    bare_name: str
+    module: str  # repo-relative posix path of the defining module
+    domain: str  # defaults to the defining module path
+    qualname: str = ""
+    line: int = 0
+    source: str = "affinity.toml"  # or "inline" for # fusionlint: home-loop
+
+
+class Affinity:
+    def __init__(
+        self,
+        marshals: List[str],
+        functions: List[HomeLoopFn],
+        domains: Dict[str, str],
+    ):
+        #: helper names whose ARGUMENTS are exempt (the callable travels
+        #: un-called; the helper runs it on the right loop)
+        self.marshals = set(marshals) or {
+            "call_soon_threadsafe",
+            "run_coroutine_threadsafe",
+        }
+        self.domains = dict(domains)
+        self.by_name: Dict[str, List[HomeLoopFn]] = {}
+        for fn in functions:
+            self.add(fn)
+
+    def add(self, fn: HomeLoopFn) -> None:
+        if not fn.domain:
+            fn.domain = self.domain_of_module(fn.module)
+        self.by_name.setdefault(fn.bare_name, []).append(fn)
+
+    def domain_of_module(self, module_path: str) -> str:
+        """A module's affinity domain: the explicit ``[domains]`` entry
+        when present, else the module path itself (every module is its
+        own domain by default — cross-module direct calls to a home-loop
+        function are what FL001 exists to catch)."""
+        return self.domains.get(module_path, module_path)
+
+
+def load_affinity(path: str) -> Affinity:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = parse_toml_subset(f.read())
+    except FileNotFoundError:
+        data = {}
+    marshals = list((data.get("marshals") or {}).get("helpers") or [])
+    domains: Dict[str, str] = {
+        k: str(v) for k, v in (data.get("domains") or {}).items()
+    }
+    functions: List[HomeLoopFn] = []
+    for key, value in (data.get("home_loop") or {}).items():
+        # "path/to/module.py::Class.method" = "optional-domain"
+        module, sep, qual = key.partition("::")
+        if not sep:
+            raise ValueError(
+                f"affinity.toml [home_loop] key {key!r} must be 'module.py::QualName'"
+            )
+        functions.append(
+            HomeLoopFn(
+                bare_name=qual.rsplit(".", 1)[-1],
+                module=module,
+                domain=str(value),
+                qualname=qual,
+            )
+        )
+    return Affinity(marshals, functions, domains)

@@ -1,0 +1,325 @@
+"""The fusionlint engine: file walking, suppression comments, the
+committed baseline, and the JSON/human reports.
+
+Suppressions are per-line comments with a REQUIRED reason::
+
+    x = risky()  # fusionlint: disable=FL004 reason this is actually fine
+    # fusionlint: disable=FL002,FL003 one comment alone on a line covers
+    do_the_thing()                   # ...the next line
+
+A reasonless suppression is itself a finding (FL000) and cannot be
+suppressed. Suppression counts export in the JSON summary as
+``fusionlint_suppressions_total`` keyed by rule (and render as
+``fusionlint_suppressions_total{rule="FLxxx"}`` lines in human output) so
+a silently growing suppression count is visible in the bench record.
+
+The baseline (``baseline.json``) grandfathers pre-existing findings keyed
+by (rule, file, enclosing context) with a count per bucket — line numbers
+drift with unrelated edits, containing functions rarely do. CI forbids
+the unbaselined set growing past zero; stale baseline entries (fixed
+findings) are reported so the file can be re-shrunk with
+``--write-baseline`` (shrinking is the only legitimate direction).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from . import JSON_SCHEMA_VERSION, Finding
+from .affinity import Affinity, load_affinity
+from .rules import (
+    ModuleContext,
+    collect_home_loop_markers,
+    fl001_cross_loop,
+    fl002_counted_fallback,
+    fl003_task_retention,
+    fl004_blocking_in_async,
+)
+from .telemetry import fl005_catalog_sync
+
+__all__ = ["LintReport", "run_lint", "load_baseline", "baseline_from_findings"]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*fusionlint:\s*disable=([A-Z0-9,\s]+?)(?:\s+(\S.*))?$"
+)
+_DOC_NAME = "OBSERVABILITY.md"
+_SCAN_ROOTS = ("stl_fusion_tpu",)
+
+
+class LintReport:
+    def __init__(
+        self,
+        findings: List[Finding],
+        files_scanned: int,
+        baseline_size: int,
+        baseline_matched: int,
+        baseline_stale: int,
+    ):
+        self.findings = findings  # every finding, flags set
+        self.files_scanned = files_scanned
+        self.baseline_size = baseline_size
+        self.baseline_matched = baseline_matched
+        self.baseline_stale = baseline_stale
+
+    @property
+    def active(self) -> List[Finding]:
+        """Unsuppressed, unbaselined — the set that fails the build."""
+        return [
+            f for f in self.findings if not f.suppressed and not f.baselined
+        ]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def summary(self) -> dict:
+        by_rule: Dict[str, int] = {}
+        for f in self.active:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        sup_by_rule: Dict[str, int] = {}
+        for f in self.suppressed:
+            sup_by_rule[f.rule] = sup_by_rule.get(f.rule, 0) + 1
+        return {
+            "findings_total": len(self.active),
+            "findings_by_rule": dict(sorted(by_rule.items())),
+            "suppressions_total": len(self.suppressed),
+            "fusionlint_suppressions_total": dict(sorted(sup_by_rule.items())),
+            "baseline_size": self.baseline_size,
+            "baseline_matched": self.baseline_matched,
+            "baseline_stale": self.baseline_stale,
+            "files_scanned": self.files_scanned,
+        }
+
+    def to_json(self) -> dict:
+        return {
+            "version": JSON_SCHEMA_VERSION,
+            "findings": [f.to_json() for f in self.active],
+            "summary": self.summary(),
+        }
+
+    def render_human(self) -> str:
+        lines: List[str] = []
+        for f in sorted(self.active, key=lambda f: (f.path, f.line, f.rule)):
+            lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} [{f.context}] {f.message}")
+        s = self.summary()
+        lines.append("")
+        lines.append(
+            f"fusionlint: {s['findings_total']} finding(s) "
+            f"({', '.join(f'{r}={n}' for r, n in s['findings_by_rule'].items()) or 'none'}) "
+            f"over {s['files_scanned']} file(s); baseline {s['baseline_matched']}/"
+            f"{s['baseline_size']} matched"
+            + (f", {s['baseline_stale']} stale (re-shrink with --write-baseline)"
+               if s["baseline_stale"] else "")
+        )
+        for rule, n in s["fusionlint_suppressions_total"].items():
+            lines.append(f'fusionlint_suppressions_total{{rule="{rule}"}} {n}')
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- suppression
+
+def _apply_suppressions(ctx: ModuleContext, findings: List[Finding]) -> None:
+    """Mark findings whose statement span carries a disable comment for
+    their rule; emit FL000 for reasonless suppressions."""
+    # line (1-based) -> (rules, reason)
+    targets: Dict[int, Tuple[set, str]] = {}
+    for idx, line in enumerate(ctx.lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            findings.append(
+                Finding(
+                    rule="FL000",
+                    path=ctx.path,
+                    line=idx,
+                    col=line.find("#"),
+                    context="<suppression>",
+                    message=(
+                        "suppression without a reason — write "
+                        "'# fusionlint: disable=FLxxx <why this is safe>'; "
+                        "reasonless suppressions are how silent fallbacks "
+                        "come back"
+                    ),
+                )
+            )
+            continue
+        code_part = line[: line.find("#")].strip()
+        target = idx if code_part else idx + 1
+        if target in targets:
+            old_rules, old_reason = targets[target]
+            targets[target] = (old_rules | rules, old_reason)
+        else:
+            targets[target] = (rules, reason)
+    if not targets:
+        return
+    for f in findings:
+        if f.rule == "FL000" or f.path != ctx.path:
+            continue
+        span_end = f.end_line if f.end_line is not None else f.line
+        for line in range(f.line, span_end + 1):
+            hit = targets.get(line)
+            if hit and f.rule in hit[0]:
+                f.suppressed = True
+                f.suppress_reason = hit[1]
+                break
+
+
+# ------------------------------------------------------------------ baseline
+
+def load_baseline(path: str) -> Dict[str, int]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return {}
+    return {e["key"]: int(e["count"]) for e in data.get("entries", [])}
+
+
+def baseline_from_findings(findings: List[Finding]) -> dict:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        if f.suppressed or f.rule == "FL000":
+            continue
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    return {
+        "version": JSON_SCHEMA_VERSION,
+        "comment": (
+            "Grandfathered findings — CI forbids this set GROWING. Shrink it "
+            "(fix a finding, run --write-baseline) freely; never hand-add "
+            "entries: new code meets the rules or carries a reasoned "
+            "per-line suppression."
+        ),
+        "entries": [
+            {"key": k, "count": v} for k, v in sorted(counts.items())
+        ],
+    }
+
+
+def _apply_baseline(findings: List[Finding], baseline: Dict[str, int]) -> Tuple[int, int]:
+    """Mark up to baseline[key] findings per bucket as baselined (oldest
+    first by line — the NEWEST occurrences in a bucket surface when a
+    bucket grows). Returns (matched, stale)."""
+    remaining = dict(baseline)
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        if f.suppressed or f.rule == "FL000":
+            continue
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            f.baselined = True
+    matched = sum(baseline.values()) - sum(remaining.values())
+    stale = sum(remaining.values())
+    return matched, stale
+
+
+# ---------------------------------------------------------------------- run
+
+def _iter_py_files(root: str) -> List[str]:
+    out: List[str] = []
+    for scan_root in _SCAN_ROOTS:
+        base = os.path.join(root, scan_root)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.join(dirpath, name))
+    return sorted(out)
+
+
+def run_lint(
+    root: str,
+    baseline_path: Optional[str] = None,
+    affinity_path: Optional[str] = None,
+    use_baseline: bool = True,
+) -> LintReport:
+    here = os.path.dirname(os.path.abspath(__file__))
+    if affinity_path is None:
+        affinity_path = os.path.join(here, "affinity.toml")
+    if baseline_path is None:
+        baseline_path = os.path.join(here, "baseline.json")
+    registry: Affinity = load_affinity(affinity_path)
+
+    findings: List[Finding] = []
+    modules: List[ModuleContext] = []
+    for abs_path in _iter_py_files(root):
+        rel = os.path.relpath(abs_path, root).replace(os.sep, "/")
+        try:
+            with open(abs_path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=rel)
+        except (OSError, SyntaxError) as exc:
+            findings.append(
+                Finding(
+                    rule="FL000",
+                    path=rel,
+                    line=getattr(exc, "lineno", 1) or 1,
+                    col=0,
+                    context="<parse>",
+                    message=f"file does not parse: {exc}",
+                )
+            )
+            continue
+        modules.append(ModuleContext(rel, source, tree))
+
+    # pass 1: cross-file state — inline home-loop markers join the registry
+    for ctx in modules:
+        for fn in collect_home_loop_markers(ctx):
+            registry.add(fn)
+
+    # pass 2: per-module rules
+    per_module: Dict[str, List[Finding]] = {}
+    for ctx in modules:
+        mod_findings: List[Finding] = []
+        fl001_cross_loop(ctx, registry, mod_findings)
+        fl002_counted_fallback(ctx, mod_findings)
+        fl003_task_retention(ctx, mod_findings)
+        fl004_blocking_in_async(ctx, mod_findings)
+        per_module[ctx.path] = mod_findings
+
+    # pass 3: the telemetry catalog (whole-repo state)
+    doc_abs = os.path.join(root, _DOC_NAME)
+    try:
+        with open(doc_abs, "r", encoding="utf-8") as fh:
+            doc_text = fh.read()
+    except OSError:
+        doc_text = ""
+        findings.append(
+            Finding(
+                rule="FL005",
+                path=_DOC_NAME,
+                line=1,
+                col=0,
+                context="<telemetry>",
+                message=f"{_DOC_NAME} is missing — the metric catalog is the operator contract",
+            )
+        )
+    fl005 = []
+    if doc_text:
+        fl005_catalog_sync(modules, _DOC_NAME, doc_text, fl005)
+    for f in fl005:
+        per_module.setdefault(f.path, []).append(f)
+
+    for ctx in modules:
+        mod_findings = per_module.get(ctx.path, [])
+        _apply_suppressions(ctx, mod_findings)
+        findings.extend(mod_findings)
+    # findings in non-scanned files (OBSERVABILITY.md) skip suppression
+    for path, fs in per_module.items():
+        if path == _DOC_NAME:
+            findings.extend(fs)
+
+    baseline = load_baseline(baseline_path) if use_baseline else {}
+    matched, stale = _apply_baseline(findings, baseline) if baseline else (0, 0)
+    return LintReport(
+        findings=findings,
+        files_scanned=len(modules),
+        baseline_size=sum(baseline.values()),
+        baseline_matched=matched,
+        baseline_stale=stale,
+    )

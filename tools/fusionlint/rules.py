@@ -1,0 +1,510 @@
+"""FL001–FL004: the AST rules.
+
+Each rule is a function over a :class:`ModuleContext` appending
+:class:`~tools.fusionlint.Finding` objects. The engine parses every file
+once, collects the cross-file state FL001 needs (inline home-loop
+markers), then runs the per-module checks.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from . import Finding
+from .affinity import Affinity, HomeLoopFn
+
+__all__ = [
+    "ModuleContext",
+    "collect_home_loop_markers",
+    "fl001_cross_loop",
+    "fl002_counted_fallback",
+    "fl003_task_retention",
+    "fl004_blocking_in_async",
+    "FL002_SCOPE",
+]
+
+#: FL002 applies where the fallback-ladder contract is load-bearing (the
+#: packages whose degraded paths the CHANGES.md review logs kept re-finding)
+FL002_SCOPE = (
+    "stl_fusion_tpu/edge/",
+    "stl_fusion_tpu/rpc/",
+    "stl_fusion_tpu/graph/",
+    "stl_fusion_tpu/parallel/",
+)
+
+_HOME_LOOP_RE = re.compile(r"#\s*fusionlint:\s*home-loop(?:=([\w./-]+))?")
+
+
+class ModuleContext:
+    """One parsed file plus the derived maps every rule shares."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+    # ------------------------------------------------------------- geometry
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(
+        self, node: ast.AST
+    ) -> Optional[ast.AST]:
+        """Nearest enclosing def/async def/lambda (lambdas are sync
+        execution boundaries for FL004)."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return anc
+        return None
+
+    def qualname(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                parts.append(anc.name)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            parts.insert(0, node.name)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        ctx_node = node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = None
+            for anc in self.ancestors(node):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = anc
+                    break
+            ctx_node = fn if fn is not None else node
+        context = self.qualname(ctx_node) if ctx_node is not node else self.qualname(node)
+        return Finding(
+            rule=rule,
+            path=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            end_line=getattr(node, "end_lineno", None),
+            message=message,
+            context=context,
+        )
+
+
+def _terminal_name(func: ast.AST) -> Optional[str]:
+    """``a.b.c(...)`` -> ``c``; ``f(...)`` -> ``f``."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` -> ``"a.b.c"`` for Name/Attribute chains."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------------- FL001
+
+def collect_home_loop_markers(ctx: ModuleContext) -> List[HomeLoopFn]:
+    """Inline ``# fusionlint: home-loop[=domain]`` markers: trailing on the
+    ``def`` line, or alone on the line directly above the def (above any
+    decorators)."""
+    out: List[HomeLoopFn] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        candidates = [node.lineno - 1]  # the def line (0-based)
+        first_line = min(
+            [node.lineno] + [d.lineno for d in node.decorator_list]
+        )
+        if first_line >= 2:
+            candidates.append(first_line - 2)  # line above def/decorators
+        for idx in candidates:
+            if 0 <= idx < len(ctx.lines):
+                m = _HOME_LOOP_RE.search(ctx.lines[idx])
+                if m:
+                    out.append(
+                        HomeLoopFn(
+                            bare_name=node.name,
+                            module=ctx.path,
+                            domain=m.group(1) or "",
+                            qualname=ctx.qualname(node),
+                            line=node.lineno,
+                            source="inline",
+                        )
+                    )
+                    break
+    return out
+
+
+def fl001_cross_loop(
+    ctx: ModuleContext, registry: Affinity, findings: List[Finding]
+) -> None:
+    caller_domain = registry.domain_of_module(ctx.path)
+    if not registry.by_name:
+        return
+    # functions in THIS module that are themselves home-loop (a marked
+    # function may call its same-domain siblings directly)
+    local_marked: Dict[ast.AST, str] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qn = ctx.qualname(node)
+            for entries in registry.by_name.values():
+                for e in entries:
+                    if e.module == ctx.path and e.qualname == qn:
+                        local_marked[node] = e.domain
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        if name is None or name not in registry.by_name:
+            continue
+        entries = registry.by_name[name]
+        target_domains = {e.domain for e in entries}
+        if caller_domain in target_domains:
+            continue  # same-domain module owns the loop discipline
+        # inside a function itself marked with a matching domain?
+        enclosing_ok = False
+        for anc in ctx.ancestors(node):
+            if anc in local_marked and local_marked[anc] in target_domains:
+                enclosing_ok = True
+                break
+        if enclosing_ok:
+            continue
+        # under a marshal helper (lambda handed to call_soon_threadsafe):
+        # the helper re-enters on the right loop, so the nested call is fine
+        marshaled = False
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.Call):
+                anc_name = _terminal_name(anc.func)
+                if anc_name in registry.marshals:
+                    marshaled = True
+                    break
+        if marshaled:
+            continue
+        owners = ", ".join(
+            sorted({f"{e.module}::{e.qualname or e.bare_name}" for e in entries})
+        )
+        findings.append(
+            ctx.finding(
+                "FL001",
+                node,
+                f"direct call to loop-affine {name}() ({owners}) from a "
+                f"differently-affine module — hand the callable to "
+                f"call_soon_threadsafe/a marshal helper, or declare a shared "
+                f"domain in tools/fusionlint/affinity.toml",
+            )
+        )
+
+
+# ---------------------------------------------------------------------- FL002
+
+_BROAD_NAMES = {"Exception", "BaseException"}
+
+#: statuses for the all-paths-count walk
+_COUNTS, _CLEAN_EXIT, _FALLTHROUGH, _UNCOUNTED_EXIT = range(4)
+
+_COUNT_ATTR_PREFIXES = ("record", "note")
+_COUNT_NAMES = {"inc", "add_shed", "count_event"}
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD_NAMES
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD_NAMES for e in t.elts
+        )
+    return False
+
+
+class _CountJudge:
+    """Decides whether a statement list reaches a counting event on every
+    control-flow path. Counting = ``.inc()`` / ``record*`` / ``note*``
+    calls, a ``+=`` on an attribute (the hot-path plain-counter idiom this
+    codebase uses deliberately — see diagnostics/metrics.py), or a call
+    into a same-module function whose own body always counts (the shed/
+    fallback helper pattern). ``raise`` exits are vacuously fine."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        # bare name -> defs in this module (methods matched generously by
+        # bare name: a miss here only costs a false positive the author
+        # can suppress with a reason)
+        self.local_defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.local_defs.setdefault(node.name, []).append(node)
+        self._memo: Dict[ast.AST, bool] = {}
+        self._in_flight: Set[ast.AST] = set()
+
+    # ---------------------------------------------------------- primitives
+    def _call_counts(self, call: ast.Call, depth: int) -> bool:
+        name = _terminal_name(call.func)
+        if name is None:
+            return False
+        if name in _COUNT_NAMES or name.startswith(_COUNT_ATTR_PREFIXES):
+            return True
+        if depth <= 0:
+            return False
+        for fn in self.local_defs.get(name, ()):  # one hop into helpers
+            if self._def_counts(fn, depth - 1):
+                return True
+        return False
+
+    def _def_counts(self, fn: ast.AST, depth: int) -> bool:
+        if fn in self._memo:
+            return self._memo[fn]
+        if fn in self._in_flight:
+            return False  # recursion: be conservative
+        self._in_flight.add(fn)
+        try:
+            status = self.walk(fn.body, depth)
+            result = status in (_COUNTS, _CLEAN_EXIT)
+            self._memo[fn] = result
+            return result
+        finally:
+            self._in_flight.discard(fn)
+
+    def _stmt_counts(self, stmt: ast.stmt, depth: int) -> bool:
+        if isinstance(stmt, ast.AugAssign) and isinstance(stmt.op, ast.Add):
+            if isinstance(stmt.target, ast.Attribute):
+                return True  # self.fallbacks += 1 — the hot-path counter
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and self._call_counts(node, depth):
+                return True
+        return False
+
+    # --------------------------------------------------------------- walk
+    def walk(self, stmts: List[ast.stmt], depth: int = 2) -> int:
+        for stmt in stmts:
+            if isinstance(stmt, ast.Raise):
+                return _CLEAN_EXIT
+            if isinstance(stmt, (ast.Return, ast.Continue, ast.Break)):
+                if self._stmt_counts(stmt, depth):
+                    return _COUNTS  # return self.counted_fallback()
+                return _UNCOUNTED_EXIT
+            if isinstance(stmt, ast.If):
+                body = self.walk(stmt.body, depth)
+                orelse = self.walk(stmt.orelse, depth) if stmt.orelse else _FALLTHROUGH
+                if _UNCOUNTED_EXIT in (body, orelse):
+                    return _UNCOUNTED_EXIT
+                if body in (_COUNTS, _CLEAN_EXIT) and orelse in (_COUNTS, _CLEAN_EXIT):
+                    return _COUNTS
+                continue  # some path falls through; keep scanning
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                # the body may run zero times — only an uncounted EXIT
+                # inside is decisive
+                if self.walk(stmt.body, depth) == _UNCOUNTED_EXIT:
+                    return _UNCOUNTED_EXIT
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                status = self.walk(stmt.body, depth)
+                if status != _FALLTHROUGH:
+                    return status
+                continue
+            if isinstance(stmt, ast.Try):
+                body = self.walk(stmt.body + stmt.orelse, depth)
+                handlers = [self.walk(h.body, depth) for h in stmt.handlers]
+                final = self.walk(stmt.finalbody, depth) if stmt.finalbody else _FALLTHROUGH
+                if final in (_COUNTS, _CLEAN_EXIT):
+                    return final
+                if _UNCOUNTED_EXIT in [body] + handlers:
+                    return _UNCOUNTED_EXIT
+                if body in (_COUNTS, _CLEAN_EXIT) and all(
+                    h in (_COUNTS, _CLEAN_EXIT) for h in handlers
+                ):
+                    return _COUNTS
+                continue
+            if self._stmt_counts(stmt, depth):
+                return _COUNTS
+        return _FALLTHROUGH
+
+
+def fl002_counted_fallback(ctx: ModuleContext, findings: List[Finding]) -> None:
+    if not ctx.path.startswith(FL002_SCOPE):
+        return
+    judge = _CountJudge(ctx)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            if not _is_broad_handler(handler):
+                continue
+            status = judge.walk(handler.body)
+            if status in (_COUNTS, _CLEAN_EXIT):
+                continue
+            what = (
+                "falls through without"
+                if status == _FALLTHROUGH
+                else "can exit (return/continue/break) before"
+            )
+            findings.append(
+                ctx.finding(
+                    "FL002",
+                    handler,
+                    f"broad except handler {what} reaching a counter/recorder "
+                    f"event — the fallback ladder is counted, never silent "
+                    f"(increment a Counter, bump a stats attribute, or record "
+                    f"a recorder event on every path)",
+                )
+            )
+
+
+# ---------------------------------------------------------------------- FL003
+
+_SPAWN_NAMES = {"create_task", "ensure_future"}
+
+
+def fl003_task_retention(ctx: ModuleContext, findings: List[Finding]) -> None:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _terminal_name(node.func) not in _SPAWN_NAMES:
+            continue
+        # climb: the task is retained if its value reaches an assignment,
+        # await, return, argument position, or container literal. It is
+        # DISCARDED when the chain tops out at a bare expression statement
+        # (including `create_task(c).add_done_callback(cb)` — a done
+        # callback holds no strong reference; the loop may drop the task
+        # mid-flight and teardown can never cancel it).
+        cur: ast.AST = node
+        parent = ctx.parent(cur)
+        discarded = False
+        while parent is not None:
+            if isinstance(parent, ast.Expr):
+                discarded = True
+                break
+            if isinstance(parent, ast.Attribute) and parent.value is cur:
+                cur = parent
+                parent = ctx.parent(cur)
+                continue
+            if isinstance(parent, ast.Call) and parent.func is cur:
+                cur = parent
+                parent = ctx.parent(cur)
+                continue
+            break  # assignment / await / arg / return / container: retained
+        if discarded:
+            findings.append(
+                ctx.finding(
+                    "FL003",
+                    node,
+                    "fire-and-forget task: store the handle, await it, or "
+                    "register it with a lifecycle owner (utils.async_utils."
+                    "TaskSet) so teardown can cancel it — an unretained task "
+                    "can be garbage-collected mid-flight and leaks its pins "
+                    "on shutdown",
+                )
+            )
+
+
+# ---------------------------------------------------------------------- FL004
+
+_BLOCKING_DOTTED = {
+    "time.sleep",
+    "os.system",
+    "os.popen",
+    "os.wait",
+    "os.waitpid",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.getoutput",
+    "subprocess.getstatusoutput",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "urllib.request.urlopen",
+}
+
+_PROCLIKE_RE = re.compile(r"(?:^|_)(?:proc|process|popen|child)(?:$|_|\d)", re.I)
+
+
+def _import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Bound name -> dotted origin (``from time import sleep as s`` maps
+    ``s`` -> ``time.sleep``; ``import subprocess as sp`` maps ``sp`` ->
+    ``subprocess``)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def fl004_blocking_in_async(ctx: ModuleContext, findings: List[Finding]) -> None:
+    aliases = _import_aliases(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        enclosing = ctx.enclosing_function(node)
+        if not isinstance(enclosing, ast.AsyncFunctionDef):
+            continue  # sync code (incl. lambdas / nested sync defs) is exempt
+        dotted = _dotted_name(node.func)
+        resolved = None
+        if dotted is not None:
+            head, _, rest = dotted.partition(".")
+            origin = aliases.get(head)
+            if origin is not None:
+                resolved = origin + ("." + rest if rest else "")
+            else:
+                resolved = dotted
+        if resolved in _BLOCKING_DOTTED:
+            findings.append(
+                ctx.finding(
+                    "FL004",
+                    node,
+                    f"blocking call {resolved}() inside an async function "
+                    f"freezes every task on this loop — await the async "
+                    f"equivalent or run it in an executor",
+                )
+            )
+            continue
+        # Popen.wait heuristic: a non-awaited `.wait()` on a process-like
+        # receiver (asyncio primitives' .wait() is awaited, so exempt)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "wait"
+            and not isinstance(ctx.parent(node), ast.Await)
+        ):
+            recv = node.func.value
+            recv_name = recv.attr if isinstance(recv, ast.Attribute) else (
+                recv.id if isinstance(recv, ast.Name) else None
+            )
+            if recv_name is not None and _PROCLIKE_RE.search(recv_name):
+                findings.append(
+                    ctx.finding(
+                        "FL004",
+                        node,
+                        f"blocking {recv_name}.wait() inside an async function "
+                        f"— the PR 10 frozen-pump class; reap the process off-"
+                        f"loop (executor) or poll with returncode + sleep",
+                    )
+                )

@@ -1,0 +1,238 @@
+"""FL005 — telemetry catalog sync between code-minted ``fusion_*`` metrics
+and the OBSERVABILITY.md catalog.
+
+Code side: a metric is MINTED where its name appears as
+
+- the name argument of a ``counter()`` / ``gauge()`` / ``histogram()`` call,
+- a string key of a dict literal (the collector idiom: hot paths keep plain
+  attribute counters and a pull-time collector returns ``{name: value}``),
+- a string subscript key (``out["fusion_x"] = v`` / ``out[f'...'] = v``),
+- the name argument of ``set_aggregation()`` (also records the declared
+  aggregation mode).
+
+f-string names keep their constant skeleton with ``<*>`` standing in for
+each formatted value (``f"fusion_resilience_{k}_total"`` ->
+``fusion_resilience_<*>_total``); the doc's ``<kind>``-style placeholders
+normalize the same way. A ``{label="value"}`` suffix contributes the label
+KEY set, not the values. ``ContextVar("fusion_current_*")`` names are
+excluded — context variables, not metrics. ``find()`` is a read, never a
+mint.
+
+Doc side: every markdown table row (a line starting with ``|``) in
+OBSERVABILITY.md; each backticked token containing ``fusion_`` is one
+catalog entry. A row documents MAX aggregation by containing the literal
+uppercase ``MAX`` — code-declared ``set_aggregation(name, "max")`` metrics
+must say so in their row (two half-loaded components must scrape as half
+loaded, not summed to overload — the PR 12 gauge-aggregation class).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from . import Finding
+
+__all__ = ["fl005_catalog_sync", "extract_code_metrics", "parse_doc_catalog"]
+
+_PLACEHOLDER = "<*>"
+_DOC_PLACEHOLDER_RE = re.compile(r"<[^>*]+>")
+_LABEL_KEY_RE = re.compile(r"([A-Za-z_]\w*)\s*=")
+_TICK_RE = re.compile(r"`([^`]*fusion_[^`]*)`")
+_NAME_OK_RE = re.compile(r"^fusion_[A-Za-z0-9_]*(?:<\*>[A-Za-z0-9_]*)*$")
+
+
+class MetricInfo:
+    __slots__ = ("labels", "sites", "max_agg")
+
+    def __init__(self):
+        self.labels: Set[str] = set()
+        self.sites: List[Tuple[str, int]] = []  # (path, line)
+        self.max_agg = False
+
+
+def _split_token(token: str) -> Tuple[str, Set[str]]:
+    """``fusion_x{peer="m0"}`` -> (``fusion_x``, {``peer``})."""
+    base, _, labelpart = token.partition("{")
+    return base.strip(), set(_LABEL_KEY_RE.findall(labelpart))
+
+
+def _name_from_node(node: ast.AST) -> str:
+    """The metric-name skeleton of a string-ish AST node, or ''. """
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append(_PLACEHOLDER)
+        return "".join(parts)
+    return ""
+
+
+def _record(metrics: Dict[str, MetricInfo], raw: str, path: str, line: int) -> None:
+    if not raw.startswith("fusion_"):
+        return
+    base, labels = _split_token(raw)
+    if not _NAME_OK_RE.match(base):
+        return  # not a metric-name shape (prose, format artifacts)
+    info = metrics.setdefault(base, MetricInfo())
+    info.labels |= labels
+    info.sites.append((path, line))
+
+
+def extract_code_metrics(modules) -> Dict[str, MetricInfo]:
+    """``modules``: iterable of objects with ``.path`` and ``.tree``."""
+    metrics: Dict[str, MetricInfo] = {}
+    agg_max: Dict[str, Tuple[str, int]] = {}
+    for mod in modules:
+        if not mod.path.startswith("stl_fusion_tpu/"):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                func = node.func
+                name = func.attr if isinstance(func, ast.Attribute) else (
+                    func.id if isinstance(func, ast.Name) else None
+                )
+                if name == "ContextVar":
+                    continue  # names its contextvar, not a metric
+                if name in ("counter", "gauge", "histogram"):
+                    arg = node.args[0] if node.args else None
+                    for kw in node.keywords:
+                        if kw.arg == "name":
+                            arg = kw.value
+                    if arg is not None:
+                        _record(metrics, _name_from_node(arg), mod.path, node.lineno)
+                elif name == "set_aggregation" and len(node.args) >= 2:
+                    metric = _name_from_node(node.args[0])
+                    mode = (
+                        node.args[1].value
+                        if isinstance(node.args[1], ast.Constant)
+                        else None
+                    )
+                    _record(metrics, metric, mod.path, node.lineno)
+                    if mode == "max" and metric.startswith("fusion_"):
+                        agg_max[_split_token(metric)[0]] = (mod.path, node.lineno)
+            elif isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if key is not None:
+                        _record(metrics, _name_from_node(key), mod.path, key.lineno)
+            elif isinstance(node, ast.DictComp):
+                # {f"fusion_resilience_{k}_total": v for k, v in ...} —
+                # the collector-comprehension idiom (resilience/events.py)
+                _record(metrics, _name_from_node(node.key), mod.path, node.key.lineno)
+            elif isinstance(node, ast.Subscript):
+                _record(
+                    metrics,
+                    _name_from_node(node.slice),
+                    mod.path,
+                    node.lineno,
+                )
+    for base, site in agg_max.items():
+        info = metrics.setdefault(base, MetricInfo())
+        info.max_agg = True
+        if not info.sites:
+            info.sites.append(site)
+    return metrics
+
+
+class DocEntry:
+    __slots__ = ("labels", "lines", "has_max")
+
+    def __init__(self):
+        self.labels: Set[str] = set()
+        self.lines: List[int] = []
+        self.has_max = False
+
+
+def parse_doc_catalog(doc_text: str) -> Dict[str, DocEntry]:
+    entries: Dict[str, DocEntry] = {}
+    for lineno, line in enumerate(doc_text.splitlines(), start=1):
+        stripped = line.strip()
+        if not stripped.startswith("|") or "fusion_" not in stripped:
+            continue
+        for token in _TICK_RE.findall(stripped):
+            token = _DOC_PLACEHOLDER_RE.sub(_PLACEHOLDER, token)
+            base, labels = _split_token(token)
+            if not base.startswith("fusion_") or not _NAME_OK_RE.match(base):
+                continue
+            entry = entries.setdefault(base, DocEntry())
+            entry.labels |= labels
+            entry.lines.append(lineno)
+            if "MAX" in stripped:
+                entry.has_max = True
+    return entries
+
+
+def fl005_catalog_sync(
+    modules, doc_path: str, doc_text: str, findings: List[Finding]
+) -> None:
+    code = extract_code_metrics(modules)
+    doc = parse_doc_catalog(doc_text)
+    for base in sorted(set(code) - set(doc)):
+        path, line = code[base].sites[0]
+        findings.append(
+            Finding(
+                rule="FL005",
+                path=path,
+                line=line,
+                col=0,
+                context="<telemetry>",
+                message=(
+                    f"metric {base} is minted here but has no catalog row in "
+                    f"{doc_path} — every fusion_* metric gets a documented "
+                    f"meaning (the catalog is the operator contract)"
+                ),
+            )
+        )
+    for base in sorted(set(doc) - set(code)):
+        findings.append(
+            Finding(
+                rule="FL005",
+                path=doc_path,
+                line=doc[base].lines[0],
+                col=0,
+                context="<telemetry>",
+                message=(
+                    f"catalog row documents {base} but nothing in "
+                    f"stl_fusion_tpu/ mints it — stale row (rename drift?) "
+                    f"or the metric was removed without its row"
+                ),
+            )
+        )
+    for base in sorted(set(code) & set(doc)):
+        c, d = code[base], doc[base]
+        if c.labels != d.labels:
+            findings.append(
+                Finding(
+                    rule="FL005",
+                    path=doc_path,
+                    line=d.lines[0],
+                    col=0,
+                    context="<telemetry>",
+                    message=(
+                        f"label drift on {base}: code exports "
+                        f"{{{', '.join(sorted(c.labels)) or 'no labels'}}} but the "
+                        f"catalog row documents "
+                        f"{{{', '.join(sorted(d.labels)) or 'no labels'}}}"
+                    ),
+                )
+            )
+        if c.max_agg and not d.has_max:
+            findings.append(
+                Finding(
+                    rule="FL005",
+                    path=doc_path,
+                    line=d.lines[0],
+                    col=0,
+                    context="<telemetry>",
+                    message=(
+                        f"{base} declares MAX aggregation in code "
+                        f"(set_aggregation) but its catalog row does not say "
+                        f"MAX — operators must know two half-loaded components "
+                        f"scrape as half loaded, not summed to overload"
+                    ),
+                )
+            )
