@@ -844,6 +844,14 @@ def _compact_result(
             "fused_chain_dispatches": live.get("live_fused_chain_dispatches"),
             "eager_fallback_rounds": live.get("live_eager_fallback_rounds"),
             "overlap_occupancy": live.get("live_overlap_occupancy"),
+            # device-resident super-rounds (ISSUE 14): depth of the
+            # resident program, device occupancy of the flight window, and
+            # host stalls per super-round — the live-vs-static gap story
+            "superround_depth": live.get("live_superround_depth"),
+            "device_occupancy": live.get("live_superround_occupancy"),
+            "host_stalls_per_round": live.get("live_superround_host_stall_ms"),
+            "superround_eager_rounds": live.get("live_superround_eager_rounds"),
+            "superround_faults": live.get("live_superround_faults"),
             "churn_rows_per_s": _r(live.get("churn_recompute_rows_per_s"), 0),
             "churn_edges": live.get("churn_edges_declared"),
             "mirror_patches": live.get("mirror_patches"),

@@ -41,13 +41,29 @@ system and drives it UNDER CHURN (VERDICT r3 #1/#2/#3):
   wall time covered by that host work; ``LIVE_NONBLOCKING=0`` restores
   the per-round blocking loop (the A/B baseline).
 
+- **Device-resident super-rounds** (ISSUE 14, default): the whole live
+  round — seed accumulate → fused wave chain → columnar refresh through
+  the memo-table loader → packed fence extraction — runs as ONE resident
+  device program (``backend.enable_super_rounds``); the host's
+  per-super-round work is staging the next seed buffer (back buffer,
+  packed while the previous super-round executes) and draining the
+  previous fence buffer. ``loop_phases`` splits the old ``burst_s`` into
+  ``stage_s`` (host seed/dispatch staging) vs ``device_s``
+  (harvest-measured device stall), and the result carries the program's
+  occupancy/host-stall/fallback accounting. ``LIVE_SUPER_ROUNDS=0``
+  restores the PR 7 chain loop (the A/B middle column);
+  ``LIVE_NONBLOCKING=0`` restores the per-round blocking baseline.
+
 Env: LIVE_NODES (default 1_000_000), LIVE_DEG (3), LIVE_ROUNDS (6),
 LIVE_LANE_GROUPS (512), LIVE_LANE_SEEDS (8),
 LIVE_SCALAR_NODES (20000; 0 skips), LIVE_LAT_WAVES (32; 0 skips),
 LIVE_EDGE_CHURN (2000/round — level-aware realistic churn, see
 make_churn_edges), LIVE_SCALAR_CHURN (4/round),
 LIVE_NONBLOCKING (1; 0 = legacy blocking loop),
-LIVE_FUSE_DEPTH (3; logical rounds fused per dispatch chain),
+LIVE_SUPER_ROUNDS (1; 0 = PR 7 chain loop — the A/B knob),
+LIVE_SMOKE (0; 1 = CI gates: exit nonzero on eager fallback, faults, or
+host re-entries on the clean path — the tier1 live smoke),
+LIVE_FUSE_DEPTH (3; logical rounds fused per dispatch chain/super-round),
 LIVE_TELEMETRY (1; 0 disables the wave profiler — the A/B knob for the
 <3% observability-overhead budget; the result's ``telemetry`` section
 records which mode ran so BENCH_*.json tracks it),
@@ -171,6 +187,20 @@ def bootstrap_ci(samples: np.ndarray, q: float, n_boot: int = 1000, seed: int = 
 
 async def main() -> None:
     _setup_jax_cache()
+    from stl_fusion_tpu.graph.program_cache import (
+        program_warm_report,
+        time_program_warm,
+    )
+
+    repo_jax_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache",
+    )
+
+    def warm_timer(name: str, key=None):
+        # per-program warm attribution for the cold_start block (ISSUE 14
+        # satellite): warm seconds + whether the persistent cache served it
+        return time_program_warm(name, key=key, jax_dir=repo_jax_dir)
     n = int(os.environ.get("LIVE_NODES", 1_000_000))
     deg = float(os.environ.get("LIVE_DEG", 3))
     rounds = int(os.environ.get("LIVE_ROUNDS", 6))
@@ -181,6 +211,8 @@ async def main() -> None:
     edge_churn = int(os.environ.get("LIVE_EDGE_CHURN", 2000))
     scalar_churn = int(os.environ.get("LIVE_SCALAR_CHURN", 4))
     nonblocking = os.environ.get("LIVE_NONBLOCKING", "1") != "0"
+    super_rounds = nonblocking and os.environ.get("LIVE_SUPER_ROUNDS", "1") != "0"
+    smoke = os.environ.get("LIVE_SMOKE", "0") == "1"
     fuse_depth = max(1, min(int(os.environ.get("LIVE_FUSE_DEPTH", 3)), rounds))
     telemetry_on = os.environ.get("LIVE_TELEMETRY", "1") != "0"
     recorder_on = os.environ.get("LIVE_RECORDER", "1") != "0"
@@ -279,12 +311,13 @@ async def main() -> None:
             f"(disk cache {'HIT' if mirror_cache_hit else 'miss'}); warming programs..."
         )
         t0 = time.perf_counter()
-        backend.cascade_rows_batch(block, [n - 1])  # lat-mirror union compile
-        gdev = backend.graph
-        if gdev._mirror_valid():
-            # the topo fused union is the lat path's overflow fallback —
-            # warm it too or a deep lone wave pays its compile mid-sample
-            gdev._run_mirror_union([[n - 1]])
+        with warm_timer("union", key=(n, "lat+topo")):
+            backend.cascade_rows_batch(block, [n - 1])  # lat-mirror union compile
+            gdev = backend.graph
+            if gdev._mirror_valid():
+                # the topo fused union is the lat path's overflow fallback —
+                # warm it too or a deep lone wave pays its compile mid-sample
+                gdev._run_mirror_union([[n - 1]])
         union_warm_s = time.perf_counter() - t0
         stale = np.nonzero(table._stale_host)[0]
         if stale.size:
@@ -391,25 +424,26 @@ async def main() -> None:
             for _ in range(n_groups)
         ]
         t0 = time.perf_counter()
-        backend.cascade_rows_lanes(block, group_ids)  # fused lane program
-        if table.stale_count():
-            backend.refresh_block_on_device(block)
-        backend.flush()
-        # ALSO warm every multi-pass variant a churned run can route to:
-        # fused-2 and fused-3 (one program per pass count ≤ FUSED_PASS_MAX)
-        # and the split gate/sweep/finish pipeline (passes > 3, the
-        # violation-pileup bridge while a re-level runs) — any of these
-        # compiling inside a timed burst would depress that round's rate
-        gdev = backend.graph
-        m = gdev._topo_mirror
-        for warm_passes in (2, 3, 4):
-            m["passes"] = warm_passes
-            backend.cascade_rows_lanes(block, group_ids)
-            backend.cascade_rows_batch(block, [n - 1])
-        m["passes"] = 1
-        if table.stale_count():
-            backend.refresh_block_on_device(block)
-        backend.flush()
+        with warm_timer("lanes", key=(n, n_groups, "passes<=4")):
+            backend.cascade_rows_lanes(block, group_ids)  # fused lane program
+            if table.stale_count():
+                backend.refresh_block_on_device(block)
+            backend.flush()
+            # ALSO warm every multi-pass variant a churned run can route to:
+            # fused-2 and fused-3 (one program per pass count ≤ FUSED_PASS_MAX)
+            # and the split gate/sweep/finish pipeline (passes > 3, the
+            # violation-pileup bridge while a re-level runs) — any of these
+            # compiling inside a timed burst would depress that round's rate
+            gdev = backend.graph
+            m = gdev._topo_mirror
+            for warm_passes in (2, 3, 4):
+                m["passes"] = warm_passes
+                backend.cascade_rows_lanes(block, group_ids)
+                backend.cascade_rows_batch(block, [n - 1])
+            m["passes"] = 1
+            if table.stale_count():
+                backend.refresh_block_on_device(block)
+            backend.flush()
         lane_warm_s = time.perf_counter() - t0
         note(f"lane programs warm, fused + split ({lane_warm_s:.1f}s)")
 
@@ -470,8 +504,9 @@ async def main() -> None:
         import jax as _jax
 
         t0 = time.perf_counter()
-        backend.refresh_block_on_device(block)
-        _jax.device_get(table._values[:1])
+        with warm_timer("refresh", key=(n,)):
+            backend.refresh_block_on_device(block)
+            _jax.device_get(table._values[:1])
         refresh_warm_s = time.perf_counter() - t0
         note(f"device-refresh program warm ({refresh_warm_s:.1f}s)")
 
@@ -491,7 +526,14 @@ async def main() -> None:
         chain_wall_s = 0.0  # dispatch -> harvest-complete wall time
         phases = {
             "declare_s": 0.0, "scalar_s": 0.0, "refresh_s": 0.0,
-            "burst_s": 0.0, "maintain_s": 0.0,
+            # burst_s stays the chain/super-round total for continuity;
+            # stage_s/device_s are its split (ISSUE 14 satellite: the old
+            # accounting bucketed dispatch-side host staging into burst_s,
+            # so the A/B could not prove where the time went): stage_s =
+            # host seed packing + dispatch enqueue + fence-drain host
+            # work, device_s = the harvest-measured device stall
+            "burst_s": 0.0, "stage_s": 0.0, "device_s": 0.0,
+            "maintain_s": 0.0,
         }
         # scalar-churn rows: the bump+recapture cycle re-declares the row's
         # in-edges; rows with declared in-degree beyond the mirror row
@@ -547,25 +589,38 @@ async def main() -> None:
         # super-round-sized journal replay scatters, and the patch
         # scatters — all persisted in the program cache.
         chain_warm_s = None
+        sr_prog = None
+        if super_rounds:
+            # the resident program (ISSUE 14): staging + dispatch + fence
+            # drain ride it for the rest of the run
+            sr_prog = backend.enable_super_rounds(
+                block, depth=fuse_depth, max_words=16
+            )
         if nonblocking:
             t0 = time.perf_counter()
             depths = [fuse_depth]
             if rounds % fuse_depth:
                 depths.append(rounds % fuse_depth)
             warm_base = rounds
-            for d in depths:
-                await prep_churn(d, warm_base, timed=False)
-                warm_base += d
+            warm_name = "superround" if super_rounds else "refresh_chain"
+            with warm_timer(warm_name, key=(n, n_groups, tuple(depths))):
+                for d in depths:
+                    await prep_churn(d, warm_base, timed=False)
+                    warm_base += d
+                    backend.flush()
+                    backend.refresh_block_on_device(block)
+                    if super_rounds:
+                        sr_prog.dispatch(sr_prog.stage([group_ids] * d))
+                        sr_prog.drain()
+                    else:
+                        backend.cascade_rows_lanes_refresh_chain(
+                            block, [group_ids] * d
+                        )
                 backend.flush()
-                backend.refresh_block_on_device(block)
-                backend.cascade_rows_lanes_refresh_chain(
-                    block, [group_ids] * d
-                )
-            backend.flush()
             chain_warm_s = time.perf_counter() - t0
             note(
-                f"burst→refresh chain warm super-rounds, depths {depths} "
-                f"({chain_warm_s:.1f}s)"
+                f"{'super-round' if super_rounds else 'burst→refresh chain'} "
+                f"warm super-rounds, depths {depths} ({chain_warm_s:.1f}s)"
             )
 
         # -------- churn-interleaved lane bursts: THE live headline
@@ -600,7 +655,91 @@ async def main() -> None:
             phases["maintain_s"] += time.perf_counter() - t0
 
         loop_t0 = time.perf_counter()
-        if nonblocking:
+        sr0 = sr_prog.stats() if sr_prog is not None else None
+        if super_rounds:
+            # ---- the ISSUE 14 loop: the whole round is resident on
+            # device. Per super-round the host (a) preps churn + stages
+            # the NEXT seed buffer while the previous super-round executes
+            # (back buffer), (b) drains the previous super-round's packed
+            # fence masks, (c) flush/refresh, (d) dispatches the staged
+            # buffer — one device dispatch per super-round, no per-round
+            # host re-entry
+            pending_sr = None
+            pending_k = 0
+            staged_next = None
+            done_rounds = 0
+            while done_rounds < rounds or pending_sr is not None:
+                k = min(fuse_depth, rounds - done_rounds)
+                if k > 0:
+                    # overlapped host work: churn prep (journal-only) and
+                    # the seed-buffer pack both run while the previous
+                    # super-round executes on device
+                    await prep_churn(k, done_rounds)
+                    t0 = time.perf_counter()
+                    staged_next = sr_prog.stage([group_ids] * k)
+                    dt = time.perf_counter() - t0
+                    phases["stage_s"] += dt
+                    phases["burst_s"] += dt
+                    burst_s += dt
+                if pending_sr is not None:
+                    t0 = time.perf_counter()
+                    stall0 = sr_prog.stall_s
+                    per_burst = pending_sr.harvest()
+                    dt = time.perf_counter() - t0
+                    stall = sr_prog.stall_s - stall0
+                    phases["device_s"] += stall
+                    phases["stage_s"] += max(dt - stall, 0.0)
+                    phases["burst_s"] += dt
+                    burst_s += dt
+                    chain_wall_s += time.perf_counter() - pending_sr.dispatched_at
+                    chain_inv = sum(int(c.sum()) for c in per_burst)
+                    total_inv += chain_inv
+                    m = gdev._topo_mirror
+                    note(
+                        f"super-round of {pending_k}: fence drain {dt:.2f}s "
+                        f"(device stall {stall:.2f}s, {chain_inv:,} inv, "
+                        f"passes={m.get('passes', 1) if m else '?'}), "
+                        f"patches={gdev.mirror_patches} "
+                        f"rebuilds={gdev.mirror_rebuilds}"
+                    )
+                    pending_sr = None
+                    maintain()
+                if k > 0:
+                    # flush the prep's journal (scalar marks cascade — one
+                    # union wave) and re-consistent those rows pre-burst
+                    t0 = time.perf_counter()
+                    backend.flush()
+                    phases["scalar_s"] += time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    refreshed = backend.refresh_block_on_device(block)
+                    _jax.device_get(table._values[:1])  # honest phase split:
+                    # billed identically to the two baseline loops, so the
+                    # A/B's refresh_s/device_s columns are comparable
+                    dt = time.perf_counter() - t0
+                    churn_s += dt
+                    phases["refresh_s"] += dt
+                    churn_rows_total += refreshed
+                    t0 = time.perf_counter()
+                    ticket = sr_prog.dispatch(staged_next)
+                    pending_k = k
+                    if ticket.done:
+                        # a counted fallback (eager/fault) resolved inline
+                        total_inv += sum(int(c.sum()) for c in ticket.per_burst)
+                    else:
+                        pending_sr = ticket
+                        fused_chain_dispatches += 1
+                    dt = time.perf_counter() - t0
+                    phases["stage_s"] += dt
+                    phases["burst_s"] += dt
+                    burst_s += dt
+                    done_rounds += k
+            delta = {
+                k_: sr_prog.stats()[k_] - sr0[k_]
+                for k_ in ("eager_rounds", "cleared_total")
+            }
+            eager_rounds += delta["eager_rounds"]
+            churn_rows_total += delta["cleared_total"]
+        elif nonblocking:
             # ---- the ISSUE 7 loop: super-rounds of fuse_depth logical
             # rounds; burst i → device refresh → burst i+1 run as ONE
             # loop-carried chain dispatch, churn prep for the NEXT
@@ -715,6 +854,31 @@ async def main() -> None:
         overlap_occupancy = (
             round(overlap_host_s / chain_wall_s, 4) if chain_wall_s else None
         )
+        # super-round accounting (ISSUE 14): this RUN's deltas over the
+        # warm baseline — occupancy/stall of the timed loop only
+        sr_delta = None
+        if sr_prog is not None:
+            s1 = sr_prog.stats()
+            sr_delta = {
+                k_: round(s1[k_] - sr0[k_], 4)
+                for k_ in (
+                    "superrounds_dispatched", "rounds_total", "eager_rounds",
+                    "faults", "restages", "journal_forced_harvests",
+                    "harvests", "stall_s", "wall_s", "stage_s",
+                )
+            }
+            wall_d, stall_d = sr_delta["wall_s"], sr_delta["stall_s"]
+            sr_delta["occupancy"] = (
+                round(max(0.0, min(1.0, 1 - stall_d / wall_d)), 4)
+                if wall_d > 0 else None
+            )
+            sr_delta["host_stall_ms"] = (
+                round(stall_d / sr_delta["harvests"] * 1e3, 2)
+                if sr_delta["harvests"] else None
+            )
+            # the super-round notion of overlap: fraction of the device
+            # flight window covered by useful host work
+            overlap_occupancy = sr_delta["occupancy"]
         note(
             f"loop done: {total_inv:,} inv, burst {burst_s:.2f}s, loop {loop_s:.2f}s, "
             f"patches={gdev.mirror_patches} rebuilds={gdev.mirror_rebuilds} "
@@ -723,6 +887,11 @@ async def main() -> None:
                 f", fused_chains={fused_chain_dispatches} "
                 f"overlap_occupancy={overlap_occupancy}"
                 if nonblocking else ""
+            )
+            + (
+                f", superround stall {sr_delta['stall_s']:.2f}s "
+                f"stage {phases['stage_s']:.2f}s"
+                if sr_delta is not None else ""
             )
         )
 
@@ -779,6 +948,35 @@ async def main() -> None:
                 assert want == int(lane_counts[gi]), (gi, want, int(lane_counts[gi]))
             note("lane ≡ host-BFS oracle: OK")
         gdev.clear_invalid()
+
+        # -------- CI gates (LIVE_SMOKE=1, the tier1 live smoke): the
+        # super-round path must have served the clean path — any eager
+        # fallback, fault, or host re-entry (forced harvest, re-stage)
+        # beyond the budget fails the run; oracle divergence already
+        # raised above
+        if smoke and sr_delta is not None:
+            budget = int(os.environ.get("LIVE_SUPERROUND_REENTRY_BUDGET", "0"))
+            problems = []
+            if sr_delta["eager_rounds"]:
+                problems.append(
+                    f"{sr_delta['eager_rounds']} round(s) fell back to the "
+                    "eager path on a clean run"
+                )
+            if sr_delta["faults"]:
+                problems.append(f"{sr_delta['faults']} super-round fault(s)")
+            reentries = (
+                sr_delta["journal_forced_harvests"] + sr_delta["restages"]
+            )
+            if reentries > budget:
+                problems.append(
+                    f"{reentries} host re-entries per run > budget {budget}"
+                )
+            if sr_delta["superrounds_dispatched"] == 0:
+                problems.append("zero resident super-round dispatches")
+            if problems:
+                raise SystemExit("LIVE_SMOKE gate failed: " + "; ".join(problems))
+        if smoke and super_rounds and sr_delta is None:
+            raise SystemExit("LIVE_SMOKE gate failed: super-round program never ran")
 
         # -------- durable restart budget (ISSUE 6): snapshot the live
         # device graph atomically, then clock the restore — the number a
@@ -899,6 +1097,30 @@ async def main() -> None:
             ),
             "live_eager_fallback_rounds": eager_rounds if nonblocking else None,
             "live_overlap_occupancy": overlap_occupancy,
+            # device-resident super-rounds (ISSUE 14): whether the resident
+            # program served the loop, its depth, and the run's
+            # occupancy/stall/fallback accounting (deltas over the warm)
+            "live_superround": super_rounds,
+            "live_superround_depth": fuse_depth if super_rounds else None,
+            "live_superround_dispatches": (
+                sr_delta["superrounds_dispatched"] if sr_delta else None
+            ),
+            "live_superround_occupancy": (
+                sr_delta["occupancy"] if sr_delta else None
+            ),
+            "live_superround_host_stall_ms": (
+                sr_delta["host_stall_ms"] if sr_delta else None
+            ),
+            "live_superround_eager_rounds": (
+                sr_delta["eager_rounds"] if sr_delta else None
+            ),
+            "live_superround_faults": sr_delta["faults"] if sr_delta else None,
+            "live_superround_restages": (
+                sr_delta["restages"] if sr_delta else None
+            ),
+            "live_superround_forced_harvests": (
+                sr_delta["journal_forced_harvests"] if sr_delta else None
+            ),
             "live_rounds": rounds,
             "live_lanes_groups": n_groups,
             "live_lanes_seeds_per_group": seeds_per_group,
@@ -972,6 +1194,11 @@ async def main() -> None:
                 "program_cache_entries": (
                     program_cache["entries"] if program_cache else None
                 ),
+                # per-program warm attribution (ISSUE 14 satellite): each
+                # warm's seconds + whether the persistent cache served it
+                # — the 60 s lane_program_warm line item is now itemized
+                # and its cache hit/miss is a recorded fact, not a guess
+                "programs": program_warm_report(),
             },
         }
         print(json.dumps(result))

@@ -3,6 +3,7 @@ from .backend import RowBlock, TpuGraphBackend
 from .device_graph import DeviceGraph
 from .nonblocking import WavePipeline, WaveTicket
 from .program_cache import enable_program_cache, program_cache_stats
+from .superround import SuperRoundProgram, SuperRoundTicket
 
 __all__ = [
     "TpuGraphBackend",
@@ -10,6 +11,8 @@ __all__ = [
     "DeviceGraph",
     "WavePipeline",
     "WaveTicket",
+    "SuperRoundProgram",
+    "SuperRoundTicket",
     "enable_program_cache",
     "program_cache_stats",
 ]

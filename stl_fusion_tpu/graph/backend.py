@@ -178,12 +178,12 @@ class _RefreshChainTicket:
     __slots__ = (
         "backend", "block", "n_bursts", "stage_burst", "stages", "refresh",
         "pending", "cause", "seqs", "pre_block_invalid", "dispatched_at",
-        "update_valid", "done", "cleared_total",
+        "update_valid", "done", "cleared_total", "kind",
     )
 
     def __init__(self, backend, block, n_bursts, stage_burst, stages, refresh,
                  pending, cause, seqs, pre_block_invalid, dispatched_at,
-                 update_valid):
+                 update_valid, kind: str = "lanes_refresh_chain"):
         self.backend = backend
         self.block = block
         self.n_bursts = n_bursts
@@ -196,6 +196,7 @@ class _RefreshChainTicket:
         self.pre_block_invalid = pre_block_invalid
         self.dispatched_at = dispatched_at
         self.update_valid = update_valid
+        self.kind = kind
         self.done = False
         #: filled at harvest: total block rows the chained refreshes
         #: recomputed (the churn-recompute accounting of the fused loop)
@@ -245,7 +246,7 @@ class _RefreshChainTicket:
         backend.waves_run += sum(len(s) for s in stages)
         backend.device_invalidations += total_counts
         backend._profile_wave(
-            "lanes_refresh_chain",
+            self.kind,
             sum(len(g) for s in stages for g in s), self.cause,
             self.dispatched_at, t1, total_newly, seqs[0],
             groups=sum(len(s) for s in stages),
@@ -294,6 +295,11 @@ class TpuGraphBackend:
         #: accumulator + fused-chain dispatcher; Computed.invalidate_eventually
         #: and FusionHub.enable_nonblocking route here
         self.pipeline = None
+        #: optional graph.superround.SuperRoundProgram (ISSUE 14): the
+        #: resident whole-live-loop device program with double-buffered
+        #: host I/O; enable_super_rounds installs it and
+        #: WavePipeline.drain() covers its in-flight work
+        self.super_rounds = None
         #: True while a pipeline harvest applies wave N-1's newly-mask WITH
         #: wave N still executing on device — the fan-out index reads it to
         #: count fences drained in the overlap window (ISSUE 7 stage c)
@@ -916,18 +922,6 @@ class TpuGraphBackend:
         loader and a fusible mirror (callers fall back to the sequential
         pair)."""
         self.flush()
-        table = block.table
-        fn = table.device_compute_fn
-        if fn is None:
-            raise TypeError(
-                "table has no device loader — declare "
-                "TableBacking(device_batch=...) or run the sequential "
-                "cascade_rows_lanes + table.refresh() pair"
-            )
-        if block.n_rows != table.n_rows:
-            raise ValueError(
-                "cascade_rows_lanes_refresh_chain requires a FULL table bind"
-            )
         # one stage per burst chunk; stage→burst mapping folds counts back
         stages: List[List[List[int]]] = []
         stage_burst: List[int] = []
@@ -939,22 +933,8 @@ class TpuGraphBackend:
             for c0 in range(0, max(len(seed_lists), 1), self._LANES_CHUNK):
                 stages.append(seed_lists[c0 : c0 + self._LANES_CHUNK])
                 stage_burst.append(bi)
-        update_valid = not table._valid_dev_dirty
-        loader_args = (
-            tuple(table.device_loader_args())
-            if table.device_loader_args is not None
-            else ()
-        )
-        refresh = {
-            "base": block.base,
-            "n_rows": block.n_rows,
-            "fn": fn,
-            "largs": loader_args,
-            "values": table._values,
-            "valid_dev": table.valid_mask if update_valid else table._valid_dev,
-            "update_valid": update_valid,
-            "cache": block._dev_refresh,
-        }
+        refresh = self._block_refresh_state(block)
+        update_valid = refresh["update_valid"]
         dg = self.graph
         pre_block_invalid = dg._h_invalid[block.base : block.end()].copy()
         cause, seqs = self._begin_wave_span(len(stages))
@@ -967,6 +947,65 @@ class TpuGraphBackend:
         if nonblocking:
             return ticket
         return ticket.harvest()
+
+    def _block_refresh_state(self, block: RowBlock) -> dict:
+        """The device-refresh runtime state the fused chain / super-round
+        programs thread through their loop carry (memo values, validity,
+        loader args) — ONE construction shared by
+        :meth:`cascade_rows_lanes_refresh_chain` and
+        ``graph/superround.py`` so the table contract can never drift.
+        Raises for tables without a device loader or partial binds
+        (callers fall back to the sequential pair)."""
+        table = block.table
+        fn = table.device_compute_fn
+        if fn is None:
+            raise TypeError(
+                "table has no device loader — declare "
+                "TableBacking(device_batch=...) or run the sequential "
+                "cascade_rows_lanes + table.refresh() pair"
+            )
+        if block.n_rows != table.n_rows:
+            raise ValueError(
+                "the fused burst→refresh composition requires a FULL table bind"
+            )
+        update_valid = not table._valid_dev_dirty
+        loader_args = (
+            tuple(table.device_loader_args())
+            if table.device_loader_args is not None
+            else ()
+        )
+        return {
+            "base": block.base,
+            "n_rows": block.n_rows,
+            "fn": fn,
+            "largs": loader_args,
+            "values": table._values,
+            "valid_dev": table.valid_mask if update_valid else table._valid_dev,
+            "update_valid": update_valid,
+            "cache": block._dev_refresh,
+        }
+
+    def enable_super_rounds(
+        self, block: RowBlock, depth: int = 4, max_words: int = 16
+    ):
+        """Install the resident super-round program (ISSUE 14): K live
+        rounds of (seed accumulate → fused wave chain → columnar refresh
+        through the memo-table loader → two-tier memo apply → packed
+        fence-mask extraction) compile into ONE device program, and the
+        host's only per-super-round work is staging a seed buffer and
+        draining a packed fence buffer — double-buffered, so staging for
+        super-round N+1 and the fence drain of N−1 both overlap N's device
+        execution. Returns the :class:`~stl_fusion_tpu.graph.superround.
+        SuperRoundProgram`; ``backend.super_rounds`` holds it and
+        ``WavePipeline.drain()`` covers its in-flight work."""
+        from .superround import SuperRoundProgram
+
+        if self.super_rounds is not None and not self.super_rounds._disposed:
+            raise ValueError("backend already has a SuperRoundProgram attached")
+        self.super_rounds = SuperRoundProgram(
+            self, block, depth=depth, max_words=max_words
+        )
+        return self.super_rounds
 
     def refresh_block_on_device(self, block: RowBlock) -> int:
         """Recompute ALL stale rows of a bound table ON DEVICE, from the
@@ -1866,12 +1905,12 @@ class TpuGraphBackend:
         entry = self._routed_mirror
         if entry is None:
             return 0
-        if entry.get("inflight", 0) and self.pipeline is not None:
+        if entry.get("inflight", 0):
             # a fused chain mid-flight references the CURRENT row layout;
             # moving shards under it would make its harvest map rows
             # through the new permutation (dropped invalidations). Drain
             # first — the reshard then applies to a quiesced mirror.
-            self.pipeline.drain()
+            self._drain_nonblocking()
             entry = self._routed_mirror
             if entry is None:
                 return 0
@@ -1937,13 +1976,23 @@ class TpuGraphBackend:
         # containment as the sharded union bridge)
         entry.pop("invalid_version", None)
 
+    def _drain_nonblocking(self) -> None:
+        """Harvest every in-flight nonblocking plane — the WavePipeline's
+        fused chains AND the SuperRoundProgram's resident super-rounds —
+        so blocking paths (reshards, routed unions) act on a quiesced
+        device state."""
+        if self.pipeline is not None:
+            self.pipeline.drain()  # also drains super_rounds
+        elif self.super_rounds is not None and not self.super_rounds._disposed:
+            self.super_rounds.drain()
+
     def _union_routed_nids(self, seeds: List[int]) -> int:
         entry = self.routed_mirror()
-        if entry.get("inflight", 0) and self.pipeline is not None:
+        if entry.get("inflight", 0):
             # a fused chain is mid-flight: its device advance must land
             # before a blocking union syncs from the dense mirror (drain
             # is the nonblocking-mode barrier — same rule as flush)
-            self.pipeline.drain()
+            self._drain_nonblocking()
             entry = self.routed_mirror()
         graph = entry["graph"]
         dg = self.graph
@@ -1981,7 +2030,9 @@ class TpuGraphBackend:
         )
         return count
 
-    def dispatch_waves_routed_chain(self, stage_seed_lists: Sequence[Sequence[int]]) -> dict:
+    def dispatch_waves_routed_chain(
+        self, stage_seed_lists: Sequence[Sequence[int]], staged: Optional[dict] = None
+    ) -> dict:
         """K logical waves in ONE routed lax.scan dispatch with NO readback
         — the frontier exchange composed into the nonblocking loop-carried
         chain (graph/nonblocking.py rides this when mesh routing is on).
@@ -2003,7 +2054,9 @@ class TpuGraphBackend:
         if entry.get("inflight", 0) == 0:
             self._routed_sync(entry)
         levels0 = graph.levels_total
-        pending = graph.dispatch_union_chain(stage_seed_lists)
+        # a pre-packed seed buffer (SuperRoundProgram's back buffer) skips
+        # the host pack; dispatch_union_chain rejects a stale token
+        pending = graph.dispatch_union_chain(stage_seed_lists, staged=staged)
         entry["inflight"] = entry.get("inflight", 0) + 1  # after dispatch succeeds
         pending["entry"] = entry
         pending["levels0"] = levels0

@@ -1668,9 +1668,7 @@ class DeviceGraph:
                     g.invalid, jnp.asarray(mats),
                 )
             else:
-                chain = self._refresh_chain_program(
-                    m, refresh, words, passes, len(batch)
-                )
+                chain = self._refresh_chain_program(m, refresh, words, passes)
                 (
                     g_inv2, values2, valid2, lane_counts_d, packed_d,
                 ) = chain(
@@ -1697,17 +1695,19 @@ class DeviceGraph:
             "dispatches": len(batches),
         }
 
-    def _refresh_chain_program(self, m, refresh: dict, words: int, passes: int, depth: int):
-        """Build (or reuse) the jitted burst→refresh chain for one block:
-        per stage, the lane sweep's result state feeds the block's device
-        loader (stale rows recompute in-program) and the block's invalid
-        bits clear — the loop-carried composition of
-        ``run_waves_lanes`` + ``refresh_block_on_device``. Cached in the
-        caller-owned ``refresh["cache"]`` dict keyed on everything that
-        shapes the program (level layout included: a re-level must never
-        serve a stale chain)."""
+    def _refresh_chain_program(self, m, refresh: dict, words: int, passes: int):
+        """Build (or reuse) the jitted burst→refresh scan for one block —
+        the loop-carried composition of ``run_waves_lanes`` +
+        ``refresh_block_on_device`` (ops/topo_wave.py::
+        topo_mirror_superround_step; the chain path and the resident
+        super-round program share the ONE definition, so the two can never
+        drift). Cached in the caller-owned ``refresh["cache"]`` dict keyed
+        on everything that shapes the program (level layout included: a
+        re-level must never serve a stale chain; depth is NOT a key — jit
+        re-traces per seed-tensor shape, one program object per
+        geometry)."""
         key = (
-            "lanes_refresh_chain", words, passes, depth,
+            "lanes_refresh_chain", words, passes,
             refresh["update_valid"], m["n_tot"], m["level_starts"],
             refresh["base"], refresh["n_rows"],
         )
@@ -1715,50 +1715,77 @@ class DeviceGraph:
         prog = cache.get(key)
         if prog is not None:
             return prog
-        import jax
-        import jax.numpy as jnp
-        from jax import lax
+        from ..ops.topo_wave import topo_mirror_superround_step
 
-        from ..ops.bitops import pack_bool_bits
-        from ..ops.topo_wave import _lanes_stage_body
+        prog = topo_mirror_superround_step(
+            m["level_starts"], m["n_tot"], words, passes,
+            refresh["base"], refresh["n_rows"], refresh["fn"],
+            refresh["update_valid"],
+        )
+        cache[key] = prog
+        return prog
 
-        level_starts = m["level_starts"]
-        n_tot = m["n_tot"]
-        W = words
-        base, n_rows = refresh["base"], refresh["n_rows"]
-        fn = refresh["fn"]
-        update_valid = refresh["update_valid"]
+    #: rounds per resident super-round dispatch: one lax.scan covers the
+    #: whole depth (no FUSE_CHAIN_MAX batching — the program is resident
+    #: and reused every super-round, so a deep scan amortizes rather than
+    #: re-keys); the cap bounds trace/compile time for a runaway depth
+    SUPER_DEPTH_MAX = 64
 
-        @jax.jit
-        def chain(values, valid_dev, garrays, node_epoch0, perm_clipped,
-                  g_invalid, seed_mats, *largs):
-            def stage(carry, seed_new_ids):
-                g_inv, values, valid_dev = carry
-                g_inv2, lane_counts, newly_dense = _lanes_stage_body(
-                    level_starts, n_tot, W, passes,
-                    garrays, node_epoch0, perm_clipped, g_inv, seed_new_ids,
-                )
-                stale = lax.slice_in_dim(g_inv2, base, base + n_rows)
-                ids = jnp.arange(n_rows, dtype=jnp.int32)
-                fresh = fn(ids, *largs)
-                mask = stale.reshape((n_rows,) + (1,) * (values.ndim - 1))
-                values2 = jnp.where(mask, fresh, values)
-                inv3 = lax.dynamic_update_slice_in_dim(
-                    g_inv2,
-                    jnp.zeros(n_rows, dtype=g_inv2.dtype), base, 0,
-                )
-                valid2 = (valid_dev | stale) if update_valid else valid_dev
-                return (inv3, values2, valid2), (
-                    lane_counts, pack_bool_bits(newly_dense)
-                )
-
-            (inv_f, values_f, valid_f), (lane_counts, packed) = lax.scan(
-                stage, (g_invalid, values, valid_dev), seed_mats
+    def dispatch_waves_superround(
+        self, mats: np.ndarray, sizes: Sequence[int], refresh: dict,
+        words: int,
+    ) -> dict:
+        """ONE resident dispatch for a whole super-round (ISSUE 14):
+        ``mats`` is the PRE-PACKED ``int32[K, 32*words, S]`` NEW-id seed
+        tensor — staged by the host while the PREVIOUS super-round executed
+        (graph/superround.py owns the double buffering), so dispatch does
+        no per-stage pack work and no geometry recomputation. Unlike
+        :meth:`dispatch_waves_lanes_chain` there is no chunking: the whole
+        depth runs as one ``lax.scan`` through the shared
+        burst→refresh→fence program, and same geometry ⇒ the SAME compiled
+        executable every super-round. Requires a fusible mirror; raises
+        RuntimeError otherwise (callers count the eager fallback — never
+        silent). Returns a pending dict for
+        :meth:`harvest_waves_lanes_chain`."""
+        jnp = self._jnp
+        m = self.build_topo_mirror()
+        if not self._mirror_valid():
+            raise RuntimeError(
+                "topo mirror unavailable — super-round needs the fused path"
             )
-            return inv_f, values_f, valid_f, lane_counts, packed
-
-        cache[key] = chain
-        return chain
+        passes = m.get("passes", 1)
+        if passes > self.FUSED_PASS_MAX:
+            raise RuntimeError(
+                f"mirror carries {passes} sweep passes > FUSED_PASS_MAX — "
+                "super-rounds serve only the fused one-dispatch regime"
+            )
+        K = int(mats.shape[0])
+        if K > self.SUPER_DEPTH_MAX:
+            raise ValueError(
+                f"super-round depth {K} > SUPER_DEPTH_MAX={self.SUPER_DEPTH_MAX}"
+            )
+        g = self.device_arrays()
+        prog = self._refresh_chain_program(m, refresh, words, passes)
+        (
+            g_inv2, values2, valid2, lane_counts_d, packed_d,
+        ) = prog(
+            refresh["values"], refresh["valid_dev"],
+            m["garrays"], m["node_epoch0"], m["perm_clipped"],
+            g.invalid, jnp.asarray(mats), *refresh["largs"],
+        )
+        refresh["values"] = values2
+        refresh["valid_dev"] = valid2
+        # commit the device handle NOW so a next super-round the caller
+        # enqueues chains device-side off this one's final state
+        self._g = g._replace(invalid=g_inv2)
+        self.mirror_bursts += K
+        self.last_lanes_info = {"depth": K, "dispatches": 1}
+        return {
+            "batches": [(lane_counts_d, packed_d, list(sizes))],
+            "refresh": refresh,
+            "depth": K,
+            "dispatches": 1,
+        }
 
     def harvest_waves_lanes_chain(self, pending: dict) -> Tuple[list, list]:
         """Block on a :meth:`dispatch_waves_lanes_chain` ticket and fold the

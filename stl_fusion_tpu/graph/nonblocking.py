@@ -183,15 +183,19 @@ class WavePipeline:
             return
         waves, self._pending = self._pending, []
         backend = self.backend
-        if self._inflight and backend._journal:
+        if backend._journal:
             # flush() with a chain in flight would read (run_icasc's
             # was_clear) and clear invalid state through the STALE host
             # mirror — the exact hazard the refresh-chain ticket documents.
-            # A non-empty journal forces the harvest first; the common
-            # pure-pipeline cadence (no journal between dispatches) keeps
-            # the full overlap.
-            while self._inflight:
-                self._harvest(self._inflight.popleft())
+            # A non-empty journal forces the harvest first — of BOTH
+            # nonblocking planes: an in-flight SUPER-ROUND's device
+            # advance is just as unharvested as this pipeline's own
+            # chains. The common pure-pipeline cadence (no journal
+            # between dispatches) keeps the full overlap.
+            self.harvest_inflight()
+            sr = backend.super_rounds
+            if sr is not None and not sr._disposed:
+                sr._harvest_all()
         backend.flush()
         cause, seqs = backend._begin_wave_span(len(waves))
         wd = backend.watchdog
@@ -238,14 +242,27 @@ class WavePipeline:
         while len(self._inflight) > self.MAX_INFLIGHT:
             self._harvest(self._inflight.popleft())
 
+    def harvest_inflight(self) -> None:
+        """Harvest every dispatched-but-unharvested chain WITHOUT
+        dispatching pending accumulations — the flush-hazard half of
+        drain(), also called by the SuperRoundProgram's own guard so
+        either plane's dispatch quiesces the other before flushing."""
+        while self._inflight:
+            self._harvest(self._inflight.popleft())
+
     def drain(self) -> int:
         """The nonblocking-mode barrier: dispatch anything accumulated and
-        harvest every in-flight chain. Returns the total newly-invalidated
-        count of the waves resolved by this call."""
+        harvest every in-flight chain — INCLUDING any super-rounds the
+        backend's resident SuperRoundProgram (ISSUE 14) has in flight, so
+        one barrier covers both nonblocking planes. Returns the total
+        newly-invalidated count of the waves resolved by this call."""
         before = self.backend.device_invalidations
         self.dispatch()
         while self._inflight:
             self._harvest(self._inflight.popleft())
+        sr = self.backend.super_rounds
+        if sr is not None and not sr._disposed:
+            sr.drain()
         return self.backend.device_invalidations - before
 
     # ------------------------------------------------------------------ harvest

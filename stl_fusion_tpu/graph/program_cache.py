@@ -22,7 +22,13 @@ from typing import Optional
 
 log = logging.getLogger("stl_fusion_tpu")
 
-__all__ = ["enable_program_cache", "program_cache_stats"]
+__all__ = [
+    "enable_program_cache",
+    "program_cache_stats",
+    "time_program_warm",
+    "program_warm_report",
+    "reset_program_warms",
+]
 
 #: env override for the cache root (matches FUSION_MIRROR_CACHE's shape)
 CACHE_ENV = "FUSION_PROGRAM_CACHE"
@@ -87,6 +93,85 @@ def enable_program_cache(
     except Exception:  # noqa: BLE001 — metrics must never block enabling
         pass
     return info
+
+
+#: per-program warm records: name -> {"key", "warm_s", "cache_hit",
+#: "new_entries"} (insertion-ordered; the bench cold_start block reports it)
+_PROGRAM_WARMS: dict = {}
+
+
+class time_program_warm:
+    """Context manager timing ONE program family's warm-up, attributing it
+    to the persistent cache (ISSUE 14 cold-start satellite — BENCH_r05's
+    ``lane_program_warm_s`` was 60.22 s with no way to tell a cache-served
+    warm from a cold compile). ``key`` names what the program is keyed on
+    — geometry, depth, exchange — so two runs with different keys never
+    read as the same warm. ``cache_hit`` is judged from the persistent
+    cache dir: a warm that added NO new executables (and the cache is
+    enabled) was served from disk/in-process. Records land in
+    :func:`program_warm_report`; live_path.py folds them into the bench
+    ``cold_start`` block.
+
+    Usage::
+
+        with time_program_warm("lane", key=(n_tot, words, passes)):
+            backend.cascade_rows_lanes(block, group_ids)
+    """
+
+    def __init__(self, name: str, key=None, jax_dir: Optional[str] = None):
+        self.name = name
+        self.key = key
+        self.jax_dir = jax_dir
+        self._t0 = 0.0
+        self._entries0 = 0
+
+    def _entries(self) -> int:
+        try:
+            return program_cache_stats(self.jax_dir)["entries"]
+        except OSError:  # an unreadable cache dir reads as empty
+            return 0
+
+    def __enter__(self):
+        import time
+
+        self._entries0 = self._entries()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        import time
+
+        dt = time.perf_counter() - self._t0
+        new = self._entries() - self._entries0
+        # with no cache dir on disk the entry delta proves nothing — a
+        # cold 60 s compile must never be recorded as cache-served
+        # (cache_hit=None = unattributable, the honest answer)
+        cache_present = os.path.isdir(
+            program_cache_stats(self.jax_dir)["dir"]
+            if self.jax_dir is None else self.jax_dir
+        )
+        _PROGRAM_WARMS[self.name] = {
+            "key": repr(self.key) if self.key is not None else None,
+            "warm_s": round(dt, 3),
+            "new_entries": int(new),
+            # no new persisted executables ⇒ the warm was served from the
+            # persistent cache (or was cheap enough to fall under the
+            # min-compile-time persistence floor — either way, not a cold
+            # multi-second XLA compile)
+            "cache_hit": (new <= 0) if cache_present else None,
+        }
+        return False
+
+
+def program_warm_report() -> dict:
+    """Everything :class:`time_program_warm` recorded this process — the
+    bench ``cold_start.programs`` block (per-program warm seconds + warm
+    vs. cache-hit attribution)."""
+    return {k: dict(v) for k, v in _PROGRAM_WARMS.items()}
+
+
+def reset_program_warms() -> None:
+    _PROGRAM_WARMS.clear()
 
 
 def program_cache_stats(root: Optional[str] = None) -> dict:

@@ -52,6 +52,7 @@ __all__ = [
     "topo_mirror_fused_union_step",
     "topo_mirror_fused_lanes_step",
     "topo_mirror_fused_lanes_chain_step",
+    "topo_mirror_superround_step",
     "topo_mirror_gate_lanes_step",
     "topo_mirror_finish_lanes_step",
     "run_topo_sweep_passes",
@@ -612,6 +613,74 @@ def topo_mirror_fused_lanes_chain_step(
         return g_invalid2, lane_counts, packed_stages
 
     return chain
+
+
+def topo_mirror_superround_step(
+    level_starts, n_tot: int, words: int, passes: int,
+    base: int, n_rows: int, fn, update_valid: bool,
+):
+    """K live rounds of (lane-burst sweep → columnar refresh through the
+    memo-table device loader → packed fence-mask extraction) as ONE jitted
+    loop-carried ``lax.scan`` — the resident super-round program (ISSUE 14,
+    the FuseFlow-style fusion ACROSS pipeline-stage boundaries). The carry
+    holds the dense invalid state AND the memo columns (values + validity),
+    so round ``i+1`` cascades against exactly the state round ``i`` left —
+    burst, refresh, and fence extraction for the whole super-round run with
+    zero host round trips between rounds.
+
+    Per-round semantics = :func:`topo_mirror_fused_lanes_step` followed by
+    the block's device refresh (``TpuGraphBackend.refresh_block_on_device``)
+    — a super-round of K rounds is oracle-identical to K sequential
+    (burst → refresh) pairs. The depth comes from ``seed_mats.shape[0]`` at
+    trace time, so ONE returned program object serves every pinned depth
+    (jit re-traces per shape; the persistent XLA cache keeps each compiled
+    executable across restarts). Returns ``(g_invalid2, values2, valid2,
+    lane_counts int32[K, 32*words], packed uint32[K, ceil(dense/32)])`` —
+    per-ROUND packed fence masks, so the host drain applies (and fences)
+    each logical wave under its own identity while the next super-round
+    executes.
+
+    ``fn`` is the memo table's device loader ``(ids, *largs) -> rows``;
+    its state rides as trailing runtime args, never closure constants."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .bitops import pack_bool_bits
+
+    W = words
+
+    @jax.jit
+    def superround(values, valid_dev, garrays, node_epoch0, perm_clipped,
+                   g_invalid, seed_mats, *largs):
+        def round_step(carry, seed_new_ids):
+            g_inv, values, valid_dev = carry
+            g_inv2, lane_counts, newly_dense = _lanes_stage_body(
+                level_starts, n_tot, W, passes,
+                garrays, node_epoch0, perm_clipped, g_inv, seed_new_ids,
+            )
+            # columnar refresh: the block's invalid rows recompute through
+            # the table's device loader and their invalid bits clear, so
+            # the NEXT round cascades against a consistent block
+            stale = lax.slice_in_dim(g_inv2, base, base + n_rows)
+            ids = jnp.arange(n_rows, dtype=jnp.int32)
+            fresh = fn(ids, *largs)
+            mask = stale.reshape((n_rows,) + (1,) * (values.ndim - 1))
+            values2 = jnp.where(mask, fresh, values)
+            inv3 = lax.dynamic_update_slice_in_dim(
+                g_inv2, jnp.zeros(n_rows, dtype=g_inv2.dtype), base, 0,
+            )
+            valid2 = (valid_dev | stale) if update_valid else valid_dev
+            return (inv3, values2, valid2), (
+                lane_counts, pack_bool_bits(newly_dense)
+            )
+
+        (inv_f, values_f, valid_f), (lane_counts, packed) = lax.scan(
+            round_step, (g_invalid, values, valid_dev), seed_mats
+        )
+        return inv_f, values_f, valid_f, lane_counts, packed
+
+    return superround
 
 
 @functools.lru_cache(maxsize=8)

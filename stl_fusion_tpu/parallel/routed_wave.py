@@ -468,15 +468,17 @@ class RoutedShardedGraph:
         return collect
 
     # ------------------------------------------------------------------ chain
-    def dispatch_union_chain(
+    def stage_union_chain(
         self, stage_seed_lists: Sequence[Sequence[int]], cap: int = 65536
     ) -> dict:
-        """K logical union waves in ONE lax.scan dispatch, NO readback:
-        stage i cascades against the invalid state stages < i left (each
-        result equals a sequential per-stage dispatch). Returns a pending
-        ticket for :meth:`harvest_union_chain`; the device invalid state
-        advances immediately (futures)."""
-        self._check_usable()
+        """Host-side pack of a union chain's seed tensor — the super-round
+        BACK BUFFER (ISSUE 14): perm-map and pad WITHOUT dispatching, so
+        the pack runs while the previous chain executes on device. The
+        staged dict carries a (graph identity, placement epoch) token;
+        :meth:`dispatch_union_chain` refuses a buffer staged against a
+        permutation a reshard/rebuild has since retired (PlacementError —
+        the caller re-stages, counted, never silently dispatches stale
+        row ids)."""
         K = len(stage_seed_lists)
         if K == 0:
             raise ValueError("empty chain")
@@ -492,6 +494,33 @@ class RoutedShardedGraph:
                     raise PlacementError("seed node lands on an off-mesh shard")
                 mat[i, : len(seeds)] = r
         capd = max(cap // self.n_dev, 1024)
+        return {
+            "mat": mat, "stages": K, "width": width, "capd": capd,
+            "token": (id(self), self.placement.epoch),
+        }
+
+    def dispatch_union_chain(
+        self,
+        stage_seed_lists: Optional[Sequence[Sequence[int]]] = None,
+        cap: int = 65536,
+        staged: Optional[dict] = None,
+    ) -> dict:
+        """K logical union waves in ONE lax.scan dispatch, NO readback:
+        stage i cascades against the invalid state stages < i left (each
+        result equals a sequential per-stage dispatch). ``staged`` (from
+        :meth:`stage_union_chain`) skips the host pack — the double-
+        buffered super-round path. Returns a pending ticket for
+        :meth:`harvest_union_chain`; the device invalid state advances
+        immediately (futures)."""
+        self._check_usable()
+        if staged is None:
+            staged = self.stage_union_chain(stage_seed_lists, cap)
+        elif staged["token"] != (id(self), self.placement.epoch):
+            raise PlacementError(
+                "staged seed buffer predates a reshard/rebuild — re-stage"
+            )
+        K, width, capd = staged["stages"], staged["width"], staged["capd"]
+        mat = staged["mat"]
         fn = self._chain_cache.get((K, width, capd))
         if fn is None:
             fn = self._build_chain(capd)

@@ -46,6 +46,12 @@ def test_compact_record_stays_under_tail_window():
         "live_fused_chain_dispatches": 2,
         "live_eager_fallback_rounds": 0,
         "live_overlap_occupancy": 0.4312,
+        "live_superround": True,
+        "live_superround_depth": 3,
+        "live_superround_occupancy": 0.9123,
+        "live_superround_host_stall_ms": 25.45,
+        "live_superround_eager_rounds": 0,
+        "live_superround_faults": 0,
         "churn_recompute_rows_per_s": 46925984.0,
         "churn_edges_declared": 11389,
         "mirror_patches": 6,
@@ -57,10 +63,24 @@ def test_compact_record_stays_under_tail_window():
             "build_s": 2.45, "mirror_build_s": 48.95,
             "lane_program_warm_s": 20.59, "union_program_warm_s": 27.13,
             "refresh_program_warm_s": 0.63,
+            # per-program warm attribution (ISSUE 14 cold-start satellite)
+            "programs": {
+                "union": {"key": "(10000000, 'lat+topo')", "warm_s": 27.13,
+                          "new_entries": 0, "cache_hit": True},
+                "lanes": {"key": "(10000000, 512, 'passes<=4')",
+                          "warm_s": 20.59, "new_entries": 6,
+                          "cache_hit": False},
+                "refresh": {"key": "(10000000,)", "warm_s": 0.63,
+                            "new_entries": 0, "cache_hit": True},
+                "superround": {"key": "(10000000, 512, (3,))",
+                               "warm_s": 9.86, "new_entries": 2,
+                               "cache_hit": False},
+            },
         },
         "loop_phases": {
             "declare_s": 0.01, "scalar_s": 4.9, "refresh_s": 1.07,
-            "burst_s": 28.48, "maintain_s": 0.0,
+            "burst_s": 28.48, "stage_s": 1.92, "device_s": 26.56,
+            "maintain_s": 0.0,
         },
     }
     edge = {
@@ -173,6 +193,14 @@ def test_compact_record_stays_under_tail_window():
     assert d["live"]["overlap_occupancy"] == 0.4312
     assert d["live"]["eager_fallback_rounds"] == 0
     assert d["live"]["mirror_patch_device_ms"] == 1590.4
+    # the device-resident super-round fields (ISSUE 14) ride the capture:
+    # resident depth, device occupancy, host stalls per super-round, and
+    # the must-stay-zero fallback counters
+    assert d["live"]["superround_depth"] == 3
+    assert d["live"]["device_occupancy"] == 0.9123
+    assert d["live"]["host_stalls_per_round"] == 25.45
+    assert d["live"]["superround_eager_rounds"] == 0
+    assert d["live"]["superround_faults"] == 0
     # the mesh-sharded graph (ISSUE 9): the north-star scale + oracle
     # verdict + routed-path engagement ride the capture
     assert d["mesh"]["nodes"] == 80_000_000 and d["mesh"]["oracle_exact"] is True
