@@ -570,7 +570,14 @@ def run_mesh_section():
     if nodes <= 0:
         return None
     devices = int(os.environ.get("FUSION_BENCH_MESH_DEVICES", 8))
-    env = dict(os.environ, MESH_NODES=str(nodes), JAX_PLATFORMS="cpu")
+    # the multihost leg (ISSUE 15): 2 real OS-process hosts at reduced
+    # scale ride behind the static/live legs so the record carries
+    # hosts / bucket_resizes / host_kill_recovery_s; =0 skips
+    mh_hosts = int(os.environ.get("FUSION_BENCH_MESH_HOSTS", 2))
+    env = dict(
+        os.environ, MESH_NODES=str(nodes), JAX_PLATFORMS="cpu",
+        MESH_MULTIHOST=str(mh_hosts),
+    )
     # the subprocess needs its own virtual pool — REPLACE any inherited
     # single-device XLA_FLAGS rather than appending a duplicate flag
     flags = [
@@ -983,6 +990,26 @@ def _compact_result(
             "eager_waves": (lv.get("pipeline") or {}).get("eager_waves"),
             "violations": mesh.get("violations"),
         }
+        mh = mesh.get("multihost") or {}
+        if mh:
+            # ISSUE 15: the REAL-process leg — hosts, the hierarchical
+            # exchange's cross-host words, in-place bucket resizes, the
+            # cross-process DCN marker, and the host-kill recovery time
+            scale = mh.get("scale") or {}
+            chaos = mh.get("chaos") or {}
+            stats = scale.get("stats") or {}
+            out["mesh"]["hosts"] = mh.get("hosts")
+            out["mesh"]["mh_exchange"] = stats.get("exchange")
+            out["mesh"]["mh_nodes"] = mh.get("nodes")
+            out["mesh"]["mh_oracle_exact"] = scale.get("oracle_exact")
+            out["mesh"]["mh_xcheck_ok"] = (scale.get("xcheck") or {}).get("ok")
+            out["mesh"]["cross_host_words"] = stats.get("cross_host_words")
+            out["mesh"]["bucket_resizes"] = stats.get("bucket_resizes")
+            out["mesh"]["dcn_fallback_relays"] = (scale.get("dcn") or {}).get(
+                "dcn_fallback_relays"
+            )
+            out["mesh"]["host_kill_recovery_s"] = chaos.get("host_kill_recovery_s")
+            out["mesh"]["rejoin_oracle_exact"] = chaos.get("rejoin_oracle_exact")
     if traffic is not None and "error" in traffic:
         out["traffic"] = {"error": traffic["error"]}
     elif traffic is not None:

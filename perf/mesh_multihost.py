@@ -1,0 +1,698 @@
+#!/usr/bin/env python
+"""True multi-host mesh legs (ISSUE 15): real OS-process boundaries.
+
+Orchestrates 2+ emulated HOST processes (cluster/multihost.py:
+``jax.distributed`` + gloo CPU collectives, one XLA CPU device pool per
+process) running the routed graph with the hierarchical exchange, and
+gates the claims PR 9 could only count:
+
+1. **Scale leg** — a power-law graph of ``MESH_MH_NODES`` split across
+   the hosts, ``exchange="hier"`` (intra-host subgroup a2a + inter-host
+   host-bucket ppermute tree): wave 0 is oracle-checked against the
+   vectorized host BFS IN the workers, and its packed mask is exported so
+   the parent cross-checks it against the SINGLE-PROCESS routed oracle —
+   two processes and one process must produce the bit-identical frontier.
+   Then fused chain rounds measure throughput, a patch burst FORCES a
+   bucket/edge-slack overflow that must resolve by counted in-place
+   resize (zero rebuilds in steady state), and a DCN leg posts a fence to
+   an off-mesh member over a real TCP socket between the two host
+   processes (``fusion_mesh_dcn_fallback_total`` EXERCISED, not merely
+   counted).
+
+2. **Host-kill chaos leg** — both hosts run chain rounds, snapshotting
+   their LOCAL shards per round (checkpoint.save_mesh_shards machinery).
+   The parent SIGKILLs host 1 mid-burst; host 0's watchdog notices (file
+   flag from the parent OR a stuck collective) and exits; the SURVIVOR
+   phase restarts host 0 alone — membership reassigns the dead host's
+   shards (``ShardMap.with_members``), the new placement re-packs onto
+   the surviving device pool, per-shard snapshots restore, and the
+   remaining rounds must be oracle-exact (recovery time recorded). The
+   REJOIN phase brings host 1 back: a fresh 2-host mesh warm-rejoins
+   from the survivor's snapshots and finishes the round schedule, again
+   oracle-exact. Zero oracle-divergent waves anywhere or the leg fails.
+
+Run as orchestrator: ``python perf/mesh_multihost.py`` (or via
+perf/mesh_path.py with ``MESH_MULTIHOST=2``). The worker entry is this
+same file with ``--worker`` (the launcher env carries the rest).
+
+Env: MESH_MULTIHOST (2), MESH_MH_DPH (2), MESH_MH_NODES (40_000),
+MESH_MH_SHARDS (64), MESH_MH_ROUNDS (4), MESH_MH_SEEDS_PER_ROUND (4),
+MESH_MH_EXCHANGE (hier), MESH_MH_CHAOS (1), MESH_MH_SCALE (1),
+MESH_MH_XCHECK (1: parent single-process oracle cross-check),
+MESH_MH_TIMEOUT (600s per phase).
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, str(default)))
+
+
+# the ONE oracle BFS both perf gates share (mesh_path is importable in
+# both entry modes: worker runs from perf/, orchestrator imports us lazily)
+from mesh_path import numpy_bfs_mask  # noqa: E402
+
+
+def _put_file(path: str, content: str) -> None:
+    """Atomic rendezvous-file write: the peer polls on existence and then
+    parses ONCE — a plain open/write exposes a zero-byte window between
+    create and flush that crashes the reader (int('') / json.loads(''))."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(content)
+    os.replace(tmp, path)
+
+
+def round_seeds(rng_seed: int, n: int, rounds: int, per_round: int, stages: int):
+    """The deterministic burst schedule every phase re-derives: round r =
+    ``stages`` chain stages of ``per_round`` seeds each."""
+    rng = np.random.default_rng(rng_seed)
+    return [
+        [rng.choice(n, size=per_round, replace=False).tolist() for _ in range(stages)]
+        for _ in range(rounds)
+    ]
+
+
+# ===================================================================== worker
+def _watchdog(mh_dir: str, deadline_holder: list) -> None:
+    """Daemon thread: a parent 'peer-dead' flag or a wedged collective
+    (round overrunning its deadline) hard-exits the process — a killed
+    peer leaves gloo collectives stuck in C++ where no Python exception
+    can reach. Exit code 3 = 'peer lost, state on disk'."""
+    flag = os.path.join(mh_dir, "peer-dead")
+    while True:
+        time.sleep(0.2)
+        if os.path.exists(flag):
+            os._exit(3)
+        dl = deadline_holder[0]
+        if dl is not None and time.time() > dl:
+            os._exit(3)
+
+
+async def _dcn_leg(ctx, mh_dir: str, result: dict) -> None:
+    """The real-DCN marker (ISSUE 15 satellite): host 0 serves a live
+    mini-hub whose fan-out scope marks host 1's member OFF-mesh; host 1
+    subscribes over a real TCP socket and must observe the fence. The
+    relay therefore crosses an actual process boundary and
+    ``fusion_mesh_dcn_fallback_total`` is exercised, not merely counted."""
+    import asyncio
+
+    from stl_fusion_tpu.client import compute_client, install_compute_call_type
+    from stl_fusion_tpu.core import (
+        ComputeService,
+        FusionHub,
+        TableBacking,
+        capture,
+        compute_method,
+        memo_table_of,
+        set_default_hub,
+    )
+    from stl_fusion_tpu.graph import TpuGraphBackend
+    from stl_fusion_tpu.rpc import RpcHub
+    from stl_fusion_tpu.rpc.fanout import install_compute_fanout
+    from stl_fusion_tpu.rpc.tcp import RpcTcpServer, tcp_client_connector
+
+    members = ctx.member_names()
+    port_file = os.path.join(mh_dir, "dcn-port")
+    sub_file = os.path.join(mh_dir, "dcn-subscribed")
+    ack_file = os.path.join(mh_dir, "dcn-ack")
+
+    async def _wait_for(path: str, timeout: float = 60.0) -> str:
+        # MUST yield to the loop: the server host sits in this wait while
+        # its RpcTcpServer serves the peer's subscribe — a blocking sleep
+        # here deadlocks both hosts (the FL004 frozen-pump class)
+        t0 = time.time()
+        while not os.path.exists(path):
+            if time.time() - t0 > timeout:
+                raise TimeoutError(f"rendezvous file {path} never appeared")
+            await asyncio.sleep(0.05)
+        with open(path) as f:
+            return f.read()
+
+    if ctx.process_id == 0:
+        ns = 256
+        hub = FusionHub()
+        old = set_default_hub(hub)
+        try:
+            backend = TpuGraphBackend(hub, node_capacity=ns + 16, edge_capacity=256)
+
+            class RowSvc(ComputeService):
+                def load(self, ids):
+                    return np.asarray(ids, dtype=np.float32)
+
+                @compute_method(table=TableBacking(rows=ns, batch="load"))
+                async def row(self, i: int) -> float:
+                    return float(i)
+
+            svc = RowSvc(hub)
+            hub.add_service(svc)
+            table = memo_table_of(svc.row)
+            blk = backend.bind_table_rows(table)
+            table.read_batch(np.arange(ns))
+            backend.flush()
+            server_rpc = RpcHub("server")
+            install_compute_call_type(server_rpc)
+            server_rpc.add_service("rows", svc)
+            fanout = install_compute_fanout(server_rpc, backend)
+            # host 0's member is ON this host's mesh scope; host 1's is a
+            # cluster member on ANOTHER host — the legitimate DCN path
+            fanout.set_mesh_scope([members[0]], cluster_members=members)
+            server = await RpcTcpServer(server_rpc, ref_prefix="").start()
+            _put_file(port_file, str(server.port))
+            await _wait_for(sub_file)
+            backend.cascade_rows_batch(blk, [5])
+            await asyncio.sleep(0)  # let the outbox drain post
+            ack = json.loads(await _wait_for(ack_file, timeout=60.0))
+            result["dcn"] = {
+                "dcn_fallback_relays": fanout.dcn_fallback_relays,
+                "mesh_member_relays": fanout.mesh_member_relays,
+                "client_observed_fence": bool(ack.get("invalidated")),
+            }
+            fanout.dispose()
+            await server_rpc.stop()
+            await server.stop()
+        finally:
+            set_default_hub(old)
+    elif ctx.process_id == 1:
+        port = int(await _wait_for(port_file))
+        client_rpc = RpcHub(f"{members[1]}-rpc")
+        install_compute_call_type(client_rpc)
+        client_rpc.client_connector = tcp_client_connector(
+            "127.0.0.1", port, client_id=members[1]
+        )
+        client = compute_client("rows", client_rpc, FusionHub())
+        got = await client.row(5)
+        node = await capture(lambda: client.row(5))
+        _put_file(sub_file, "1")
+        invalidated = True
+        try:
+            await asyncio.wait_for(node.when_invalidated(), 30.0)
+        except (asyncio.TimeoutError, TimeoutError):
+            # asyncio.TimeoutError is not the builtin before 3.11
+            invalidated = False
+        _put_file(ack_file, json.dumps({"invalidated": invalidated, "value": got}))
+        result["dcn"] = {"client_observed_fence": invalidated}
+        await client_rpc.stop()
+
+
+def run_worker() -> int:
+    import threading
+
+    from stl_fusion_tpu.checkpoint import restore_mesh_shards, save_mesh_shards
+    from stl_fusion_tpu.cluster import DevicePlacement, ShardMap
+    from stl_fusion_tpu.cluster.multihost import init_multihost
+    from stl_fusion_tpu.graph.synthetic import power_law_dag
+
+    phase = os.environ.get("MESH_MH_PHASE", "scale")
+    mh_dir = os.environ["MESH_MH_DIR"]
+    n = _env_int("MESH_MH_NODES", 40_000)
+    n_shards = _env_int("MESH_MH_SHARDS", 64)
+    exchange = os.environ.get("MESH_MH_EXCHANGE", "hier")
+    rounds_total = _env_int("MESH_MH_ROUNDS", 4)
+    per_round = _env_int("MESH_MH_SEEDS_PER_ROUND", 4)
+    stages = _env_int("MESH_MH_STAGES", 2)
+    start_round = _env_int("MESH_MH_START_ROUND", 0)
+    end_round = _env_int("MESH_MH_END_ROUND", rounds_total)
+    restore_from = os.environ.get("MESH_MH_RESTORE", "")
+    all_members = os.environ["MESH_MH_MEMBERS"].split(",")
+    round_deadline_s = float(os.environ.get("MESH_MH_ROUND_DEADLINE", "120"))
+
+    ctx = init_multihost()
+    from stl_fusion_tpu.parallel import RoutedShardedGraph
+
+    result: dict = {
+        "phase": phase,
+        "host": ctx.process_id,
+        "n_hosts": ctx.n_hosts,
+        "devices_per_host": ctx.devices_per_host,
+        "violations": [],
+    }
+    deadline_holder = [None]
+    threading.Thread(
+        target=_watchdog, args=(mh_dir, deadline_holder), daemon=True
+    ).start()
+
+    t0 = time.time()
+    src, dst = power_law_dag(n, avg_degree=3.0, seed=7)
+    gen_s = time.time() - t0
+    # the phase's member view: survivors only in the survivor phase; the
+    # shard map DIFF from the full membership is what reassigns the dead
+    # host's shards (PR 5 machinery, real this time)
+    live_members = all_members[: ctx.n_hosts]
+    smap = ShardMap.initial(all_members, n_shards=n_shards)
+    if live_members != all_members:
+        smap = smap.with_members(live_members)
+    t0 = time.time()
+    placement = DevicePlacement.build(
+        smap, ctx.n_dev, n, mesh_members=live_members,
+        devices_per_host=ctx.devices_per_host,
+    )
+    graph = RoutedShardedGraph(
+        src, dst, n, placement, mesh=ctx.mesh(), exchange=exchange
+    )
+    build_s = time.time() - t0
+    log(
+        f"[h{ctx.process_id}/{phase}] {n} nodes, {len(src)} edges over "
+        f"{ctx.n_hosts} host(s) x {ctx.devices_per_host} dev; build {build_s:.1f}s "
+        f"(e_cap {graph.e_cap}, bucket {graph.bucket_cap}, hbucket {graph.hbucket_cap})"
+    )
+    result.update(
+        nodes=n, edges=int(len(src)), exchange=graph.exchange,
+        gen_s=round(gen_s, 1), build_s=round(build_s, 1),
+    )
+
+    if restore_from:
+        restored = 0
+        for path in sorted(restore_from.split(",")):
+            if os.path.exists(path):
+                restored += restore_mesh_shards(graph, path)["restored"]
+        result["restored_shards"] = restored
+        if restored == 0:
+            result["violations"].append("warm-rejoin restored zero shards")
+
+    schedule = round_seeds(123, n, rounds_total, per_round, stages)
+    # per-stage count oracles re-BFS per stage — exact but O(rounds·BFS);
+    # phases that warm-start from snapshots (whose restored state may run
+    # AHEAD of the replay start: monotone, still ⊆ the final closure) and
+    # the 100M record leg gate on the phase-end FULL-MASK equality instead
+    check_stages = os.environ.get("MESH_MH_STAGE_ORACLE", "1") == "1"
+    # the oracle's memory: every seed of every round ALREADY run (prior
+    # phases included — the restored snapshot carries their cascades)
+    flat = [s for r in schedule[:start_round] for st in r for s in st]
+    mask_know = numpy_bfs_mask(src, dst, n, flat) if check_stages else None
+    divergence = 0
+    chain_dispatches = 0
+    t_run = time.time()
+    for r in range(start_round, end_round):
+        deadline_holder[0] = time.time() + round_deadline_s
+        pending = graph.dispatch_union_chain(schedule[r])
+        counts, stage_ids, info = graph.harvest_union_chain(pending)
+        chain_dispatches += 1
+        if check_stages:
+            seen = set(np.nonzero(mask_know)[0].tolist())
+            for st, c in zip(schedule[r], counts):
+                want = {
+                    x
+                    for x in np.nonzero(numpy_bfs_mask(src, dst, n, st))[0].tolist()
+                    if x not in seen
+                }
+                seen |= want
+                if int(c) != len(want):
+                    divergence += 1
+            mask_know = np.zeros(n, dtype=bool)
+            mask_know[np.fromiter(seen, dtype=np.int64, count=len(seen))] = True
+        deadline_holder[0] = None
+        if os.environ.get("MESH_MH_SNAPSHOT", "0") == "1":
+            snap = os.path.join(mh_dir, f"snap_h{ctx.process_id}.npz")
+            save_mesh_shards_local(graph, snap, save_mesh_shards)
+            _put_file(
+                os.path.join(mh_dir, f"progress_h{ctx.process_id}"), str(r + 1)
+            )
+    burst_s = time.time() - t_run
+    rounds_run = end_round - start_round
+    if mask_know is None:
+        flat_all = [s for r_ in schedule[:end_round] for st in r_ for s in st]
+        mask_know = numpy_bfs_mask(src, dst, n, flat_all)
+
+    # phase-end oracle: the resident mask must EXACTLY equal the BFS
+    # closure of every seed so far — zero oracle-divergent waves
+    mask = graph.invalid_mask()
+    oracle_exact = bool(np.array_equal(mask, mask_know))
+    if not oracle_exact:
+        result["violations"].append(
+            f"phase-end mask diverged at {int((mask != mask_know).sum())} node(s)"
+        )
+    if divergence:
+        result["violations"].append(f"{divergence} chain stage(s) diverged")
+    result.update(
+        rounds=rounds_run,
+        burst_s=round(burst_s, 2),
+        oracle_exact=oracle_exact,
+        chain_dispatches=chain_dispatches,
+        divergence=divergence,
+        serving_ts=time.time(),  # first oracle-exact service of this phase
+    )
+
+    if phase == "scale":
+        # wave-0 packed mask export: the parent cross-checks it against
+        # the SINGLE-PROCESS routed oracle (acceptance: bit-identical)
+        if ctx.process_id == 0:
+            np.save(
+                os.path.join(mh_dir, "wave_mask.npy"), np.packbits(mask)
+            )
+        # resize leg: flood one destination's slack past e_cap — must
+        # resolve by counted in-place resize, zero rebuild-grade failures.
+        # MESH_MH_RESIZE=0 skips it (the flood is e_cap-sized: a python
+        # slot-assignment loop that is fine at smoke scale and hours at
+        # the 100M record's ~50M-entry slack — the CI smoke owns this gate)
+        if os.environ.get("MESH_MH_RESIZE", "1") == "1":
+            _resize_leg(graph, src, dst, n, mask_know, result)
+        # DCN leg: a fence relayed to the OTHER host process over TCP
+        ctx.sync("pre-dcn")
+        import asyncio
+
+        asyncio.run(_dcn_leg(ctx, mh_dir, result))
+        ctx.sync("post-dcn")
+
+    if phase == "survivor":
+        # the survivor saves ALL shards so the rejoin phase warm-starts
+        # from the post-recovery state
+        save_mesh_shards(
+            graph, os.path.join(mh_dir, "snap_survivor.npz")
+        )
+
+    st = graph.stats()
+    result["stats"] = {
+        k: st[k]
+        for k in (
+            "exchange", "hosts", "waves_run", "exchange_levels_total",
+            "cross_host_words", "cross_words_per_level", "bucket_resizes",
+            "e_cap", "bucket_cap", "hbucket_cap",
+        )
+    }
+    result["inv_per_s"] = round(int(mask_know.sum()) / max(burst_s, 1e-9), 1)
+    if graph.cross_words_per_level == 0 and ctx.n_hosts > 1:
+        result["violations"].append("zero cross-host exchange words")
+    if chain_dispatches == 0:
+        result["violations"].append("zero fused chain dispatches")
+    with open(
+        os.path.join(mh_dir, f"result_{phase}_h{ctx.process_id}.json"), "w"
+    ) as f:
+        json.dump(result, f)
+    ctx.shutdown()
+    return 0 if not result["violations"] else 1
+
+
+def _resize_leg(graph, src, dst, n, mask_know, result: dict) -> None:
+    """Steady-state overflow: flood one destination's slack past e_cap —
+    must resolve by counted in-place resize with the grown layout still
+    oracle-exact; a rebuild-grade failure is a gate violation."""
+    rng = np.random.default_rng(77)
+    k = graph.e_cap + 64
+    u = rng.integers(0, n - 1, size=k)
+    v = np.full(k, n - 1, dtype=np.int64)
+    ok = graph.patch_batch(np.empty(0, np.int64), u, v, np.zeros(k, np.int32))
+    if not ok:
+        result["violations"].append("steady-state patch fell to the rebuild rung")
+    if graph.bucket_resizes == 0:
+        result["violations"].append("overflow resolved without a counted resize")
+    adj_extra = numpy_bfs_mask(
+        np.concatenate([src, u.astype(np.int32)]),
+        np.concatenate([dst, v.astype(np.int32)]),
+        n,
+        [int(u[0])],
+    )
+    _c2, _ids2, over2 = graph.run_wave_collect([int(u[0])])
+    grown_mask = graph.invalid_mask()
+    want2 = mask_know | adj_extra
+    if over2 or not np.array_equal(grown_mask, want2):
+        result["violations"].append("post-resize wave diverged from oracle")
+    result["resize"] = {
+        "bucket_resizes": graph.bucket_resizes,
+        "detail": graph.stats()["resize_detail"],
+        "post_resize_oracle_exact": bool(np.array_equal(grown_mask, want2)),
+    }
+
+
+def save_mesh_shards_local(graph, path: str, save_fn) -> None:
+    """Per-host snapshot: only the shards THIS host's devices own (the
+    honest per-shard unit of the chaos ladder) — written atomically via
+    the checkpoint helper on a local-only export."""
+    snap = graph.export_shard_state(local_only=True)
+
+    class _Shim:
+        def export_shard_state(self):
+            return snap
+
+    save_fn(_Shim(), path)
+
+
+# ================================================================ orchestrator
+def _launch(phase: str, n_hosts: int, dph: int, mh_dir: str, extra_env: dict):
+    from stl_fusion_tpu.cluster.multihost import launch_hosts
+
+    env = dict(os.environ)
+    env.update(
+        MESH_MH_PHASE=phase,
+        MESH_MH_DIR=mh_dir,
+        **{k: str(v) for k, v in extra_env.items()},
+    )
+    return launch_hosts(
+        [sys.executable, os.path.abspath(__file__), "--worker"],
+        n_hosts=n_hosts,
+        devices_per_host=dph,
+        env=env,
+    )
+
+
+def _read_results(mh_dir: str, phase: str, n_hosts: int) -> list:
+    out = []
+    for h in range(n_hosts):
+        path = os.path.join(mh_dir, f"result_{phase}_h{h}.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                out.append(json.load(f))
+    return out
+
+
+def run_multihost(out: dict) -> None:
+    """The multihost record section + gates, merged into a mesh_path-style
+    ``out`` dict (``out["violations"]`` drives the exit code)."""
+    n_hosts = _env_int("MESH_MULTIHOST", 2)
+    dph = _env_int("MESH_MH_DPH", 2)
+    n = _env_int("MESH_MH_NODES", 40_000)
+    rounds = _env_int("MESH_MH_ROUNDS", 4)
+    timeout_s = _env_int("MESH_MH_TIMEOUT", 600)
+    members = [f"h{i}" for i in range(n_hosts)]
+    mh: dict = {"hosts": n_hosts, "devices_per_host": dph, "nodes": n}
+    out["multihost"] = mh
+    base_env = {
+        "MESH_MH_MEMBERS": ",".join(members),
+        "MESH_MH_NODES": n,
+        "MESH_MH_ROUNDS": rounds,
+    }
+
+    def _wait(procs, what: str) -> list:
+        rcs = []
+        deadline = time.time() + timeout_s
+        for p in procs:
+            try:
+                rcs.append(p.wait(timeout=max(deadline - time.time(), 1)))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs.append(-9)
+                out["violations"].append(f"{what}: host timed out")
+        return rcs
+
+    with tempfile.TemporaryDirectory(prefix="fusion-mh-") as mh_dir:
+        # ---- scale leg (oracle + resize + DCN) ----
+        if os.environ.get("MESH_MH_SCALE", "1") == "1":
+            log(f"multihost scale leg: {n_hosts} hosts x {dph} devices, {n} nodes")
+            t0 = time.time()
+            procs = _launch("scale", n_hosts, dph, mh_dir, base_env)
+            rcs = _wait(procs, "scale")
+            results = _read_results(mh_dir, "scale", n_hosts)
+            if len(results) < n_hosts or any(r != 0 for r in rcs):
+                out["violations"].append(
+                    f"scale leg: rcs={rcs}, results={len(results)}/{n_hosts}"
+                )
+            for r in results:
+                out["violations"].extend(
+                    f"scale h{r['host']}: {v}" for v in r.get("violations", [])
+                )
+            # key by the host id each worker wrote — _read_results skips
+            # missing files, so results[0] is not necessarily host 0
+            h0 = next((r for r in results if r.get("host") == 0), {})
+            mh["scale"] = {
+                "wall_s": round(time.time() - t0, 1),
+                "oracle_exact": h0.get("oracle_exact"),
+                "inv_per_s": h0.get("inv_per_s"),
+                "burst_s": h0.get("burst_s"),
+                "build_s": h0.get("build_s"),
+                "stats": h0.get("stats"),
+                "resize": h0.get("resize"),
+                "dcn": h0.get("dcn") or {},
+            }
+            dcn0 = h0.get("dcn") or {}
+            if not dcn0.get("dcn_fallback_relays"):
+                out["violations"].append("DCN fallback not exercised cross-process")
+            if not dcn0.get("client_observed_fence"):
+                out["violations"].append("DCN fence never reached the peer host")
+            if dcn0.get("mesh_member_relays"):
+                out["violations"].append(
+                    f"{dcn0['mesh_member_relays']} on-mesh member relay(s)"
+                )
+            # single-process routed oracle cross-check (the acceptance
+            # criterion: 2-process wave 0 == 1-process wave 0 == BFS)
+            if os.environ.get("MESH_MH_XCHECK", "1") == "1":
+                mh["scale"]["xcheck"] = _single_process_xcheck(mh_dir, n, out)
+
+        # ---- host-kill chaos leg ----
+        if os.environ.get("MESH_MH_CHAOS", "1") == "1" and n_hosts >= 2:
+            _chaos_leg(n_hosts, dph, mh_dir, base_env, members, rounds, out, mh, _wait)
+
+
+def _single_process_xcheck(mh_dir: str, n: int, out: dict) -> dict:
+    """Rebuild the same graph on THIS process's local device pool and
+    compare wave-0 masks bit-for-bit with the 2-process run."""
+    from stl_fusion_tpu.cluster import DevicePlacement, ShardMap
+    from stl_fusion_tpu.graph.synthetic import power_law_dag
+    from stl_fusion_tpu.parallel import RoutedShardedGraph, graph_mesh
+
+    mask_path = os.path.join(mh_dir, "wave_mask.npy")
+    if not os.path.exists(mask_path):
+        out["violations"].append("xcheck: worker exported no wave mask")
+        return {"ok": False}
+    packed = np.load(mask_path)
+    theirs = np.unpackbits(packed)[:n].astype(bool)
+    src, dst = power_law_dag(n, avg_degree=3.0, seed=7)
+    members = os.environ.get("MESH_MH_MEMBERS", "h0,h1").split(",")
+    smap = ShardMap.initial(members, n_shards=_env_int("MESH_MH_SHARDS", 64))
+    mesh = graph_mesh()
+    pl = DevicePlacement.build(smap, mesh.devices.size, n)
+    g = RoutedShardedGraph(src, dst, n, pl, mesh=mesh, exchange="a2a")
+    schedule = round_seeds(
+        123, n, _env_int("MESH_MH_ROUNDS", 4),
+        _env_int("MESH_MH_SEEDS_PER_ROUND", 4), _env_int("MESH_MH_STAGES", 2),
+    )
+    pending = g.dispatch_union_chain(schedule[0])
+    g.harvest_union_chain(pending)
+    for r in schedule[1:]:
+        g.harvest_union_chain(g.dispatch_union_chain(r))
+    mine = g.invalid_mask()
+    ok = bool(np.array_equal(mine, theirs))
+    if not ok:
+        out["violations"].append(
+            f"xcheck: multi-process mask != single-process routed oracle "
+            f"({int((mine != theirs).sum())} nodes)"
+        )
+    return {"ok": ok, "single_process_devices": int(mesh.devices.size)}
+
+
+def _chaos_leg(n_hosts, dph, mh_dir, base_env, members, rounds, out, mh, _wait):
+    log("multihost chaos leg: kill host 1 mid-burst, survivor serves, rejoin")
+    chaos_env = dict(
+        base_env,
+        MESH_MH_SNAPSHOT=1,
+        MESH_MH_ROUNDS=rounds,
+        MESH_MH_END_ROUND=max(rounds - 2, 1),
+        MESH_MH_ROUND_DEADLINE=45,
+    )
+    mid = max(rounds - 2, 1)
+    for f in ("peer-dead", "progress_h0", "progress_h1"):
+        path = os.path.join(mh_dir, f)
+        if os.path.exists(path):
+            os.unlink(path)
+    procs = _launch("main", n_hosts, dph, mh_dir, chaos_env)
+    # kill host 1 once it is genuinely mid-burst (≥1 round committed)
+    t_kill = None
+    deadline = time.time() + _env_int("MESH_MH_TIMEOUT", 600)
+    prog_file = os.path.join(mh_dir, "progress_h1")
+    while time.time() < deadline:
+        if os.path.exists(prog_file) and int(open(prog_file).read() or 0) >= 1:
+            procs[1].kill()
+            t_kill = time.time()
+            break
+        if procs[1].poll() is not None:
+            break
+        time.sleep(0.1)
+    if t_kill is None:
+        out["violations"].append("chaos: never reached the kill point")
+        for p in procs:
+            p.kill()
+        return
+    # flag the survivor (its watchdog exits even if wedged in a collective)
+    with open(os.path.join(mh_dir, "peer-dead"), "w") as f:
+        f.write("1")
+    _wait(procs, "chaos-main")
+    # last round BOTH hosts committed: the snapshots' consistent frontier.
+    # A host that died before its first progress write committed ROUND 0 —
+    # skipping its missing file would start the replay past its lost work
+    committed = min(
+        int(open(p).read() or 0) if os.path.exists(p) else 0
+        for p in (os.path.join(mh_dir, f"progress_h{h}") for h in range(n_hosts))
+    )
+    os.unlink(os.path.join(mh_dir, "peer-dead"))
+    # ---- survivor: host 0 alone, membership reassigns, snapshots restore
+    snaps = ",".join(os.path.join(mh_dir, f"snap_h{h}.npz") for h in range(n_hosts))
+    surv_env = dict(
+        base_env,
+        MESH_MH_MEMBERS=",".join(members),
+        MESH_MH_START_ROUND=committed,
+        MESH_MH_END_ROUND=max(rounds - 1, committed),
+        MESH_MH_RESTORE=snaps,
+        MESH_MH_ROUNDS=rounds,
+        MESH_MH_STAGE_ORACLE=0,  # restored state may run ahead of the replay
+    )
+    sprocs = _launch("survivor", 1, dph, mh_dir, surv_env)
+    _wait(sprocs, "survivor")
+    sres = _read_results(mh_dir, "survivor", 1)
+    recovery_s = None
+    if sres:
+        out["violations"].extend(
+            f"survivor: {v}" for v in sres[0].get("violations", [])
+        )
+        if sres[0].get("oracle_exact") and t_kill is not None:
+            recovery_s = round(sres[0]["serving_ts"] - t_kill, 2)
+    else:
+        out["violations"].append("survivor phase produced no result")
+    # ---- rejoin: both hosts back, warm start from the survivor snapshot
+    rejoin_env = dict(
+        base_env,
+        MESH_MH_START_ROUND=max(rounds - 1, committed),
+        MESH_MH_END_ROUND=rounds,
+        MESH_MH_RESTORE=os.path.join(mh_dir, "snap_survivor.npz"),
+        MESH_MH_ROUNDS=rounds,
+        MESH_MH_STAGE_ORACLE=0,
+    )
+    rprocs = _launch("rejoin", n_hosts, dph, mh_dir, rejoin_env)
+    _wait(rprocs, "rejoin")
+    rres = _read_results(mh_dir, "rejoin", n_hosts)
+    if len(rres) < n_hosts:
+        out["violations"].append("rejoin phase lost a host result")
+    for r in rres:
+        out["violations"].extend(
+            f"rejoin h{r['host']}: {v}" for v in r.get("violations", [])
+        )
+    mh["chaos"] = {
+        "killed_host": 1,
+        "committed_rounds_at_kill": committed,
+        "host_kill_recovery_s": recovery_s,
+        "survivor_oracle_exact": sres[0].get("oracle_exact") if sres else None,
+        "survivor_restored_shards": sres[0].get("restored_shards") if sres else None,
+        "rejoin_oracle_exact": all(r.get("oracle_exact") for r in rres) if rres else None,
+        "rejoin_restored_shards": [r.get("restored_shards") for r in rres],
+    }
+    if recovery_s is None:
+        out["violations"].append("chaos: no recovery time recorded")
+
+
+def main() -> None:
+    if "--worker" in sys.argv:
+        sys.exit(run_worker())
+    out: dict = {"violations": []}
+    run_multihost(out)
+    ok = not out["violations"]
+    out["ok"] = ok
+    print("# full record: " + json.dumps(out), file=sys.stderr, flush=True)
+    print(json.dumps(out, separators=(",", ":")))
+    if not ok:
+        log(f"GATE FAILURES: {out['violations']}")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -29,9 +29,21 @@ GATES (exit 1 — the tier1 mesh smoke rides them):
   an on-mesh member — the exact regression ISSUE 9 retires);
 - a reshard that moved zero device shards.
 
+3. **Multihost leg** (``MESH_MULTIHOST>=2`` — ISSUE 15): delegates to
+   perf/mesh_multihost.py — 2+ REAL OS-process hosts joined by
+   ``jax.distributed`` + gloo run the hierarchical exchange, with the
+   wave mask cross-checked against THIS process's single-process routed
+   oracle, a counted in-place bucket resize under live patching, a DCN
+   fence over a real TCP socket between the host processes, and the
+   host-kill → survivor → warm-rejoin chaos ladder. Its violations merge
+   into this script's gate (exit 1).
+
 Env: MESH_NODES, MESH_WAVES (2), MESH_SEEDS (100_000), MESH_EXCHANGE
-(a2a), MESH_LIVE_NODES (20_000), MESH_MEMBERS (4), MESH_SHARDS (256),
-MESH_LAT_SAMPLES (24), MESH_SKIP_STATIC=1 (smoke: live leg only).
+(a2a; the live leg rides it too — "hier" + MESH_HOSTS emulates the host
+axis in-process), MESH_HOSTS (1), MESH_LIVE_NODES (20_000), MESH_MEMBERS
+(4), MESH_SHARDS (256), MESH_LAT_SAMPLES (24), MESH_SKIP_STATIC=1
+(smoke: live leg only), MESH_SKIP_LIVE=1, MESH_MULTIHOST (0) + the
+MESH_MH_* knobs of perf/mesh_multihost.py.
 """
 import json
 import os
@@ -192,7 +204,12 @@ async def run_live(mesh, out: dict) -> None:
         backend.flush()
 
         smap = ShardMap.initial(members, n_shards=64)
-        backend.enable_mesh_routing(smap, mesh=mesh)
+        exchange = os.environ.get("MESH_EXCHANGE", "a2a")
+        n_hosts = int(os.environ.get("MESH_HOSTS", "1"))
+        backend.enable_mesh_routing(
+            smap, mesh=mesh, exchange=exchange,
+            devices_per_host=(mesh.devices.size // n_hosts) if n_hosts > 1 else None,
+        )
 
         adj = {}
         for u, v in zip(s2.tolist(), d2.tolist()):
@@ -366,7 +383,13 @@ def main() -> None:
     out: dict = {"mesh_devices": n_dev, "violations": []}
     if os.environ.get("MESH_SKIP_STATIC", "0") != "1":
         run_static(mesh, out)
-    asyncio.run(run_live(mesh, out))
+    if os.environ.get("MESH_SKIP_LIVE", "0") != "1":
+        asyncio.run(run_live(mesh, out))
+    if int(os.environ.get("MESH_MULTIHOST", "0")) >= 2:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from mesh_multihost import run_multihost
+
+        run_multihost(out)
     ok = not out["violations"]
     out["ok"] = ok
     print("# full record: " + json.dumps(out), file=sys.stderr, flush=True)

@@ -43,6 +43,7 @@ from .router import (
     install_cluster_client,
     install_cluster_guard,
 )
+from .multihost import MultiHostContext, init_multihost, launch_hosts, pick_coordinator
 from .shard_map import DEFAULT_SHARDS, ShardMap, ShardMovedError
 
 __all__ = [
@@ -50,7 +51,11 @@ __all__ = [
     "ClusterRebalancer",
     "DEFAULT_SHARDS",
     "DevicePlacement",
+    "MultiHostContext",
     "PlacementError",
+    "init_multihost",
+    "launch_hosts",
+    "pick_coordinator",
     "EPOCH_HEADER",
     "FAILOVER_HEADER",
     "RejoinReport",

@@ -69,6 +69,11 @@ class DevicePlacement:
     ids_per_shard: int = 0
     slot_rows: int = 0
     slots_per_dev: int = 0
+    #: the HOST axis (ISSUE 15): host h owns the contiguous device range
+    #: [h*devices_per_host, (h+1)*devices_per_host) — cluster/multihost.py
+    #: verifies this against the real process layout at bring-up. 0 means
+    #: single host (every device local), the pre-multihost default.
+    devices_per_host: int = 0
     #: shard → owning device (-1: owner member is off-mesh → DCN relay)
     shard_dev: np.ndarray = field(default_factory=lambda: np.empty(0, np.int32))
     #: shard → slot index on its device (-1 when off-mesh)
@@ -83,17 +88,26 @@ class DevicePlacement:
         n_nodes: int,
         mesh_members: Optional[Sequence[str]] = None,
         slot_headroom: float = 1.5,
+        devices_per_host: Optional[int] = None,
     ) -> "DevicePlacement":
         """Derive the placement for a map + mesh. ``mesh_members`` defaults
         to ALL members (single-host cluster: the whole map lives on this
         mesh). ``slot_headroom`` over-provisions per-device slots so a
-        reshard can first-fit moved shards without a rebuild."""
+        reshard can first-fit moved shards without a rebuild.
+        ``devices_per_host`` declares the host axis (default: all devices
+        one host) — the hierarchical exchange and host-aware reshard move
+        costs key off it."""
         members = tuple(mesh_members) if mesh_members is not None else shard_map.members
         if not members:
             raise PlacementError("placement needs at least one mesh member")
         if n_dev < len(members) or n_dev % len(members):
             raise PlacementError(
                 f"{n_dev} devices do not split evenly over {len(members)} mesh members"
+            )
+        dph = n_dev if not devices_per_host else int(devices_per_host)
+        if dph <= 0 or n_dev % dph:
+            raise PlacementError(
+                f"{n_dev} devices do not split into {devices_per_host}-device hosts"
             )
         V = shard_map.n_shards
         ids_per_shard = max(-(-n_nodes // V), 1)
@@ -107,6 +121,7 @@ class DevicePlacement:
             slot_rows=slot_rows,
             shard_dev=np.full(V, -1, np.int32),
             shard_slot=np.full(V, -1, np.int32),
+            devices_per_host=dph,
         )
         member_set = set(members)
         dpm = n_dev // len(members)
@@ -139,6 +154,24 @@ class DevicePlacement:
     @property
     def epoch(self) -> int:
         return self.shard_map.epoch
+
+    @property
+    def n_hosts(self) -> int:
+        dph = self.devices_per_host or self.n_dev
+        return self.n_dev // dph
+
+    def host_of_device(self, dev: int) -> int:
+        return int(dev) // (self.devices_per_host or self.n_dev)
+
+    def cross_host_moves(self, moves: Sequence[Tuple[int, int, int]]) -> int:
+        """How many of a :meth:`moved_to` move list's row-block transfers
+        cross a host boundary — the DCN leg of a reshard (the host-aware
+        candidate ranking exists to minimize this)."""
+        return sum(
+            1
+            for _s, old, new in moves
+            if old >= 0 and new >= 0 and self.host_of_device(old) != self.host_of_device(new)
+        )
 
     def shard_of_node(self, node_id: int) -> int:
         return int(node_id) // self.ids_per_shard
@@ -205,54 +238,84 @@ class DevicePlacement:
             shard_dev=self.shard_dev.copy(),
             shard_slot=self.shard_slot.copy(),
             moves=self.moves,
+            devices_per_host=self.devices_per_host,
         )
         member_set = set(members)
         dpm = self.n_dev // len(members)
+        dph = self.devices_per_host or self.n_dev
         member_devs = {m: range(i * dpm, (i + 1) * dpm) for i, m in enumerate(members)}
-        moved = ShardMap.diff(self.shard_map, new_map)
+        moved = sorted(ShardMap.diff(self.shard_map, new_map))
+        moved_set = set(moved)
         assignment = new_map.assignment
         # occupancy per device, from the carried slots
         used: Dict[int, set] = {d: set() for d in range(self.n_dev)}
         for s in range(new_map.n_shards):
-            if nxt.shard_dev[s] >= 0 and s not in moved:
+            if nxt.shard_dev[s] >= 0 and s not in moved_set:
                 used[int(nxt.shard_dev[s])].add(int(nxt.shard_slot[s]))
+
+        def ranked(owner: str, s: int, old_dev: int) -> List[int]:
+            """The new owner's devices in preference order: rendezvous
+            score descending, SAME-HOST candidates first when the shard
+            already has rows resident (ISSUE 15 satellite: a reshard must
+            not needlessly turn an intra-host slot reassignment into a
+            cross-host DCN transfer)."""
+            devs = sorted(
+                member_devs[owner], key=lambda d: _dev_score(owner, d, s), reverse=True
+            )
+            if old_dev < 0 or dph >= self.n_dev:
+                return devs
+            oh = old_dev // dph
+            return [d for d in devs if d // dph == oh] + [
+                d for d in devs if d // dph != oh
+            ]
+
         moves: List[Tuple[int, int, int]] = []
-        # pass 1: a moved shard whose NEW rendezvous device equals its old
-        # one keeps its slot outright — no row block moves, but its slot
-        # must be claimed before pass 2 first-fits genuinely moving shards
-        new_dev: Dict[int, int] = {}
+        # pass 1: a moved shard whose PREFERRED device equals its old one
+        # keeps its slot outright — no row block moves, but its slot must
+        # be claimed before pass 2 first-fits genuinely moving shards
+        cands: Dict[int, List[int]] = {}
         for s in moved:
             owner = assignment[s] if assignment else None
             if owner not in member_set:
-                new_dev[s] = -1
+                cands[s] = []
                 continue
-            dev = max(member_devs[owner], key=lambda d: _dev_score(owner, d, s))
-            new_dev[s] = dev
-            if dev == int(nxt.shard_dev[s]):
-                used[dev].add(int(nxt.shard_slot[s]))
+            cands[s] = ranked(owner, s, int(nxt.shard_dev[s]))
+            if cands[s][0] == int(nxt.shard_dev[s]):
+                used[cands[s][0]].add(int(nxt.shard_slot[s]))
         for s in moved:
             old_dev = int(nxt.shard_dev[s])
-            dev = new_dev[s]
-            if dev < 0:
+            devs = cands[s]
+            if not devs:
                 nxt.shard_dev[s] = -1
                 nxt.shard_slot[s] = -1
                 if old_dev >= 0:
                     moves.append((s, old_dev, -1))
                 continue
-            if dev == old_dev:
+            if devs[0] == old_dev:
                 continue  # ownership changed hands, the rows never move
-            slot = next(
-                (k for k in range(self.slots_per_dev) if k not in used[dev]), None
-            )
-            if slot is None:
-                raise PlacementError(
-                    f"device {dev} has no free slot for moved shard {s} "
-                    f"(slots_per_dev={self.slots_per_dev})"
+            # scan the ranked candidates for the first with a free slot
+            # (landing back on old_dev keeps the rows in place)
+            placed = False
+            for dev in devs:
+                if dev == old_dev and int(nxt.shard_slot[s]) not in used[dev]:
+                    used[dev].add(int(nxt.shard_slot[s]))
+                    placed = True
+                    break
+                slot = next(
+                    (k for k in range(self.slots_per_dev) if k not in used[dev]), None
                 )
-            used[dev].add(slot)
-            nxt.shard_dev[s] = dev
-            nxt.shard_slot[s] = slot
-            moves.append((s, old_dev, dev))
+                if slot is not None:
+                    used[dev].add(slot)
+                    nxt.shard_dev[s] = dev
+                    nxt.shard_slot[s] = slot
+                    moves.append((s, old_dev, dev))
+                    placed = True
+                    break
+            if not placed:
+                raise PlacementError(
+                    f"no free slot on any of member {assignment[s]!r}'s devices "
+                    f"for moved shard {s} (slots_per_dev={self.slots_per_dev})"
+                )
         nxt.moves = self.moves + len(moves)
         return nxt, moves
 
@@ -261,6 +324,8 @@ class DevicePlacement:
         return {
             "epoch": self.epoch,
             "n_dev": self.n_dev,
+            "hosts": self.n_hosts,
+            "devices_per_host": self.devices_per_host or self.n_dev,
             "mesh_members": list(self.mesh_members),
             "ids_per_shard": self.ids_per_shard,
             "slot_rows": self.slot_rows,

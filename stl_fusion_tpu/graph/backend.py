@@ -1760,6 +1760,7 @@ class TpuGraphBackend:
         mesh=None,
         mesh_members=None,
         exchange: str = "a2a",
+        devices_per_host: Optional[int] = None,
     ) -> None:
         """Pin the live graph's CSR shards onto mesh devices per the
         CLUSTER shard map (ISSUE 9 tentpole): each member's shard-map
@@ -1770,13 +1771,18 @@ class TpuGraphBackend:
         and re-entering through per-key RPC. ``mesh_members`` names the
         members co-located on THIS mesh (default: all map members — the
         single-host cluster); shards owned by off-mesh members stay on the
-        DCN relay path (rpc/fanout.py counts it). The mirror itself builds
-        lazily on first routed wave."""
+        DCN relay path (rpc/fanout.py counts it). ``devices_per_host``
+        declares the placement's host axis (ISSUE 15) — with
+        ``exchange="hier"`` each BFS level then resolves as an intra-host
+        collective plus an inter-host exchange of the reduced per-host
+        frontier words, inside the same fused chain the super-rounds ride.
+        The mirror itself builds lazily on first routed wave."""
         self._routed_config = {
             "shard_map": shard_map,
             "mesh": mesh,
             "mesh_members": tuple(mesh_members) if mesh_members is not None else None,
             "exchange": exchange,
+            "devices_per_host": devices_per_host,
         }
         self._routed_mirror = None  # rebuild under the new config
 
@@ -1825,7 +1831,10 @@ class TpuGraphBackend:
         n_dev = mesh.devices.size if mesh is not None else len(_jax.devices())
         smap = cfg["shard_map"]
         members = cfg["mesh_members"] or smap.members
-        placement = DevicePlacement.build(smap, n_dev, dg.n_nodes, mesh_members=members)
+        placement = DevicePlacement.build(
+            smap, n_dev, dg.n_nodes, mesh_members=members,
+            devices_per_host=cfg.get("devices_per_host"),
+        )
         m = dg.n_edges
         graph = RoutedShardedGraph(
             dg._h_edge_src[:m].copy(),

@@ -1,4 +1,4 @@
-"""Cluster-routed CSR shards with collective frontier exchange (ISSUE 9).
+"""Cluster-routed CSR shards with collective frontier exchange (ISSUE 9 + 15).
 
 The unification of the cluster control plane with the mesh path: node rows
 live on the device that owns their cluster shard (:class:`~..cluster.
@@ -6,17 +6,31 @@ placement.DevicePlacement` — the shard map's device half), edges shard by
 DESTINATION owner device, and each BFS level exchanges the invalidation
 frontier with mesh collectives instead of surfacing to the host:
 
-- ``exchange="a2a"`` (default, the routed protocol): each device bit-packs
-  its newly-lit frontier into uint32 words and sends each consumer device
+- ``exchange="a2a"`` (single-host default): each device bit-packs its
+  newly-lit frontier into uint32 words and sends each consumer device
   ONLY the words that consumer's edges actually reference — static
   per-(producer, consumer) word buckets delivered by one ``lax.all_to_all``
   per level. Exchange volume is O(cut words), not O(n): a frontier bit
   travels only to device shards whose edges need it (the "cluster-routed"
   step PAPER.md's collectives thesis asks for).
+- ``exchange="hier"`` (ISSUE 15, the multi-host protocol): each level
+  resolves in TWO stages over a 2-D ``(host, ldev)`` mesh — an intra-host
+  packed-word a2a over the local device group (the ICI leg: same bucket
+  routing as ``a2a``, restricted to same-host pairs), then an inter-host
+  exchange of the REDUCED per-host frontier words: every device gathers
+  its owned words of the per-(producer-host, consumer-host) buckets, the
+  host group OR-assembles them in log2(dph) ``ppermute`` rounds, and the
+  assembled host payloads travel a recursive-doubling ``ppermute`` tree
+  across hosts (log2(n_hosts) rounds — the Tascade reduction-tree shape,
+  PAPERS.md #1). Only bucket words cross the host boundary (the DCN leg),
+  and the whole two-stage exchange stays INSIDE the fused wave/chain scan
+  — super-rounds ride it with zero host-relay hops. Under
+  ``jax.distributed`` (cluster/multihost.py) the host axis spans REAL OS
+  processes and the inter-host ppermute moves bytes between them.
 - ``exchange="tree"``: the full packed frontier replicates through a
-  log2(n_dev)-round recursive-doubling ``ppermute`` reduction tree — the
-  Tascade-style merge (PAPERS.md), each round OR-combining block pairs at
-  doubling distance; the explicit-tree alternative to ``lax.all_gather``.
+  log2(n_dev)-round recursive-doubling ``ppermute`` reduction tree,
+  each round OR-combining block pairs at doubling distance; the
+  explicit-tree alternative to ``lax.all_gather``.
 - ``exchange="gather"``: plain ``lax.all_gather`` of packed words — the
   reference for equivalence tests.
 
@@ -34,12 +48,25 @@ the fused dispatch instead of re-entering through per-key host RPC.
 
 A live reshard MOVES a device shard (:meth:`apply_placement`): the moved
 shard's fixed-width row block transfers on-device to its new owner's free
-slot, the two affected consumer devices' edge slices + exchange buckets
+slot, the affected consumer devices' edge slices + exchange buckets
 re-pack host-side, and everything else stays resident. Structural churn
 patches route by owner (:meth:`patch_batch` — bumps scatter absolute
 epochs, adds splice into per-device slack slots) and apply in ONE fused
-dispatch per batch (ISSUE 9 satellite: per-patch dispatch overhead, not
-per-edge cost, dominated BENCH_r05's mirror_patch_ms).
+dispatch per batch.
+
+**Dynamic bucket growth (ISSUE 15).** Edge routing is CAP-INDEPENDENT:
+per-edge arrays carry ``(eprod, ebslot)`` — the producer family and the
+slot WITHIN its bucket — and the kernel computes the flat exchange index
+from the (trace-time) bucket capacities. An overflowed exchange bucket,
+host bucket, or edge-slack slot therefore GROWS IN PLACE: the host-side
+table re-allocates with the new capacity, re-uploads, and the next
+dispatch recompiles against the new shape — no consumer's slot
+assignments change (slots are append-only between rebuilds). Every grow
+counts in ``fusion_mesh_bucket_resizes_total``; a graph that exhausts its
+``max_resizes`` budget reports the overflow exactly like the old code
+(``False`` / :class:`PlacementError`) and the caller takes the REBUILD
+rung — the last rung of the counted ladder
+(resize → resize-exhausted → rebuild), never a silent fallback.
 """
 from __future__ import annotations
 
@@ -52,20 +79,39 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..cluster.placement import DevicePlacement, PlacementError
+from ..diagnostics.metrics import global_metrics
 from .mesh import GRAPH_AXIS, graph_mesh, shard_map_compat
 
 __all__ = ["RoutedShardedGraph", "build_routed_wave"]
 
-_EXCHANGES = ("a2a", "tree", "gather")
+_EXCHANGES = ("a2a", "tree", "gather", "hier")
+HOST_AXIS = "host"
+LDEV_AXIS = "ldev"
+
+
+def _flat_spec(mesh: Mesh) -> P:
+    """The node/edge partition spec for a routed mesh: 1-D graph axis, or
+    the flattened (host, ldev) product for the hierarchical exchange."""
+    names = mesh.axis_names
+    return P(names[0]) if len(names) == 1 else P(tuple(names))
+
+
+def _psum_axes(mesh: Mesh):
+    names = mesh.axis_names
+    return names[0] if len(names) == 1 else tuple(names)
 
 
 def build_routed_wave(mesh: Mesh, n_global: int, n_dev: int, exchange: str):
     """Compile the routed union wave for a mesh + geometry. Returns
-    ``wave(frontier, send_idx, eslot, ebit, edst, eepoch, nepoch, invalid)
-    -> (invalid', count, levels)`` — all arrays GRAPH_AXIS-sharded; seeds
-    conduct even when already invalid (the r4 union rule); ``levels`` is
-    the number of frontier exchanges the wave ran (the collective-rounds
-    telemetry ``fusion_mesh_exchange_levels`` aggregates)."""
+    ``wave(frontier, send_idx, hsend_idx, eprod, ebslot, ebit, edst,
+    eepoch, nepoch, invalid) -> (invalid', count, levels)`` — all arrays
+    sharded over the mesh's flat device axis; seeds conduct even when
+    already invalid (the r4 union rule); ``levels`` is the number of
+    frontier exchanges the wave ran (the collective-rounds telemetry
+    ``fusion_mesh_exchange_levels`` aggregates). For ``exchange="hier"``
+    the mesh must be the 2-D ``(host, ldev)`` mesh; bucket capacities are
+    read from the (trace-time) table shapes, which is what lets an
+    in-place bucket resize recompile instead of re-pack."""
     if exchange not in _EXCHANGES:
         raise ValueError(f"unknown exchange {exchange!r}")
     n_local = n_global // n_dev
@@ -73,10 +119,17 @@ def build_routed_wave(mesh: Mesh, n_global: int, n_dev: int, exchange: str):
     w_local = n_local // 32
     if exchange == "tree" and (n_dev & (n_dev - 1)):
         raise ValueError("tree exchange needs a power-of-two device count")
+    if exchange == "hier":
+        n_hosts, dph = mesh.devices.shape
+        assert n_hosts * dph == n_dev
+    else:
+        n_hosts, dph = 1, n_dev
 
-    node_spec = P(GRAPH_AXIS)
-    edge_spec = P(GRAPH_AXIS)
-    send_spec = P(GRAPH_AXIS, None)
+    spec = _flat_spec(mesh)
+    ax = _psum_axes(mesh)
+    node_spec = spec
+    edge_spec = spec
+    send_spec = P(*(spec + (None,)))
 
     def _pack_words(f_l):
         lanes = jnp.arange(32, dtype=jnp.uint32)[None, :]
@@ -84,62 +137,126 @@ def build_routed_wave(mesh: Mesh, n_global: int, n_dev: int, exchange: str):
             f_l.reshape(-1, 32).astype(jnp.uint32) << lanes, axis=1, dtype=jnp.uint32
         )
 
-    def _exchange_words(f_l, send_idx_l):
-        """One frontier exchange: local packed words → the flat word vector
-        the per-edge ``eslot`` indexes into (layout differs per mode)."""
+    def _exchange_words(f_l, send_idx_l, hsend_idx_l):
+        """One frontier exchange: local packed words → (intra_flat,
+        cross_flat) word vectors the per-edge (eprod, ebslot) routing
+        indexes into (layout differs per mode; cross_flat exists only for
+        hier)."""
         words = _pack_words(f_l)
         if exchange == "gather":
-            return lax.all_gather(words, GRAPH_AXIS, tiled=True)
+            return lax.all_gather(words, ax, tiled=True), None
         if exchange == "a2a":
             words_p = jnp.concatenate([words, jnp.zeros(1, jnp.uint32)])  # pad word
-            send = words_p[send_idx_l]  # [n_dev, cap] — bucket per consumer
+            send = words_p[send_idx_l]  # [n_dev, icap] — bucket per consumer
             recv = lax.all_to_all(
-                send, GRAPH_AXIS, split_axis=0, concat_axis=0, tiled=True
+                send, ax, split_axis=0, concat_axis=0, tiled=True
             )
-            return recv.reshape(-1)  # row p = words from producer p
-        # tree: recursive-doubling ppermute — log2(n_dev) OR-merge rounds
-        acc = words
-        idx = lax.axis_index(GRAPH_AXIS)
+            return recv.reshape(-1), None  # row p = words from producer p
+        if exchange == "tree":
+            # recursive-doubling ppermute — log2(n_dev) OR-merge rounds
+            acc = words
+            idx = lax.axis_index(ax)
+            step = 1
+            while step < n_dev:
+                perm = [(i, i ^ step) for i in range(n_dev)]
+                recv = lax.ppermute(acc, ax, perm)
+                low = (idx & step) == 0  # my block sits in the lower half
+                acc = jnp.where(
+                    low,
+                    jnp.concatenate([acc, recv]),
+                    jnp.concatenate([recv, acc]),
+                )
+                step *= 2
+            return acc, None  # full packed frontier, device order
+        # hier — ISSUE 15: two stages, intra-host then inter-host
+        words_p = jnp.concatenate([words, jnp.zeros(1, jnp.uint32)])
+        # stage 1: intra-host packed-word a2a over the local device group
+        # (same bucket protocol as a2a, subgroup = this host's devices;
+        # nothing crosses the host boundary here)
+        send = words_p[send_idx_l]  # [dph, icap]
+        intra = lax.all_to_all(
+            send, LDEV_AXIS, split_axis=0, concat_axis=0, tiled=True
+        ).reshape(-1)  # row p_l = words from local producer p_l
+        # stage 2a: host-bucket contribution gather + intra-host OR
+        # assembly — each device owns a disjoint word range, so OR over
+        # the host group assembles the host's complete outgoing buckets
+        contrib = words_p[hsend_idx_l]  # [n_hosts(G), hcap]
         step = 1
-        while step < n_dev:
-            perm = [(i, i ^ step) for i in range(n_dev)]
-            recv = lax.ppermute(acc, GRAPH_AXIS, perm)
-            low = (idx & step) == 0  # my block sits in the lower half
+        while step < dph:
+            perm = [(i, i ^ step) for i in range(dph)]
+            contrib = contrib | lax.ppermute(contrib, LDEV_AXIS, perm)
+            step *= 2
+        # stage 2b: recursive-doubling ppermute TREE across hosts (the
+        # Tascade reduction-tree shape) shipping the reduced per-host
+        # frontier BUCKETS — only bucket payloads cross the host boundary
+        # (never full frontiers), though each tree round re-ships the
+        # accumulated blocks, so wire cost ~ n_hosts x bucket capacity
+        acc = contrib[None]  # [1, n_hosts(G), hcap] — my host's payload
+        h = lax.axis_index(HOST_AXIS)
+        hstep = 1
+        while hstep < n_hosts:
+            perm = [(i, i ^ hstep) for i in range(n_hosts)]
+            recv = lax.ppermute(acc, HOST_AXIS, perm)
+            low = (h & hstep) == 0
             acc = jnp.where(
                 low,
                 jnp.concatenate([acc, recv]),
                 jnp.concatenate([recv, acc]),
             )
-            step *= 2
-        return acc  # full packed frontier, device order
+            hstep *= 2
+        return intra, acc.reshape(-1)  # [n_hosts(H) * n_hosts(G) * hcap]
+
+    def _lookup(intra_flat, cross_flat, send_idx_l, hsend_idx_l, eprod_l, ebslot_l):
+        """Per-edge source word via the cap-independent (eprod, ebslot)
+        routing. Capacities come from trace-time table shapes — the hook
+        dynamic bucket growth hangs off."""
+        if exchange in ("tree", "gather"):
+            return intra_flat[ebslot_l]
+        if exchange == "a2a":
+            icap = send_idx_l.shape[-1]
+            return intra_flat[eprod_l * icap + ebslot_l]
+        # hier: intra edges read the subgroup-a2a rows; cross edges read
+        # the (producer host, consumer host) bucket of the host tree
+        icap = send_idx_l.shape[-1]
+        hcap = hsend_idx_l.shape[-1]
+        g = lax.axis_index(HOST_AXIS)
+        is_cross = eprod_l >= n_dev
+        idx_i = (eprod_l % dph) * icap + ebslot_l
+        idx_c = ((eprod_l - n_dev) * n_hosts + g) * hcap + ebslot_l
+        w_i = intra_flat[jnp.where(is_cross, 0, idx_i)]
+        w_c = cross_flat[jnp.where(is_cross, idx_c, 0)]
+        return jnp.where(is_cross, w_c, w_i)
 
     @shard_map_compat(
         mesh=mesh,
         in_specs=(
-            node_spec, send_spec, edge_spec, edge_spec, edge_spec, edge_spec,
-            node_spec, node_spec,
+            node_spec, send_spec, send_spec, edge_spec, edge_spec, edge_spec,
+            edge_spec, edge_spec, node_spec, node_spec,
         ),
         out_specs=(node_spec, P(), P()),
     )
-    def _wave(seeds_l, send_idx_l, eslot_l, ebit_l, edst_l, eepoch_l, nepoch_l, inv_l):
+    def _wave(seeds_l, send_idx_l, hsend_idx_l, eprod_l, ebslot_l, ebit_l,
+              edst_l, eepoch_l, nepoch_l, inv_l):
         fresh = seeds_l & ~inv_l
         inv_l = inv_l | seeds_l
-        count0 = lax.psum(fresh.sum(dtype=jnp.int32), GRAPH_AXIS)
-        go0 = lax.psum(seeds_l.any().astype(jnp.int32), GRAPH_AXIS) > 0
+        count0 = lax.psum(fresh.sum(dtype=jnp.int32), ax)
+        go0 = lax.psum(seeds_l.any().astype(jnp.int32), ax) > 0
 
         def cond(carry):
             return carry[4]
 
         def body(carry):
             f_l, inv_l, count, levels, _go = carry
-            flat = _exchange_words(f_l, send_idx_l)
-            word = flat[eslot_l]
+            intra_flat, cross_flat = _exchange_words(f_l, send_idx_l, hsend_idx_l)
+            word = _lookup(
+                intra_flat, cross_flat, send_idx_l, hsend_idx_l, eprod_l, ebslot_l
+            )
             src_active = ((word >> ebit_l.astype(jnp.uint32)) & 1).astype(bool)
             ver_ok = nepoch_l[edst_l] == eepoch_l  # gather clamps; -1 never matches
             fire = src_active & ver_ok & ~inv_l[edst_l]
             nxt_l = jnp.zeros_like(f_l).at[edst_l].max(fire)  # OOB pads dropped
             inv_l = inv_l | nxt_l
-            newly = lax.psum(nxt_l.sum(dtype=jnp.int32), GRAPH_AXIS)
+            newly = lax.psum(nxt_l.sum(dtype=jnp.int32), ax)
             return nxt_l, inv_l, count + newly, levels + 1, newly > 0
 
         _f, inv_l, count, levels, _go = lax.while_loop(
@@ -160,18 +277,26 @@ def build_routed_compact(mesh: Mesh, n_global: int, n_dev: int, capd: int):
     ``bufs[d*capd : d*capd + counts[d]]``; ``counts[d] > capd`` = that
     device overflowed (caller mask-diffs)."""
     n_local = n_global // n_dev
-    node_spec = P(GRAPH_AXIS)
+    spec = _flat_spec(mesh)
+    names = mesh.axis_names
+    if len(names) == 1:
+        dev_index = lambda: lax.axis_index(names[0])  # noqa: E731
+    else:
+        dph = mesh.devices.shape[1]
+        dev_index = lambda: (  # noqa: E731
+            lax.axis_index(names[0]) * dph + lax.axis_index(names[1])
+        )
 
     @shard_map_compat(
         mesh=mesh,
-        in_specs=(node_spec, node_spec, node_spec),
-        out_specs=(P(GRAPH_AXIS), P(GRAPH_AXIS)),
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, spec),
     )
     def _compact(inv2_l, inv_l, real_l):
         newly_l = inv2_l & ~inv_l & real_l
         count = newly_l.sum(dtype=jnp.int32)
         pos = jnp.cumsum(newly_l.astype(jnp.int32)) - 1
-        base = (lax.axis_index(GRAPH_AXIS) * n_local).astype(jnp.int32)
+        base = (dev_index() * n_local).astype(jnp.int32)
         rows = base + jnp.arange(n_local, dtype=jnp.int32)
         scatter_pos = jnp.where(newly_l & (pos < capd), pos, capd)
         buf = jnp.full(capd, -1, jnp.int32).at[scatter_pos].set(rows, mode="drop")
@@ -196,17 +321,32 @@ class RoutedShardedGraph:
         invalid: Optional[np.ndarray] = None,
         bucket_headroom: float = 1.3,
         edge_headroom: float = 1.3,
+        max_resizes: int = 8,
+        resize_growth: float = 1.5,
     ):
-        self.mesh = mesh or graph_mesh()
-        if self.mesh.devices.size != placement.n_dev:
+        base_mesh = mesh or graph_mesh()
+        if base_mesh.devices.size != placement.n_dev:
             raise PlacementError(
                 f"placement spans {placement.n_dev} devices, mesh has "
-                f"{self.mesh.devices.size}"
+                f"{base_mesh.devices.size}"
             )
         if exchange not in _EXCHANGES:
             raise ValueError(f"unknown exchange {exchange!r}")
         if exchange == "tree" and (placement.n_dev & (placement.n_dev - 1)):
             exchange = "gather"  # tree needs 2^k devices; honest fallback
+        self.dph = placement.devices_per_host or placement.n_dev
+        self.n_hosts = placement.n_dev // self.dph
+        if exchange == "hier" and (
+            (self.dph & (self.dph - 1)) or (self.n_hosts & (self.n_hosts - 1))
+        ):
+            exchange = "gather"  # hier's xor trees need 2^k hosts AND dph
+        if exchange == "hier":
+            devs = np.asarray(base_mesh.devices).reshape(-1)
+            self.mesh = Mesh(
+                devs.reshape(self.n_hosts, self.dph), (HOST_AXIS, LDEV_AXIS)
+            )
+        else:
+            self.mesh = base_mesh
         self.placement = placement
         self.exchange = exchange
         self.n_nodes = n_nodes
@@ -217,12 +357,23 @@ class RoutedShardedGraph:
         #: set when a failed in-place reshard left device/host layout
         #: inconsistent — every wave entry point then refuses (rebuild)
         self.broken = False
+        #: in-place capacity growth budget: once spent, an overflow falls
+        #: to the REBUILD rung of the ladder exactly like the pre-resize
+        #: code (counted, never silent)
+        self.max_resizes = max_resizes
+        self.resize_growth = resize_growth
+        self.bucket_resizes = 0
+        self.resize_detail = {"bucket": 0, "hbucket": 0, "edge": 0}
         # -- telemetry --
         self.waves_run = 0
         self.levels_total = 0  # frontier exchanges (collective rounds)
         self.shard_moves = 0
+        self.cross_host_moves = 0
         self.patches = 0
         self.patch_dispatches = 0
+        self.cross_host_words = 0  # cumulative words shipped across hosts
+        self.cross_words_per_level = 0  # static per-exchange-level payload
+        self._procs = jax.process_count()
 
         # int32 host truth: node ids always fit (n_global is int32-bound),
         # and at 240M edges the int64 sorted copies alone were ~5 GB
@@ -255,9 +406,12 @@ class RoutedShardedGraph:
                 dev_edges[d] += len(es)
         self.e_cap = max(int(dev_edges.max() * edge_headroom) + 32, 64)
         self.bucket_headroom = bucket_headroom
-        self._node_sh = NamedSharding(self.mesh, P(GRAPH_AXIS))
-        self._edge_sh = NamedSharding(self.mesh, P(GRAPH_AXIS))
-        self._send_sh = NamedSharding(self.mesh, P(GRAPH_AXIS, None))
+        spec = _flat_spec(self.mesh)
+        self._node_sh = NamedSharding(self.mesh, spec)
+        self._edge_sh = NamedSharding(self.mesh, spec)
+        self._send_sh = NamedSharding(self.mesh, P(*(spec + (None,))))
+        self._rep_sh = NamedSharding(self.mesh, P())
+        self._replicator = None  # lazy jit identity → replicated (multihost fetch)
 
         perm, inv_perm = placement.permutation()
         self.perm, self.inv_perm = perm, inv_perm
@@ -280,9 +434,9 @@ class RoutedShardedGraph:
         self._h_is_real[self._real_rows] = True
 
         self._build_exchange_and_edges()
-        self.g_node_epoch = jax.device_put(nep, self._node_sh)
-        self.g_invalid = jax.device_put(inv0, self._node_sh)
-        self.g_is_real = jax.device_put(self._h_is_real, self._node_sh)
+        self.g_node_epoch = self._put(nep, self._node_sh)
+        self.g_invalid = self._put(inv0, self._node_sh)
+        self.g_is_real = self._put(self._h_is_real, self._node_sh)
         self._wave = build_routed_wave(
             self.mesh, self.n_global, self.n_dev, self.exchange
         )
@@ -290,14 +444,76 @@ class RoutedShardedGraph:
         self._chain_cache: dict = {}
         self._patch_cache: dict = {}
         self._move_cache: dict = {}
+        if self.n_hosts > 1:
+            g = global_metrics().gauge(
+                "fusion_mesh_hosts",
+                help="host processes joined into the global device mesh",
+            )
+            g.set(self.n_hosts)
+            global_metrics().set_aggregation("fusion_mesh_hosts", "max")
+
+    # ---------------------------------------------------------------- helpers
+    def _host_of_dev(self, d) -> np.ndarray:
+        return np.asarray(d) // self.dph
+
+    def _put(self, a: np.ndarray, sharding):
+        """Host array → global device array. Multi-process: via
+        ``make_array_from_callback`` — each process materializes ONLY its
+        addressable shards from the (identical, SPMD-contract) host
+        truth, so an upload NEVER touches the wire. A cross-process
+        ``device_put`` lowers to an SPMD program whose collectives can
+        interleave with an in-flight compute module's on the shared gloo
+        pairs (chunked large messages mispair → transport abort; found
+        at the 5M build, nondeterministic). Single-process: plain
+        device_put, unchanged."""
+        if self._procs == 1:
+            return jax.device_put(a, sharding)
+        a = np.asarray(a)
+        return jax.make_array_from_callback(a.shape, sharding, lambda idx: a[idx])
+
+    def _host_arg(self, a: np.ndarray):
+        """A host index/seed array as a jit argument: replicated global
+        array under multi-process (every host passes identical data —
+        the SPMD contract), plain local array otherwise."""
+        if self._procs == 1:
+            return jnp.asarray(a)
+        return self._put(np.asarray(a), self._rep_sh)
+
+    def _sync(self, *arrays) -> None:
+        """Multi-process collective-module serialization: block until the
+        dispatched module's outputs are ready before dispatching the NEXT
+        module that carries collectives. Two concurrently-executing
+        modules reuse XLA channel ids on the gloo CPU transport and their
+        chunked messages mispair (the same abort class the _put docstring
+        names) — on the real accelerator fabric this is a no-op concern,
+        so single-process keeps the async dispatch overlap."""
+        if self._procs > 1:
+            jax.block_until_ready(arrays)
+
+    def _fetch(self, x) -> np.ndarray:
+        """A device array's FULL value on every host. Single-process:
+        plain device_get. Multi-process: one jitted replication (an
+        all-gather over the mesh) then read the local copy — a global
+        array spans non-addressable devices and cannot be fetched
+        directly."""
+        if self._procs == 1:
+            return np.asarray(jax.device_get(x))
+        if self._replicator is None:
+            self._replicator = jax.jit(lambda a: a, out_shardings=self._rep_sh)
+        rep = self._replicator(x)
+        out = np.asarray(rep.addressable_shards[0].data)
+        self._sync(rep)
+        return out
 
     # ------------------------------------------------------------------ build
-    def _consumer_pack(self, d: int):
-        """Pack consumer device ``d``'s edge slice + its word buckets from
-        the host per-shard edge lists. Returns (eslot, ebit, edst, eep,
-        buckets) where buckets[p] = local word indices producer p sends d.
-        ``eslot`` uses the exchange's layout (a2a: p*cap+j; tree/gather:
-        global word id)."""
+    def _consumer_pack(self, d: int) -> dict:
+        """Pack consumer device ``d``'s edge slice (UNPADDED) + its word
+        buckets from the host per-shard edge lists. Intra buckets cover
+        every producer for ``a2a`` and same-host producers for ``hier``;
+        hier's cross-host edges come back as (producer host, global word)
+        pairs — their ``ebslot`` is assigned against the shared host
+        buckets by the caller (build: vectorized union; repack/patch:
+        append-only against the live tables)."""
         pl = self.placement
         srcs: List[np.ndarray] = []
         dsts: List[np.ndarray] = []
@@ -318,53 +534,114 @@ class RoutedShardedGraph:
         else:
             src = dst = np.empty(0, np.int64)
             ep = np.empty(0, np.int32)
-        if len(src) > self.e_cap:
-            raise PlacementError(
-                f"device {d} edge slice {len(src)} exceeds capacity {self.e_cap}"
-            )
         src_rows = self.perm[src] if len(src) else src
         dst_rows = self.perm[dst] if len(dst) else dst
         if len(src) and (src_rows.min() < 0 or dst_rows.min() < 0):
             raise PlacementError("edge endpoints land on off-mesh shards")
+        n_e = len(src)
         words = src_rows >> 5
+        eprod = np.zeros(n_e, dtype=np.int32)
+        ebslot = np.zeros(n_e, dtype=np.int32)
+        ebit = (src_rows & 31).astype(np.int32) if n_e else np.empty(0, np.int32)
+        edst = (
+            (dst_rows - d * self.n_local).astype(np.int32)
+            if n_e
+            else np.empty(0, np.int32)
+        )
         buckets: Dict[int, np.ndarray] = {}
-        eslot = np.zeros(self.e_cap, dtype=np.int32)
-        ebit = np.zeros(self.e_cap, dtype=np.int32)
-        edst = np.full(self.e_cap, self.n_local, dtype=np.int32)  # pad: dropped
-        eep = np.full(self.e_cap, -1, dtype=np.int32)  # pad: never matches
-        if self.exchange == "a2a":
+        cross = None
+        if self.exchange in ("tree", "gather"):
+            if n_e:
+                ebslot[:] = words.astype(np.int32)
+        else:
             prod = (src_rows // self.n_local).astype(np.int64)
-            slots = np.empty(len(src), dtype=np.int64)
+            my_host = d // self.dph
+            if self.exchange == "hier":
+                intra_sel = self._host_of_dev(prod) == my_host if n_e else np.empty(0, bool)
+            else:
+                intra_sel = np.ones(n_e, dtype=bool)
             for p in range(self.n_dev):
-                sel = prod == p
+                if self.exchange == "hier" and p // self.dph != my_host:
+                    continue
+                sel = intra_sel & (prod == p)
                 if not sel.any():
                     buckets[p] = np.empty(0, np.int64)
                     continue
                 wl = words[sel] - p * self.w_local
                 uniq = np.unique(wl)
                 buckets[p] = uniq
-                slots[sel] = np.searchsorted(uniq, wl)
-            # sorted build-time buckets: slot lookup at patch time is a
-            # searchsorted, never a V×words Python dict (100M-node scale)
-            self._buckets[d] = buckets
-            self._patch_slots[d] = {}
-            self._bucket_fill[d] = {p: len(b) for p, b in buckets.items()}
-            if len(src):
-                # final eslot needs bucket_cap (p*cap + j) — filled by the
-                # caller once the global cap is known; stash raw (p, j)
-                eslot_raw = (prod, slots)
-            else:
-                eslot_raw = (np.empty(0, np.int64), np.empty(0, np.int64))
-        else:
-            eslot_raw = None
-            if len(src):
-                eslot[: len(src)] = words.astype(np.int32)
-        if len(src):
-            ebit[: len(src)] = (src_rows & 31).astype(np.int32)
-            edst[: len(src)] = (dst_rows - d * self.n_local).astype(np.int32)
-            eep[: len(src)] = ep
-        self._dev_edge_count[d] = len(src)
-        return eslot, ebit, edst, eep, buckets, eslot_raw, len(src)
+                eprod[sel] = p
+                ebslot[sel] = np.searchsorted(uniq, wl)
+            if self.exchange == "hier":
+                csel = ~intra_sel
+                if csel.any():
+                    ch = self._host_of_dev(prod[csel]).astype(np.int64)
+                    eprod[csel] = (self.n_dev + ch).astype(np.int32)
+                    cross = (ch, words[csel], np.flatnonzero(csel))
+        return {
+            "n_e": n_e,
+            "eprod": eprod,
+            "ebslot": ebslot,
+            "ebit": ebit,
+            "edst": edst,
+            "eep": ep,
+            "buckets": buckets,
+            "cross": cross,
+        }
+
+    def _register_pack_buckets(self, d: int, pack: dict) -> None:
+        """Adopt a pack's build-time intra buckets as device ``d``'s live
+        bucket truth (sorted build-time buckets: slot lookup at patch time
+        is a searchsorted, never a V×words Python dict at 100M-node
+        scale); patch-added slots restart empty."""
+        self._buckets[d] = pack["buckets"]
+        self._patch_slots[d] = {}
+        self._bucket_fill[d] = {p: len(b) for p, b in pack["buckets"].items()}
+        self._dev_edge_count[d] = pack["n_e"]
+
+    def _assign_cross_slots(self, d: int, pack: dict, append: bool) -> int:
+        """Resolve a pack's cross-host edges to host-bucket slots. With
+        ``append=True`` (repack after a reshard) new words APPEND to the
+        live buckets — existing consumers' slots never shift, which is
+        what makes a re-pack touch only the affected consumer's slices.
+        Returns the peak fill the assignment needed (the caller grows
+        ``hbucket_cap`` when it exceeds it)."""
+        peak = 0
+        if pack["cross"] is None:
+            return peak
+        g = d // self.dph
+        ch, cw, pos = pack["cross"]
+        for h in np.unique(ch).tolist():
+            key = (int(h), g)
+            sel = ch == h
+            wsel = cw[sel]
+            hb = self._hbuckets.setdefault(key, np.empty(0, np.int64))
+            pslots = self._hpatch_slots.setdefault(key, {})
+            fill = self._hbucket_fill.get(key, len(hb))
+            base = np.searchsorted(hb, wsel)
+            base_cl = np.minimum(base, max(len(hb) - 1, 0))
+            hit = (len(hb) > 0) & (hb[base_cl] == wsel) if len(hb) else np.zeros(len(wsel), bool)
+            slots = np.where(hit, base_cl, -1).astype(np.int64)
+            miss = np.flatnonzero(~hit)
+            if len(miss):
+                if not append:
+                    raise PlacementError(
+                        f"cross-host word missing from host bucket {key}"
+                    )
+                for i in miss.tolist():
+                    w = int(wsel[i])
+                    j = pslots.get(w)
+                    if j is None:
+                        j = fill
+                        pslots[w] = j
+                        fill += 1
+                        p = w // self.w_local
+                        self._hsend_writes.append((p, g, j, w - p * self.w_local))
+                    slots[i] = j
+            self._hbucket_fill[key] = fill
+            peak = max(peak, fill)
+            pack["ebslot"][pos[sel]] = slots.astype(np.int32)
+        return peak
 
     def _build_exchange_and_edges(self) -> None:
         """(Re)build the full host-side edge partition + exchange tables and
@@ -376,39 +653,244 @@ class RoutedShardedGraph:
         #: only (build-time slots resolve by searchsorted in _buckets)
         self._patch_slots: Dict[int, Dict[Tuple[int, int], int]] = {}
         self._bucket_fill: Dict[int, Dict[int, int]] = {}
+        #: hier cross-host buckets: (producer host, consumer host) →
+        #: sorted build-time GLOBAL word ids (+ append-only patch slots)
+        self._hbuckets: Dict[Tuple[int, int], np.ndarray] = {}
+        self._hpatch_slots: Dict[Tuple[int, int], Dict[int, int]] = {}
+        self._hbucket_fill: Dict[Tuple[int, int], int] = {}
+        self._hsend_writes: List[Tuple[int, int, int, int]] = []
         self._dev_edge_count = np.zeros(n_dev, dtype=np.int64)
         packs = [self._consumer_pack(d) for d in range(n_dev)]
-        if self.exchange == "a2a":
-            peak = max(
-                (max(f.values(), default=0) for f in (self._bucket_fill[d] for d in range(n_dev))),
-                default=0,
-            )
-            self.bucket_cap = max(int(peak * self.bucket_headroom) + 8, 16)
-            send = np.full((n_dev, n_dev, self.bucket_cap), self.w_local, np.int32)
-            for d in range(n_dev):
-                eslot, ebit, edst, eep, buckets, (prod, slots), n_e = packs[d]
-                for p, wl in buckets.items():
-                    send[p, d, : len(wl)] = wl
-                if n_e:
-                    eslot[:n_e] = (prod * self.bucket_cap + slots).astype(np.int32)
-            self._h_send = send.reshape(n_dev * n_dev, self.bucket_cap)
+        need_e = max((p["n_e"] for p in packs), default=0)
+        if need_e > self.e_cap:
+            # construction sizes e_cap itself; this only triggers on a
+            # geometry edge case — size up front, it is not a "resize"
+            self.e_cap = need_e + 32
+        for d, pack in enumerate(packs):
+            self._register_pack_buckets(d, pack)
+        peak = max(
+            (max(f.values(), default=0) for f in self._bucket_fill.values()),
+            default=0,
+        )
+        self.bucket_cap = max(int(peak * self.bucket_headroom) + 8, 16)
+        if self.exchange == "hier":
+            # host buckets: vectorized union over every consumer's cross
+            # word lists, one sorted array per (producer host, consumer
+            # host) pair
+            per_pair: Dict[Tuple[int, int], List[np.ndarray]] = {}
+            for d, pack in enumerate(packs):
+                if pack["cross"] is None:
+                    continue
+                g = d // self.dph
+                ch, cw, _pos = pack["cross"]
+                for h in np.unique(ch).tolist():
+                    per_pair.setdefault((int(h), g), []).append(cw[ch == h])
+            for key, parts in per_pair.items():
+                hb = np.unique(np.concatenate(parts))
+                self._hbuckets[key] = hb
+                self._hpatch_slots[key] = {}
+                self._hbucket_fill[key] = len(hb)
+            hpeak = max(self._hbucket_fill.values(), default=0)
+            self.hbucket_cap = max(int(hpeak * self.bucket_headroom) + 8, 16)
+            for d, pack in enumerate(packs):
+                self._assign_cross_slots(d, pack, append=False)
+            self._hsend_writes = []
         else:
-            self.bucket_cap = 16  # unused; kernel signature stays uniform
-            self._h_send = np.zeros((n_dev * n_dev, self.bucket_cap), np.int32)
-        self._h_eslot = np.concatenate([p[0] for p in packs])
-        self._h_ebit = np.concatenate([p[1] for p in packs])
-        self._h_edst = np.concatenate([p[2] for p in packs])
-        self._h_eep = np.concatenate([p[3] for p in packs])
+            self.hbucket_cap = 1
+        self._rebuild_send_tables(packs)
+        self._write_edge_slices({d: p for d, p in enumerate(packs)})
+        self._recount_cross_words()
         self._upload_edges()
 
+    def _rebuild_send_tables(self, packs: Sequence[dict]) -> None:
+        """Materialize the send-index tables from the live bucket truth."""
+        n_dev = self.n_dev
+        if self.exchange == "a2a":
+            send = np.full(
+                (n_dev, n_dev, self.bucket_cap), self.w_local, np.int32
+            )
+            for d in range(n_dev):
+                for p, wl in self._buckets[d].items():
+                    send[p, d, : len(wl)] = wl
+                for (p, w), j in self._patch_slots[d].items():
+                    send[p, d, j] = w
+            self._h_send = send.reshape(n_dev * n_dev, self.bucket_cap)
+        elif self.exchange == "hier":
+            # intra: producer p's rows are its same-host consumers by
+            # LOCAL index — [n_dev * dph, icap], each device holds [dph, icap]
+            send = np.full(
+                (n_dev, self.dph, self.bucket_cap), self.w_local, np.int32
+            )
+            for d in range(n_dev):
+                c_l = d % self.dph
+                for p, wl in self._buckets[d].items():
+                    send[p, c_l, : len(wl)] = wl
+                for (p, w), j in self._patch_slots[d].items():
+                    send[p, c_l, j] = w
+            self._h_send = send.reshape(n_dev * self.dph, self.bucket_cap)
+        else:
+            self.bucket_cap = 16  # unused; kernel signature stays uniform
+            self._h_send = np.zeros((n_dev, 1), np.int32)
+        if self.exchange == "hier":
+            # cross: device p's [n_hosts, hcap] block marks the local word
+            # index of every host-bucket word IT owns (pad elsewhere; the
+            # host group OR-assembles the full bucket on device)
+            hs = np.full(
+                (n_dev, self.n_hosts, self.hbucket_cap), self.w_local, np.int32
+            )
+            for (h, g), hb in self._hbuckets.items():
+                if len(hb):
+                    p = hb // self.w_local
+                    hs[p, g, np.arange(len(hb))] = (hb - p * self.w_local).astype(
+                        np.int32
+                    )
+                for w, j in self._hpatch_slots[(h, g)].items():
+                    p = w // self.w_local
+                    hs[p, g, j] = w - p * self.w_local
+            self._h_hsend = hs.reshape(n_dev * self.n_hosts, self.hbucket_cap)
+        else:
+            self._h_hsend = np.zeros((self.n_dev, 1), np.int32)
+
+    def _write_edge_slices(self, packs: Dict[int, dict]) -> None:
+        """(Re)write the listed devices' fixed-width edge slices into the
+        host mirrors (allocating them first when absent)."""
+        if not hasattr(self, "_h_eprod") or len(self._h_eprod) != self.n_dev * self.e_cap:
+            self._h_eprod = np.zeros(self.n_dev * self.e_cap, dtype=np.int32)
+            self._h_ebslot = np.zeros(self.n_dev * self.e_cap, dtype=np.int32)
+            self._h_ebit = np.zeros(self.n_dev * self.e_cap, dtype=np.int32)
+            self._h_edst = np.full(
+                self.n_dev * self.e_cap, self.n_local, dtype=np.int32
+            )  # pad: dropped
+            self._h_eep = np.full(self.n_dev * self.e_cap, -1, dtype=np.int32)
+        for d, pack in packs.items():
+            sl = slice(d * self.e_cap, (d + 1) * self.e_cap)
+            n_e = pack["n_e"]
+            self._h_eprod[sl] = 0
+            self._h_ebslot[sl] = 0
+            self._h_ebit[sl] = 0
+            self._h_edst[sl] = self.n_local
+            self._h_eep[sl] = -1
+            if n_e:
+                self._h_eprod[sl][:n_e] = pack["eprod"]
+                self._h_ebslot[sl][:n_e] = pack["ebslot"]
+                self._h_ebit[sl][:n_e] = pack["ebit"]
+                self._h_edst[sl][:n_e] = pack["edst"]
+                self._h_eep[sl][:n_e] = pack["eep"]
+
+    def _recount_cross_words(self) -> None:
+        """Static per-exchange-level cross-host payload (words), per mode —
+        the ``fusion_mesh_cross_host_words_total`` increment unit. Zero on
+        a single-host mesh by construction. For ``hier`` this counts the
+        DISTINCT reduced host-bucket words (fill) — the frontier
+        information that must cross; the recursive-doubling tree's wire
+        traffic is larger (each round ships the accumulated payload incl.
+        capacity padding, ~n_hosts x the fill at full depth)."""
+        if self.n_hosts <= 1:
+            self.cross_words_per_level = 0
+            return
+        if self.exchange == "hier":
+            self.cross_words_per_level = int(sum(self._hbucket_fill.values()))
+        elif self.exchange == "a2a":
+            total = 0
+            for d, by_p in self._bucket_fill.items():
+                for p, fill in by_p.items():
+                    if p // self.dph != d // self.dph:
+                        total += fill
+            self.cross_words_per_level = total
+        else:  # tree/gather replicate the full frontier to every host
+            self.cross_words_per_level = (
+                (self.n_hosts - 1) * self.n_hosts * self.dph * self.w_local
+            )
+
     def _upload_edges(self) -> None:
-        self.g_send = jax.device_put(self._h_send, self._send_sh)
-        self.g_eslot = jax.device_put(self._h_eslot, self._edge_sh)
-        self.g_ebit = jax.device_put(self._h_ebit, self._edge_sh)
-        self.g_edst = jax.device_put(self._h_edst, self._edge_sh)
-        self.g_eep = jax.device_put(self._h_eep, self._edge_sh)
+        self.g_send = self._put(self._h_send, self._send_sh)
+        self.g_hsend = self._put(self._h_hsend, self._send_sh)
+        self.g_eprod = self._put(self._h_eprod, self._edge_sh)
+        self.g_ebslot = self._put(self._h_ebslot, self._edge_sh)
+        self.g_ebit = self._put(self._h_ebit, self._edge_sh)
+        self.g_edst = self._put(self._h_edst, self._edge_sh)
+        self.g_eep = self._put(self._h_eep, self._edge_sh)
+
+    # ------------------------------------------------------------------ resize
+    def _try_grow(self, kind: str, needed: int, upload: bool = True) -> bool:
+        """Grow an overflowed capacity IN PLACE (ISSUE 15): re-allocate the
+        host table with headroom, re-upload, and let the next dispatch
+        recompile against the new shape — slot assignments are
+        cap-independent so NOTHING re-packs. Counted; a spent budget
+        returns False and the caller takes the rebuild rung.
+
+        ``upload=False`` defers the device re-upload to the caller — a
+        mutation that may grow several capacities (or that ends with its
+        own :meth:`_upload_edges`) pays ONE full-table transfer instead of
+        one per grow; the caller must upload before the next dispatch."""
+        if self.bucket_resizes >= self.max_resizes:
+            global_metrics().counter(
+                "fusion_mesh_resize_exhausted_total",
+                help="bucket/edge-slack overflows that exhausted the in-place "
+                "resize budget and fell to the rebuild rung",
+            ).inc()
+            return False
+        if kind == "bucket":
+            old = self.bucket_cap
+            new = max(needed + 8, int(old * self.resize_growth) + 1)
+            rows = self._h_send.shape[0]
+            grown = np.full((rows, new), self.w_local, np.int32)
+            grown[:, :old] = self._h_send
+            self._h_send = grown
+            self.bucket_cap = new
+        elif kind == "hbucket":
+            old = self.hbucket_cap
+            new = max(needed + 8, int(old * self.resize_growth) + 1)
+            rows = self._h_hsend.shape[0]
+            grown = np.full((rows, new), self.w_local, np.int32)
+            grown[:, :old] = self._h_hsend
+            self._h_hsend = grown
+            self.hbucket_cap = new
+        elif kind == "edge":
+            old = self.e_cap
+            new = max(needed + 32, int(old * self.resize_growth) + 1)
+            for name, pad in (
+                ("_h_eprod", 0),
+                ("_h_ebslot", 0),
+                ("_h_ebit", 0),
+                ("_h_edst", self.n_local),
+                ("_h_eep", -1),
+            ):
+                arr = getattr(self, name)
+                grown = np.full(self.n_dev * new, pad, dtype=np.int32)
+                grown.reshape(self.n_dev, new)[:, :old] = arr.reshape(
+                    self.n_dev, old
+                )
+                setattr(self, name, grown)
+            self.e_cap = new
+        else:  # pragma: no cover — internal misuse
+            raise ValueError(kind)
+        self.bucket_resizes += 1
+        self.resize_detail[kind] += 1
+        global_metrics().counter(
+            "fusion_mesh_bucket_resizes_total",
+            help="exchange-bucket / host-bucket / edge-slack capacities grown "
+            "in place instead of rebuilding the routed mirror (ISSUE 15)",
+        ).inc()
+        if upload:
+            self._upload_edges()
+        return True
 
     # ------------------------------------------------------------------ waves
+    def _count_exchange(self, levels: int) -> None:
+        self.levels_total += levels
+        if self.cross_words_per_level and levels:
+            shipped = levels * self.cross_words_per_level
+            self.cross_host_words += shipped
+            global_metrics().counter(
+                "fusion_mesh_cross_host_words_total",
+                help="distinct reduced host-bucket frontier words per exchange "
+                "level (the DCN leg's information content — what the bucket "
+                "protocol exists to minimize). Wire cost runs higher: the "
+                "recursive-doubling tree replicates the assembled payload "
+                "~n_hosts x and ships capacity padding",
+            ).inc(shipped)
+
     def run_wave_collect(
         self, seed_node_ids: Sequence[int], cap: int = 65536
     ) -> Tuple[int, np.ndarray, bool]:
@@ -432,13 +914,16 @@ class RoutedShardedGraph:
             fn = self._build_collect(capd)
             self._collect_cache[(capd, width)] = fn
         self.g_invalid, counts, levels, bufs = fn(
-            jnp.asarray(rows), self.g_send, self.g_eslot, self.g_ebit,
-            self.g_edst, self.g_eep, self.g_node_epoch, self.g_invalid,
-            self.g_is_real,
+            self._host_arg(rows), self.g_send, self.g_hsend, self.g_eprod,
+            self.g_ebslot, self.g_ebit, self.g_edst, self.g_eep,
+            self.g_node_epoch, self.g_invalid, self.g_is_real,
         )
-        counts, levels, bufs = jax.device_get((counts, levels, bufs))
+        self._sync(self.g_invalid, counts, levels, bufs)
+        counts = self._fetch(counts)
+        levels = self._fetch(levels)
+        bufs = self._fetch(bufs)
         self.waves_run += 1
-        self.levels_total += int(levels)
+        self._count_exchange(int(levels))
         count = int(counts.sum())
         if (counts > capd).any():
             return count, np.empty(0, np.int64), True
@@ -454,13 +939,14 @@ class RoutedShardedGraph:
         n_global = self.n_global
 
         @jax.jit
-        def collect(seed_rows, send, eslot, ebit, edst, eep, nepoch, inv, is_real):
+        def collect(seed_rows, send, hsend, eprod, ebslot, ebit, edst, eep,
+                    nepoch, inv, is_real):
             frontier = lax.with_sharding_constraint(
                 jnp.zeros(n_global, bool).at[seed_rows].set(True, mode="drop"),
                 node_sh,
             )
             inv2, _count, levels = wave(
-                frontier, send, eslot, ebit, edst, eep, nepoch, inv
+                frontier, send, hsend, eprod, ebslot, ebit, edst, eep, nepoch, inv
             )
             counts, bufs = compact(inv2, inv, is_real)
             return inv2, counts, levels, bufs
@@ -526,10 +1012,14 @@ class RoutedShardedGraph:
             fn = self._build_chain(capd)
             self._chain_cache[(K, width, capd)] = fn
         self.g_invalid, counts, levels, bufs = fn(
-            jnp.asarray(mat), self.g_send, self.g_eslot, self.g_ebit,
-            self.g_edst, self.g_eep, self.g_node_epoch, self.g_invalid,
-            self.g_is_real,
+            self._host_arg(mat), self.g_send, self.g_hsend, self.g_eprod,
+            self.g_ebslot, self.g_ebit, self.g_edst, self.g_eep,
+            self.g_node_epoch, self.g_invalid, self.g_is_real,
         )
+        # multi-process: the chain's collectives must fully drain before
+        # any later module's (harvest fetch, patch) hit the gloo pairs —
+        # the dispatch stays nonblocking on a single-process mesh
+        self._sync(self.g_invalid, counts, levels, bufs)
         return {"counts": counts, "levels": levels, "bufs": bufs,
                 "stages": K, "capd": capd, "dispatches": 1}
 
@@ -540,14 +1030,16 @@ class RoutedShardedGraph:
         n_global = self.n_global
 
         @jax.jit
-        def chain(seed_mat, send, eslot, ebit, edst, eep, nepoch, inv0, is_real):
+        def chain(seed_mat, send, hsend, eprod, ebslot, ebit, edst, eep,
+                  nepoch, inv0, is_real):
             def body(inv, seed_rows):
                 frontier = lax.with_sharding_constraint(
                     jnp.zeros(n_global, bool).at[seed_rows].set(True, mode="drop"),
                     node_sh,
                 )
                 inv2, _c, levels = wave(
-                    frontier, send, eslot, ebit, edst, eep, nepoch, inv
+                    frontier, send, hsend, eprod, ebslot, ebit, edst, eep,
+                    nepoch, inv,
                 )
                 counts, bufs = compact(inv2, inv, is_real)
                 return inv2, (counts, levels, bufs)
@@ -560,13 +1052,15 @@ class RoutedShardedGraph:
     def harvest_union_chain(self, pending: dict) -> Tuple[np.ndarray, List[np.ndarray], dict]:
         """Block on a chain ticket: (per-stage counts, per-stage newly NODE
         id arrays, info). An overflowed stage returns ``None`` in its slot —
-        the caller mask-diffs against its dense mirror."""
-        counts_dev, levels, bufs = jax.device_get(
-            (pending["counts"], pending["levels"], pending["bufs"])
-        )
+        the caller mask-diffs against its dense mirror; every overflow is
+        COUNTED (``fusion_mesh_chain_overflows_total``), the containment
+        path is never silent."""
+        counts_dev = self._fetch(pending["counts"])
+        levels = self._fetch(pending["levels"])
+        bufs = self._fetch(pending["bufs"])
         capd = pending["capd"]
         self.waves_run += pending["stages"]
-        self.levels_total += int(levels.sum())
+        self._count_exchange(int(levels.sum()))
         counts = counts_dev.astype(np.int64).sum(axis=1)
         stage_ids: List[Optional[np.ndarray]] = []
         overflowed = False
@@ -585,13 +1079,20 @@ class RoutedShardedGraph:
                         )
                     ]
                 )
+        if overflowed:
+            global_metrics().counter(
+                "fusion_mesh_chain_overflows_total",
+                help="fused-chain stages whose compacted newly-id buffer "
+                "overflowed (recovered by one dense mask diff — counted, "
+                "never silent)",
+            ).inc(sum(1 for i in stage_ids if i is None))
         info = {"levels": levels.astype(np.int64), "overflowed": overflowed}
         return counts, stage_ids, info
 
     # ------------------------------------------------------------------ state
     def invalid_mask(self) -> np.ndarray:
         """bool[n_nodes] in NODE space (reads the device state once)."""
-        arr = np.asarray(self.g_invalid)
+        arr = self._fetch(self.g_invalid)
         out = np.zeros(self.n_nodes, dtype=bool)
         out[self._real_nodes] = arr[self._real_rows]
         return out
@@ -602,10 +1103,10 @@ class RoutedShardedGraph:
         rows = self.perm[: len(m)]
         ok = rows >= 0
         inv[rows[ok]] = m[ok]
-        self.g_invalid = jax.device_put(inv, self._node_sh)
+        self.g_invalid = self._put(inv, self._node_sh)
 
     def clear_invalid(self) -> None:
-        self.g_invalid = jax.device_put(
+        self.g_invalid = self._put(
             np.zeros(self.n_global, dtype=bool), self._node_sh
         )
 
@@ -616,12 +1117,14 @@ class RoutedShardedGraph:
         gather/scatter dispatch for node state), and the affected consumer
         devices' edge slices + exchange buckets re-pack — affected means
         the old/new OWNER devices plus every consumer whose edges SOURCE
-        from a moved shard (their eslot/bucket routes reference the
+        from a moved shard (their slot/bucket routes reference the
         vacated rows; missing them loses invalidations silently — caught
         in review with a single-shard-move repro). State for unmoved
-        shards never leaves its device. Raises :class:`PlacementError` on
-        slot/edge-capacity overflow, after which the graph is BROKEN
-        (every wave entry point refuses) — the caller rebuilds."""
+        shards never leaves its device. An overflow the in-place resize
+        ladder cannot absorb raises :class:`PlacementError`, after which
+        the graph is BROKEN (every wave entry point refuses) — the caller
+        rebuilds. Cross-host row moves (the DCN transfers the host-aware
+        placement ranking minimizes) are counted separately."""
         if not moves:
             self.placement = new_placement
             return
@@ -646,7 +1149,7 @@ class RoutedShardedGraph:
             old_rows_l.append(np.arange(base_old, base_old + n, dtype=np.int64))
             new_rows_l.append(np.arange(base_new, base_new + n, dtype=np.int64))
         # consumers whose edge SOURCES moved: their exchange routes (a2a
-        # buckets / global word slots) point at the old rows
+        # buckets / host buckets / global word slots) point at the old rows
         moved_shards = np.fromiter((m[0] for m in moves), dtype=np.int64)
         for shard, ent in self._shard_edges.items():
             d = int(new_placement.shard_dev[shard])
@@ -654,13 +1157,14 @@ class RoutedShardedGraph:
                 continue
             if len(ent[0]) and np.isin(ent[0] // ips, moved_shards).any():
                 affected_devs.add(d)
+        cross = new_placement.cross_host_moves(moves) if self.n_hosts > 1 else 0
         self.placement = new_placement
         self.perm, self.inv_perm = new_placement.permutation()
         self._real_rows = np.flatnonzero(self.inv_perm >= 0)
         self._real_nodes = self.inv_perm[self._real_rows]
         self._h_is_real = np.zeros(self.n_global, dtype=bool)
         self._h_is_real[self._real_rows] = True
-        self.g_is_real = jax.device_put(self._h_is_real, self._node_sh)
+        self.g_is_real = self._put(self._h_is_real, self._node_sh)
         if old_rows_l:
             old_rows = np.concatenate(old_rows_l)
             new_rows = np.concatenate(new_rows_l)
@@ -674,8 +1178,10 @@ class RoutedShardedGraph:
                 fn = self._build_move()
                 self._move_cache[width] = fn
             self.g_node_epoch, self.g_invalid = fn(
-                self.g_node_epoch, self.g_invalid, jnp.asarray(po), jnp.asarray(pn)
+                self.g_node_epoch, self.g_invalid,
+                self._host_arg(po), self._host_arg(pn),
             )
+            self._sync(self.g_node_epoch, self.g_invalid)
         # re-pack edges + buckets for the touched consumer devices only
         try:
             self._repack_devices(sorted(affected_devs))
@@ -687,6 +1193,14 @@ class RoutedShardedGraph:
             self.broken = True
             raise
         self.shard_moves += len(moves)
+        if cross:
+            self.cross_host_moves += cross
+            global_metrics().counter(
+                "fusion_mesh_cross_host_moves_total",
+                help="moved device-shard row blocks that crossed a host "
+                "boundary during a reshard (the DCN transfers the "
+                "host-aware placement ranking minimizes)",
+            ).inc(cross)
 
     def _build_move(self):
         node_sh = self._node_sh
@@ -708,29 +1222,66 @@ class RoutedShardedGraph:
         return move
 
     def _repack_devices(self, devs: Sequence[int]) -> None:
-        """Host-side re-pack of the listed consumer devices' edge slices and
-        (a2a) their bucket columns from every producer, then one upload per
-        touched array slice."""
+        """Host-side re-pack of the listed consumer devices' edge slices
+        and their bucket columns, then one upload. Overflow climbs the
+        resize ladder first (edge slack and bucket/host-bucket capacities
+        grow in place, counted); only a spent budget raises."""
         packs = {d: self._consumer_pack(d) for d in devs}
+        need_e = max((p["n_e"] for p in packs.values()), default=0)
+        if need_e > self.e_cap and not self._try_grow("edge", need_e, upload=False):
+            raise PlacementError(
+                f"edge slice {need_e} exceeds capacity {self.e_cap} and the "
+                f"resize budget is spent"
+            )
+        for d, pack in packs.items():
+            self._register_pack_buckets(d, pack)
+        peak = max(
+            (max(f.values(), default=0) for f in self._bucket_fill.values()),
+            default=0,
+        )
+        if peak > self.bucket_cap and not self._try_grow("bucket", peak, upload=False):
+            raise PlacementError(
+                f"exchange bucket fill {peak} exceeds cap {self.bucket_cap} "
+                f"and the resize budget is spent"
+            )
+        if self.exchange == "hier":
+            self._hsend_writes = []
+            hpeak = 0
+            for d, pack in packs.items():
+                hpeak = max(hpeak, self._assign_cross_slots(d, pack, append=True))
+            if hpeak > self.hbucket_cap and not self._try_grow(
+                "hbucket", hpeak, upload=False
+            ):
+                raise PlacementError(
+                    f"host bucket fill {hpeak} exceeds cap {self.hbucket_cap} "
+                    f"and the resize budget is spent"
+                )
+            for p, g, j, wloc in self._hsend_writes:
+                self._h_hsend[p * self.n_hosts + g, j] = wloc
+            self._hsend_writes = []
+        # repacked consumers rewrite their send columns from bucket truth
         if self.exchange == "a2a":
-            for d, (eslot, ebit, edst, eep, buckets, raw, n_e) in packs.items():
-                for p, wl in buckets.items():
+            for d, pack in packs.items():
+                send3 = self._h_send.reshape(self.n_dev, self.n_dev, self.bucket_cap)
+                for p in range(self.n_dev):
                     col = np.full(self.bucket_cap, self.w_local, np.int32)
-                    if len(wl) > self.bucket_cap:
-                        raise PlacementError(
-                            f"bucket ({p}->{d}) {len(wl)} exceeds cap {self.bucket_cap}"
-                        )
-                    col[: len(wl)] = wl
-                    self._h_send[p * self.n_dev + d] = col
-                if n_e:
-                    prod, slots = raw
-                    eslot[:n_e] = (prod * self.bucket_cap + slots).astype(np.int32)
-        for d, (eslot, ebit, edst, eep, _b, _raw, _n) in packs.items():
-            sl = slice(d * self.e_cap, (d + 1) * self.e_cap)
-            self._h_eslot[sl] = eslot
-            self._h_ebit[sl] = ebit
-            self._h_edst[sl] = edst
-            self._h_eep[sl] = eep
+                    wl = self._buckets[d].get(p)
+                    if wl is not None and len(wl):
+                        col[: len(wl)] = wl
+                    send3[p, d] = col
+        elif self.exchange == "hier":
+            send3 = self._h_send.reshape(self.n_dev, self.dph, self.bucket_cap)
+            for d, pack in packs.items():
+                c_l = d % self.dph
+                my_host = d // self.dph
+                for p in range(my_host * self.dph, (my_host + 1) * self.dph):
+                    col = np.full(self.bucket_cap, self.w_local, np.int32)
+                    wl = self._buckets[d].get(p)
+                    if wl is not None and len(wl):
+                        col[: len(wl)] = wl
+                    send3[p, c_l] = col
+        self._write_edge_slices(packs)
+        self._recount_cross_words()
         self._upload_edges()
 
     # ------------------------------------------------------------------ patches
@@ -742,12 +1293,16 @@ class RoutedShardedGraph:
         add_ep: np.ndarray,
     ) -> bool:
         """Apply a WHOLE burst's structural patches in one fused device
-        dispatch (the ISSUE 9 amortization satellite): epoch bumps
-        scatter-add (+k for k bumps of one row — final state is
-        order-independent because bumps are increments and adds carry
-        absolute captured epochs), new edges splice into per-device slack
-        slots routed by their destination's OWNER. Returns False on any
-        capacity overflow (caller rebuilds)."""
+        dispatch: epoch bumps scatter-add (+k for k bumps of one row —
+        final state is order-independent because bumps are increments and
+        adds carry absolute captured epochs), new edges splice into
+        per-device slack slots routed by their destination's OWNER.
+        Exhausted slack GROWS IN PLACE first (edge slots, exchange
+        buckets, host buckets — each counted in
+        ``fusion_mesh_bucket_resizes_total``); returns False only for
+        rebuild-grade shapes (new nodes, off-mesh endpoints) or a spent
+        resize budget — after False the caller MUST rebuild (host truth
+        may be partially advanced, same contract as before)."""
         self._check_usable()
         bump_rows = np.empty(0, np.int64)
         bump_counts = np.empty(0, np.int32)
@@ -762,12 +1317,14 @@ class RoutedShardedGraph:
             # on device + dense mirror; shard edge lists carry captured
             # epochs, which bumps do not rewrite
         e_rows = np.empty(0, np.int64)
-        e_slot = np.empty(0, np.int32)
+        e_prod = np.empty(0, np.int32)
+        e_bslot = np.empty(0, np.int32)
         e_bit = np.empty(0, np.int32)
         e_dst = np.empty(0, np.int32)
         e_ep = np.empty(0, np.int32)
-        s_rows = np.empty(0, np.int64)
-        s_vals = np.empty(0, np.int32)
+        send_writes: List[Tuple[int, int, int, int]] = []  # (p, c, j, wl) intra
+        self._hsend_writes = []
+        grew = False  # defer the grow re-uploads to ONE transfer pre-dispatch
         if len(add_u):
             u = np.asarray(add_u, dtype=np.int64)
             v = np.asarray(add_v, dtype=np.int64)
@@ -781,13 +1338,26 @@ class RoutedShardedGraph:
                 return False
             shards = v // ips
             devs = (v_rows // self.n_local).astype(np.int64)
-            er, es, eb, ed, ee, sr, sv = [], [], [], [], [], [], []
-            for d in np.unique(devs).tolist():
+            # pre-scan the edge slack so e_rows are computed against ONE
+            # final e_cap (a mid-batch grow would mix two layouts)
+            uds, ucounts = np.unique(devs, return_counts=True)
+            need_e = int(
+                max(
+                    self._dev_edge_count[d] + k
+                    for d, k in zip(uds.tolist(), ucounts.tolist())
+                )
+            )
+            if need_e > self.e_cap:
+                if not self._try_grow("edge", need_e, upload=False):
+                    return False  # edge slack exhausted: rebuild rung
+                grew = True
+            er, eP, eS, eb, ed, ee = [], [], [], [], [], []
+            bucket_need = 0
+            hbucket_need = 0
+            for d in uds.tolist():
                 sel = devs == d
                 k = int(sel.sum())
                 base = int(self._dev_edge_count[d])
-                if base + k > self.e_cap:
-                    return False  # edge slack exhausted
                 self._dev_edge_count[d] = base + k
                 rows = d * self.e_cap + base + np.arange(k, dtype=np.int64)
                 ur, vr = u_rows[sel], v_rows[sel]
@@ -795,14 +1365,46 @@ class RoutedShardedGraph:
                 eb.append((ur & 31).astype(np.int32))
                 ed.append((vr - d * self.n_local).astype(np.int32))
                 ee.append(ep[sel])
-                if self.exchange == "a2a":
+                if self.exchange in ("tree", "gather"):
+                    eP.append(np.zeros(k, np.int32))
+                    eS.append((ur >> 5).astype(np.int32))
+                else:
                     prod = (ur // self.n_local).astype(np.int64)
                     wl = (ur >> 5) - prod * self.w_local
+                    my_host = d // self.dph
+                    prods = np.empty(k, dtype=np.int64)
+                    slots = np.empty(k, dtype=np.int64)
                     built = self._buckets[d]
                     patch_slots = self._patch_slots[d]
                     fill = self._bucket_fill[d]
-                    slots = np.empty(k, dtype=np.int64)
                     for i, (p, w) in enumerate(zip(prod.tolist(), wl.tolist())):
+                        if self.exchange == "hier" and p // self.dph != my_host:
+                            # cross-host edge: slot in the (H, G) host
+                            # bucket, append-only (other consumers' slots
+                            # never shift)
+                            h = p // self.dph
+                            key = (h, my_host)
+                            wg = p * self.w_local + w
+                            hb = self._hbuckets.get(key)
+                            j = None
+                            if hb is not None and len(hb):
+                                pos = int(np.searchsorted(hb, wg))
+                                if pos < len(hb) and hb[pos] == wg:
+                                    j = pos
+                            if j is None:
+                                pslots = self._hpatch_slots.setdefault(key, {})
+                                j = pslots.get(wg)
+                                if j is None:
+                                    j = self._hbucket_fill.get(
+                                        key, len(hb) if hb is not None else 0
+                                    )
+                                    pslots[wg] = j
+                                    self._hbucket_fill[key] = j + 1
+                                    self._hsend_writes.append((p, my_host, j, w))
+                            hbucket_need = max(hbucket_need, j + 1)
+                            prods[i] = self.n_dev + h
+                            slots[i] = j
+                            continue
                         bucket = built.get(p)
                         j = None
                         if bucket is not None and len(bucket):
@@ -813,17 +1415,14 @@ class RoutedShardedGraph:
                             j = patch_slots.get((p, w))
                         if j is None:
                             j = fill.get(p, 0)
-                            if j >= self.bucket_cap:
-                                return False  # bucket slack exhausted
                             patch_slots[(p, w)] = j
                             fill[p] = j + 1
-                            sr.append(np.array([(p * self.n_dev + d) * self.bucket_cap + j]))
-                            sv.append(np.array([w], dtype=np.int32))
-                            self._h_send[p * self.n_dev + d, j] = w
+                            send_writes.append((p, d, j, w))
+                        bucket_need = max(bucket_need, j + 1)
+                        prods[i] = p
                         slots[i] = j
-                    es.append((prod * self.bucket_cap + slots).astype(np.int32))
-                else:
-                    es.append(((ur >> 5)).astype(np.int32))
+                    eP.append(prods.astype(np.int32))
+                    eS.append(slots.astype(np.int32))
                 # host truth for future repacks
                 for s in np.unique(shards[sel]).tolist():
                     ss = sel & (shards == s)
@@ -835,18 +1434,72 @@ class RoutedShardedGraph:
                     ent[1] = np.concatenate([ent[1], v[ss]])
                     ent[2] = np.concatenate([ent[2], ep[ss]])
                 # mirror into host edge arrays
-                self._h_eslot[rows] = es[-1]
+                self._h_eprod[rows] = eP[-1]
+                self._h_ebslot[rows] = eS[-1]
                 self._h_ebit[rows] = eb[-1]
                 self._h_edst[rows] = ed[-1]
                 self._h_eep[rows] = ee[-1]
+            # bucket growth AFTER slot assignment (slots are cap-independent
+            # — only the flat table rows below depend on the final caps)
+            if bucket_need > self.bucket_cap:
+                if not self._try_grow("bucket", bucket_need, upload=False):
+                    return False
+                grew = True
+            if hbucket_need > self.hbucket_cap:
+                if not self._try_grow("hbucket", hbucket_need, upload=False):
+                    return False
+                grew = True
+            if grew:
+                # one transfer for every grow this batch: the fused dispatch
+                # below scatters into device tables of the FINAL shapes
+                self._upload_edges()
             e_rows = np.concatenate(er) if er else e_rows
-            e_slot = np.concatenate(es) if es else e_slot
+            e_prod = np.concatenate(eP) if eP else e_prod
+            e_bslot = np.concatenate(eS) if eS else e_bslot
             e_bit = np.concatenate(eb) if eb else e_bit
             e_dst = np.concatenate(ed) if ed else e_dst
             e_ep = np.concatenate(ee) if ee else e_ep
-            if sr:
-                s_rows = np.concatenate(sr)
-                s_vals = np.concatenate(sv)
+        # materialize the send-table writes with the FINAL capacities
+        s_rows = np.empty(0, np.int64)
+        s_vals = np.empty(0, np.int32)
+        if send_writes:
+            if self.exchange == "a2a":
+                s_rows = np.asarray(
+                    [(p * self.n_dev + c) * self.bucket_cap + j for p, c, j, _w in send_writes],
+                    dtype=np.int64,
+                )
+            else:  # hier intra: row p*dph + local consumer index
+                s_rows = np.asarray(
+                    [
+                        (p * self.dph + (c % self.dph)) * self.bucket_cap + j
+                        for p, c, j, _w in send_writes
+                    ],
+                    dtype=np.int64,
+                )
+            s_vals = np.asarray([w for _p, _c, _j, w in send_writes], dtype=np.int32)
+            flat = self._h_send.reshape(-1)
+            flat[s_rows] = s_vals
+        hs_rows = np.empty(0, np.int64)
+        hs_vals = np.empty(0, np.int32)
+        if self._hsend_writes:
+            hs_rows = np.asarray(
+                [
+                    (p * self.n_hosts + g) * self.hbucket_cap + j
+                    for p, g, j, _w in self._hsend_writes
+                ],
+                dtype=np.int64,
+            )
+            hs_vals = np.asarray(
+                [w for _p, _g, _j, w in self._hsend_writes], dtype=np.int32
+            )
+            hflat = self._h_hsend.reshape(-1)
+            hflat[hs_rows] = hs_vals
+            self._hsend_writes = []
+        if send_writes or len(hs_rows):
+            # new bucket words may be cross-host in EITHER mode (a2a routes
+            # cross-host pairs through the same per-(p, c) buckets) — keep
+            # fusion_mesh_cross_host_words_total's per-level unit honest
+            self._recount_cross_words()
         if not len(bump_rows) and not len(e_rows):
             return True
         # ONE fused dispatch for the whole batch — pad each index family to
@@ -860,68 +1513,86 @@ class RoutedShardedGraph:
         pb = _pad(bump_rows, self.n_global)
         pbc = _pad(bump_counts, 0, np.int32)
         pe = _pad(e_rows, self.n_dev * self.e_cap)
-        pes = _pad(e_slot, 0, np.int32)
+        pep = _pad(e_prod, 0, np.int32)
+        pes = _pad(e_bslot, 0, np.int32)
         peb = _pad(e_bit, 0, np.int32)
         ped = _pad(e_dst, self.n_local, np.int32)
         pee = _pad(e_ep, -1, np.int32)
-        ps = _pad(s_rows, self.n_dev * self.n_dev * self.bucket_cap)
+        ps = _pad(s_rows, self._h_send.size)
         psv = _pad(s_vals, self.w_local, np.int32)
-        key = (len(pb), len(pe), len(ps))
+        ph = _pad(hs_rows, self._h_hsend.size)
+        phv = _pad(hs_vals, self.w_local, np.int32)
+        key = (len(pb), len(pe), len(ps), len(ph))
         fn = self._patch_cache.get(key)
         if fn is None:
             fn = self._build_patch()
             self._patch_cache[key] = fn
         (
-            self.g_node_epoch, self.g_eslot, self.g_ebit, self.g_edst,
-            self.g_eep, self.g_send,
+            self.g_node_epoch, self.g_eprod, self.g_ebslot, self.g_ebit,
+            self.g_edst, self.g_eep, self.g_send, self.g_hsend,
         ) = fn(
-            self.g_node_epoch, self.g_eslot, self.g_ebit, self.g_edst,
-            self.g_eep, self.g_send,
-            jnp.asarray(pb), jnp.asarray(pbc), jnp.asarray(pe),
-            jnp.asarray(pes), jnp.asarray(peb), jnp.asarray(ped),
-            jnp.asarray(pee), jnp.asarray(ps), jnp.asarray(psv),
+            self.g_node_epoch, self.g_eprod, self.g_ebslot, self.g_ebit,
+            self.g_edst, self.g_eep, self.g_send, self.g_hsend,
+            self._host_arg(pb), self._host_arg(pbc), self._host_arg(pe),
+            self._host_arg(pep), self._host_arg(pes), self._host_arg(peb),
+            self._host_arg(ped), self._host_arg(pee), self._host_arg(ps),
+            self._host_arg(psv), self._host_arg(ph), self._host_arg(phv),
         )
+        self._sync(self.g_node_epoch, self.g_send)
         self.patches += 1
         self.patch_dispatches += 1
         return True
 
     def _build_patch(self):
         node_sh, edge_sh, send_sh = self._node_sh, self._edge_sh, self._send_sh
-        cap = self.bucket_cap
 
         @jax.jit
-        def patch(nep, eslot, ebit, edst, eep, send,
-                  b_rows, b_counts, e_rows, e_slot, e_bit, e_dst, e_ep,
-                  s_rows, s_vals):
+        def patch(nep, eprod, ebslot, ebit, edst, eep, send, hsend,
+                  b_rows, b_counts, e_rows, e_prod, e_bslot, e_bit, e_dst, e_ep,
+                  s_rows, s_vals, h_rows, h_vals):
             nep = nep.at[b_rows].add(b_counts, mode="drop")
-            eslot = eslot.at[e_rows].set(e_slot, mode="drop")
+            eprod = eprod.at[e_rows].set(e_prod, mode="drop")
+            ebslot = ebslot.at[e_rows].set(e_bslot, mode="drop")
             ebit = ebit.at[e_rows].set(e_bit, mode="drop")
             edst = edst.at[e_rows].set(e_dst, mode="drop")
             eep = eep.at[e_rows].set(e_ep, mode="drop")
             flat = send.reshape(-1).at[s_rows].set(s_vals, mode="drop")
+            hflat = hsend.reshape(-1).at[h_rows].set(h_vals, mode="drop")
             return (
                 lax.with_sharding_constraint(nep, node_sh),
-                lax.with_sharding_constraint(eslot, edge_sh),
+                lax.with_sharding_constraint(eprod, edge_sh),
+                lax.with_sharding_constraint(ebslot, edge_sh),
                 lax.with_sharding_constraint(ebit, edge_sh),
                 lax.with_sharding_constraint(edst, edge_sh),
                 lax.with_sharding_constraint(eep, edge_sh),
                 lax.with_sharding_constraint(flat.reshape(send.shape), send_sh),
+                lax.with_sharding_constraint(hflat.reshape(hsend.shape), send_sh),
             )
 
         return patch
 
     # ------------------------------------------------------------------ snapshots
-    def export_shard_state(self) -> dict:
+    def export_shard_state(self, local_only: bool = False) -> dict:
         """Per-device-shard node state keyed by VIRTUAL SHARD id (the unit
         that survives a reshard): checkpoint/durable.py stores this so a
         warm restart re-pins each shard under whatever placement the
-        restarting process derives — layout-independent by construction."""
-        ep = np.asarray(self.g_node_epoch)
-        inv = np.asarray(self.g_invalid)
+        restarting process derives — layout-independent by construction.
+        ``local_only=True`` exports only the shards whose owner device is
+        on THIS host process (the per-host snapshot unit of the multihost
+        chaos ladder)."""
+        ep = self._fetch(self.g_node_epoch)
+        inv = self._fetch(self.g_invalid)
         pl = self.placement
+        my_host = None
+        if local_only and self._procs > 1:
+            import jax as _jax
+
+            my_host = _jax.process_index()
         shards: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         for s in range(pl.shard_map.n_shards):
             if pl.shard_dev[s] < 0:
+                continue
+            if my_host is not None and int(pl.shard_dev[s]) // self.dph != my_host:
                 continue
             lo = s * pl.ids_per_shard
             n = min(pl.ids_per_shard, self.n_nodes - lo)
@@ -951,8 +1622,8 @@ class RoutedShardedGraph:
                 f"n_shards={snap.get('n_shards')}) does not match this graph "
                 f"({self.n_nodes}, {pl.shard_map.n_shards}); cold-build instead"
             )
-        ep = np.asarray(self.g_node_epoch).copy()
-        inv = np.asarray(self.g_invalid).copy()
+        ep = self._fetch(self.g_node_epoch).copy()
+        inv = self._fetch(self.g_invalid).copy()
         restored = 0
         for s, (sep, sinv) in snap["shards"].items():
             s = int(s)
@@ -965,8 +1636,8 @@ class RoutedShardedGraph:
             ep[base : base + n] = sep[:n]
             inv[base : base + n] = sinv[:n]
             restored += 1
-        self.g_node_epoch = jax.device_put(ep, self._node_sh)
-        self.g_invalid = jax.device_put(inv, self._node_sh)
+        self.g_node_epoch = self._put(ep, self._node_sh)
+        self.g_invalid = self._put(inv, self._node_sh)
         return restored
 
     def _check_usable(self) -> None:
@@ -980,14 +1651,22 @@ class RoutedShardedGraph:
         return {
             "exchange": self.exchange,
             "n_dev": self.n_dev,
+            "hosts": self.n_hosts,
+            "devices_per_host": self.dph,
             "n_nodes": self.n_nodes,
             "n_global": self.n_global,
             "e_cap": self.e_cap,
             "bucket_cap": self.bucket_cap,
+            "hbucket_cap": self.hbucket_cap,
             "placement_epoch": self.placement.epoch,
             "waves_run": self.waves_run,
             "exchange_levels_total": self.levels_total,
             "shard_moves": self.shard_moves,
+            "cross_host_moves": self.cross_host_moves,
             "patches": self.patches,
             "patch_dispatches": self.patch_dispatches,
+            "bucket_resizes": self.bucket_resizes,
+            "resize_detail": dict(self.resize_detail),
+            "cross_host_words": self.cross_host_words,
+            "cross_words_per_level": self.cross_words_per_level,
         }

@@ -1,0 +1,96 @@
+"""Multi-host bring-up (ISSUE 15): launcher env contract, single-host
+context shortcut, and the real 2-OS-process mesh self-check (slow: the
+tier1 `multihost` CI job runs the full perf gate; the spawn test here is
+the library-level smoke)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from stl_fusion_tpu.cluster.multihost import (
+    ENV_COORDINATOR,
+    ENV_DEVICES_PER_HOST,
+    ENV_NUM_HOSTS,
+    ENV_PROCESS_ID,
+    MultiHostContext,
+    host_env,
+    init_multihost,
+    pick_coordinator,
+)
+
+
+def test_host_env_sets_mesh_vars_and_replaces_device_count():
+    base = {
+        "PYTHONPATH": "/keep/this:/and/this",
+        "XLA_FLAGS": "--xla_foo=1 --xla_force_host_platform_device_count=8",
+        "SOMETHING": "else",
+    }
+    env = host_env(2, 1, "127.0.0.1:9999", 4, base_env=base)
+    # the parent env survives (PYTHONPATH especially: the axon site dir
+    # must reach the child or every jax import fails)
+    assert env["PYTHONPATH"] == "/keep/this:/and/this"
+    assert env["SOMETHING"] == "else"
+    # the device-count flag is REPLACED, other XLA flags kept
+    assert "--xla_foo=1" in env["XLA_FLAGS"]
+    assert env["XLA_FLAGS"].count("xla_force_host_platform_device_count") == 1
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env[ENV_NUM_HOSTS] == "2" and env[ENV_PROCESS_ID] == "1"
+    assert env[ENV_COORDINATOR] == "127.0.0.1:9999"
+    assert env[ENV_DEVICES_PER_HOST] == "4"
+
+
+def test_pick_coordinator_returns_bindable_address():
+    addr = pick_coordinator()
+    host, port = addr.rsplit(":", 1)
+    assert host == "127.0.0.1" and 0 < int(port) < 65536
+
+
+def test_context_geometry_helpers():
+    ctx = MultiHostContext(process_id=1, n_hosts=2, devices_per_host=4)
+    assert ctx.n_dev == 8 and ctx.is_multiprocess
+    assert ctx.host_of_device(3) == 0 and ctx.host_of_device(4) == 1
+    assert ctx.member_names() == ["h0", "h1"]
+    assert ctx.member_names("m") == ["m0", "m1"]
+
+
+def test_init_single_host_shortcut_no_distributed_runtime():
+    """n_hosts=1 must not touch jax.distributed (a lone survivor phase
+    and every pre-ISSUE-15 caller run this path)."""
+    import jax
+
+    ctx = init_multihost(n_hosts=1, devices_per_host=jax.local_device_count())
+    assert not ctx.is_multiprocess
+    assert ctx.n_dev == jax.local_device_count()
+    ctx.sync()  # no-op
+    ctx.shutdown()  # no-op
+    # a wrong local device expectation must refuse loudly
+    with pytest.raises(RuntimeError):
+        init_multihost(n_hosts=1, devices_per_host=jax.local_device_count() + 1)
+
+
+@pytest.mark.slow
+def test_two_real_host_processes_join_one_mesh():
+    """The zero-to-aha spawn: 2 OS processes x 2 emulated devices form ONE
+    4-device global mesh and a cross-process psum agrees on both."""
+    from stl_fusion_tpu.cluster.multihost import launch_hosts
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = launch_hosts(
+        [sys.executable, "-m", "stl_fusion_tpu.cluster.multihost"],
+        n_hosts=2,
+        devices_per_host=2,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        outs.append(out.decode())
+    assert all(p.returncode == 0 for p in procs), outs
+    for i, out in enumerate(outs):
+        assert f"host={i}/2" in out and "psum_ok=True" in out, out

@@ -90,6 +90,45 @@ def test_placement_off_mesh_members_have_no_slots():
         assert perm[lo] == -1
 
 
+# ---------------------------------------------------------------- host axis
+def test_placement_host_axis_geometry():
+    smap = ShardMap.initial(["a", "b"], n_shards=64)
+    p = DevicePlacement.build(smap, 8, 10_000, devices_per_host=4)
+    assert p.n_hosts == 2 and p.devices_per_host == 4
+    assert p.host_of_device(0) == 0 and p.host_of_device(3) == 0
+    assert p.host_of_device(4) == 1 and p.host_of_device(7) == 1
+    snap = p.snapshot()
+    assert snap["hosts"] == 2 and snap["devices_per_host"] == 4
+    # default: every device one host (the pre-multihost shape)
+    p1 = DevicePlacement.build(smap, 8, 10_000)
+    assert p1.n_hosts == 1 and p1.host_of_device(7) == 0
+    with pytest.raises(Exception):
+        DevicePlacement.build(smap, 8, 10_000, devices_per_host=3)
+
+
+def test_placement_host_aware_moves_prefer_same_host_and_are_deterministic():
+    """ISSUE 15 satellite: a reshard must not needlessly turn an
+    intra-host slot reassignment into a cross-host DCN transfer — moved
+    shards land on a same-host device of the new owner whenever one has a
+    free slot, deterministically."""
+    smap = ShardMap.initial(["a", "b"], n_shards=64)
+    pl = DevicePlacement.build(smap, 8, 10_000, devices_per_host=4, slot_headroom=3.0)
+    # kill b: member a absorbs every device range, so b's shards (resident
+    # on host-1 devices 4-7) have same-host candidates under the new owner
+    m2 = smap.with_members(["a"])
+    p2a, moves_a = pl.moved_to(m2, mesh_members=["a"])
+    p2b, moves_b = pl.moved_to(m2, mesh_members=["a"])
+    # determinism: identical placements + move lists across derivations
+    assert moves_a == moves_b
+    assert np.array_equal(p2a.shard_dev, p2b.shard_dev)
+    assert np.array_equal(p2a.shard_slot, p2b.shard_slot)
+    # host preference: with generous slot headroom NO move crosses hosts
+    assert pl.cross_host_moves(moves_a) == 0
+    for s, old, new in moves_a:
+        assert pl.host_of_device(old) == pl.host_of_device(new)
+    assert p2a.devices_per_host == 4  # the host axis survives the epoch
+
+
 # ---------------------------------------------------------------- waves
 @pytest.mark.parametrize("exchange", ["a2a", "tree", "gather"])
 def test_routed_wave_matches_bfs_oracle(exchange):
@@ -109,6 +148,104 @@ def test_routed_wave_matches_bfs_oracle(exchange):
     c2, _ids2, _ = g.run_wave_collect(seeds[:2])
     assert c2 == 0
     assert g.levels_total > 0  # collective exchange rounds were counted
+
+
+@pytest.mark.parametrize("dph", [2, 4])
+def test_hier_exchange_matches_bfs_oracle_and_counts_cross_words(dph):
+    """ISSUE 15 tentpole: the hierarchical two-stage exchange (intra-host
+    subgroup a2a + inter-host host-bucket ppermute tree) is oracle-exact
+    on an emulated host axis, and the cross-host word telemetry counts."""
+    n = 4000
+    src, dst, adj = make_graph(n)
+    smap = ShardMap.initial(["a", "b"], n_shards=32)
+    pl = DevicePlacement.build(smap, 8, n, devices_per_host=dph)
+    g = RoutedShardedGraph(src, dst, n, pl, mesh=graph_mesh(), exchange="hier")
+    assert g.exchange == "hier" and g.n_hosts == 8 // dph
+    rng = np.random.default_rng(1)
+    seeds = rng.choice(n, size=5, replace=False).tolist()
+    count, ids, over = g.run_wave_collect(seeds)
+    assert not over
+    want = bfs_closure(adj, seeds)
+    assert set(ids.tolist()) == want
+    assert count == len(want)
+    # a frontier spanning shards on distinct hosts must ship words across
+    # the host boundary — exercised, not merely counted
+    assert g.cross_words_per_level > 0
+    assert g.cross_host_words > 0
+    st = g.stats()
+    assert st["hosts"] == 8 // dph and st["cross_host_words"] == g.cross_host_words
+
+
+def test_hier_chain_equals_sequential_and_patches_apply():
+    n = 4000
+    src, dst, adj = make_graph(n)
+    smap = ShardMap.initial(["a", "b"], n_shards=32)
+    pl = DevicePlacement.build(smap, 8, n, devices_per_host=4)
+    g = RoutedShardedGraph(src, dst, n, pl, mesh=graph_mesh(), exchange="hier")
+    rng = np.random.default_rng(2)
+    stages = [rng.choice(n, size=3, replace=False).tolist() for _ in range(3)]
+    pending = g.dispatch_union_chain(stages)
+    counts, stage_ids, info = g.harvest_union_chain(pending)
+    assert not info["overflowed"] and pending["dispatches"] == 1
+    seen = set()
+    for st, c, ids in zip(stages, counts, stage_ids):
+        want = {x for x in bfs_closure(adj, st) if x not in seen}
+        seen |= want
+        assert int(c) == len(want)
+        assert set(ids.tolist()) == want
+    # live patching on the hier layout: a bump stops the cascade, a
+    # re-declare at the bumped epoch resumes it — and a CROSS-HOST added
+    # edge routes through the host buckets
+    g.clear_invalid()
+    # pick u on host 0's id range, v on host 1's (contiguous shard ids →
+    # find one pair via the placement)
+    def host_of_node(i):
+        return g.placement.host_of_device(
+            int(g.placement.shard_dev[g.placement.shard_of_node(i)])
+        )
+
+    # a SINK on host 0 (closure = itself) so the asserted cascade can only
+    # come from the patched cross-host edge
+    u_node = next(i for i in range(n) if host_of_node(i) == 0 and i not in adj)
+    v_node = next(i for i in range(n) if host_of_node(i) == 1 and i != u_node)
+    before = g.cross_words_per_level
+    ok = g.patch_batch(
+        np.empty(0, np.int64), np.array([u_node]), np.array([v_node]),
+        np.zeros(1, np.int32),
+    )
+    assert ok
+    assert g.cross_words_per_level >= before  # host buckets absorbed the word
+    c, ids, _ = g.run_wave_collect([u_node])
+    got = set(ids.tolist())
+    assert v_node in got  # the cross-host patched edge conducts
+
+
+def test_hier_kill_join_moves_shards_preserving_state():
+    n = 4000
+    src, dst, adj = make_graph(n)
+    smap = ShardMap.initial(["a", "b"], n_shards=32)
+    pl = DevicePlacement.build(smap, 8, n, devices_per_host=4, slot_headroom=3.0)
+    g = RoutedShardedGraph(
+        src, dst, n, pl, mesh=graph_mesh(), exchange="hier",
+        edge_headroom=2.5, bucket_headroom=2.5,
+    )
+    rng = np.random.default_rng(3)
+    seeds = rng.choice(n, size=4, replace=False).tolist()
+    g.run_wave_collect(seeds)
+    mask0 = g.invalid_mask().copy()
+    m2 = smap.with_members(["a"])
+    pl2, moves = pl.moved_to(m2, mesh_members=["a"])
+    assert moves
+    g.apply_placement(pl2, moves)
+    assert np.array_equal(g.invalid_mask(), mask0)
+    # host-aware ranking: the generous headroom means zero DCN transfers
+    assert g.cross_host_moves == 0
+    # waves stay oracle-exact on the churned hier layout
+    s2 = rng.choice(n, size=3, replace=False).tolist()
+    c, ids, _ = g.run_wave_collect(s2)
+    already = bfs_closure(adj, seeds)
+    want = {x for x in bfs_closure(adj, s2) if x not in already}
+    assert set(ids.tolist()) == want and c == len(want)
 
 
 def test_routed_chain_equals_sequential_waves():
@@ -198,18 +335,71 @@ def test_routed_patch_batch_is_one_dispatch_and_oracle_exact():
     assert n - 2 in set(ids.tolist())
 
 
-def test_routed_patch_overflow_reports_rebuild():
+def test_routed_patch_overflow_reports_rebuild_when_resizes_exhausted():
     n = 2000
     src, dst, _adj = make_graph(n, seed=5)
     smap = ShardMap.initial(["a"], n_shards=16)
     pl = DevicePlacement.build(smap, 8, n)
-    g = RoutedShardedGraph(src, dst, n, pl, mesh=graph_mesh(), edge_headroom=1.01)
+    # max_resizes=0: the pre-ISSUE-15 ladder — overflow goes straight to
+    # the rebuild rung (False), and the exhaustion is COUNTED
+    g = RoutedShardedGraph(
+        src, dst, n, pl, mesh=graph_mesh(), edge_headroom=1.01, max_resizes=0
+    )
+    from stl_fusion_tpu.diagnostics.metrics import global_metrics
+
+    before = global_metrics().snapshot().get("fusion_mesh_resize_exhausted_total", 0)
     # flood one destination's device with more edges than the slack holds
     k = g.e_cap  # definitely over the per-device free slots
     u = np.random.default_rng(0).integers(0, n - 1, size=k)
     v = np.full(k, n - 1, dtype=np.int64)
     ep = np.zeros(k, dtype=np.int32)
     assert g.patch_batch(np.empty(0, np.int64), u, v, ep) is False
+    assert g.bucket_resizes == 0
+    after = global_metrics().snapshot().get("fusion_mesh_resize_exhausted_total", 0)
+    assert after == before + 1
+
+
+def test_routed_patch_overflow_resizes_in_place_and_stays_oracle_exact():
+    """ISSUE 15 satellite: an overflowed edge-slack slot / exchange bucket
+    under live patching GROWS in place (counted), the patched wave stays
+    oracle-exact, and zero rebuild-grade failures are reported."""
+    n = 2000
+    src, dst, adj = make_graph(n, seed=5)
+    smap = ShardMap.initial(["a", "b"], n_shards=16)
+    pl = DevicePlacement.build(smap, 8, n)
+    g = RoutedShardedGraph(
+        src, dst, n, pl, mesh=graph_mesh(), edge_headroom=1.01, bucket_headroom=1.01
+    )
+    from stl_fusion_tpu.diagnostics.metrics import global_metrics
+
+    before = global_metrics().snapshot().get("fusion_mesh_bucket_resizes_total", 0)
+    # flood one destination device's slack well past e_cap AND mint many
+    # new (producer, word) bucket entries
+    rng = np.random.default_rng(0)
+    k = g.e_cap + 64
+    u = rng.integers(0, n - 1, size=k)
+    v = np.full(k, n - 1, dtype=np.int64)
+    ep = np.zeros(k, dtype=np.int32)
+    assert g.patch_batch(np.empty(0, np.int64), u, v, ep) is True
+    assert g.bucket_resizes >= 1
+    assert g.resize_detail["edge"] >= 1
+    after = global_metrics().snapshot().get("fusion_mesh_bucket_resizes_total", 0)
+    assert after == before + g.bucket_resizes
+    # the grown layout serves oracle-exact waves: every new edge conducts
+    for s, d_ in zip(u.tolist(), v.tolist()):
+        adj.setdefault(s, []).append(d_)
+    seeds = [int(u[0]), int(u[k // 2])]
+    c, ids, over = g.run_wave_collect(seeds)
+    assert not over
+    want = bfs_closure(adj, seeds)
+    assert set(ids.tolist()) == want and c == len(want)
+    # a second overflow within the remaining budget also resizes in place
+    u2 = rng.integers(0, n - 1, size=g.e_cap)
+    v2 = np.full(len(u2), n - 2, dtype=np.int64)
+    assert g.patch_batch(
+        np.empty(0, np.int64), u2, v2, np.zeros(len(u2), np.int32)
+    ) is True
+    assert g.resize_detail["edge"] >= 2
 
 
 def test_mesh_shard_snapshot_survives_reshard():
@@ -274,11 +464,14 @@ def test_packed_patch_batch_equals_sequential():
 
 
 # ---------------------------------------------------------------- live backend
-async def test_backend_mesh_routing_pipeline_and_reshard_chaos():
+@pytest.mark.parametrize("exchange", ["a2a", "hier"])
+async def test_backend_mesh_routing_pipeline_and_reshard_chaos(exchange):
     """The ISSUE 9 acceptance scenario at test scale: a live hub's fused
     wave chains ride the routed mesh path, a mid-burst reshard MOVES
     device shards, and the consistency auditor sees zero oracle-divergent
-    reads on the churned topology."""
+    reads on the churned topology. Parametrized over the hierarchical
+    exchange (ISSUE 15): the two-stage intra-host + inter-host protocol
+    must ride the SAME pipeline with zero eager fallbacks."""
     from stl_fusion_tpu.core import (
         ComputeService,
         FusionHub,
@@ -315,7 +508,10 @@ async def test_backend_mesh_routing_pipeline_and_reshard_chaos():
         backend.flush()
 
         smap = ShardMap.initial(["m0", "m1"], n_shards=32)
-        backend.enable_mesh_routing(smap, mesh=graph_mesh())
+        backend.enable_mesh_routing(
+            smap, mesh=graph_mesh(), exchange=exchange,
+            devices_per_host=4 if exchange == "hier" else None,
+        )
         pipe = WavePipeline(backend, fuse_depth=2)
         rng = np.random.default_rng(7)
         seen = set()

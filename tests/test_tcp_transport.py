@@ -1,0 +1,145 @@
+"""Plain-TCP RPC transport (ISSUE 15): stdlib-only real-socket tests —
+echo RPC, fusion invalidation push, and the cross-host DCN fallback
+classification riding an actual socket (no optional websockets dep)."""
+import asyncio
+
+import numpy as np
+import pytest
+
+from stl_fusion_tpu.client import compute_client, install_compute_call_type
+from stl_fusion_tpu.core import (
+    ComputeService,
+    FusionHub,
+    capture,
+    compute_method,
+    invalidating,
+)
+from stl_fusion_tpu.rpc import RpcHub
+from stl_fusion_tpu.rpc.tcp import RpcTcpServer, tcp_client_connector
+
+
+class Echo:
+    async def echo(self, text: str) -> str:
+        return f"tcp:{text}"
+
+
+async def test_rpc_over_real_tcp():
+    server_hub = RpcHub("tcp-server")
+    server_hub.add_service("echo", Echo())
+    server = await RpcTcpServer(server_hub).start()
+    client_hub = RpcHub("tcp-client")
+    client_hub.client_connector = tcp_client_connector(server.host, server.port)
+    try:
+        proxy = client_hub.client("echo", "default")
+        assert await proxy.echo("hello") == "tcp:hello"
+        results = await asyncio.gather(*(proxy.echo(str(i)) for i in range(20)))
+        assert results == [f"tcp:{i}" for i in range(20)]
+    finally:
+        await client_hub.stop()
+        await server.stop()
+
+
+class Counters(ComputeService):
+    def __init__(self, hub=None):
+        super().__init__(hub)
+        self.data = {}
+
+    @compute_method
+    async def get(self, key: str) -> int:
+        return self.data.get(key, 0)
+
+    async def increment(self, key: str):
+        self.data[key] = self.data.get(key, 0) + 1
+        with invalidating():
+            await self.get(key)
+
+
+async def test_fusion_invalidation_over_real_tcp():
+    server_fusion = FusionHub()
+    server_rpc = RpcHub("tcp-server")
+    install_compute_call_type(server_rpc)
+    svc = Counters(server_fusion)
+    server_rpc.add_service("counters", svc)
+    server = await RpcTcpServer(server_rpc).start()
+    client_rpc = RpcHub("tcp-client")
+    install_compute_call_type(client_rpc)
+    client_rpc.client_connector = tcp_client_connector(server.host, server.port)
+    try:
+        client = compute_client("counters", client_rpc, FusionHub())
+        assert await client.get("k") == 0
+        node = await capture(lambda: client.get("k"))
+        await svc.increment("k")
+        # the $sys-c push crossed the real socket
+        await asyncio.wait_for(node.when_invalidated(), 5.0)
+        assert await client.get("k") == 1
+    finally:
+        await client_rpc.stop()
+        await server.stop()
+
+
+async def test_dcn_fallback_classification_over_real_tcp():
+    """The ISSUE 15 DCN-leg contract: a fence for a key subscribed by an
+    OFF-MESH cluster member counts as ``fusion_mesh_dcn_fallback_total``
+    AND actually travels the socket — exercised, not merely counted."""
+    from stl_fusion_tpu.core import (
+        TableBacking,
+        memo_table_of,
+        set_default_hub,
+    )
+    from stl_fusion_tpu.graph import TpuGraphBackend
+    from stl_fusion_tpu.rpc.fanout import install_compute_fanout
+
+    ns = 64
+    hub = FusionHub()
+    old = set_default_hub(hub)
+    server = None
+    client_rpc = None
+    try:
+        backend = TpuGraphBackend(hub, node_capacity=ns + 16, edge_capacity=256)
+
+        class RowSvc(ComputeService):
+            def load(self, ids):
+                return np.asarray(ids, dtype=np.float32)
+
+            @compute_method(table=TableBacking(rows=ns, batch="load"))
+            async def row(self, i: int) -> float:
+                return float(i)
+
+        svc = RowSvc(hub)
+        hub.add_service(svc)
+        table = memo_table_of(svc.row)
+        blk = backend.bind_table_rows(table)
+        table.read_batch(np.arange(ns))
+        backend.flush()
+
+        server_rpc = RpcHub("server")
+        install_compute_call_type(server_rpc)
+        server_rpc.add_service("rows", svc)
+        fanout = install_compute_fanout(server_rpc, backend)
+        # m0 is on this mesh; m1 is a cluster member on ANOTHER host: its
+        # relays are the legitimate DCN fallback
+        fanout.set_mesh_scope(["m0"], cluster_members=["m0", "m1"])
+        # ref_prefix="": the server-side peer ref IS the member name
+        server = await RpcTcpServer(server_rpc, ref_prefix="").start()
+
+        client_rpc = RpcHub("m1-client")
+        install_compute_call_type(client_rpc)
+        client_rpc.client_connector = tcp_client_connector(
+            server.host, server.port, client_id="m1"
+        )
+        client = compute_client("rows", client_rpc, FusionHub())
+        assert await client.row(5) == 5.0
+        node = await capture(lambda: client.row(5))
+        assert fanout.dcn_fallback_relays == 0
+        backend.cascade_rows_batch(blk, [5])
+        # the fence crossed the real socket
+        await asyncio.wait_for(node.when_invalidated(), 5.0)
+        assert fanout.dcn_fallback_relays >= 1
+        assert fanout.mesh_member_relays == 0  # nothing on-mesh relayed
+        fanout.dispose()
+    finally:
+        if client_rpc is not None:
+            await client_rpc.stop()
+        if server is not None:
+            await server.stop()
+        set_default_hub(old)
