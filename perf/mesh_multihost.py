@@ -19,17 +19,33 @@ gates the claims PR 9 could only count:
    processes (``fusion_mesh_dcn_fallback_total`` EXERCISED, not merely
    counted).
 
-2. **Host-kill chaos leg** — both hosts run chain rounds, snapshotting
-   their LOCAL shards per round (checkpoint.save_mesh_shards machinery).
-   The parent SIGKILLs host 1 mid-burst; host 0's watchdog notices (file
-   flag from the parent OR a stuck collective) and exits; the SURVIVOR
-   phase restarts host 0 alone — membership reassigns the dead host's
-   shards (``ShardMap.with_members``), the new placement re-packs onto
-   the surviving device pool, per-shard snapshots restore, and the
-   remaining rounds must be oracle-exact (recovery time recorded). The
-   REJOIN phase brings host 1 back: a fresh 2-host mesh warm-rejoins
-   from the survivor's snapshots and finishes the round schedule, again
-   oracle-exact. Zero oracle-divergent waves anywhere or the leg fails.
+2. **Elastic chaos ladder (ISSUE 16)** — the survivor NEVER restarts.
+   Each ``elastic`` host forms the world, runs round 0 attached (warming
+   the gloo communicators), then DETACHES the coordination agent
+   (``detach_world`` — a peer death no longer aborts survivors) and hands
+   membership to :class:`~stl_fusion_tpu.cluster.mesh_controller.
+   MeshController`. The parent SIGKILLs host 1 mid-burst (timing from the
+   ``host_kill_reform`` ChaosPolicy): the survivor's evidence converges
+   (round-deadline overrun on the wedged dispatch thread + heartbeat
+   lapse + the orchestrator's dead flag), it DEGRADES in-process (counted
+   ``mesh_degraded``, local serving continues), re-forms over the
+   survivors via the rendezvous board's counted election ladder, rebuilds
+   graph+placement for the new member set, restores every host's last
+   committed snapshot, and REPLAYS from the minimum committed round — the
+   first oracle-exact wave stamps ``host_kill_recovery_s`` (gate: under
+   ``MESH_MH_RECOVERY_BUDGET_S``). The FLAP rung relaunches host 1 as a
+   live JOINER moments later: members absorb it at an agreed round
+   boundary (re-form to N+1, boundary snapshots rebalance the shards) and
+   the schedule finishes on both hosts with zero divergent waves. A
+   separate JOIN leg grows 2 → 3 hosts live (non-power-of-2: the hier
+   exchange resolves via the counted gather fallback), and a PARTITION
+   leg (``mesh_partition`` policy) proves a lone heartbeat lapse rides
+   through without a degrade.
+
+3. **Geometry certify legs** — ``MESH_MH_GEOMETRIES`` (default "4,3")
+   re-runs the scale oracle at each emulated host count: 4 (and 8 in the
+   record protocol) certify the hierarchical exchange past 2 hosts;
+   3 certifies the non-power-of-2 gather fallback, counted and exact.
 
 Run as orchestrator: ``python perf/mesh_multihost.py`` (or via
 perf/mesh_path.py with ``MESH_MULTIHOST=2``). The worker entry is this
@@ -37,7 +53,13 @@ same file with ``--worker`` (the launcher env carries the rest).
 
 Env: MESH_MULTIHOST (2), MESH_MH_DPH (2), MESH_MH_NODES (40_000),
 MESH_MH_SHARDS (64), MESH_MH_ROUNDS (4), MESH_MH_SEEDS_PER_ROUND (4),
-MESH_MH_EXCHANGE (hier), MESH_MH_CHAOS (1), MESH_MH_SCALE (1),
+MESH_MH_EXCHANGE (hier), MESH_MH_SCALE (1), MESH_MH_ELASTIC (1),
+MESH_MH_JOIN3 (1), MESH_MH_PARTITION (1), MESH_MH_GEOMETRIES (4,3),
+MESH_MH_RECOVERY_BUDGET_S (15), MESH_MH_JOIN_BUDGET_S (30),
+MESH_MH_EXPECT_JOINS (0: members hold the last MESH_MH_JOIN_RESERVE (2)
+rounds until that many scripted joiners are absorbed — smoke schedules
+otherwise finish before a joiner's interpreter is up; violation after
+MESH_MH_JOIN_HOLD_S (180)), MESH_MH_GEOM_NODES (12000),
 MESH_MH_XCHECK (1: parent single-process oracle cross-check),
 MESH_MH_TIMEOUT (600s per phase).
 """
@@ -361,18 +383,13 @@ def run_worker() -> int:
         if os.environ.get("MESH_MH_RESIZE", "1") == "1":
             _resize_leg(graph, src, dst, n, mask_know, result)
         # DCN leg: a fence relayed to the OTHER host process over TCP
-        ctx.sync("pre-dcn")
-        import asyncio
+        # (geometry certify legs skip it — it is a 2-host protocol)
+        if os.environ.get("MESH_MH_DCN", "1") == "1":
+            ctx.sync("pre-dcn")
+            import asyncio
 
-        asyncio.run(_dcn_leg(ctx, mh_dir, result))
-        ctx.sync("post-dcn")
-
-    if phase == "survivor":
-        # the survivor saves ALL shards so the rejoin phase warm-starts
-        # from the post-recovery state
-        save_mesh_shards(
-            graph, os.path.join(mh_dir, "snap_survivor.npz")
-        )
+            asyncio.run(_dcn_leg(ctx, mh_dir, result))
+            ctx.sync("post-dcn")
 
     st = graph.stats()
     result["stats"] = {
@@ -380,7 +397,7 @@ def run_worker() -> int:
         for k in (
             "exchange", "hosts", "waves_run", "exchange_levels_total",
             "cross_host_words", "cross_words_per_level", "bucket_resizes",
-            "e_cap", "bucket_cap", "hbucket_cap",
+            "hier_fallbacks", "e_cap", "bucket_cap", "hbucket_cap",
         )
     }
     result["inv_per_s"] = round(int(mask_know.sum()) / max(burst_s, 1e-9), 1)
@@ -438,6 +455,463 @@ def save_mesh_shards_local(graph, path: str, save_fn) -> None:
             return snap
 
     save_fn(_Shim(), path)
+
+
+# =============================================================== elastic worker
+def _read_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _wait_json(path: str, timeout_s: float = 180.0) -> dict:
+    t0 = time.time()
+    while True:
+        rec = _read_json(path)
+        if rec is not None:
+            return rec
+        if time.time() - t0 > timeout_s:
+            raise TimeoutError(f"rendezvous file {path} never appeared")
+        time.sleep(0.05)
+
+
+def run_elastic_worker() -> int:
+    """One ELASTIC host process (ISSUE 16): survives peer death, flap and
+    live join WITHOUT restarting.
+
+    Round 0 runs attached (compiling the chain program and warming the
+    gloo communicators), then the coordination agent is DETACHED — from
+    that moment the MeshController owns membership. Every later round
+    dispatches on a worker thread under a deadline: an overrun is counted
+    evidence (the wedged-collective tell), and when independent signals
+    converge on a peer the survivor degrades in-process (the wedged
+    thread is the documented zombie), re-forms over the survivors via the
+    board's counted election ladder, rebuilds graph+placement for the new
+    member set, restores every host's last committed snapshot and replays
+    from the minimum committed round — the first oracle-exact wave stamps
+    the ``recovered-*`` file the orchestrator gates on. Pending JOINs
+    absorb at a PLANNED round boundary (the lowest-ranked member writes
+    the plan one boundary ahead, so collectively-synchronized members
+    never split-brain on when to re-form): members snapshot, re-form to
+    N+k, and everyone — joiner included — restores and continues the same
+    schedule, zero divergent waves."""
+    import threading
+
+    from stl_fusion_tpu.checkpoint import restore_mesh_shards, save_mesh_shards
+    from stl_fusion_tpu.cluster import DevicePlacement, ShardMap
+    from stl_fusion_tpu.cluster.mesh_controller import (
+        JaxWorldOps,
+        MeshController,
+        RendezvousBoard,
+    )
+    from stl_fusion_tpu.cluster.multihost import (
+        ENV_DEVICES_PER_HOST,
+        ENV_PROCESS_ID,
+        init_multihost,
+        teardown_world,
+    )
+    from stl_fusion_tpu.graph.synthetic import power_law_dag
+    from stl_fusion_tpu.parallel import RoutedShardedGraph, graph_mesh
+    from stl_fusion_tpu.resilience.events import global_events
+
+    mh_dir = os.environ["MESH_MH_DIR"]
+    n = _env_int("MESH_MH_NODES", 40_000)
+    n_shards = _env_int("MESH_MH_SHARDS", 64)
+    exchange = os.environ.get("MESH_MH_EXCHANGE", "hier")
+    rounds_total = _env_int("MESH_MH_ROUNDS", 6)
+    per_round = _env_int("MESH_MH_SEEDS_PER_ROUND", 4)
+    stages = _env_int("MESH_MH_STAGES", 2)
+    round_deadline_s = float(os.environ.get("MESH_MH_ROUND_DEADLINE", "6"))
+    hb_timeout_s = float(os.environ.get("MESH_MH_HB_TIMEOUT", "2"))
+    all_members = os.environ["MESH_MH_MEMBERS"].split(",")
+    is_joiner = os.environ.get("MESH_MH_JOINER", "0") == "1"
+    absorb = os.environ.get("MESH_MH_ABSORB", "1") == "1"
+    partition_target = os.environ.get("MESH_MH_PARTITION_TARGET", "")
+    # scripted-join pacing: members expecting a live joiner RESERVE the
+    # last rounds, holding that boundary until the join is absorbed — a
+    # smoke-scale schedule finishes in under a second, long before the
+    # joiner's interpreter is even up
+    expect_joins = 0 if is_joiner else _env_int("MESH_MH_EXPECT_JOINS", 0)
+    join_reserve = _env_int("MESH_MH_JOIN_RESERVE", 2)
+    join_hold_s = float(os.environ.get("MESH_MH_JOIN_HOLD_S", "180"))
+    dph = int(os.environ[ENV_DEVICES_PER_HOST])
+
+    if is_joiner:
+        member_id = os.environ["MESH_MH_MEMBER_ID"]
+    else:
+        member_id = all_members[int(os.environ.get(ENV_PROCESS_ID, "0"))]
+
+    board = RendezvousBoard(os.path.join(mh_dir, "board"))
+    events = global_events()
+    ops = JaxWorldOps(dph)
+    src, dst = power_law_dag(n, avg_degree=3.0, seed=7)
+    schedule = round_seeds(123, n, rounds_total, per_round, stages)
+    result: dict = {
+        "phase": "elastic",
+        "member": member_id,
+        "joiner": is_joiner,
+        "violations": [],
+        "recoveries": [],
+        "joins": [],
+    }
+    stop_beats = threading.Event()
+    hold_beats = threading.Event()
+
+    def _closure(upto: int):
+        flat = [s for rr in schedule[:upto] for st in rr for s in st]
+        return numpy_bfs_mask(src, dst, n, flat)
+
+    def _progress(m: str) -> int:
+        try:
+            with open(os.path.join(mh_dir, f"progress_{m}")) as f:
+                return int(f.read() or 0)
+        except OSError:
+            return 0
+
+    g = None
+    ctl = None
+    divergence = 0
+    r = 0
+    try:
+        if is_joiner:
+            # form FIRST, touch jax after: a pre-existing local backend
+            # would ignore the gloo collectives config form_world installs
+            ctl = MeshController(
+                member_id, [member_id], board, ops, events=events,
+                heartbeat_timeout_s=hb_timeout_s,
+            )
+            world = ctl.join(
+                timeout_s=float(os.environ.get("MESH_MH_JOIN_TIMEOUT", "180"))
+            )
+            r = int(
+                _wait_json(os.path.join(mh_dir, f"resume-{ctl.epoch}.json"))["round"]
+            )
+        else:
+            ctx = init_multihost()
+            ctl = MeshController(
+                member_id, all_members[: ctx.n_hosts], board, ops,
+                events=events, heartbeat_timeout_s=hb_timeout_s,
+            )
+            world = ctl.adopt_world(ctx)
+        log(f"[{member_id}/elastic] epoch {ctl.epoch} members={ctl.members}")
+
+        def _beater():
+            while not stop_beats.wait(0.3):
+                if not hold_beats.is_set():
+                    ctl.beat()
+
+        threading.Thread(target=_beater, daemon=True, name="mesh-beater").start()
+
+        def _build(live):
+            t0 = time.time()
+            smap = ShardMap.initial(all_members, n_shards=n_shards)
+            if list(live) != list(all_members):
+                smap = smap.with_members(list(live))
+            placement = DevicePlacement.build(
+                smap, len(live) * dph, n, mesh_members=list(live),
+                devices_per_host=dph,
+            )
+            built = RoutedShardedGraph(
+                src, dst, n, placement, mesh=graph_mesh(), exchange=exchange
+            )
+            log(
+                f"[{member_id}/elastic] graph over {list(live)} in "
+                f"{time.time() - t0:.1f}s (exchange {built.exchange})"
+            )
+            return built
+
+        def _restore(into, members, *, only_progress=None) -> int:
+            restored = 0
+            for m in members:
+                if only_progress is not None and _progress(m) != only_progress:
+                    continue  # a stale flap-era snapshot must not shadow fresh bits
+                path = os.path.join(mh_dir, f"snap_{m}.npz")
+                if os.path.exists(path):
+                    restored += restore_mesh_shards(into, path)["restored"]
+            return restored
+
+        def _commit_snapshot(committed: int) -> None:
+            save_mesh_shards_local(
+                g, os.path.join(mh_dir, f"snap_{member_id}.npz"), save_mesh_shards
+            )
+            _put_file(os.path.join(mh_dir, f"progress_{member_id}"), str(committed))
+
+        def _full_mask_check(upto: int, what: str) -> bool:
+            want = _closure(upto)
+            got = g.invalid_mask()
+            ok = bool(np.array_equal(got, want))
+            if not ok:
+                result["violations"].append(
+                    f"{what}: mask diverged at {int((got != want).sum())} node(s)"
+                )
+            return ok
+
+        mask_know = _closure(r)
+
+        def _stage_check(round_idx: int, counts) -> None:
+            nonlocal mask_know, divergence
+            seen = set(np.nonzero(mask_know)[0].tolist())
+            for st, c in zip(schedule[round_idx], counts):
+                want = {
+                    x
+                    for x in np.nonzero(
+                        numpy_bfs_mask(src, dst, n, st)
+                    )[0].tolist()
+                    if x not in seen
+                }
+                seen |= want
+                if int(c) != len(want):
+                    divergence += 1
+            mask_know = np.zeros(n, dtype=bool)
+            mask_know[np.fromiter(seen, dtype=np.int64, count=len(seen))] = True
+
+        # detach must WAIT until one real chain round has run in a fresh
+        # world: new gloo communicators rendezvous through the agent's KV
+        # store, so the first round after any (re-)form runs attached
+        pending_detach = False
+        if is_joiner:
+            g = _build(ctl.members)
+            result["restored_shards"] = _restore(g, ctl.members, only_progress=r)
+            world.sync("post-join")
+            ok = _full_mask_check(r, "joiner warm start")
+            mask_know = _closure(r)
+            _put_file(
+                os.path.join(mh_dir, f"rebalanced-{member_id}"),
+                json.dumps({"ts": time.time(), "round": r, "oracle_exact": ok}),
+            )
+            pending_detach = True
+        else:
+            g = _build(ctl.members)
+            # round 0 runs ATTACHED: it compiles the chain program and
+            # warms the gloo communicators that must outlive the agent
+            counts, _ids, _info = g.harvest_union_chain(
+                g.dispatch_union_chain(schedule[0])
+            )
+            _stage_check(0, counts)
+            r = 1
+            _commit_snapshot(r)
+            if world.is_multiprocess:
+                ctl.detach()
+            _put_file(os.path.join(mh_dir, f"detached-{member_id}"), "1")
+
+        recovery_target = None  # committed-round count that completes a recovery
+
+        def _stamp_recovery() -> None:
+            nonlocal recovery_target, mask_know
+            ok = _full_mask_check(r, "recovery")
+            mask_know = _closure(r)
+            _put_file(
+                os.path.join(mh_dir, f"recovered-{member_id}"),
+                json.dumps({"ts": time.time(), "round": r, "oracle_exact": ok}),
+            )
+            recovery_target = None
+
+        def _dispatch_with_deadline(graph_now, round_idx):
+            holder = {"done": threading.Event(), "counts": None, "err": None}
+
+            def _run():
+                try:
+                    pending = graph_now.dispatch_union_chain(schedule[round_idx])
+                    holder["counts"] = graph_now.harvest_union_chain(pending)[0]
+                except BaseException as e:  # noqa: BLE001 — the zombie reports, never raises
+                    holder["err"] = repr(e)
+                finally:
+                    holder["done"].set()
+
+            threading.Thread(
+                target=_run, daemon=True, name=f"dispatch-r{round_idx}"
+            ).start()
+            t0 = time.time()
+            overrun_noted = False
+            while not holder["done"].wait(0.2):
+                ctl.poll_evidence()
+                if not overrun_noted and time.time() - t0 > round_deadline_s:
+                    overrun_noted = True
+                    for peer in ctl.members:
+                        if peer != member_id:
+                            ctl.note_deadline_overrun(peer)
+                if ctl.dead_peers():
+                    return None  # abandon the wedge: recovery owns it now
+            return holder
+
+        partition_honored = False
+        hold_t0 = None
+        while r < rounds_total:
+            ctl.poll_evidence()
+            # DCN partition window (ChaosPolicy-scripted): the target
+            # hushes its beats and stalls — the peer must ride out the
+            # lone heartbeat lapse without degrading
+            if (
+                partition_target == member_id
+                and not partition_honored
+                and os.path.exists(os.path.join(mh_dir, "partition-pause.json"))
+            ):
+                rec = _wait_json(os.path.join(mh_dir, "partition-pause.json"))
+                partition_honored = True
+                hold_beats.set()
+                time.sleep(float(rec["dur"]))
+                hold_beats.clear()
+                result["partition_honored_s"] = rec["dur"]
+            # live JOIN absorption at a PLANNED boundary: the lowest rank
+            # publishes the plan one boundary ahead so every (collective-
+            # synchronized) member re-forms at the same round
+            holding = (
+                expect_joins
+                and ctl.joins_absorbed < expect_joins
+                and recovery_target is None
+                and r >= max(rounds_total - join_reserve, 1)
+            )
+            if absorb and recovery_target is None:
+                plan_path = os.path.join(mh_dir, f"absorb-plan-{ctl.epoch}.json")
+                plan = _read_json(plan_path)
+                pending_joins = ctl.pending_joins()
+                if (
+                    pending_joins
+                    and member_id == ctl.members[0]
+                    and (plan is None or plan["round"] < r)
+                ):
+                    # holding members all sit at THIS boundary, so absorb
+                    # now; mid-schedule the plan lands one boundary ahead
+                    # (collective lockstep means no member is past it yet)
+                    plan = {"round": r if holding else r + 1,
+                            "joiners": pending_joins}
+                    _put_file(plan_path, json.dumps(plan))
+                if (
+                    plan is not None
+                    and plan["round"] == r
+                    and any(j not in ctl.members for j in plan["joiners"])
+                ):
+                    _commit_snapshot(r)
+                    t0 = time.time()
+                    world = ctl.absorb_joins(plan["joiners"])
+                    _put_file(
+                        os.path.join(mh_dir, f"resume-{ctl.epoch}.json"),
+                        json.dumps({"round": r}),
+                    )
+                    g = _build(ctl.members)
+                    _restore(g, ctl.members, only_progress=r)
+                    world.sync("post-join")
+                    pending_detach = True
+                    _full_mask_check(r, f"post-join epoch {ctl.epoch}")
+                    mask_know = _closure(r)
+                    result["joins"].append(
+                        {
+                            "epoch": ctl.epoch,
+                            "members": list(ctl.members),
+                            "absorb_s": round(time.time() - t0, 2),
+                        }
+                    )
+                    hold_t0 = None
+                    continue
+            dead = ctl.dead_peers()
+            if dead:
+                prev_members = list(ctl.members)
+                survivors = [m for m in prev_members if m not in dead]
+                t0 = time.time()
+                ctl.degrade(f"evidence converged: {','.join(dead)}")
+                # the counted degrade window: LOCAL serving continues
+                # (eager, single-host) while the re-form ladder runs
+                import jax
+
+                local_ok = int(jax.jit(lambda a: a + 1)(np.arange(3))[2]) == 3
+                world = ctl.reform(survivors)
+                committed = [_progress(m) for m in prev_members]
+                replay_from, replay_to = min(committed), max(committed)
+                g = _build(ctl.members)
+                restored = _restore(g, prev_members)
+                world.sync("post-reform")
+                pending_detach = world.is_multiprocess
+                r = replay_from
+                recovery_target = replay_to
+                result["recoveries"].append(
+                    {
+                        "dead": dead,
+                        "epoch": ctl.epoch,
+                        "members": list(ctl.members),
+                        "replay_from": replay_from,
+                        "replay_to": replay_to,
+                        "restored_shards": restored,
+                        "local_serve_ok": local_ok,
+                        "reform_s": round(time.time() - t0, 2),
+                    }
+                )
+                if r >= recovery_target:
+                    _stamp_recovery()
+                continue
+            if holding:
+                # a smoke-scale schedule outruns a joiner's interpreter
+                # start: hold the reserved boundary (still beating, still
+                # polling evidence) until the scripted join is absorbed
+                if hold_t0 is None:
+                    hold_t0 = time.time()
+                if time.time() - hold_t0 > join_hold_s:
+                    result["violations"].append(
+                        f"expected {expect_joins} joiner(s), "
+                        f"{ctl.joins_absorbed} absorbed within {join_hold_s:.0f}s"
+                    )
+                    expect_joins = 0
+                else:
+                    time.sleep(0.2)
+                continue
+            holder = _dispatch_with_deadline(g, r)
+            if holder is None:
+                continue
+            if holder["err"]:
+                result["violations"].append(f"round {r}: {holder['err']}")
+                break
+            if recovery_target is None:
+                _stage_check(r, holder["counts"])
+            r += 1
+            _commit_snapshot(r)
+            if pending_detach:
+                # all world members reach this barrier after committing
+                # the SAME round (the collective kept them in lockstep)
+                pending_detach = False
+                if world.is_multiprocess:
+                    ctl.detach()
+            if recovery_target is not None and r >= recovery_target:
+                _stamp_recovery()
+
+        _full_mask_check(r, "phase end")
+    except Exception as e:  # noqa: BLE001 — the gate reads violations, not a traceback
+        result["violations"].append(f"elastic worker error: {e!r}")
+    stop_beats.set()
+    if divergence:
+        result["violations"].append(f"{divergence} chain stage(s) diverged")
+    result.update(
+        rounds_committed=r,
+        divergence=divergence,
+        serving_ts=time.time(),
+        controller=ctl.snapshot() if ctl is not None else None,
+        events={
+            k: events.count(k)
+            for k in (
+                "mesh_detached", "mesh_degraded", "mesh_evidence",
+                "mesh_reform_attempt", "mesh_reform_failed", "mesh_reform_ok",
+                "mesh_coordinator_takeover", "mesh_join_absorbed",
+                "mesh_joined", "hier_fallback",
+            )
+        },
+    )
+    if g is not None:
+        st = g.stats()
+        result["stats"] = {
+            k: st[k]
+            for k in (
+                "exchange", "hosts", "waves_run", "cross_host_words",
+                "bucket_resizes", "hier_fallbacks",
+            )
+        }
+    with open(
+        os.path.join(mh_dir, f"result_elastic_{member_id}.json"), "w"
+    ) as f:
+        json.dump(result, f)
+    # detach already retired the agent; drop any service/backends so the
+    # process exits clean (no jax.distributed.shutdown on a gone world)
+    teardown_world(rebuild_local=False)
+    return 0 if not result["violations"] else 1
 
 
 # ================================================================ orchestrator
@@ -540,9 +1014,17 @@ def run_multihost(out: dict) -> None:
             if os.environ.get("MESH_MH_XCHECK", "1") == "1":
                 mh["scale"]["xcheck"] = _single_process_xcheck(mh_dir, n, out)
 
-        # ---- host-kill chaos leg ----
-        if os.environ.get("MESH_MH_CHAOS", "1") == "1" and n_hosts >= 2:
-            _chaos_leg(n_hosts, dph, mh_dir, base_env, members, rounds, out, mh, _wait)
+        # ---- elastic chaos ladder (ISSUE 16): kill+flap, join, partition ----
+        if os.environ.get("MESH_MH_ELASTIC", "1") == "1" and n_hosts >= 2:
+            _elastic_leg(dph, mh_dir, base_env, members, out, mh, _wait)
+        if os.environ.get("MESH_MH_JOIN3", "1") == "1":
+            _join_leg(dph, mh_dir, base_env, out, mh, _wait)
+        if os.environ.get("MESH_MH_PARTITION", "1") == "1":
+            _partition_leg(dph, mh_dir, base_env, out, mh, _wait)
+        # ---- geometry certify: hier past 2 hosts, non-pow2 fallback ----
+        for spec in os.environ.get("MESH_MH_GEOMETRIES", "4,3").split(","):
+            if spec.strip():
+                _geometry_leg(int(spec), dph, mh_dir, base_env, out, mh, _wait)
 
 
 def _single_process_xcheck(mh_dir: str, n: int, out: dict) -> dict:
@@ -582,106 +1064,343 @@ def _single_process_xcheck(mh_dir: str, n: int, out: dict) -> dict:
     return {"ok": ok, "single_process_devices": int(mesh.devices.size)}
 
 
-def _chaos_leg(n_hosts, dph, mh_dir, base_env, members, rounds, out, mh, _wait):
-    log("multihost chaos leg: kill host 1 mid-burst, survivor serves, rejoin")
-    chaos_env = dict(
-        base_env,
-        MESH_MH_SNAPSHOT=1,
-        MESH_MH_ROUNDS=rounds,
-        MESH_MH_END_ROUND=max(rounds - 2, 1),
-        MESH_MH_ROUND_DEADLINE=45,
-    )
-    mid = max(rounds - 2, 1)
-    for f in ("peer-dead", "progress_h0", "progress_h1"):
-        path = os.path.join(mh_dir, f)
-        if os.path.exists(path):
-            os.unlink(path)
-    procs = _launch("main", n_hosts, dph, mh_dir, chaos_env)
-    # kill host 1 once it is genuinely mid-burst (≥1 round committed)
-    t_kill = None
-    deadline = time.time() + _env_int("MESH_MH_TIMEOUT", 600)
-    prog_file = os.path.join(mh_dir, "progress_h1")
-    while time.time() < deadline:
-        if os.path.exists(prog_file) and int(open(prog_file).read() or 0) >= 1:
-            procs[1].kill()
-            t_kill = time.time()
-            break
-        if procs[1].poll() is not None:
-            break
+def _wait_cond(cond, timeout_s: float, what: str, out: dict) -> bool:
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        if cond():
+            return True
         time.sleep(0.1)
-    if t_kill is None:
-        out["violations"].append("chaos: never reached the kill point")
+    out["violations"].append(f"{what}: timed out after {timeout_s:.0f}s")
+    return False
+
+
+def _elastic_leg(dph, root_dir, base_env, members, out, mh, _wait):
+    """Host-kill + flap rung: SIGKILL h1 mid-burst (ChaosPolicy-scripted),
+    the SAME h0 process degrades/re-forms/recovers under the budget, then
+    h1 relaunches as a live JOINER and is absorbed — zero divergent
+    waves, survivor never restarted (one Popen serves the whole arc)."""
+    from stl_fusion_tpu.cluster.mesh_controller import RendezvousBoard
+    from stl_fusion_tpu.resilience.chaos import SCENARIOS
+
+    kill_policy = SCENARIOS["host_kill_reform"]()
+    flap_policy = SCENARIOS["host_flap"]()
+    leg_dir = os.path.join(root_dir, "elastic")
+    os.makedirs(leg_dir, exist_ok=True)
+    rounds = max(_env_int("MESH_MH_ROUNDS", 4) + 2, 6)
+    budget = float(os.environ.get("MESH_MH_RECOVERY_BUDGET_S", "15"))
+    timeout_s = _env_int("MESH_MH_TIMEOUT", 600)
+    env = dict(
+        base_env,
+        MESH_MH_ROUNDS=rounds,
+        MESH_MH_ROUND_DEADLINE=os.environ.get("MESH_MH_ROUND_DEADLINE", "6"),
+        MESH_MH_EXPECT_JOINS=1,  # members hold the last rounds for the flap rejoin
+    )
+    log(f"elastic leg: kill {members[1]} mid-burst, in-process recovery, flap rejoin")
+    procs = _launch("elastic", 2, dph, leg_dir, env)
+
+    def _prog(m: str) -> int:
+        try:
+            with open(os.path.join(leg_dir, f"progress_{m}")) as f:
+                return int(f.read() or 0)
+        except OSError:
+            return 0
+
+    # kill only once BOTH hosts run detached (the agent's shutdown barrier
+    # must not be mid-flight) and the victim has committed detached rounds
+    ready = _wait_cond(
+        lambda: all(
+            os.path.exists(os.path.join(leg_dir, f"detached-{m}"))
+            for m in members[:2]
+        )
+        and _prog(members[1]) >= 2
+        and procs[1].poll() is None,
+        timeout_s, "elastic: kill point", out,
+    )
+    if not ready:
         for p in procs:
             p.kill()
         return
-    # flag the survivor (its watchdog exits even if wedged in a collective)
-    with open(os.path.join(mh_dir, "peer-dead"), "w") as f:
-        f.write("1")
-    _wait(procs, "chaos-main")
-    # last round BOTH hosts committed: the snapshots' consistent frontier.
-    # A host that died before its first progress write committed ROUND 0 —
-    # skipping its missing file would start the replay past its lost work
-    committed = min(
-        int(open(p).read() or 0) if os.path.exists(p) else 0
-        for p in (os.path.join(mh_dir, f"progress_h{h}") for h in range(n_hosts))
+    assert kill_policy.peer_kills, "host_kill_reform script names no victim"
+    victim = members[1]
+    procs[1].kill()
+    t_kill = time.time()
+    # the orchestrator that SIGKILLed the victim says so — the
+    # authoritative evidence signal (lapse + overrun converge without it)
+    RendezvousBoard(os.path.join(leg_dir, "board")).flag_dead(
+        victim, "sigkill by chaos driver"
     )
-    os.unlink(os.path.join(mh_dir, "peer-dead"))
-    # ---- survivor: host 0 alone, membership reassigns, snapshots restore
-    snaps = ",".join(os.path.join(mh_dir, f"snap_h{h}.npz") for h in range(n_hosts))
-    surv_env = dict(
+    # flap rung: the host_flap script's second kill offset is the fast-
+    # rejoin delay — relaunch the victim as a live JOINER while the
+    # survivor is still mid-recovery (its breaker window still open)
+    flap_delay = (
+        flap_policy.peer_kills[1][0] - flap_policy.peer_kills[0][0]
+    ) * 10.0
+    time.sleep(max(flap_delay, 0.5))
+    t_rejoin = time.time()
+    jprocs = _launch(
+        "elastic", 1, dph, leg_dir,
+        dict(env, MESH_MH_JOINER=1, MESH_MH_MEMBER_ID=victim,
+             MESH_MH_JOIN_TIMEOUT=timeout_s),
+    )
+    rcs = _wait([procs[0]] + jprocs, "elastic")
+    results = {
+        m: _read_json(os.path.join(leg_dir, f"result_elastic_{m}.json"))
+        for m in members[:2]
+    }
+    for m, res in results.items():
+        if res is None:
+            out["violations"].append(f"elastic: no result from {m}")
+        else:
+            out["violations"].extend(
+                f"elastic {m}: {v}" for v in res.get("violations", [])
+            )
+    if any(rc != 0 for rc in rcs):
+        out["violations"].append(f"elastic: nonzero exits {rcs}")
+    h0 = results.get(members[0]) or {}
+    rec = _read_json(os.path.join(leg_dir, f"recovered-{members[0]}"))
+    recovery_s = None
+    if rec is None:
+        out["violations"].append("elastic: survivor never stamped a recovery")
+    else:
+        recovery_s = round(rec["ts"] - t_kill, 2)
+        if not rec.get("oracle_exact"):
+            out["violations"].append("elastic: recovery wave not oracle-exact")
+        if recovery_s > budget:
+            out["violations"].append(
+                f"elastic: host_kill_recovery_s {recovery_s} > budget {budget}"
+            )
+    if not h0.get("recoveries"):
+        out["violations"].append("elastic: survivor recorded no recovery arc")
+    if not (h0.get("events") or {}).get("mesh_degraded"):
+        out["violations"].append("elastic: degrade window was not counted")
+    if not h0.get("joins"):
+        out["violations"].append("elastic: flap joiner never absorbed")
+    reb = _read_json(os.path.join(leg_dir, f"rebalanced-{victim}"))
+    if reb is None or not reb.get("oracle_exact"):
+        out["violations"].append("elastic: flap rejoin not oracle-exact")
+    mh["elastic"] = {
+        "killed_host": victim,
+        "host_kill_recovery_s": recovery_s,
+        "recovery_budget_s": budget,
+        "survivor_restarts": 0,  # structural: ONE Popen serves the whole arc
+        "survivor_epoch": (h0.get("controller") or {}).get("epoch"),
+        "recoveries": h0.get("recoveries"),
+        "joins": h0.get("joins"),
+        "flap_rejoin_s": round(reb["ts"] - t_rejoin, 2) if reb else None,
+        "divergence": [(res or {}).get("divergence") for res in results.values()],
+        "events": h0.get("events"),
+    }
+
+
+def _join_leg(dph, root_dir, base_env, out, mh, _wait):
+    """Live JOIN leg: a serving 2-host mesh absorbs h2 — re-form to 3
+    hosts (non-power-of-2: hier resolves via the counted gather
+    fallback), boundary snapshots rebalance, join-to-rebalanced gated."""
+    leg_dir = os.path.join(root_dir, "join3")
+    os.makedirs(leg_dir, exist_ok=True)
+    members = ["h0", "h1", "h2"]
+    rounds = max(_env_int("MESH_MH_ROUNDS", 4) + 2, 6)
+    budget = float(os.environ.get("MESH_MH_JOIN_BUDGET_S", "30"))
+    timeout_s = _env_int("MESH_MH_TIMEOUT", 600)
+    env = dict(
         base_env,
         MESH_MH_MEMBERS=",".join(members),
-        MESH_MH_START_ROUND=committed,
-        MESH_MH_END_ROUND=max(rounds - 1, committed),
-        MESH_MH_RESTORE=snaps,
         MESH_MH_ROUNDS=rounds,
-        MESH_MH_STAGE_ORACLE=0,  # restored state may run ahead of the replay
+        MESH_MH_EXPECT_JOINS=1,  # members hold the last rounds for h2
     )
-    sprocs = _launch("survivor", 1, dph, mh_dir, surv_env)
-    _wait(sprocs, "survivor")
-    sres = _read_results(mh_dir, "survivor", 1)
-    recovery_s = None
-    if sres:
-        out["violations"].extend(
-            f"survivor: {v}" for v in sres[0].get("violations", [])
-        )
-        if sres[0].get("oracle_exact") and t_kill is not None:
-            recovery_s = round(sres[0]["serving_ts"] - t_kill, 2)
-    else:
-        out["violations"].append("survivor phase produced no result")
-    # ---- rejoin: both hosts back, warm start from the survivor snapshot
-    rejoin_env = dict(
-        base_env,
-        MESH_MH_START_ROUND=max(rounds - 1, committed),
-        MESH_MH_END_ROUND=rounds,
-        MESH_MH_RESTORE=os.path.join(mh_dir, "snap_survivor.npz"),
-        MESH_MH_ROUNDS=rounds,
-        MESH_MH_STAGE_ORACLE=0,
+    log("join leg: live 2 -> 3 hosts (non-pow2 gather fallback, counted)")
+    procs = _launch("elastic", 2, dph, leg_dir, env)
+    ready = _wait_cond(
+        lambda: all(
+            os.path.exists(os.path.join(leg_dir, f"detached-{m}"))
+            for m in members[:2]
+        ),
+        timeout_s, "join3: detach point", out,
     )
-    rprocs = _launch("rejoin", n_hosts, dph, mh_dir, rejoin_env)
-    _wait(rprocs, "rejoin")
-    rres = _read_results(mh_dir, "rejoin", n_hosts)
-    if len(rres) < n_hosts:
-        out["violations"].append("rejoin phase lost a host result")
-    for r in rres:
-        out["violations"].extend(
-            f"rejoin h{r['host']}: {v}" for v in r.get("violations", [])
-        )
-    mh["chaos"] = {
-        "killed_host": 1,
-        "committed_rounds_at_kill": committed,
-        "host_kill_recovery_s": recovery_s,
-        "survivor_oracle_exact": sres[0].get("oracle_exact") if sres else None,
-        "survivor_restored_shards": sres[0].get("restored_shards") if sres else None,
-        "rejoin_oracle_exact": all(r.get("oracle_exact") for r in rres) if rres else None,
-        "rejoin_restored_shards": [r.get("restored_shards") for r in rres],
+    if not ready:
+        for p in procs:
+            p.kill()
+        return
+    t_join = time.time()
+    jprocs = _launch(
+        "elastic", 1, dph, leg_dir,
+        dict(env, MESH_MH_JOINER=1, MESH_MH_MEMBER_ID="h2",
+             MESH_MH_JOIN_TIMEOUT=timeout_s),
+    )
+    rcs = _wait(procs + jprocs, "join3")
+    results = {
+        m: _read_json(os.path.join(leg_dir, f"result_elastic_{m}.json"))
+        for m in members
     }
-    if recovery_s is None:
-        out["violations"].append("chaos: no recovery time recorded")
+    for m, res in results.items():
+        if res is None:
+            out["violations"].append(f"join3: no result from {m}")
+        else:
+            out["violations"].extend(
+                f"join3 {m}: {v}" for v in res.get("violations", [])
+            )
+    if any(rc != 0 for rc in rcs):
+        out["violations"].append(f"join3: nonzero exits {rcs}")
+    h0 = results.get("h0") or {}
+    if not h0.get("joins"):
+        out["violations"].append("join3: members absorbed no joiner")
+    reb = _read_json(os.path.join(leg_dir, "rebalanced-h2"))
+    join_s = None
+    if reb is None or not reb.get("oracle_exact"):
+        out["violations"].append("join3: joiner warm start not oracle-exact")
+    else:
+        join_s = round(reb["ts"] - t_join, 2)
+        if join_s > budget:
+            out["violations"].append(
+                f"join3: join_to_rebalanced_s {join_s} > budget {budget}"
+            )
+    st = h0.get("stats") or {}
+    if os.environ.get("MESH_MH_EXCHANGE", "hier") == "hier" and (
+        st.get("exchange") != "gather" or not st.get("hier_fallbacks")
+    ):
+        out["violations"].append(
+            f"join3: non-pow2 gather fallback not counted ({st})"
+        )
+    mh["join3"] = {
+        "join_to_rebalanced_s": join_s,
+        "join_budget_s": budget,
+        "final_members": (h0.get("controller") or {}).get("members"),
+        "joins": h0.get("joins"),
+        "exchange_after_join": st.get("exchange"),
+        "hier_fallbacks": st.get("hier_fallbacks"),
+        "divergence": [(res or {}).get("divergence") for res in results.values()],
+    }
+
+
+def _partition_leg(dph, root_dir, base_env, out, mh, _wait):
+    """DCN-partition ride-through: the mesh_partition ChaosPolicy window
+    silences h1's beats mid-leg; h0 must observe the lapse (counted
+    evidence) and NOT degrade — single-signal eviction is the bug this
+    leg pins."""
+    from stl_fusion_tpu.resilience.chaos import SCENARIOS
+
+    policy = SCENARIOS["mesh_partition"]()
+    dur = round(policy.partitions[0][1] * 2.0, 1)  # scripted window -> wall time
+    leg_dir = os.path.join(root_dir, "partition")
+    os.makedirs(leg_dir, exist_ok=True)
+    timeout_s = _env_int("MESH_MH_TIMEOUT", 600)
+    env = dict(
+        base_env,
+        MESH_MH_ROUNDS=6,
+        MESH_MH_ROUND_DEADLINE=45,
+        MESH_MH_HB_TIMEOUT=1.0,
+        MESH_MH_ABSORB=0,
+        MESH_MH_PARTITION_TARGET="h1",
+    )
+    log(f"partition leg: {dur}s beat blackout on h1 — must ride through")
+    procs = _launch("elastic", 2, dph, leg_dir, env)
+    ready = _wait_cond(
+        lambda: all(
+            os.path.exists(os.path.join(leg_dir, f"detached-h{i}"))
+            for i in range(2)
+        ),
+        timeout_s, "partition: detach point", out,
+    )
+    if not ready:
+        for p in procs:
+            p.kill()
+        return
+    _put_file(
+        os.path.join(leg_dir, "partition-pause.json"),
+        json.dumps({"member": "h1", "dur": dur}),
+    )
+    rcs = _wait(procs, "partition")
+    results = {
+        f"h{i}": _read_json(os.path.join(leg_dir, f"result_elastic_h{i}.json"))
+        for i in range(2)
+    }
+    for m, res in results.items():
+        if res is None:
+            out["violations"].append(f"partition: no result from {m}")
+        else:
+            out["violations"].extend(
+                f"partition {m}: {v}" for v in res.get("violations", [])
+            )
+    if any(rc != 0 for rc in rcs):
+        out["violations"].append(f"partition: nonzero exits {rcs}")
+    h0 = results.get("h0") or {}
+    h1 = results.get("h1") or {}
+    ctl0 = h0.get("controller") or {}
+    ev_h1 = (ctl0.get("evidence") or {}).get("h1") or {}
+    if ctl0.get("degrades"):
+        out["violations"].append("partition: degraded on a lone lapse")
+    if "heartbeat_lapse" not in (ev_h1.get("kinds") or {}):
+        out["violations"].append("partition: lapse evidence never observed")
+    if "partition_honored_s" not in h1:
+        out["violations"].append("partition: target never honored the window")
+    mh["partition"] = {
+        "window_s": dur,
+        "degrades": ctl0.get("degrades"),
+        "evidence_score": ev_h1.get("score"),
+        "evidence_kinds": sorted((ev_h1.get("kinds") or {})),
+        "divergence": [(res or {}).get("divergence") for res in results.values()],
+    }
+
+
+def _geometry_leg(hosts, dph, root_dir, base_env, out, mh, _wait):
+    """Geometry certify: the scale oracle at ``hosts`` emulated hosts —
+    pow2 counts certify the hierarchical exchange proper; non-pow2 counts
+    certify the counted gather fallback (exact, never a decline)."""
+    leg_dir = os.path.join(root_dir, f"geom{hosts}")
+    os.makedirs(leg_dir, exist_ok=True)
+    members = [f"h{i}" for i in range(hosts)]
+    n = min(_env_int("MESH_MH_NODES", 40_000), _env_int("MESH_MH_GEOM_NODES", 12_000))
+    env = dict(
+        base_env,
+        MESH_MH_MEMBERS=",".join(members),
+        MESH_MH_NODES=n,
+        MESH_MH_ROUNDS=2,
+        MESH_MH_RESIZE=0,
+        MESH_MH_DCN=0,
+    )
+    log(f"geometry certify: {hosts} hosts x {dph} devices, {n} nodes")
+    t0 = time.time()
+    procs = _launch("scale", hosts, dph, leg_dir, env)
+    rcs = _wait(procs, f"geom{hosts}")
+    results = _read_results(leg_dir, "scale", hosts)
+    if len(results) < hosts or any(rc != 0 for rc in rcs):
+        out["violations"].append(
+            f"geom{hosts}: rcs={rcs}, results={len(results)}/{hosts}"
+        )
+    for res in results:
+        out["violations"].extend(
+            f"geom{hosts} h{res['host']}: {v}" for v in res.get("violations", [])
+        )
+    h0 = next((res for res in results if res.get("host") == 0), {})
+    st = h0.get("stats") or {}
+    pow2 = hosts & (hosts - 1) == 0
+    if os.environ.get("MESH_MH_EXCHANGE", "hier") == "hier":
+        if pow2 and (st.get("exchange") != "hier" or st.get("hier_fallbacks")):
+            out["violations"].append(
+                f"geom{hosts}: pow2 geometry lost the hier exchange ({st})"
+            )
+        if not pow2 and (
+            st.get("exchange") != "gather" or st.get("hier_fallbacks") != 1
+        ):
+            out["violations"].append(
+                f"geom{hosts}: non-pow2 fallback not counted ({st})"
+            )
+    mh.setdefault("geometry", {})[str(hosts)] = {
+        "hosts": hosts,
+        "nodes": n,
+        "wall_s": round(time.time() - t0, 1),
+        "oracle_exact": h0.get("oracle_exact"),
+        "inv_per_s": h0.get("inv_per_s"),
+        "exchange": st.get("exchange"),
+        "hier_fallbacks": st.get("hier_fallbacks"),
+        "cross_host_words": st.get("cross_host_words"),
+    }
 
 
 def main() -> None:
     if "--worker" in sys.argv:
+        if os.environ.get("MESH_MH_PHASE") == "elastic":
+            sys.exit(run_elastic_worker())
         sys.exit(run_worker())
     out: dict = {"violations": []}
     run_multihost(out)

@@ -30,8 +30,20 @@ metrics registry. CLUSTER.md is the runbook.
   DEVICE half — the same epoch-versioned assignment extended onto the
   accelerator mesh, pinning each member's CSR slice to its devices; the
   layout contract parallel/routed_wave.py builds on.
+- :mod:`.mesh_controller` — ``MeshController`` (ISSUE 16): elastic
+  multi-host membership — evidence-converged death detection, counted
+  in-process degrade (the survivor never restarts), coordinator
+  re-election + re-form ladder over the rendezvous board, and live JOIN
+  absorption. CLUSTER.md "Elastic mesh" is the runbook.
 """
 from .membership import ClusterMember
+from .mesh_controller import (
+    JaxWorldOps,
+    MeshController,
+    MeshReformError,
+    PeerEvidence,
+    RendezvousBoard,
+)
 from .placement import DevicePlacement, PlacementError
 from .rebalancer import ClusterRebalancer
 from .rejoin import RejoinReport, fence_moved_keys, verify_restore, warm_rejoin
@@ -51,8 +63,13 @@ __all__ = [
     "ClusterRebalancer",
     "DEFAULT_SHARDS",
     "DevicePlacement",
+    "JaxWorldOps",
+    "MeshController",
+    "MeshReformError",
     "MultiHostContext",
+    "PeerEvidence",
     "PlacementError",
+    "RendezvousBoard",
     "init_multihost",
     "launch_hosts",
     "pick_coordinator",

@@ -40,6 +40,31 @@ Gotcha (measured, not theoretical): setting
 ``jax.distributed`` breaks single-process CPU client creation on this
 jax — so the gloo config is applied only on the genuinely multi-process
 path.
+
+Elastic world mechanics (ISSUE 16): ``jax.distributed.initialize`` can
+run exactly once per process (it refuses after backends exist), and the
+coordination service it installs is all-or-nothing — any task death
+propagates a fatal error that ABORTS every survivor from inside the
+error-polling agent (measured: SIGKILL a peer and the survivor dies
+rc=-6 in ``PollForError`` with no Python frame on the stack). Both
+properties are wrong for a mesh that must outlive its members, so this
+module owns the world lifecycle directly:
+
+- :func:`form_world` builds the coordination service (process 0) and
+  client through ``xla_extension`` and installs them into jax's
+  ``global_state`` — repeatable any number of times per process.
+- :func:`detach_world` gracefully retires the coordination agent AFTER
+  backend formation (``client.shutdown()`` is itself the cross-host
+  barrier). The gloo pairs are already established peer-to-peer, so
+  collectives keep running — but with no agent left polling, a later
+  peer death can no longer abort the survivor. Failure detection moves
+  where it belongs: :class:`~.mesh_controller.MeshController`.
+- :func:`teardown_world` abandons a (possibly wedged) world in-process:
+  drop the service/client refs, clear backends + jit caches, reset the
+  collectives config. A dispatch thread blocked inside a wedged gloo
+  collective keeps the OLD backend alive as a zombie (C++ offers no
+  cancellation); the fresh world forms on new ports regardless — that
+  leaked thread is the measured cost of surviving without a restart.
 """
 from __future__ import annotations
 
@@ -56,6 +81,10 @@ __all__ = [
     "launch_hosts",
     "host_env",
     "pick_coordinator",
+    "form_world",
+    "detach_world",
+    "teardown_world",
+    "world_is_formed",
     "ENV_NUM_HOSTS",
     "ENV_PROCESS_ID",
     "ENV_COORDINATOR",
@@ -78,6 +107,11 @@ class MultiHostContext:
     n_hosts: int
     devices_per_host: int
     coordinator: Optional[str] = None
+    #: the coordination agent has been retired (detach_world): collectives
+    #: still run over the established gloo pairs, but cross-host phase
+    #: sequencing must come from the caller's own machinery, and shutdown
+    #: is a local drop instead of a coordinated barrier
+    detached: bool = False
 
     @property
     def n_dev(self) -> int:
@@ -112,8 +146,21 @@ class MultiHostContext:
 
         multihost_utils.sync_global_devices(tag)
 
+    def detach(self) -> bool:
+        """Retire this host's coordination agent (see :func:`detach_world`).
+        Blocks until every host calls it — the agent's shutdown barrier IS
+        the cross-host synchronization point."""
+        if not self.is_multiprocess or self.detached:
+            return False
+        self.detached = detach_world()
+        return self.detached
+
     def shutdown(self) -> None:
         if not self.is_multiprocess:
+            return
+        if self.detached:
+            # no agent left to coordinate a barrier through — local drop
+            teardown_world(rebuild_local=False)
             return
         import jax
 
@@ -124,6 +171,133 @@ class MultiHostContext:
             # unreachable, and shutdown-on-exit must not mask the run's
             # real result; counted by the caller's exit path, not here
             pass
+
+
+def _global_state():
+    from jax._src import distributed as jdist
+
+    return jdist.global_state
+
+
+def world_is_formed() -> bool:
+    """Whether a coordination client is currently installed (a DETACHED
+    world reports False — its agent is gone by design)."""
+    return _global_state().client is not None
+
+
+def form_world(
+    n_hosts: int,
+    process_id: int,
+    coordinator: str,
+    *,
+    heartbeat_interval_s: int = 2,
+    max_missing_heartbeats: int = 10,
+    init_timeout_s: int = 60,
+    shutdown_timeout_s: int = 30,
+) -> None:
+    """Bring up the ``jax.distributed`` world directly (service on process
+    0 + client everywhere), installing the handles into jax's
+    ``global_state`` exactly as ``jax.distributed.initialize`` would —
+    minus its once-per-process restriction, so a surviving process can
+    re-form over a new member set after :func:`teardown_world`.
+
+    Idempotence guard: refuses when a client is already installed —
+    tear the old world down first, don't stack worlds."""
+    import jax
+    from jax._src.lib import xla_extension
+
+    state = _global_state()
+    if state.client is not None:
+        raise RuntimeError("a coordination client is already installed; "
+                           "teardown_world() before re-forming")
+    try:
+        if jax.config.jax_platforms != "cpu":
+            jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend already initialized
+        pass
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    if process_id == 0 and state.service is None:
+        port = coordinator.rsplit(":", 1)[1]
+        state.service = xla_extension.get_distributed_runtime_service(
+            f"[::]:{port}",
+            n_hosts,
+            heartbeat_interval=heartbeat_interval_s,
+            max_missing_heartbeats=max_missing_heartbeats,
+        )
+    client = xla_extension.get_distributed_runtime_client(
+        coordinator,
+        process_id,
+        init_timeout=init_timeout_s,
+        shutdown_timeout=shutdown_timeout_s,
+        heartbeat_interval=heartbeat_interval_s,
+        max_missing_heartbeats=max_missing_heartbeats,
+        # destruction must NEVER imply a barrier: teardown_world drops the
+        # ref with the peer possibly dead, and a destructor that dials the
+        # coordinator would wedge the survivor right back
+        shutdown_on_destruction=False,
+        use_compression=True,
+    )
+    client.connect()
+    state.client = client
+    state.process_id = process_id
+    state.num_processes = n_hosts
+    state.coordinator_address = coordinator
+
+
+def detach_world() -> bool:
+    """Gracefully retire the coordination agent AFTER world formation.
+
+    ``client.shutdown()`` runs the coordination service's own shutdown
+    barrier, so every host blocks here until all of them detach — a free
+    synchronization point. Afterwards the established gloo communicators
+    keep serving collectives, but no agent is left error-polling: a peer
+    SIGKILL surfaces as a wedged collective (detectable, survivable)
+    instead of a process abort (measured rc=-6 without this). Returns
+    False when no client is installed (single-host or already detached)."""
+    state = _global_state()
+    if state.client is None:
+        return False
+    state.client.shutdown()
+    state.client = None
+    return True
+
+
+def teardown_world(*, rebuild_local: bool = True) -> None:
+    """Abandon the current world in-process: drop the coordination
+    handles, clear backends and jit caches, and (by default) reset the
+    collectives config so the next backend is a plain local CPU pool.
+
+    Safe with a collective wedged on another thread: that thread keeps
+    the old backend alive as an abandoned zombie (no cancellation exists
+    for an in-flight gloo op), while new backends form independently on
+    fresh ports. Callers re-enter :func:`form_world` afterwards — or just
+    compute locally when ``rebuild_local`` left the config at ``none``."""
+    import jax
+
+    state = _global_state()
+    # the dead-peer case: no graceful shutdown is possible; dropping the
+    # refs is the teardown (shutdown_on_destruction=False by contract)
+    state.client = None
+    if state.service is not None:
+        try:
+            state.service.shutdown()
+        except Exception:  # noqa: BLE001 — peers gone mid-barrier; the
+            # service is being abandoned either way
+            pass
+        state.service = None
+    state.preemption_sync_manager = None
+    state.process_id = 0
+    state.num_processes = 1  # the pristine default — the CPU backend
+    # factory passes this straight through as num_nodes and rejects None
+    state.coordinator_address = None
+    if rebuild_local:
+        # 'none' (string) is the real local implementation — Python None
+        # is rejected by this jax's config validator
+        jax.config.update("jax_cpu_collectives_implementation", "none")
+    from jax.extend import backend as _jeb
+
+    _jeb.clear_backends()
+    jax.clear_caches()
 
 
 def init_multihost(
@@ -157,13 +331,11 @@ def init_multihost(
         if not coordinator:
             raise ValueError(f"multi-host init needs a coordinator ({ENV_COORDINATOR})")
         # gloo ONLY on the real multi-process path: configuring it without
-        # jax.distributed.initialize breaks CPU client creation outright
-        jax.config.update("jax_cpu_collectives_implementation", "gloo")
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=n_hosts,
-            process_id=process_id,
-        )
+        # a distributed world breaks CPU client creation outright.
+        # form_world (not jax.distributed.initialize) so the SAME process
+        # can tear down and re-form after a member change — the elastic
+        # mesh's whole point (ISSUE 16)
+        form_world(n_hosts, process_id, coordinator)
     local = jax.local_device_count()
     if devices_per_host is None:
         devices_per_host = int(os.environ.get(ENV_DEVICES_PER_HOST, str(local)))

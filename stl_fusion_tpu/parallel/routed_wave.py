@@ -336,10 +336,26 @@ class RoutedShardedGraph:
             exchange = "gather"  # tree needs 2^k devices; honest fallback
         self.dph = placement.devices_per_host or placement.n_dev
         self.n_hosts = placement.n_dev // self.dph
+        #: hier requested but the geometry can't ride the xor trees —
+        #: resolved via gather instead of declining (ISSUE 16), COUNTED:
+        #: a non-power-of-2 mesh silently losing its hierarchical exchange
+        #: would misread as a perf regression with no telemetry trail
+        self.hier_fallbacks = 0
         if exchange == "hier" and (
             (self.dph & (self.dph - 1)) or (self.n_hosts & (self.n_hosts - 1))
         ):
             exchange = "gather"  # hier's xor trees need 2^k hosts AND dph
+            self.hier_fallbacks = 1
+            global_metrics().counter(
+                "fusion_mesh_hier_fallback_total",
+                help="hier exchanges resolved via gather on a non-power-of-2 "
+                "host/device geometry (counted fallback, never a decline)",
+            ).inc()
+            from ..resilience.events import global_events
+
+            global_events().record(
+                "hier_fallback", f"hosts={self.n_hosts} dph={self.dph}"
+            )
         if exchange == "hier":
             devs = np.asarray(base_mesh.devices).reshape(-1)
             self.mesh = Mesh(
@@ -1666,6 +1682,7 @@ class RoutedShardedGraph:
             "patches": self.patches,
             "patch_dispatches": self.patch_dispatches,
             "bucket_resizes": self.bucket_resizes,
+            "hier_fallbacks": self.hier_fallbacks,
             "resize_detail": dict(self.resize_detail),
             "cross_host_words": self.cross_host_words,
             "cross_words_per_level": self.cross_words_per_level,

@@ -308,6 +308,57 @@ def rolling_restart(seed: int = 53) -> ChaosPolicy:
     return ChaosPolicy(seed=seed, drop=0.03, duplicate=0.02, reorder_window=4)
 
 
+@_scenario("host_kill_reform")
+def host_kill_reform(seed: int = 61) -> ChaosPolicy:
+    """Mesh-layer weather for the host-death leg (ISSUE 16): one scheduled
+    peer kill on a lossy link. The HOST kill itself (SIGKILL of a whole
+    emulated-host process, evidence convergence, in-process degrade →
+    re-form) is orchestrated by the harness (perf/mesh_multihost.py and
+    tests/test_mesh_controller.py); this policy supplies the DCN frame
+    weather riding under it, so detection converges from noisy evidence,
+    not a clean silence."""
+    return ChaosPolicy(
+        seed=seed,
+        drop=0.03,
+        duplicate=0.02,
+        reorder_window=4,
+        peer_kills=[(0.2, "default")],
+    )
+
+
+@_scenario("host_flap")
+def host_flap(seed: int = 67) -> ChaosPolicy:
+    """Host flap (ISSUE 16): kill + fast rejoin under an open breaker.
+    Two quick peer kills (the ramp that opens the breaker) and NO partition
+    — the harness kills the host process right after, then relaunches it as
+    a live JOINer while the survivor's breaker is still open. Certifies
+    that a flapping host is absorbed via the counted degrade → re-form →
+    join path with zero divergent waves, never a survivor restart."""
+    return ChaosPolicy(
+        seed=seed,
+        drop=0.03,
+        duplicate=0.02,
+        reorder_window=4,
+        peer_kills=[(0.1, "default"), (0.25, "default")],
+    )
+
+
+@_scenario("mesh_partition")
+def mesh_partition(seed: int = 71) -> ChaosPolicy:
+    """DCN partition between live hosts (ISSUE 16): a 1.5s full partition
+    on a lossy link, no kills. The mesh controller must RIDE THIS OUT —
+    a lone heartbeat lapse is single-source evidence, below the
+    convergence threshold, so no eviction and no degrade; the window
+    closes and waves stay oracle-exact."""
+    return ChaosPolicy(
+        seed=seed,
+        drop=0.03,
+        duplicate=0.02,
+        reorder_window=4,
+        partitions=[(0.2, 1.5)],
+    )
+
+
 @_scenario("partition_storm")
 def partition_storm(seed: int = 31) -> ChaosPolicy:
     """Three quick peer kills (the flap ramp that opens a breaker), then a

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import secrets
 import struct
 from typing import Optional
@@ -39,6 +40,22 @@ __all__ = ["RpcTcpServer", "tcp_client_connector"]
 
 _MAX_FRAME = 64 * 1024 * 1024
 _MAX_HELLO = 256
+#: dial retry ladder (ISSUE 16): bounded, jittered — a refused dial during
+#: a mesh re-form window is expected weather, not an instant failure, but
+#: it must stay COUNTED (``tcp_dial_retry``) and bounded (the breaker owns
+#: long-horizon gating; this ladder only rides out sub-second races)
+_DIAL_ATTEMPTS = 4
+_DIAL_BACKOFF_BASE_S = 0.05
+_DIAL_BACKOFF_CAP_S = 0.5
+
+
+def _record_event(kind: str, detail: str) -> None:
+    """Journal a transport event into the resilience ledger (deferred
+    import — rpc must stay importable without the resilience package
+    initialized, the middleware.py convention)."""
+    from ..resilience.events import global_events
+
+    global_events().record(kind, detail)
 
 
 class _TcpAdapter:
@@ -61,12 +78,20 @@ class _TcpAdapter:
                 if length > _MAX_FRAME:
                     raise ValueError(f"frame of {length}B exceeds cap")
                 return loads(await self._reader.readexactly(length))
-            except ConnectionError:
+            except ConnectionError as e:
+                _record_event("tcp_link_death", f"recv: {e}")
                 raise
+            except asyncio.IncompleteReadError as e:
+                # EOF mid-frame: the link died under us — counted, then
+                # surfaced as ConnectionError so the peer's run loop tears
+                # the connection down and reconnects
+                _record_event("tcp_link_death", "recv: eof mid-frame")
+                raise ConnectionError(str(e)) from e
             except Exception as e:  # noqa: BLE001 — closed/aborted/corrupt
                 # a malformed or truncated frame is a TRANSPORT failure:
                 # surface it as ConnectionError so the peer's run loop
                 # tears the connection down and reconnects
+                _record_event("tcp_link_death", f"recv: {type(e).__name__}")
                 raise ConnectionError(str(e)) from e
 
     class _Writer:
@@ -81,6 +106,7 @@ class _TcpAdapter:
                     self._writer.write(struct.pack("<I", len(data)) + data)
                     await self._writer.drain()
                 except Exception as e:  # noqa: BLE001 — link died mid-send
+                    _record_event("tcp_link_death", f"send: {type(e).__name__}")
                     raise ConnectionError(str(e)) from e
 
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -171,13 +197,39 @@ def tcp_client_connector(host: str, port: int, client_id: Optional[str] = None):
     The generated clientId is stable per connector, so reconnects resume
     the same server peer (reconnect dedup). Pass an explicit ``client_id``
     (e.g. the member name) to pin the server-side peer ref — the mesh
-    workers do, so the fan-out DCN classification sees the member."""
+    workers do, so the fan-out DCN classification sees the member.
+
+    Dial failures retry on a bounded jittered backoff ladder
+    (``_DIAL_ATTEMPTS`` tries, each counted as ``tcp_dial_retry`` in the
+    resilience ledger) — a refused connection during a mesh re-form window
+    rides out the race instead of failing the peer, but the ladder is
+    BOUNDED: past it, the failure surfaces and the circuit breaker owns
+    the long-horizon gating. Nothing is swallowed silently."""
     cid = client_id or f"c-{secrets.token_hex(8)}"
 
     async def connect(peer: RpcClientPeer) -> _TcpAdapter:
-        reader, writer = await asyncio.open_connection(host, port)
-        writer.write(cid.encode() + b"\n")
-        await writer.drain()
-        return _TcpAdapter(reader, writer)
+        last: Optional[BaseException] = None
+        for attempt in range(_DIAL_ATTEMPTS):
+            if attempt:
+                delay = min(
+                    _DIAL_BACKOFF_BASE_S * (2 ** (attempt - 1)),
+                    _DIAL_BACKOFF_CAP_S,
+                ) * (0.5 + random.random())
+                _record_event(
+                    "tcp_dial_retry",
+                    f"{host}:{port} attempt={attempt + 1} "
+                    f"after {type(last).__name__}",
+                )
+                await asyncio.sleep(delay)
+            try:
+                reader, writer = await asyncio.open_connection(host, port)
+                writer.write(cid.encode() + b"\n")
+                await writer.drain()
+                return _TcpAdapter(reader, writer)
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                last = e
+        raise ConnectionError(
+            f"dial {host}:{port} failed after {_DIAL_ATTEMPTS} attempts: {last}"
+        ) from last
 
     return connect

@@ -1214,6 +1214,87 @@ async def test_warm_rejoin_replays_exact_tail_and_fences_moved_keys(tmp_path):
         await c.stop()
 
 
+async def test_warm_rejoin_every_snapshot_corrupt_degrades_to_cold(tmp_path):
+    """When EVERY durable snapshot is corrupt/torn, warm_rejoin must
+    degrade to the cold path — never crash, never serve garbage: each bad
+    file is quarantined (ledgered ``snapshot_corrupt`` + renamed
+    ``*.corrupt``), ``report.warm`` is False, the reader tails from the log
+    end, the fence event still fires (awaiters never hang), and the member
+    re-announces and serves recomputed-from-scratch values (ISSUE 16
+    satellite: a mesh host whose disk was torn mid-kill still rejoins)."""
+    import glob
+    import os
+
+    from stl_fusion_tpu.resilience.events import ResilienceEvents
+
+    c = Cluster(["m0", "m1", "m2"], oplog=True)
+    try:
+        await c.wait_epoch(
+            lambda: all(m.shard_map.epoch >= 1 for m in c.members.values()),
+            what="bootstrap epoch",
+        )
+        for n in range(4):
+            await c.put_cmd("m2", f"k{n}", n + 1)
+        # dial the survivors — a client connected to nobody never hears
+        # the post-kill map gossip
+        for i in range(12):
+            await asyncio.wait_for(c.proxy.get(f"warm{i}"), 5)
+        await c.wait_epoch(
+            lambda: {"m0", "m1"} <= set(c.client_rpc.peers),
+            what="client survivor links",
+        )
+        await c.wait_oplog_synced()
+
+        events = ResilienceEvents()
+        mgr = CheckpointManager(str(tmp_path / "m2-ckpts"), events=events)
+        mgr.save_durable(
+            c.fusions["m2"], reader=c.readers["m2"],
+            member=c.members["m2"], rpc_hub=c.hubs["m2"],
+        )
+        await c.put_cmd("m0", "k0", 101)
+        mgr.save_durable(c.fusions["m2"])  # second snapshot to fall back past
+        steps = [mgr.path_of(s) for s in mgr._steps()]
+        assert len(steps) == 2
+        await c.kill("m2")
+        await c.wait_epoch(
+            lambda: "m2" not in c.router.shard_map.members, what="kill epoch"
+        )
+        # tear EVERY snapshot: garbage where the header should be
+        for path in steps:
+            with open(path, "wb") as fp:
+                fp.write(b"torn-by-host-kill" * 7)
+
+        member, reader, report = await c.rejoin_warm("m2", mgr)
+        assert report.warm is False
+        assert report.restored_nodes == 0 and report.replayed_entries == 0
+        assert mgr.corrupt_skipped == 2
+        assert events.count("snapshot_corrupt") == 2
+        # both files quarantined as evidence, none left to block a re-walk
+        assert mgr._steps() == []
+        assert len(glob.glob(os.path.join(str(tmp_path / "m2-ckpts"), "*.corrupt"))) == 2
+        # cold reader tails from the end; fence awaiters never hang
+        assert reader.watermark == c.log_store.last_index()
+        await asyncio.wait_for(report.fence_applied.wait(), 8)
+        assert report.fenced_keys == 0
+
+        # the cold member still rejoins and serves — recomputed, not warm
+        await c.wait_epoch(
+            lambda: "m2" in c.router.shard_map.members, what="rejoin epoch"
+        )
+        for k, want in [("k0", 101), ("k1", 2), ("k2", 3), ("k3", 4)]:
+            deadline = asyncio.get_event_loop().time() + 10
+            while True:
+                got = await asyncio.wait_for(c.proxy.get(k), 5)
+                if got[1] == want:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, (k, got, want)
+                await asyncio.sleep(0.05)
+        audit = await verify_restore(c.fusions["m2"])
+        assert audit["violations"] == [], audit
+    finally:
+        await c.stop()
+
+
 async def test_fence_fires_after_full_cluster_restart_epoch_regression(tmp_path):
     """A FULL-cluster restart re-mints epochs from 1, so a snapshot taken
     at epoch N may never see a map with epoch >= N again. The fence must

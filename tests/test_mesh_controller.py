@@ -1,0 +1,294 @@
+"""MeshController unit tests (ISSUE 16): evidence convergence thresholds,
+the rendezvous board's atomic single-writer call files, the counted
+degrade → re-election → re-form ladder (jittered, capped, every attempt
+ledgered), rank-staggered coordinator takeover, and live JOIN absorption.
+
+Everything runs against fake WorldOps and injected clocks — the controller
+is deliberately jax-free, so every ladder transition is deterministic
+here; the REAL world mechanics (form/detach/teardown over emulated host
+processes) are certified by tests/test_multihost.py and the
+perf/mesh_multihost.py chaos legs.
+"""
+import pytest
+
+from stl_fusion_tpu.cluster.mesh_controller import (
+    EVIDENCE_WEIGHTS,
+    MeshController,
+    MeshReformError,
+    PeerEvidence,
+    RendezvousBoard,
+)
+from stl_fusion_tpu.resilience.events import ResilienceEvents
+
+
+# ------------------------------------------------------------------ fakes
+
+class FakeClock:
+    """Monotonic + wall clock in one; sleep() advances it."""
+
+    def __init__(self, at: float = 100.0):
+        self.at = at
+        self.sleeps = []
+
+    def clock(self) -> float:
+        return self.at
+
+    def sleep(self, s: float) -> None:
+        self.sleeps.append(s)
+        self.at += s
+
+
+class FakeOps:
+    """WorldOps double: records every form/teardown, fails on command."""
+
+    def __init__(self, fail_forms: int = 0):
+        self.fail_forms = fail_forms
+        self.forms = []
+        self.teardowns = 0
+        self.detaches = 0
+
+    def form(self, members, process_id, coordinator):
+        if self.fail_forms > 0:
+            self.fail_forms -= 1
+            raise TimeoutError("coordinator unreachable")
+        world = {
+            "members": list(members),
+            "process_id": process_id,
+            "coordinator": coordinator,
+        }
+        self.forms.append(world)
+        return world
+
+    def detach(self) -> bool:
+        self.detaches += 1
+        return True
+
+    def teardown(self) -> None:
+        self.teardowns += 1
+
+
+class FixedRng:
+    """random() == 0.5 — jitter factor exactly 1.0, delays assertable."""
+
+    def random(self) -> float:
+        return 0.5
+
+
+def make_controller(tmp_path, member, members, *, ops=None, events=None, **kw):
+    clock = FakeClock()
+    board = RendezvousBoard(str(tmp_path / "board"))
+    ops = ops if ops is not None else FakeOps()
+    events = events if events is not None else ResilienceEvents()
+    ctl = MeshController(
+        member,
+        members,
+        board,
+        ops,
+        events=events,
+        clock=clock.clock,
+        wall_clock=clock.clock,
+        sleep=clock.sleep,
+        rng=FixedRng(),
+        pick_address=lambda: "127.0.0.1:7777",
+        **kw,
+    )
+    return ctl, board, ops, events, clock
+
+
+# ------------------------------------------------------------------ evidence
+
+def test_single_soft_signal_never_converges(tmp_path):
+    """A lone heartbeat lapse (DCN partition window) stays below the
+    threshold — the mesh_partition scenario's ride-through contract."""
+    ctl, board, _, events, clock = make_controller(tmp_path, "h0", ["h0", "h1"])
+    board.beat("h1", clock.at - 60.0)  # long-lapsed heartbeat
+    ctl.poll_evidence()
+    assert ctl.evidence["h1"].score == 1
+    assert ctl.dead_peers() == []
+    # a second INDEPENDENT signal converges it
+    ctl.note_breaker_open("h1")
+    assert ctl.dead_peers() == ["h1"]
+    assert events.count("mesh_evidence") == 2
+
+
+def test_orchestrator_flag_is_authoritative(tmp_path):
+    ctl, board, _, _, _ = make_controller(tmp_path, "h0", ["h0", "h1", "h2"])
+    board.flag_dead("h2", "sigkill by chaos driver")
+    ctl.poll_evidence()
+    assert ctl.dead_peers() == ["h2"]
+    assert "h1" not in ctl.evidence
+
+
+def test_evidence_kinds_count_once():
+    ev = PeerEvidence("h1")
+    assert ev.add("deadline_overrun", 1.0)
+    assert not ev.add("deadline_overrun", 2.0)  # repeat signal: no stacking
+    assert ev.score == EVIDENCE_WEIGHTS["deadline_overrun"]
+    with pytest.raises(ValueError):
+        ev.add("vibes", 3.0)
+
+
+# ------------------------------------------------------------------ board
+
+def test_board_call_has_exactly_one_winner(tmp_path):
+    board = RendezvousBoard(str(tmp_path / "b"))
+    first = board.publish_call(3, ["h0", "h2"], "127.0.0.1:1111")
+    second = board.publish_call(3, ["h0", "h2"], "127.0.0.1:2222")
+    assert first["coordinator"] == "127.0.0.1:1111"
+    assert second == first  # loser reads the winner, never overwrites
+    assert board.read_call(3) == first
+    board.publish_call(5, ["h0"], "127.0.0.1:3333")
+    assert board.latest_call()["epoch"] == 5
+    assert board.latest_call(min_epoch=6) is None
+
+
+def test_board_joins_and_flags_round_trip(tmp_path):
+    board = RendezvousBoard(str(tmp_path / "b"))
+    board.request_join("h3", 10.0)
+    board.request_join("h4", 11.0)
+    assert board.pending_joins() == ["h3", "h4"]
+    board.clear_join("h3")
+    assert board.pending_joins() == ["h4"]
+    board.flag_dead("h1")
+    assert board.dead_flagged("h1")
+    board.clear_dead_flag("h1")
+    assert not board.dead_flagged("h1")
+
+
+# ------------------------------------------------------------------ lifecycle
+
+def test_kill_path_degrade_then_reform_counted(tmp_path):
+    """The host-kill arc: form → detach → evidence → counted degrade
+    (in-process, ops.teardown — never an exit) → re-form over survivors
+    with the first rung failing (counted, jittered backoff)."""
+    ctl, board, ops, events, clock = make_controller(
+        tmp_path, "h0", ["h0", "h1", "h2"]
+    )
+    ctl.form_initial("127.0.0.1:9999")
+    assert ctl.state == MeshController.SERVING and ctl.epoch == 1
+    assert ctl.detach() and events.count("mesh_detached") == 1
+    ops.fail_forms = 1  # first re-form rung will fail, counted
+
+    board.flag_dead("h1")
+    ctl.poll_evidence()
+    assert ctl.dead_peers() == ["h1"]
+
+    ctl.degrade("evidence converged on h1")
+    assert ctl.state == MeshController.DEGRADED
+    assert ops.teardowns == 1 and ctl.world is None
+    assert events.count("mesh_degraded") == 1
+
+    world = ctl.reform(["h0", "h2"])
+    assert ctl.state == MeshController.SERVING
+    assert world["members"] == ["h0", "h2"] and world["process_id"] == 0
+    # first rung failed: attempt 1 counted failed, attempt 2 succeeded at
+    # the NEXT target epoch (epochs are never reused across rungs)
+    assert events.count("mesh_reform_attempt") == 2
+    assert events.count("mesh_reform_failed") == 1
+    assert events.count("mesh_reform_ok") == 1
+    assert ctl.epoch == 3  # 1 + attempt 2
+    assert clock.sleeps and clock.sleeps[0] == pytest.approx(0.25)  # base * jitter 1.0
+    assert ctl.members == ["h0", "h2"]
+    # dead peer's slate survives (it is OUT); survivors' slates are fresh
+    assert "h0" not in ctl.evidence and "h2" not in ctl.evidence
+
+
+def test_reform_backoff_is_capped_and_ladder_bounded(tmp_path):
+    ops = FakeOps(fail_forms=99)
+    ctl, _, _, events, clock = make_controller(
+        tmp_path, "h0", ["h0", "h1"], ops=ops,
+        reform_attempts=5, backoff_base_s=0.25, backoff_cap_s=1.0,
+    )
+    ctl.epoch = 1
+    with pytest.raises(MeshReformError):
+        ctl.reform(["h0"])
+    assert events.count("mesh_reform_attempt") == 5
+    assert events.count("mesh_reform_failed") == 5
+    # 0.25, 0.5, 1.0, then CAPPED at 1.0 (x jitter factor 1.0)
+    assert clock.sleeps == pytest.approx([0.25, 0.5, 1.0, 1.0, 1.0])
+
+
+def test_rank_staggered_takeover_when_caller_elect_is_dead(tmp_path):
+    """h0 (rank 0, the caller-elect) is the dead one: h1 polls, then takes
+    over publishing after call_takeover_s * rank — counted."""
+    ctl, board, ops, events, clock = make_controller(
+        tmp_path, "h1", ["h0", "h1", "h2"], call_takeover_s=3.0
+    )
+    ctl.epoch = 1
+    world = ctl.reform(["h1", "h2"])  # h1 is rank 0 of the survivor set
+    assert world["coordinator"] == "127.0.0.1:7777"
+    # now the OTHER shape: h1 is rank 1 behind a dead caller-elect
+    ctl2, board2, _, events2, clock2 = make_controller(
+        tmp_path / "two", "h1", ["h0", "h1"], call_takeover_s=3.0
+    )
+    ctl2.epoch = 1
+    world2 = ctl2.reform(["h0", "h1"])  # h0 never publishes (it is dead)
+    assert events2.count("mesh_coordinator_takeover") == 1
+    assert world2["members"] == ["h0", "h1"]
+    # takeover waited the rank-staggered window before publishing
+    assert sum(clock2.sleeps) >= 3.0
+
+
+def test_reform_rejects_mismatched_call(tmp_path):
+    """A stale/foreign call naming the wrong member set must fail the rung
+    (counted), not form a world with ghosts in it."""
+    ctl, board, ops, events, _ = make_controller(
+        tmp_path, "h1", ["h0", "h1"], reform_attempts=1
+    )
+    ctl.epoch = 1
+    board.publish_call(2, ["h0", "h1", "GHOST"], "127.0.0.1:1")
+    with pytest.raises(MeshReformError):
+        ctl.reform(["h0", "h1"])
+    assert events.count("mesh_reform_failed") == 1
+    assert ops.forms == []
+
+
+def test_join_absorption_and_joiner_handshake(tmp_path):
+    """Members absorb a pending joiner by re-forming to N+1; the joiner
+    polls the board for the first call naming it and forms into the same
+    epoch — both sides counted."""
+    ctl, board, ops, events, clock = make_controller(tmp_path, "h0", ["h0", "h1"])
+    ctl.form_initial("127.0.0.1:9999")
+
+    # joiner shares the BOARD but has its own controller/ops/clock
+    jops = FakeOps()
+    jevents = ResilienceEvents()
+    jclock = FakeClock()
+    joiner = MeshController(
+        "h2", ["h2"], board, jops, events=jevents,
+        clock=jclock.clock, wall_clock=jclock.clock, sleep=jclock.sleep,
+        rng=FixedRng(), pick_address=lambda: "127.0.0.1:8888",
+    )
+    board.request_join("h2", jclock.at)
+    assert ctl.pending_joins() == ["h2"]
+
+    world = ctl.absorb_joins(ctl.pending_joins())
+    assert world["members"] == ["h0", "h1", "h2"]
+    assert ctl.joins_absorbed == 1
+    assert events.count("mesh_degraded") == 1  # the re-form window is counted
+    assert events.count("mesh_join_absorbed") == 1
+    assert board.pending_joins() == []  # request cleared after absorption
+
+    jworld = joiner.join(timeout_s=5.0)
+    assert jworld["members"] == ["h0", "h1", "h2"]
+    assert jworld["process_id"] == 2
+    assert joiner.epoch == ctl.epoch == 2
+    assert jevents.count("mesh_joined") == 1
+
+
+def test_absorb_joins_noop_without_pending(tmp_path):
+    ctl, _, ops, events, _ = make_controller(tmp_path, "h0", ["h0", "h1"])
+    ctl.form_initial("127.0.0.1:9999")
+    assert ctl.absorb_joins([]) is ctl.world
+    assert ctl.absorb_joins(["h1"]) is ctl.world  # already a member
+    assert events.count("mesh_degraded") == 0
+
+
+def test_degrade_rung_forms_single_host_world(tmp_path):
+    """Re-forming to a single survivor is the degrade rung — the world is
+    local (rank 0 of 1), serving continues, nothing exits."""
+    ctl, _, ops, _, _ = make_controller(tmp_path, "h0", ["h0", "h1"])
+    ctl.epoch = 1
+    world = ctl.reform(["h0"])
+    assert world["members"] == ["h0"] and world["process_id"] == 0
+    assert ctl.state == MeshController.SERVING

@@ -70,6 +70,123 @@ def test_init_single_host_shortcut_no_distributed_runtime():
         init_multihost(n_hosts=1, devices_per_host=jax.local_device_count() + 1)
 
 
+def test_world_guards_without_distributed_runtime():
+    """Single-host library guards: no client installed, detach is a no-op,
+    teardown is safe to call on an unformed world (the degrade path calls
+    it unconditionally)."""
+    from stl_fusion_tpu.cluster.multihost import detach_world, world_is_formed
+
+    assert not world_is_formed()
+    assert detach_world() is False
+
+
+_ELASTIC_WORKER = r"""
+import os, sys, time
+import numpy as np
+import jax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from stl_fusion_tpu.cluster.multihost import (
+    detach_world, form_world, pick_coordinator, teardown_world,
+    world_is_formed,
+)
+from stl_fusion_tpu.parallel.mesh import GRAPH_AXIS, graph_mesh, shard_map_compat
+
+DIR = os.environ["ELASTIC_DIR"]
+pid = int(os.environ["FUSION_MH_PROCESS_ID"])
+n = int(os.environ["FUSION_MH_NUM_HOSTS"])
+
+def put(name):
+    open(os.path.join(DIR, name), "w").write("1")
+
+def wait(name, t=90):
+    t0 = time.time()
+    while not os.path.exists(os.path.join(DIR, name)):
+        assert time.time() - t0 < t, name
+        time.sleep(0.05)
+
+form_world(n, pid, os.environ["FUSION_MH_COORDINATOR"])
+assert world_is_formed()
+mesh = graph_mesh()
+sh = NamedSharding(mesh, P(GRAPH_AXIS))
+
+@jax.jit
+def f(x):
+    @shard_map_compat(mesh=mesh, in_specs=(P(GRAPH_AXIS),), out_specs=P(GRAPH_AXIS))
+    def inner(xl):
+        return xl + lax.psum(xl.sum(), GRAPH_AXIS)
+    return inner(x)
+
+x = jax.device_put(np.arange(jax.device_count() * 4, dtype=np.int32), sh)
+np.asarray(f(x).addressable_shards[0].data)
+put(f"ready-{pid}")
+for i in range(n):
+    wait(f"ready-{i}")
+assert detach_world() and not world_is_formed()
+np.asarray(f(x).addressable_shards[0].data)  # collectives outlive the agent
+print("DETACHED_OK", flush=True)
+if pid == 1:
+    put("h1-parked")
+    time.sleep(120)  # parked until the orchestrator SIGKILLs us
+    sys.exit(0)
+wait("h1-dead")
+# the survivor arc, all in THIS process: abandon the dead world, serve
+# local, then re-form a fresh 1-host world on a new coordinator port
+teardown_world(rebuild_local=True)
+z = np.asarray(jax.jit(lambda a: a * 2)(np.arange(8)))
+assert int(z[3]) == 6
+form_world(1, 0, pick_coordinator())
+assert world_is_formed()
+teardown_world(rebuild_local=True)
+print("SURVIVOR_OK", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_survivor_outlives_peer_kill_without_restart(tmp_path):
+    """THE elastic-world mechanics (ISSUE 16), library level: two real
+    host processes form a world, both detach the coordination agent, the
+    orchestrator SIGKILLs h1 — and h0 (the SAME process, never restarted)
+    tears the dead world down, computes locally, and re-forms a fresh
+    world. Without detach_world the kill aborts h0 with rc=-6 (measured)."""
+    from stl_fusion_tpu.cluster.multihost import launch_hosts
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = tmp_path / "elastic_worker.py"
+    worker.write_text(_ELASTIC_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_DIR"] = str(tmp_path)
+    procs = launch_hosts(
+        [sys.executable, str(worker)],
+        n_hosts=2,
+        devices_per_host=2,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = 90
+        import time as _time
+
+        t0 = _time.time()
+        while not (tmp_path / "h1-parked").exists():
+            assert _time.time() - t0 < deadline, "h1 never parked"
+            assert procs[1].poll() is None, procs[1].communicate()[0].decode()
+            _time.sleep(0.1)
+        procs[1].kill()  # the host-kill chaos primitive
+        procs[1].wait(timeout=30)
+        (tmp_path / "h1-dead").write_text("1")
+        out0, _ = procs[0].communicate(timeout=120)
+        text = out0.decode()
+        assert procs[0].returncode == 0, text
+        assert "DETACHED_OK" in text and "SURVIVOR_OK" in text, text
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
 @pytest.mark.slow
 def test_two_real_host_processes_join_one_mesh():
     """The zero-to-aha spawn: 2 OS processes x 2 emulated devices form ONE

@@ -173,6 +173,57 @@ async def test_breaker_opens_on_flaps_and_recloses():
         await _stop(client_rpc, server_rpc)
 
 
+async def test_breaker_probe_dial_failure_reopens_escalated():
+    """An UNREACHABLE peer (mesh host died: nothing listening, every dial
+    refused) must not let the breaker's half-open probe dial ungated at the
+    transport retry rate. The probe dial itself fails — no connection event
+    ever fires — so the only signal is the peer re-entering the dial gate
+    while a released probe is still pending: the breaker re-opens
+    ESCALATED (exponential cooldown, every open counted)."""
+    svc, client, transport, client_rpc, server_rpc, _sf = make_rpc_stack()
+    events = ResilienceEvents()
+    try:
+        assert await client.get("a") == 0
+        peer = client_rpc.client_peer("default")
+        breaker = PeerCircuitBreaker(
+            peer, flap_threshold=3, flap_window=10.0,
+            cooldown=0.1, probe_stable=0.1, events=events,
+        ).install()
+        for _ in range(3):  # the flap ramp opens it
+            await transport.disconnect()
+            await asyncio.sleep(0.02)
+        await asyncio.sleep(0.05)
+        assert breaker.state == BreakerState.OPEN
+        assert breaker.opens == 1
+
+        # now the host is GONE: every dial is refused, so the released
+        # probe never produces a connection event — the re-entered gate is
+        # the failure signal and each re-open escalates the cooldown
+        transport.block_reconnects(True)
+        deadline = asyncio.get_event_loop().time() + 8.0
+        while breaker.opens < 3:
+            assert asyncio.get_event_loop().time() < deadline, breaker.snapshot()
+            await asyncio.sleep(0.02)
+        assert breaker.state == BreakerState.OPEN
+        assert events.count("breaker_open") == breaker.opens >= 3
+        assert breaker._consecutive_opens >= 3  # escalation, not flat retry
+        assert breaker.closes == 0
+
+        # host returns: the next released probe connects, stabilizes, and
+        # the breaker closes — the escalation resets with it
+        transport.block_reconnects(False)
+        deadline = asyncio.get_event_loop().time() + 8.0
+        while breaker.state != BreakerState.CLOSED:
+            assert asyncio.get_event_loop().time() < deadline, breaker.snapshot()
+            await asyncio.sleep(0.05)
+        assert breaker.closes == 1
+        assert breaker._consecutive_opens == 0
+        assert await client.get("a") == 0
+        await breaker.dispose()
+    finally:
+        await _stop(client_rpc, server_rpc)
+
+
 async def test_breaker_state_surfaces_through_peer_monitor():
     from stl_fusion_tpu.ext.peer_monitor import RpcPeerStateMonitor
 
