@@ -877,6 +877,12 @@ def _compact_result(
             # flight-recorder mode + event accounting (ISSUE 4): tracks
             # the causal-journal overhead A/B (LIVE_RECORDER) per release
             "recorder": live.get("recorder"),
+            # adaptive sweep mode (ISSUE 17): whether the loop ran the
+            # device-side fixed-point sweeps + the per-wave barrier stall
+            # the fixed-vs-adaptive microbench measured reclaimed
+            "async": live.get("live_async"),
+            "adaptive_stages": live.get("live_adaptive_stages"),
+            "level_stall_ms": _r(live.get("live_level_stall_ms"), 3),
         })
         for opt in ("phases", "telemetry", "recorder"):
             if out["live"][opt] is None:
@@ -990,6 +996,19 @@ def _compact_result(
             "eager_waves": (lv.get("pipeline") or {}).get("eager_waves"),
             "violations": mesh.get("violations"),
         }
+        ab = mesh.get("async_ab") or {}
+        if ab:
+            # ISSUE 17: the async-vs-sync A/B — exchange barriers
+            # reclaimed (merge epochs vs sync levels), the measured wall
+            # stall, and the counted quiescence checks beside both modes'
+            # honest inv/s
+            out["mesh"]["async_depth"] = ab.get("async_depth")
+            out["mesh"]["async_oracle_exact"] = ab.get("oracle_exact")
+            out["mesh"]["levels_reclaimed"] = ab.get("levels_reclaimed")
+            out["mesh"]["level_stall_ms"] = ab.get("level_stall_ms")
+            out["mesh"]["quiescence_checks"] = ab.get("quiescence_checks")
+            out["mesh"]["sync_inv_per_s"] = ab.get("sync_inv_per_s")
+            out["mesh"]["async_inv_per_s"] = ab.get("async_inv_per_s")
         mh = mesh.get("multihost") or {}
         if mh:
             # ISSUE 15: the REAL-process leg — hosts, the hierarchical

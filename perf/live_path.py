@@ -69,7 +69,13 @@ LIVE_TELEMETRY (1; 0 disables the wave profiler — the A/B knob for the
 records which mode ran so BENCH_*.json tracks it),
 LIVE_RECORDER (1; 0 disables the causal flight recorder — the ISSUE 4
 A/B under the same <3% budget discipline; the result's ``recorder``
-section records the mode + event counts for BENCH_*.json).
+section records the mode + event counts for BENCH_*.json),
+LIVE_ASYNC (0; 1 = ISSUE 17: the loop's fused sweeps run as a
+device-side adaptive fixed-point instead of a fixed worst-case pass
+count — the existing lane ≡ oracle gates certify it bit-exactly, and a
+fixed-vs-adaptive microbench records the per-wave barrier stall
+reclaimed; under LIVE_SMOKE=1 a silent fallback to fixed passes or a
+zero measured reclaim exits nonzero).
 """
 import asyncio
 import json
@@ -216,6 +222,7 @@ async def main() -> None:
     fuse_depth = max(1, min(int(os.environ.get("LIVE_FUSE_DEPTH", 3)), rounds))
     telemetry_on = os.environ.get("LIVE_TELEMETRY", "1") != "0"
     recorder_on = os.environ.get("LIVE_RECORDER", "1") != "0"
+    live_async = os.environ.get("LIVE_ASYNC", "0") == "1"
     rng = np.random.default_rng(123)
 
     note(f"generating {n}-node power-law DAG...")
@@ -516,6 +523,14 @@ async def main() -> None:
         # super-round-sized journal scatters, the patch quad-scatter
         # widths) is compiled before the clock starts
         gdev = backend.graph
+        if live_async:
+            # ISSUE 17: the whole loop's fused sweeps run ADAPTIVELY — a
+            # device-side fixed-point loop (seeded sweep + counted extra
+            # sweeps to quiescence) replaces the fixed worst-case pass
+            # count. Set BEFORE the chain warm so the adaptive programs
+            # are the ones compiled; the existing lane ≡ oracle gates
+            # below certify the mode bit-exactly
+            gdev.set_adaptive_passes(True)
         total_inv = 0
         burst_s = 0.0
         churn_rows_total = 0
@@ -949,6 +964,45 @@ async def main() -> None:
             note("lane ≡ host-BFS oracle: OK")
         gdev.clear_invalid()
 
+        # -------- adaptive-pass stall microbench (ISSUE 17): the same
+        # single-seed union wave timed at the FIXED worst-case pass count
+        # vs the adaptive fixed-point sweep — the delta is the per-wave
+        # barrier stall the adaptive mode reclaims (the seed is already
+        # invalid after the first call, so every timed rep is
+        # state-neutral). The lat shortcut is disabled so both runs take
+        # the fused sweep program the loop actually rides.
+        async_stall_ms = None
+        if live_async and gdev._topo_mirror is not None:
+            from stl_fusion_tpu.parallel.routed_wave import record_level_stall_ms
+
+            note("adaptive-pass stall microbench (fixed vs adaptive sweeps)...")
+            m = gdev._topo_mirror
+            m["lat"] = None
+            probe_seed = [[int(block.base)]]
+            reps = 12
+
+            def _union_ms(passes: int) -> float:
+                m["passes"] = passes
+                gdev.run_waves_union(probe_seed)  # compile/warm (untimed)
+                samples = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    gdev.run_waves_union(probe_seed)
+                    samples.append((time.perf_counter() - t0) * 1e3)
+                return float(np.median(samples))
+
+            fixed_ms = _union_ms(gdev.FUSED_PASS_MAX)
+            adaptive_ms = _union_ms(0)
+            async_stall_ms = max(fixed_ms - adaptive_ms, 0.0)
+            record_level_stall_ms(async_stall_ms)
+            gdev.clear_invalid()
+            note(
+                f"fixed({gdev.FUSED_PASS_MAX})={fixed_ms:.2f}ms "
+                f"adaptive={adaptive_ms:.2f}ms -> stall reclaimed "
+                f"{async_stall_ms:.2f}ms/wave "
+                f"(adaptive_stages={gdev.adaptive_stages})"
+            )
+
         # -------- CI gates (LIVE_SMOKE=1, the tier1 live smoke): the
         # super-round path must have served the clean path — any eager
         # fallback, fault, or host re-entry (forced harvest, re-stage)
@@ -977,6 +1031,24 @@ async def main() -> None:
                 raise SystemExit("LIVE_SMOKE gate failed: " + "; ".join(problems))
         if smoke and super_rounds and sr_delta is None:
             raise SystemExit("LIVE_SMOKE gate failed: super-round program never ran")
+        # LIVE_ASYNC=1 smoke: the adaptive mode must have actually served
+        # the loop (counted stages — zero means a silent fallback to the
+        # fixed pass count) and the microbench must have measured a
+        # positive per-wave stall reclaim
+        if smoke and live_async:
+            problems = []
+            if not gdev.adaptive_stages:
+                problems.append(
+                    "LIVE_ASYNC=1 but zero adaptive sweep stages ran "
+                    "(silent fixed-pass fallback)"
+                )
+            if not async_stall_ms:
+                problems.append(
+                    "zero barrier-stall reclaim measured "
+                    f"(async_stall_ms={async_stall_ms})"
+                )
+            if problems:
+                raise SystemExit("LIVE_SMOKE gate failed: " + "; ".join(problems))
 
         # -------- durable restart budget (ISSUE 6): snapshot the live
         # device graph atomically, then clock the restore — the number a
@@ -1154,6 +1226,14 @@ async def main() -> None:
             "bursts_on_mirror": bursts_on_mirror,
             "mirror_passes_final": (
                 gdev._topo_mirror.get("passes", 1) if gdev._topo_mirror else None
+            ),
+            # adaptive sweep mode (ISSUE 17): whether the loop ran the
+            # device-side fixed-point sweeps, how many dispatches did, and
+            # the per-wave barrier stall the microbench measured reclaimed
+            "live_async": live_async,
+            "live_adaptive_stages": gdev.adaptive_stages if live_async else None,
+            "live_level_stall_ms": (
+                round(async_stall_ms, 3) if async_stall_ms is not None else None
             ),
             # wave-profiler summary (ISSUE 3): the system's own account of
             # where wave time went — device vs host-apply vs journal flush —

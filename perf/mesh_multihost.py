@@ -235,7 +235,7 @@ def run_worker() -> int:
 
     from stl_fusion_tpu.checkpoint import restore_mesh_shards, save_mesh_shards
     from stl_fusion_tpu.cluster import DevicePlacement, ShardMap
-    from stl_fusion_tpu.cluster.multihost import init_multihost
+    from stl_fusion_tpu.cluster.multihost import async_depth_env, init_multihost
     from stl_fusion_tpu.graph.synthetic import power_law_dag
 
     phase = os.environ.get("MESH_MH_PHASE", "scale")
@@ -243,6 +243,7 @@ def run_worker() -> int:
     n = _env_int("MESH_MH_NODES", 40_000)
     n_shards = _env_int("MESH_MH_SHARDS", 64)
     exchange = os.environ.get("MESH_MH_EXCHANGE", "hier")
+    async_depth = async_depth_env()
     rounds_total = _env_int("MESH_MH_ROUNDS", 4)
     per_round = _env_int("MESH_MH_SEEDS_PER_ROUND", 4)
     stages = _env_int("MESH_MH_STAGES", 2)
@@ -283,7 +284,8 @@ def run_worker() -> int:
         devices_per_host=ctx.devices_per_host,
     )
     graph = RoutedShardedGraph(
-        src, dst, n, placement, mesh=ctx.mesh(), exchange=exchange
+        src, dst, n, placement, mesh=ctx.mesh(), exchange=exchange,
+        exchange_async=async_depth > 0, async_depth=async_depth,
     )
     build_s = time.time() - t0
     log(
@@ -398,8 +400,14 @@ def run_worker() -> int:
             "exchange", "hosts", "waves_run", "exchange_levels_total",
             "cross_host_words", "cross_words_per_level", "bucket_resizes",
             "hier_fallbacks", "e_cap", "bucket_cap", "hbucket_cap",
+            "exchange_async", "async_depth", "quiescence_checks",
+            "spec_levels_total",
         )
     }
+    if async_depth > 0 and graph.quiescence_checks == 0:
+        result["violations"].append(
+            "async requested but zero quiescence checks ran (silent sync)"
+        )
     result["inv_per_s"] = round(int(mask_know.sum()) / max(burst_s, 1e-9), 1)
     if graph.cross_words_per_level == 0 and ctx.n_hosts > 1:
         result["violations"].append("zero cross-host exchange words")
@@ -509,6 +517,7 @@ def run_elastic_worker() -> int:
     from stl_fusion_tpu.cluster.multihost import (
         ENV_DEVICES_PER_HOST,
         ENV_PROCESS_ID,
+        async_depth_env,
         init_multihost,
         teardown_world,
     )
@@ -520,6 +529,7 @@ def run_elastic_worker() -> int:
     n = _env_int("MESH_MH_NODES", 40_000)
     n_shards = _env_int("MESH_MH_SHARDS", 64)
     exchange = os.environ.get("MESH_MH_EXCHANGE", "hier")
+    async_depth = async_depth_env()
     rounds_total = _env_int("MESH_MH_ROUNDS", 6)
     per_round = _env_int("MESH_MH_SEEDS_PER_ROUND", 4)
     stages = _env_int("MESH_MH_STAGES", 2)
@@ -614,7 +624,8 @@ def run_elastic_worker() -> int:
                 devices_per_host=dph,
             )
             built = RoutedShardedGraph(
-                src, dst, n, placement, mesh=graph_mesh(), exchange=exchange
+                src, dst, n, placement, mesh=graph_mesh(), exchange=exchange,
+                exchange_async=async_depth > 0, async_depth=async_depth,
             )
             log(
                 f"[{member_id}/elastic] graph over {list(live)} in "
@@ -902,8 +913,14 @@ def run_elastic_worker() -> int:
             for k in (
                 "exchange", "hosts", "waves_run", "cross_host_words",
                 "bucket_resizes", "hier_fallbacks",
+                "exchange_async", "async_depth", "quiescence_checks",
+                "spec_levels_total",
             )
         }
+        if async_depth > 0 and g.quiescence_checks == 0:
+            result["violations"].append(
+                "async requested but zero quiescence checks ran (silent sync)"
+            )
     with open(
         os.path.join(mh_dir, f"result_elastic_{member_id}.json"), "w"
     ) as f:
@@ -1385,6 +1402,15 @@ def _geometry_leg(hosts, dph, root_dir, base_env, out, mh, _wait):
             out["violations"].append(
                 f"geom{hosts}: non-pow2 fallback not counted ({st})"
             )
+    # async certify: when the ladder runs at FUSION_MH_ASYNC_DEPTH > 0
+    # this geometry must have actually speculated (quiescence checks are
+    # the counted evidence — zero means a silent downgrade to sync)
+    if _env_int("FUSION_MH_ASYNC_DEPTH", 0) > 0 and not st.get(
+        "quiescence_checks"
+    ):
+        out["violations"].append(
+            f"geom{hosts}: async requested but never certified ({st})"
+        )
     mh.setdefault("geometry", {})[str(hosts)] = {
         "hosts": hosts,
         "nodes": n,
@@ -1394,6 +1420,9 @@ def _geometry_leg(hosts, dph, root_dir, base_env, out, mh, _wait):
         "exchange": st.get("exchange"),
         "hier_fallbacks": st.get("hier_fallbacks"),
         "cross_host_words": st.get("cross_host_words"),
+        "exchange_async": st.get("exchange_async"),
+        "async_depth": st.get("async_depth"),
+        "quiescence_checks": st.get("quiescence_checks"),
     }
 
 

@@ -38,12 +38,26 @@ GATES (exit 1 — the tier1 mesh smoke rides them):
    host-kill → survivor → warm-rejoin chaos ladder. Its violations merge
    into this script's gate (exit 1).
 
+4. **Async A/B leg** (``MESH_ASYNC=1`` — ISSUE 17): the same graph and
+   seed schedule run through a bulk-synchronous routed graph AND an
+   async one (``MESH_ASYNC_DEPTH`` speculative levels between merges).
+   Gates (exit 1): any per-wave mask divergence — async vs sync vs host
+   BFS, all three bit-identical; ``quiescence_checks == 0`` on the async
+   graph (the uncounted-fallback-to-sync tell); zero reclaimed exchange
+   barriers (async merge epochs must be STRICTLY fewer than sync levels
+   — the structural, noise-free form of the stall reclaim). The
+   wall-clock delta feeds the ``fusion_mesh_level_stall_ms`` gauge.
+   ``MESH_ASYNC=1`` also switches the live leg's routed mirror to async
+   so the superround/pipeline composition rides the same mode.
+
 Env: MESH_NODES, MESH_WAVES (2), MESH_SEEDS (100_000), MESH_EXCHANGE
 (a2a; the live leg rides it too — "hier" + MESH_HOSTS emulates the host
 axis in-process), MESH_HOSTS (1), MESH_LIVE_NODES (20_000), MESH_MEMBERS
 (4), MESH_SHARDS (256), MESH_LAT_SAMPLES (24), MESH_SKIP_STATIC=1
 (smoke: live leg only), MESH_SKIP_LIVE=1, MESH_MULTIHOST (0) + the
-MESH_MH_* knobs of perf/mesh_multihost.py.
+MESH_MH_* knobs of perf/mesh_multihost.py, MESH_ASYNC (0),
+MESH_ASYNC_DEPTH (4), MESH_AB_NODES (120_000), MESH_AB_WAVES (3),
+MESH_AB_SEEDS (64).
 """
 import json
 import os
@@ -155,6 +169,104 @@ def run_static(mesh, out: dict) -> None:
     }
 
 
+def run_async_ab(mesh, out: dict) -> None:
+    """ISSUE 17 A/B: one graph, one seed schedule, two routed builds —
+    bulk-synchronous and async (``MESH_ASYNC_DEPTH`` speculative levels
+    between global merges). The async run must converge to the
+    BIT-IDENTICAL invalid mask on every wave while retiring strictly
+    fewer exchange barriers; the reclaimed wall-clock (an honest delta of
+    the two timed bursts, floored at zero — CPU emulation can make the
+    speculation overhead exceed the collective savings at smoke scale)
+    feeds the ``fusion_mesh_level_stall_ms`` MAX-gauge."""
+    from stl_fusion_tpu.cluster import DevicePlacement, ShardMap
+    from stl_fusion_tpu.graph.synthetic import power_law_dag
+    from stl_fusion_tpu.parallel import RoutedShardedGraph
+    from stl_fusion_tpu.parallel.routed_wave import record_level_stall_ms
+
+    n = int(os.environ.get("MESH_AB_NODES", 120_000))
+    n_waves = int(os.environ.get("MESH_AB_WAVES", 3))
+    n_seeds = int(os.environ.get("MESH_AB_SEEDS", 64))
+    depth = int(os.environ.get("MESH_ASYNC_DEPTH", 4))
+    exchange = os.environ.get("MESH_EXCHANGE", "a2a")
+
+    src, dst = power_law_dag(n, avg_degree=3.0, seed=11)
+    smap = ShardMap.initial([f"m{i}" for i in range(4)], n_shards=64)
+    placement = DevicePlacement.build(smap, mesh.devices.size, n)
+    rng = np.random.default_rng(321)
+    seed_sets = [
+        rng.choice(n, size=n_seeds, replace=False).tolist()
+        for _ in range(n_waves)
+    ]
+
+    def _burst(async_mode: bool):
+        g = RoutedShardedGraph(
+            src, dst, n, placement, mesh=mesh, exchange=exchange,
+            exchange_async=async_mode, async_depth=depth,
+        )
+        g.run_wave_collect(seed_sets[0])  # compile (untimed)
+        g.clear_invalid()
+        levels0 = g.levels_total
+        masks, totals = [], 0
+        t0 = time.time()
+        for s in seed_sets:
+            c, _ids, _over = g.run_wave_collect(s)
+            totals += int(c)
+            masks.append(g.invalid_mask())
+            g.clear_invalid()
+        wall = time.time() - t0
+        return g, masks, totals, g.levels_total - levels0, wall
+
+    log(f"async A/B: {n} nodes, {n_waves} waves, depth {depth} ({exchange})")
+    g_sync, m_sync, tot_sync, lv_sync, wall_sync = _burst(False)
+    g_async, m_async, tot_async, lv_async, wall_async = _burst(True)
+
+    divergence = 0
+    for w, (a, s) in enumerate(zip(m_async, m_sync)):
+        want = numpy_bfs_mask(src, dst, n, seed_sets[w])
+        if not np.array_equal(a, s):
+            divergence += 1
+            out["violations"].append(
+                f"async wave {w} diverged from sync at "
+                f"{int((a != s).sum())} node(s)"
+            )
+        elif not np.array_equal(a, want):
+            divergence += 1
+            out["violations"].append(
+                f"async wave {w} diverged from host BFS at "
+                f"{int((a != want).sum())} node(s)"
+            )
+    if g_async.quiescence_checks == 0:
+        out["violations"].append(
+            "async graph ran zero quiescence checks (uncounted fallback "
+            "to sync)"
+        )
+    reclaimed = lv_sync - lv_async
+    if reclaimed <= 0:
+        out["violations"].append(
+            f"async reclaimed zero exchange barriers "
+            f"(sync {lv_sync} vs async {lv_async} merge epochs)"
+        )
+    stall_ms = max(wall_sync - wall_async, 0.0) * 1e3
+    record_level_stall_ms(stall_ms)
+    out["async_ab"] = {
+        "nodes": n,
+        "waves": n_waves,
+        "async_depth": depth,
+        "exchange": exchange,
+        "oracle_exact": divergence == 0,
+        "sync_levels": lv_sync,
+        "async_merge_epochs": lv_async,
+        "levels_reclaimed": reclaimed,
+        "quiescence_checks": g_async.quiescence_checks,
+        "spec_levels_total": g_async.spec_levels_total,
+        "level_stall_ms": round(stall_ms, 2),
+        "sync_wall_s": round(wall_sync, 3),
+        "async_wall_s": round(wall_async, 3),
+        "sync_inv_per_s": round(tot_sync / max(wall_sync, 1e-9), 1),
+        "async_inv_per_s": round(tot_async / max(wall_async, 1e-9), 1),
+    }
+
+
 async def run_live(mesh, out: dict) -> None:
     from stl_fusion_tpu.client import compute_client, install_compute_call_type
     from stl_fusion_tpu.cluster import ShardMap
@@ -206,9 +318,17 @@ async def run_live(mesh, out: dict) -> None:
         smap = ShardMap.initial(members, n_shards=64)
         exchange = os.environ.get("MESH_EXCHANGE", "a2a")
         n_hosts = int(os.environ.get("MESH_HOSTS", "1"))
+        # MESH_ASYNC=1 rides the whole live composition (pipeline ->
+        # superround -> routed mirror) on the async wave program
+        async_depth = (
+            int(os.environ.get("MESH_ASYNC_DEPTH", "4"))
+            if os.environ.get("MESH_ASYNC", "0") == "1"
+            else 0
+        )
         backend.enable_mesh_routing(
             smap, mesh=mesh, exchange=exchange,
             devices_per_host=(mesh.devices.size // n_hosts) if n_hosts > 1 else None,
+            exchange_async=async_depth > 0, async_depth=async_depth,
         )
 
         adj = {}
@@ -336,6 +456,12 @@ async def run_live(mesh, out: dict) -> None:
             )
         if moves == 0:
             out["violations"].append("reshard moved zero device shards")
+        rg = backend._routed_mirror["graph"]
+        if async_depth > 0 and rg.quiescence_checks == 0:
+            out["violations"].append(
+                "live async ran zero quiescence checks (uncounted fallback "
+                "to sync)"
+            )
         out["live"] = {
             "nodes": ns,
             "members": n_members,
@@ -353,6 +479,8 @@ async def run_live(mesh, out: dict) -> None:
             "external_client_fences": fanout.drained_total,
             "mesh_member_relays": fanout.mesh_member_relays,
             "dcn_fallback_relays": fanout.dcn_fallback_relays,
+            "async_depth": async_depth,
+            "quiescence_checks": rg.quiescence_checks,
         }
         await server_rpc.stop()
         await client_rpc.stop()
@@ -383,6 +511,8 @@ def main() -> None:
     out: dict = {"mesh_devices": n_dev, "violations": []}
     if os.environ.get("MESH_SKIP_STATIC", "0") != "1":
         run_static(mesh, out)
+    if os.environ.get("MESH_ASYNC", "0") == "1":
+        run_async_ab(mesh, out)
     if os.environ.get("MESH_SKIP_LIVE", "0") != "1":
         asyncio.run(run_live(mesh, out))
     if int(os.environ.get("MESH_MULTIHOST", "0")) >= 2:

@@ -89,12 +89,33 @@ __all__ = [
     "ENV_PROCESS_ID",
     "ENV_COORDINATOR",
     "ENV_DEVICES_PER_HOST",
+    "ENV_ASYNC_DEPTH",
+    "async_depth_env",
 ]
 
 ENV_NUM_HOSTS = "FUSION_MH_NUM_HOSTS"
 ENV_PROCESS_ID = "FUSION_MH_PROCESS_ID"
 ENV_COORDINATOR = "FUSION_MH_COORDINATOR"
 ENV_DEVICES_PER_HOST = "FUSION_MH_DEVICES_PER_HOST"
+#: asynchronous frontier execution across real host processes (ISSUE 17):
+#: > 0 switches every routed wave a worker builds to async mode at that
+#: speculation depth; 0 (default) keeps the bulk-synchronous exchange.
+#: One shared parsing site so the scale / geometry / elastic workers and
+#: the orchestrator can never disagree on the mode under test.
+ENV_ASYNC_DEPTH = "FUSION_MH_ASYNC_DEPTH"
+
+
+def async_depth_env(default: int = 0) -> int:
+    """The async speculation depth this process should run routed waves
+    at (``FUSION_MH_ASYNC_DEPTH``; 0 = synchronous per-level exchange).
+    Every host process of a mesh must agree — the wave program is SPMD —
+    which is why workers read the env rather than taking a per-call
+    argument."""
+    try:
+        depth = int(os.environ.get(ENV_ASYNC_DEPTH, str(default)))
+    except ValueError:
+        return default
+    return max(depth, 0)
 
 _DEVCOUNT_FLAG = "--xla_force_host_platform_device_count"
 

@@ -1761,6 +1761,8 @@ class TpuGraphBackend:
         mesh_members=None,
         exchange: str = "a2a",
         devices_per_host: Optional[int] = None,
+        exchange_async: bool = False,
+        async_depth: int = 4,
     ) -> None:
         """Pin the live graph's CSR shards onto mesh devices per the
         CLUSTER shard map (ISSUE 9 tentpole): each member's shard-map
@@ -1776,13 +1778,21 @@ class TpuGraphBackend:
         ``exchange="hier"`` each BFS level then resolves as an intra-host
         collective plus an inter-host exchange of the reduced per-host
         frontier words, inside the same fused chain the super-rounds ride.
-        The mirror itself builds lazily on first routed wave."""
+        ``exchange_async=True`` (ISSUE 17) runs the routed waves in
+        asynchronous mode: each shard expands its LOCAL frontier
+        speculatively for up to ``async_depth`` levels between global
+        merge epochs, and the level fence becomes a counted quiescence
+        vote — the phase-end invalid mask stays bit-identical to sync by
+        the idempotent-OR argument (tier1-gated). The mirror itself
+        builds lazily on first routed wave."""
         self._routed_config = {
             "shard_map": shard_map,
             "mesh": mesh,
             "mesh_members": tuple(mesh_members) if mesh_members is not None else None,
             "exchange": exchange,
             "devices_per_host": devices_per_host,
+            "exchange_async": exchange_async,
+            "async_depth": async_depth,
         }
         self._routed_mirror = None  # rebuild under the new config
 
@@ -1845,6 +1855,8 @@ class TpuGraphBackend:
             exchange=cfg["exchange"],
             edge_dst_epoch=dg._h_edge_dst_epoch[:m].copy(),
             node_epoch=dg._h_node_epoch[: dg.n_nodes],
+            exchange_async=cfg.get("exchange_async", False),
+            async_depth=cfg.get("async_depth", 4),
         )
         self._routed_mirror = {
             "fp": self._routed_fingerprint(),

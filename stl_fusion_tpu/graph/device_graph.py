@@ -190,6 +190,11 @@ class DeviceGraph:
         self._rebuild_deltas: Optional[list] = None
         self.mirror_patches = 0  # patch applications (batches, not deltas)
         self.mirror_rebuilds = 0  # full topo rebuilds
+        # adaptive sweep passes (ISSUE 17): a patched mirror runs sweeps
+        # under a device-side fixed-point loop (passes=0 sentinel) instead
+        # of a worst-case 1+n_viol schedule; counted per adaptive dispatch
+        self.adaptive_passes = False
+        self.adaptive_stages = 0
         self.mirror_patch_s = 0.0  # cumulative patch time
         # patch-time breakdown (ISSUE 7 satellite: BENCH_r05 charged
         # 1090.7 ms to "mirror_patch_ms" with no way to tell numpy
@@ -811,7 +816,9 @@ class DeviceGraph:
             # loop over the jitted sweep serves any count with no
             # recompiles at all
             m["n_viol"] = n_viol
-            m["passes"] = 1 + n_viol
+            # adaptive mode replaces the worst-case 1+n_viol schedule with
+            # the sweep fixed-point loop (passes=0 sentinel, ISSUE 17)
+            m["passes"] = 0 if self.adaptive_passes else 1 + n_viol
         self._mirror_deltas = []
         m["validated_at"] = self._struct_version
         m["fp"] = None  # build-time fingerprint no longer describes the tables
@@ -944,6 +951,33 @@ class DeviceGraph:
     FUSED_PASS_MAX = 3  # ≤ this many sweep passes ride the fused one-
     # dispatch burst programs (one compile per count, persisted); beyond,
     # the split pipeline's host loop serves any count with no recompiles
+    # (passes=0 — the adaptive fixed-point sentinel — always fuses)
+
+    def set_adaptive_passes(self, on: bool = True) -> None:
+        """Switch the mirror sweep schedule to adaptive fixed-point mode
+        (ISSUE 17): bursts run sweeps under a device-side quiescence loop
+        (``passes=0``) instead of the worst-case ``1 + n_viol`` count a
+        patched mirror carries. Takes effect on the next patch/burst; an
+        already-built mirror's pinned pass count updates in place."""
+        self.adaptive_passes = bool(on)
+        m = self._topo_mirror
+        if m is not None:
+            n_viol = int(m.get("n_viol", 0))
+            m["passes"] = 0 if on else 1 + n_viol
+
+    def _count_adaptive(self, passes: int) -> None:
+        """Count one adaptive-mode burst dispatch (``passes <= 0``)."""
+        if passes > 0:
+            return
+        self.adaptive_stages += 1
+        from ..diagnostics.metrics import global_metrics
+
+        global_metrics().counter(
+            "fusion_wave_adaptive_stages_total",
+            help="mirror burst dispatches that ran their sweeps under the "
+            "adaptive device-side fixed-point loop instead of a pinned "
+            "worst-case pass count (ISSUE 17)",
+        ).inc()
     LAT_SEED_MAX = 256  # ≤ this many union seeds routes via the lat mirror
     LAT_K = 4  # lat out-ELL build width (virtual trees bound fan-out)
     LAT_LCAP = 512
@@ -1231,6 +1265,9 @@ class DeviceGraph:
             # occupancy truth) + level boundaries as an array for row→level
             "h_in_src": topo.in_src.copy(),
             "level_starts_arr": np.asarray(topo.level_starts, dtype=np.int64),
+            # a fresh install honors the adaptive-sweep mode (ISSUE 17): a
+            # mid-loop re-level must not silently revert to fixed passes
+            "passes": 0 if self.adaptive_passes else 1,
             # a dict is an already-materialized lat CARRIED across a
             # re-level (level-independent); an EllGraph materializes fresh
             "lat": (
@@ -1549,6 +1586,7 @@ class DeviceGraph:
             # which never recompiles at any pass count
             from ..ops.topo_wave import topo_mirror_fused_union_step
 
+            self._count_adaptive(passes)
             g_invalid2, count, out_ids, overflow = topo_mirror_fused_union_step(
                 m["level_starts"], m["cap"], n_tot, passes
             )(garrays, m["node_epoch0"], m["perm_clipped"], g.invalid, jnp.asarray(ids))
@@ -1615,6 +1653,7 @@ class DeviceGraph:
                 f"mirror carries {passes} sweep passes > FUSED_PASS_MAX — "
                 "chain fusion serves only the fused one-dispatch regime"
             )
+        self._count_adaptive(passes)
         n_tot = m["n_tot"]
         # common lane geometry for the whole chain (scan stages must share
         # one shape): words covers the widest stage, width the widest group
@@ -1759,6 +1798,7 @@ class DeviceGraph:
                 f"mirror carries {passes} sweep passes > FUSED_PASS_MAX — "
                 "super-rounds serve only the fused one-dispatch regime"
             )
+        self._count_adaptive(passes)
         K = int(mats.shape[0])
         if K > self.SUPER_DEPTH_MAX:
             raise ValueError(
@@ -1909,6 +1949,7 @@ class DeviceGraph:
             if passes <= self.FUSED_PASS_MAX:
                 from ..ops.topo_wave import topo_mirror_fused_lanes_step
 
+                self._count_adaptive(passes)
                 g_invalid2, lane_counts, union_count, packed = (
                     topo_mirror_fused_lanes_step(
                         m["level_starts"], n_tot, words, passes

@@ -630,7 +630,24 @@ class SuperRoundProgram:
 
     # ------------------------------------------------------------------ stats
     def stats(self) -> dict:
+        # async frontier passthrough (ISSUE 17): when the routed mirror
+        # rides the asynchronous exchange, surface its merge/quiescence
+        # telemetry beside the super-round counters — the resident program
+        # itself is UNCHANGED (double-buffered staging, one scan per
+        # super-round); only the wave kernel inside the chain differs
+        routed_async: dict = {}
+        entry = self.backend._routed_mirror
+        if entry is not None:
+            g = entry.get("graph")
+            if g is not None and getattr(g, "exchange_async", False):
+                routed_async = {
+                    "exchange_async": True,
+                    "async_depth": g.async_depth,
+                    "quiescence_checks": g.quiescence_checks,
+                    "spec_levels_total": g.spec_levels_total,
+                }
         return {
+            **routed_async,
             "depth": self.depth,
             "superrounds_dispatched": self.superrounds_dispatched,
             "rounds_total": self.rounds_total,

@@ -407,6 +407,33 @@ def run_topo_sweep_passes(level_starts, garrays, seed_bits, node_epoch, passes: 
     return state
 
 
+def _sweep_adaptive(level_starts, garrays, seed_bits, state):
+    """Adaptive pass mode (``passes <= 0``, ISSUE 17): one seeded sweep,
+    then extra sweeps under a device-side ``lax.while_loop`` until the
+    invalid bits reach a FIXED POINT. The bits are monotone under OR, so
+    termination is guaranteed and the fixed point equals what any fixed
+    pass count ≥ the true violation depth computes — the burst stops
+    exactly when quiescent instead of paying a worst-case pass schedule
+    on every dispatch (the fused-chain analogue of the routed plane's
+    counted quiescence check)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    state, _ = _topo_sweep_impl(level_starts, garrays, seed_bits, state, 0)
+    zero_sb = jnp.zeros_like(seed_bits)
+
+    def cond(carry):
+        return carry[1]
+
+    def body(carry):
+        st, _changed = carry
+        st2, _ = _topo_sweep_impl(level_starts, garrays, zero_sb, st, 0)
+        return st2, (st2.invalid_bits != st.invalid_bits).any()
+
+    state, _ = lax.while_loop(cond, body, (state, jnp.array(True)))
+    return state
+
+
 def _pack_bool_bits(mask):
     """Burst epilogues ship the newly-union as 1 bit/node through the
     per-byte-charged relay instead of capped id buffers + a separate pack
@@ -472,10 +499,13 @@ def topo_mirror_fused_union_step(
             jnp.zeros(n_tot + 1, jnp.int32).at[seed_new_ids].set(1).at[n_tot].set(0)
         )
         state = TopoState(node_epoch, jnp.zeros(n_tot + 1, dtype=jnp.int32))
-        sb = seed_bits
-        for _ in range(passes):
-            state, _ = _topo_sweep_impl(level_starts, garrays, sb, state, 0)
-            sb = jnp.zeros_like(seed_bits)  # only the first pass seeds
+        if passes <= 0:
+            state = _sweep_adaptive(level_starts, garrays, seed_bits, state)
+        else:
+            sb = seed_bits
+            for _ in range(passes):
+                state, _ = _topo_sweep_impl(level_starts, garrays, sb, state, 0)
+                sb = jnp.zeros_like(seed_bits)  # only the first pass seeds
         newly = state.invalid_bits.astype(bool) & is_real & ~g_invalid[perm_clipped]
         count = newly.sum(dtype=jnp.int32)
         pos = jnp.cumsum(newly.astype(jnp.int32)) - 1
@@ -555,10 +585,13 @@ def _lanes_stage_body(
         .set(0)
     )
     state = TopoState(node_epoch, jnp.zeros((n_tot + 1, W), dtype=jnp.int32))
-    sb = seed_bits
-    for _ in range(passes):
-        state, _ = _topo_sweep_impl(level_starts, garrays, sb, state, 0)
-        sb = jnp.zeros_like(seed_bits)  # only the first pass seeds
+    if passes <= 0:
+        state = _sweep_adaptive(level_starts, garrays, seed_bits, state)
+    else:
+        sb = seed_bits
+        for _ in range(passes):
+            state, _ = _topo_sweep_impl(level_starts, garrays, sb, state, 0)
+            sb = jnp.zeros_like(seed_bits)  # only the first pass seeds
     newly_bits = jnp.where(
         is_real[:, None] & ~g_invalid[perm_clipped][:, None],
         state.invalid_bits, 0,

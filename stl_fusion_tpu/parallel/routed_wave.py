@@ -82,11 +82,27 @@ from ..cluster.placement import DevicePlacement, PlacementError
 from ..diagnostics.metrics import global_metrics
 from .mesh import GRAPH_AXIS, graph_mesh, shard_map_compat
 
-__all__ = ["RoutedShardedGraph", "build_routed_wave"]
+__all__ = ["RoutedShardedGraph", "build_routed_wave", "record_level_stall_ms"]
 
 _EXCHANGES = ("a2a", "tree", "gather", "hier")
 HOST_AXIS = "host"
 LDEV_AXIS = "ldev"
+
+
+def record_level_stall_ms(ms: float) -> None:
+    """Record the level-barrier stall time an async A/B leg reclaimed
+    (sync wall − async wall over the same wave schedule, clamped at 0) as
+    the ``fusion_mesh_level_stall_ms`` MAX-gauge. Lives here — next to the
+    kernel whose barrier it measures — so the perf legs share one minting
+    site and the catalog row has a package anchor."""
+    g = global_metrics().gauge(
+        "fusion_mesh_level_stall_ms",
+        help="level-barrier stall time reclaimed by the async frontier "
+        "mode over an identical wave schedule (sync wall minus async "
+        "wall, ms; MAX across recordings)",
+    )
+    g.set(float(ms))
+    global_metrics().set_aggregation("fusion_mesh_level_stall_ms", "max")
 
 
 def _flat_spec(mesh: Mesh) -> P:
@@ -101,17 +117,40 @@ def _psum_axes(mesh: Mesh):
     return names[0] if len(names) == 1 else tuple(names)
 
 
-def build_routed_wave(mesh: Mesh, n_global: int, n_dev: int, exchange: str):
+def build_routed_wave(
+    mesh: Mesh, n_global: int, n_dev: int, exchange: str, async_depth: int = 0
+):
     """Compile the routed union wave for a mesh + geometry. Returns
     ``wave(frontier, send_idx, hsend_idx, eprod, ebslot, ebit, edst,
-    eepoch, nepoch, invalid) -> (invalid', count, levels)`` — all arrays
-    sharded over the mesh's flat device axis; seeds conduct even when
-    already invalid (the r4 union rule); ``levels`` is the number of
-    frontier exchanges the wave ran (the collective-rounds telemetry
-    ``fusion_mesh_exchange_levels`` aggregates). For ``exchange="hier"``
-    the mesh must be the 2-D ``(host, ldev)`` mesh; bucket capacities are
-    read from the (trace-time) table shapes, which is what lets an
-    in-place bucket resize recompile instead of re-pack."""
+    elsrc, eepoch, nepoch, invalid) -> (invalid', count, levels,
+    spec_levels)`` — all arrays sharded over the mesh's flat device axis;
+    seeds conduct even when already invalid (the r4 union rule);
+    ``levels`` is the number of frontier exchanges the wave ran (the
+    collective-rounds telemetry ``fusion_mesh_exchange_levels``
+    aggregates). For ``exchange="hier"`` the mesh must be the 2-D
+    ``(host, ldev)`` mesh; bucket capacities are read from the
+    (trace-time) table shapes, which is what lets an in-place bucket
+    resize recompile instead of re-pack.
+
+    ``async_depth >= 1`` compiles the ASYNCHRONOUS execution mode (ISSUE
+    17): between global merges each shard advances its LOCAL frontier
+    speculatively for up to ``async_depth`` levels through the per-edge
+    ``elsrc`` table (same-device source row, pad for remote sources —
+    local CSR expansion never waits on remote words). A merge then
+    exchanges the cumulative EVER-LIT accumulator through the unchanged
+    OR-accumulation collectives (atomic-free by construction — packed-word
+    OR is idempotent and order-independent, the Tascade reduction-tree
+    property) and fires every edge against it, which both completes the
+    remote frontier and picks up local rows the bounded speculation left
+    unexpanded. The per-level barrier becomes a counted QUIESCENCE vote:
+    one psum of "did any shard's merge fire a row" per merge epoch —
+    merge firing nothing anywhere proves no ever-lit→eligible edge
+    remains, i.e. the closure is complete (monotone idempotent
+    OR-accumulation makes the final mask schedule-independent, so the
+    async mask is bit-identical to the sync exchange and the host BFS).
+    ``levels`` then counts MERGE epochs (each runs exactly one full
+    exchange — the cross-host-words accounting stays honest) and
+    ``spec_levels`` the deepest shard's productive speculative levels."""
     if exchange not in _EXCHANGES:
         raise ValueError(f"unknown exchange {exchange!r}")
     n_local = n_global // n_dev
@@ -231,30 +270,82 @@ def build_routed_wave(mesh: Mesh, n_global: int, n_dev: int, exchange: str):
         mesh=mesh,
         in_specs=(
             node_spec, send_spec, send_spec, edge_spec, edge_spec, edge_spec,
-            edge_spec, edge_spec, node_spec, node_spec,
+            edge_spec, edge_spec, edge_spec, node_spec, node_spec,
         ),
-        out_specs=(node_spec, P(), P()),
+        out_specs=(node_spec, P(), P(), P()),
     )
     def _wave(seeds_l, send_idx_l, hsend_idx_l, eprod_l, ebslot_l, ebit_l,
-              edst_l, eepoch_l, nepoch_l, inv_l):
+              edst_l, elsrc_l, eepoch_l, nepoch_l, inv_l):
         fresh = seeds_l & ~inv_l
         inv_l = inv_l | seeds_l
         count0 = lax.psum(fresh.sum(dtype=jnp.int32), ax)
         go0 = lax.psum(seeds_l.any().astype(jnp.int32), ax) > 0
+
+        def merge_fire(frontier, inv):
+            """One global exchange of ``frontier`` + a fire over EVERY
+            edge against it (shared by the sync per-level step and the
+            async merge epoch)."""
+            intra_flat, cross_flat = _exchange_words(
+                frontier, send_idx_l, hsend_idx_l
+            )
+            word = _lookup(
+                intra_flat, cross_flat, send_idx_l, hsend_idx_l, eprod_l, ebslot_l
+            )
+            src_active = ((word >> ebit_l.astype(jnp.uint32)) & 1).astype(bool)
+            ver_ok = nepoch_l[edst_l] == eepoch_l  # gather clamps; -1 never matches
+            fire = src_active & ver_ok & ~inv[edst_l]
+            return jnp.zeros_like(frontier).at[edst_l].max(fire)  # OOB pads dropped
+
+        if async_depth and async_depth > 0:
+            # ---- asynchronous mode: speculative local levels between
+            # counted-quiescence merges (ISSUE 17) ----
+            def spec_body(_i, st):
+                f, inv, acc, newly_l, spec = st
+                # local-only expansion: a remote-sourced edge's elsrc is
+                # the pad row → fill False, so it simply waits for a merge
+                src_active = f.at[elsrc_l].get(mode="fill", fill_value=False)
+                ver_ok = nepoch_l[edst_l] == eepoch_l
+                fire = src_active & ver_ok & ~inv[edst_l]
+                nxt = jnp.zeros_like(f).at[edst_l].max(fire)
+                return (
+                    nxt, inv | nxt, acc | nxt,
+                    newly_l + nxt.sum(dtype=jnp.int32),
+                    spec + nxt.any().astype(jnp.int32),
+                )
+
+            def cond(carry):
+                return carry[6]
+
+            def body(carry):
+                f, inv, acc, count, merges, spec, _go = carry
+                f, inv, acc, newly_l, spec = lax.fori_loop(
+                    0, async_depth, spec_body,
+                    (f, inv, acc, jnp.int32(0), spec),
+                )
+                # merge epoch: exchange the EVER-LIT accumulator and fire
+                # every edge against it — completes remote frontiers AND
+                # local rows the bounded speculation left unexpanded
+                nxt_m = merge_fire(acc, inv)
+                inv = inv | nxt_m
+                acc = acc | nxt_m
+                newly = lax.psum(newly_l + nxt_m.sum(dtype=jnp.int32), ax)
+                # quiescence vote: the merge covers ALL edges against all
+                # ever-lit rows — firing nothing anywhere proves closure
+                go = lax.psum(nxt_m.any().astype(jnp.int32), ax) > 0
+                return nxt_m, inv, acc, count + newly, merges + 1, spec, go
+
+            _f, inv_l, _acc, count, levels, spec, _go = lax.while_loop(
+                cond, body,
+                (seeds_l, inv_l, seeds_l, count0, jnp.int32(0), jnp.int32(0), go0),
+            )
+            return inv_l, count, levels, lax.pmax(spec, ax)
 
         def cond(carry):
             return carry[4]
 
         def body(carry):
             f_l, inv_l, count, levels, _go = carry
-            intra_flat, cross_flat = _exchange_words(f_l, send_idx_l, hsend_idx_l)
-            word = _lookup(
-                intra_flat, cross_flat, send_idx_l, hsend_idx_l, eprod_l, ebslot_l
-            )
-            src_active = ((word >> ebit_l.astype(jnp.uint32)) & 1).astype(bool)
-            ver_ok = nepoch_l[edst_l] == eepoch_l  # gather clamps; -1 never matches
-            fire = src_active & ver_ok & ~inv_l[edst_l]
-            nxt_l = jnp.zeros_like(f_l).at[edst_l].max(fire)  # OOB pads dropped
+            nxt_l = merge_fire(f_l, inv_l)
             inv_l = inv_l | nxt_l
             newly = lax.psum(nxt_l.sum(dtype=jnp.int32), ax)
             return nxt_l, inv_l, count + newly, levels + 1, newly > 0
@@ -262,7 +353,7 @@ def build_routed_wave(mesh: Mesh, n_global: int, n_dev: int, exchange: str):
         _f, inv_l, count, levels, _go = lax.while_loop(
             cond, body, (seeds_l, inv_l, count0, jnp.int32(0), go0)
         )
-        return inv_l, count, levels
+        return inv_l, count, levels, jnp.int32(0)
 
     return jax.jit(_wave)
 
@@ -323,6 +414,8 @@ class RoutedShardedGraph:
         edge_headroom: float = 1.3,
         max_resizes: int = 8,
         resize_growth: float = 1.5,
+        exchange_async: bool = False,
+        async_depth: int = 4,
     ):
         base_mesh = mesh or graph_mesh()
         if base_mesh.devices.size != placement.n_dev:
@@ -332,8 +425,23 @@ class RoutedShardedGraph:
             )
         if exchange not in _EXCHANGES:
             raise ValueError(f"unknown exchange {exchange!r}")
+        #: tree requested but n_dev is not a power of two — resolved via
+        #: gather, COUNTED (FL002: no silent mode swaps; same contract as
+        #: the hier fallback below)
+        self.tree_fallbacks = 0
         if exchange == "tree" and (placement.n_dev & (placement.n_dev - 1)):
-            exchange = "gather"  # tree needs 2^k devices; honest fallback
+            exchange = "gather"  # tree's xor rounds need 2^k devices
+            self.tree_fallbacks = 1
+            global_metrics().counter(
+                "fusion_mesh_tree_fallback_total",
+                help="tree exchanges resolved via gather on a non-power-of-2 "
+                "device count (counted fallback, never a decline)",
+            ).inc()
+            from ..resilience.events import global_events
+
+            global_events().record(
+                "tree_fallback", f"n_dev={placement.n_dev}"
+            )
         self.dph = placement.devices_per_host or placement.n_dev
         self.n_hosts = placement.n_dev // self.dph
         #: hier requested but the geometry can't ride the xor trees —
@@ -380,9 +488,15 @@ class RoutedShardedGraph:
         self.resize_growth = resize_growth
         self.bucket_resizes = 0
         self.resize_detail = {"bucket": 0, "hbucket": 0, "edge": 0}
+        #: async frontier execution (ISSUE 17): speculative local levels
+        #: between counted-quiescence merge epochs
+        self.exchange_async = bool(exchange_async)
+        self.async_depth = int(async_depth) if self.exchange_async else 0
         # -- telemetry --
         self.waves_run = 0
         self.levels_total = 0  # frontier exchanges (collective rounds)
+        self.quiescence_checks = 0  # async merge epochs (each = one vote)
+        self.spec_levels_total = 0  # deepest shard's productive spec levels
         self.shard_moves = 0
         self.cross_host_moves = 0
         self.patches = 0
@@ -454,7 +568,8 @@ class RoutedShardedGraph:
         self.g_invalid = self._put(inv0, self._node_sh)
         self.g_is_real = self._put(self._h_is_real, self._node_sh)
         self._wave = build_routed_wave(
-            self.mesh, self.n_global, self.n_dev, self.exchange
+            self.mesh, self.n_global, self.n_dev, self.exchange,
+            async_depth=self.async_depth,
         )
         self._collect_cache: dict = {}
         self._chain_cache: dict = {}
@@ -564,6 +679,18 @@ class RoutedShardedGraph:
             if n_e
             else np.empty(0, np.int32)
         )
+        # async speculation operates on LOCAL sources only: same-device
+        # producers get their local row, remote ones the pad row (they
+        # wait for a merge epoch)
+        elsrc = (
+            np.where(
+                src_rows // self.n_local == d,
+                src_rows - d * self.n_local,
+                self.n_local,
+            ).astype(np.int32)
+            if n_e
+            else np.empty(0, np.int32)
+        )
         buckets: Dict[int, np.ndarray] = {}
         cross = None
         if self.exchange in ("tree", "gather"):
@@ -600,6 +727,7 @@ class RoutedShardedGraph:
             "ebslot": ebslot,
             "ebit": ebit,
             "edst": edst,
+            "elsrc": elsrc,
             "eep": ep,
             "buckets": buckets,
             "cross": cross,
@@ -777,6 +905,9 @@ class RoutedShardedGraph:
             self._h_edst = np.full(
                 self.n_dev * self.e_cap, self.n_local, dtype=np.int32
             )  # pad: dropped
+            self._h_elsrc = np.full(
+                self.n_dev * self.e_cap, self.n_local, dtype=np.int32
+            )  # pad: fill-False on speculative gather
             self._h_eep = np.full(self.n_dev * self.e_cap, -1, dtype=np.int32)
         for d, pack in packs.items():
             sl = slice(d * self.e_cap, (d + 1) * self.e_cap)
@@ -785,12 +916,14 @@ class RoutedShardedGraph:
             self._h_ebslot[sl] = 0
             self._h_ebit[sl] = 0
             self._h_edst[sl] = self.n_local
+            self._h_elsrc[sl] = self.n_local
             self._h_eep[sl] = -1
             if n_e:
                 self._h_eprod[sl][:n_e] = pack["eprod"]
                 self._h_ebslot[sl][:n_e] = pack["ebslot"]
                 self._h_ebit[sl][:n_e] = pack["ebit"]
                 self._h_edst[sl][:n_e] = pack["edst"]
+                self._h_elsrc[sl][:n_e] = pack["elsrc"]
                 self._h_eep[sl][:n_e] = pack["eep"]
 
     def _recount_cross_words(self) -> None:
@@ -825,6 +958,7 @@ class RoutedShardedGraph:
         self.g_ebslot = self._put(self._h_ebslot, self._edge_sh)
         self.g_ebit = self._put(self._h_ebit, self._edge_sh)
         self.g_edst = self._put(self._h_edst, self._edge_sh)
+        self.g_elsrc = self._put(self._h_elsrc, self._edge_sh)
         self.g_eep = self._put(self._h_eep, self._edge_sh)
 
     # ------------------------------------------------------------------ resize
@@ -870,6 +1004,7 @@ class RoutedShardedGraph:
                 ("_h_ebslot", 0),
                 ("_h_ebit", 0),
                 ("_h_edst", self.n_local),
+                ("_h_elsrc", self.n_local),
                 ("_h_eep", -1),
             ):
                 arr = getattr(self, name)
@@ -893,8 +1028,20 @@ class RoutedShardedGraph:
         return True
 
     # ------------------------------------------------------------------ waves
-    def _count_exchange(self, levels: int) -> None:
+    def _count_exchange(self, levels: int, spec_levels: int = 0) -> None:
         self.levels_total += levels
+        if self.exchange_async and levels:
+            # async mode: each merge epoch ends in exactly one counted
+            # quiescence vote over the psum plane (the level fence that
+            # replaced the per-level barrier)
+            self.quiescence_checks += levels
+            self.spec_levels_total += spec_levels
+            global_metrics().counter(
+                "fusion_mesh_quiescence_checks_total",
+                help="async-mode counted quiescence votes (one per merge "
+                "epoch — the fence that replaced the per-level exchange "
+                "barrier, ISSUE 17)",
+            ).inc(levels)
         if self.cross_words_per_level and levels:
             shipped = levels * self.cross_words_per_level
             self.cross_host_words += shipped
@@ -929,17 +1076,18 @@ class RoutedShardedGraph:
         if fn is None:
             fn = self._build_collect(capd)
             self._collect_cache[(capd, width)] = fn
-        self.g_invalid, counts, levels, bufs = fn(
+        self.g_invalid, counts, levels, spec, bufs = fn(
             self._host_arg(rows), self.g_send, self.g_hsend, self.g_eprod,
-            self.g_ebslot, self.g_ebit, self.g_edst, self.g_eep,
+            self.g_ebslot, self.g_ebit, self.g_edst, self.g_elsrc, self.g_eep,
             self.g_node_epoch, self.g_invalid, self.g_is_real,
         )
-        self._sync(self.g_invalid, counts, levels, bufs)
+        self._sync(self.g_invalid, counts, levels, spec, bufs)
         counts = self._fetch(counts)
         levels = self._fetch(levels)
+        spec = self._fetch(spec)
         bufs = self._fetch(bufs)
         self.waves_run += 1
-        self._count_exchange(int(levels))
+        self._count_exchange(int(levels), int(spec))
         count = int(counts.sum())
         if (counts > capd).any():
             return count, np.empty(0, np.int64), True
@@ -955,17 +1103,18 @@ class RoutedShardedGraph:
         n_global = self.n_global
 
         @jax.jit
-        def collect(seed_rows, send, hsend, eprod, ebslot, ebit, edst, eep,
-                    nepoch, inv, is_real):
+        def collect(seed_rows, send, hsend, eprod, ebslot, ebit, edst, elsrc,
+                    eep, nepoch, inv, is_real):
             frontier = lax.with_sharding_constraint(
                 jnp.zeros(n_global, bool).at[seed_rows].set(True, mode="drop"),
                 node_sh,
             )
-            inv2, _count, levels = wave(
-                frontier, send, hsend, eprod, ebslot, ebit, edst, eep, nepoch, inv
+            inv2, _count, levels, spec = wave(
+                frontier, send, hsend, eprod, ebslot, ebit, edst, elsrc,
+                eep, nepoch, inv,
             )
             counts, bufs = compact(inv2, inv, is_real)
-            return inv2, counts, levels, bufs
+            return inv2, counts, levels, spec, bufs
 
         return collect
 
@@ -1027,16 +1176,16 @@ class RoutedShardedGraph:
         if fn is None:
             fn = self._build_chain(capd)
             self._chain_cache[(K, width, capd)] = fn
-        self.g_invalid, counts, levels, bufs = fn(
+        self.g_invalid, counts, levels, spec, bufs = fn(
             self._host_arg(mat), self.g_send, self.g_hsend, self.g_eprod,
-            self.g_ebslot, self.g_ebit, self.g_edst, self.g_eep,
+            self.g_ebslot, self.g_ebit, self.g_edst, self.g_elsrc, self.g_eep,
             self.g_node_epoch, self.g_invalid, self.g_is_real,
         )
         # multi-process: the chain's collectives must fully drain before
         # any later module's (harvest fetch, patch) hit the gloo pairs —
         # the dispatch stays nonblocking on a single-process mesh
-        self._sync(self.g_invalid, counts, levels, bufs)
-        return {"counts": counts, "levels": levels, "bufs": bufs,
+        self._sync(self.g_invalid, counts, levels, spec, bufs)
+        return {"counts": counts, "levels": levels, "spec": spec, "bufs": bufs,
                 "stages": K, "capd": capd, "dispatches": 1}
 
     def _build_chain(self, capd: int):
@@ -1046,22 +1195,22 @@ class RoutedShardedGraph:
         n_global = self.n_global
 
         @jax.jit
-        def chain(seed_mat, send, hsend, eprod, ebslot, ebit, edst, eep,
-                  nepoch, inv0, is_real):
+        def chain(seed_mat, send, hsend, eprod, ebslot, ebit, edst, elsrc,
+                  eep, nepoch, inv0, is_real):
             def body(inv, seed_rows):
                 frontier = lax.with_sharding_constraint(
                     jnp.zeros(n_global, bool).at[seed_rows].set(True, mode="drop"),
                     node_sh,
                 )
-                inv2, _c, levels = wave(
-                    frontier, send, hsend, eprod, ebslot, ebit, edst, eep,
-                    nepoch, inv,
+                inv2, _c, levels, spec = wave(
+                    frontier, send, hsend, eprod, ebslot, ebit, edst, elsrc,
+                    eep, nepoch, inv,
                 )
                 counts, bufs = compact(inv2, inv, is_real)
-                return inv2, (counts, levels, bufs)
+                return inv2, (counts, levels, spec, bufs)
 
-            inv, (counts, levels, bufs) = lax.scan(body, inv0, seed_mat)
-            return inv, counts, levels, bufs
+            inv, (counts, levels, spec, bufs) = lax.scan(body, inv0, seed_mat)
+            return inv, counts, levels, spec, bufs
 
         return chain
 
@@ -1073,10 +1222,11 @@ class RoutedShardedGraph:
         path is never silent."""
         counts_dev = self._fetch(pending["counts"])
         levels = self._fetch(pending["levels"])
+        spec = self._fetch(pending["spec"])
         bufs = self._fetch(pending["bufs"])
         capd = pending["capd"]
         self.waves_run += pending["stages"]
-        self._count_exchange(int(levels.sum()))
+        self._count_exchange(int(levels.sum()), int(spec.sum()))
         counts = counts_dev.astype(np.int64).sum(axis=1)
         stage_ids: List[Optional[np.ndarray]] = []
         overflowed = False
@@ -1337,6 +1487,7 @@ class RoutedShardedGraph:
         e_bslot = np.empty(0, np.int32)
         e_bit = np.empty(0, np.int32)
         e_dst = np.empty(0, np.int32)
+        e_lsrc = np.empty(0, np.int32)
         e_ep = np.empty(0, np.int32)
         send_writes: List[Tuple[int, int, int, int]] = []  # (p, c, j, wl) intra
         self._hsend_writes = []
@@ -1367,7 +1518,7 @@ class RoutedShardedGraph:
                 if not self._try_grow("edge", need_e, upload=False):
                     return False  # edge slack exhausted: rebuild rung
                 grew = True
-            er, eP, eS, eb, ed, ee = [], [], [], [], [], []
+            er, eP, eS, eb, ed, el, ee = [], [], [], [], [], [], []
             bucket_need = 0
             hbucket_need = 0
             for d in uds.tolist():
@@ -1380,6 +1531,11 @@ class RoutedShardedGraph:
                 er.append(rows)
                 eb.append((ur & 31).astype(np.int32))
                 ed.append((vr - d * self.n_local).astype(np.int32))
+                el.append(
+                    np.where(
+                        ur // self.n_local == d, ur - d * self.n_local, self.n_local
+                    ).astype(np.int32)
+                )
                 ee.append(ep[sel])
                 if self.exchange in ("tree", "gather"):
                     eP.append(np.zeros(k, np.int32))
@@ -1454,6 +1610,7 @@ class RoutedShardedGraph:
                 self._h_ebslot[rows] = eS[-1]
                 self._h_ebit[rows] = eb[-1]
                 self._h_edst[rows] = ed[-1]
+                self._h_elsrc[rows] = el[-1]
                 self._h_eep[rows] = ee[-1]
             # bucket growth AFTER slot assignment (slots are cap-independent
             # — only the flat table rows below depend on the final caps)
@@ -1474,6 +1631,7 @@ class RoutedShardedGraph:
             e_bslot = np.concatenate(eS) if eS else e_bslot
             e_bit = np.concatenate(eb) if eb else e_bit
             e_dst = np.concatenate(ed) if ed else e_dst
+            e_lsrc = np.concatenate(el) if el else e_lsrc
             e_ep = np.concatenate(ee) if ee else e_ep
         # materialize the send-table writes with the FINAL capacities
         s_rows = np.empty(0, np.int64)
@@ -1533,6 +1691,7 @@ class RoutedShardedGraph:
         pes = _pad(e_bslot, 0, np.int32)
         peb = _pad(e_bit, 0, np.int32)
         ped = _pad(e_dst, self.n_local, np.int32)
+        pel = _pad(e_lsrc, self.n_local, np.int32)
         pee = _pad(e_ep, -1, np.int32)
         ps = _pad(s_rows, self._h_send.size)
         psv = _pad(s_vals, self.w_local, np.int32)
@@ -1545,14 +1704,15 @@ class RoutedShardedGraph:
             self._patch_cache[key] = fn
         (
             self.g_node_epoch, self.g_eprod, self.g_ebslot, self.g_ebit,
-            self.g_edst, self.g_eep, self.g_send, self.g_hsend,
+            self.g_edst, self.g_elsrc, self.g_eep, self.g_send, self.g_hsend,
         ) = fn(
             self.g_node_epoch, self.g_eprod, self.g_ebslot, self.g_ebit,
-            self.g_edst, self.g_eep, self.g_send, self.g_hsend,
+            self.g_edst, self.g_elsrc, self.g_eep, self.g_send, self.g_hsend,
             self._host_arg(pb), self._host_arg(pbc), self._host_arg(pe),
             self._host_arg(pep), self._host_arg(pes), self._host_arg(peb),
-            self._host_arg(ped), self._host_arg(pee), self._host_arg(ps),
-            self._host_arg(psv), self._host_arg(ph), self._host_arg(phv),
+            self._host_arg(ped), self._host_arg(pel), self._host_arg(pee),
+            self._host_arg(ps), self._host_arg(psv), self._host_arg(ph),
+            self._host_arg(phv),
         )
         self._sync(self.g_node_epoch, self.g_send)
         self.patches += 1
@@ -1563,14 +1723,15 @@ class RoutedShardedGraph:
         node_sh, edge_sh, send_sh = self._node_sh, self._edge_sh, self._send_sh
 
         @jax.jit
-        def patch(nep, eprod, ebslot, ebit, edst, eep, send, hsend,
-                  b_rows, b_counts, e_rows, e_prod, e_bslot, e_bit, e_dst, e_ep,
-                  s_rows, s_vals, h_rows, h_vals):
+        def patch(nep, eprod, ebslot, ebit, edst, elsrc, eep, send, hsend,
+                  b_rows, b_counts, e_rows, e_prod, e_bslot, e_bit, e_dst,
+                  e_lsrc, e_ep, s_rows, s_vals, h_rows, h_vals):
             nep = nep.at[b_rows].add(b_counts, mode="drop")
             eprod = eprod.at[e_rows].set(e_prod, mode="drop")
             ebslot = ebslot.at[e_rows].set(e_bslot, mode="drop")
             ebit = ebit.at[e_rows].set(e_bit, mode="drop")
             edst = edst.at[e_rows].set(e_dst, mode="drop")
+            elsrc = elsrc.at[e_rows].set(e_lsrc, mode="drop")
             eep = eep.at[e_rows].set(e_ep, mode="drop")
             flat = send.reshape(-1).at[s_rows].set(s_vals, mode="drop")
             hflat = hsend.reshape(-1).at[h_rows].set(h_vals, mode="drop")
@@ -1580,6 +1741,7 @@ class RoutedShardedGraph:
                 lax.with_sharding_constraint(ebslot, edge_sh),
                 lax.with_sharding_constraint(ebit, edge_sh),
                 lax.with_sharding_constraint(edst, edge_sh),
+                lax.with_sharding_constraint(elsrc, edge_sh),
                 lax.with_sharding_constraint(eep, edge_sh),
                 lax.with_sharding_constraint(flat.reshape(send.shape), send_sh),
                 lax.with_sharding_constraint(hflat.reshape(hsend.shape), send_sh),
@@ -1677,6 +1839,11 @@ class RoutedShardedGraph:
             "placement_epoch": self.placement.epoch,
             "waves_run": self.waves_run,
             "exchange_levels_total": self.levels_total,
+            "exchange_async": self.exchange_async,
+            "async_depth": self.async_depth,
+            "quiescence_checks": self.quiescence_checks,
+            "spec_levels_total": self.spec_levels_total,
+            "tree_fallbacks": self.tree_fallbacks,
             "shard_moves": self.shard_moves,
             "cross_host_moves": self.cross_host_moves,
             "patches": self.patches,

@@ -59,6 +59,9 @@ def test_compact_record_stays_under_tail_window():
         "mirror_patch_ms": 1678.61,
         "mirror_patch_host_ms": 88.21,
         "mirror_patch_device_ms": 1590.41,
+        "live_async": True,
+        "live_adaptive_stages": 18,
+        "live_level_stall_ms": 2.413,
         "cold_start": {
             "build_s": 2.45, "mirror_build_s": 48.95,
             "lane_program_warm_s": 20.59, "union_program_warm_s": 27.13,
@@ -127,7 +130,17 @@ def test_compact_record_stays_under_tail_window():
             "wave_chain_ms_p50": 10.553, "wave_chain_ms_p99": 16.637,
             "wave_chain_rejects": 0, "reshard_moves": 29,
             "oracle_divergence": 0, "mesh_member_relays": 0,
-            "dcn_fallback_relays": 0,
+            "dcn_fallback_relays": 0, "async_depth": 4,
+            "quiescence_checks": 31,
+        },
+        "async_ab": {
+            "nodes": 120_000, "waves": 3, "async_depth": 4,
+            "exchange": "a2a", "oracle_exact": True, "sync_levels": 53,
+            "async_merge_epochs": 42, "levels_reclaimed": 11,
+            "quiescence_checks": 56, "spec_levels_total": 104,
+            "level_stall_ms": 41.23, "sync_wall_s": 0.402,
+            "async_wall_s": 0.361, "sync_inv_per_s": 107373.9,
+            "async_inv_per_s": 119584.2,
         },
         "multihost": {
             "hosts": 2, "devices_per_host": 2, "nodes": 100_000_000,
@@ -190,10 +203,11 @@ def test_compact_record_stays_under_tail_window():
                         traffic=traffic, lint=lint),
         separators=(",", ":"),
     )
-    # window raised 3700 → 4000 for the ISSUE 15 multihost fields (hosts /
-    # cross_host_words / bucket_resizes / dcn / host_kill_recovery_s) —
-    # still comfortably inside the driver's bounded stdout tail
-    assert len(line) < 4000, f"compact record grew to {len(line)} bytes"
+    # window raised 3700 → 4000 for the ISSUE 15 multihost fields, then
+    # → 4300 for the ISSUE 17 async fields (levels_reclaimed /
+    # level_stall_ms / quiescence_checks / adaptive_stages) — still
+    # comfortably inside the driver's bounded stdout tail
+    assert len(line) < 4300, f"compact record grew to {len(line)} bytes"
     d = json.loads(line)
     # the edge tier (ISSUE 8): the million-subscriber numbers make the capture
     assert d["edge"]["subs"] == 1_000_000 and d["edge"]["fenced_per_s"] == 412346
@@ -229,6 +243,11 @@ def test_compact_record_stays_under_tail_window():
     assert d["live"]["host_stalls_per_round"] == 25.45
     assert d["live"]["superround_eager_rounds"] == 0
     assert d["live"]["superround_faults"] == 0
+    # the adaptive-sweep fields (ISSUE 17) ride the capture: mode bit,
+    # counted adaptive stages, and the measured per-wave stall reclaim
+    assert d["live"]["async"] is True
+    assert d["live"]["adaptive_stages"] == 18
+    assert d["live"]["level_stall_ms"] == 2.413
     # the mesh-sharded graph (ISSUE 9): the north-star scale + oracle
     # verdict + routed-path engagement ride the capture
     assert d["mesh"]["nodes"] == 80_000_000 and d["mesh"]["oracle_exact"] is True
@@ -247,6 +266,15 @@ def test_compact_record_stays_under_tail_window():
     assert d["mesh"]["dcn_fallback_relays"] == 1
     assert d["mesh"]["host_kill_recovery_s"] == 2.53
     assert d["mesh"]["rejoin_oracle_exact"] is True
+    # the async A/B (ISSUE 17): barriers reclaimed + the counted
+    # quiescence evidence + both modes' inv/s ride the capture
+    assert d["mesh"]["async_depth"] == 4
+    assert d["mesh"]["async_oracle_exact"] is True
+    assert d["mesh"]["levels_reclaimed"] == 11
+    assert d["mesh"]["level_stall_ms"] == 41.23
+    assert d["mesh"]["quiescence_checks"] == 56
+    assert d["mesh"]["sync_inv_per_s"] == 107373.9
+    assert d["mesh"]["async_inv_per_s"] == 119584.2
     # the overload plane (ISSUE 12): admitted/shed per lane, the drain
     # loss (must be 0) and the adversarial p99s ride the capture
     assert d["traffic"]["ok"] is True
