@@ -600,7 +600,17 @@ def run_mesh_section():
         return {"error": "mesh path timed out"}
     if proc.returncode != 0:
         return {"error": f"mesh path failed rc={proc.returncode} (stderr inherited above)"}
-    return json.loads(proc.stdout.strip().splitlines()[-1])
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    if env.get("MESH_ASYNC") == "1":
+        # ISSUE 18: an async bench run without straggler attribution is
+        # a blind record — the whole point of async mode is knowing WHO
+        # paced the merge epochs, so its absence is a recorded violation
+        trace = (rec.get("async_ab") or {}).get("trace") or {}
+        if not trace.get("straggler"):
+            rec.setdefault("violations", []).append(
+                "bench: MESH_ASYNC=1 but the async A/B carries no straggler table"
+            )
+    return rec
 
 
 def run_edge_section():
@@ -1029,6 +1039,21 @@ def _compact_result(
             )
             out["mesh"]["host_kill_recovery_s"] = chaos.get("host_kill_recovery_s")
             out["mesh"]["rejoin_oracle_exact"] = chaos.get("rejoin_oracle_exact")
+            # ISSUE 18: the fleet-telemetry merge verdict (every host
+            # reporting, zero live hosts stale, counters an exact SUM)
+            # and the stitched-wave digest — levels, pacing host/shard,
+            # the straggler table — ride the canonical record, so wave
+            # pacing is diffable release over release
+            telem = scale.get("mesh_telemetry") or {}
+            if telem:
+                out["mesh"]["mesh_telemetry"] = {
+                    "hosts": telem.get("hosts"),
+                    "stale": telem.get("stale"),
+                    "sum_exact": telem.get("sum_exact"),
+                    "merged_series": telem.get("merged_series"),
+                }
+            if scale.get("trace"):
+                out["mesh"]["mh_trace"] = scale["trace"]
     if traffic is not None and "error" in traffic:
         out["traffic"] = {"error": traffic["error"]}
     elif traffic is not None:

@@ -85,7 +85,7 @@ def _env_int(name: str, default: int) -> int:
 
 # the ONE oracle BFS both perf gates share (mesh_path is importable in
 # both entry modes: worker runs from perf/, orchestrator imports us lazily)
-from mesh_path import numpy_bfs_mask  # noqa: E402
+from mesh_path import compact_trace, numpy_bfs_mask  # noqa: E402
 
 
 def _put_file(path: str, content: str) -> None:
@@ -322,6 +322,9 @@ def run_worker() -> int:
     t_run = time.time()
     for r in range(start_round, end_round):
         deadline_holder[0] = time.time() + round_deadline_s
+        # every host pins the SAME deterministic cause for round r, so the
+        # per-host trace segments stitch into one cross-host wave timeline
+        graph.trace_cause = f"mesh-wave/{phase}#r{r}"
         pending = graph.dispatch_union_chain(schedule[r])
         counts, stage_ids, info = graph.harvest_union_chain(pending)
         chain_dispatches += 1
@@ -346,6 +349,7 @@ def run_worker() -> int:
                 os.path.join(mh_dir, f"progress_h{ctx.process_id}"), str(r + 1)
             )
     burst_s = time.time() - t_run
+    graph.trace_cause = None  # later legs mint their own wave causes
     rounds_run = end_round - start_round
     if mask_know is None:
         flat_all = [s for r_ in schedule[:end_round] for st in r_ for s in st]
@@ -369,6 +373,13 @@ def run_worker() -> int:
         divergence=divergence,
         serving_ts=time.time(),  # first oracle-exact service of this phase
     )
+
+    # fleet telemetry + trace stitch (ISSUE 18): every host publishes its
+    # registry snapshot + trace segments onto the board, then host 0
+    # aggregates, asserts the merge semantics and stitches the last round
+    ctx.sync("pre-telemetry")
+    _telemetry_leg(ctx, mh_dir, phase, live_members, end_round, result)
+    ctx.sync("post-telemetry")
 
     if phase == "scale":
         # wave-0 packed mask export: the parent cross-checks it against
@@ -450,6 +461,83 @@ def _resize_leg(graph, src, dst, n, mask_know, result: dict) -> None:
         "detail": graph.stats()["resize_detail"],
         "post_resize_oracle_exact": bool(np.array_equal(grown_mask, want2)),
     }
+
+
+def _telemetry_leg(ctx, mh_dir: str, phase: str, live_members, end_round: int,
+                   result: dict) -> None:
+    """Mesh telemetry over a REAL process boundary (ISSUE 18 tentpole c):
+    each host publishes its registry snapshot + trace segments onto the
+    rendezvous board; host 0 aggregates, asserts the merge is honest (SUM
+    of a known counter matches the per-host scrapes exactly, both host
+    labels present, nobody stale), and stitches the last round's wave into
+    ONE cross-host timeline with a straggler table."""
+    from stl_fusion_tpu.cluster.mesh_controller import RendezvousBoard
+    from stl_fusion_tpu.diagnostics.mesh_telemetry import (
+        MeshTelemetryAggregator,
+        MeshTelemetryPublisher,
+        global_mesh_trace,
+    )
+
+    member = f"h{ctx.process_id}"
+    board = RendezvousBoard(os.path.join(mh_dir, "tboard"))
+    pub = MeshTelemetryPublisher(member=member, period_s=5.0)
+    payload = pub.publish_board(board)
+    ctx.sync("telemetry-published")
+    if ctx.process_id != 0:
+        return
+    agg = MeshTelemetryAggregator(local_member=member, period_s=5.0)
+    agg.sync_board(board)
+    missing = sorted(set(live_members) - set(agg.known_hosts()))
+    if missing:
+        result["violations"].append(
+            f"mesh telemetry: no snapshot from {missing}"
+        )
+    per_host, merged, stale = agg.merged_samples()
+    if stale:
+        result["violations"].append(
+            f"mesh telemetry: live host(s) marked stale: {sorted(stale)}"
+        )
+    # SUM semantics, asserted against the per-host scrapes: the wave
+    # counter exists on every host that ran the burst
+    probe = "fusion_mesh_trace_segments_total"
+    want = sum(per_host[h].get(probe, 0.0) for h in per_host if h not in stale)
+    got = merged.get(probe, 0.0)
+    sum_exact = got == want and want > 0
+    if not sum_exact:
+        result["violations"].append(
+            f"mesh-telemetry-sum-mismatch: merged {probe}={got}, "
+            f"per-host sum={want}"
+        )
+    text = agg.render_mesh_prometheus()
+    labels_ok = all(f'host="{h}"' in text for h in live_members)
+    if not labels_ok:
+        result["violations"].append(
+            "mesh telemetry: merged exposition missing a host= label"
+        )
+    result["mesh_telemetry"] = {
+        "hosts": agg.known_hosts(),
+        "stale": sorted(stale),
+        "sum_exact": sum_exact,
+        "merged_series": len(merged),
+        "exposition_lines": text.count("\n"),
+        "snapshot_series": len(payload.get("series") or ()),
+    }
+    # stitch the LAST round's wave: both hosts pinned the same cause
+    cause = f"mesh-wave/{phase}#r{end_round - 1}"
+    stitched = global_mesh_trace().stitch(cause, expected_hosts=list(live_members))
+    if stitched is None:
+        result["violations"].append(f"mesh telemetry: no trace for {cause}")
+        return
+    if stitched["partial"]:
+        result["violations"].append(
+            f"mesh telemetry: PARTIAL stitch, missing {stitched['missing_hosts']}"
+        )
+    if not stitched["levels"]:
+        result["violations"].append("mesh telemetry: stitched timeline has no levels")
+    # the FULL stitched timeline rides the worker result file (the
+    # tools/trace_dump.py input); the orchestrator compacts it for the
+    # bench-record-sized mesh section
+    result["trace"] = stitched
 
 
 def save_mesh_shards_local(graph, path: str, save_fn) -> None:
@@ -553,7 +641,16 @@ def run_elastic_worker() -> int:
     else:
         member_id = all_members[int(os.environ.get(ENV_PROCESS_ID, "0"))]
 
+    from stl_fusion_tpu.diagnostics.mesh_telemetry import (
+        MeshTelemetryAggregator,
+        MeshTelemetryPublisher,
+    )
+
     board = RendezvousBoard(os.path.join(mh_dir, "board"))
+    # fleet plane rides the SAME board that carries the election ladder:
+    # the telemetry channel must survive the degrade window (ISSUE 18)
+    telem_pub = MeshTelemetryPublisher(member=member_id, period_s=1.0)
+    telem_agg = MeshTelemetryAggregator(local_member=member_id, period_s=1.0)
     events = global_events()
     ops = JaxWorldOps(dph)
     src, dst = power_law_dag(n, avg_degree=3.0, seed=7)
@@ -648,6 +745,7 @@ def run_elastic_worker() -> int:
                 g, os.path.join(mh_dir, f"snap_{member_id}.npz"), save_mesh_shards
             )
             _put_file(os.path.join(mh_dir, f"progress_{member_id}"), str(committed))
+            telem_pub.publish_board(board)  # fleet snapshot rides each commit
 
         def _full_mask_check(upto: int, what: str) -> bool:
             want = _closure(upto)
@@ -836,6 +934,19 @@ def run_elastic_worker() -> int:
                 pending_detach = world.is_multiprocess
                 r = replay_from
                 recovery_target = replay_to
+                # the fleet plane's view of the kill: the victim's last
+                # snapshot stays visible but MUST be marked stale (evicted
+                # by membership), never silently merged (ISSUE 18)
+                telem_agg.sync_board(board)
+                for m in dead:
+                    telem_agg.mark_evicted(m)
+                telem_agg.note_members(ctl.members)
+                not_stale = set(dead) - telem_agg.stale_hosts()
+                if not_stale:
+                    result["violations"].append(
+                        f"mesh telemetry: dead host(s) {sorted(not_stale)} "
+                        f"not marked stale after degrade"
+                    )
                 result["recoveries"].append(
                     {
                         "dead": dead,
@@ -891,6 +1002,13 @@ def run_elastic_worker() -> int:
     stop_beats.set()
     if divergence:
         result["violations"].append(f"{divergence} chain stage(s) diverged")
+    try:
+        telem_agg.sync_board(board)
+        if ctl is not None:
+            telem_agg.note_members(ctl.members)
+        result["mesh_telemetry"] = telem_agg.summary()
+    except Exception as e:  # noqa: BLE001 — telemetry must not mask the arc
+        result["mesh_telemetry"] = {"error": repr(e)}
     result.update(
         rounds_committed=r,
         divergence=divergence,
@@ -1016,7 +1134,13 @@ def run_multihost(out: dict) -> None:
                 "stats": h0.get("stats"),
                 "resize": h0.get("resize"),
                 "dcn": h0.get("dcn") or {},
+                "mesh_telemetry": h0.get("mesh_telemetry"),
+                "trace": compact_trace(h0.get("trace")),
             }
+            if not (h0.get("trace") or {}).get("levels"):
+                out["violations"].append("scale: stitched wave timeline is empty")
+            if (h0.get("mesh_telemetry") or {}).get("stale"):
+                out["violations"].append("scale: live host marked stale in merge")
             dcn0 = h0.get("dcn") or {}
             if not dcn0.get("dcn_fallback_relays"):
                 out["violations"].append("DCN fallback not exercised cross-process")
@@ -1206,6 +1330,7 @@ def _elastic_leg(dph, root_dir, base_env, members, out, mh, _wait):
         "flap_rejoin_s": round(reb["ts"] - t_rejoin, 2) if reb else None,
         "divergence": [(res or {}).get("divergence") for res in results.values()],
         "events": h0.get("events"),
+        "mesh_telemetry": h0.get("mesh_telemetry"),
     }
 
 
@@ -1423,6 +1548,8 @@ def _geometry_leg(hosts, dph, root_dir, base_env, out, mh, _wait):
         "exchange_async": st.get("exchange_async"),
         "async_depth": st.get("async_depth"),
         "quiescence_checks": st.get("quiescence_checks"),
+        "trace_levels": len((h0.get("trace") or {}).get("levels") or ()),
+        "telemetry_hosts": (h0.get("mesh_telemetry") or {}).get("hosts"),
     }
 
 

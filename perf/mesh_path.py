@@ -89,6 +89,24 @@ def numpy_bfs_mask(src, dst, n, seeds):
     return inv
 
 
+def compact_trace(stitched) -> dict:
+    """A record-sized digest of one stitched wave timeline (ISSUE 18):
+    the per-level segments stay in the trace store / ``GET /trace`` — the
+    perf record carries the straggler table and the pacing verdict."""
+    if not stitched:
+        return None
+    return {
+        "cause": stitched["cause"],
+        "hosts": stitched["hosts"],
+        "partial": stitched["partial"],
+        "duration_ms": stitched["duration_ms"],
+        "segments": len(stitched["segments"]),
+        "levels": len(stitched["levels"]),
+        "straggler": stitched["straggler"][:4],
+        "paced_by": stitched["paced_by"],
+    }
+
+
 def run_static(mesh, out: dict) -> None:
     from stl_fusion_tpu.cluster import DevicePlacement, ShardMap
     from stl_fusion_tpu.graph.synthetic import power_law_dag
@@ -248,6 +266,20 @@ def run_async_ab(mesh, out: dict) -> None:
         )
     stall_ms = max(wall_sync - wall_async, 0.0) * 1e3
     record_level_stall_ms(stall_ms)
+    # the async burst's LAST wave, stitched: single-host here, but the
+    # derived per-level segments + straggler table must exist (the
+    # multihost leg stitches the same machinery across real processes)
+    from stl_fusion_tpu.diagnostics.mesh_telemetry import global_mesh_trace
+
+    stitched = (
+        global_mesh_trace().stitch(g_async.last_trace_cause)
+        if g_async.last_trace_cause
+        else None
+    )
+    if stitched is None or not stitched["levels"]:
+        out["violations"].append(
+            "async A/B recorded no stitched wave timeline (trace hooks dark)"
+        )
     out["async_ab"] = {
         "nodes": n,
         "waves": n_waves,
@@ -264,6 +296,7 @@ def run_async_ab(mesh, out: dict) -> None:
         "async_wall_s": round(wall_async, 3),
         "sync_inv_per_s": round(tot_sync / max(wall_sync, 1e-9), 1),
         "async_inv_per_s": round(tot_async / max(wall_async, 1e-9), 1),
+        "trace": compact_trace(stitched),
     }
 
 
@@ -462,6 +495,19 @@ async def run_live(mesh, out: dict) -> None:
                 "live async ran zero quiescence checks (uncounted fallback "
                 "to sync)"
             )
+        # stitch the most recent wave the superround threaded through the
+        # routed mirror — its cause id IS the wave's existing cause, so
+        # /trace?cause=<id> and explain() name the same timeline
+        from stl_fusion_tpu.diagnostics.mesh_telemetry import global_mesh_trace
+
+        live_cause = rg.last_trace_cause or global_mesh_trace().latest_cause()
+        live_trace = (
+            global_mesh_trace().stitch(live_cause) if live_cause else None
+        )
+        if live_trace is None:
+            out["violations"].append(
+                "live leg recorded no wave trace segments (stitch hooks dark)"
+            )
         out["live"] = {
             "nodes": ns,
             "members": n_members,
@@ -481,6 +527,7 @@ async def run_live(mesh, out: dict) -> None:
             "dcn_fallback_relays": fanout.dcn_fallback_relays,
             "async_depth": async_depth,
             "quiescence_checks": rg.quiescence_checks,
+            "trace": compact_trace(live_trace),
         }
         await server_rpc.stop()
         await client_rpc.stop()

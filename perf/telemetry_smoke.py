@@ -240,6 +240,81 @@ async def main() -> int:
         )
         pipe.dispose()
 
+        # -------- mesh-scope leg (ISSUE 18 CI gate): a second EMULATED
+        # host ships its registry snapshot over a REAL rpc/tcp socket
+        # (length-prefixed frames, actual loopback TCP), then
+        # /metrics?scope=mesh must answer ONE honest merge: parses as
+        # Prometheus text, both host= labels present, a known counter
+        # SUMs exactly, and the declared-MAX oplog lag stays MAX
+        from stl_fusion_tpu.diagnostics.mesh_telemetry import (
+            MeshTelemetryAggregator,
+            MeshTelemetryPublisher,
+            MeshTelemetryService,
+        )
+        from stl_fusion_tpu.diagnostics.metrics import MetricsRegistry
+        from stl_fusion_tpu.rpc.tcp import RpcTcpServer, tcp_client_connector
+
+        agg = MeshTelemetryAggregator(period_s=5.0)
+        gateway.mesh_telemetry = agg
+        server_rpc.add_service("mesh-telemetry", MeshTelemetryService(agg))
+        telem_server = await RpcTcpServer(server_rpc, ref_prefix="").start()
+        global_metrics().gauge(
+            "fusion_oplog_reader_lag",
+            help="rows behind the oplog tail (emulated for the mesh leg)",
+        ).set(4.0)
+        global_metrics().set_aggregation("fusion_oplog_reader_lag", "max")
+
+        # host h1: its own registry, its own hub, a real TCP dial
+        remote_reg = MetricsRegistry()
+        remote_reg.counter(
+            "fusion_waves_run_total", help="emulated h1 wave counter"
+        ).inc(7)
+        remote_reg.gauge(
+            "fusion_oplog_reader_lag", help="emulated h1 oplog lag"
+        ).set(9.0)
+        remote_reg.set_aggregation("fusion_oplog_reader_lag", "max")
+        remote_pub = MeshTelemetryPublisher(
+            member="h1", registry=remote_reg, period_s=5.0
+        )
+        peer_rpc = RpcHub("h1-telemetry")
+        peer_rpc.client_connector = tcp_client_connector(
+            "127.0.0.1", telem_server.port, client_id="h1"
+        )
+        reply = await remote_pub.publish_hub(peer_rpc)
+        assert reply.get("ok") and "h1" in reply.get("hosts", ()), reply
+
+        status, body = await http_get(
+            gateway.host, gateway.port, "/metrics?scope=mesh"
+        )
+        assert status.endswith("200 OK"), status
+        mesh_samples = parse_exposition(body.decode())
+        local_member = agg.local_member
+        waves_local = mesh_samples.get(
+            f'fusion_waves_run_total{{host="{local_member}"}}'
+        )
+        waves_remote = mesh_samples.get('fusion_waves_run_total{host="h1"}')
+        assert waves_local is not None and waves_remote == 7.0, (
+            "mesh exposition must carry BOTH host labels",
+            waves_local, waves_remote,
+        )
+        assert mesh_samples["fusion_waves_run_total"] == waves_local + 7.0, (
+            "merged counter must be the EXACT sum of the per-host scrapes",
+            mesh_samples["fusion_waves_run_total"], waves_local,
+        )
+        assert mesh_samples["fusion_oplog_reader_lag"] == 9.0, (
+            "declared-MAX gauge must merge as MAX across hosts, not SUM",
+            mesh_samples["fusion_oplog_reader_lag"],
+        )
+        assert mesh_samples.get('fusion_mesh_telemetry_stale{host="h1"}') == 0.0
+        assert mesh_samples.get("fusion_mesh_telemetry_hosts_reporting") == 2.0
+        note(
+            f"mesh scope: {len(mesh_samples)} merged samples over "
+            f"{agg.known_hosts()}; SUM + MAX semantics exact over a real "
+            f"TCP snapshot"
+        )
+        await peer_rpc.stop()
+        await telem_server.stop()
+
         print(json.dumps({
             "metric": "telemetry_smoke",
             "ok": True,
@@ -254,6 +329,8 @@ async def main() -> int:
             "recorder_events": report["recorder"]["events_recorded"],
             "fused_depth_p50": fused_p50,
             "fused_trace_entries": len(fused_recent),
+            "mesh_hosts": agg.known_hosts(),
+            "mesh_samples": len(mesh_samples),
         }))
         monitor.dispose()
         await gateway.stop()

@@ -170,6 +170,24 @@ class RendezvousBoard:
         except OSError:
             pass
 
+    # --------------------------------------------------------- telemetry
+    def put_telemetry(self, member: str, payload: dict) -> None:
+        """One member's latest MetricsRegistry snapshot (ISSUE 18): the
+        fleet-metrics channel that keeps working through a degrade window,
+        because file rendezvous needs no formed world. Atomic like every
+        board write — a scraper mid-merge never reads a torn snapshot."""
+        self._put(f"telemetry-{member}.json", payload)
+
+    def read_telemetry(self) -> Dict[str, dict]:
+        """member → latest snapshot payload, for the aggregating host."""
+        out: Dict[str, dict] = {}
+        for name in sorted(os.listdir(self.directory)):
+            if name.startswith("telemetry-") and name.endswith(".json"):
+                rec = self._get(name)
+                if rec is not None and rec.get("member"):
+                    out[rec["member"]] = rec
+        return out
+
     # ------------------------------------------------------------- calls
     def publish_call(
         self, epoch: int, members: Sequence[str], coordinator: str
@@ -470,6 +488,7 @@ class MeshController:
             self.world = world
             self.epoch = target
             self._epoch_gauge.set(self.epoch)
+            retired = [m for m in self.members if m not in survivors]
             self.members = survivors
             self.state = MeshController.SERVING
             self.reforms += 1
@@ -477,6 +496,14 @@ class MeshController:
             # construction (it described the PREVIOUS world)
             for m in survivors:
                 self.evidence.pop(m, None)
+            # retire the dropped members' clock samples with their
+            # membership: the per-peer fusion_clock_* series otherwise
+            # accumulate one labeled pair per ref across every re-form
+            # (ISSUE 18 satellite — the cardinality leak)
+            if retired:
+                from ..diagnostics.clocksync import global_clock_sync
+
+                global_clock_sync().prune(retired)
             self.events.record(
                 "mesh_reform_ok", f"epoch={self.epoch} members={len(survivors)}"
             )

@@ -18,6 +18,14 @@ from .explain import (
 )
 from .flight_recorder import RECORDER, FlightRecorder, global_recorder
 from .invariants import InvariantReport, InvariantViolation, validate_hub, validate_mirror
+from .mesh_telemetry import (
+    MeshTelemetryAggregator,
+    MeshTelemetryPublisher,
+    MeshTelemetryService,
+    MeshTraceStore,
+    WaveSegment,
+    global_mesh_trace,
+)
 from .metrics import (
     Counter,
     Gauge,
@@ -51,6 +59,12 @@ __all__ = [
     "explain_remote",
     "explain_with_fallback",
     "install_explain",
+    "MeshTelemetryAggregator",
+    "MeshTelemetryPublisher",
+    "MeshTelemetryService",
+    "MeshTraceStore",
+    "WaveSegment",
+    "global_mesh_trace",
     "InvariantReport",
     "InvariantViolation",
     "validate_hub",

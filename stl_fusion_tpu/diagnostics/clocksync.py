@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, Iterable, Optional, Tuple
 
 from .metrics import global_metrics
 
@@ -65,8 +65,24 @@ class ClockSync:
                 self._offsets[ref] = (offset, rtt)
 
     def forget(self, ref: str) -> None:
+        """Retire one peer's sample — the mesh controller calls this for
+        every member a re-form drops, so ``fusion_clock_offset_ms{peer=}``
+        / ``fusion_clock_rtt_ms{peer=}`` series stop accumulating across
+        re-forms and flaps (ISSUE 18 satellite: the per-peer label set was
+        append-only before this)."""
         with self._lock:
             self._offsets.pop(ref, None)
+
+    def prune(self, retired: "Iterable[str]") -> int:
+        """Batch retire: drop every listed peer's sample, returning how
+        many actually held one (a flap that re-joins re-probes fresh — the
+        series set stays bounded by LIVE membership, not history)."""
+        dropped = 0
+        with self._lock:
+            for ref in retired:
+                if self._offsets.pop(ref, None) is not None:
+                    dropped += 1
+        return dropped
 
     # ------------------------------------------------------------------ mapping
     def offset(self, ref: Optional[str]) -> Optional[float]:

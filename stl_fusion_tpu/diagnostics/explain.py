@@ -396,6 +396,27 @@ def explain(
                     f"(member {pl.member_of_device(dev)})"
                 )
         chain.append(line)
+        # ISSUE 18: the straggler, named. When the wave's cause has trace
+        # segments, the stitched timeline knows which (host, shard) paced
+        # the worst merge epoch — the line that turns "the wave was slow"
+        # into a rebalance target.
+        if cause is not None:
+            from .mesh_telemetry import global_mesh_trace
+
+            stitched = global_mesh_trace().stitch(cause)
+            if stitched is not None and stitched.get("paced_by"):
+                p = stitched["paced_by"]
+                line = (
+                    f"paced by host {p['host']} shard {p['shard']} at level "
+                    f"{p['level']} ({p['stall_ms']:.1f} ms stall across "
+                    f"{len(stitched['hosts'])} host(s)"
+                )
+                if stitched["partial"]:
+                    line += (
+                        f"; PARTIAL — no segments from "
+                        f"{','.join(stitched['missing_hosts'])}"
+                    )
+                chain.append(line + ")")
     if cause is not None:
         line = f"caused by {cause}"
         if host is not None:

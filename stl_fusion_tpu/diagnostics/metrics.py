@@ -347,6 +347,33 @@ class MetricsRegistry:
                 out[k] = v
         return out
 
+    def flat_samples(self) -> Dict[str, float]:
+        """One flat ``{series: value}`` map — the transport shape of a mesh
+        telemetry snapshot (ISSUE 18). Counters/gauges contribute their
+        value; histograms contribute ``_sum``/``_count`` (their buckets are
+        per-process detail the fleet merge has no honest semantics for);
+        collector samples ride as-is, registered metrics winning shadows."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if isinstance(m, Histogram):
+                out[f"{m.name}_sum"] = float(m.sum)
+                out[f"{m.name}_count"] = float(m.count)
+            else:
+                out[m.name] = float(m.value)
+        for k, v in self._collect().items():
+            if k not in out:
+                out[k] = float(v)
+        return out
+
+    def max_aggregated_names(self) -> List[str]:
+        """The declared-MAX series names — shipped with every mesh snapshot
+        so the cross-host merge applies the same non-additive contract the
+        in-process collector merge does."""
+        with self._lock:
+            return sorted(k for k, mode in self._agg.items() if mode == "max")
+
     def render_prometheus(self) -> str:
         """Prometheus text exposition (v0.0.4)."""
         lines: List[str] = []

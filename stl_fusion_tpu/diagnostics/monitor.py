@@ -58,6 +58,9 @@ class FusionMonitor:
         #: edge gateway nodes (attach_edge): per-node snapshots listed in
         #: report()["edge"] — sessions, upstream subs, eviction/delivery
         self._edge_nodes: list = []
+        #: mesh telemetry aggregator (attach_mesh_telemetry): fleet-scope
+        #: snapshot table + stitched wave timelines via mesh_report()
+        self._mesh_telemetry = None
         # the hot-cache fast path counts amortized on the registry (every
         # 16th hit — see core/service.py) instead of firing a hook per hit
         self._fast_hits0 = getattr(hub.registry, "fast_hits", 0)
@@ -207,6 +210,40 @@ class FusionMonitor:
         for node in nodes:
             self._edge_nodes.append(weakref.ref(node))
         return self
+
+    def attach_mesh_telemetry(self, aggregator) -> "FusionMonitor":
+        """Export the mesh telemetry plane (ISSUE 18) through
+        :meth:`mesh_report`: the aggregator's per-host snapshot table and
+        the stitched cross-host wave timelines. Weakly referenced, like
+        every other attachment."""
+        import weakref
+
+        self._mesh_telemetry = weakref.ref(aggregator)
+        return self
+
+    def mesh_report(self, cause=None) -> dict:
+        """The mesh-scope answer ``report()`` cannot give: fleet snapshot
+        freshness (per-host ages, stale/evicted marking) plus ONE stitched
+        wave timeline — for ``cause``, or the most recent traced wave.
+        Every field degrades explicitly: no aggregator attached reports
+        ``"telemetry": None``, an unknown cause reports ``"trace": None``
+        (with the cause it looked for) — never a silent empty dict."""
+        from .mesh_telemetry import global_mesh_trace
+
+        agg = self._mesh_telemetry() if self._mesh_telemetry is not None else None
+        store = global_mesh_trace()
+        looked_for = cause or store.latest_cause()
+        stitched = None
+        if looked_for is not None:
+            stitched = store.stitch(
+                looked_for,
+                expected_hosts=agg.known_hosts() if agg is not None else None,
+            )
+        return {
+            "telemetry": agg.summary() if agg is not None else None,
+            "cause": looked_for,
+            "trace": stitched,
+        }
 
     def _edge_report(self):
         nodes = [ref() for ref in self._edge_nodes]

@@ -147,6 +147,14 @@ def current_cause_id() -> Optional[str]:
     return span_cause_id(span) if span is not None else None
 
 
+def wave_shaped_cause(seq: int) -> str:
+    """A wave-shaped cause id (``<prefix>/wave#<seq>``) for wave work no
+    backend span began — the routed graph driven directly by a perf
+    worker still keys its mesh trace segments in the ONE cause-id format
+    (ISSUE 18), so stitch/explain join them like any backend wave."""
+    return f"{CAUSE_PREFIX}/wave#{seq}"
+
+
 def find_span_by_cause(cause: str) -> Optional[Span]:
     """Resolve a span-shaped cause id back to its recorded span (None for
     wave-shaped causes, foreign-process causes, or evicted spans)."""

@@ -215,6 +215,13 @@ class SuperRoundTicket:
         # live on the dense device state, so the columnar refresh folds
         # per SUPER-ROUND here (still one dispatch, zero per-round hops)
         prog.cleared_total += backend.refresh_block_on_device(prog.block)
+        # the fence drain is the one phase the host DOES time end-to-end:
+        # apply + refresh between harvest and profile (ISSUE 18)
+        from ..diagnostics.mesh_telemetry import global_mesh_trace
+
+        global_mesh_trace().record(
+            self.cause, "fence_drain", t_apply0, time.perf_counter()
+        )
         backend._profile_wave(
             "superround", sum(len(s) for s in self.staged.stages),
             self.cause, self.dispatched_at, t_apply0, total, self.seqs[0],
@@ -494,26 +501,35 @@ class SuperRoundProgram:
         return SuperRoundTicket(self, inner, staged, cause, seqs, t0)
 
     def _dispatch_routed(self, staged, cause, seqs, t0) -> SuperRoundTicket:
+        from ..diagnostics.mesh_telemetry import reset_dispatch_cause, set_dispatch_cause
+
         backend = self.backend
         # the routed invalid_version protocol ties harvest (which also
         # folds the per-super-round refresh) to the dense mirror — harvest
         # the previous super-round before dispatching the next; staging
         # still overlapped its flight window
         self._harvest_all()
+        # thread THIS wave's cause into the routed dispatch so the graph's
+        # host-boundary trace segments share it (ISSUE 18) — one identity
+        # per wave, never a second cause minted a layer down
+        token = set_dispatch_cause(cause)
         try:
-            pending = backend.dispatch_waves_routed_chain(
-                staged.stages, staged=staged.routed_staged
-            )
-        except Exception as e:
-            from ..cluster.placement import PlacementError
+            try:
+                pending = backend.dispatch_waves_routed_chain(
+                    staged.stages, staged=staged.routed_staged
+                )
+            except Exception as e:
+                from ..cluster.placement import PlacementError
 
-            if not isinstance(e, PlacementError):
-                raise
-            # staged against a placement that resharded: re-pack + retry
-            # once, counted — never dispatch stale row permutations
-            self.restages += 1
-            staged.routed_staged = None
-            pending = backend.dispatch_waves_routed_chain(staged.stages)
+                if not isinstance(e, PlacementError):
+                    raise
+                # staged against a placement that resharded: re-pack + retry
+                # once, counted — never dispatch stale row permutations
+                self.restages += 1
+                staged.routed_staged = None
+                pending = backend.dispatch_waves_routed_chain(staged.stages)
+        finally:
+            reset_dispatch_cause(token)
         return SuperRoundTicket(
             self, None, staged, cause, seqs, t0, routed_pending=pending
         )

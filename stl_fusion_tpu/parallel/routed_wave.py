@@ -70,6 +70,7 @@ rung — the last rung of the counted ladder
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -79,7 +80,9 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..cluster.placement import DevicePlacement, PlacementError
-from ..diagnostics.metrics import global_metrics
+from ..diagnostics.mesh_telemetry import current_dispatch_cause, global_mesh_trace
+from ..diagnostics.metrics import global_metrics, next_wave_seq
+from ..diagnostics.tracing import wave_shaped_cause
 from .mesh import GRAPH_AXIS, graph_mesh, shard_map_compat
 
 __all__ = ["RoutedShardedGraph", "build_routed_wave", "record_level_stall_ms"]
@@ -504,6 +507,15 @@ class RoutedShardedGraph:
         self.cross_host_words = 0  # cumulative words shipped across hosts
         self.cross_words_per_level = 0  # static per-exchange-level payload
         self._procs = jax.process_count()
+        #: mesh trace identity (ISSUE 18): segments recorded at the host
+        #: boundaries carry this host label; ``trace_cause`` lets a driver
+        #: pin a mesh-wide cause (every host running the same deterministic
+        #: schedule names the wave identically, so the stitch can join
+        #: their segments); the super-round threads the backend's cause via
+        #: the dispatch contextvar instead
+        self.trace_host = f"h{jax.process_index()}"
+        self.trace_cause: Optional[str] = None
+        self.last_trace_cause: Optional[str] = None
 
         # int32 host truth: node ids always fit (n_global is int32-bound),
         # and at 240M edges the int64 sorted copies alone were ~5 GB
@@ -1054,6 +1066,79 @@ class RoutedShardedGraph:
                 "~n_hosts x and ships capacity padding",
             ).inc(shipped)
 
+    # -------------------------------------------------------- trace hooks
+    #: derived per-level segments are capped per stage (coarsened by
+    #: grouping, window preserved) so a deep wave cannot flood the store
+    _TRACE_MAX_LEVELS = 64
+
+    def _trace_cause_for_dispatch(self) -> Optional[str]:
+        """The cause this dispatch's segments key under: the super-round's
+        wave cause (contextvar) > a driver-pinned mesh-wide cause > a
+        freshly minted wave-shaped cause. None when tracing is off."""
+        if not global_mesh_trace().enabled:
+            return None
+        cause = current_dispatch_cause() or self.trace_cause
+        if cause is None:
+            cause = wave_shaped_cause(next_wave_seq())
+        self.last_trace_cause = cause
+        return cause
+
+    def _pacing_shard(self, newly_node_ids) -> int:
+        """The shard that carried most of this window's newly-invalid
+        frontier — the per-host pacing attribution (the per-level split
+        inside the jit'd kernel is not host-visible; the dominant shard
+        of the harvested frontier is, and it is what a rebalance acts on)."""
+        if newly_node_ids is None or len(newly_node_ids) == 0:
+            return -1
+        ips = self.placement.ids_per_shard
+        counts = np.bincount(np.asarray(newly_node_ids, dtype=np.int64) // ips)
+        return int(counts.argmax())
+
+    def _trace_slice(self, store, cause, t0, t1, levels, spec, shard, level_base) -> int:
+        """Record one stage's host-visible window as per-level segments.
+
+        The wave kernel runs inside ONE jit dispatch — per-level host
+        timestamps do not exist — so the measured window is divided across
+        the counted levels (totals and ordering preserved; the derivation
+        is documented in OBSERVABILITY.md, never passed off as measured).
+        Async mode: the speculative share first (spec_expand), then one
+        quiescence_vote per merge epoch; hier sync: each level splits into
+        a2a (intra-host) + tree_round (cross-host); other sync modes: one
+        exchange/tree_round segment per level. Returns the next wave-wide
+        level index (chains keep level numbering cumulative)."""
+        window = max(t1 - t0, 0.0)
+        if levels <= 0:
+            store.record(cause, "spec_expand" if spec else "exchange",
+                         t0, t0 + window, host=self.trace_host, shard=shard)
+            return level_base
+        cursor = t0
+        if self.exchange_async and spec > 0:
+            cut = t0 + window * (spec / (spec + levels))
+            store.record(cause, "spec_expand", cursor, cut,
+                         host=self.trace_host, shard=shard)
+            cursor = cut
+        per = max(t1 - cursor, 0.0) / levels
+        step = max(1, -(-levels // self._TRACE_MAX_LEVELS))
+        for first in range(0, levels, step):
+            n = min(step, levels - first)
+            seg0 = cursor + first * per
+            seg1 = seg0 + n * per
+            lvl = level_base + first
+            if self.exchange_async:
+                store.record(cause, "quiescence_vote", seg0, seg1,
+                             host=self.trace_host, level=lvl, shard=shard)
+            elif self.exchange == "hier":
+                mid = (seg0 + seg1) / 2.0
+                store.record(cause, "a2a", seg0, mid,
+                             host=self.trace_host, level=lvl, shard=shard)
+                store.record(cause, "tree_round", mid, seg1,
+                             host=self.trace_host, level=lvl, shard=shard)
+            else:
+                phase = "tree_round" if self.exchange == "tree" else "exchange"
+                store.record(cause, phase, seg0, seg1,
+                             host=self.trace_host, level=lvl, shard=shard)
+        return level_base + levels
+
     def run_wave_collect(
         self, seed_node_ids: Sequence[int], cap: int = 65536
     ) -> Tuple[int, np.ndarray, bool]:
@@ -1076,6 +1161,8 @@ class RoutedShardedGraph:
         if fn is None:
             fn = self._build_collect(capd)
             self._collect_cache[(capd, width)] = fn
+        cause = self._trace_cause_for_dispatch()
+        t0 = time.perf_counter()
         self.g_invalid, counts, levels, spec, bufs = fn(
             self._host_arg(rows), self.g_send, self.g_hsend, self.g_eprod,
             self.g_ebslot, self.g_ebit, self.g_edst, self.g_elsrc, self.g_eep,
@@ -1089,12 +1176,21 @@ class RoutedShardedGraph:
         self.waves_run += 1
         self._count_exchange(int(levels), int(spec))
         count = int(counts.sum())
-        if (counts > capd).any():
+        overflow = bool((counts > capd).any())
+        node_ids: Optional[np.ndarray] = None
+        if not overflow:
+            ids = np.concatenate(
+                [bufs[d * capd : d * capd + int(counts[d])] for d in range(self.n_dev)]
+            )
+            node_ids = self.inv_perm[ids]
+        if cause is not None:
+            self._trace_slice(
+                global_mesh_trace(), cause, t0, time.perf_counter(),
+                int(levels), int(spec), self._pacing_shard(node_ids), 0,
+            )
+        if overflow:
             return count, np.empty(0, np.int64), True
-        ids = np.concatenate(
-            [bufs[d * capd : d * capd + int(counts[d])] for d in range(self.n_dev)]
-        )
-        return count, self.inv_perm[ids], False
+        return count, node_ids, False
 
     def _build_collect(self, capd: int):
         wave = self._wave
@@ -1176,6 +1272,8 @@ class RoutedShardedGraph:
         if fn is None:
             fn = self._build_chain(capd)
             self._chain_cache[(K, width, capd)] = fn
+        trace_cause = self._trace_cause_for_dispatch()
+        trace_t0 = time.perf_counter()
         self.g_invalid, counts, levels, spec, bufs = fn(
             self._host_arg(mat), self.g_send, self.g_hsend, self.g_eprod,
             self.g_ebslot, self.g_ebit, self.g_edst, self.g_elsrc, self.g_eep,
@@ -1186,7 +1284,8 @@ class RoutedShardedGraph:
         # the dispatch stays nonblocking on a single-process mesh
         self._sync(self.g_invalid, counts, levels, spec, bufs)
         return {"counts": counts, "levels": levels, "spec": spec, "bufs": bufs,
-                "stages": K, "capd": capd, "dispatches": 1}
+                "stages": K, "capd": capd, "dispatches": 1,
+                "trace_cause": trace_cause, "trace_t0": trace_t0}
 
     def _build_chain(self, capd: int):
         wave = self._wave
@@ -1252,7 +1351,29 @@ class RoutedShardedGraph:
                 "overflowed (recovered by one dense mask diff — counted, "
                 "never silent)",
             ).inc(sum(1 for i in stage_ids if i is None))
-        info = {"levels": levels.astype(np.int64), "overflowed": overflowed}
+        cause = pending.get("trace_cause")
+        store = global_mesh_trace()
+        if cause is not None and store.enabled:
+            # the chain's dispatch→harvest window, split across stages
+            # proportionally to their counted levels, then per-level within
+            # each stage (_trace_slice); level numbering runs cumulatively
+            # so the stitched timeline's merge epochs stay distinct
+            t1 = time.perf_counter()
+            t0 = float(pending.get("trace_t0", t1))
+            lv = levels.astype(np.int64).ravel()
+            sp = spec.astype(np.int64).ravel()
+            weights = np.maximum(lv + sp, 1).astype(np.float64)
+            edges = np.concatenate([[0.0], np.cumsum(weights)])
+            scale = max(t1 - t0, 0.0) / edges[-1] if edges[-1] else 0.0
+            level_base = 0
+            for i in range(pending["stages"]):
+                level_base = self._trace_slice(
+                    store, cause, t0 + edges[i] * scale, t0 + edges[i + 1] * scale,
+                    int(lv[i]), int(sp[i]), self._pacing_shard(stage_ids[i]),
+                    level_base,
+                )
+        info = {"levels": levels.astype(np.int64), "overflowed": overflowed,
+                "trace_cause": cause}
         return counts, stage_ids, info
 
     # ------------------------------------------------------------------ state

@@ -189,6 +189,13 @@ class FusionHttpServer:
         self.serve_observability: bool = True
         #: optional diagnostics.FusionMonitor whose report() /trace embeds
         self.monitor = None
+        #: optional diagnostics.MeshTelemetryAggregator (ISSUE 18): when
+        #: set, ``GET /metrics?scope=mesh`` answers the MERGED fleet
+        #: exposition (per-host ``host="h<N>"`` labels, SUM/MAX merge,
+        #: stale marking) instead of the process-local registry, and
+        #: ``GET /trace?cause=<id>`` marks missing hosts PARTIAL against
+        #: the aggregator's membership
+        self.mesh_telemetry = None
         #: cluster control-plane parts served by GET /shards (ISSUE 5):
         #: any mix of ClusterMember / ShardMapRouter / ClusterRebalancer
         #: (anything with ``snapshot()``), merged — same trust gate as the
@@ -258,12 +265,74 @@ class FusionHttpServer:
                 and self._is_trusted_proxy(headers)
             )
             if observability and path == "/metrics":
+                scope = urllib.parse.parse_qs(parsed_target.query).get(
+                    "scope", [None]
+                )[0]
+                if scope == "mesh":
+                    # fleet scrape (ISSUE 18): the merged exposition, or an
+                    # honest 503 — answering scope=mesh with LOCAL data
+                    # would silently misrepresent one host as the fleet
+                    if self.mesh_telemetry is None:
+                        await self._write_json(
+                            writer,
+                            "503 Service Unavailable",
+                            {
+                                "error": {
+                                    "type": "NoMeshTelemetry",
+                                    "message": (
+                                        "no MeshTelemetryAggregator attached "
+                                        "to this gateway"
+                                    ),
+                                }
+                            },
+                        )
+                        return
+                    raw = self.mesh_telemetry.render_mesh_prometheus().encode()
+                    writer.write(
+                        "HTTP/1.1 200 OK\r\n"
+                        "Content-Type: text/plain; version=0.0.4; charset=utf-8\r\n"
+                        f"Content-Length: {len(raw)}\r\nConnection: close\r\n\r\n".encode()
+                        + raw
+                    )
+                    await writer.drain()
+                    return
                 await write_metrics_response(writer)
                 return
             if observability and path == "/trace":
                 from ..diagnostics.tracing import recent_spans
 
                 query = urllib.parse.parse_qs(parsed_target.query)
+                cause = query.get("cause", [None])[0]
+                if cause:
+                    # stitched cross-host wave timeline (ISSUE 18) — one
+                    # clock-aligned view of one wave, keyed by its cause id
+                    from ..diagnostics.mesh_telemetry import global_mesh_trace
+
+                    expected = (
+                        self.mesh_telemetry.known_hosts()
+                        if self.mesh_telemetry is not None
+                        else None
+                    )
+                    stitched = global_mesh_trace().stitch(
+                        cause, expected_hosts=expected
+                    )
+                    if stitched is None:
+                        await self._write_json(
+                            writer,
+                            "404 Not Found",
+                            {
+                                "error": {
+                                    "type": "UnknownCause",
+                                    "message": (
+                                        f"no trace segments recorded for "
+                                        f"cause {cause!r}"
+                                    ),
+                                }
+                            },
+                        )
+                        return
+                    await self._write_json(writer, "200 OK", {"trace": stitched})
+                    return
                 section = query.get("section", [None])[0]
                 if section:
                     # payload bound (ISSUE 4 satellite): a scraper fetches

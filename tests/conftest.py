@@ -37,16 +37,22 @@ def _isolate_span_state():
     listeners + recorder gate around every test (ISSUE 3/4 satellites)."""
     from stl_fusion_tpu.diagnostics import tracing
     from stl_fusion_tpu.diagnostics.flight_recorder import RECORDER
+    from stl_fusion_tpu.diagnostics.mesh_telemetry import global_mesh_trace
 
+    trace_store = global_mesh_trace()
     tracing.clear_recent()
     RECORDER.clear()
+    trace_store.clear()
     listeners_before = list(tracing._listeners)
     recorder_enabled_before = RECORDER.enabled
+    trace_enabled_before = trace_store.enabled
     yield
     tracing._listeners[:] = listeners_before
     tracing.clear_recent()
     RECORDER.enabled = recorder_enabled_before
     RECORDER.clear()
+    trace_store.enabled = trace_enabled_before
+    trace_store.clear()
 
 
 def pytest_pyfunc_call(pyfuncitem):

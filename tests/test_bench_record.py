@@ -158,6 +158,22 @@ def test_compact_record_stays_under_tail_window():
                            "post_resize_oracle_exact": True},
                 "dcn": {"dcn_fallback_relays": 1, "mesh_member_relays": 0,
                         "client_observed_fence": True},
+                "mesh_telemetry": {"hosts": ["h0", "h1"], "stale": [],
+                                   "sum_exact": True, "merged_series": 10,
+                                   "exposition_lines": 29,
+                                   "snapshot_series": 3},
+                "trace": {"cause": "mesh-wave/scale#r2",
+                          "hosts": ["h0", "h1"], "partial": False,
+                          "duration_ms": 137.084, "segments": 36,
+                          "levels": 9,
+                          "straggler": [
+                              {"host": "h1", "shard": 13, "paced_levels": 3,
+                               "stall_ms_total": 9.567},
+                              {"host": "h1", "shard": 14, "paced_levels": 5,
+                               "stall_ms_total": 6.145},
+                          ],
+                          "paced_by": {"host": "h1", "shard": 13,
+                                       "level": 8, "stall_ms": 3.679}},
                 "xcheck": {"ok": True, "single_process_devices": 8},
             },
             "chaos": {
@@ -205,9 +221,11 @@ def test_compact_record_stays_under_tail_window():
     )
     # window raised 3700 → 4000 for the ISSUE 15 multihost fields, then
     # → 4300 for the ISSUE 17 async fields (levels_reclaimed /
-    # level_stall_ms / quiescence_checks / adaptive_stages) — still
-    # comfortably inside the driver's bounded stdout tail
-    assert len(line) < 4300, f"compact record grew to {len(line)} bytes"
+    # level_stall_ms / quiescence_checks / adaptive_stages), then
+    # → 4900 for the ISSUE 18 observability block (the fleet-telemetry
+    # merge verdict + the stitched-wave digest incl. its straggler
+    # table) — still comfortably inside the driver's bounded stdout tail
+    assert len(line) < 4900, f"compact record grew to {len(line)} bytes"
     d = json.loads(line)
     # the edge tier (ISSUE 8): the million-subscriber numbers make the capture
     assert d["edge"]["subs"] == 1_000_000 and d["edge"]["fenced_per_s"] == 412346
@@ -266,6 +284,16 @@ def test_compact_record_stays_under_tail_window():
     assert d["mesh"]["dcn_fallback_relays"] == 1
     assert d["mesh"]["host_kill_recovery_s"] == 2.53
     assert d["mesh"]["rejoin_oracle_exact"] is True
+    # the mesh observability block (ISSUE 18): the fleet merge verdict
+    # (zero stale hosts, exact SUM) and the stitched-wave digest with
+    # its straggler attribution ride the capture
+    assert d["mesh"]["mesh_telemetry"] == {
+        "hosts": ["h0", "h1"], "stale": [], "sum_exact": True,
+        "merged_series": 10,
+    }
+    assert d["mesh"]["mh_trace"]["levels"] == 9
+    assert d["mesh"]["mh_trace"]["paced_by"]["shard"] == 13
+    assert d["mesh"]["mh_trace"]["straggler"][0]["stall_ms_total"] == 9.567
     # the async A/B (ISSUE 17): barriers reclaimed + the counted
     # quiescence evidence + both modes' inv/s ride the capture
     assert d["mesh"]["async_depth"] == 4

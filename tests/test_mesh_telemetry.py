@@ -1,0 +1,288 @@
+"""Mesh-wide observability tests (ISSUE 18): the fleet-plane merge
+semantics (SUM / declared-MAX / per-host labels / staleness marking),
+cross-host wave trace stitching (deterministic, clock-skew-proof, PARTIAL
+counted never silent, straggler attribution), and the ClockSync per-peer
+label cardinality fix (a kill → re-form cycle must not grow the
+``fusion_clock_offset_ms{peer=}`` series set).
+"""
+import time
+
+import pytest
+
+from stl_fusion_tpu.diagnostics.clocksync import ClockSync, global_clock_sync
+from stl_fusion_tpu.diagnostics.mesh_telemetry import (
+    MeshTelemetryAggregator,
+    MeshTelemetryPublisher,
+    MeshTraceStore,
+    global_mesh_trace,
+)
+from stl_fusion_tpu.diagnostics.metrics import MetricsRegistry, global_metrics
+
+
+# ------------------------------------------------------------------ registry
+def test_flat_samples_and_max_names():
+    reg = MetricsRegistry()
+    reg.counter("t_c_total", help="x").inc(3)
+    reg.gauge("t_g", help="x").set(2.5)
+    h = reg.histogram("t_h_ms", help="x")
+    h.record(4.0)
+    h.record(6.0)
+    reg.set_aggregation("t_g", "max")
+    flat = reg.flat_samples()
+    assert flat["t_c_total"] == 3.0 and flat["t_g"] == 2.5
+    # histograms ship the summable moments only, never per-bucket series
+    assert flat["t_h_ms_sum"] == 10.0 and flat["t_h_ms_count"] == 2.0
+    assert not any(k.startswith("t_h_ms_bucket") for k in flat)
+    assert "t_g" in reg.max_aggregated_names()
+
+
+# ---------------------------------------------------------------- aggregation
+def _make_pair():
+    """Local h0 registry + a remote h1 payload, with one SUM counter and
+    one declared-MAX gauge on both sides."""
+    local = MetricsRegistry()
+    local.counter("fusion_waves_run_total", help="x").inc(5)
+    local.gauge("fusion_oplog_reader_lag", help="x").set(10.0)
+    local.set_aggregation("fusion_oplog_reader_lag", "max")
+    remote = MetricsRegistry()
+    remote.counter("fusion_waves_run_total", help="x").inc(7)
+    remote.gauge("fusion_oplog_reader_lag", help="x").set(4.0)
+    remote.set_aggregation("fusion_oplog_reader_lag", "max")
+    agg = MeshTelemetryAggregator(
+        local_member="h0", registry=local, period_s=5.0,
+        clock=ClockSync(), trace=MeshTraceStore(),
+    )
+    pub = MeshTelemetryPublisher(
+        member="h1", registry=remote, period_s=5.0, trace=MeshTraceStore()
+    )
+    return agg, pub
+
+
+def test_merge_sum_and_declared_max_with_host_labels():
+    agg, pub = _make_pair()
+    agg.ingest(pub.payload())
+    per_host, merged, stale = agg.merged_samples()
+    assert not stale
+    assert merged["fusion_waves_run_total"] == 12.0  # SUM, exact
+    assert merged["fusion_oplog_reader_lag"] == 10.0  # declared MAX, not 14
+    text = agg.render_mesh_prometheus()
+    assert 'fusion_waves_run_total{host="h0"} 5.0' in text
+    assert 'fusion_waves_run_total{host="h1"} 7.0' in text
+    assert "fusion_waves_run_total 12.0" in text
+    # one TYPE line per family, even with per-host labeled repeats
+    assert text.count("# TYPE fusion_waves_run_total gauge") == 1
+    assert 'fusion_mesh_telemetry_stale{host="h1"} 0.0' in text
+    assert "fusion_mesh_telemetry_hosts_reporting 2.0" in text
+
+
+def test_stale_by_age_excluded_from_merge_but_never_dropped():
+    agg, pub = _make_pair()
+    agg.ingest(pub.payload())
+    later = time.time() + 3 * agg.period_s  # > 2 reporting periods old
+    assert agg.stale_hosts(later) == {"h1"}
+    _, merged, stale = agg.merged_samples(later)
+    assert stale == {"h1"}
+    assert merged["fusion_waves_run_total"] == 5.0  # h1 excluded from merge
+    text = agg.render_mesh_prometheus(later)
+    # the last-known per-host series stay VISIBLE, flagged stale
+    assert 'fusion_waves_run_total{host="h1"} 7.0' in text
+    assert 'fusion_mesh_telemetry_stale{host="h1"} 1.0' in text
+
+
+def test_eviction_marks_stale_and_reingest_revives():
+    agg, pub = _make_pair()
+    agg.ingest(pub.payload())
+    agg.mark_evicted("h1")
+    assert "h1" in agg.stale_hosts()
+    # membership reconciliation: a snapshot-holder the mesh no longer
+    # names is evicted too
+    agg2, pub2 = _make_pair()
+    agg2.ingest(pub2.payload())
+    agg2.note_members(["h0"])
+    assert "h1" in agg2.stale_hosts()
+    # a flapped member that reports again is live again
+    agg.ingest(pub.payload())
+    assert "h1" not in agg.stale_hosts()
+
+
+def test_publisher_board_roundtrip(tmp_path):
+    from stl_fusion_tpu.cluster.mesh_controller import RendezvousBoard
+
+    board = RendezvousBoard(str(tmp_path / "board"))
+    agg, pub = _make_pair()
+    pub.publish_board(board)
+    assert agg.sync_board(board) == ["h1"]
+    assert agg.known_hosts() == ["h0", "h1"]
+    assert agg.merged_samples()[1]["fusion_waves_run_total"] == 12.0
+
+
+# ------------------------------------------------------------------ stitching
+def _seed_two_host(store, cause="w#1", h1_shift=0.0, slow_shard=37):
+    """3 merge epochs on two hosts; h1's ``slow_shard`` is deliberately
+    slowed at level 2 (20 ms vs h0's 4 ms)."""
+    base = 100.0
+    for lvl in range(3):
+        t0 = base + lvl * 0.010
+        store.record(cause, "a2a", t0, t0 + 0.004, host="h0", level=lvl, shard=3)
+        dur = 0.020 if lvl == 2 else 0.006
+        store.record(
+            cause, "tree_round", t0 + h1_shift, t0 + h1_shift + dur,
+            host="h1", level=lvl, shard=slow_shard,
+        )
+
+
+def test_stitch_two_host_deterministic():
+    clock = ClockSync()
+    stitched = []
+    for _ in range(2):
+        store = MeshTraceStore()
+        _seed_two_host(store)
+        stitched.append(store.stitch("w#1", clock=clock, local="h0"))
+    assert stitched[0] == stitched[1]  # seeded stitch is deterministic
+    st = stitched[0]
+    assert st["hosts"] == ["h0", "h1"] and not st["partial"]
+    assert len(st["levels"]) == 3
+    # level 2: h0 ends at +24ms, h1 at +40ms -> 16ms stall, h1/37 pacing
+    assert st["levels"][2]["stall_ms"] == pytest.approx(16.0, abs=1e-6)
+    assert st["paced_by"] == {
+        "host": "h1", "shard": 37, "level": 2,
+        "stall_ms": pytest.approx(16.0, abs=1e-6),
+    }
+
+
+def test_stitch_straggler_table_names_slowed_shard():
+    store = MeshTraceStore()
+    _seed_two_host(store, slow_shard=12)
+    st = store.stitch("w#1", clock=ClockSync(), local="h0")
+    top = st["straggler"][0]
+    assert (top["host"], top["shard"]) == ("h1", 12)
+    assert top["stall_ms_total"] > 0 and top["paced_levels"] >= 1
+
+
+def test_stitch_survives_clock_offset_skew():
+    ref_store = MeshTraceStore()
+    _seed_two_host(ref_store)
+    ref = ref_store.stitch("w#1", clock=ClockSync(), local="h0")
+
+    skew = 50.0  # h1's perf_counter runs 50s ahead of h0's
+    store = MeshTraceStore()
+    _seed_two_host(store, h1_shift=skew)
+    clock = ClockSync()
+    # one zero-RTT probe: offset = remote - midpoint = +50s exactly
+    clock.note_sample("h1", 200.0, 250.0, 200.0)
+    got = store.stitch("w#1", clock=clock, local="h0")
+
+    # segment timing and per-level attribution survive the skew bit-exact
+    # (a canonical sort absorbs sub-µs float-noise ties at equal starts)
+    def canon(segs):
+        return sorted(
+            segs, key=lambda s: (s["start_ms"], s["end_ms"], s["host"])
+        )
+
+    assert canon(got["segments"]) == canon(ref["segments"])
+    assert got["levels"] == ref["levels"]
+    assert got["paced_by"] == ref["paced_by"]
+    assert got["clock"]["h1"]["offset_ms"] == pytest.approx(50_000.0)
+    assert got["clock"]["h1"]["residual_ms"] == 0.0  # bounded by RTT/2 = 0
+    # WITHOUT the clock the same segments stitch garbage (h1 50s late) —
+    # the alignment is load-bearing, not decorative
+    raw = store.stitch("w#1", clock=ClockSync(), local="h0")
+    assert raw["duration_ms"] > 49_000
+
+
+def test_partial_stitch_counted_never_silent():
+    store = MeshTraceStore()
+    store.record("w#2", "exchange", 1.0, 2.0, host="h0", level=0, shard=1)
+    before = global_metrics().snapshot().get(
+        "fusion_mesh_trace_partial_stitches_total", 0
+    )
+    st = store.stitch(
+        "w#2", clock=ClockSync(), expected_hosts=["h0", "h2"], local="h0"
+    )
+    assert st["partial"] and st["missing_hosts"] == ["h2"]
+    after = global_metrics().snapshot()[
+        "fusion_mesh_trace_partial_stitches_total"
+    ]
+    assert after == before + 1
+    assert store.stitch("never-seen") is None
+
+
+def test_ingest_dedups_and_validates():
+    store = MeshTraceStore()
+    seg = {
+        "cause": "w#3", "host": "h1", "phase": "a2a",
+        "level": 0, "shard": 2, "t0": 1.0, "t1": 2.0,
+    }
+    assert store.ingest([seg, dict(seg), {"junk": 1}]) == 1
+    assert len(store.segments_for("w#3")) == 1
+
+
+def test_monitor_mesh_report_carries_stitch_and_summary():
+    from stl_fusion_tpu.core import FusionHub
+    from stl_fusion_tpu.diagnostics import FusionMonitor
+
+    store = global_mesh_trace()
+    store.record("w#9", "exchange", 1.0, 2.0, host="h0", level=0, shard=1)
+    agg = MeshTelemetryAggregator(
+        local_member="h0", registry=MetricsRegistry(),
+        clock=ClockSync(), trace=store,
+    )
+    mon = FusionMonitor(FusionHub()).attach_mesh_telemetry(agg)
+    rep = mon.mesh_report()
+    assert rep["cause"] == "w#9"
+    assert rep["trace"]["hosts"] == ["h0"]
+    assert rep["telemetry"]["local"] == "h0"
+    mon.dispose()
+
+
+# ------------------------------------------------- clocksync cardinality fix
+def test_clock_peer_series_pruned_on_reform(tmp_path):
+    """The ISSUE 18 satellite regression: probes accumulate per-peer
+    labeled series; a kill → re-form cycle (members retired, flap peer
+    re-probed) must leave the series set EXACTLY where it started —
+    before this fix every re-form leaked the dead epoch's peers forever."""
+    from stl_fusion_tpu.cluster.mesh_controller import (
+        MeshController,
+        RendezvousBoard,
+    )
+    from stl_fusion_tpu.resilience.events import ResilienceEvents
+
+    class _Ops:
+        def form(self, members, process_id, coordinator):
+            return {
+                "members": list(members), "process_id": process_id,
+                "coordinator": coordinator,
+            }
+
+        def detach(self):
+            return True
+
+        def teardown(self):
+            return None
+
+    cs = global_clock_sync()
+    peers = ["tz-h1", "tz-h2"]  # unique names: the sync is a process singleton
+    keys_before = set(cs._collect_metrics())
+    for i, p in enumerate(peers):
+        cs.note_sample(p, 0.0, 1.0 + i, 0.2)
+    keys_probed = set(cs._collect_metrics())
+    assert f'fusion_clock_offset_ms{{peer="{peers[0]}"}}' in keys_probed
+    assert len(keys_probed) == len(keys_before) + 2 * len(peers)
+
+    ctl = MeshController(
+        "tz-h0", ["tz-h0", *peers],
+        RendezvousBoard(str(tmp_path / "board")), _Ops(),
+        events=ResilienceEvents(),
+        clock=time.monotonic, wall_clock=time.time, sleep=lambda s: None,
+        pick_address=lambda: "127.0.0.1:7777",
+    )
+    ctl.epoch = 1
+    ctl.reform(["tz-h0"])  # both peers retired by the re-form
+    assert set(cs._collect_metrics()) == keys_before
+
+    # flap: the peer comes back, is probed, dies again — still no growth
+    cs.note_sample(peers[0], 0.0, 1.0, 0.2)
+    ctl.members = ["tz-h0", peers[0]]
+    ctl.epoch += 1
+    ctl.reform(["tz-h0"])
+    assert set(cs._collect_metrics()) == keys_before

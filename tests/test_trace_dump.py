@@ -1,0 +1,145 @@
+"""tools/trace_dump.py — golden-output test for the ASCII timeline (ISSUE 18).
+
+The renderer is a pure function of the stitched dict, so the golden can be
+pinned byte-for-byte on a hand-built stitch. A second test runs the real
+pipeline (MeshTraceStore.stitch -> render) to keep the two in sync.
+"""
+import io
+import json
+import sys
+
+import pytest
+
+from tools.trace_dump import find_trace, main, render
+
+from stl_fusion_tpu.diagnostics.mesh_telemetry import MeshTraceStore
+
+# A tiny two-host wave, already stitched: h0 runs a2a, h1 runs tree_round
+# and stalls 6 ms at level 1 on shard 37.
+STITCHED = {
+    "cause": "w#gold",
+    "hosts": ["h0", "h1"],
+    "partial": False,
+    "missing_hosts": [],
+    "duration_ms": 20.0,
+    "clock": {"h1": {"offset_ms": 2.5, "rtt_ms": 1.0, "residual_ms": 0.5}},
+    "segments": [
+        {"host": "h0", "phase": "a2a", "level": 0, "shard": 3,
+         "start_ms": 0.0, "end_ms": 4.0},
+        {"host": "h1", "phase": "tree_round", "level": 0, "shard": 9,
+         "start_ms": 0.0, "end_ms": 6.0},
+        {"host": "h0", "phase": "a2a", "level": 1, "shard": 3,
+         "start_ms": 6.0, "end_ms": 10.0},
+        {"host": "h1", "phase": "tree_round", "level": 1, "shard": 37,
+         "start_ms": 6.0, "end_ms": 16.0},
+        {"host": "h0", "phase": "fence_drain", "level": 2, "shard": 0,
+         "start_ms": 16.0, "end_ms": 20.0},
+    ],
+    "levels": [
+        {"level": 0, "start_ms": 0.0, "end_ms": 6.0, "stall_ms": 2.0,
+         "hosts": ["h0", "h1"], "paced_by": {"host": "h1", "shard": 9}},
+        {"level": 1, "start_ms": 6.0, "end_ms": 16.0, "stall_ms": 6.0,
+         "hosts": ["h0", "h1"], "paced_by": {"host": "h1", "shard": 37}},
+        {"level": 2, "start_ms": 16.0, "end_ms": 20.0, "stall_ms": 0.0,
+         "hosts": ["h0"], "paced_by": {"host": "h0", "shard": 0}},
+    ],
+    "straggler": [
+        {"host": "h1", "shard": 37, "paced_levels": 1, "stall_ms_total": 6.0},
+        {"host": "h1", "shard": 9, "paced_levels": 1, "stall_ms_total": 2.0},
+    ],
+    "paced_by": {"host": "h1", "shard": 37, "level": 1, "stall_ms": 6.0},
+}
+
+GOLDEN = """\
+== wave w#gold ==
+hosts   : h0, h1 (complete)
+duration: 20.000 ms, 5 segment(s), 3 level(s)
+paced by: host h1 shard 37 at level 1 (6.000 ms stall)
+clock   : h1 offset +2.500 ms, rtt 1.000 ms, residual <= 0.500 ms
+
+timeline (each column = 0.500 ms)
+  h0  |AAAAAAAAA...AAAAAAAAA..........FFFFFFFFF|
+  h1  |TTTTTTTTTTTTTTTTTTTTTTTTTTTTTTTT........|
+  lvl             |                  |       |
+  key: S=spec_expand A=a2a X=exchange T=tree_round Q=quiescence_vote F=fence_drain (.=idle)
+
+levels
+  lvl     start_ms       end_ms     stall_ms  paced_by
+    0        0.000        6.000        2.000  h1/9 #######
+    1        6.000       16.000        6.000  h1/37 ####################
+    2       16.000       20.000        0.000  h0/0
+
+stragglers (who paced the merge epochs)
+  host  shard  paced_levels  stall_ms_total
+  h1       37             1           6.000 ####################
+  h1        9             1           2.000 #######
+"""
+
+
+def test_render_golden():
+    assert render(STITCHED, width=40) == GOLDEN
+
+
+def test_render_compact_digest_summary_only():
+    digest = {
+        "cause": "w#c", "hosts": ["h0", "h1"], "partial": True,
+        "missing_hosts": ["h1"], "duration_ms": 12.5,
+        "segments": 36, "levels": 9,
+        "straggler": [
+            {"host": "h1", "shard": 13, "paced_levels": 3,
+             "stall_ms_total": 9.567},
+        ],
+        "paced_by": {"host": "h1", "shard": 13, "level": 8, "stall_ms": 3.7},
+    }
+    text = render(digest)
+    assert "PARTIAL, missing h1" in text
+    assert "36 segment(s), 9 level(s)" in text
+    assert "timeline" not in text  # no per-segment lanes in digest mode
+    assert "h1       13             3           9.567" in text
+
+
+def test_render_matches_real_stitch():
+    store = MeshTraceStore()
+    for host, phase, shard, t0, t1 in [
+        ("h0", "a2a", 3, 100.0, 100.004),
+        ("h1", "tree_round", 9, 100.0, 100.006),
+        ("h0", "a2a", 3, 100.006, 100.010),
+        ("h1", "tree_round", 37, 100.006, 100.016),
+    ]:
+        for lvl, seg in enumerate([(t0, t1)]):
+            store.record(cause="w#live", host=host, phase=phase,
+                         level=0 if t0 == 100.0 else 1, shard=shard,
+                         t0=seg[0], t1=seg[1])
+    stitched = store.stitch("w#live")
+    text = render(stitched, width=48)
+    assert "== wave w#live ==" in text
+    assert "paced by: host h1 shard 37 at level 1" in text
+    assert "  h0  |" in text and "  h1  |" in text
+
+
+@pytest.mark.parametrize("wrap", [
+    lambda t: t,                                   # bare stitched dict
+    lambda t: {"trace": t},                        # /trace response
+    lambda t: {"violations": [], "trace": t},      # worker result file
+    lambda t: {"multihost": {"scale": {"trace": t}}},  # bench/perf record
+])
+def test_find_trace_all_shapes(wrap):
+    assert find_trace(wrap(STITCHED)) is STITCHED
+
+
+def test_main_reads_file_and_stdin(tmp_path, monkeypatch, capsys):
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps({"trace": STITCHED}))
+    assert main([str(p), "--width", "40"]) == 0
+    assert capsys.readouterr().out == GOLDEN
+
+    monkeypatch.setattr(sys, "stdin", io.StringIO(json.dumps(STITCHED)))
+    assert main(["--width", "40"]) == 0
+    assert capsys.readouterr().out == GOLDEN
+
+
+def test_main_rejects_traceless_input(tmp_path, capsys):
+    p = tmp_path / "empty.json"
+    p.write_text("{}")
+    assert main([str(p)]) == 1
+    assert "no stitched trace" in capsys.readouterr().err

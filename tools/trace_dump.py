@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Pretty-print a stitched mesh wave timeline (ISSUE 18) — stdlib only.
+
+Input (file arg or stdin) is any JSON that carries a stitched trace:
+
+* a ``GET /trace?cause=<id>`` response (``{"trace": {...}}``),
+* a stitched dict straight from ``MeshTraceStore.stitch()``,
+* a recorded perf result (``perf/mesh_multihost.py`` worker files carry
+  the full stitch under ``"trace"``; orchestrator/bench records carry the
+  compact digest, which renders summary + straggler table only).
+
+Output: per-host lanes on one shared millisecond axis (phase-letter
+fill), level-fence markers, a per-level table with ASCII stall bars, and
+the straggler attribution table — the ``explain()`` "paced by host h1
+shard 37 at level 12" line, drawn.
+
+Usage::
+
+    python -m tools.trace_dump result_scale_h0.json
+    curl -s "$GW/trace?cause=$CAUSE" | python -m tools.trace_dump
+    python -m tools.trace_dump --width 100 record.json
+"""
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+#: one deterministic letter per recorded phase (unknown phases render '*')
+PHASE_LETTERS = {
+    "spec_expand": "S",
+    "a2a": "A",
+    "exchange": "X",
+    "tree_round": "T",
+    "quiescence_vote": "Q",
+    "fence_drain": "F",
+}
+
+
+def find_trace(doc) -> Optional[dict]:
+    """Walk any of the accepted JSON shapes down to the stitched dict."""
+    if not isinstance(doc, dict):
+        return None
+    if "segments" in doc and "hosts" in doc:
+        return doc
+    for key in ("trace",):
+        if isinstance(doc.get(key), dict):
+            return find_trace(doc[key]) or doc[key]
+    # perf records: multihost.scale.trace / async_ab.trace / live.trace
+    for key in ("multihost", "mesh", "scale", "async_ab", "live"):
+        sub = doc.get(key)
+        if isinstance(sub, dict):
+            found = find_trace(sub)
+            if found is not None:
+                return found
+    return None
+
+
+def _bar(value: float, peak: float, width: int = 20) -> str:
+    if peak <= 0 or value <= 0:
+        return ""
+    return "#" * max(1, round(value / peak * width))
+
+
+def render(trace: dict, width: int = 72) -> str:
+    """One deterministic ASCII panel for one stitched wave (pure function
+    of the stitched dict — the golden test pins this byte-for-byte)."""
+    out: List[str] = []
+    cause = trace.get("cause", "?")
+    hosts = trace.get("hosts") or []
+    dur = float(trace.get("duration_ms") or 0.0)
+    levels = trace.get("levels") or []
+    segments = trace.get("segments")
+    full = isinstance(segments, list)
+    n_segs = len(segments) if full else trace.get("segments", 0)
+    state = "PARTIAL, missing %s" % ",".join(trace.get("missing_hosts") or []) \
+        if trace.get("partial") else "complete"
+    out.append(f"== wave {cause} ==")
+    out.append(f"hosts   : {', '.join(hosts)} ({state})")
+    n_levels = len(levels) if isinstance(levels, list) else levels
+    out.append(
+        f"duration: {dur:.3f} ms, {n_segs} segment(s), {n_levels} level(s)"
+    )
+    paced = trace.get("paced_by")
+    if paced:
+        out.append(
+            f"paced by: host {paced['host']} shard {paced['shard']} at "
+            f"level {paced['level']} ({paced['stall_ms']:.3f} ms stall)"
+        )
+    clock = trace.get("clock") or {}
+    for h in sorted(clock):
+        c = clock[h]
+        if c.get("offset_ms") is not None:
+            out.append(
+                f"clock   : {h} offset {c['offset_ms']:+.3f} ms, "
+                f"rtt {c['rtt_ms']:.3f} ms, residual <= {c['residual_ms']:.3f} ms"
+            )
+
+    if full and segments and dur > 0:
+        span = width - 1
+
+        def col(ms: float) -> int:
+            return min(span, max(0, round(ms / dur * span)))
+
+        out.append("")
+        out.append(f"timeline (each column = {dur / width:.3f} ms)")
+        for h in hosts:
+            lane = ["."] * width
+            for s in segments:
+                if s["host"] != h:
+                    continue
+                letter = PHASE_LETTERS.get(s["phase"], "*")
+                for c in range(col(s["start_ms"]), col(s["end_ms"]) + 1):
+                    lane[c] = letter
+            out.append(f"  {h:<4}|{''.join(lane)}|")
+        # level fences: a '|' at each merge epoch's end column
+        if isinstance(levels, list) and levels:
+            fence = [" "] * width
+            for entry in levels:
+                fence[col(entry["end_ms"])] = "|"
+            out.append(f"  lvl {''.join(fence)} ")
+        key = " ".join(f"{v}={k}" for k, v in PHASE_LETTERS.items())
+        out.append(f"  key: {key} (.=idle)")
+
+    if isinstance(levels, list) and levels:
+        peak = max(e["stall_ms"] for e in levels)
+        out.append("")
+        out.append("levels")
+        out.append("  lvl     start_ms       end_ms     stall_ms  paced_by")
+        for e in levels:
+            pb = e["paced_by"]
+            out.append(
+                f"  {e['level']:>3} {e['start_ms']:>12.3f} {e['end_ms']:>12.3f} "
+                f"{e['stall_ms']:>12.3f}  {pb['host']}/{pb['shard']} "
+                f"{_bar(e['stall_ms'], peak)}"
+            )
+
+    rows = trace.get("straggler") or []
+    if rows:
+        peak = max(r["stall_ms_total"] for r in rows)
+        out.append("")
+        out.append("stragglers (who paced the merge epochs)")
+        out.append("  host  shard  paced_levels  stall_ms_total")
+        for r in rows:
+            out.append(
+                f"  {r['host']:<5} {r['shard']:>5} {r['paced_levels']:>13} "
+                f"{r['stall_ms_total']:>15.3f} {_bar(r['stall_ms_total'], peak)}"
+            )
+    return "\n".join(line.rstrip() for line in out) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="pretty-print a stitched mesh wave timeline"
+    )
+    ap.add_argument("path", nargs="?", help="JSON file (default: stdin)")
+    ap.add_argument("--width", type=int, default=72, help="lane width in columns")
+    args = ap.parse_args(argv)
+    try:
+        if args.path:
+            with open(args.path) as f:
+                doc = json.load(f)
+        else:
+            doc = json.load(sys.stdin)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"trace_dump: cannot read input: {e}", file=sys.stderr)
+        return 2
+    trace = find_trace(doc)
+    if trace is None:
+        print("trace_dump: no stitched trace in input", file=sys.stderr)
+        return 1
+    sys.stdout.write(render(trace, width=max(args.width, 24)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
