@@ -1052,6 +1052,14 @@ def _compact_result(
                     "sum_exact": telem.get("sum_exact"),
                     "merged_series": telem.get("merged_series"),
                 }
+            # ISSUE 19: the mesh-scope health verdict (worst-wins over
+            # every host's burn-rate state machine) and the merged top
+            # key per attribution domain — the record answers both "was
+            # the fleet healthy" and "who was the workload" per release
+            if scale.get("health"):
+                out["mesh"]["health"] = scale["health"]
+            if scale.get("hotkeys"):
+                out["mesh"]["hotkeys"] = scale["hotkeys"]
             if scale.get("trace"):
                 out["mesh"]["mh_trace"] = scale["trace"]
     if traffic is not None and "error" in traffic:

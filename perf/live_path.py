@@ -994,7 +994,9 @@ async def main() -> None:
             fixed_ms = _union_ms(gdev.FUSED_PASS_MAX)
             adaptive_ms = _union_ms(0)
             async_stall_ms = max(fixed_ms - adaptive_ms, 0.0)
-            record_level_stall_ms(async_stall_ms)
+            record_level_stall_ms(
+                async_stall_ms, cause=getattr(gdev, "last_cause_id", None)
+            )
             gdev.clear_invalid()
             note(
                 f"fixed({gdev.FUSED_PASS_MAX})={fixed_ms:.2f}ms "

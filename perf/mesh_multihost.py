@@ -522,6 +522,36 @@ def _telemetry_leg(ctx, mh_dir: str, phase: str, live_members, end_round: int,
         "exposition_lines": text.count("\n"),
         "snapshot_series": len(payload.get("series") or ()),
     }
+    # mesh-scope health verdict (ISSUE 19 CI gate): every live host's
+    # shipped verdict folds worst-wins; the run fails if the fleet is
+    # anything but ok or any merge leaned on a stale snapshot
+    health = agg.mesh_health()
+    if health["verdict"] != "ok":
+        result["violations"].append(
+            f"mesh health: {health['verdict']} "
+            f"(triggered by {health.get('triggered_by')} "
+            f"on {health.get('triggered_host')})"
+        )
+    if health["stale"]:
+        result["violations"].append(
+            f"mesh health: verdict merged over stale host(s) {health['stale']}"
+        )
+    result["health"] = {
+        "verdict": health["verdict"],
+        "hosts": {m: e["verdict"] for m, e in health["hosts"].items()},
+        "stale": health["stale"],
+    }
+    # workload attribution digest (ISSUE 19): the mesh-merged top key per
+    # domain — compact (one entry per domain), diffable release over release
+    hot = agg.hotkeys_report(n=1)
+    result["hotkeys"] = {
+        d: {
+            "total": body["total"],
+            "top_key": body["top"][0]["key"] if body["top"] else None,
+            "top_share": body["top"][0]["share"] if body["top"] else None,
+        }
+        for d, body in (hot.get("domains") or {}).items()
+    }
     # stitch the LAST round's wave: both hosts pinned the same cause
     cause = f"mesh-wave/{phase}#r{end_round - 1}"
     stitched = global_mesh_trace().stitch(cause, expected_hosts=list(live_members))
@@ -1135,12 +1165,18 @@ def run_multihost(out: dict) -> None:
                 "resize": h0.get("resize"),
                 "dcn": h0.get("dcn") or {},
                 "mesh_telemetry": h0.get("mesh_telemetry"),
+                "health": h0.get("health"),
+                "hotkeys": h0.get("hotkeys"),
                 "trace": compact_trace(h0.get("trace")),
             }
             if not (h0.get("trace") or {}).get("levels"):
                 out["violations"].append("scale: stitched wave timeline is empty")
             if (h0.get("mesh_telemetry") or {}).get("stale"):
                 out["violations"].append("scale: live host marked stale in merge")
+            if (h0.get("health") or {}).get("verdict") != "ok":
+                out["violations"].append(
+                    f"scale: mesh health verdict {(h0.get('health') or {}).get('verdict')!r}"
+                )
             dcn0 = h0.get("dcn") or {}
             if not dcn0.get("dcn_fallback_relays"):
                 out["violations"].append("DCN fallback not exercised cross-process")

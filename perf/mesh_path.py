@@ -265,7 +265,9 @@ def run_async_ab(mesh, out: dict) -> None:
             f"(sync {lv_sync} vs async {lv_async} merge epochs)"
         )
     stall_ms = max(wall_sync - wall_async, 0.0) * 1e3
-    record_level_stall_ms(stall_ms)
+    # the cause rides into the reclaim histogram's exemplar ring: the
+    # stall number links to the async leg's last stitched wave (ISSUE 19)
+    record_level_stall_ms(stall_ms, cause=g_async.last_trace_cause)
     # the async burst's LAST wave, stitched: single-host here, but the
     # derived per-level segments + straggler table must exist (the
     # multihost leg stitches the same machinery across real processes)

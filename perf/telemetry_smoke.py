@@ -82,6 +82,17 @@ async def main() -> int:
     n_clients = int(os.environ.get("TELEMETRY_CLIENTS", 4))
     keys_per_client = int(os.environ.get("TELEMETRY_KEYS", 4))
 
+    # SLO burn windows compressed to smoke scale (ISSUE 19): the health
+    # leg must see ok -> burning -> warn -> ok inside seconds, not the
+    # production minutes. Must land BEFORE the first /health evaluation
+    # mints the global SloEngine (windows are read at construction).
+    os.environ.setdefault("FUSION_SLO_FAST_S", "0.8")
+    os.environ.setdefault("FUSION_SLO_SLOW_S", "3.2")
+    os.environ.setdefault("FUSION_SLO_HOLD_S", "0.6")
+    # CPU CI boxes are not latency SLO subjects — park the p99 budget out
+    # of the way so this leg exercises the shed SLO, not scheduler noise
+    os.environ.setdefault("FUSION_SLO_DELIVERY_P99_MS", "60000")
+
     hub = FusionHub()
     old = set_default_hub(hub)
     try:
@@ -240,6 +251,94 @@ async def main() -> int:
         )
         pipe.dispose()
 
+        # -------- health-plane leg (ISSUE 19 CI gate): /health answers a
+        # machine-readable verdict; an induced anonymous-lane shed storm
+        # must flip the edge_shed_rate SLO to BURNING with the shedding
+        # tenant named in the attribution block, and clearing the storm
+        # must walk it back through warn (hysteresis) to ok — the full
+        # burn-rate arc over plain HTTP, in seconds
+        from stl_fusion_tpu.edge.admission import AdmissionController
+
+        status, body = await http_get(gateway.host, gateway.port, "/health")
+        assert status.endswith("200 OK"), status
+        health = json.loads(body)
+        assert health["verdict"] == "ok", health
+        assert health["scope"] == "local", health
+        slo_names = {s["name"] for s in health["slos"]}
+        assert {"delivery_e2e_p99", "superround_eager_rounds",
+                "invariant_violations", "edge_shed_rate"} <= slo_names, slo_names
+
+        adm = AdmissionController(shed_pressure=0.5, name="smoke-edge")
+        adm.set_pressure("smoke_storm", 1.0)
+        states_seen = []
+        burning_health = None
+        deadline = asyncio.get_event_loop().time() + 20.0
+        while asyncio.get_event_loop().time() < deadline:
+            for _ in range(64):  # the storm: anonymous cold attaches shed
+                adm.admit()
+            status, body = await http_get(gateway.host, gateway.port, "/health")
+            assert status.endswith("200 OK"), status
+            health = json.loads(body)
+            shed_slo = next(
+                s for s in health["slos"] if s["name"] == "edge_shed_rate"
+            )
+            states_seen.append(shed_slo["state"])
+            if shed_slo["state"] == "burning":
+                burning_health = health
+                break
+            await asyncio.sleep(0.12)
+        assert burning_health is not None, (
+            "shed storm never drove edge_shed_rate to burning", states_seen,
+        )
+        assert burning_health["verdict"] == "burning"
+        assert burning_health["triggered_by"] == "edge_shed_rate"
+        burn_slo = next(
+            s for s in burning_health["slos"] if s["name"] == "edge_shed_rate"
+        )
+        assert burn_slo["burn"]["fast"]["samples"] >= 2, burn_slo["burn"]
+        attr = burn_slo.get("attribution")
+        assert attr and attr["domain"] == "tenant_sheds", burn_slo
+        assert any(e["key"] == "(default)" for e in attr["top"]), attr
+        note(
+            f"shed storm: edge_shed_rate burning after {len(states_seen)} "
+            f"polls, attribution names {attr['top'][0]['key']!r}"
+        )
+
+        # /hotkeys names the shedding tenant too (the attribution plane
+        # has its own endpoint, not just a ride-along in /health)
+        status, body = await http_get(
+            gateway.host, gateway.port, "/hotkeys?domain=tenant_sheds"
+        )
+        assert status.endswith("200 OK"), status
+        hot = json.loads(body)
+        sheds_top = hot["domains"]["tenant_sheds"]["top"]
+        assert any(e["key"] == "(default)" for e in sheds_top), hot
+
+        # storm over: the verdict must RECOVER, and must pass through
+        # warn on the way down (hysteresis hold-down + slow window) —
+        # a health plane that snaps burning->ok would flap the pager
+        adm.clear_pressure("smoke_storm")
+        deadline = asyncio.get_event_loop().time() + 20.0
+        while asyncio.get_event_loop().time() < deadline:
+            status, body = await http_get(gateway.host, gateway.port, "/health")
+            health = json.loads(body)
+            shed_slo = next(
+                s for s in health["slos"] if s["name"] == "edge_shed_rate"
+            )
+            states_seen.append(shed_slo["state"])
+            if shed_slo["state"] == "ok":
+                break
+            await asyncio.sleep(0.12)
+        assert states_seen[-1] == "ok", (
+            "edge_shed_rate never recovered to ok", states_seen,
+        )
+        last_burn = len(states_seen) - 1 - states_seen[::-1].index("burning")
+        assert "warn" in states_seen[last_burn + 1:], (
+            "recovery skipped the warn hold-down (hysteresis)", states_seen,
+        )
+        assert health["verdict"] == "ok", health
+        note(f"health arc: {'>'.join(dict.fromkeys(states_seen))} (hysteresis held)")
+
         # -------- mesh-scope leg (ISSUE 18 CI gate): a second EMULATED
         # host ships its registry snapshot over a REAL rpc/tcp socket
         # (length-prefixed frames, actual loopback TCP), then
@@ -312,6 +411,17 @@ async def main() -> int:
             f"{agg.known_hosts()}; SUM + MAX semantics exact over a real "
             f"TCP snapshot"
         )
+
+        # with the aggregator attached, /health widens to MESH scope: the
+        # remote's shipped verdict folds in worst-wins, zero stale hosts
+        status, body = await http_get(gateway.host, gateway.port, "/health")
+        assert status.endswith("200 OK"), status
+        mesh_health = json.loads(body)
+        assert mesh_health["scope"] == "mesh", mesh_health
+        assert mesh_health["verdict"] == "ok", mesh_health
+        assert "h1" in mesh_health["hosts"], mesh_health["hosts"]
+        assert mesh_health["hosts"]["h1"]["verdict"] == "ok", mesh_health
+        assert mesh_health["stale"] == [], mesh_health
         await peer_rpc.stop()
         await telem_server.stop()
 
@@ -331,6 +441,9 @@ async def main() -> int:
             "fused_trace_entries": len(fused_recent),
             "mesh_hosts": agg.known_hosts(),
             "mesh_samples": len(mesh_samples),
+            "health_arc": list(dict.fromkeys(states_seen)),
+            "mesh_health": mesh_health["verdict"],
+            "shed_attribution": attr["top"][0]["key"],
         }))
         monitor.dispose()
         await gateway.stop()

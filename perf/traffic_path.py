@@ -137,13 +137,21 @@ async def settle(seconds: float = 0.05) -> None:
 class SloGate:
     """The per-tenant SLO gate table: every check is RECORDED (pass or
     fail) so the JSON line shows the whole table, and enforce() fails the
-    run — nonzero exit, CI-gate semantics — if any check failed."""
+    run — nonzero exit, CI-gate semantics — if any check failed.
+
+    Pass/fail is delegated to ``SloSpec.violated`` — the same comparator
+    the ``/health`` burn-rate engine uses — so a CI gate and a live
+    health verdict can never disagree about what "violated" means."""
 
     def __init__(self):
         self.checks = []
 
     def check(self, name: str, value, ceiling, unit: str = "ms") -> None:
-        ok = value is not None and value <= ceiling
+        from stl_fusion_tpu.diagnostics.slo import SloSpec
+
+        spec = SloSpec(name=name, threshold=float(ceiling), comparator="le",
+                       unit=unit)
+        ok = not spec.violated(value)
         self.checks.append(
             {"name": name, "value": value, "ceiling": ceiling,
              "unit": unit, "ok": ok}
@@ -152,7 +160,10 @@ class SloGate:
              f"(ceiling {ceiling})")
 
     def check_eq(self, name: str, value, want) -> None:
-        ok = value == want
+        from stl_fusion_tpu.diagnostics.slo import SloSpec
+
+        spec = SloSpec(name=name, threshold=want, comparator="eq")
+        ok = not spec.violated(value)
         self.checks.append(
             {"name": name, "value": value, "ceiling": want, "unit": "eq",
              "ok": ok}

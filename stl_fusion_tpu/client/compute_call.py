@@ -79,7 +79,7 @@ class ResultMissedError(Exception):
 _delivery_hist = None
 
 
-def _record_delivery(delta_ms: float) -> None:
+def _record_delivery(delta_ms: float, cause: Optional[str] = None) -> None:
     global _delivery_hist
     h = _delivery_hist
     if h is None:
@@ -87,7 +87,10 @@ def _record_delivery(delta_ms: float) -> None:
             "fusion_e2e_delivery_ms",
             help="server wave apply -> client invalidation apply",
         )
-    h.record(delta_ms)
+    # cause rides into the histogram's exemplar ring (ISSUE 19): a tail
+    # delivery sample keeps the wave id that produced it, so an alert on
+    # this histogram links to GET /trace?cause= in one hop
+    h.record(delta_ms, cause=cause)
 
 
 class RpcOutboundComputeCall(RpcOutboundCall):
@@ -193,7 +196,7 @@ class RpcOutboundComputeCall(RpcOutboundCall):
             self.invalidation_origin_ts = origin_ts
             delta_ms = (time.perf_counter() - origin_ts) * 1e3
             if 0.0 <= delta_ms < 3.6e6:  # range guard, NOT skew detection
-                _record_delivery(delta_ms)
+                _record_delivery(delta_ms, cause=cause)
         if self.future is not None and not self.future.done():
             self.future.set_exception(
                 ResultMissedError(f"invalidation overtook the result of call {self.call_id}")

@@ -32,6 +32,7 @@ from __future__ import annotations
 import logging
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..diagnostics.hotkeys import global_hotkeys
 from ..diagnostics.metrics import global_metrics
 from ..rpc.message import (
     COMPUTE_SYSTEM_SERVICE,
@@ -170,6 +171,12 @@ class ShardMapRouter:
         split-brain a write onto a replica)."""
         smap = self.shard_map
         shard = smap.shard_of(self.key_for(service, method, args))
+        # attribution (ISSUE 19): per-shard routing pressure, plus the
+        # shard|method sketch the straggler table joins against ("the
+        # slow shard's hottest keys")
+        board = global_hotkeys()
+        board.offer("routed_shards", str(shard))
+        board.offer("shard_keys", f"{shard}|{service}.{method}")
         # owner from the cached assignment table (O(1)); the rendezvous
         # re-sort in owners_for_shard stays off this per-call path
         owner = smap.owner_of_shard(shard)

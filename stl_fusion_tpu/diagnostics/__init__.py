@@ -17,6 +17,7 @@ from .explain import (
     install_explain,
 )
 from .flight_recorder import RECORDER, FlightRecorder, global_recorder
+from .hotkeys import HOTKEY_DOMAINS, HotKeyBoard, SpaceSavingSketch, global_hotkeys
 from .invariants import InvariantReport, InvariantViolation, validate_hub, validate_mirror
 from .mesh_telemetry import (
     MeshTelemetryAggregator,
@@ -35,6 +36,13 @@ from .metrics import (
     global_metrics,
 )
 from .monitor import FusionMonitor
+from .slo import (
+    SloEngine,
+    SloSpec,
+    default_slos,
+    global_slo_engine,
+    merge_verdicts,
+)
 from .tracing import (
     ActivitySource,
     Span,
@@ -85,4 +93,13 @@ __all__ = [
     "MetricsRegistry",
     "WaveProfiler",
     "global_metrics",
+    "HOTKEY_DOMAINS",
+    "HotKeyBoard",
+    "SpaceSavingSketch",
+    "global_hotkeys",
+    "SloEngine",
+    "SloSpec",
+    "default_slos",
+    "global_slo_engine",
+    "merge_verdicts",
 ]

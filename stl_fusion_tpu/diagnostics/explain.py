@@ -483,6 +483,35 @@ def explain(
             + " attach(es) naming this key (counted in "
             "fusion_edge_shed_total; clients retry per Retry-After)"
         )
+    # workload attribution (ISSUE 19): is this key a tracked heavy hitter?
+    # The hot-key board answers with its rank and share per domain — the
+    # line that turns "this key is slow" into "this key is 3.1% of all
+    # edge deliveries". Checked against the delivery and invalidation
+    # sketches; the node-id sketch needs the backend to resolve the key.
+    from .hotkeys import global_hotkeys
+
+    board = global_hotkeys()
+    hot: list = []
+    share = board.share_of("edge_deliveries", key_str)
+    if share is not None:
+        hot.append(share)
+    nid = (
+        backend.id_for(computed)
+        if (backend is not None and computed is not None)
+        else None
+    )
+    if nid is not None:
+        share = board.share_of("wave_invalidations", str(nid))
+        if share is not None:
+            hot.append(share)
+    if hot:
+        out["hotkeys"] = hot
+        top = max(hot, key=lambda h: h["share"])
+        chain.append(
+            f"key is a top-k heavy hitter: {top['share'] * 100:.1f}% of "
+            f"{top['domain']} (rank {top['rank']}, ~{top['count']} offers, "
+            f"over-count ≤ {top['error']})"
+        )
     out["chain"] = chain
     return out
 

@@ -130,7 +130,12 @@ class Histogram:
     the raw bucket counts travel in ``snapshot()`` so nothing is hidden."""
 
     kind = "histogram"
-    __slots__ = ("name", "help", "unit", "edges", "buckets", "count", "sum", "min", "max")
+    __slots__ = ("name", "help", "unit", "edges", "buckets", "count", "sum",
+                 "min", "max", "ex_cap", "exemplars", "ex_recorded", "ex_evicted")
+
+    #: exemplar ring bound — big enough to name several distinct causes in
+    #: the tail, small enough that a million-sample storm stays O(1) memory
+    EXEMPLAR_CAP = 8
 
     def __init__(self, name: str, help: str = "", unit: str = "ms",
                  lo: float = 0.001, hi: float = 120_000.0):
@@ -148,17 +153,24 @@ class Histogram:
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
+        # tail exemplars (ISSUE 19): cause-carrying samples, highest values
+        # kept — an alert on this histogram links to /trace?cause= in one hop
+        self.ex_cap = self.EXEMPLAR_CAP
+        self.exemplars: List[list] = []
+        self.ex_recorded = 0
+        self.ex_evicted = 0
 
-    def record(self, value: float) -> None:
-        self.record_many(value, 1)
+    def record(self, value: float, cause: Optional[str] = None) -> None:
+        self.record_many(value, 1, cause)
 
-    def record_many(self, value: float, n: int) -> None:
+    def record_many(self, value: float, n: int, cause: Optional[str] = None) -> None:
         """``n`` samples of the same value in one bucket update — the edge
         fan-out records one client-visible instant for a whole batch of
         synchronous-sink sessions (a per-session record() there would put
         a registry histogram inside a million-iteration loop). The single-
         sample :meth:`record` delegates here so the clamp + bucket logic
-        exists once."""
+        exists once. ``cause`` (the wave cause id) offers the sample to the
+        bounded exemplar ring — the tail keeps its provenance."""
         if n <= 0:
             return
         v = float(value)
@@ -171,6 +183,25 @@ class Histogram:
             self.min = v
         if v > self.max:
             self.max = v
+        if cause is not None:
+            self._offer_exemplar(v, cause)
+
+    def _offer_exemplar(self, v: float, cause: Any) -> None:
+        """Keep the highest-valued cause-carrying samples, ring bounded at
+        ``ex_cap`` — replace the current minimum when the ring is full, so
+        a burst of a million tail samples retains exactly ``ex_cap``."""
+        ex = self.exemplars
+        self.ex_recorded += 1
+        if len(ex) < self.ex_cap:
+            ex.append([v, str(cause), time.time()])
+            return
+        self.ex_evicted += 1
+        mi = 0
+        for i in range(1, len(ex)):
+            if ex[i][0] < ex[mi][0]:
+                mi = i
+        if v >= ex[mi][0]:
+            ex[mi] = [v, str(cause), time.time()]
 
     @staticmethod
     def _percentile_from(buckets, edges, count, observed_max, q: float) -> Optional[float]:
@@ -223,7 +254,7 @@ class Histogram:
         }
 
     def snapshot(self) -> dict:
-        return {
+        out = {
             "count": self.count,
             "sum": round(self.sum, 4),
             "min": round(self.min, 4) if self.count else None,
@@ -238,6 +269,13 @@ class Histogram:
                 if n
             },
         }
+        if self.exemplars:
+            # highest first: the tail's provenance, cause id attached
+            out["exemplars"] = [
+                [round(v, 4), cause, round(ts, 3)]
+                for v, cause, ts in sorted(self.exemplars, reverse=True)
+            ]
+        return out
 
 
 #: collector: fn(owner) -> {metric_name: numeric value}; gauge semantics,
@@ -335,6 +373,24 @@ class MetricsRegistry:
         return totals
 
     # ------------------------------------------------------------------ export
+    def _exemplar_totals(self) -> Dict[str, float]:
+        """Registry-wide exemplar accounting (ISSUE 19): summed across all
+        histograms, emitted only once any exemplar exists — a repo that
+        never passes ``cause=`` scrapes exactly what it did before."""
+        rec = ev = 0
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if isinstance(m, Histogram):
+                rec += m.ex_recorded
+                ev += m.ex_evicted
+        if rec == 0:
+            return {}
+        return {
+            "fusion_exemplars_recorded_total": float(rec),
+            "fusion_exemplars_evicted_total": float(ev),
+        }
+
     def snapshot(self) -> dict:
         """Nested dict of everything: registered metrics + collector sums."""
         out: Dict[str, Any] = {}
@@ -345,6 +401,8 @@ class MetricsRegistry:
         for k, v in self._collect().items():
             if k not in out:  # registered metrics win over collector shadows
                 out[k] = v
+        for k, v in self._exemplar_totals().items():
+            out.setdefault(k, v)
         return out
 
     def flat_samples(self) -> Dict[str, float]:
@@ -365,6 +423,8 @@ class MetricsRegistry:
         for k, v in self._collect().items():
             if k not in out:
                 out[k] = float(v)
+        for k, v in self._exemplar_totals().items():
+            out.setdefault(k, v)
         return out
 
     def max_aggregated_names(self) -> List[str]:
@@ -408,6 +468,10 @@ class MetricsRegistry:
                 lines.append(f"# TYPE {base} gauge")
                 typed.add(base)
             lines.append(f"{k} {collected[k]}")
+        for k, v in sorted(self._exemplar_totals().items()):
+            if k not in typed:
+                lines.append(f"# TYPE {k} gauge")
+                lines.append(f"{k} {v}")
         return "\n".join(lines) + "\n"
 
     def clear(self) -> None:
@@ -578,10 +642,10 @@ class WaveProfiler:
         self.newly_total += int(newly)
         self.metrics.histogram(
             "fusion_wave_device_ms", help="device wave dispatch->readback latency"
-        ).record(device_ms)
+        ).record(device_ms, cause=cause)
         self.metrics.histogram(
             "fusion_wave_apply_ms", help="host two-tier wave application latency"
-        ).record(apply_ms)
+        ).record(apply_ms, cause=cause)
 
     # ------------------------------------------------------------------ query
     def recent(self, n: Optional[int] = None) -> List[dict]:

@@ -53,6 +53,7 @@ import math
 import time
 from typing import Callable, Dict, Optional
 
+from ..diagnostics.hotkeys import global_hotkeys
 from ..diagnostics.metrics import global_metrics
 
 log = logging.getLogger("stl_fusion_tpu")
@@ -334,6 +335,9 @@ class AdmissionController:
         retry_after: Optional[float] = None,
     ) -> AdmissionDecision:
         self.shed_by_reason[reason] = self.shed_by_reason.get(reason, 0) + 1
+        # attribution (ISSUE 19): the per-tenant shed sketch is what the
+        # edge_shed_rate SLO names in its /health attribution block
+        global_hotkeys().offer("tenant_sheds", tenant_id or "(default)")
         if retry_after is None:
             retry_after = self.retry_after
         elif not math.isfinite(retry_after):
@@ -421,6 +425,7 @@ class AdmissionController:
                 )
         decision = AdmissionDecision(True, lane, tid)
         self.admitted_by_lane[lane] = self.admitted_by_lane.get(lane, 0) + 1
+        global_hotkeys().offer("tenant_admits", tid or "(default)")
         if hold:
             decision._held = True
             self._in_flight += 1

@@ -578,6 +578,12 @@ class EdgeNode:
             "fusion_edge_delivery_ms",
             help="server fence (wave apply) -> edge session client-visible",
         )
+        # attribution (ISSUE 19): per-key delivery offers into the
+        # process hot-key board — /hotkeys and explain() name the keys
+        # that dominate the edge fan
+        from ..diagnostics.hotkeys import global_hotkeys
+
+        self._hotkeys = global_hotkeys()
         self._batch_size_hist = global_metrics().histogram(
             "fusion_edge_reread_batch_size",
             help="keys per recompute_batch upstream frame",
@@ -1778,6 +1784,12 @@ class EdgeNode:
         # the amortization ratio exact and the shared bytes ready before
         # any pump or worker asks
         encoded = self.encode_frame(frame)
+        sessions = sum(len(bucket) for bucket in sub.shards)
+        if sessions:
+            # one offer per fanned frame, weighted by its session count —
+            # the sketch sees "this key reached N downstreams" without a
+            # per-session hop inside the delivery loops
+            self._hotkeys.offer("edge_deliveries", sub.key_str, sessions)
         if self._broadcasts:
             for hook in self._broadcasts:
                 try:
@@ -1856,7 +1868,7 @@ class EdgeNode:
             # record_delivery per drained frame).
             delta_ms = (time.perf_counter() - origin_ts) * 1e3
             if 0.0 <= delta_ms < 3.6e6 and sinks:  # range guard as $sys-c e2e
-                self._delivery_hist.record_many(delta_ms, sinks)
+                self._delivery_hist.record_many(delta_ms, sinks, cause=cause)
         if (cause is not None or err is not None) and RECORDER.enabled and n > 0:
             # the edge hop of the causal chain: explain() joins this to
             # the client-side "fenced" event (same call-shaped key, same
@@ -1892,7 +1904,7 @@ class EdgeNode:
             return
         delta_ms = (time.perf_counter() - origin_ts) * 1e3
         if 0.0 <= delta_ms < 3.6e6:
-            self._delivery_hist.record(delta_ms)
+            self._delivery_hist.record(delta_ms, cause=frame[3])
 
     # ------------------------------------------------------------------ plane
     def attach_broadcast(self, hook) -> None:

@@ -182,7 +182,9 @@ class SuperRoundTicket:
         # that _could_ overlap the next super-round's device execution)
         t0 = time.perf_counter()
         lane_counts, packed = jax.device_get((lc_d, pk_d))
-        prog.stall_s += time.perf_counter() - t0
+        stall = time.perf_counter() - t0
+        prog.stall_s += stall
+        prog._record_stall(stall, self.cause)
         inner.pending["batches"][0] = (lane_counts, packed, sizes)
         per_burst = inner.harvest()
         if prog._live_refresh is inner.refresh:
@@ -195,7 +197,9 @@ class SuperRoundTicket:
         backend = prog.backend
         t0 = time.perf_counter()
         counts, stage_ids = backend.harvest_waves_routed_chain(self.routed_pending)
-        prog.stall_s += time.perf_counter() - t0
+        stall = time.perf_counter() - t0
+        prog.stall_s += stall
+        prog._record_stall(stall, self.cause)
         K = len(stage_ids)
         backend.last_cause_id = self.cause
         total = 0
@@ -278,6 +282,15 @@ class SuperRoundProgram:
         # half-stalled programs are half stalled, not summed to a stall)
         reg.set_aggregation("fusion_superround_occupancy", "max")
         reg.set_aggregation("fusion_superround_host_stall_ms", "max")
+        # per-harvest stall distribution; exemplars carry the super-round
+        # cause so a tail stall links to GET /trace?cause= (ISSUE 19)
+        self._stall_hist = reg.histogram(
+            "fusion_superround_stall_ms",
+            help="per-harvest host milliseconds blocked on the device read",
+        )
+
+    def _record_stall(self, stall_s: float, cause) -> None:
+        self._stall_hist.record(stall_s * 1e3, cause=cause)
 
     # ------------------------------------------------------------------ metrics
     def occupancy(self) -> float:

@@ -92,12 +92,16 @@ HOST_AXIS = "host"
 LDEV_AXIS = "ldev"
 
 
-def record_level_stall_ms(ms: float) -> None:
+def record_level_stall_ms(ms: float, cause: Optional[str] = None) -> None:
     """Record the level-barrier stall time an async A/B leg reclaimed
     (sync wall − async wall over the same wave schedule, clamped at 0) as
     the ``fusion_mesh_level_stall_ms`` MAX-gauge. Lives here — next to the
     kernel whose barrier it measures — so the perf legs share one minting
-    site and the catalog row has a package anchor."""
+    site and the catalog row has a package anchor. ``cause`` (the leg's
+    last traced wave) additionally records the sample into the
+    ``fusion_mesh_stall_reclaim_ms`` histogram, whose exemplar ring keeps
+    the wave id — an operator reading the reclaim number can jump to
+    ``GET /trace?cause=`` in one hop (ISSUE 19)."""
     g = global_metrics().gauge(
         "fusion_mesh_level_stall_ms",
         help="level-barrier stall time reclaimed by the async frontier "
@@ -106,6 +110,12 @@ def record_level_stall_ms(ms: float) -> None:
     )
     g.set(float(ms))
     global_metrics().set_aggregation("fusion_mesh_level_stall_ms", "max")
+    if cause is not None:
+        global_metrics().histogram(
+            "fusion_mesh_stall_reclaim_ms",
+            help="per-recording async stall-reclaim samples; exemplars "
+            "carry the reclaiming leg's wave cause id",
+        ).record(float(ms), cause=cause)
 
 
 def _flat_spec(mesh: Mesh) -> P:

@@ -44,6 +44,7 @@ from typing import TYPE_CHECKING, Dict, Optional, Set, Tuple
 import numpy as np
 
 from ..diagnostics.flight_recorder import RECORDER
+from ..diagnostics.hotkeys import global_hotkeys
 from ..utils.serialization import dumps
 from .message import CALL_TYPE_COMPUTE, COMPUTE_SYSTEM_SERVICE, RpcMessage
 
@@ -198,7 +199,12 @@ class ComputeFanoutIndex:
         publish_nids: Dict[int, Tuple[Optional[str], Optional[float]]] = {}
         per_peer: Dict[int, Tuple[object, list]] = {}
         total_posted = 0
+        hotkeys = global_hotkeys()
         for nid in hits.tolist():
+            # attribution (ISSUE 19): one offer per subscribed node the
+            # wave invalidated — the sketch that lets /hotkeys and
+            # explain() name the keys a hot workload keeps re-fencing
+            hotkeys.offer("wave_invalidations", str(nid))
             subs = self._by_nid.pop(nid, None)
             if subs is None:
                 continue

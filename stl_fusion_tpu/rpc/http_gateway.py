@@ -258,7 +258,8 @@ class FusionHttpServer:
             observability = (
                 self.serve_observability
                 and method == "GET"
-                and path in ("/metrics", "/trace", "/explain", "/shards")
+                and path in ("/metrics", "/trace", "/explain", "/shards",
+                             "/health", "/hotkeys")
                 # same trust gate as principal headers: loopback (or the
                 # shared scraper secret) only — a direct remote client must
                 # not read spans/reports off a port it happens to reach
@@ -401,6 +402,74 @@ class FusionHttpServer:
                     status_line = "500 Internal Server Error"
                     payload = {"error": {"type": type(e).__name__, "message": str(e)}}
                 await self._write_json(writer, status_line, payload)
+                return
+            if observability and path == "/health":
+                # machine-readable SLO verdict (ISSUE 19): mesh-scope when
+                # an aggregator is attached (stale hosts surface as
+                # degraded entries), local-scope otherwise. Always 200 —
+                # the verdict IS the answer; transport success must not be
+                # conflated with fleet health.
+                from ..diagnostics.slo import global_slo_engine
+
+                try:
+                    if self.mesh_telemetry is not None:
+                        payload = self.mesh_telemetry.mesh_health()
+                    else:
+                        payload = global_slo_engine().evaluate()
+                except Exception as e:  # noqa: BLE001 — a judging fault is a
+                    # degraded verdict, never a dropped connection
+                    log.exception("/health evaluation failed")
+                    from ..diagnostics.metrics import global_metrics
+
+                    global_metrics().counter(
+                        "fusion_health_endpoint_errors_total",
+                        help="/health evaluations that raised and answered "
+                             "a degraded verdict instead",
+                    ).inc()
+                    payload = {
+                        "verdict": "degraded",
+                        "scope": "local",
+                        "error": {"type": type(e).__name__, "message": str(e)},
+                    }
+                await self._write_json(writer, "200 OK", payload)
+                return
+            if observability and path == "/hotkeys":
+                # workload attribution (ISSUE 19): top-k heavy hitters per
+                # domain, mesh-merged when an aggregator is attached
+                from ..diagnostics.hotkeys import global_hotkeys
+
+                query = urllib.parse.parse_qs(parsed_target.query)
+                try:
+                    n = max(1, min(int(query.get("n", ["5"])[0]), 64))
+                except ValueError:
+                    n = 5
+                domain = query.get("domain", [None])[0]
+                if self.mesh_telemetry is not None:
+                    payload = self.mesh_telemetry.hotkeys_report(n)
+                else:
+                    payload = {
+                        "scope": "local",
+                        "domains": global_hotkeys().report(n),
+                    }
+                if domain is not None:
+                    domains = payload.get("domains") or {}
+                    if domain not in domains:
+                        await self._write_json(
+                            writer,
+                            "404 Not Found",
+                            {
+                                "error": {
+                                    "type": "UnknownDomain",
+                                    "message": (
+                                        f"no sketch for domain {domain!r}; "
+                                        f"available: {sorted(domains)}"
+                                    ),
+                                }
+                            },
+                        )
+                        return
+                    payload["domains"] = {domain: domains[domain]}
+                await self._write_json(writer, "200 OK", payload)
                 return
             if observability and path == "/shards":
                 merged: dict = {}

@@ -162,6 +162,11 @@ def test_compact_record_stays_under_tail_window():
                                    "sum_exact": True, "merged_series": 10,
                                    "exposition_lines": 29,
                                    "snapshot_series": 3},
+                "health": {"verdict": "ok",
+                           "hosts": {"h0": "ok", "h1": "ok"}, "stale": []},
+                "hotkeys": {"wave_invalidations":
+                            {"total": 1812, "top_key": "Tbl.node(7,)",
+                             "top_share": 0.31}},
                 "trace": {"cause": "mesh-wave/scale#r2",
                           "hosts": ["h0", "h1"], "partial": False,
                           "duration_ms": 137.084, "segments": 36,
@@ -224,8 +229,10 @@ def test_compact_record_stays_under_tail_window():
     # level_stall_ms / quiescence_checks / adaptive_stages), then
     # → 4900 for the ISSUE 18 observability block (the fleet-telemetry
     # merge verdict + the stitched-wave digest incl. its straggler
-    # table) — still comfortably inside the driver's bounded stdout tail
-    assert len(line) < 4900, f"compact record grew to {len(line)} bytes"
+    # table), then → 5300 for the ISSUE 19 health plane (the mesh
+    # burn-rate verdict + the per-domain hot-key digest) — still
+    # comfortably inside the driver's bounded stdout tail
+    assert len(line) < 5300, f"compact record grew to {len(line)} bytes"
     d = json.loads(line)
     # the edge tier (ISSUE 8): the million-subscriber numbers make the capture
     assert d["edge"]["subs"] == 1_000_000 and d["edge"]["fenced_per_s"] == 412346
@@ -294,6 +301,11 @@ def test_compact_record_stays_under_tail_window():
     assert d["mesh"]["mh_trace"]["levels"] == 9
     assert d["mesh"]["mh_trace"]["paced_by"]["shard"] == 13
     assert d["mesh"]["mh_trace"]["straggler"][0]["stall_ms_total"] == 9.567
+    # the health plane (ISSUE 19): the mesh-scope burn-rate verdict and
+    # the merged top key per attribution domain ride the capture
+    assert d["mesh"]["health"]["verdict"] == "ok"
+    assert d["mesh"]["health"]["hosts"] == {"h0": "ok", "h1": "ok"}
+    assert d["mesh"]["hotkeys"]["wave_invalidations"]["top_key"] == "Tbl.node(7,)"
     # the async A/B (ISSUE 17): barriers reclaimed + the counted
     # quiescence evidence + both modes' inv/s ride the capture
     assert d["mesh"]["async_depth"] == 4

@@ -334,6 +334,72 @@ def test_fl005_label_and_max_drift(tmp_path):
     assert len(report.active) == 2
 
 
+# ---------------------------------------------------------------------- FL006
+
+FL006_CODE = """
+    from .slo import SloSpec
+
+    def default_slos():
+        return [
+            SloSpec("documented_p99", series="fusion_x_ms", kind="p99",
+                    threshold=250.0),
+            SloSpec(name="undocumented_rate", series="fusion_y_total",
+                    kind="rate", threshold=0.0),
+        ]
+"""
+
+FL006_DOC = """
+    # Observability
+
+    ## SLO catalog
+
+    | slo | series | kind | budget |
+    | --- | --- | --- | --- |
+    | `documented_p99` | `fusion_x_ms` | p99 | <= 250 ms |
+    | `ghost_slo` | `fusion_z_total` | rate | = 0/s, removed from code |
+
+    ## Something else
+
+    | `not_an_slo_row` | outside the SLO catalog section |
+"""
+
+
+def test_fl006_slo_catalog_drift_both_directions(tmp_path):
+    report = lint(
+        tmp_path, {"stl_fusion_tpu/s.py": FL006_CODE}, doc=FL006_DOC,
+    )
+    msgs = sorted(f.message for f in report.active if f.rule == "FL006")
+    assert len(msgs) == 2
+    assert "ghost_slo" in msgs[0] and "stale row" in msgs[0]
+    assert "undocumented_rate" in msgs[1] and "no row" in msgs[1]
+    # rows outside the "## SLO catalog" section never register as SLOs,
+    # and the series column (fusion_*) never masquerades as an SLO name
+    assert all("not_an_slo_row" not in m for m in msgs)
+    assert all("fusion_x_ms" not in m for m in msgs)
+
+
+def test_fl006_synced_catalog_is_clean(tmp_path):
+    doc = FL006_DOC.replace(
+        "| `ghost_slo` | `fusion_z_total` | rate | = 0/s, removed from code |",
+        "| `undocumented_rate` | `fusion_y_total` | rate | = 0/s |",
+    )
+    report = lint(tmp_path, {"stl_fusion_tpu/s.py": FL006_CODE}, doc=doc)
+    assert [f for f in report.active if f.rule == "FL006"] == []
+
+
+def test_fl006_ignores_specs_outside_package(tmp_path):
+    # perf-harness gates wrap ad-hoc checks in SloSpec for the shared
+    # comparator — dynamic names outside stl_fusion_tpu/ are not scanned
+    # (the perf/ tree is not part of the module walk at all; this guards
+    # the scan-scope check inside extract_code_slos stays in place)
+    report = lint(
+        tmp_path,
+        {"stl_fusion_tpu/empty.py": "x = 1\n"},
+        doc=MINI_DOC,
+    )
+    assert [f for f in report.active if f.rule == "FL006"] == []
+
+
 # ------------------------------------------------------------- suppressions
 
 def test_suppression_requires_reason_and_counts(tmp_path):

@@ -1,0 +1,214 @@
+"""diagnostics/hotkeys.py — space-saving heavy-hitter sketches (ISSUE 19).
+
+The acceptance properties: merge is order-independent and deterministic
+(asserted both on bare sketches and across an emulated two-host mesh
+snapshot exchange), memory stays O(k) under 1M distinct keys, counts
+never understate, and the board's mesh transport round-trips exactly.
+"""
+import json
+import sys
+
+from stl_fusion_tpu.diagnostics.clocksync import ClockSync
+from stl_fusion_tpu.diagnostics.hotkeys import (
+    HOTKEY_DOMAINS,
+    HotKeyBoard,
+    SpaceSavingSketch,
+)
+from stl_fusion_tpu.diagnostics.mesh_telemetry import (
+    MeshTelemetryAggregator,
+    MeshTelemetryPublisher,
+    MeshTraceStore,
+)
+from stl_fusion_tpu.diagnostics.metrics import MetricsRegistry
+
+
+def test_exact_when_under_capacity():
+    sk = SpaceSavingSketch(capacity=8)
+    for key, n in [("a", 5), ("b", 3), ("c", 1)]:
+        sk.offer(key, n)
+    assert sk.estimate("a") == 5 and sk.error_of("a") == 0
+    assert sk.total == 9
+    top = sk.topk(2)
+    assert [(e["key"], e["count"]) for e in top] == [("a", 5), ("b", 3)]
+    assert top[0]["share"] == round(5 / 9, 6)
+
+
+def test_eviction_inherits_count_and_never_understates():
+    sk = SpaceSavingSketch(capacity=2)
+    sk.offer("a", 10)
+    sk.offer("b", 1)
+    sk.offer("c", 1)  # evicts b (min count 1, ties by key) at count 1
+    assert sk.estimate("c") == 2  # inherited 1 + its own 1: never understates
+    assert sk.error_of("c") == 1  # and says so
+    assert sk.estimate("b") == 0
+    assert len(sk) == 2
+
+
+def test_deterministic_eviction_ties_break_by_key():
+    a = SpaceSavingSketch(capacity=2)
+    b = SpaceSavingSketch(capacity=2)
+    for sk in (a, b):
+        sk.offer("x", 1)
+        sk.offer("y", 1)
+        sk.offer("z", 1)  # both evict "x" (count tie, lowest key)
+    assert a.to_payload() == b.to_payload()
+    assert a.estimate("y") == 1 and a.estimate("x") == 0
+
+
+def test_memory_stays_bounded_under_1m_distinct_keys():
+    sk = SpaceSavingSketch(capacity=16)
+    for i in range(1_000_000):
+        sk.offer(f"k{i}")
+    assert len(sk) == 16
+    assert len(sk._heap) <= 4 * 16  # the lazy heap self-rebuilds
+    assert sk.total == 1_000_000
+    # the container sizes are the whole memory story: no per-key residue
+    assert len(sk._counts) == 16 and len(sk._errors) == 16
+
+
+def test_heavy_hitters_survive_a_long_tail():
+    sk = SpaceSavingSketch(capacity=32)
+    for i in range(20_000):
+        sk.offer(f"tail{i}")
+        if i % 4 == 0:
+            sk.offer("hot", 2)
+    top = sk.topk(1)[0]
+    assert top["key"] == "hot"
+    # space-saving guarantee: estimate >= true count (10000 offers of 2)
+    assert top["count"] >= 10_000
+
+
+def test_merge_is_commutative_and_deterministic():
+    a = SpaceSavingSketch(capacity=8)
+    b = SpaceSavingSketch(capacity=8)
+    for i in range(100):
+        a.offer(f"a{i % 12}")
+        b.offer(f"b{i % 7}")
+        a.offer("shared", 1)
+        b.offer("shared", 2)
+    ab, ba = a.merge(b), b.merge(a)
+    assert ab.to_payload() == ba.to_payload()
+    assert ab.total == a.total + b.total
+    assert ab.estimate("shared") == a.estimate("shared") + b.estimate("shared")
+
+
+def test_payload_roundtrip_is_exact_and_json_safe():
+    sk = SpaceSavingSketch(capacity=4)
+    for i in range(50):
+        sk.offer(f"k{i % 6}", i % 3 + 1)
+    wire = json.loads(json.dumps(sk.to_payload()))
+    back = SpaceSavingSketch.from_payload(wire)
+    assert back.to_payload() == sk.to_payload()
+    # malformed entries drop without poisoning the sketch
+    wire["entries"].append(["ok-key", "not-a-count", None])
+    patched = SpaceSavingSketch.from_payload(wire)
+    assert patched.estimate("ok-key") == 0
+    assert patched.to_payload()["entries"] == sk.to_payload()["entries"]
+
+
+def test_board_domains_and_share_of():
+    board = HotKeyBoard(capacity=8, registry=MetricsRegistry())
+    for domain in HOTKEY_DOMAINS[:2]:
+        board.offer(domain, "k1", 3)
+        board.offer(domain, "k2", 1)
+    assert board.domains() == sorted(HOTKEY_DOMAINS[:2])
+    share = board.share_of(HOTKEY_DOMAINS[0], "k1")
+    assert share["rank"] == 1 and share["count"] == 3
+    assert share["share"] == 0.75
+    assert board.share_of(HOTKEY_DOMAINS[0], "missing") is None
+    assert board.share_of("never_offered", "k1") is None
+
+
+def test_board_collector_exports_offer_counters():
+    reg = MetricsRegistry()
+    board = HotKeyBoard(capacity=8, registry=reg)
+    board.offer("edge_deliveries", "k", 5)
+    flat = reg.flat_samples()
+    assert flat['fusion_hotkey_offers_total{domain="edge_deliveries"}'] == 5
+    assert flat['fusion_hotkey_tracked{domain="edge_deliveries"}'] == 1
+
+
+def _two_host_boards():
+    """Emulated 2-host mesh: each host has its own registry + board, h1
+    ships its snapshot (sketches riding inside) to h0's aggregator."""
+    reg0, reg1 = MetricsRegistry(), MetricsRegistry()
+    board0 = HotKeyBoard(capacity=8, registry=reg0)
+    board1 = HotKeyBoard(capacity=8, registry=reg1)
+    for i in range(40):
+        board0.offer("edge_deliveries", f"k{i % 5}")
+        board1.offer("edge_deliveries", f"k{i % 3}", 2)
+    board1.offer("tenant_sheds", "t-noisy", 9)
+    agg = MeshTelemetryAggregator(
+        local_member="h0", registry=reg0, period_s=5.0,
+        clock=ClockSync(), trace=MeshTraceStore(), hotkeys=board0,
+    )
+    pub = MeshTelemetryPublisher(
+        member="h1", registry=reg1, period_s=5.0, trace=MeshTraceStore(),
+        hotkeys=board1,
+    )
+    return board0, board1, agg, pub
+
+
+def test_mesh_snapshot_merge_is_order_independent():
+    board0, board1, agg, pub = _two_host_boards()
+    payload = pub.payload()
+    assert "sketches" in payload  # the sketches ride the snapshot
+    agg.ingest(payload)
+    merged = agg.merged_sketches()
+    # the mesh merge equals the bare commutative merge, both orders
+    direct_ab = board0.sketch("edge_deliveries").merge(
+        board1.sketch("edge_deliveries")
+    )
+    direct_ba = board1.sketch("edge_deliveries").merge(
+        board0.sketch("edge_deliveries")
+    )
+    assert merged["edge_deliveries"].to_payload() == direct_ab.to_payload()
+    assert direct_ab.to_payload() == direct_ba.to_payload()
+    # a domain only the remote offered still surfaces mesh-side
+    assert merged["tenant_sheds"].estimate("t-noisy") == 9
+
+
+def test_mesh_hotkeys_report_shape():
+    _board0, _board1, agg, pub = _two_host_boards()
+    agg.ingest(pub.payload())
+    report = agg.hotkeys_report(n=2)
+    assert report["scope"] == "mesh"
+    assert "h1" in report["hosts"]
+    deliveries = report["domains"]["edge_deliveries"]
+    assert deliveries["total"] == 40 + 80
+    assert len(deliveries["top"]) == 2
+    json.dumps(report)  # wire-safe end to end
+
+
+def test_stale_host_sketches_are_excluded():
+    _board0, _board1, agg, pub = _two_host_boards()
+    agg.ingest(pub.payload())
+    fresh = agg.merged_sketches()
+    assert fresh["edge_deliveries"].total == 120
+    # age h1's snapshot past the staleness horizon: its sketches drop out
+    # of the merge exactly like its counters do
+    future = __import__("time").time() + 1000.0
+    merged = agg.merged_sketches(now_wall=future)
+    assert merged["edge_deliveries"].total == 40  # local only
+    assert "tenant_sheds" not in merged
+
+
+def test_merge_payload_fold_matches_pairwise_any_order():
+    # capacity above the distinct-key count: below truncation the fold is
+    # exactly order-independent (truncating folds only guarantee the 2-way
+    # commutativity the mesh exchange relies on, tested above)
+    sketches = []
+    for seed in range(3):
+        sk = SpaceSavingSketch(capacity=16)
+        for i in range(60):
+            sk.offer(f"k{(i * (seed + 3)) % 9}")
+        sketches.append(sk)
+    payloads = [{"d": sk.to_payload()} for sk in sketches]
+    forward = HotKeyBoard.merge_payloads(payloads)["d"]
+    backward = HotKeyBoard.merge_payloads(payloads[::-1])["d"]
+    assert forward.total == backward.total == 180
+    assert forward.to_payload() == backward.to_payload()
+
+
+if __name__ == "__main__":
+    sys.exit(0)

@@ -1,0 +1,268 @@
+"""diagnostics/slo.py — burn-rate verdicts behind /health (ISSUE 19).
+
+Fake clocks drive the multi-window state machine deterministically:
+burning when the fast window's violation fraction crosses its ratio,
+warn from the slow window or the hysteresis hold-down, ok only after
+the hold elapses. merge_verdicts folds hosts worst-wins with stale
+snapshots contributing a degraded entry no matter what they claimed.
+"""
+import pytest
+
+from stl_fusion_tpu.diagnostics.hotkeys import HotKeyBoard
+from stl_fusion_tpu.diagnostics.metrics import MetricsRegistry
+from stl_fusion_tpu.diagnostics.slo import (
+    VERDICT_RANK,
+    SloEngine,
+    SloSpec,
+    default_slos,
+    merge_verdicts,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _engine(registry, specs, clock, **kw):
+    kw.setdefault("fast_s", 10.0)
+    kw.setdefault("slow_s", 60.0)
+    kw.setdefault("hold_s", 10.0)
+    return SloEngine(
+        specs=specs, registry=registry, clock=clock, wall=clock, **kw
+    )
+
+
+# ---------------------------------------------------------------- comparator
+
+
+def test_violated_is_the_single_comparator():
+    le = SloSpec("a", threshold=5.0, comparator="le")
+    assert not le.violated(5.0) and le.violated(5.1)
+    ge = SloSpec("b", threshold=5.0, comparator="ge")
+    assert not ge.violated(5.0) and ge.violated(4.9)
+    eq = SloSpec("c", threshold=0.0, comparator="eq")
+    assert not eq.violated(0.0) and eq.violated(1.0)
+    # a measurement that produced nothing must fail loudly, not pass
+    for spec in (le, ge, eq):
+        assert spec.violated(None)
+
+
+def test_spec_rejects_unknown_kind_and_comparator():
+    with pytest.raises(ValueError):
+        SloSpec("x", kind="p50")
+    with pytest.raises(ValueError):
+        SloSpec("x", comparator="lt")
+
+
+def test_default_slos_read_env_thresholds(monkeypatch):
+    monkeypatch.setenv("FUSION_SLO_DELIVERY_P99_MS", "42")
+    by_name = {s.name: s for s in default_slos()}
+    assert by_name["delivery_e2e_p99"].threshold == 42.0
+    assert by_name["edge_shed_rate"].attribution == "tenant_sheds"
+    # SLO names never carry the metric prefix: FL005/FL006 catalogs stay disjoint
+    assert all("fusion_" not in s.name for s in by_name.values())
+
+
+# ------------------------------------------------------------- state machine
+
+
+def test_boot_is_ok_with_no_observations():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    eng = _engine(reg, [SloSpec("p99", series="lat_ms", kind="p99",
+                                threshold=100.0, unit="ms")], clock)
+    verdict = eng.evaluate()
+    # empty histogram -> no observation -> no claimed latency -> ok
+    assert verdict["verdict"] == "ok" and verdict["triggered_by"] is None
+    slo = verdict["slos"][0]
+    assert slo["state"] == "ok" and slo["value"] is None
+    assert slo["burn"]["fast"]["samples"] == 0
+
+
+def test_fast_window_burns_and_hold_down_releases_through_warn():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms")
+    eng = _engine(reg, [SloSpec("p99", series="lat_ms", kind="p99",
+                                threshold=100.0, unit="ms")], clock)
+    h.record(10.0)
+    assert eng.evaluate()["slos"][0]["state"] == "ok"
+    # two violating samples inside the fast window -> burning (page)
+    for _ in range(2):
+        clock.tick(1.0)
+        h.record(5000.0)
+        verdict = eng.evaluate()
+    assert verdict["verdict"] == "burning"
+    assert verdict["triggered_by"] == "p99"
+    assert verdict["slos"][0]["burn"]["fast"]["samples"] >= 2
+    # recovery: the histogram cannot forget its tail, so rebind a clean
+    # series the way a new measurement window would
+    # (drive recovery via rate-kind below; here assert hysteresis timing)
+    state = eng._states["p99"]
+    assert state.state == "burning"
+
+
+def test_rate_slo_full_arc_burning_then_warn_then_ok():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    shed = reg.counter("shed_total")
+    eng = _engine(reg, [SloSpec("shed_rate", series="shed_total",
+                                kind="rate", threshold=0.5, unit="/s")],
+                  clock, fast_s=5.0, slow_s=20.0, hold_s=3.0)
+    # first reading anchors the rate: no sample, still ok
+    verdict = eng.evaluate()
+    assert verdict["slos"][0]["burn"]["fast"]["samples"] == 0
+    states = []
+    # storm: 10 sheds/s for 3 ticks -> fast window fraction 1.0 -> burning
+    for _ in range(3):
+        clock.tick(1.0)
+        shed.inc(10)
+        states.append(eng.evaluate()["slos"][0]["state"])
+    assert states[-1] == "burning"
+    # quiet: violations age out of the fast window (burning clears), linger
+    # in the slow window (warn), then age out of that too (ok)
+    arc = []
+    for _ in range(25):
+        clock.tick(1.0)
+        arc.append(eng.evaluate()["slos"][0]["state"])
+    assert "warn" in arc  # hysteresis: never snaps burning -> ok
+    assert arc[-1] == "ok"
+    assert arc.index("ok") > arc.index("warn")
+
+
+def test_slow_window_warns_without_paging():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    val = reg.gauge("drift")
+    eng = _engine(reg, [SloSpec("drift_zero", series="drift", kind="value",
+                                threshold=0.0, comparator="eq")],
+                  clock, fast_s=4.0, slow_s=60.0, hold_s=4.0)
+    # one violation, then only clean samples once it has aged out of the
+    # fast window: below the 50% fast ratio, above the 10% slow ratio ->
+    # warn, never a page
+    val.set(1.0)
+    eng.evaluate()
+    val.set(0.0)
+    clock.tick(6.0)  # past fast_s: the fast window sees only clean samples
+    states = []
+    for _ in range(9):
+        states.append(eng.evaluate()["slos"][0]["state"])
+        clock.tick(2.0)
+    assert "warn" in states and "burning" not in states
+
+
+def test_missing_scalar_series_reads_zero_not_violation():
+    clock = FakeClock()
+    eng = _engine(MetricsRegistry(),
+                  [SloSpec("inv", series="never_minted", kind="value",
+                           threshold=0.0, comparator="eq")], clock)
+    slo = eng.evaluate()["slos"][0]
+    # no invariant counter means no invariant breaks, not a page
+    assert slo["state"] == "ok" and slo["value"] == 0.0
+
+
+def test_attribution_rides_non_ok_verdicts():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    board = HotKeyBoard(capacity=8, registry=reg)
+    board.offer("tenant_sheds", "t-noisy", 30)
+    board.offer("tenant_sheds", "t-quiet", 1)
+    val = reg.gauge("sheds")
+    eng = _engine(reg, [SloSpec("shed_zero", series="sheds", kind="value",
+                                threshold=0.0, comparator="eq",
+                                attribution="tenant_sheds")],
+                  clock, hotkeys=board)
+    val.set(1.0)
+    eng.evaluate()
+    clock.tick(1.0)
+    verdict = eng.evaluate()
+    slo = verdict["slos"][0]
+    assert slo["state"] == "burning"
+    top = slo["attribution"]["top"]
+    assert slo["attribution"]["domain"] == "tenant_sheds"
+    assert top[0]["key"] == "t-noisy" and top[0]["share"] > 0.9
+    # recovery drops the suspects list along with the verdict
+    val.set(0.0)
+    clock.tick(100.0)
+    eng.evaluate()
+    clock.tick(1.0)
+    ok_slo = eng.evaluate()["slos"][0]
+    assert ok_slo["state"] == "ok" and "attribution" not in ok_slo
+
+
+def test_engine_exports_state_ranks_through_collector():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    val = reg.gauge("sheds")
+    eng = _engine(reg, [SloSpec("shed_zero", series="sheds", kind="value",
+                                threshold=0.0, comparator="eq")], clock)
+    val.set(1.0)
+    eng.evaluate()
+    clock.tick(1.0)
+    eng.evaluate()
+    flat = reg.flat_samples()
+    assert flat['fusion_slo_state{slo="shed_zero"}'] == VERDICT_RANK["burning"]
+    assert flat["fusion_slo_burning"] == 1
+    assert flat["fusion_slo_evaluations_total"] == 2
+
+
+# -------------------------------------------------------------- mesh merge
+
+
+def _ok(name="x"):
+    return {"verdict": "ok", "triggered_by": None, "at": 1.0, "slos": []}
+
+
+def test_merge_verdicts_worst_wins():
+    merged = merge_verdicts(
+        _ok(),
+        {"h1": {"verdict": "warn", "triggered_by": "p99"},
+         "h2": {"verdict": "burning", "triggered_by": "shed_rate"}},
+        stale_hosts=[], local_member="h0",
+    )
+    assert merged["verdict"] == "burning"
+    assert merged["scope"] == "mesh"
+    assert merged["triggered_host"] == "h2"
+    assert merged["triggered_by"] == "shed_rate"
+    assert merged["hosts"]["h0"]["verdict"] == "ok"
+
+
+def test_merge_verdicts_stale_host_is_degraded_no_matter_what():
+    merged = merge_verdicts(
+        _ok(),
+        {"h1": {"verdict": "ok", "triggered_by": None}},
+        stale_hosts=["h1"], local_member="h0",
+    )
+    assert merged["hosts"]["h1"]["verdict"] == "degraded"
+    assert merged["hosts"]["h1"]["reason"] == "telemetry snapshot stale"
+    assert merged["verdict"] == "degraded"
+    assert merged["stale"] == ["h1"]
+    # a stale host we never even got a snapshot from degrades too
+    merged = merge_verdicts(_ok(), {}, stale_hosts=["h9"], local_member="h0")
+    assert merged["hosts"]["h9"]["verdict"] == "degraded"
+
+
+def test_merge_verdicts_missing_verdict_degrades():
+    merged = merge_verdicts(
+        _ok(), {"h1": None}, stale_hosts=[], local_member="h0"
+    )
+    assert merged["hosts"]["h1"]["verdict"] == "degraded"
+    assert merged["hosts"]["h1"]["reason"] == "no health verdict in snapshot"
+    assert merged["verdict"] == "degraded"
+
+
+def test_merge_verdicts_all_ok():
+    merged = merge_verdicts(
+        _ok(), {"h1": _ok(), "h2": _ok()}, stale_hosts=[], local_member="h0"
+    )
+    assert merged["verdict"] == "ok"
+    assert merged["triggered_by"] is None and merged["triggered_host"] is None
+    assert sorted(merged["hosts"]) == ["h0", "h1", "h2"]

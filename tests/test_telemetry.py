@@ -97,6 +97,35 @@ class TestMetricsRegistry:
         assert phase_b["p99"] <= 4.0  # unpolluted by phase A's 1s samples
         assert h.percentile(50) >= 1.0  # whole-run view unchanged
 
+    def test_exemplar_ring_stays_bounded_under_burst(self):
+        h = Histogram("lat_ms")
+        for i in range(10_000):
+            h.record(float(i % 997), cause=f"w{i}")
+        assert len(h.exemplars) == Histogram.EXEMPLAR_CAP
+        assert h.ex_recorded == 10_000
+        assert h.ex_evicted == 10_000 - Histogram.EXEMPLAR_CAP
+        # keep-highest policy: the survivors are all from the tail
+        assert all(v >= 990.0 for v, _cause, _ts in h.exemplars)
+        snap = h.snapshot()
+        assert len(snap["exemplars"]) == Histogram.EXEMPLAR_CAP
+        # highest first, cause id attached for the /trace?cause= hop
+        values = [e[0] for e in snap["exemplars"]]
+        assert values == sorted(values, reverse=True)
+        assert all(e[1].startswith("w") for e in snap["exemplars"])
+
+    def test_exemplar_totals_absent_until_a_cause_is_offered(self):
+        r = MetricsRegistry()
+        h = r.histogram("fusion_e2e_delivery_ms")
+        h.record(5.0)  # no cause: registry scrapes exactly as before
+        snap = r.snapshot()
+        assert "fusion_exemplars_recorded_total" not in snap
+        assert "exemplars" not in snap["fusion_e2e_delivery_ms"]
+        h.record(9.0, cause="w1")
+        snap = r.snapshot()
+        assert snap["fusion_exemplars_recorded_total"] == 1.0
+        assert snap["fusion_exemplars_evicted_total"] == 0.0
+        assert snap["fusion_e2e_delivery_ms"]["exemplars"][0][1] == "w1"
+
     def test_collectors_sum_and_weakref_prune(self):
         r = MetricsRegistry()
 

@@ -10,7 +10,15 @@ import sys
 
 import pytest
 
-from tools.trace_dump import find_trace, main, render
+from tools.trace_dump import (
+    find_health,
+    find_hotkeys,
+    find_trace,
+    main,
+    render,
+    render_health,
+    render_hotkeys,
+)
 
 from stl_fusion_tpu.diagnostics.mesh_telemetry import MeshTraceStore
 
@@ -143,3 +151,126 @@ def test_main_rejects_traceless_input(tmp_path, capsys):
     p.write_text("{}")
     assert main([str(p)]) == 1
     assert "no stitched trace" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------- ISSUE 19
+# health-verdict + hot-key panels: pure functions of their dicts, pinned
+# byte-for-byte exactly like the timeline golden above.
+
+HEALTH = {
+    "verdict": "burning", "scope": "mesh", "at": 1700000000.0,
+    "triggered_by": "edge_shed_rate", "triggered_host": "h1",
+    "hosts": {
+        "h0": {"verdict": "ok", "triggered_by": None},
+        "h1": {"verdict": "burning", "triggered_by": "edge_shed_rate"},
+        "h2": {"verdict": "degraded", "reason": "telemetry snapshot stale",
+               "triggered_by": None},
+    },
+    "stale": ["h2"],
+    "slos": [
+        {"name": "delivery_e2e_p99", "state": "ok", "kind": "p99",
+         "series": "fusion_e2e_delivery_ms", "threshold": 250.0,
+         "unit": "ms", "value": 3.21,
+         "burn": {"fast": {"window_s": 60.0, "ratio": 0.0, "samples": 12},
+                  "slow": {"window_s": 300.0, "ratio": 0.0, "samples": 40}}},
+        {"name": "edge_shed_rate", "state": "burning", "kind": "rate",
+         "series": "fusion_edge_shed_total", "threshold": 0.5,
+         "unit": "/s", "value": 41.7,
+         "burn": {"fast": {"window_s": 60.0, "ratio": 1.0, "samples": 6},
+                  "slow": {"window_s": 300.0, "ratio": 0.35, "samples": 40}},
+         "attribution": {"domain": "tenant_sheds", "top": [
+             {"key": "anon", "count": 500, "error": 0, "share": 0.625},
+             {"key": "t-big", "count": 250, "error": 12, "share": 0.3125},
+         ]}},
+    ],
+}
+
+HEALTH_GOLDEN = """\
+== health: BURNING (mesh) ==
+triggered: edge_shed_rate on h1
+  slo                       state      value  threshold  burn fast/slow
+  delivery_e2e_p99          ok          3.21ms      250ms  0%/12  0%/40
+  edge_shed_rate            burning     41.7/s      0.5/s  100%/6  35%/40
+    suspects (tenant_sheds): anon 62.5%, t-big 31.2%
+hosts   : h0=ok h1=burning h2=degraded
+stale   : h2
+"""
+
+HOTKEYS = {
+    "scope": "mesh", "hosts": ["h0", "h1"],
+    "domains": {
+        "edge_deliveries": {"total": 1000, "top": [
+            {"key": "Tbl.node(7,)", "count": 310, "error": 0, "share": 0.31},
+            {"key": "Tbl.node(9,)", "count": 120, "error": 4, "share": 0.12},
+        ]},
+        "tenant_sheds": {"total": 0, "top": []},
+    },
+}
+
+HOTKEYS_GOLDEN = """\
+== hot keys (mesh) ==
+edge_deliveries (total 1000)
+  rank   share    count  (+/-err)  key
+     1   31.0%      310         0  Tbl.node(7,) ################
+     2   12.0%      120         4  Tbl.node(9,) ######
+tenant_sheds (total 0)
+  (no offers)
+"""
+
+
+def test_render_health_golden():
+    assert render_health(HEALTH) == HEALTH_GOLDEN
+
+
+def test_render_health_compact_digest():
+    # perf records carry {"verdict", "hosts": {m: "ok"}, "stale": []}
+    digest = {"verdict": "ok", "hosts": {"h0": "ok", "h1": "ok"}, "stale": []}
+    text = render_health(digest)
+    assert "== health: OK (mesh) ==" in text
+    assert "hosts   : h0=ok h1=ok" in text
+    assert "stale" not in text and "triggered" not in text
+
+
+def test_render_hotkeys_golden():
+    assert render_hotkeys(HOTKEYS) == HOTKEYS_GOLDEN
+
+
+def test_straggler_rows_name_their_hot_keys():
+    digest = {
+        "cause": "w#hot", "hosts": ["h0", "h1"], "partial": False,
+        "missing_hosts": [], "duration_ms": 10.0, "segments": 4, "levels": 2,
+        "straggler": [
+            {"host": "h1", "shard": 3, "paced_levels": 2,
+             "stall_ms_total": 5.0,
+             "hot_keys": [
+                 {"key": "Tbl.node(7,)", "count": 31, "share": 0.31}]},
+        ],
+        "paced_by": {"host": "h1", "shard": 3, "level": 1, "stall_ms": 4.0},
+    }
+    text = render(digest)
+    assert "        hot: Tbl.node(7,) 31.0%" in text
+
+
+@pytest.mark.parametrize("wrap", [
+    lambda h: h,                                    # bare /health body
+    lambda h: {"report": {"health": h}},            # monitor report
+    lambda h: {"multihost": {"scale": {"health": h}}},  # perf record
+])
+def test_find_health_all_shapes(wrap):
+    assert find_health(wrap(HEALTH)) is HEALTH
+
+
+def test_find_hotkeys_shapes():
+    assert find_hotkeys(HOTKEYS) is HOTKEYS
+    # a record's bare {domain: {total, top}} map normalizes to {"domains": ...}
+    bare = {"hotkeys": {"edge_deliveries": {"total": 3, "top": []}}}
+    found = find_hotkeys(bare)
+    assert found == {"domains": bare["hotkeys"]}
+
+
+def test_main_renders_health_and_hotkeys_panels(tmp_path, capsys):
+    p = tmp_path / "h.json"
+    p.write_text(json.dumps({"health": HEALTH, "hotkeys": HOTKEYS}))
+    assert main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert HEALTH_GOLDEN in out and HOTKEYS_GOLDEN in out

@@ -1,6 +1,6 @@
 """fusionlint — a repo-native static analyzer for the invalidation pipeline.
 
-Five rules distilled from the measured bug history (see README.md in this
+Six rules distilled from the measured bug history (see README.md in this
 directory for the full catalog, one section per rule with the CHANGES.md
 PR reference each rule encodes):
 
@@ -25,6 +25,10 @@ PR reference each rule encodes):
   ``stl_fusion_tpu/`` appears in OBSERVABILITY.md with a matching label
   set (and MAX-aggregation marker where code declares it), and vice
   versa. Doubles as the doc linter.
+- **FL006 SLO catalog sync** — every ``SloSpec`` objective declared in
+  ``stl_fusion_tpu/`` has a row in the OBSERVABILITY.md "SLO catalog"
+  section, and every row names a live objective. The judgment-plane
+  twin of FL005: the catalog is what the pager rotation reads.
 
 Stdlib-``ast`` only — linting never imports the code under analysis (no
 jax, runs in seconds). Entry point: ``python -m tools.fusionlint``.
@@ -48,6 +52,7 @@ RULES = {
     "FL003": "fire-and-forget task with no retained handle or lifecycle owner",
     "FL004": "blocking call inside an async function",
     "FL005": "fusion_* metric catalog drift between code and OBSERVABILITY.md",
+    "FL006": "SLO catalog drift between SloSpec declarations and OBSERVABILITY.md",
 }
 
 

@@ -20,7 +20,7 @@ def main(argv=None) -> int:
     default_root = os.path.dirname(os.path.dirname(here))
     parser = argparse.ArgumentParser(
         prog="python -m tools.fusionlint",
-        description="repo-native static analyzer (FL001-FL005); see tools/fusionlint/README.md",
+        description="repo-native static analyzer (FL001-FL006); see tools/fusionlint/README.md",
     )
     parser.add_argument("--root", default=default_root, help="repo root to scan")
     parser.add_argument("--json", action="store_true", help="machine-readable output")

@@ -38,6 +38,7 @@ from .rules import (
     fl003_task_retention,
     fl004_blocking_in_async,
 )
+from .slo_catalog import fl006_slo_catalog_sync
 from .telemetry import fl005_catalog_sync
 
 __all__ = ["LintReport", "run_lint", "load_baseline", "baseline_from_findings"]
@@ -302,6 +303,8 @@ def run_lint(
     fl005 = []
     if doc_text:
         fl005_catalog_sync(modules, _DOC_NAME, doc_text, fl005)
+        # FL006 (ISSUE 19): SLO catalog, same both-directions discipline
+        fl006_slo_catalog_sync(modules, _DOC_NAME, doc_text, fl005)
     for f in fl005:
         per_module.setdefault(f.path, []).append(f)
 

@@ -14,10 +14,19 @@ fill), level-fence markers, a per-level table with ASCII stall bars, and
 the straggler attribution table — the ``explain()`` "paced by host h1
 shard 37 at level 12" line, drawn.
 
+The same input may also carry the ISSUE 19 judgment planes, rendered as
+extra panels when present:
+
+* a ``GET /health`` verdict (or a record's compact ``health`` digest) —
+  the SLO table with burn windows and per-host verdicts,
+* a ``GET /hotkeys`` body (or ``mesh_report()["hotkeys"]``) — the top-k
+  heavy-hitter table per attribution domain.
+
 Usage::
 
     python -m tools.trace_dump result_scale_h0.json
     curl -s "$GW/trace?cause=$CAUSE" | python -m tools.trace_dump
+    curl -s "$GW/health" | python -m tools.trace_dump
     python -m tools.trace_dump --width 100 record.json
 """
 import argparse
@@ -53,6 +62,131 @@ def find_trace(doc) -> Optional[dict]:
             if found is not None:
                 return found
     return None
+
+
+def find_health(doc) -> Optional[dict]:
+    """Walk any accepted JSON shape down to a health verdict dict —
+    a ``/health`` body, ``report()["health"]``, or a perf record's
+    compact ``{"verdict", "hosts", "stale"}`` digest."""
+    if not isinstance(doc, dict):
+        return None
+    if "verdict" in doc and ("slos" in doc or "hosts" in doc):
+        return doc
+    for key in ("health",):
+        if isinstance(doc.get(key), dict):
+            return find_health(doc[key]) or doc[key]
+    for key in ("report", "multihost", "mesh", "scale", "async_ab", "live"):
+        sub = doc.get(key)
+        if isinstance(sub, dict):
+            found = find_health(sub)
+            if found is not None:
+                return found
+    return None
+
+
+def find_hotkeys(doc) -> Optional[dict]:
+    """Walk down to a hot-key report: a ``/hotkeys`` body
+    (``{"domains": {...}}``) or a bare ``{domain: {"total", "top"}}``
+    map under a record's ``hotkeys`` key."""
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("domains"), dict):
+        return doc
+    hk = doc.get("hotkeys")
+    if isinstance(hk, dict):
+        found = find_hotkeys(hk)
+        if found is not None:
+            return found
+        if hk and all(
+            isinstance(v, dict) and "top" in v for v in hk.values()
+        ):
+            return {"domains": hk}
+    for key in ("report", "multihost", "mesh", "scale"):
+        sub = doc.get(key)
+        if isinstance(sub, dict):
+            found = find_hotkeys(sub)
+            if found is not None:
+                return found
+    return None
+
+
+def render_health(health: dict) -> str:
+    """One deterministic ASCII panel for a health verdict (pure function
+    of the verdict dict — the golden test pins this byte-for-byte)."""
+    out: List[str] = []
+    verdict = str(health.get("verdict", "?"))
+    scope = health.get("scope") or ("mesh" if "hosts" in health else "local")
+    out.append(f"== health: {verdict.upper()} ({scope}) ==")
+    trig = health.get("triggered_by")
+    if trig:
+        host = health.get("triggered_host")
+        out.append(f"triggered: {trig}" + (f" on {host}" if host else ""))
+    slos = health.get("slos") or []
+    if slos:
+        out.append(
+            "  slo                       state      value  threshold"
+            "  burn fast/slow"
+        )
+        for s in slos:
+            value = s.get("value")
+            unit = s.get("unit") or ""
+            vtxt = "-" if value is None else f"{value:g}{unit}"
+            thr = f"{s.get('threshold', 0):g}{unit}"
+            burn = s.get("burn") or {}
+            fast = burn.get("fast") or {}
+            slow = burn.get("slow") or {}
+            btxt = (
+                f"{fast.get('ratio', 0) * 100:.0f}%/{fast.get('samples', 0)}"
+                f"  {slow.get('ratio', 0) * 100:.0f}%/{slow.get('samples', 0)}"
+            )
+            out.append(
+                f"  {s.get('name', '?'):<25} {s.get('state', '?'):<8} "
+                f"{vtxt:>9} {thr:>10}  {btxt}"
+            )
+            attr = s.get("attribution") or {}
+            top = attr.get("top") or []
+            if top:
+                suspects = ", ".join(
+                    f"{e['key']} {e['share'] * 100:.1f}%" for e in top
+                )
+                out.append(f"    suspects ({attr.get('domain')}): {suspects}")
+    hosts = health.get("hosts") or {}
+    if hosts:
+        parts = []
+        for member in sorted(hosts):
+            entry = hosts[member]
+            v = entry.get("verdict", "?") if isinstance(entry, dict) else entry
+            parts.append(f"{member}={v}")
+        out.append(f"hosts   : {' '.join(parts)}")
+    stale = health.get("stale") or []
+    if stale:
+        out.append(f"stale   : {', '.join(stale)}")
+    return "\n".join(line.rstrip() for line in out) + "\n"
+
+
+def render_hotkeys(hot: dict, top_n: int = 5) -> str:
+    """Top-k heavy hitters per attribution domain, with honest error
+    bounds (a space-saving count may overstate by ``err``, never under)."""
+    out: List[str] = []
+    scope = hot.get("scope") or "local"
+    out.append(f"== hot keys ({scope}) ==")
+    domains = hot.get("domains") or {}
+    for domain in sorted(domains):
+        entry = domains[domain] or {}
+        top = (entry.get("top") or [])[:top_n]
+        out.append(f"{domain} (total {entry.get('total', 0)})")
+        if not top:
+            out.append("  (no offers)")
+            continue
+        out.append("  rank   share    count  (+/-err)  key")
+        peak = max(e["count"] for e in top)
+        for rank, e in enumerate(top, start=1):
+            out.append(
+                f"  {rank:>4} {e['share'] * 100:>6.1f}% {e['count']:>8} "
+                f"{e.get('error', 0):>9}  {e['key']} "
+                f"{_bar(e['count'], peak, 16)}"
+            )
+    return "\n".join(line.rstrip() for line in out) + "\n"
 
 
 def _bar(value: float, peak: float, width: int = 20) -> str:
@@ -145,6 +279,14 @@ def render(trace: dict, width: int = 72) -> str:
                 f"  {r['host']:<5} {r['shard']:>5} {r['paced_levels']:>13} "
                 f"{r['stall_ms_total']:>15.3f} {_bar(r['stall_ms_total'], peak)}"
             )
+            # ISSUE 19: a slow shard names its hottest keys (the monitor
+            # joins the shard_keys sketch onto the straggler rows)
+            hot = r.get("hot_keys") or []
+            if hot:
+                keys = ", ".join(
+                    f"{e['key']} {e['share'] * 100:.1f}%" for e in hot
+                )
+                out.append(f"        hot: {keys}")
     return "\n".join(line.rstrip() for line in out) + "\n"
 
 
@@ -165,10 +307,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"trace_dump: cannot read input: {e}", file=sys.stderr)
         return 2
     trace = find_trace(doc)
-    if trace is None:
-        print("trace_dump: no stitched trace in input", file=sys.stderr)
+    health = find_health(doc)
+    hotkeys = find_hotkeys(doc)
+    if trace is None and health is None and hotkeys is None:
+        print(
+            "trace_dump: no stitched trace, health verdict, or hot-key "
+            "report in input",
+            file=sys.stderr,
+        )
         return 1
-    sys.stdout.write(render(trace, width=max(args.width, 24)))
+    panels = []
+    if trace is not None:
+        panels.append(render(trace, width=max(args.width, 24)))
+    if health is not None:
+        panels.append(render_health(health))
+    if hotkeys is not None:
+        panels.append(render_hotkeys(hotkeys))
+    sys.stdout.write("\n".join(panels))
     return 0
 
 
