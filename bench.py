@@ -669,6 +669,36 @@ def run_traffic_section():
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def run_write_section():
+    """Embedded write-path measurement (ISSUE 20): perf/write_path.py as
+    a subprocess — zipf writers driving increment commands through the
+    routed ClusterCommander (commands → journal → fused waves → edge
+    fences) with its SLO gates enforced: zero lost and zero
+    double-applied writes against the store oracle, zero eager-fallback
+    waves, dedup replay absorbed, plus the hot-key storm, mid-burst
+    join, and mid-burst owner-kill adversarial legs.
+    FUSION_BENCH_WRITE_OPS=0 skips."""
+    import subprocess
+
+    ops = int(os.environ.get("FUSION_BENCH_WRITE_OPS", 12_000))
+    if ops <= 0:
+        return None
+    env = dict(os.environ, WRITE_OPS=str(ops))
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "perf", "write_path.py"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], env=env, stdout=subprocess.PIPE, text=True,
+            timeout=3600,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "write path timed out"}
+    if proc.returncode != 0:
+        return {"error": f"write path failed rc={proc.returncode} (stderr inherited above)"}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def run_lint_section():
     """fusionlint compact record (ISSUE 13): the static gate's verdict
     beside the perf numbers — findings-by-rule (must stay empty),
@@ -745,6 +775,9 @@ def main() -> None:
     traffic = run_traffic_section()
     if traffic is not None:
         detail["traffic"] = traffic
+    write = run_write_section()
+    if write is not None:
+        detail["write"] = write
     mesh = run_mesh_section()
     if mesh is not None:
         detail["mesh"] = mesh
@@ -767,7 +800,7 @@ def main() -> None:
         json.dumps(
             _compact_result(
                 inv_per_sec, detail, live, fanout, cluster, edge, mesh, traffic,
-                lint,
+                lint, write,
             ),
             separators=(",", ":"),
         )
@@ -802,7 +835,7 @@ def _pos_ms(fields: dict) -> dict:
 
 def _compact_result(
     inv_per_sec: float, detail: dict, live, fanout=None, cluster=None, edge=None,
-    mesh=None, traffic=None, lint=None,
+    mesh=None, traffic=None, lint=None, write=None,
 ) -> dict:
     """The single stdout line: every headline metric, nothing that scales
     with run verbosity, target well under the driver's tail window."""
@@ -1092,6 +1125,37 @@ def _compact_result(
             ),
             "audit_violations": audit.get("violations"),
             "stale_keys": audit.get("stale"),
+        }
+    if write is not None and "error" in write:
+        out["write"] = {"error": write["error"]}
+    elif write is not None:
+        # the write plane (ISSUE 20): commands through the routed
+        # commander as a measured record — throughput and command→
+        # client-visible latency, the hot-key storm p99, the counted
+        # retries the join/kill legs cost, and the integrity verdicts
+        # (lost/double-applied MUST be 0; dedup absorbs every replay;
+        # zero eager-fallback waves means every command wave fused)
+        wmain = write.get("main") or {}
+        pipe = write.get("pipeline") or {}
+        dedup = write.get("dedup") or {}
+        out["write"] = {
+            "ok": write.get("ok"),
+            "total_writes": write.get("total_writes"),
+            "writes_per_s": wmain.get("writes_per_s"),
+            "cmd_visible_p50_ms": wmain.get("cmd_visible_p50_ms"),
+            "cmd_visible_p99_ms": wmain.get("cmd_visible_p99_ms"),
+            "storm_p99_ms": (write.get("storm") or {}).get(
+                "cmd_visible_p99_ms"
+            ),
+            "reshard_retries": (write.get("reshard") or {}).get("retries"),
+            "kill_retries": (write.get("kill") or {}).get("retries"),
+            "dedup_replayed": dedup.get("replayed"),
+            "dedup_absorbed": dedup.get("absorbed"),
+            "eager_waves": pipe.get("eager_waves"),
+            "fused_dispatches": pipe.get("fused_dispatches"),
+            "slo_failed": sorted(
+                c["name"] for c in write.get("slo") or [] if not c.get("ok")
+            ),
         }
     # cold vs warm start (ISSUE 6): the rebuild bill a restart used to pay
     # (mirror build + program warm-up) beside what the durable path pays

@@ -33,6 +33,12 @@ a CI gate:
    map moves ~half the keys to a second member: moved keys re-pin, the
    single-upstream invariant holds, and the post-reshard burst converges
    oracle-clean within the p99 ceiling.
+6. **write-path burst** (ISSUE 20) — commands drive the graph: order
+   commands route through the ClusterCommander, the invalidation replay
+   is COLLECTED and submitted through the nonblocking WavePipeline
+   (command waves fuse — zero eager fallbacks), and the subscribed
+   sessions see the fences within the command→visible ceiling; a
+   duplicate operation id is absorbed (never re-applied).
 
 Cross-cutting gates: the per-tenant SLO table (gold p99 ceiling at least
 as tight as anonymous), a final ConsistencyAuditor sweep (zero invariant
@@ -56,6 +62,7 @@ TRAFFIC_RECONNECT_SLO_S (60), TRAFFIC_TIMEOUT_S (600), TRAFFIC_WIRE (1).
 Prints ONE JSON line (stdout); progress notes go to stderr.
 """
 import asyncio
+import dataclasses
 import json
 import os
 import sys
@@ -113,6 +120,20 @@ from stl_fusion_tpu.graph import TpuGraphBackend  # noqa: E402
 from stl_fusion_tpu.graph.synthetic import power_law_dag  # noqa: E402
 from stl_fusion_tpu.rpc import RpcHub, install_compute_fanout  # noqa: E402
 from stl_fusion_tpu.rpc.testing import RpcMultiServerTestTransport  # noqa: E402
+from stl_fusion_tpu.utils.serialization import wire_type  # noqa: E402
+
+
+@wire_type("TrafficOrder")
+@dataclasses.dataclass(frozen=True)
+class OrderCmd:
+    """S6's write: one order against a DAG row's cart. Routed by row so
+    the command plane and the graph agree on the key."""
+
+    row: int
+    qty: int
+
+    def shard_key(self):
+        return f"row-{self.row}"
 
 
 def require(cond: bool, what: str) -> None:
@@ -875,6 +896,99 @@ async def main() -> None:
                 "p99_ms": reshard_p99,
             }
             await until(quiesced, timeout_s, "S5 queue drain")
+
+        # ========================================================== S6
+        # write-path burst (ISSUE 20): commands → fused waves → fences.
+        # The command plane rides THIS stack: orders route through the
+        # ClusterCommander, completion's invalidation replay is collected
+        # and submitted through the nonblocking pipeline, and the
+        # subscribed sessions see the fences.
+        note("S6: write-path burst (commands fuse into waves)...")
+        from stl_fusion_tpu.commands import ClusterCommander
+        from stl_fusion_tpu.core import is_invalidating
+        from stl_fusion_tpu.diagnostics import global_metrics as _gm
+
+        orders: dict = {}
+
+        async def apply_order(command):
+            if is_invalidating():
+                await svc.node(command.row)
+                return
+            orders[command.row] = orders.get(command.row, 0) + command.qty
+            return float(orders[command.row])
+
+        hub.commander.add_handler(apply_order, command_type=OrderCmd)
+        hub.commander.attach_operations_pipeline()
+        pipe = hub.enable_nonblocking(fuse_depth=8)
+        cc = ClusterCommander(hub.commander, member_id="s0")
+        write_rows = key_rows[: min(8, n_keys)]
+        write_rounds = 2 if smoke else 4
+        eager_before = pipe.stats()["eager_waves"]
+        vis_hist = _gm().histogram(
+            "fusion_cmd_visible_ms",
+            help="command acceptance → client-visible invalidation",
+            unit="ms",
+        )
+        hist_ck = vis_hist.checkpoint()
+        round_ms = []
+        fenced_write_keys = {
+            edges[0].node.key_str(spec_of_row[r]) for r in write_rows
+        }
+        for rnd in range(write_rounds):
+            for e in edges:
+                expected = sum(
+                    sub.session_count
+                    for ks, sub in e.node._subs.items()
+                    if ks in fenced_write_keys
+                )
+                e.counter.arm(expected, collect=False)
+            t0 = time.perf_counter()
+            for j, row in enumerate(write_rows):
+                await cc.call(OrderCmd(int(row), 1),
+                              operation_id=f"op-traffic-{rnd}-{j}")
+            cc.drain()  # flush + harvest: the commands' super-round lands
+            await asyncio.wait_for(
+                asyncio.gather(*(e.counter.event.wait() for e in edges)),
+                timeout_s,
+            )
+            round_ms.append((time.perf_counter() - t0) * 1e3)
+        # the duplicate operation id is ABSORBED, never re-applied
+        dedup_before = _gm().counter("fusion_cmd_dedup_total").value
+        before_dup = orders[int(write_rows[0])]
+        again = await cc.call(OrderCmd(int(write_rows[0]), 1),
+                              operation_id="op-traffic-0-0")
+        require(
+            orders[int(write_rows[0])] == before_dup and again == 1.0,
+            "duplicate order op id re-applied (memo must return the FIRST "
+            "application's result and leave the ledger untouched)",
+        )
+        require(
+            _gm().counter("fusion_cmd_dedup_total").value == dedup_before + 1,
+            "dedup replay not counted",
+        )
+        write_p99 = pctile(round_ms, 99)
+        slo.check("write.cmd_visible_p99", write_p99, p99_ceiling)
+        slo.check_eq(
+            "write.eager_waves",
+            int(pipe.stats()["eager_waves"] - eager_before), 0,
+        )
+        require(
+            vis_hist.since(hist_ck)["count"] >= write_rounds * len(write_rows),
+            "fusion_cmd_visible_ms never recorded the command waves",
+        )
+        require(
+            sum(orders.values()) == write_rounds * len(write_rows),
+            "order ledger lost or double-applied a write",
+        )
+        results["write"] = {
+            "rounds": write_rounds,
+            "orders": sum(orders.values()),
+            "cmd_visible_p99_ms": write_p99,
+            "eager_waves": int(pipe.stats()["eager_waves"] - eager_before),
+            "fused_dispatches": pipe.stats()["fused_dispatches"],
+        }
+        pipe.dispose()  # back to the blocking burst path for the audits
+        await until(quiesced, timeout_s, "S6 queue drain")
 
         # ================================================== final audits
         note("final staleness + consistency audit...")

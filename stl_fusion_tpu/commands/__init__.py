@@ -1,4 +1,10 @@
 """CommandR — the CQRS command pipeline (SURVEY.md §2.3)."""
+from .cluster_commander import (
+    ClusterCommander,
+    ClusterCommanderFacade,
+    CommandEnvelope,
+    expose_cluster_commander,
+)
 from .commander import Commander, LocalCommand
 from .context import CommandContext, current_command_context
 from .handlers import CommandHandler, HandlerRegistry, command_filter, command_handler
@@ -7,6 +13,10 @@ from .tracer import CommandTracer, attach_command_tracer
 
 __all__ = [
     "COMMANDER_SERVICE",
+    "ClusterCommander",
+    "ClusterCommanderFacade",
+    "CommandEnvelope",
+    "expose_cluster_commander",
     "CommanderFacade",
     "bridge_commands",
     "expose_commander",

@@ -45,6 +45,7 @@ def bridge_commands(
     command_types: Iterable[Type],
     peer_ref: Optional[str] = "default",
     service: str = COMMANDER_SERVICE,
+    router=None,
 ) -> None:
     """Register final handlers forwarding the given command types over RPC.
 
@@ -52,11 +53,37 @@ def bridge_commands(
     ``call_router`` (per-command sharding, as in the MultiServerRpc sample).
     Filters registered on the local commander (retry, tracing…) still wrap
     the forwarded call; only the final handler is remote.
+
+    A forwarded command that comes back with a ``ShardMovedError`` applies
+    the carried shard map to the router BEFORE the error surfaces (ISSUE
+    20 — the same healing rule the batched read path got in PR 11): the
+    pinned-peer path bypasses the hub's routed-retry healing entirely, so
+    without this the caller's retry would land on the SAME stale owner.
+    Counted as ``fusion_cmd_shard_retries_total``; ``router`` defaults to
+    the hub's ``call_router`` when it knows how to ``note_moved``.
     """
     proxy = rpc_hub.client(service, peer_ref)
+    if router is None:
+        candidate = getattr(rpc_hub, "call_router", None)
+        if hasattr(candidate, "note_moved"):
+            router = candidate
 
     async def forward(command):
-        return await proxy.call(command)
+        from ..cluster.shard_map import ShardMovedError
+        from ..diagnostics.metrics import global_metrics
+
+        try:
+            return await proxy.call(command)
+        except ShardMovedError as e:
+            if router is not None:
+                router.note_moved(e)  # heal: the retry routes to the new owner
+            global_metrics().counter(
+                "fusion_cmd_shard_retries_total",
+                help="bridged commands bounced by a moved shard whose carried "
+                "map was applied before surfacing (retry lands on the new "
+                "owner first try)",
+            ).inc()
+            raise
 
     for command_type in command_types:
         commander.add_handler(forward, command_type=command_type)

@@ -422,6 +422,14 @@ def explain(
         if host is not None:
             line += f" on host {host}"
         chain.append(line)
+        # ISSUE 20: the cluster commander labels command causes (and the
+        # oplog reader re-labels them on replay hosts), so the chain names
+        # the WRITE end to end: command → wave seq → delivery
+        from .mesh_telemetry import global_mesh_trace
+
+        command_label = global_mesh_trace().command_for(cause)
+        if command_label is not None:
+            chain.append(f"invalidated by command {command_label}")
     if span_dict is not None:
         chain.append(
             f"originating span: {span_dict['source']}:{span_dict['name']}"

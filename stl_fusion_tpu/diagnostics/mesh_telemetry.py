@@ -163,6 +163,10 @@ class MeshTraceStore:
         self._lock = threading.Lock()
         #: cause -> list of segment dicts, insertion-ordered for eviction
         self._by_cause: "OrderedDict[str, List[dict]]" = OrderedDict()
+        #: cause -> originating-command label (ISSUE 20): bounded the same
+        #: way, fed by the cluster commander locally and by the oplog
+        #: reader for operations journaled on other hosts
+        self._commands: "OrderedDict[str, str]" = OrderedDict()
         self.recorded = 0
         self.ingested = 0
         self.dropped = 0
@@ -228,6 +232,26 @@ class MeshTraceStore:
                 self._by_cause.popitem(last=False)
         return True
 
+    # ------------------------------------------------------------- attribution
+    def note_command(self, cause: Optional[str], label: str) -> None:
+        """Remember which command a wave cause id originated from, so a
+        stitched timeline (and ``explain()``) can say "invalidated by
+        command X" instead of only naming an opaque cause (ISSUE 20).
+        First write wins: the origin member labels before any replayer."""
+        if cause is None or not label:
+            return
+        with self._lock:
+            if cause not in self._commands:
+                self._commands[cause] = label
+                while len(self._commands) > self.max_causes:
+                    self._commands.popitem(last=False)
+
+    def command_for(self, cause: Optional[str]) -> Optional[str]:
+        if cause is None:
+            return None
+        with self._lock:
+            return self._commands.get(cause)
+
     # ------------------------------------------------------------------ read
     def causes(self) -> List[str]:
         with self._lock:
@@ -254,6 +278,7 @@ class MeshTraceStore:
     def clear(self) -> None:
         with self._lock:
             self._by_cause.clear()
+            self._commands.clear()
         self.recorded = 0
         self.ingested = 0
         self.dropped = 0
@@ -363,7 +388,7 @@ class MeshTraceStore:
                 # alignment error of their own
                 "residual_ms": 0.0 if (h == local or rtt is None) else round(rtt * 5e2, 3),
             }
-        return {
+        out = {
             "cause": cause,
             "hosts": hosts,
             "partial": partial,
@@ -381,6 +406,10 @@ class MeshTraceStore:
             "straggler": straggler_rows,
             "paced_by": paced_by,
         }
+        command = self.command_for(cause)
+        if command is not None:
+            out["command"] = command
+        return out
 
 
 _TRACE: Optional[MeshTraceStore] = None

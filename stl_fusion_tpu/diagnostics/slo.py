@@ -160,6 +160,22 @@ def default_slos() -> List[SloSpec]:
             attribution="tenant_sheds",
             description="admission shed rate within budget (per-tenant attribution)",
         ),
+        SloSpec(
+            "cmd_visible_p99",
+            series="fusion_cmd_visible_ms",
+            kind="p99",
+            threshold=_env_float("FUSION_SLO_CMD_P99_MS", 250.0),
+            unit="ms",
+            description="command → client-visible invalidation p99 within budget",
+        ),
+        SloSpec(
+            "cmd_error_rate",
+            series="fusion_cmd_errors_total",
+            kind="rate",
+            threshold=_env_float("FUSION_SLO_CMD_ERROR_RATE", 0.0),
+            unit="/s",
+            description="no commands failing after bounded owner retries",
+        ),
     ]
 
 

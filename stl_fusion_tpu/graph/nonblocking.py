@@ -74,7 +74,7 @@ class WaveTicket:
     and — once its chain is harvested — the newly-invalidated count and
     the wave seq the fused dispatch stamped for it."""
 
-    __slots__ = ("seeds", "count", "seq", "fallback", "done")
+    __slots__ = ("seeds", "count", "seq", "fallback", "done", "cause")
 
     def __init__(self, seeds: List[int], fallback: int = 0):
         self.seeds = seeds
@@ -82,6 +82,10 @@ class WaveTicket:
         self.count: Optional[int] = None
         self.seq: Optional[int] = None
         self.done = False
+        #: the fused chain's cause id, stamped at harvest — the command →
+        #: wave join point: a cluster commander labels this cause in the
+        #: mesh trace store so explain()/stitch() name the command
+        self.cause: Optional[str] = None
 
     def _resolve(self, count: int, seq: Optional[int]) -> None:
         self.count = count + self.fallback
@@ -289,6 +293,7 @@ class WavePipeline:
                 backend.last_wave_seq = seqs[i]
                 backend._apply_newly(stage_masks[i])
                 count = int(stage_counts[i].sum())
+                wave.cause = ticket["cause"]
                 wave._resolve(count, seqs[i])
                 total += count
         finally:
@@ -370,6 +375,7 @@ class WavePipeline:
                     [wave.seeds], mirror=mirror
                 )
                 backend._apply_newly(ids)
+                wave.cause = cause
                 wave._resolve(int(count), seqs[i])
                 total += int(count)
         finally:

@@ -32,6 +32,11 @@ class Operation:
     commit_time: Optional[float] = None
     #: nested commands executed inside this operation (replayed on invalidation)
     items: List[Any] = field(default_factory=list)
+    #: originating span/wave cause id (ISSUE 20) — stamped by the cluster
+    #: commander so a journaled operation can be joined back to the command
+    #: span that minted it (and, both directions over the oplog, so remote
+    #: replays attribute their stitched wave timelines to the command)
+    cause_id: Optional[str] = None
 
     @property
     def is_committed(self) -> bool:

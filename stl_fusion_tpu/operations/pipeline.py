@@ -51,7 +51,12 @@ if TYPE_CHECKING:
 
 log = logging.getLogger("stl_fusion_tpu")
 
-__all__ = ["OperationsHost", "attach_operations", "current_operation"]
+__all__ = [
+    "OperationsHost",
+    "attach_operations",
+    "current_operation",
+    "pinned_operation_scope",
+]
 
 # priority constants (higher runs earlier), mirroring the reference ordering
 PRIORITY_REPROCESSOR = 100
@@ -161,7 +166,16 @@ def attach_operations(commander: "Commander") -> OperationsHost:
     async def operation_scope_provider(command: Any, context: "CommandContext"):
         if isinstance(command, Completion) or is_invalidating() or _enclosing_operation(context) is not None:
             return await context.invoke_remaining_handlers()
-        operation = Operation(command=command, agent_id=host.agent.id)
+        pin = _pinned_operation.get()
+        if pin is not None:
+            # the cluster commander pinned the operation identity: the SAME
+            # op id across retries is what makes the journal dedup
+            # exactly-once, and the cause id joins journal ↔ command span
+            operation = Operation(
+                command=command, agent_id=host.agent.id, id=pin[0], cause_id=pin[1]
+            )
+        else:
+            operation = Operation(command=command, agent_id=host.agent.id)
         context.items.set(operation, key=Operation)
         result = await context.invoke_remaining_handlers()
         # success ⇒ commit + notify (errors propagate, no completion);
@@ -236,6 +250,27 @@ def attach_operations(commander: "Commander") -> OperationsHost:
 _batch_cascade_collector: "contextvars.ContextVar[Optional[Callable]]" = (
     contextvars.ContextVar("batch_cascade_collector", default=None)
 )
+
+_pinned_operation: "contextvars.ContextVar[Optional[tuple]]" = contextvars.ContextVar(
+    "fusion_pinned_operation", default=None
+)
+
+
+@contextlib.contextmanager
+def pinned_operation_scope(operation_id: str, cause_id: Optional[str] = None):
+    """Pin the identity of the NEXT top-level operation minted inside this
+    task's await chain (ISSUE 20): the scope provider builds it with this
+    ``operation_id`` (+ optional originating ``cause_id``) instead of a
+    fresh uuid. The cluster commander wraps every routed execution in this
+    so a retried command — reshard, host kill, duplicate client send —
+    journals under ONE id, and replay dedup (``notify_completed`` +
+    journal ``INSERT OR IGNORE``) makes the write exactly-once.
+    Contextvar-scoped: concurrent commands are unaffected."""
+    token = _pinned_operation.set((operation_id, cause_id))
+    try:
+        yield
+    finally:
+        _pinned_operation.reset(token)
 
 
 @contextlib.contextmanager

@@ -46,9 +46,15 @@ def ensure_operations_schema(conn: sqlite3.Connection) -> None:
             agent_id TEXT,
             commit_time REAL,
             command_json TEXT,
-            items_json TEXT
+            items_json TEXT,
+            cause_id TEXT
         )"""
     )
+    # pre-ISSUE-20 databases lack the cause column; migrate in place (the
+    # column is nullable, so old rows read back with cause=None)
+    cols = {row[1] for row in conn.execute("PRAGMA table_info(operations)")}
+    if "cause_id" not in cols:
+        conn.execute("ALTER TABLE operations ADD COLUMN cause_id TEXT")
     conn.execute(
         "CREATE INDEX IF NOT EXISTS ix_operations_commit ON operations(commit_time)"
     )
@@ -58,14 +64,16 @@ def insert_operation_row(conn: sqlite3.Connection, record: "OperationRecord"):
     """INSERT the record (id-deduped) WITHOUT committing — the caller owns
     the transaction."""
     return conn.execute(
-        "INSERT OR IGNORE INTO operations (id, agent_id, commit_time, command_json, items_json)"
-        " VALUES (?, ?, ?, ?, ?)",
+        "INSERT OR IGNORE INTO operations"
+        " (id, agent_id, commit_time, command_json, items_json, cause_id)"
+        " VALUES (?, ?, ?, ?, ?, ?)",
         (
             record.id,
             record.agent_id,
             record.commit_time,
             json.dumps(encode(record.command)),
             json.dumps(encode(list(record.items))),
+            record.cause,
         ),
     )
 
@@ -78,6 +86,10 @@ class OperationRecord:
     command: Any
     items: tuple  # nested commands
     index: int = 0  # log position (store-assigned)
+    #: originating span/wave cause id (ISSUE 20): rides the log BOTH
+    #: directions so a remote replay's stitched wave timeline attributes
+    #: back to the command that minted the operation
+    cause: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -111,6 +123,12 @@ class OperationLog:
     def last_index(self) -> int:
         raise NotImplementedError
 
+    def contains(self, operation_id: str) -> bool:
+        """Is an operation with this id already journaled? The cluster
+        commander's replay dedup (ISSUE 20): a retried command whose first
+        attempt committed must NOT re-apply."""
+        raise NotImplementedError
+
     def trim_before(self, commit_time: float) -> int:
         """Drop old records (≈ DbOperationLogTrimmer). Returns removed count."""
         raise NotImplementedError
@@ -122,15 +140,22 @@ class OperationLog:
 class InMemoryOperationLog(OperationLog):
     def __init__(self):
         self._records: List[OperationRecord] = []
+        self._ids: dict = {}  # operation id -> record (the INSERT OR IGNORE analog)
         self._lock = threading.Lock()
 
     def append(self, record: OperationRecord) -> OperationRecord:
         with self._lock:
+            # id-dedup mirrors the sqlite INSERT OR IGNORE: a replayed
+            # operation (same id) journals once, never twice
+            existing = self._ids.get(record.id)
+            if existing is not None:
+                return existing
             rec = OperationRecord(
                 record.id, record.agent_id, record.commit_time, record.command,
-                record.items, index=len(self._records) + 1,
+                record.items, index=len(self._records) + 1, cause=record.cause,
             )
             self._records.append(rec)
+            self._ids[rec.id] = rec
             return rec
 
     def read_after(self, index: int, limit: int = 1024) -> List[OperationRecord]:
@@ -141,11 +166,16 @@ class InMemoryOperationLog(OperationLog):
         with self._lock:
             return self._records[-1].index if self._records else 0
 
+    def contains(self, operation_id: str) -> bool:
+        with self._lock:
+            return operation_id in self._ids
+
     def trim_before(self, commit_time: float) -> int:
         with self._lock:
             keep = [r for r in self._records if r.commit_time >= commit_time]
             removed = len(self._records) - len(keep)
             self._records = keep
+            self._ids = {r.id: r for r in keep}
             return removed
 
 
@@ -202,14 +232,14 @@ class SqliteOperationLog(OperationLog):
             idx = cur.lastrowid or 0
             return OperationRecord(
                 record.id, record.agent_id, record.commit_time, record.command,
-                record.items, index=idx,
+                record.items, index=idx, cause=record.cause,
             )
 
     def read_after(self, index: int, limit: int = 1024) -> List[OperationRecord]:
         with self._lock:
             rows = self._conn.execute(
-                "SELECT idx, id, agent_id, commit_time, command_json, items_json"
-                " FROM operations WHERE idx > ? ORDER BY idx LIMIT ?",
+                "SELECT idx, id, agent_id, commit_time, command_json, items_json,"
+                " cause_id FROM operations WHERE idx > ? ORDER BY idx LIMIT ?",
                 (index, limit),
             ).fetchall()
         out: List[OperationRecord] = []
@@ -223,6 +253,7 @@ class SqliteOperationLog(OperationLog):
                         command=decode(json.loads(r[4])),
                         items=tuple(decode(json.loads(r[5]))),
                         index=r[0],
+                        cause=r[6],
                     )
                 )
             except Exception as e:  # noqa: BLE001 — torn/garbled row: surface,
@@ -235,6 +266,13 @@ class SqliteOperationLog(OperationLog):
         with self._lock:
             row = self._conn.execute("SELECT MAX(idx) FROM operations").fetchone()
             return row[0] or 0
+
+    def contains(self, operation_id: str) -> bool:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT 1 FROM operations WHERE id = ? LIMIT 1", (operation_id,)
+            ).fetchone()
+            return row is not None
 
     def trim_before(self, commit_time: float) -> int:
         with self._lock:

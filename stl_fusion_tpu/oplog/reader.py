@@ -259,7 +259,21 @@ class OperationLogReader(WorkerBase):
                             id=rec.id,
                             commit_time=rec.commit_time,
                             items=list(rec.items),
+                            cause_id=rec.cause,
                         )
+                        if rec.cause:
+                            # cross-host command attribution (ISSUE 20): the
+                            # origin member journaled the command span's
+                            # cause id; teaching the local trace store the
+                            # label lets stitch()/explain() on THIS host
+                            # name the originating command too
+                            from ..diagnostics.mesh_telemetry import global_mesh_trace
+
+                            global_mesh_trace().note_command(
+                                rec.cause,
+                                f"{type(rec.command).__name__} "
+                                f"(op {rec.id[:8]}, agent {rec.agent_id})",
+                            )
                         if RECORDER.enabled:
                             # the flight-journal join point for cross-host
                             # causality: explain() resolves "via oplog entry
@@ -363,6 +377,7 @@ def attach_operation_log(
             commit_time=operation.commit_time or time.time(),
             command=operation.command,
             items=tuple(operation.items),
+            cause=getattr(operation, "cause_id", None),
         )
         log_store.append(self_rec)
         if notifier is not None:

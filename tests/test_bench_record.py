@@ -209,6 +209,29 @@ def test_compact_record_stays_under_tail_window():
         "audit": {"keys_audited": 128, "stale": 0, "violations": 0,
                   "canary_staleness_ms": 0.31},
     }
+    write = {
+        "ok": True,
+        "smoke": False,
+        "carts": 2048, "writers": 32, "members": 3, "sessions": 2000,
+        "main": {"ops": 11_968, "writes_per_s": 134.4,
+                 "cmd_visible_p50_ms": 812.2, "cmd_visible_p99_ms": 2521.4,
+                 "visible_samples": 2_992},
+        "storm": {"ops": 1_984, "writes_per_s": 98.1,
+                  "cmd_visible_p99_ms": 1402.7},
+        "reshard": {"ops": 1_472, "joined": "m3", "epoch": [4, 6],
+                    "retries": 5},
+        "kill": {"ops": 1_472, "victim": "m1", "retries": 36,
+                 "writes_per_s": 88.2},
+        "dedup": {"replayed": 32, "absorbed": 32},
+        "fusion": {"probe_waves": 6, "fused_dispatches": 2},
+        "pipeline": {"waves_submitted": 16_902, "fused_dispatches": 411,
+                     "eager_waves": 0},
+        "total_writes": 16_902,
+        "journal_rows": 16_902,
+        "slo": [{"name": "write.cmd_visible_p99", "value": 2521.4,
+                 "ceiling": 20_000, "unit": "ms", "ok": True},
+                {"name": "final.lost", "value": 0, "want": 0, "ok": True}],
+    }
     lint = {
         "ok": True,
         "findings": 0,
@@ -221,7 +244,7 @@ def test_compact_record_stays_under_tail_window():
     }
     line = json.dumps(
         _compact_result(7.07e9, detail, live, edge=edge, mesh=mesh,
-                        traffic=traffic, lint=lint),
+                        traffic=traffic, lint=lint, write=write),
         separators=(",", ":"),
     )
     # window raised 3700 → 4000 for the ISSUE 15 multihost fields, then
@@ -230,9 +253,11 @@ def test_compact_record_stays_under_tail_window():
     # → 4900 for the ISSUE 18 observability block (the fleet-telemetry
     # merge verdict + the stitched-wave digest incl. its straggler
     # table), then → 5300 for the ISSUE 19 health plane (the mesh
-    # burn-rate verdict + the per-domain hot-key digest) — still
+    # burn-rate verdict + the per-domain hot-key digest), then → 5700
+    # for the ISSUE 20 write plane (throughput, command→visible p50/p99,
+    # the adversarial-leg retries and the integrity verdicts) — still
     # comfortably inside the driver's bounded stdout tail
-    assert len(line) < 5300, f"compact record grew to {len(line)} bytes"
+    assert len(line) < 5700, f"compact record grew to {len(line)} bytes"
     d = json.loads(line)
     # the edge tier (ISSUE 8): the million-subscriber numbers make the capture
     assert d["edge"]["subs"] == 1_000_000 and d["edge"]["fenced_per_s"] == 412346
@@ -326,6 +351,18 @@ def test_compact_record_stays_under_tail_window():
     assert d["traffic"]["reconnect_resumed"] == 10_000
     assert d["traffic"]["reshard_p99_ms"] == 512.1
     assert d["traffic"]["audit_violations"] == 0
+    # the write plane (ISSUE 20): throughput, command→client-visible
+    # latency, the adversarial-leg retry counts, and the integrity
+    # verdicts (lost/double-applied/eager all zero) ride the capture
+    assert d["write"]["ok"] is True
+    assert d["write"]["total_writes"] == 16_902
+    assert d["write"]["writes_per_s"] == 134.4
+    assert d["write"]["cmd_visible_p99_ms"] == 2521.4
+    assert d["write"]["storm_p99_ms"] == 1402.7
+    assert d["write"]["kill_retries"] == 36
+    assert d["write"]["dedup_absorbed"] == 32
+    assert d["write"]["eager_waves"] == 0
+    assert d["write"]["slo_failed"] == []
     # the static gate (ISSUE 13): the lint verdict + per-rule suppression
     # counts + baseline size ride the capture (a growing suppression or
     # grandfathered set must be visible in the canonical record)

@@ -26,6 +26,7 @@ from stl_fusion_tpu.diagnostics.mesh_telemetry import MeshTraceStore
 # and stalls 6 ms at level 1 on shard 37.
 STITCHED = {
     "cause": "w#gold",
+    "command": "KvSet (op 1a2b3c4d, member h0)",
     "hosts": ["h0", "h1"],
     "partial": False,
     "missing_hosts": [],
@@ -60,6 +61,7 @@ STITCHED = {
 
 GOLDEN = """\
 == wave w#gold ==
+command : KvSet (op 1a2b3c4d, member h0)
 hosts   : h0, h1 (complete)
 duration: 20.000 ms, 5 segment(s), 3 level(s)
 paced by: host h1 shard 37 at level 1 (6.000 ms stall)
@@ -104,6 +106,24 @@ def test_render_compact_digest_summary_only():
     assert "36 segment(s), 9 level(s)" in text
     assert "timeline" not in text  # no per-segment lanes in digest mode
     assert "h1       13             3           9.567" in text
+
+
+def test_stitch_attributes_originating_command():
+    # ISSUE 20: a cause labeled via note_command (commander locally, oplog
+    # reader on replay hosts) rides the stitched dict into the renderer
+    store = MeshTraceStore()
+    store.record(cause="w#cmd", host="h0", phase="a2a", level=0, shard=1,
+                 t0=10.0, t1=10.002)
+    store.note_command("w#cmd", "AddItem (op deadbeef, member h0)")
+    stitched = store.stitch("w#cmd")
+    assert stitched["command"] == "AddItem (op deadbeef, member h0)"
+    assert "command : AddItem (op deadbeef, member h0)" in render(stitched)
+    # an unlabeled cause stays renderer-compatible: no command key, no line
+    store.record(cause="w#anon", host="h0", phase="a2a", level=0, shard=1,
+                 t0=11.0, t1=11.002)
+    anon = store.stitch("w#anon")
+    assert "command" not in anon
+    assert "command :" not in render(anon)
 
 
 def test_render_matches_real_stitch():

@@ -209,6 +209,11 @@ def render(trace: dict, width: int = 72) -> str:
     state = "PARTIAL, missing %s" % ",".join(trace.get("missing_hosts") or []) \
         if trace.get("partial") else "complete"
     out.append(f"== wave {cause} ==")
+    command = trace.get("command")
+    if command:
+        # ISSUE 20: stitched timelines attribute back to the originating
+        # command (the oplog carries the cause id both directions)
+        out.append(f"command : {command}")
     out.append(f"hosts   : {', '.join(hosts)} ({state})")
     n_levels = len(levels) if isinstance(levels, list) else levels
     out.append(
